@@ -1,0 +1,26 @@
+"""pydcop_tpu — a TPU-native DCOP framework.
+
+A from-scratch re-design of pyDCOP's capabilities (reference:
+/root/reference, bladeXue/pyDcop) built TPU-first on JAX/XLA:
+
+- the *problem model* (domains, variables, constraints, agents, YAML
+  formats) is pure Python and format-compatible with the reference
+  (``/root/reference/docs/usage/file_formats/dcop_format.yml``);
+- the *execution engine* compiles a DCOP + computation graph into dense,
+  padded, bucketed arrays and runs message-passing algorithms as jitted
+  bulk-synchronous supersteps (``lax.scan`` over a functional state), with
+  sharding over a ``jax.sharding.Mesh`` replacing the reference's
+  thread-per-agent runtime (reference: pydcop/infrastructure/agents.py:78);
+- an agent-mode runtime (threads + in-process / HTTP transports) is kept
+  for parity with the reference's distributed deployment model.
+"""
+
+__version__ = "0.1.0"
+
+
+def solve(*args, **kwargs):
+    """Shortcut for :func:`pydcop_tpu.api.solve` (lazy import to keep
+    modeling-only imports light)."""
+    from pydcop_tpu.api import solve as _solve
+
+    return _solve(*args, **kwargs)

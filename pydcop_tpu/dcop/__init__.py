@@ -1,0 +1,21 @@
+"""DCOP problem modeling: domains, variables, constraints, agents, YAML IO.
+
+Reference parity: pydcop/dcop/ (objects.py, relations.py, dcop.py,
+yamldcop.py, scenario.py).
+"""
+
+from pydcop_tpu.dcop.objects import (  # noqa: F401
+    AgentDef,
+    BinaryVariable,
+    Domain,
+    ExternalVariable,
+    Variable,
+    VariableDomain,
+    VariableNoisyCostFunc,
+    VariableWithCostDict,
+    VariableWithCostFunc,
+    create_agents,
+    create_binary_variables,
+    create_variables,
+)
+from pydcop_tpu.dcop.dcop import DCOP  # noqa: F401

@@ -1,0 +1,11 @@
+"""Distribution layer: mapping computations onto agents.
+
+Reference parity: pydcop/distribution/ — every method module exposes
+``distribute(computation_graph, agentsdef, hints, computation_memory,
+communication_load) -> Distribution`` and most expose
+``distribution_cost(...)``.
+
+TPU-native addition: distribution doubles as the shard-balancing pass for
+the device engine (see pydcop_tpu.engine.sharding) — the same cost hooks
+drive per-device shard assignment instead of per-agent placement.
+"""

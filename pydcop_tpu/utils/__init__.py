@@ -1,0 +1,5 @@
+"""Utility layer: serialization mixin, expression compiler, graph helpers.
+
+Reference parity: pydcop/utils/ (simple_repr.py, expressionfunction.py,
+graphs.py, various.py).
+"""

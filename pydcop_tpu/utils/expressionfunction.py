@@ -1,0 +1,183 @@
+"""Compile python expression strings into callables — powers YAML
+``intention:`` constraints.
+
+Reference parity: pydcop/utils/expressionfunction.py:40 (``ExpressionFunction``:
+AST variable-name scan :218, partial application, external source files).
+
+Two forms are accepted (matching the DCOP YAML format spec,
+docs/usage/file_formats/dcop_format.yml in the reference):
+
+- a single python expression: ``"1 if v1 == v2 else 0"``;
+- a function body containing ``return`` statements (multi-line YAML string),
+  which is wrapped into a generated ``def``.
+
+The names the function depends on are discovered by scanning the AST for
+loaded-but-never-assigned names, excluding builtins and the modules made
+available in the evaluation scope (``math``, ``random``, and — for external
+source files — ``source``).
+"""
+
+import ast
+import builtins
+import importlib.util
+import math
+import random
+import textwrap
+from typing import Iterable, Optional
+
+_SCOPE_MODULES = {"math": math, "random": random}
+
+
+def _free_names(tree: ast.AST) -> list:
+    loads, stores = [], set()
+    nodes = sorted(
+        (n for n in ast.walk(tree) if isinstance(n, ast.Name)),
+        key=lambda n: (n.lineno, n.col_offset),
+    )
+    for node in nodes:
+        if isinstance(node.ctx, ast.Load):
+            if node.id not in loads:
+                loads.append(node.id)
+        else:
+            stores.add(node.id)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stores.add(node.name)
+            for a in node.args.args + node.args.kwonlyargs:
+                stores.add(a.arg)
+    reserved = set(dir(builtins)) | set(_SCOPE_MODULES) | {"source"}
+    return [n for n in loads if n not in stores and n not in reserved]
+
+
+def _load_source_module(path: str):
+    spec = importlib.util.spec_from_file_location("_dcop_ext_source", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+class ExpressionFunction:
+    """A callable built from a python expression (or function-body) string.
+
+    >>> f = ExpressionFunction("a + b * 2")
+    >>> sorted(f.variable_names)
+    ['a', 'b']
+    >>> f(a=1, b=2)
+    5
+    >>> g = f.partial(b=3)
+    >>> list(g.variable_names)
+    ['a']
+    >>> g(a=1)
+    7
+    """
+
+    def __init__(
+        self,
+        expression: str,
+        source_file: Optional[str] = None,
+        **fixed_vars,
+    ):
+        self._expression = expression
+        self._source_file = source_file
+        self._fixed_vars = dict(fixed_vars)
+
+        self._scope = dict(_SCOPE_MODULES)
+        if source_file:
+            self._scope["source"] = _load_source_module(source_file)
+
+        stripped = textwrap.dedent(expression).strip()
+        try:
+            tree = ast.parse(stripped, mode="eval")
+            self._is_body = False
+        except SyntaxError:
+            tree = ast.parse(
+                "def __expr__():\n" + textwrap.indent(textwrap.dedent(expression), "    ")
+            )
+            self._is_body = True
+
+        names = _free_names(tree)
+        self._all_names = [n for n in names]
+        self._variable_names = [n for n in names if n not in self._fixed_vars]
+
+        if self._is_body:
+            src = "def __expr__({}):\n{}".format(
+                ", ".join(self._all_names),
+                textwrap.indent(textwrap.dedent(expression), "    "),
+            )
+            g = dict(self._scope)
+            g["__builtins__"] = builtins
+            exec(compile(src, "<dcop_expression>", "exec"), g)
+            self._func = g["__expr__"]
+            self._code = None
+        else:
+            self._func = None
+            self._code = compile(stripped, "<dcop_expression>", "eval")
+
+    @property
+    def expression(self) -> str:
+        return self._expression
+
+    @property
+    def source_file(self) -> Optional[str]:
+        return self._source_file
+
+    @property
+    def variable_names(self) -> Iterable[str]:
+        """Names the function still depends on (fixed vars excluded)."""
+        return list(self._variable_names)
+
+    @property
+    def fixed_vars(self) -> dict:
+        return dict(self._fixed_vars)
+
+    @property
+    def __name__(self):
+        return self._expression
+
+    def __call__(self, *args, **kwargs):
+        if args:
+            kwargs.update(zip(self._variable_names, args))
+        scope = dict(self._fixed_vars)
+        scope.update(kwargs)
+        if self._is_body:
+            return self._func(**{n: scope[n] for n in self._all_names})
+        g = dict(self._scope)
+        g["__builtins__"] = builtins
+        return eval(self._code, g, scope)
+
+    def partial(self, **kwargs):
+        fixed = dict(self._fixed_vars)
+        fixed.update(kwargs)
+        return ExpressionFunction(
+            self._expression, source_file=self._source_file, **fixed
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ExpressionFunction)
+            and self._expression == other._expression
+            and self._fixed_vars == other._fixed_vars
+        )
+
+    def __hash__(self):
+        return hash((self._expression, tuple(sorted(self._fixed_vars.items()))))
+
+    def __repr__(self):
+        return f"ExpressionFunction({self._expression!r})"
+
+    def _simple_repr(self):
+        return {
+            "__module__": self.__class__.__module__,
+            "__qualname__": self.__class__.__qualname__,
+            "expression": self._expression,
+            "source_file": self._source_file,
+            "fixed_vars": dict(self._fixed_vars),
+        }
+
+    @classmethod
+    def _from_repr(cls, r):
+        return cls(
+            r["expression"],
+            source_file=r.get("source_file"),
+            **r.get("fixed_vars", {}),
+        )

@@ -1,0 +1,99 @@
+"""JSON-able object serialization — the wire format for messages and defs.
+
+Reference parity: pydcop/utils/simple_repr.py:68 (``SimpleRepr`` mixin),
+:133 (``simple_repr``), :175 (``from_repr``).
+
+An object opts in by mixing in :class:`SimpleRepr`.  Its repr is a plain
+dict ``{"__module__": ..., "__qualname__": ..., <arg>: <repr>...}`` where
+the args are discovered from the ``__init__`` signature and read back from
+attributes of the same name (``self.<arg>`` or ``self._<arg>``).  The
+inverse, :func:`from_repr`, imports the class and calls ``__init__`` with
+the decoded args.  This keeps every message / definition JSON- and
+YAML-serializable without a schema registry.
+"""
+
+import importlib
+import inspect
+from typing import Any
+
+
+class SimpleReprException(Exception):
+    pass
+
+
+class SimpleRepr:
+    """Mixin providing automatic ``_simple_repr`` from the init signature.
+
+    Subclasses whose init args do not map 1:1 to attributes may either set
+    ``_repr_mapping = {arg_name: attr_name}`` or override ``_simple_repr``.
+    """
+
+    _repr_mapping: dict = {}
+
+    def _simple_repr(self):
+        r = {
+            "__module__": self.__class__.__module__,
+            "__qualname__": self.__class__.__qualname__,
+        }
+        sig = inspect.signature(self.__init__)
+        for name, param in sig.parameters.items():
+            if name in ("self", "args", "kwargs"):
+                continue
+            attr = self._repr_mapping.get(name, name)
+            if hasattr(self, attr):
+                val = getattr(self, attr)
+            elif hasattr(self, "_" + attr):
+                val = getattr(self, "_" + attr)
+            elif param.default is not inspect.Parameter.default and (
+                param.default is not inspect.Parameter.empty
+            ):
+                val = param.default
+            else:
+                raise SimpleReprException(
+                    f"Cannot build repr for {self!r}: no attribute for init "
+                    f"argument {name!r} (tried {attr!r} and '_{attr}')"
+                )
+            r[name] = simple_repr(val)
+        return r
+
+
+def simple_repr(o: Any):
+    """Return a JSON-able representation of `o` (recursively)."""
+    if o is None or isinstance(o, (str, int, float, bool)):
+        return o
+    if isinstance(o, (list, tuple)):
+        return [simple_repr(i) for i in o]
+    if isinstance(o, set):
+        return [simple_repr(i) for i in o]
+    if isinstance(o, dict):
+        return {k: simple_repr(v) for k, v in o.items()}
+    if hasattr(o, "_simple_repr"):
+        return o._simple_repr()
+    raise SimpleReprException(
+        f"Object {o!r} of type {type(o)} has no simple repr (missing "
+        "SimpleRepr mixin?)"
+    )
+
+
+def from_repr(r: Any):
+    """Rebuild an object from its simple repr (inverse of simple_repr)."""
+    if r is None or isinstance(r, (str, int, float, bool)):
+        return r
+    if isinstance(r, list):
+        return [from_repr(i) for i in r]
+    if isinstance(r, dict):
+        if "__module__" in r and "__qualname__" in r:
+            module = importlib.import_module(r["__module__"])
+            qualname = r["__qualname__"]
+            cls = module
+            for part in qualname.split("."):
+                cls = getattr(cls, part)
+            if hasattr(cls, "_from_repr"):
+                args = {k: v for k, v in r.items() if not k.startswith("__")}
+                return cls._from_repr(args)
+            args = {
+                k: from_repr(v) for k, v in r.items() if not k.startswith("__")
+            }
+            return cls(**args)
+        return {k: from_repr(v) for k, v in r.items()}
+    raise SimpleReprException(f"Cannot rebuild object from repr {r!r}")

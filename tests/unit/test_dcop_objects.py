@@ -1,0 +1,176 @@
+"""Unit tests for domains, variables and agent definitions.
+
+Mirrors the reference's test strategy (tests/unit/test_dcop_objects.py):
+pure in-memory, no runtime.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    BinaryVariable,
+    Domain,
+    ExternalVariable,
+    Variable,
+    VariableNoisyCostFunc,
+    VariableWithCostDict,
+    VariableWithCostFunc,
+    create_agents,
+    create_binary_variables,
+    create_variables,
+)
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+class TestDomain:
+    def test_basics(self):
+        d = Domain("colors", "color", ["R", "G", "B"])
+        assert len(d) == 3
+        assert d.index("G") == 1
+        assert list(d) == ["R", "G", "B"]
+        assert d[2] == "B"
+        assert "R" in d
+
+    def test_to_domain_value_from_str(self):
+        d = Domain("d", "", [1, 2, 3])
+        assert d.to_domain_value("2") == (1, 2)
+
+    def test_to_domain_value_unknown_raises(self):
+        d = Domain("d", "", [1, 2, 3])
+        with pytest.raises(ValueError):
+            d.to_domain_value("9")
+
+    def test_equality_and_hash(self):
+        d1 = Domain("d", "t", [0, 1])
+        d2 = Domain("d", "t", [0, 1])
+        assert d1 == d2
+        assert hash(d1) == hash(d2)
+
+    def test_simple_repr_roundtrip(self):
+        d = Domain("d", "t", [0, 1, 2])
+        assert from_repr(simple_repr(d)) == d
+
+
+class TestVariable:
+    def test_basics(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = Variable("v1", d, initial_value=1)
+        assert v.initial_value == 1
+        assert v.cost_for_val(0) == 0
+
+    def test_bad_initial_value(self):
+        d = Domain("d", "", [0, 1])
+        with pytest.raises(ValueError):
+            Variable("v1", d, initial_value=5)
+
+    def test_list_domain_wrapped(self):
+        v = Variable("v1", [0, 1, 2])
+        assert len(v.domain) == 3
+
+    def test_cost_func(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = VariableWithCostFunc("v1", d, "v1 * 0.5")
+        assert v.cost_for_val(2) == 1.0
+        assert list(v.cost_vector()) == [0, 0.5, 1.0]
+
+    def test_cost_func_wrong_variable_raises(self):
+        d = Domain("d", "", [0, 1])
+        with pytest.raises(ValueError):
+            VariableWithCostFunc("v1", d, "other * 0.5")
+
+    def test_cost_dict(self):
+        d = Domain("d", "", ["a", "b"])
+        v = VariableWithCostDict("v1", d, {"a": 1.0, "b": 2.0})
+        assert v.cost_for_val("b") == 2.0
+
+    def test_noisy_cost_is_deterministic(self):
+        d = Domain("d", "", [0, 1, 2])
+        v1 = VariableNoisyCostFunc("v1", d, "v1 * 0.5", noise_level=0.1)
+        v2 = VariableNoisyCostFunc("v1", d, "v1 * 0.5", noise_level=0.1)
+        assert v1.cost_for_val(1) == v2.cost_for_val(1)
+        assert 0.5 <= v1.cost_for_val(1) < 0.6
+
+    def test_noisy_cost_differs_across_vars(self):
+        d = Domain("d", "", [0, 1, 2])
+        v1 = VariableNoisyCostFunc("v1", d, "v1 * 0", noise_level=0.1)
+        v2 = VariableNoisyCostFunc("v2", d, "v2 * 0", noise_level=0.1)
+        assert v1.cost_for_val(1) != v2.cost_for_val(1)
+
+    def test_binary_variable(self):
+        v = BinaryVariable("b1")
+        assert list(v.domain) == [0, 1]
+
+    def test_external_variable_fires_callbacks(self):
+        d = Domain("d", "", [True, False])
+        ev = ExternalVariable("e1", d, value=True)
+        seen = []
+        ev.subscribe(seen.append)
+        ev.value = False
+        assert seen == [False]
+        ev.value = False  # no change, no fire
+        assert seen == [False]
+
+    def test_simple_repr_roundtrip_cost_func(self):
+        d = Domain("d", "", [0, 1, 2])
+        v = VariableWithCostFunc("v1", d, "v1 * 0.5", initial_value=1)
+        v2 = from_repr(simple_repr(v))
+        assert v2.name == "v1"
+        assert v2.cost_for_val(2) == 1.0
+
+
+class TestCreateVariables:
+    def test_from_str_list(self):
+        d = Domain("d", "", [0, 1])
+        vs = create_variables("x_", ["a", "b"], d)
+        assert set(vs) == {"x_a", "x_b"}
+        assert vs["x_a"].name == "x_a"
+
+    def test_from_ranges(self):
+        d = Domain("d", "", [0, 1])
+        vs = create_variables("v", [range(2), range(3)], d)
+        assert len(vs) == 6
+        assert vs[(1, 2)].name == "v1_2"
+
+    def test_binary(self):
+        vs = create_binary_variables("b_", [["c1", "c2"], ["a1"]])
+        assert vs[("c1", "a1")].name == "b_c1_a1"
+
+
+class TestAgentDef:
+    def test_defaults(self):
+        a = AgentDef("a1")
+        assert a.capacity == 100
+        assert a.route("a2") == 1
+        assert a.route("a1") == 0
+        assert a.hosting_cost("c1") == 0
+
+    def test_extras(self):
+        a = AgentDef("a1", capacity=42, foo="bar")
+        assert a.capacity == 42
+        assert a.foo == "bar"
+        with pytest.raises(AttributeError):
+            a.baz
+
+    def test_costs_routes(self):
+        a = AgentDef(
+            "a1",
+            default_hosting_cost=5,
+            hosting_costs={"c1": 10},
+            default_route=2,
+            routes={"a2": 7},
+        )
+        assert a.hosting_cost("c1") == 10
+        assert a.hosting_cost("cX") == 5
+        assert a.route("a2") == 7
+        assert a.route("a3") == 2
+
+    def test_simple_repr_roundtrip(self):
+        a = AgentDef("a1", capacity=42, hosting_costs={"c1": 10})
+        a2 = from_repr(simple_repr(a))
+        assert a2 == a
+
+    def test_create_agents(self):
+        agts = create_agents("a", range(3), capacity=50)
+        assert set(agts) == {"a0", "a1", "a2"}
+        assert agts["a1"].capacity == 50

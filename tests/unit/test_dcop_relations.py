@@ -1,0 +1,220 @@
+"""Unit tests for the constraint algebra (join/projection = DPOP math)."""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import (
+    AsNAryFunctionRelation,
+    ConditionalRelation,
+    NAryFunctionRelation,
+    NAryMatrixRelation,
+    NeutralRelation,
+    UnaryBooleanRelation,
+    UnaryFunctionRelation,
+    ZeroAryRelation,
+    assignment_cost,
+    assignment_matrix,
+    constraint_from_str,
+    find_arg_optimal,
+    find_optimal,
+    find_optimum,
+    generate_assignment_as_dict,
+    join,
+    optimal_cost_value,
+    projection,
+)
+
+d3 = Domain("d3", "", [0, 1, 2])
+x = Variable("x", d3)
+y = Variable("y", d3)
+z = Variable("z", d3)
+
+
+class TestBasicRelations:
+    def test_zero_ary(self):
+        r = ZeroAryRelation("r", 42)
+        assert r() == 42
+        assert r.arity == 0
+
+    def test_unary_function(self):
+        r = UnaryFunctionRelation("r", x, lambda v: v * 2)
+        assert r(2) == 4
+        assert r(x=2) == 4
+
+    def test_unary_expression(self):
+        r = UnaryFunctionRelation("r", x, "x + 1")
+        assert r(x=1) == 2
+
+    def test_unary_boolean(self):
+        b = Variable("b", Domain("db", "", [True, False]))
+        r = UnaryBooleanRelation("r", b)
+        assert r(True) == 1
+        assert r(False) == 0
+
+    def test_nary_function(self):
+        r = NAryFunctionRelation(lambda a, b: a + b, [x, y], name="sum")
+        assert r(1, 2) == 3
+        assert r(x=1, y=2) == 3
+        assert r.arity == 2
+
+    def test_nary_expression(self):
+        r = constraint_from_str("r", "x * y + z", [x, y, z])
+        assert r.scope_names == ["x", "y", "z"]
+        assert r(x=2, y=2, z=1) == 5
+
+    def test_decorator(self):
+        @AsNAryFunctionRelation(x, y)
+        def my_rel(x, y):
+            return abs(x - y)
+
+        assert my_rel.name == "my_rel"
+        assert my_rel(0, 2) == 2
+
+    def test_neutral(self):
+        r = NeutralRelation([x, y])
+        assert r(x=1, y=2) == 0
+        assert np.all(r.to_array() == 0)
+
+    def test_conditional(self):
+        cond = UnaryFunctionRelation("cond", x, "x > 1")
+        rel = UnaryFunctionRelation("rel", y, "y * 10")
+        r = ConditionalRelation(cond, rel)
+        assert r(x=2, y=1) == 10
+        assert r(x=0, y=1) == 0
+
+    def test_slice_function_relation(self):
+        r = constraint_from_str("r", "x * 10 + y", [x, y])
+        s = r.slice({"x": 2})
+        assert s.scope_names == ["y"]
+        assert s(y=1) == 21
+
+
+class TestMatrixRelation:
+    def test_build_and_call(self):
+        m = np.arange(9).reshape(3, 3)
+        r = NAryMatrixRelation([x, y], m, "r")
+        assert r(x=1, y=2) == 5
+        assert r.get_value_for_assignment([2, 0]) == 6
+
+    def test_shape_check(self):
+        with pytest.raises(ValueError):
+            NAryMatrixRelation([x, y], np.zeros((2, 3)), "r")
+
+    def test_set_value(self):
+        r = NAryMatrixRelation([x, y], name="r")
+        r2 = r.set_value_for_assignment({"x": 0, "y": 1}, 5)
+        assert r2(x=0, y=1) == 5
+        assert r(x=0, y=1) == 0  # immutable
+
+    def test_slice(self):
+        m = np.arange(9).reshape(3, 3)
+        r = NAryMatrixRelation([x, y], m, "r")
+        s = r.slice({"x": 1})
+        assert s.scope_names == ["y"]
+        assert s(y=0) == 3
+
+    def test_from_func(self):
+        f = constraint_from_str("r", "x + y", [x, y])
+        r = NAryMatrixRelation.from_func_relation(f)
+        assert r(x=2, y=2) == 4
+
+    def test_simple_repr_roundtrip(self):
+        from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+        m = np.arange(9).reshape(3, 3)
+        r = NAryMatrixRelation([x, y], m, "r")
+        r2 = from_repr(simple_repr(r))
+        assert r2 == r
+
+
+class TestJoinProjection:
+    def test_join_shared_var(self):
+        r1 = NAryMatrixRelation.from_func_relation(
+            constraint_from_str("r1", "x + y", [x, y]))
+        r2 = NAryMatrixRelation.from_func_relation(
+            constraint_from_str("r2", "y * z", [y, z]))
+        j = join(r1, r2)
+        assert set(j.scope_names) == {"x", "y", "z"}
+        assert j(x=1, y=2, z=2) == (1 + 2) + (2 * 2)
+
+    def test_join_disjoint(self):
+        r1 = NAryMatrixRelation.from_func_relation(
+            constraint_from_str("r1", "x", [x]))
+        r2 = NAryMatrixRelation.from_func_relation(
+            constraint_from_str("r2", "z", [z]))
+        j = join(r1, r2)
+        assert j(x=1, z=2) == 3
+
+    def test_projection_min(self):
+        r = NAryMatrixRelation.from_func_relation(
+            constraint_from_str("r", "x + y", [x, y]))
+        p = projection(r, y, "min")
+        assert p.scope_names == ["x"]
+        assert p(x=2) == 2  # min over y of x+y = x+0
+
+    def test_projection_max(self):
+        r = NAryMatrixRelation.from_func_relation(
+            constraint_from_str("r", "x + y", [x, y]))
+        p = projection(r, x, "max")
+        assert p(y=1) == 3
+
+    def test_dpop_chain(self):
+        # join three constraints then eliminate two vars: classic UTIL pass
+        r1 = NAryMatrixRelation.from_func_relation(
+            constraint_from_str("r1", "1 if x == y else 0", [x, y]))
+        r2 = NAryMatrixRelation.from_func_relation(
+            constraint_from_str("r2", "1 if y == z else 0", [y, z]))
+        j = join(r1, r2)
+        p = projection(projection(j, z, "min"), y, "min")
+        assert p.scope_names == ["x"]
+        assert all(p(x=v) == 0 for v in d3)
+
+
+class TestHelpers:
+    def test_assignment_matrix(self):
+        m = assignment_matrix([x, y], default_value=7)
+        assert m.shape == (3, 3)
+        assert np.all(m == 7)
+
+    def test_generate_assignment_order(self):
+        assts = list(generate_assignment_as_dict([x, y]))
+        assert len(assts) == 9
+        # last variable varies fastest
+        assert assts[0] == {"x": 0, "y": 0}
+        assert assts[1] == {"x": 0, "y": 1}
+
+    def test_find_optimum(self):
+        r = constraint_from_str("r", "x + y", [x, y])
+        assert find_optimum(r, "min") == 0
+        assert find_optimum(r, "max") == 4
+
+    def test_find_arg_optimal_first_tie(self):
+        r = UnaryFunctionRelation("r", x, lambda v: 0)
+        vals, cost = find_arg_optimal(x, r, "min")
+        assert vals[0] == 0  # first in domain order
+        assert len(vals) == 3
+
+    def test_find_optimal(self):
+        c = constraint_from_str("c", "1 if x == y else 0", [x, y])
+        vals, cost = find_optimal(y, {"x": 1}, [c], "min")
+        assert cost == 0
+        assert vals == [0, 2]
+
+    def test_optimal_cost_value(self):
+        from pydcop_tpu.dcop.objects import VariableWithCostFunc
+
+        v = VariableWithCostFunc("v", d3, lambda val: (val - 1) ** 2)
+        val, cost = optimal_cost_value(v, "min")
+        assert (val, cost) == (1, 0)
+
+    def test_assignment_cost(self):
+        c1 = constraint_from_str("c1", "x + y", [x, y])
+        c2 = constraint_from_str("c2", "z", [z])
+        assert assignment_cost({"x": 1, "y": 1, "z": 2}, [c1, c2]) == 4
+
+    def test_assignment_cost_hard_violation(self):
+        c = constraint_from_str(
+            "c", "float('inf') if x == y else 0", [x, y])
+        with pytest.raises(ValueError):
+            assignment_cost({"x": 1, "y": 1}, [c])

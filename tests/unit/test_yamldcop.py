@@ -1,0 +1,322 @@
+"""YAML loader tests, including round-trips on the reference's fixtures.
+
+The fixture files under /root/reference/tests/instances are the parity
+oracle: our loader must accept them and produce the same problems.
+"""
+
+import glob
+import os
+
+import pytest
+
+from pydcop_tpu.dcop.objects import VariableNoisyCostFunc, VariableWithCostFunc
+from pydcop_tpu.dcop.yamldcop import (
+    dcop_yaml,
+    load_dcop,
+    load_dcop_from_file,
+    load_dist,
+    load_scenario,
+    yaml_dist,
+    yaml_scenario,
+)
+
+REF_INSTANCES = "/root/reference/tests/instances"
+
+
+def test_minimal():
+    dcop = load_dcop(
+        """
+name: test
+objective: min
+domains:
+  d1:
+    values: [0, 1, 2]
+variables:
+  v1:
+    domain: d1
+constraints:
+  c1:
+    type: intention
+    function: v1 * 2
+"""
+    )
+    assert dcop.name == "test"
+    assert list(dcop.domains["d1"].values) == [0, 1, 2]
+    assert dcop.constraint("c1")(v1=2) == 4
+
+
+def test_range_domain():
+    dcop = load_dcop(
+        """
+name: test
+objective: min
+domains:
+  d1:
+    values: [1 .. 5]
+variables:
+  v1: {domain: d1}
+"""
+    )
+    assert list(dcop.domains["d1"].values) == [1, 2, 3, 4, 5]
+
+
+def test_bool_domain():
+    dcop = load_dcop(
+        """
+name: test
+objective: min
+domains:
+  d1:
+    values: [true, false]
+variables:
+  v1: {domain: d1}
+"""
+    )
+    assert list(dcop.domains["d1"].values) == [True, False]
+
+
+def test_variable_cost_and_noise():
+    dcop = load_dcop(
+        """
+name: test
+objective: min
+domains:
+  d1: {values: [0, 1, 2]}
+variables:
+  v1:
+    domain: d1
+    cost_function: v1 * 0.5
+  v2:
+    domain: d1
+    cost_function: v2 * 2
+    noise_level: 0.1
+"""
+    )
+    v1, v2 = dcop.variable("v1"), dcop.variable("v2")
+    assert isinstance(v1, VariableWithCostFunc)
+    assert v1.cost_for_val(2) == 1.0
+    assert isinstance(v2, VariableNoisyCostFunc)
+    assert 2.0 <= v2.cost_for_val(1) < 2.1
+
+
+def test_multiline_function_constraint():
+    dcop = load_dcop(
+        """
+name: test
+objective: min
+domains:
+  d1: {values: [0, 1, 2]}
+variables:
+  v1: {domain: d1}
+constraints:
+  c1:
+    type: intention
+    function: |
+      if v1 == 2:
+          return 10
+      return v1
+"""
+    )
+    c = dcop.constraint("c1")
+    assert c(v1=2) == 10
+    assert c(v1=1) == 1
+
+
+def test_extensional_constraint():
+    dcop = load_dcop(
+        """
+name: test
+objective: min
+domains:
+  d1: {values: [1, 2, 3]}
+variables:
+  v1: {domain: d1}
+  v2: {domain: d1}
+constraints:
+  c1:
+    type: extensional
+    default: 100
+    variables: [v1, v2]
+    values:
+      10: 1 2 | 2 1
+      0: 3 3
+"""
+    )
+    c = dcop.constraint("c1")
+    assert c(v1=1, v2=2) == 10
+    assert c(v1=2, v2=1) == 10
+    assert c(v1=3, v2=3) == 0
+    assert c(v1=1, v2=1) == 100
+
+
+def test_external_variable_and_partial():
+    dcop = load_dcop(
+        """
+name: test
+objective: min
+domains:
+  d1: {values: [0, 1, 2]}
+  dbool: {values: [true, false]}
+variables:
+  v1: {domain: d1}
+  v2: {domain: d1}
+external_variables:
+  e1:
+    domain: dbool
+    initial_value: true
+constraints:
+  c1:
+    type: intention
+    function: v1 if e1 else 2
+  c2:
+    type: intention
+    function: v1 * 10 + v2
+    partial:
+      v2: 1
+"""
+    )
+    assert dcop.get_external_variable("e1").value is True
+    c2 = dcop.constraint("c2")
+    assert c2.scope_names == ["v1"]
+    assert c2(v1=2) == 21
+
+
+def test_agents_routes_hosting():
+    dcop = load_dcop(
+        """
+name: test
+objective: min
+domains:
+  d1: {values: [0, 1]}
+variables:
+  v1: {domain: d1}
+agents:
+  a1: {capacity: 100}
+  a2: {capacity: 50}
+  a3: {}
+routes:
+  default: 5
+  a1: {a2: 10}
+hosting_costs:
+  default: 1000
+  a1:
+    default: 7
+    computations: {v1: 3}
+"""
+    )
+    a1, a2, a3 = (dcop.agent(n) for n in ("a1", "a2", "a3"))
+    assert a2.capacity == 50
+    assert a1.route("a2") == 10
+    assert a2.route("a1") == 10  # symmetric
+    assert a1.route("a3") == 5
+    assert a1.hosting_cost("v1") == 3
+    assert a1.hosting_cost("other") == 7
+    assert a3.hosting_cost("v1") == 1000
+
+
+def test_duplicate_route_raises():
+    from pydcop_tpu.dcop.yamldcop import DcopInvalidFormatError
+
+    with pytest.raises(DcopInvalidFormatError):
+        load_dcop(
+            """
+name: test
+domains: {d1: {values: [0]}}
+variables: {v1: {domain: d1}}
+agents: [a1, a2]
+routes:
+  a1: {a2: 10}
+  a2: {a1: 6}
+"""
+        )
+
+
+def test_agents_as_list():
+    dcop = load_dcop(
+        """
+name: test
+domains: {d1: {values: [0]}}
+variables: {v1: {domain: d1}}
+agents: [a1, a2]
+"""
+    )
+    assert set(dcop.agents) == {"a1", "a2"}
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(REF_INSTANCES, "*.y*ml"))
+    ),
+)
+def test_load_reference_fixture(fixture):
+    """Every reference fixture must load without error."""
+    dcop = load_dcop_from_file(os.path.join(REF_INSTANCES, fixture))
+    assert dcop.name
+    assert dcop.variables
+
+
+def test_reference_graph_coloring_semantics():
+    dcop = load_dcop_from_file(
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"))
+    assert dcop.objective == "min"
+    c = dcop.constraint("diff_1_2")
+    assert c(v1="R", v2="R") == 1
+    assert c(v1="R", v2="G") == 0
+    assert dcop.variable("v1").cost_for_val("R") == -0.1
+    cost, violations = dcop.solution_cost({"v1": "R", "v2": "G", "v3": "G"})
+    assert abs(cost - 0.7) < 1e-9
+    assert violations == 0
+    assert dcop.dist_hints.must_host("a1") == ["v1"]
+
+
+def test_external_python_constraint_fixture():
+    dcop = load_dcop_from_file(
+        os.path.join(REF_INSTANCES, "graph_coloring1_func.yaml"))
+    assert dcop.variables
+
+
+def test_roundtrip_through_dump():
+    src = load_dcop_from_file(
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"))
+    dumped = dcop_yaml(src)
+    again = load_dcop(dumped)
+    assert set(again.variables) == set(src.variables)
+    assert set(again.constraints) == set(src.constraints)
+    asst = {"v1": "R", "v2": "G", "v3": "G"}
+    assert again.solution_cost(asst) == src.solution_cost(asst)
+
+
+def test_scenario_roundtrip():
+    s = load_scenario(
+        """
+events:
+  - id: w
+    delay: 1
+  - id: e1
+    actions:
+      - type: remove_agent
+        agent: a2
+"""
+    )
+    assert len(s) == 2
+    assert s.events[0].is_delay
+    assert s.events[1].actions[0].type == "remove_agent"
+    assert s.events[1].actions[0].args == {"agent": "a2"}
+    s2 = load_scenario(yaml_scenario(s))
+    assert s2.events == s.events
+
+
+def test_distribution_roundtrip():
+    d = load_dist(
+        """
+distribution:
+  a0: []
+  a1: [v1, v2]
+"""
+    )
+    assert d.computations_hosted("a1") == ["v1", "v2"]
+    assert d.agent_for("v1") == "a1"
+    d2 = load_dist(yaml_dist(d))
+    assert d2 == d
