@@ -1,0 +1,145 @@
+"""Benchmark: MaxSum on 10k-variable graph coloring (the north-star
+config from BASELINE.json), device engine vs reference-style python loop.
+
+Prints ONE json line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The baseline is a faithful dict-based reimplementation of the reference's
+per-computation hot loop (factor_costs_for_var maxsum.py:382 +
+costs_for_factor :623: python dicts, per-assignment enumeration), timed
+on the same problem for a few cycles — the reference itself cannot run
+in this image (py3.12-incompatible imports, missing pulp).
+"""
+
+import json
+import time
+
+import numpy as np
+
+N_VARS = 10_000
+N_COLORS = 3
+DEVICE_CYCLES = 200
+BASELINE_CYCLES = 2
+
+
+def build_problem(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    eq = np.eye(N_COLORS, dtype=np.float32)
+    edges = []
+    seen = set()
+    for _ in range(int(N_VARS * 1.5)):
+        i, j = rng.choice(N_VARS, size=2, replace=False)
+        key = (min(i, j), max(i, j))
+        if key in seen:
+            continue
+        seen.add(key)
+        edges.append(key)
+    return edges, eq
+
+
+def bench_device(edges):
+    from pydcop_tpu.engine.compile import CompiledFactorGraph, FactorBucket
+    from pydcop_tpu.engine.runner import MaxSumEngine
+    from pydcop_tpu.engine.compile import FactorGraphMeta
+
+    n_f = len(edges)
+    costs = np.broadcast_to(
+        np.eye(N_COLORS, dtype=np.float32), (n_f, N_COLORS, N_COLORS)
+    ).copy()
+    var_ids = np.array(edges, dtype=np.int32)
+    var_costs = np.zeros((N_VARS + 1, N_COLORS), dtype=np.float32)
+    rng = np.random.default_rng(42)
+    var_costs[:N_VARS] = rng.random((N_VARS, N_COLORS)) * 0.01
+    var_costs[N_VARS] = 1e9
+    var_valid = np.ones((N_VARS + 1, N_COLORS), dtype=bool)
+    var_valid[N_VARS] = False
+    graph = CompiledFactorGraph(
+        var_costs=var_costs,
+        var_valid=var_valid,
+        buckets=(FactorBucket(costs, var_ids),),
+    )
+    meta = FactorGraphMeta(
+        var_names=tuple(f"v{i}" for i in range(N_VARS)),
+        domains=tuple(tuple(range(N_COLORS)) for _ in range(N_VARS)),
+        factor_names=tuple(f"c{k}" for k in range(n_f)),
+        bucket_sizes=(n_f,),
+        mode="min",
+    )
+    engine = MaxSumEngine(graph, meta)
+    # Warmup with the same program key so the timed run is compile-free:
+    engine.run(max_cycles=DEVICE_CYCLES, stop_on_convergence=False)
+    res = engine.run(max_cycles=DEVICE_CYCLES, stop_on_convergence=False)
+    elapsed = res.time_s
+    cps = DEVICE_CYCLES / elapsed
+    # Solution quality: conflicts at selected assignment.
+    vals = np.array(
+        [res.assignment[f"v{i}"] for i in range(N_VARS)], dtype=np.int64
+    )
+    conflicts = int(np.sum(vals[var_ids[:, 0]] == vals[var_ids[:, 1]]))
+    return cps, elapsed, conflicts
+
+
+def bench_python_reference_style(edges, var_costs_arr):
+    """Reference-semantics hot loop: dicts of dicts, python enumeration."""
+    dom = list(range(N_COLORS))
+    f2v = {}  # (f, side) -> {val: cost}
+    v2f = {}
+    var_factors = {}
+    for f, (i, j) in enumerate(edges):
+        var_factors.setdefault(i, []).append((f, 0))
+        var_factors.setdefault(j, []).append((f, 1))
+
+    t0 = time.perf_counter()
+    for _cycle in range(BASELINE_CYCLES):
+        # factor -> var (factor_costs_for_var semantics)
+        for f, (i, j) in enumerate(edges):
+            for side, (tgt, other) in enumerate(((i, j), (j, i))):
+                recv = v2f.get((f, 1 - side))
+                costs = {}
+                for d in dom:
+                    best = float("inf")
+                    for d2 in dom:
+                        val = 1.0 if d == d2 else 0.0
+                        if recv is not None:
+                            val += recv[d2]
+                        best = min(best, val)
+                    costs[d] = best
+                f2v[(f, side)] = costs
+        # var -> factor (costs_for_factor semantics, mean-normalized)
+        for v, incident in var_factors.items():
+            for f, side in incident:
+                msg = {d: var_costs_arr[v][d] for d in dom}
+                sum_cost = 0.0
+                for f2, side2 in incident:
+                    if (f2, side2) == (f, side):
+                        continue
+                    c2 = f2v.get((f2, side2))
+                    if c2 is None:
+                        continue
+                    for d in dom:
+                        msg[d] += c2[d]
+                        sum_cost += c2[d]
+                avg = sum_cost / len(dom)
+                v2f[(f, side)] = {d: msg[d] - avg for d in dom}
+    elapsed = time.perf_counter() - t0
+    return BASELINE_CYCLES / elapsed
+
+
+def main():
+    edges, _ = build_problem()
+    device_cps, elapsed, conflicts = bench_device(edges)
+
+    rng = np.random.default_rng(42)
+    var_costs_arr = rng.random((N_VARS, N_COLORS)) * 0.01
+    python_cps = bench_python_reference_style(edges, var_costs_arr)
+
+    print(json.dumps({
+        "metric": "maxsum_cycles_per_sec_10kvar_graphcoloring",
+        "value": round(device_cps, 2),
+        "unit": "cycles/s",
+        "vs_baseline": round(device_cps / python_cps, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

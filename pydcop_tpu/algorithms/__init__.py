@@ -1,0 +1,206 @@
+"""Algorithm plugin machinery: descriptors, parameter validation,
+module discovery.
+
+Reference parity: pydcop/algorithms/__init__.py (ALGO_STOP/CONTINUE :93,
+AlgoParameterDef :99, AlgorithmDef :141, ComputationDef :336,
+check_param_value :383, prepare_algo_params :446,
+list_available_algorithms :508, load_algorithm_module :528).
+
+The plugin contract (reference docs/implementation/algorithms.rst:18-241):
+an algorithm module declares ``GRAPH_TYPE``, optional ``algo_params``,
+``build_computation`` (agent mode), ``computation_memory``,
+``communication_load``; missing pieces get defaults injected at load.
+TPU addition to the contract: a module may declare
+``solve_on_device(dcop, algo_def, max_cycles, mesh, ...)`` — the batched
+engine path used when the backend is ``device``.  Drop a module in this
+package and it becomes a CLI ``--algo`` value.
+"""
+
+import importlib
+import pkgutil
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from pydcop_tpu.computations_graph.objects import ComputationNode
+from pydcop_tpu.utils.simple_repr import SimpleRepr, from_repr, simple_repr
+
+# Stop-condition semantics for agent-mode computations.
+ALGO_STOP = "stop"
+ALGO_CONTINUE = "continue"
+ALGO_NO_STOP_CONDITION = "no_stop_condition"
+
+
+class AlgoParameterDef(NamedTuple):
+    """Declaration of one algorithm parameter."""
+
+    name: str
+    type: str                       # 'str' | 'int' | 'float' | 'bool'
+    values: Optional[List] = None   # allowed values, or None
+    default_value: Any = None
+
+
+class AlgoParameterException(Exception):
+    pass
+
+
+def check_param_value(value: Any, param_def: AlgoParameterDef) -> Any:
+    """Coerce and validate a parameter value against its definition."""
+    if value is None:
+        return param_def.default_value
+    try:
+        if param_def.type == "int":
+            value = int(value)
+        elif param_def.type == "float":
+            value = float(value)
+        elif param_def.type == "bool":
+            if isinstance(value, str):
+                value = value.lower() in ("true", "1", "yes")
+            else:
+                value = bool(value)
+        elif param_def.type == "str":
+            value = str(value)
+    except (ValueError, TypeError):
+        raise AlgoParameterException(
+            f"Invalid value {value!r} for parameter {param_def.name} "
+            f"of type {param_def.type}"
+        )
+    if param_def.values is not None and value not in param_def.values:
+        raise AlgoParameterException(
+            f"Value {value!r} for parameter {param_def.name} not in "
+            f"allowed values {param_def.values}"
+        )
+    return value
+
+
+def prepare_algo_params(params: Dict[str, Any],
+                        params_defs: List[AlgoParameterDef]
+                        ) -> Dict[str, Any]:
+    """Full parameter dict: given values validated, defaults filled in.
+    Unknown parameter names raise."""
+    defs = {p.name: p for p in params_defs}
+    unknown = set(params) - set(defs)
+    if unknown:
+        raise AlgoParameterException(
+            f"Unknown algorithm parameter(s): {sorted(unknown)}; "
+            f"supported: {sorted(defs)}"
+        )
+    out = {}
+    for name, pdef in defs.items():
+        out[name] = check_param_value(params.get(name), pdef)
+    return out
+
+
+class AlgorithmDef(SimpleRepr):
+    """An algorithm selection: name + validated params + objective mode."""
+
+    def __init__(self, algo: str, params: Dict[str, Any],
+                 mode: str = "min"):
+        self._algo = algo
+        self._params = dict(params)
+        self._mode = mode
+
+    @classmethod
+    def build_with_default_param(cls, algo: str,
+                                 params: Optional[Dict] = None,
+                                 mode: str = "min",
+                                 parameters_definitions:
+                                 Optional[List[AlgoParameterDef]] = None,
+                                 ) -> "AlgorithmDef":
+        if parameters_definitions is None:
+            module = load_algorithm_module(algo)
+            parameters_definitions = module.algo_params
+        full = prepare_algo_params(params or {}, parameters_definitions)
+        return cls(algo, full, mode)
+
+    @property
+    def algo(self) -> str:
+        return self._algo
+
+    @property
+    def params(self) -> Dict[str, Any]:
+        return dict(self._params)
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def param_value(self, name: str) -> Any:
+        return self._params[name]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, AlgorithmDef)
+            and self._algo == other._algo
+            and self._params == other._params
+            and self._mode == other._mode
+        )
+
+    def __repr__(self):
+        return f"AlgorithmDef({self._algo}, {self._params}, {self._mode})"
+
+
+class ComputationDef(SimpleRepr):
+    """Everything needed to instantiate one computation: its node in the
+    computation graph + the algorithm to run on it."""
+
+    def __init__(self, node: ComputationNode, algo: AlgorithmDef):
+        self._node = node
+        self._algo = algo
+
+    @property
+    def node(self) -> ComputationNode:
+        return self._node
+
+    @property
+    def algo(self) -> AlgorithmDef:
+        return self._algo
+
+    @property
+    def name(self) -> str:
+        return self._node.name
+
+    def __repr__(self):
+        return f"ComputationDef({self.name}, {self._algo.algo})"
+
+
+def list_available_algorithms() -> List[str]:
+    """All algorithm modules in this package (plugin discovery)."""
+    import pydcop_tpu.algorithms as pkg
+
+    return sorted(
+        name
+        for _, name, ispkg in pkgutil.iter_modules(pkg.__path__)
+        if not ispkg and not name.startswith("_")
+    )
+
+
+def _default_computation_memory(node: ComputationNode) -> float:
+    return 0.0
+
+
+def _default_communication_load(src: ComputationNode,
+                                target: str) -> float:
+    return 1.0
+
+
+def load_algorithm_module(name: str):
+    """Import an algorithm module, injecting contract defaults for any
+    missing optional pieces (reference behavior, algorithms/__init__.py
+    :528-566)."""
+    module = importlib.import_module(f"pydcop_tpu.algorithms.{name}")
+    if not hasattr(module, "algo_params"):
+        module.algo_params = []
+    if not hasattr(module, "communication_load"):
+        module.communication_load = _default_communication_load
+    if not hasattr(module, "computation_memory"):
+        module.computation_memory = _default_computation_memory
+    if not hasattr(module, "GRAPH_TYPE"):
+        raise AttributeError(
+            f"Algorithm module {name} must declare GRAPH_TYPE"
+        )
+    return module
+
+
+def find_computation_implementation(algo_name: str, comp_def):
+    """Agent-mode factory: build the computation object for a node."""
+    module = load_algorithm_module(algo_name)
+    return module.build_computation(comp_def)

@@ -1,0 +1,88 @@
+"""MaxSum: synchronous belief-propagation on the factor graph.
+
+Reference parity: pydcop/algorithms/maxsum.py — the north-star hot loop.
+Parameters (:212-220): damping 0.5, damping_nodes both, stability 0.1,
+noise 0.01, start_messages leafs.  Message semantics are implemented in
+pydcop_tpu.ops.maxsum (batched) and, for agent mode, in
+pydcop_tpu.infrastructure computations built from `build_computation`.
+
+Device-path note: the batched BSP engine fires *all* factors and
+variables each cycle, which corresponds to ``start_messages=all``
+semantics; `start_messages` only changes the transient, not the fixed
+point, and is accepted for compatibility.  Send-suppression after
+SAME_COUNT identical messages (reference :106) is a wire-traffic
+optimization with no effect on message *content*; on device, messages
+are array rows and the optimization is moot.
+"""
+
+from typing import Optional
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.computations_graph import factor_graph as fg
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.engine.runner import DeviceRunResult, MaxSumEngine
+
+GRAPH_TYPE = "factor_graph"
+
+HEADER_SIZE = 0
+UNIT_SIZE = 1
+# Messages considered identical after this many resends (agent mode).
+SAME_COUNT = 4
+STABILITY_COEFF = 0.1
+
+algo_params = [
+    AlgoParameterDef("damping", "float", None, 0.5),
+    AlgoParameterDef(
+        "damping_nodes", "str", ["vars", "factors", "both", "none"], "both"
+    ),
+    AlgoParameterDef("stability", "float", None, STABILITY_COEFF),
+    AlgoParameterDef("noise", "float", None, 0.01),
+    AlgoParameterDef(
+        "start_messages", "str", ["leafs", "leafs_vars", "all"], "all"
+    ),
+]
+
+
+def computation_memory(node) -> float:
+    """Footprint: sum of incident message sizes (reference maxsum.py
+    :127-171)."""
+    return fg.computation_memory(node)
+
+
+def communication_load(src, target: str) -> float:
+    """One cost table per message (reference maxsum.py:174-209)."""
+    return fg.communication_load(src, target)
+
+
+def build_computation(comp_def):
+    """Agent-mode computation factory."""
+    from pydcop_tpu.infrastructure.computations import build_algo_computation
+
+    return build_algo_computation("maxsum", comp_def)
+
+
+def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
+                    max_cycles: int = 1000, mesh=None,
+                    n_devices: Optional[int] = None,
+                    stop_on_convergence: bool = True) -> DeviceRunResult:
+    """Batched BSP MaxSum on TPU/CPU devices."""
+    params = algo_def.params
+    pad_to = 1
+    if mesh is not None:
+        pad_to = mesh.size
+    elif n_devices:
+        pad_to = n_devices
+    graph, meta = compile_dcop(
+        dcop, noise_level=params.get("noise", 0.01), pad_to=pad_to
+    )
+    engine = MaxSumEngine(
+        graph, meta,
+        damping=params.get("damping", 0.5),
+        damping_nodes=params.get("damping_nodes", "both"),
+        stability=params.get("stability", STABILITY_COEFF),
+        mesh=mesh, n_devices=n_devices,
+    )
+    return engine.run(
+        max_cycles=max_cycles, stop_on_convergence=stop_on_convergence
+    )

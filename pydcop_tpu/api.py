@@ -1,0 +1,87 @@
+"""High-level solve API.
+
+Reference parity: pydcop/infrastructure/run.py:52 ``solve()`` — build
+graph → distribute → run → return assignment.  Here the default backend
+is the device engine (one jitted BSP program); ``backend="thread"`` runs
+the agent-mode runtime for reference-equivalent distributed execution.
+"""
+
+import time
+from typing import Any, Dict, Optional, Union
+
+from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
+from pydcop_tpu.dcop.dcop import DCOP
+
+
+class SolveResult(dict):
+    """Dict-like result: assignment, cost, violations, cycles, times."""
+
+    @property
+    def assignment(self) -> Dict[str, Any]:
+        return self["assignment"]
+
+    @property
+    def cost(self) -> float:
+        return self["cost"]
+
+
+def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
+          distribution: str = "oneagent",
+          backend: str = "device",
+          timeout: Optional[float] = None,
+          max_cycles: int = 1000,
+          algo_params: Optional[Dict[str, Any]] = None,
+          mesh=None, n_devices: Optional[int] = None,
+          ) -> SolveResult:
+    """Solve a DCOP and return assignment + quality metrics.
+
+    backend="device": batched engine on TPU/CPU devices (default).
+    backend="thread": agent-mode runtime (threads + in-process messages),
+    reference-equivalent semantics.
+    """
+    if isinstance(algo_def, str):
+        algo_def = AlgorithmDef.build_with_default_param(
+            algo_def, algo_params or {}, mode=dcop.objective
+        )
+    module = load_algorithm_module(algo_def.algo)
+
+    if backend == "device":
+        if not hasattr(module, "solve_on_device"):
+            raise NotImplementedError(
+                f"Algorithm {algo_def.algo} has no device path; use "
+                "backend='thread'"
+            )
+        t0 = time.perf_counter()
+        res = module.solve_on_device(
+            dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
+            n_devices=n_devices,
+        )
+        cost, violations = dcop.solution_cost(res.assignment)
+        return SolveResult(
+            status="FINISHED" if res.converged else "TIMEOUT",
+            assignment=res.assignment,
+            cost=cost,
+            violations=violations,
+            cycles=res.cycles,
+            time=res.time_s,
+            compile_time=res.compile_time_s,
+            total_time=time.perf_counter() - t0,
+            metrics=res.metrics,
+            backend="device",
+        )
+
+    if backend == "thread":
+        try:
+            from pydcop_tpu.infrastructure.run import solve_with_agents
+        except ModuleNotFoundError:
+            raise NotImplementedError(
+                "thread backend not available yet (agent runtime under "
+                "construction); use backend='device'"
+            )
+
+        return solve_with_agents(
+            dcop, algo_def, distribution=distribution,
+            timeout=timeout, max_cycles=max_cycles,
+        )
+
+    raise ValueError(f"Unknown backend {backend!r}")

@@ -1,0 +1,6 @@
+"""CLI subcommand modules.
+
+Reference parity: pydcop/commands/ — each module exposes
+``set_parser(subparsers)`` registering its arguments and a ``run_cmd``
+callable stored as the parser default ``func``.
+"""

@@ -1,0 +1,14 @@
+"""``pydcop agent`` — placeholder, implemented later this round.
+
+Reference parity target: pydcop/commands/agent.py.
+"""
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("agent", help="agent (not yet implemented)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    print("pydcop agent: not implemented yet in pydcop-tpu")
+    return 3

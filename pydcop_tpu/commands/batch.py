@@ -1,0 +1,14 @@
+"""``pydcop batch`` — placeholder, implemented later this round.
+
+Reference parity target: pydcop/commands/batch.py.
+"""
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("batch", help="batch (not yet implemented)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    print("pydcop batch: not implemented yet in pydcop-tpu")
+    return 3

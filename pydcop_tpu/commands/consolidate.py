@@ -1,0 +1,14 @@
+"""``pydcop consolidate`` — placeholder, implemented later this round.
+
+Reference parity target: pydcop/commands/consolidate.py.
+"""
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("consolidate", help="consolidate (not yet implemented)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    print("pydcop consolidate: not implemented yet in pydcop-tpu")
+    return 3

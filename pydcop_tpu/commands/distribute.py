@@ -1,0 +1,14 @@
+"""``pydcop distribute`` — placeholder, implemented later this round.
+
+Reference parity target: pydcop/commands/distribute.py.
+"""
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("distribute", help="distribute (not yet implemented)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    print("pydcop distribute: not implemented yet in pydcop-tpu")
+    return 3

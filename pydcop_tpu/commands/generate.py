@@ -1,0 +1,14 @@
+"""``pydcop generate`` — placeholder, implemented later this round.
+
+Reference parity target: pydcop/commands/generate.py.
+"""
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("generate", help="generate (not yet implemented)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    print("pydcop generate: not implemented yet in pydcop-tpu")
+    return 3

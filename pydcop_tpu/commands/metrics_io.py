@@ -1,0 +1,23 @@
+"""CSV metrics output, preserving the reference's column schema.
+
+Reference parity: pydcop/commands/solve.py:386-443 (csv writers used by
+--run_metrics / --end_metrics with --collect_on).
+"""
+
+import csv
+import os
+from typing import Dict
+
+COLUMNS = [
+    "time", "cycle", "cost", "violation", "msg_count", "msg_size",
+    "status",
+]
+
+
+def add_csvline(path: str, collect_on: str, metrics: Dict):
+    exists = os.path.exists(path)
+    with open(path, "a", newline="", encoding="utf-8") as f:
+        writer = csv.writer(f)
+        if not exists:
+            writer.writerow(COLUMNS)
+        writer.writerow([metrics.get(c, "") for c in COLUMNS])

@@ -1,0 +1,14 @@
+"""``pydcop orchestrator`` — placeholder, implemented later this round.
+
+Reference parity target: pydcop/commands/orchestrator.py.
+"""
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("orchestrator", help="orchestrator (not yet implemented)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    print("pydcop orchestrator: not implemented yet in pydcop-tpu")
+    return 3

@@ -1,0 +1,14 @@
+"""``pydcop replica_dist`` — placeholder, implemented later this round.
+
+Reference parity target: pydcop/commands/replica_dist.py.
+"""
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("replica_dist", help="replica_dist (not yet implemented)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    print("pydcop replica_dist: not implemented yet in pydcop-tpu")
+    return 3

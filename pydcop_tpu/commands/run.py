@@ -1,0 +1,14 @@
+"""``pydcop run`` — placeholder, implemented later this round.
+
+Reference parity target: pydcop/commands/run.py.
+"""
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser("run", help="run (not yet implemented)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    print("pydcop run: not implemented yet in pydcop-tpu")
+    return 3

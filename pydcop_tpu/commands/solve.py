@@ -1,0 +1,95 @@
+"""``pydcop solve``: one-shot local solve of a static DCOP.
+
+Reference parity: pydcop/commands/solve.py (run_cmd :444, result JSON
+keys :611-632: status/assignment/cost/violation/time/msg_count/msg_size/
+cycle/agt_metrics).  Modes: ``--mode device`` (default — batched engine
+on TPU/CPU), ``--mode thread`` / ``--mode process`` (agent runtime,
+reference semantics).
+"""
+
+import time
+
+from pydcop_tpu.commands._utils import build_algo_def, emit_result
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "solve", help="solve a static DCOP")
+    parser.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    parser.add_argument("-a", "--algo", required=True,
+                        help="algorithm name")
+    parser.add_argument("-p", "--algo_params", action="append",
+                        help="algorithm parameter as name:value")
+    parser.add_argument("-d", "--distribution", default="oneagent",
+                        help="distribution method or file")
+    parser.add_argument("-m", "--mode", default="device",
+                        choices=["device", "thread", "process"],
+                        help="execution mode")
+    parser.add_argument("-c", "--cycles", type=int, default=1000,
+                        help="max cycles (device/synchronous modes)")
+    parser.add_argument("--n_devices", type=int, default=None,
+                        help="shard over this many devices (device mode)")
+    parser.add_argument("--collect_on", default="value_change",
+                        choices=["value_change", "cycle_change", "period"])
+    parser.add_argument("--period", type=float, default=1.0)
+    parser.add_argument("--run_metrics", default=None,
+                        help="csv file for run metrics")
+    parser.add_argument("--end_metrics", default=None,
+                        help="csv file for end metrics")
+    parser.add_argument("--infinity", type=float, default=float("inf"))
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.api import solve
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
+
+    t0 = time.perf_counter()
+    if args.mode == "device":
+        res = solve(
+            dcop, algo_def, backend="device", max_cycles=args.cycles,
+            n_devices=args.n_devices,
+        )
+        result = {
+            "status": res["status"],
+            "assignment": res["assignment"],
+            "cost": res["cost"],
+            "violation": res["violations"],
+            "time": res["time"],
+            "msg_count": res["metrics"].get("msg_count", 0),
+            "msg_size": res["metrics"].get("msg_count", 0),
+            "cycle": res["cycles"],
+            "compile_time": res["compile_time"],
+            "backend": "device",
+        }
+    else:
+        res = solve(
+            dcop, algo_def, distribution=args.distribution,
+            backend="thread", timeout=args.timeout,
+            max_cycles=args.cycles,
+        )
+        result = {
+            "status": res["status"],
+            "assignment": res["assignment"],
+            "cost": res["cost"],
+            "violation": res["violations"],
+            "time": res.get("time", time.perf_counter() - t0),
+            "msg_count": res.get("msg_count", 0),
+            "msg_size": res.get("msg_size", 0),
+            "cycle": res.get("cycles", 0),
+            "agt_metrics": res.get("agt_metrics", {}),
+            "backend": "thread",
+        }
+
+    if args.run_metrics or args.end_metrics:
+        from pydcop_tpu.commands.metrics_io import add_csvline
+
+        for path in (args.run_metrics, args.end_metrics):
+            if path:
+                add_csvline(path, args.collect_on, result)
+
+    emit_result(result, args.output)
+    return 0

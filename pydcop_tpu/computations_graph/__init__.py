@@ -1,0 +1,19 @@
+"""Computation graphs: how a DCOP maps onto communicating computations.
+
+Reference parity: pydcop/computations_graph/ — four graph models, each
+exposing ``build_computation_graph(dcop) -> ComputationGraph``:
+
+- ``factor_graph``: bipartite variable/factor nodes (maxsum family);
+- ``constraints_hypergraph``: one node per variable (local-search family);
+- ``pseudotree``: DFS pseudo-tree (dpop, ncbb);
+- ``ordered_graph``: total variable order (syncbb).
+
+TPU-native addition: every graph can be *compiled* to a dense, padded,
+bucketed array form by pydcop_tpu.engine.compile for on-device execution.
+"""
+
+import importlib
+
+
+def load_graph_module(name: str):
+    return importlib.import_module(f"pydcop_tpu.computations_graph.{name}")

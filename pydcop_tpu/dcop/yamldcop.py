@@ -50,12 +50,13 @@ def load_dcop_from_file(filenames: Union[str, Iterable[str]],
     concatenated, reference behavior yamldcop.py:63)."""
     if isinstance(filenames, str):
         filenames = [filenames]
+    filenames = list(filenames)
     contents = []
     for f in filenames:
         with open(f, encoding="utf-8") as fh:
             contents.append(fh.read())
     if main_dir is None:
-        main_dir = os.path.dirname(os.path.abspath(list(filenames)[0]))
+        main_dir = os.path.dirname(os.path.abspath(filenames[0]))
     return load_dcop("\n".join(contents), main_dir=main_dir)
 
 
@@ -74,17 +75,15 @@ def _parse_domain_values(raw_values) -> List:
                 values.extend(range(int(m.group(1)), int(m.group(2)) + 1))
                 continue
         values.append(v)
-    # If every value parses as an int, the domain is an int domain.
-    if values and all(
-        isinstance(v, bool) for v in values
-    ):
-        return values
-    try:
-        if all(not isinstance(v, bool) for v in values):
-            int_values = [int(v) for v in values]
-            return int_values
-    except (ValueError, TypeError):
-        pass
+    # If every value is a *string* that parses as an int, the domain is an
+    # int domain (reference behavior for ranges / quoted ints).  Values
+    # yaml already parsed as numbers/bools are kept as-is — coercing
+    # floats would corrupt the domain.
+    if values and all(isinstance(v, str) for v in values):
+        try:
+            return [int(v) for v in values]
+        except ValueError:
+            pass
     return values
 
 
@@ -99,10 +98,7 @@ def load_dcop(yaml_str: str, main_dir: str = ".") -> DCOP:
 
     for dname, dspec in (data.get("domains") or {}).items():
         values = _parse_domain_values(dspec["values"])
-        dom = Domain(dname, dspec.get("type", ""), values)
-        if "initial_value" in dspec:
-            dom.initial_value = dspec["initial_value"]
-        dcop.add_domain(dom)
+        dcop.add_domain(Domain(dname, dspec.get("type", ""), values))
 
     for vname, vspec in (data.get("variables") or {}).items():
         dom = dcop.domain(vspec["domain"])
@@ -210,6 +206,14 @@ def _split_assignment_tokens(assignment: str) -> List[str]:
     return [t.strip("'\"") for t in tokens]
 
 
+def _quote_token(token: str) -> str:
+    """Quote an extensional-assignment token if it contains whitespace,
+    so dumped files re-load through _split_assignment_tokens."""
+    if re.search(r"\s", token):
+        return "'" + token + "'"
+    return token
+
+
 def _build_agents(dcop: DCOP, agents_spec, routes_spec, hosting_spec):
     if agents_spec is None:
         return
@@ -299,7 +303,8 @@ def dcop_yaml(dcop: DCOP) -> str:
                 if val == 0:
                     continue
                 assignment = " ".join(
-                    str(v.domain[i]) for v, i in zip(c.dimensions, idx)
+                    _quote_token(str(v.domain[i]))
+                    for v, i in zip(c.dimensions, idx)
                 )
                 values.setdefault(val, []).append(assignment)
             constraints[c.name] = {
@@ -326,6 +331,37 @@ def dcop_yaml(dcop: DCOP) -> str:
             )
             for a in dcop.agents.values()
         }
+        # Routes (symmetric: dump each pair once) and hosting costs.
+        routes: Dict[str, Dict[str, float]] = {}
+        dumped_pairs = set()
+        hosting: Dict[str, Any] = {}
+        for a in dcop.agents.values():
+            for other, cost in a.routes.items():
+                pair = frozenset((a.name, other))
+                if pair in dumped_pairs:
+                    continue
+                dumped_pairs.add(pair)
+                routes.setdefault(a.name, {})[other] = cost
+            h: Dict[str, Any] = {}
+            if a.default_hosting_cost:
+                h["default"] = a.default_hosting_cost
+            if a.hosting_costs:
+                h["computations"] = a.hosting_costs
+            if h:
+                hosting[a.name] = h
+        default_routes = {a.default_route for a in dcop.agents.values()}
+        if default_routes != {1} and len(default_routes) == 1:
+            routes = {"default": default_routes.pop(), **routes}
+        if routes:
+            data["routes"] = routes
+        if hosting:
+            data["hosting_costs"] = hosting
+    if dcop.dist_hints is not None:
+        hints: Dict[str, Any] = {}
+        if dcop.dist_hints.must_host_map:
+            hints["must_host"] = dcop.dist_hints.must_host_map
+        if hints:
+            data["distribution_hints"] = hints
     return yaml.safe_dump(data, sort_keys=False, default_flow_style=False)
 
 

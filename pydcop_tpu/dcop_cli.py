@@ -1,0 +1,105 @@
+"""``pydcop`` command-line interface.
+
+Reference parity: pydcop/dcop_cli.py (:62-130) — subcommands solve, run,
+distribute, graph, agent, orchestrator, generate, replica_dist, batch,
+consolidate; global ``--timeout``, ``--output``, verbosity flags.
+"""
+
+import argparse
+import logging
+import sys
+
+
+def _configure_logs(level: int):
+    if level >= 3:
+        log_level = logging.DEBUG
+    elif level == 2:
+        log_level = logging.INFO
+    elif level == 1:
+        log_level = logging.WARNING
+    else:
+        log_level = logging.ERROR
+    logging.basicConfig(
+        level=log_level,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        stream=sys.stderr,
+    )
+
+
+def make_parser() -> argparse.ArgumentParser:
+    from pydcop_tpu.commands import (
+        agent,
+        batch,
+        consolidate,
+        distribute,
+        generate,
+        graph,
+        orchestrator,
+        replica_dist,
+        run,
+        solve,
+    )
+
+    parser = argparse.ArgumentParser(
+        prog="pydcop",
+        description="TPU-native DCOP solver with pyDCOP capabilities",
+    )
+    parser.add_argument(
+        "-t", "--timeout", type=float, default=None,
+        help="global timeout in seconds",
+    )
+    parser.add_argument(
+        "--output", default=None, help="output file for results"
+    )
+    parser.add_argument(
+        "-v", "--verbosity", type=int, default=0,
+        help="verbosity: 0 error, 1 warning, 2 info, 3 debug",
+    )
+    parser.add_argument(
+        "--version", action="store_true", help="print version and exit"
+    )
+    subparsers = parser.add_subparsers(title="commands", dest="command")
+    for cmd in (solve, run, distribute, graph, agent, orchestrator,
+                generate, replica_dist, batch, consolidate):
+        cmd.set_parser(subparsers)
+    return parser
+
+
+def main(args=None) -> int:
+    parser = make_parser()
+    parsed = parser.parse_args(args)
+    _configure_logs(parsed.verbosity)
+    if parsed.version:
+        import pydcop_tpu
+
+        print(f"pydcop-tpu {pydcop_tpu.__version__}")
+        return 0
+    if not getattr(parsed, "func", None):
+        parser.print_help()
+        return 2
+    try:
+        return parsed.func(parsed) or 0
+    except ModuleNotFoundError as e:
+        if "pydcop_tpu.algorithms." in str(e):
+            algo = str(e).rsplit(".", 1)[-1].rstrip("'")
+            from pydcop_tpu.algorithms import list_available_algorithms
+
+            print(
+                f"Error: unknown algorithm {algo!r}; available: "
+                f"{', '.join(list_available_algorithms())}",
+                file=sys.stderr,
+            )
+            return 2
+        raise
+    except FileNotFoundError as e:
+        print(f"Error: file not found: {e.filename}", file=sys.stderr)
+        return 2
+    except Exception as e:  # clean one-line errors for users, not tracebacks
+        if parsed.verbosity >= 3:
+            raise
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

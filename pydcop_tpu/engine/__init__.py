@@ -1,0 +1,8 @@
+"""Device engine: compiles computation graphs into dense padded arrays and
+runs message-passing algorithms as jitted bulk-synchronous supersteps.
+
+This is the TPU-native replacement for the reference's thread-per-agent
+runtime (pydcop/infrastructure/agents.py): one BSP superstep = one XLA
+step over *all* computations, batched by bucket, instead of one Python
+thread per agent popping messages off a queue.
+"""

@@ -1,0 +1,191 @@
+"""Compile a DCOP factor graph into dense, padded, bucketed device arrays.
+
+This is the bridge between the host-side problem model and the jitted
+engine.  The layout decisions are what make the kernels MXU/VPU friendly
+and the sharding communication-minimal:
+
+- **Arity buckets.** Factors are grouped by arity; each bucket stacks its
+  cost hypercubes into one `[F, Dmax, ..., Dmax]` tensor so the
+  factor→variable min-reduction is a single batched reduction per bucket
+  (reference analogue: the O(d^arity) python enumeration in maxsum's
+  factor_costs_for_var, pydcop/algorithms/maxsum.py:382).
+
+- **Messages live in bucket space** as `[F, arity, Dmax]` arrays — the
+  slot (f, p) holds the message on the edge between factor f and the
+  variable at position p of its scope.  "Sending" is writing a row; there
+  is no queue and no serialization (reference analogue: the Messaging
+  priority queue, pydcop/infrastructure/communication.py:500).
+  Variable-side aggregation is a segment-sum over `var_ids`; when buckets
+  are sharded over a mesh axis this is the *only* cross-device op (one
+  all-reduce of the [V, D] totals per superstep, riding ICI).
+
+- **Domain padding.** All domains are padded to Dmax with `BIG` cost so
+  padded slots never win a min-reduction; `var_valid` masks them out of
+  normalizations and argmins.  For `objective=max` problems costs are
+  negated at compile time and the final cost re-negated on the host, so
+  kernels only ever minimize.
+
+- **Device padding.** Bucket rows are padded to a multiple of `pad_to`
+  (the mesh size); padding rows have zero cost and point at a sentinel
+  variable row (index V) which is dropped after aggregation, so sharded
+  runs need no ragged handling.
+
+- **Zero-ary constraints** are folded into a host-side constant offset
+  (`meta.constant_cost`).
+"""
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Variable, _stable_noise
+from pydcop_tpu.dcop.relations import Constraint
+
+BIG = np.float32(1e9)
+
+
+class FactorBucket(NamedTuple):
+    """All factors of one arity, stacked."""
+
+    costs: np.ndarray    # [F, Dmax]*arity, f32, BIG on padded slots
+    var_ids: np.ndarray  # [F, arity] int32 (sentinel V on padding rows)
+
+    @property
+    def arity(self) -> int:
+        return self.var_ids.shape[1]
+
+    @property
+    def n_factors(self) -> int:
+        return self.var_ids.shape[0]
+
+
+class CompiledFactorGraph(NamedTuple):
+    """Device-ready dense form of a factor graph.
+
+    Array members are numpy on the host; the runner moves them to device
+    (optionally sharded).
+    """
+
+    var_costs: np.ndarray   # [V+1, Dmax] f32 (last row = sentinel)
+    var_valid: np.ndarray   # [V+1, Dmax] bool
+    buckets: Tuple[FactorBucket, ...]
+
+    @property
+    def n_vars(self) -> int:
+        return self.var_costs.shape[0] - 1
+
+    @property
+    def dmax(self) -> int:
+        return self.var_costs.shape[1]
+
+
+class FactorGraphMeta(NamedTuple):
+    """Host-side metadata to map device results back to the problem."""
+
+    var_names: Tuple[str, ...]
+    domains: Tuple[Tuple, ...]          # domain values per var
+    factor_names: Tuple[str, ...]       # bucket order, real factors only
+    bucket_sizes: Tuple[int, ...]       # real (unpadded) factors per bucket
+    mode: str                           # 'min' or 'max'
+    constant_cost: float = 0.0          # folded zero-ary constraints
+
+    def assignment_from_indices(self, idx: Sequence[int]) -> Dict:
+        return {
+            name: self.domains[i][int(idx[i])]
+            for i, name in enumerate(self.var_names)
+        }
+
+
+def _round_up(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return n
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def compile_factor_graph(
+    variables: Sequence[Variable],
+    constraints: Sequence[Constraint],
+    mode: str = "min",
+    noise_level: float = 0.0,
+    noise_seed: Optional[int] = None,
+    pad_to: int = 1,
+    dtype=np.float32,
+) -> Tuple[CompiledFactorGraph, FactorGraphMeta]:
+    """Build the dense arrays.  `noise_level` adds deterministic
+    per-variable-value noise (maxsum's tie-breaking noise, reference
+    maxsum.py:477-487, seeded here for reproducibility)."""
+    variables = list(variables)
+    constraints = list(constraints)
+    var_index = {v.name: i for i, v in enumerate(variables)}
+    v_count = len(variables)
+    dmax = max((len(v.domain) for v in variables), default=1)
+    sign = 1.0 if mode == "min" else -1.0
+
+    # Variable cost table (+ sentinel row for padding edges).
+    var_costs = np.full((v_count + 1, dmax), BIG, dtype=dtype)
+    var_valid = np.zeros((v_count + 1, dmax), dtype=bool)
+    for i, v in enumerate(variables):
+        d = len(v.domain)
+        costs = sign * v.cost_vector()[:d]
+        if noise_level:
+            costs = costs + _stable_noise(v.name, d, noise_level, noise_seed)
+        var_costs[i, :d] = costs
+        var_valid[i, :d] = True
+
+    constant_cost = 0.0
+    by_arity: Dict[int, List[Constraint]] = {}
+    for c in constraints:
+        if c.arity == 0:
+            constant_cost += float(c())
+            continue
+        by_arity.setdefault(c.arity, []).append(c)
+
+    buckets = []
+    factor_names: List[str] = []
+    bucket_sizes: List[int] = []
+    for arity in sorted(by_arity):
+        facs = by_arity[arity]
+        n_rows = _round_up(len(facs), pad_to)
+        shape = (n_rows,) + (dmax,) * arity
+        costs = np.full(shape, BIG, dtype=dtype)
+        var_ids = np.full((n_rows, arity), v_count, dtype=np.int32)
+        for fi, c in enumerate(facs):
+            factor_names.append(c.name)
+            table = sign * np.asarray(c.to_array(), dtype=dtype)
+            idx = tuple(slice(0, s) for s in table.shape)
+            costs[(fi,) + idx] = table
+            for p, v in enumerate(c.dimensions):
+                var_ids[fi, p] = var_index[v.name]
+        # Padding rows keep cost 0 and the sentinel variable.
+        costs[len(facs):] = 0.0
+        buckets.append(FactorBucket(costs, var_ids))
+        bucket_sizes.append(len(facs))
+
+    compiled = CompiledFactorGraph(
+        var_costs=var_costs,
+        var_valid=var_valid,
+        buckets=tuple(buckets),
+    )
+    meta = FactorGraphMeta(
+        var_names=tuple(v.name for v in variables),
+        domains=tuple(tuple(v.domain) for v in variables),
+        factor_names=tuple(factor_names),
+        bucket_sizes=tuple(bucket_sizes),
+        mode=mode,
+        constant_cost=constant_cost,
+    )
+    return compiled, meta
+
+
+def compile_dcop(dcop: DCOP, noise_level: float = 0.0,
+                 noise_seed: Optional[int] = None, pad_to: int = 1,
+                 ) -> Tuple[CompiledFactorGraph, FactorGraphMeta]:
+    return compile_factor_graph(
+        list(dcop.variables.values()),
+        list(dcop.constraints.values()),
+        mode=dcop.objective,
+        noise_level=noise_level,
+        noise_seed=noise_seed,
+        pad_to=pad_to,
+    )

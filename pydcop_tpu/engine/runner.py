@@ -1,0 +1,107 @@
+"""The BSP engine runner: jit-compiles and executes algorithm loops.
+
+This replaces the reference's orchestrator + thread-per-agent runtime
+(pydcop/infrastructure/run.py:145 run_local_thread_dcop) for on-device
+execution: the whole solve — message updates, damping, convergence test,
+value selection — is one XLA program; the host only launches it and reads
+back the result.
+"""
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from pydcop_tpu.engine.compile import CompiledFactorGraph, FactorGraphMeta
+from pydcop_tpu.engine.sharding import make_mesh, shard_graph
+from pydcop_tpu.ops import maxsum as maxsum_ops
+
+
+@dataclass
+class DeviceRunResult:
+    """Result of an on-device solve."""
+
+    assignment: Dict[str, Any]
+    cycles: int
+    converged: bool
+    time_s: float
+    compile_time_s: float
+    metrics: Dict[str, Any] = field(default_factory=dict)
+
+
+class MaxSumEngine:
+    """Runs MaxSum supersteps on a compiled factor graph.
+
+    Parameters mirror the reference algo_params (maxsum.py:212-220):
+    damping (0.5), damping_nodes (vars/factors/both/none), stability
+    (0.1).  `noise` is applied at compile time (engine.compile).
+    """
+
+    def __init__(self, graph: CompiledFactorGraph, meta: FactorGraphMeta,
+                 damping: float = 0.5, damping_nodes: str = "both",
+                 stability: float = 0.1,
+                 mesh=None, n_devices: Optional[int] = None):
+        self.meta = meta
+        if mesh is None and n_devices is not None and n_devices > 1:
+            mesh = make_mesh(n_devices)
+        self.mesh = mesh
+        if mesh is not None and mesh.size > 1:
+            graph = shard_graph(graph, mesh)
+        else:
+            graph = jax.device_put(graph)
+        self.graph = graph
+        self.damping = damping
+        self.damp_vars = damping_nodes in ("vars", "both")
+        self.damp_factors = damping_nodes in ("factors", "both")
+        self.stability = stability
+        self._jitted: Dict[Any, Any] = {}
+
+    def _fn(self, max_cycles: int, stop_on_convergence: bool):
+        key = (max_cycles, stop_on_convergence)
+        if key not in self._jitted:
+            self._jitted[key] = jax.jit(
+                partial(
+                    maxsum_ops.run_maxsum,
+                    max_cycles=max_cycles,
+                    damping=self.damping,
+                    damp_vars=self.damp_vars,
+                    damp_factors=self.damp_factors,
+                    stability=self.stability,
+                    stop_on_convergence=stop_on_convergence,
+                )
+            )
+        return self._jitted[key]
+
+    def run(self, max_cycles: int = 1000,
+            stop_on_convergence: bool = True) -> DeviceRunResult:
+        fn = self._fn(max_cycles, stop_on_convergence)
+        t0 = time.perf_counter()
+        compiled = fn.lower(self.graph).compile()
+        t1 = time.perf_counter()
+        state, values = compiled(self.graph)
+        jax.block_until_ready(values)
+        t2 = time.perf_counter()
+        # One host transfer (the tunnel round-trip dominates small gets).
+        values, cycle, stable = jax.device_get(
+            (values, state.cycle, state.stable)
+        )
+        values = np.asarray(values)
+        cycle, stable = int(cycle), bool(stable)
+        assignment = self.meta.assignment_from_indices(values)
+        n_msgs = sum(
+            int(np.prod(b.var_ids.shape)) for b in self.graph.buckets
+        )
+        return DeviceRunResult(
+            assignment=assignment,
+            cycles=cycle,
+            converged=stable,
+            time_s=t2 - t1,
+            compile_time_s=t1 - t0,
+            metrics={
+                "msg_count": 2 * n_msgs * cycle,
+                "cycles_per_s": cycle / (t2 - t1) if t2 > t1 else 0.0,
+            },
+        )

@@ -1,0 +1,66 @@
+"""Mesh construction and sharding specs for the device engine.
+
+The sharding story (scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives):
+
+- one mesh axis ``"shard"`` over all devices;
+- factor buckets (costs + var_ids) and their message arrays are sharded
+  on the leading factor axis;
+- variable tables ([V+1, D] costs/valid/beliefs) are replicated;
+- the per-superstep segment-sum over sharded messages into replicated
+  totals is the only collective XLA needs to insert (an all-reduce over
+  ICI) — everything else is local.
+
+This replaces the reference's distribution-of-computations-over-agents as
+the *intra-pod* scaling mechanism (reference: pydcop/distribution/);
+the distribution algorithms remain for agent-mode and for balancing
+which factors land on which shard.
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pydcop_tpu.engine.compile import CompiledFactorGraph, FactorBucket
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices=None) -> Mesh:
+    """A 1-D mesh over (the first n of) the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def shard_graph(graph: CompiledFactorGraph,
+                mesh: Mesh) -> CompiledFactorGraph:
+    """Place the compiled graph on the mesh: buckets sharded on the
+    factor axis, variable tables replicated.
+
+    Bucket rows must be padded to a multiple of the mesh size (use
+    ``pad_to=mesh.size`` when compiling).
+    """
+    replicated = NamedSharding(mesh, P())
+    row_sharded = NamedSharding(mesh, P(SHARD_AXIS))
+    buckets = []
+    for b in graph.buckets:
+        if b.costs.shape[0] % mesh.size:
+            raise ValueError(
+                f"Bucket with {b.costs.shape[0]} rows not divisible by "
+                f"mesh size {mesh.size}; compile with pad_to=mesh.size"
+            )
+        buckets.append(FactorBucket(
+            costs=jax.device_put(b.costs, row_sharded),
+            var_ids=jax.device_put(b.var_ids, row_sharded),
+        ))
+    return CompiledFactorGraph(
+        var_costs=jax.device_put(graph.var_costs, replicated),
+        var_valid=jax.device_put(graph.var_valid, replicated),
+        buckets=tuple(buckets),
+    )

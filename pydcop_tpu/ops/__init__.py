@@ -1,0 +1,7 @@
+"""Pure JAX kernels for the message-passing hot loops.
+
+Everything in this package is functional, shape-static and jit-safe:
+no python control flow on traced values, no host callbacks.  These are
+the TPU equivalents of the reference's per-computation python loops
+(maxsum.factor_costs_for_var, dpop join/projection, dsa/mgm best-response).
+"""

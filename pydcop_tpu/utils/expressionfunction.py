@@ -41,10 +41,17 @@ def _free_names(tree: ast.AST) -> list:
         else:
             stores.add(node.id)
     for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            stores.add(node.name)
-            for a in node.args.args + node.args.kwonlyargs:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            if not isinstance(node, ast.Lambda):
+                stores.add(node.name)
+            for a in (node.args.args + node.args.kwonlyargs
+                      + node.args.posonlyargs):
                 stores.add(a.arg)
+            if node.args.vararg:
+                stores.add(node.args.vararg.arg)
+            if node.args.kwarg:
+                stores.add(node.args.kwarg.arg)
     reserved = set(dir(builtins)) | set(_SCOPE_MODULES) | {"source"}
     return [n for n in loads if n not in stores and n not in reserved]
 

@@ -44,9 +44,7 @@ class SimpleRepr:
                 val = getattr(self, attr)
             elif hasattr(self, "_" + attr):
                 val = getattr(self, "_" + attr)
-            elif param.default is not inspect.Parameter.default and (
-                param.default is not inspect.Parameter.empty
-            ):
+            elif param.default is not inspect.Parameter.empty:
                 val = param.default
             else:
                 raise SimpleReprException(

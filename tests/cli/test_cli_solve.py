@@ -1,0 +1,65 @@
+"""CLI tests: spawn the real CLI as a subprocess and parse JSON results.
+
+Mirrors the reference's test strategy (tests/dcop_cli/test_solve.py:33-60).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REF_INSTANCES = "/root/reference/tests/instances"
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def run_cli(args, timeout=120):
+    out = subprocess.check_output(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli"] + args,
+        timeout=timeout, env=ENV,
+    )
+    return json.loads(out)
+
+
+def test_solve_maxsum_graph_coloring():
+    result = run_cli([
+        "solve", "--algo", "maxsum",
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    assert result["status"] in ("FINISHED", "TIMEOUT")
+    assert result["violation"] == 0
+    assert result["cost"] == -0.1
+    assert set(result["assignment"]) == {"v1", "v2", "v3"}
+
+
+def test_solve_with_algo_params():
+    result = run_cli([
+        "solve", "--algo", "maxsum",
+        "--algo_params", "damping:0.7",
+        "--algo_params", "stability:0.01",
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    assert result["cost"] == -0.1
+
+
+def test_solve_bad_algo_param_fails():
+    with open(os.devnull, "w") as devnull:
+        code = subprocess.call(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli",
+             "solve", "--algo", "maxsum", "--algo_params", "bogus:1",
+             os.path.join(REF_INSTANCES, "graph_coloring1.yaml")],
+            stdout=devnull, stderr=devnull, timeout=60, env=ENV,
+        )
+    assert code != 0
+
+
+def test_graph_command():
+    result = run_cli([
+        "graph", "--graph", "factor_graph",
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    assert result["nodes"] == 5  # 3 vars + 2 constraints
+    assert result["edges"] == 4

@@ -1,11 +1,20 @@
 """Test configuration: force an 8-device virtual CPU platform so sharding
-tests exercise real multi-device code paths without TPU hardware."""
+tests exercise real multi-device code paths without TPU hardware.
+
+Note: this environment pre-imports jax (sitecustomize on PYTHONPATH) with
+JAX_PLATFORMS=axon, so env vars alone are not enough — we must override
+through jax.config before any backend is initialized.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
