@@ -1,0 +1,59 @@
+"""A-DSA: asynchronous DSA, clock-driven.
+
+Reference parity: pydcop/algorithms/adsa.py (:121-131: params variant,
+probability, period 0.5) — each variable re-evaluates on a periodic
+clock tick using whatever neighbor values it has seen, instead of
+waiting for a full cycle of value messages.
+
+Device path: the lockstep engine evaluates every variable each
+superstep, i.e. the `period` is one superstep for everyone; `period` is
+accepted for compatibility and used by the agent-mode runtime (periodic
+actions on the agent clock).
+"""
+
+from typing import Optional
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.algorithms import dsa as _dsa
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.engine.runner import DeviceRunResult
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("probability", "float", None, 0.7),
+    AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
+    AlgoParameterDef("period", "float", None, 0.5),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("seed", "int", None, 0),
+]
+
+computation_memory = _dsa.computation_memory
+communication_load = _dsa.communication_load
+
+
+def build_computation(comp_def):
+    from pydcop_tpu.infrastructure.computations import build_algo_computation
+
+    return build_algo_computation("adsa", comp_def)
+
+
+def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
+                    max_cycles: int = 1000, mesh=None,
+                    n_devices: Optional[int] = None,
+                    **_) -> DeviceRunResult:
+    inner = AlgorithmDef(
+        "dsa",
+        {
+            "probability": algo_def.params.get("probability", 0.7),
+            "p_mode": "fixed",
+            "variant": algo_def.params.get("variant", "B"),
+            "stop_cycle": algo_def.params.get("stop_cycle", 0),
+            "seed": algo_def.params.get("seed", 0),
+        },
+        algo_def.mode,
+    )
+    return _dsa.solve_on_device(
+        dcop, inner, max_cycles=max_cycles, mesh=mesh,
+        n_devices=n_devices,
+    )

@@ -1,0 +1,45 @@
+"""A-MaxSum: asynchronous MaxSum.
+
+Reference parity: pydcop/algorithms/amaxsum.py (:108-424) — same message
+semantics as maxsum (it reuses maxsum's factor_costs_for_var /
+costs_for_factor) but handlers fire per message instead of per BSP round,
+and paused computations re-send start messages on resume (dynamic DCOP
+support, :165-180).
+
+Device path: on the batched engine, asynchrony has no performance
+meaning — every message row updates each superstep, which corresponds to
+the "fully fired" schedule of the asynchronous execution.  Solution
+quality is equivalent (damping still applies); the asynchronous
+*schedule* itself is only observable in agent mode, where the
+infrastructure computations implement true per-message firing.
+"""
+
+from typing import Optional
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms import maxsum as _maxsum
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.engine.runner import DeviceRunResult
+
+GRAPH_TYPE = "factor_graph"
+
+algo_params = list(_maxsum.algo_params)
+
+computation_memory = _maxsum.computation_memory
+communication_load = _maxsum.communication_load
+
+
+def build_computation(comp_def):
+    from pydcop_tpu.infrastructure.computations import build_algo_computation
+
+    return build_algo_computation("amaxsum", comp_def)
+
+
+def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
+                    max_cycles: int = 1000, mesh=None,
+                    n_devices: Optional[int] = None,
+                    stop_on_convergence: bool = True) -> DeviceRunResult:
+    return _maxsum.solve_on_device(
+        dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
+        n_devices=n_devices, stop_on_convergence=stop_on_convergence,
+    )

@@ -1,0 +1,52 @@
+"""DSA-tuto: the minimal tutorial DSA implementation.
+
+Reference parity: pydcop/algorithms/dsatuto.py (:66-126) — DSA-A with
+fixed probability 0.7, written as the companion of the algorithm
+implementation tutorial (docs/tutorials/algo_implementation.rst).  The
+device path delegates to the full dsa engine pinned to variant A.
+"""
+
+from typing import Optional
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.algorithms import dsa as _dsa
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.engine.runner import DeviceRunResult
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+algo_params = [
+    AlgoParameterDef("variant", "str", ["A"], "A"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
+    AlgoParameterDef("seed", "int", None, 0),
+]
+
+computation_memory = _dsa.computation_memory
+communication_load = _dsa.communication_load
+
+
+def build_computation(comp_def):
+    from pydcop_tpu.infrastructure.computations import build_algo_computation
+
+    return build_algo_computation("dsatuto", comp_def)
+
+
+def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
+                    max_cycles: int = 1000, mesh=None,
+                    n_devices: Optional[int] = None,
+                    **_) -> DeviceRunResult:
+    inner = AlgorithmDef(
+        "dsa",
+        {
+            "probability": 0.7,
+            "p_mode": "fixed",
+            "variant": "A",
+            "stop_cycle": algo_def.params.get("stop_cycle", 0),
+            "seed": algo_def.params.get("seed", 0),
+        },
+        algo_def.mode,
+    )
+    return _dsa.solve_on_device(
+        dcop, inner, max_cycles=max_cycles, mesh=mesh,
+        n_devices=n_devices,
+    )

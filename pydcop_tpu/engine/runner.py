@@ -32,6 +32,56 @@ class DeviceRunResult:
     metrics: Dict[str, Any] = field(default_factory=dict)
 
 
+def _place_graph(graph: CompiledFactorGraph, mesh,
+                 n_devices: Optional[int]):
+    """Put the graph on device(s): sharded over a mesh when requested,
+    else whole on the default device.  Returns (graph, mesh)."""
+    if mesh is None and n_devices is not None and n_devices > 1:
+        available = len(jax.devices())
+        if n_devices > available:
+            raise ValueError(
+                f"Requested {n_devices} devices but only {available} "
+                "available"
+            )
+        mesh = make_mesh(n_devices)
+    if mesh is not None and mesh.size > 1:
+        return shard_graph(graph, mesh), mesh
+    return jax.device_put(graph), mesh
+
+
+def run_device_fn(graph: CompiledFactorGraph, meta: FactorGraphMeta,
+                  fn, mesh=None, n_devices: Optional[int] = None,
+                  finished: bool = False) -> DeviceRunResult:
+    """Jit + run a whole-solve function ``fn(graph) -> (values, cost,
+    cycles)`` and package the result (shared by the local-search and
+    sweep algorithms)."""
+    graph, mesh = _place_graph(graph, mesh, n_devices)
+    jitted = jax.jit(fn)
+    t0 = time.perf_counter()
+    compiled = jitted.lower(graph).compile()
+    t1 = time.perf_counter()
+    out = compiled(graph)
+    jax.block_until_ready(out)
+    t2 = time.perf_counter()
+    values, cost, cycles = jax.device_get(out)
+    values = np.asarray(values)
+    assignment = meta.assignment_from_indices(values)
+    sign = 1.0 if meta.mode == "min" else -1.0
+    return DeviceRunResult(
+        assignment=assignment,
+        cycles=int(cycles),
+        converged=finished,
+        time_s=t2 - t1,
+        compile_time_s=t1 - t0,
+        metrics={
+            "device_cost": sign * float(cost) + meta.constant_cost,
+            "cycles_per_s": (
+                int(cycles) / (t2 - t1) if t2 > t1 else 0.0
+            ),
+        },
+    )
+
+
 class MaxSumEngine:
     """Runs MaxSum supersteps on a compiled factor graph.
 
@@ -45,14 +95,7 @@ class MaxSumEngine:
                  stability: float = 0.1,
                  mesh=None, n_devices: Optional[int] = None):
         self.meta = meta
-        if mesh is None and n_devices is not None and n_devices > 1:
-            mesh = make_mesh(n_devices)
-        self.mesh = mesh
-        if mesh is not None and mesh.size > 1:
-            graph = shard_graph(graph, mesh)
-        else:
-            graph = jax.device_put(graph)
-        self.graph = graph
+        self.graph, self.mesh = _place_graph(graph, mesh, n_devices)
         self.damping = damping
         self.damp_vars = damping_nodes in ("vars", "both")
         self.damp_factors = damping_nodes in ("factors", "both")

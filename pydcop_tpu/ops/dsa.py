@@ -1,0 +1,128 @@
+"""DSA (Distributed Stochastic Algorithm) step kernel — variants A/B/C.
+
+Reference parity: pydcop/algorithms/dsa.py:214-431 (Zhang et al. 2005
+semantics): per cycle each variable computes its best local response
+given neighbors' previous values; it changes (to a uniform-random choice
+among optimal values) with probability p when
+
+- variant A: strict improvement exists (delta > 0, :358);
+- variant B: delta > 0, or delta == 0 with some incident constraint not
+  at its own optimum (:369, exists_violated_constraint :419) — dropping
+  the current value from the candidates when other optima exist (:380);
+- variant C: delta >= 0 (:389), same current-value dropping.
+
+The whole population updates in lockstep from previous-cycle values,
+matching the reference's current/next cycle maps (:266-268).
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.engine.compile import CompiledFactorGraph
+from pydcop_tpu.ops.localsearch import (
+    assignment_cost,
+    best_candidates,
+    candidate_costs,
+    factor_current_costs,
+    random_best_choice,
+    random_initial_values,
+)
+
+
+class DsaState(NamedTuple):
+    values: jnp.ndarray  # [V+1] int32 current value index (sentinel last)
+    key: jnp.ndarray
+    cycle: jnp.ndarray
+
+
+def init_state(graph: CompiledFactorGraph, seed: int = 0) -> DsaState:
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    return DsaState(
+        values=random_initial_values(k0, graph),
+        key=key,
+        cycle=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def _factor_optima(graph: CompiledFactorGraph) -> Tuple[jnp.ndarray, ...]:
+    """Per bucket, each factor's optimal (min) cost over all assignments
+    (reference best_constraints_costs, dsa.py:273)."""
+    return tuple(
+        jnp.min(b.costs, axis=tuple(range(1, b.costs.ndim)))
+        for b in graph.buckets
+    )
+
+
+def violated_vars(graph: CompiledFactorGraph,
+                  values: jnp.ndarray) -> jnp.ndarray:
+    """[V+1] bool: has an incident constraint not at its optimal cost
+    (reference exists_violated_constraint, dsa.py:419)."""
+    n_segments = graph.var_costs.shape[0]
+    out = jnp.zeros((n_segments,), dtype=jnp.int32)
+    for bucket, cur, opt in zip(
+        graph.buckets, factor_current_costs(graph, values),
+        _factor_optima(graph),
+    ):
+        viol = (cur != opt).astype(jnp.int32)
+        for p in range(bucket.var_ids.shape[1]):
+            out = jnp.maximum(out, jax.ops.segment_max(
+                viol, bucket.var_ids[:, p], num_segments=n_segments
+            ))
+    return out > 0
+
+
+def dsa_step(state: DsaState, graph: CompiledFactorGraph, *,
+             variant: str, probability: jnp.ndarray) -> DsaState:
+    """One lockstep DSA cycle.  `probability` is scalar or [V+1]
+    (per-variable, for p_mode=arity)."""
+    key, k_choice, k_change = jax.random.split(state.key, 3)
+    values = state.values
+
+    cand = candidate_costs(graph, values)               # [V+1, D]
+    cur = jnp.take_along_axis(cand, values[:, None], axis=1).squeeze(1)
+    best, is_best = best_candidates(graph, cand)
+    delta = cur - best                                   # >= 0
+
+    if variant == "A":
+        eligible = delta > 0
+        choice_mask = is_best
+    else:
+        n_best = jnp.sum(is_best, axis=1)
+        one_hot_cur = (
+            jnp.arange(cand.shape[1])[None, :] == values[:, None]
+        )
+        drop_cur = ((delta == 0) & (n_best > 1))[:, None] & one_hot_cur
+        choice_mask = is_best & ~drop_cur
+        if variant == "B":
+            eligible = (delta > 0) | (
+                (delta == 0) & violated_vars(graph, values)
+            )
+        else:  # C
+            eligible = delta >= 0
+
+    new_vals = random_best_choice(k_choice, choice_mask)
+    u = jax.random.uniform(k_change, (values.shape[0],))
+    change = eligible & (u < probability)
+    values = jnp.where(change, new_vals, values)
+    return DsaState(values=values, key=key, cycle=state.cycle + 1)
+
+
+def run_dsa(graph: CompiledFactorGraph, max_cycles: int, *,
+            variant: str = "B", probability=0.7, seed: int = 0,
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full DSA run in one XLA program.
+
+    Returns (values [V], final cost, cycles)."""
+    state = init_state(graph, seed)
+    state = jax.lax.fori_loop(
+        0, max_cycles,
+        lambda i, s: dsa_step(
+            s, graph, variant=variant, probability=probability
+        ),
+        state,
+    )
+    cost = assignment_cost(graph, state.values)
+    return state.values[:-1], cost, state.cycle

@@ -1,0 +1,170 @@
+"""Batched local-search kernels over the compiled factor-graph arrays.
+
+These power the constraints-hypergraph algorithm family (dsa, adsa, mgm,
+mgm2, dba, gdba, mixeddsa...).  One BSP cycle = every variable evaluates
+its candidate values against its neighbors' *previous-cycle* values —
+exactly the reference's cycle bookkeeping (dsa.py:266-268 current/next
+cycle maps), but as dense tensor ops:
+
+- `candidate_costs`: for each variable and candidate value, the cost of
+  its local view (own unary cost + every incident constraint evaluated
+  with the other variables fixed at their current values).  Implemented
+  by fixing, per bucket and per position, all other axes of the cost
+  hypercube via take_along_axis gathers, then segment-summing into
+  [V, D] (reference analogue: find_optimal / compute_best_value loops,
+  relations.py:1554, mgm.py:445).
+- `neighbor_max` / `neighbor_min_rank_where`: neighborhood reductions
+  (excluding self) used by MGM's gain comparison and tie-breaking
+  (mgm.py:515-590).
+- `assignment_cost`: total cost of the current assignment (padding rows
+  contribute 0 by construction).
+
+All kernels assume the `CompiledFactorGraph` layout (see engine.compile):
+BIG on invalid domain slots keeps padded candidates from ever winning an
+argmin; sentinel rows absorb padding contributions.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.engine.compile import BIG, CompiledFactorGraph
+
+INT_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _fix_other_axes(costs: jnp.ndarray, var_ids: jnp.ndarray,
+                    values: jnp.ndarray, keep: int) -> jnp.ndarray:
+    """Reduce a bucket cost tensor [F, D, ..., D] to [F, D] by indexing
+    every axis except `keep` with the current value of its variable."""
+    arity = var_ids.shape[1]
+    out = costs
+    # Fix axes from the last to the first: squeezing axis q+1 never
+    # shifts the axes below it, so axis numbers stay valid.
+    for q in range(arity - 1, -1, -1):
+        if q == keep:
+            continue
+        vq = values[var_ids[:, q]]  # [F]
+        idx = vq.reshape((-1,) + (1,) * (out.ndim - 1))
+        out = jnp.squeeze(
+            jnp.take_along_axis(out, idx, axis=q + 1), axis=q + 1
+        )
+    return out  # [F, D]
+
+
+def candidate_costs(graph: CompiledFactorGraph,
+                    values: jnp.ndarray) -> jnp.ndarray:
+    """[V+1, D]: cost of each candidate value per variable, given all
+    other variables at `values` (includes own unary costs)."""
+    cand = graph.var_costs
+    n_segments = graph.var_costs.shape[0]
+    for bucket in graph.buckets:
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            fixed = _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
+            cand = cand + jax.ops.segment_sum(
+                fixed, bucket.var_ids[:, p], num_segments=n_segments
+            )
+    return cand
+
+
+def factor_current_costs(graph: CompiledFactorGraph,
+                         values: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """Per bucket: [F] cost of each factor at the current assignment."""
+    out = []
+    for bucket in graph.buckets:
+        fixed = _fix_other_axes(bucket.costs, bucket.var_ids, values, 0)
+        v0 = values[bucket.var_ids[:, 0]]
+        out.append(jnp.take_along_axis(
+            fixed, v0[:, None], axis=1
+        ).squeeze(1))
+    return tuple(out)
+
+
+def assignment_cost(graph: CompiledFactorGraph,
+                    values: jnp.ndarray) -> jnp.ndarray:
+    """Scalar total cost (constraints + unary) of the assignment.
+    `values` is the full [V+1] array (sentinel row excluded from unary
+    costs; padding factors cost 0 by construction)."""
+    total = jnp.sum(
+        jnp.take_along_axis(
+            graph.var_costs[:-1], values[:-1, None], axis=1
+        )
+    )
+    for costs in factor_current_costs(graph, values):
+        total = total + jnp.sum(costs)
+    return total
+
+
+def neighbor_max(graph: CompiledFactorGraph,
+                 per_var: jnp.ndarray) -> jnp.ndarray:
+    """[V+1]: max of `per_var` over each variable's neighbors (variables
+    sharing a constraint), excluding the variable itself."""
+    n_segments = graph.var_costs.shape[0]
+    out = jnp.full((n_segments,), -jnp.inf, dtype=per_var.dtype)
+    for bucket in graph.buckets:
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            for q in range(arity):
+                if p == q:
+                    continue
+                vals_q = per_var[bucket.var_ids[:, q]]
+                out = jnp.maximum(out, jax.ops.segment_max(
+                    vals_q, bucket.var_ids[:, p],
+                    num_segments=n_segments,
+                ))
+    return out
+
+
+def neighbor_min_rank_where(graph: CompiledFactorGraph,
+                            per_var: jnp.ndarray,
+                            target: jnp.ndarray,
+                            ranks: jnp.ndarray) -> jnp.ndarray:
+    """[V+1]: min rank among neighbors whose `per_var` equals the
+    variable's `target` value (+inf when none) — MGM tie-breaking.
+    `ranks` is float (lexical index or per-cycle random draws)."""
+    n_segments = graph.var_costs.shape[0]
+    ranks = jnp.asarray(ranks, dtype=jnp.float32)
+    out = jnp.full((n_segments,), jnp.inf, dtype=jnp.float32)
+    for bucket in graph.buckets:
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            tgt_p = target[bucket.var_ids[:, p]]
+            for q in range(arity):
+                if p == q:
+                    continue
+                vq = bucket.var_ids[:, q]
+                eligible = per_var[vq] == tgt_p
+                cand_rank = jnp.where(eligible, ranks[vq], jnp.inf)
+                out = jnp.minimum(out, jax.ops.segment_min(
+                    cand_rank, bucket.var_ids[:, p],
+                    num_segments=n_segments,
+                ))
+    return out
+
+
+def best_candidates(graph: CompiledFactorGraph, cand: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(best_cost [V+1], is_best [V+1, D]) over valid domain slots."""
+    masked = jnp.where(graph.var_valid, cand, jnp.inf)
+    best = jnp.min(masked, axis=1)
+    return best, masked == best[:, None]
+
+
+def random_best_choice(key: jnp.ndarray, is_best: jnp.ndarray
+                       ) -> jnp.ndarray:
+    """Uniform random choice among True slots per row ([N] int32) —
+    reference's random.choice(best_values) (dsa.py:411)."""
+    u = jax.random.uniform(key, is_best.shape)
+    return jnp.argmax(jnp.where(is_best, u, -1.0), axis=1).astype(jnp.int32)
+
+
+def random_initial_values(key: jnp.ndarray,
+                          graph: CompiledFactorGraph) -> jnp.ndarray:
+    """Random valid value per variable ([V+1] int32, sentinel row 0) —
+    the reference's random_value_selection at start (dsa.py:293)."""
+    u = jax.random.uniform(key, graph.var_valid.shape)
+    return jnp.argmax(
+        jnp.where(graph.var_valid, u, -1.0), axis=1
+    ).astype(jnp.int32)

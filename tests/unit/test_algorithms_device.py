@@ -1,0 +1,181 @@
+"""Device/engine algorithm tests.
+
+Exact algorithms (dpop, syncbb) are checked against brute-force optima
+on random problems; local search (dsa, mgm) against quality invariants
+(mgm monotonicity is structural: never worse than random init).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable, VariableWithCostDict
+from pydcop_tpu.dcop.relations import NAryMatrixRelation, constraint_from_str
+
+
+def brute_force(dcop):
+    best, best_asst = np.inf, None
+    names = list(dcop.variables)
+    domains = [list(dcop.variables[n].domain) for n in names]
+    sign = 1 if dcop.objective == "min" else -1
+    for combo in itertools.product(*domains):
+        asst = dict(zip(names, combo))
+        cost, _ = dcop.solution_cost(asst)
+        if sign * cost < best:
+            best, best_asst = sign * cost, asst
+    return sign * best, best_asst
+
+
+def random_dcop(n_vars=8, n_constraints=12, d=3, seed=0, objective="min",
+                with_var_costs=False, arity3=False):
+    rng = np.random.default_rng(seed)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP("rand", objective=objective)
+    variables = []
+    for i in range(n_vars):
+        if with_var_costs:
+            costs = {v: float(rng.random()) for v in dom}
+            variables.append(
+                VariableWithCostDict(f"v{i}", dom, costs))
+        else:
+            variables.append(Variable(f"v{i}", dom))
+    for k in range(n_constraints):
+        arity = 3 if (arity3 and k % 4 == 0) else 2
+        idx = rng.choice(n_vars, size=arity, replace=False)
+        table = rng.integers(0, 10, size=(d,) * arity).astype(float)
+        dcop.add_constraint(NAryMatrixRelation(
+            [variables[i] for i in idx], table, f"c{k}"))
+    return dcop
+
+
+class TestDpop:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_optimal_vs_bruteforce(self, seed):
+        dcop = random_dcop(seed=seed)
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "dpop")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_optimal_with_var_costs(self):
+        dcop = random_dcop(seed=3, with_var_costs=True)
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "dpop")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_optimal_arity3(self):
+        dcop = random_dcop(seed=4, arity3=True)
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "dpop")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_max_mode(self):
+        dcop = random_dcop(seed=5, objective="max")
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "dpop")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_disconnected_components(self):
+        dom = Domain("d", "", [0, 1])
+        a, b, c, e = (Variable(n, dom) for n in "abce")
+        dcop = DCOP("disc")
+        dcop.add_constraint(constraint_from_str("c1", "a + b", [a, b]))
+        dcop.add_constraint(constraint_from_str("c2", "2 - c - e", [c, e]))
+        res = solve(dcop, "dpop")
+        assert res["cost"] == 0
+        assert res["assignment"] == {"a": 0, "b": 0, "c": 1, "e": 1}
+
+
+class TestSyncBB:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_optimal_vs_bruteforce(self, seed):
+        dcop = random_dcop(seed=seed)
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "syncbb")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_optimal_with_var_costs_and_arity3(self):
+        dcop = random_dcop(seed=6, with_var_costs=True, arity3=True)
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "syncbb")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_max_mode(self):
+        dcop = random_dcop(seed=7, objective="max")
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "syncbb")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_agrees_with_dpop(self):
+        dcop = random_dcop(seed=8, n_vars=10, n_constraints=18)
+        r1 = solve(dcop, "dpop")
+        r2 = solve(dcop, "syncbb")
+        assert r1["cost"] == pytest.approx(r2["cost"])
+
+
+class TestLocalSearch:
+    def test_dsa_reaches_reasonable_quality(self):
+        dcop = random_dcop(seed=9, n_vars=20, n_constraints=30)
+        optimal, _ = brute_force_sample(dcop)
+        res = solve(dcop, "dsa", max_cycles=100)
+        assert res["violations"] == 0
+        # Local search should land within 2x of a sampled-good cost.
+        assert res["cost"] <= optimal * 2 + 10
+
+    def test_dsa_variants_and_params(self):
+        dcop = random_dcop(seed=10)
+        for variant in ("A", "B", "C"):
+            res = solve(dcop, "dsa", max_cycles=30,
+                        algo_params={"variant": variant})
+            assert res["assignment"]
+        res = solve(dcop, "dsa", max_cycles=30,
+                    algo_params={"p_mode": "arity"})
+        assert res["assignment"]
+
+    def test_dsa_deterministic_given_seed(self):
+        dcop = random_dcop(seed=11)
+        r1 = solve(dcop, "dsa", max_cycles=40, algo_params={"seed": 5})
+        r2 = solve(dcop, "dsa", max_cycles=40, algo_params={"seed": 5})
+        assert r1["assignment"] == r2["assignment"]
+
+    def test_mgm_monotone_quality(self):
+        dcop = random_dcop(seed=12, n_vars=15, n_constraints=25)
+        r_short = solve(dcop, "mgm", max_cycles=5)
+        r_long = solve(dcop, "mgm", max_cycles=60)
+        assert r_long["cost"] <= r_short["cost"] + 1e-6
+
+    def test_mgm_break_modes(self):
+        dcop = random_dcop(seed=13)
+        for mode in ("lexic", "random"):
+            res = solve(dcop, "mgm", max_cycles=30,
+                        algo_params={"break_mode": mode})
+            assert res["assignment"]
+
+    def test_device_cost_matches_host_cost(self):
+        """The on-device cost accumulator must agree with the host
+        solution_cost evaluation (cross-validates the compiled arrays)."""
+        dcop = random_dcop(seed=14, arity3=True, with_var_costs=True)
+        for algo in ("dsa", "mgm"):
+            res = solve(dcop, algo, max_cycles=30)
+            assert res["metrics"]["device_cost"] == pytest.approx(
+                res["cost"], rel=1e-5
+            )
+
+
+def brute_force_sample(dcop, n=2000, seed=0):
+    """Sampled best cost (cheap stand-in for brute force on larger
+    problems)."""
+    rng = np.random.default_rng(seed)
+    names = list(dcop.variables)
+    domains = [list(dcop.variables[v].domain) for v in names]
+    best, best_asst = np.inf, None
+    for _ in range(n):
+        asst = {
+            v: d[rng.integers(len(d))] for v, d in zip(names, domains)
+        }
+        cost, _ = dcop.solution_cost(asst)
+        if cost < best:
+            best, best_asst = cost, asst
+    return best, best_asst
