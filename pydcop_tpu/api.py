@@ -79,6 +79,10 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                 "construction); use backend='device'"
             )
 
+        # Bound non-terminating algorithms: without an explicit timeout a
+        # maxsum/dsa run would block forever on the finished event.
+        if timeout is None:
+            timeout = 15.0
         return solve_with_agents(
             dcop, algo_def, distribution=distribution,
             timeout=timeout, max_cycles=max_cycles,

@@ -60,15 +60,22 @@ def run_cmd(args) -> int:
             "violation": res["violations"],
             "time": res["time"],
             "msg_count": res["metrics"].get("msg_count", 0),
-            "msg_size": res["metrics"].get("msg_count", 0),
+            "msg_size": res["metrics"].get("msg_size", 0),
             "cycle": res["cycles"],
             "compile_time": res["compile_time"],
             "backend": "device",
         }
     else:
+        if args.mode == "process":
+            print("Error: --mode process not implemented yet; use "
+                  "device or thread")
+            return 3
+        # Algorithms without a termination condition would run forever:
+        # bound thread runs when no explicit timeout was given.
+        timeout = args.timeout if args.timeout is not None else 15.0
         res = solve(
             dcop, algo_def, distribution=args.distribution,
-            backend="thread", timeout=args.timeout,
+            backend="thread", timeout=timeout,
             max_cycles=args.cycles,
         )
         result = {

@@ -1,0 +1,35 @@
+"""oneagent distribution: one computation per agent.
+
+Reference parity: pydcop/distribution/oneagent.py (distribute :90,
+cost 0 :65) — the classic DCOP hypothesis where each agent controls
+exactly one variable/computation.
+"""
+
+from typing import Iterable, Optional
+
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+
+
+def distribute(computation_graph, agentsdef: Iterable,
+               hints=None, computation_memory=None,
+               communication_load=None, **_) -> Distribution:
+    agents = list(agentsdef)
+    nodes = computation_graph.nodes
+    if len(agents) < len(nodes):
+        raise ImpossibleDistributionException(
+            f"Need at least {len(nodes)} agents for {len(nodes)} "
+            f"computations, got {len(agents)}"
+        )
+    mapping = {a.name: [] for a in agents}
+    for node, agent in zip(nodes, agents):
+        mapping[agent.name].append(node.name)
+    return Distribution(mapping)
+
+
+def distribution_cost(distribution: Distribution, computation_graph,
+                      agentsdef, computation_memory=None,
+                      communication_load=None) -> float:
+    return 0

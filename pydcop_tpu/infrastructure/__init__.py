@@ -1,0 +1,8 @@
+"""Agent-mode runtime: message-passing computations on threaded agents.
+
+Reference parity: pydcop/infrastructure/ — this is the reference's
+execution model (one thread per agent, per-agent priority message queue,
+central orchestrator), kept alongside the device engine for
+reference-equivalent distributed execution, multi-machine deployment and
+the resilience features (replication, repair, dynamic scenarios).
+"""

@@ -1,0 +1,546 @@
+"""Agent-mode algorithm computations (thread/process/multi-machine).
+
+These implement the same message semantics as the device kernels, but as
+per-computation message handlers running on agent threads — the
+reference's execution model (and its testing trick: drive computations
+directly with a mocked message sender).
+
+Reference parity:
+- maxsum: pydcop/algorithms/maxsum.py:279-721 (BSP via the synchronous
+  mixin; factor update :382, variable update :623, damping :679,
+  SAME_COUNT send suppression :106/:366-377);
+- dsa: pydcop/algorithms/dsa.py:214-431 (async with per-cycle value
+  bookkeeping);
+- mgm: pydcop/algorithms/mgm.py:213-609 (value/gain two-phase rounds
+  with postponed-message queues).
+"""
+
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from pydcop_tpu.dcop.objects import VariableNoisyCostFunc
+from pydcop_tpu.dcop.relations import (
+    assignment_cost,
+    find_optimal,
+    find_optimum,
+    optimal_cost_value,
+)
+from pydcop_tpu.infrastructure.computations import (
+    DcopComputation,
+    Message,
+    SynchronousComputationMixin,
+    VariableComputation,
+    message_type,
+    register,
+)
+
+SAME_COUNT = 4
+
+
+# --------------------------------------------------------------------- #
+# Shared MaxSum math (dict form — the device form lives in ops/maxsum.py)
+
+
+def factor_costs_for_var(factor, variable, recv_costs: Dict, mode: str
+                         ) -> Dict:
+    """Marginal costs a factor sends to one of its variables: min (or
+    max) over the other variables' assignments of factor cost + their
+    received costs (reference maxsum.py:382)."""
+    from pydcop_tpu.dcop.relations import generate_assignment_as_dict
+
+    other_vars = [v for v in factor.dimensions if v != variable]
+    costs = {}
+    better = (lambda a, b: a < b) if mode == "min" else (lambda a, b: a > b)
+    for d in variable.domain:
+        best = None
+        for asst in generate_assignment_as_dict(other_vars):
+            f_val = factor(**asst, **{variable.name: d})
+            sum_cost = 0
+            for other, val in asst.items():
+                if other in recv_costs and val in recv_costs[other]:
+                    sum_cost += recv_costs[other][val]
+            current = f_val + sum_cost
+            if best is None or better(current, best):
+                best = current
+        costs[d] = best
+    return costs
+
+
+def costs_for_factor(variable, factor_name: str, factors: List,
+                     costs: Dict) -> Dict:
+    """Message a variable sends to one factor: own costs + sum of other
+    factors' costs, mean-normalized (reference maxsum.py:623-674)."""
+    msg_costs = {d: variable.cost_for_val(d) for d in variable.domain}
+    sum_cost = 0
+    for d in variable.domain:
+        for f in factors:
+            if f == factor_name or f not in costs:
+                continue
+            if d not in costs[f]:
+                continue
+            c = costs[f][d]
+            sum_cost += c
+            msg_costs[d] += c
+    avg = sum_cost / len(msg_costs)
+    return {d: c - avg for d, c in msg_costs.items()}
+
+
+def apply_damping(costs: Dict, prev_costs: Optional[Dict],
+                  damping: float) -> Dict:
+    if prev_costs is None:
+        return costs
+    return {
+        d: damping * prev_costs[d] + (1 - damping) * c
+        for d, c in costs.items()
+    }
+
+
+def approx_match(costs: Dict, prev_costs: Optional[Dict],
+                 stability: float) -> bool:
+    if prev_costs is None:
+        return False
+    for d, c in costs.items():
+        prev = prev_costs[d]
+        if prev != c:
+            delta = abs(prev - c)
+            if prev + c == 0 or not (2 * delta / abs(prev + c)) < stability:
+                return False
+    return True
+
+
+def select_value(variable, costs: Dict[str, Dict], mode: str
+                 ) -> Tuple[Any, float]:
+    """Pick the domain value minimizing own + received costs; first
+    optimum in domain order wins ties (reference maxsum.py:584)."""
+    best_d, best_c = None, None
+    better = (lambda a, b: a < b) if mode == "min" else (lambda a, b: a > b)
+    for d in variable.domain:
+        c = variable.cost_for_val(d)
+        for f_costs in costs.values():
+            if d in f_costs:
+                c += f_costs[d]
+        if best_c is None or better(c, best_c):
+            best_d, best_c = d, c
+    return best_d, best_c
+
+
+class MaxSumMessage(Message):
+    def __init__(self, costs: Dict):
+        super().__init__("max_sum", None)
+        self._costs = costs
+
+    @property
+    def costs(self) -> Dict:
+        return dict(self._costs)
+
+    @property
+    def size(self) -> int:
+        return 2 * len(self._costs)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, MaxSumMessage) and self._costs == other._costs
+        )
+
+    def _simple_repr(self):
+        vals, costs = (
+            zip(*self._costs.items()) if self._costs else ((), ())
+        )
+        return {
+            "__module__": self.__class__.__module__,
+            "__qualname__": self.__class__.__qualname__,
+            "vals": list(vals),
+            "costs": list(costs),
+        }
+
+    @classmethod
+    def _from_repr(cls, r):
+        return cls(dict(zip(r["vals"], r["costs"])))
+
+    def __repr__(self):
+        return f"MaxSumMessage({self._costs})"
+
+
+class MaxSumFactorComputation(SynchronousComputationMixin,
+                              DcopComputation):
+    """One computation per factor (constraint) in the factor graph."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.factor.name, comp_def)
+        self.factor = comp_def.node.factor
+        self.variables = self.factor.dimensions
+        self._costs: Dict[str, Dict] = {}
+        params = comp_def.algo.params
+        self.damping = params.get("damping", 0.5)
+        self.damping_nodes = params.get("damping_nodes", "both")
+        self.stability = params.get("stability", 0.1)
+        self._prev: Dict[str, Tuple[Optional[Dict], int]] = {}
+
+    @register("max_sum")
+    def _on_maxsum_msg(self, sender, msg, t):
+        pass  # collected by the synchronous mixin
+
+    def footprint(self) -> float:
+        return super().footprint()
+
+    def on_new_cycle(self, messages, cycle_id):
+        for sender, (msg, t) in messages.items():
+            self._costs[sender] = msg.costs
+        for v in self.variables:
+            costs_v = factor_costs_for_var(
+                self.factor, v, self._costs, self.mode
+            )
+            prev, count = self._prev.get(v.name, (None, 0))
+            if self.damping_nodes in ("factors", "both"):
+                costs_v = apply_damping(costs_v, prev, self.damping)
+            if not approx_match(costs_v, prev, self.stability):
+                self.post_msg(v.name, MaxSumMessage(costs_v))
+                self._prev[v.name] = (costs_v, 1)
+            elif count < SAME_COUNT:
+                self.post_msg(v.name, MaxSumMessage(costs_v))
+                self._prev[v.name] = (costs_v, count + 1)
+            # else: send suppression (reference :366-377); the sync
+            # mixin emits a filler instead.
+        return None
+
+
+class MaxSumVariableComputation(SynchronousComputationMixin,
+                                VariableComputation):
+    """One computation per variable in the factor graph."""
+
+    def __init__(self, comp_def):
+        variable = comp_def.node.variable
+        params = comp_def.algo.params
+        noise = params.get("noise", 0.01)
+        if noise and not isinstance(variable, VariableNoisyCostFunc):
+            cost_func = (
+                variable.cost_func
+                if hasattr(variable, "cost_func")
+                else (lambda _: 0)
+            )
+            variable = VariableNoisyCostFunc(
+                variable.name, variable.domain, cost_func,
+                initial_value=variable.initial_value, noise_level=noise,
+            )
+        super().__init__(variable, comp_def)
+        self.factor_names = [l.factor_node for l in comp_def.node.links]
+        self._costs: Dict[str, Dict] = {}
+        self.damping = params.get("damping", 0.5)
+        self.damping_nodes = params.get("damping_nodes", "both")
+        self.stability = params.get("stability", 0.1)
+        self._prev: Dict[str, Tuple[Optional[Dict], int]] = {}
+
+    @register("max_sum")
+    def _on_maxsum_msg(self, sender, msg, t):
+        pass  # collected by the synchronous mixin
+
+    def on_start(self):
+        # Select an initial value from own costs.
+        value, cost = optimal_cost_value(self._variable, self.mode)
+        self.value_selection(value, cost)
+
+    def on_new_cycle(self, messages, cycle_id):
+        for sender, (msg, t) in messages.items():
+            self._costs[sender] = msg.costs
+        value, cost = select_value(self._variable, self._costs, self.mode)
+        self.value_selection(value, cost)
+        for f_name in self.factor_names:
+            costs_f = costs_for_factor(
+                self._variable, f_name, self.factor_names, self._costs
+            )
+            prev, count = self._prev.get(f_name, (None, 0))
+            if self.damping_nodes in ("vars", "both"):
+                costs_f = apply_damping(costs_f, prev, self.damping)
+            if not approx_match(costs_f, prev, self.stability):
+                self.post_msg(f_name, MaxSumMessage(costs_f))
+                self._prev[f_name] = (costs_f, 1)
+            elif count < SAME_COUNT:
+                self.post_msg(f_name, MaxSumMessage(costs_f))
+                self._prev[f_name] = (costs_f, count + 1)
+        return None
+
+
+# --------------------------------------------------------------------- #
+# DSA (asynchronous, cycle bookkeeping)
+
+DsaMessage = message_type("dsa_value", ["value"])
+
+
+class DsaComputation(VariableComputation):
+    """DSA-A/B/C with per-cycle neighbor value maps (reference
+    dsa.py:214-431)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.probability = params.get("probability", 0.7)
+        self.variant = params.get("variant", "B")
+        self.stop_cycle = params.get("stop_cycle", 0)
+        self.constraints = list(comp_def.node.constraints)
+        self._neighbors = [
+            v.name for c in self.constraints for v in c.dimensions
+            if v.name != self.name
+        ]
+        self._neighbors = list(dict.fromkeys(self._neighbors))
+        if params.get("p_mode") == "arity":
+            n_count = sum(len(c.dimensions) - 1 for c in self.constraints)
+            if n_count:
+                self.probability = 1.2 / n_count
+        self.current_cycle: Dict[str, Any] = {}
+        self.next_cycle: Dict[str, Any] = {}
+        if self.variant == "B":
+            self._best_constraint_costs = {
+                c.name: find_optimum(c, self.mode) for c in self.constraints
+            }
+
+    @property
+    def neighbors(self) -> List[str]:
+        return self._neighbors
+
+    def on_start(self):
+        if not self._neighbors:
+            value, cost = optimal_cost_value(self._variable, self.mode)
+            self.value_selection(value, cost)
+            self.finished()
+            self.stop()
+            return
+        self.random_value_selection()
+        self.post_to_all_neighbors(DsaMessage(self.current_value))
+        self._evaluate_cycle()
+
+    @register("dsa_value")
+    def _on_value_msg(self, sender, msg, t):
+        if not self._running:
+            return
+        if sender not in self.current_cycle:
+            self.current_cycle[sender] = msg.value
+            self._evaluate_cycle()
+        else:
+            self.next_cycle[sender] = msg.value
+
+    def _evaluate_cycle(self):
+        if len(self.current_cycle) < len(self._neighbors):
+            return
+        self.current_cycle[self.name] = self.current_value
+        asst = dict(self.current_cycle)
+        best_values, best_cost = find_optimal(
+            self._variable, asst, self.constraints, self.mode
+        )
+        current_cost = assignment_cost(asst, self.constraints)
+        delta = abs(current_cost - best_cost)
+
+        if self.variant == "A":
+            if delta > 0:
+                self._probabilistic_change(best_cost, best_values)
+        elif self.variant == "B":
+            if delta > 0:
+                self._probabilistic_change(best_cost, best_values)
+            elif delta == 0 and self._exists_violated():
+                if len(best_values) > 1 and \
+                        self.current_value in best_values:
+                    best_values.remove(self.current_value)
+                self._probabilistic_change(best_cost, best_values)
+        else:  # C
+            if delta > 0:
+                self._probabilistic_change(best_cost, best_values)
+            elif delta == 0:
+                if len(best_values) > 1 and \
+                        self.current_value in best_values:
+                    best_values.remove(self.current_value)
+                self._probabilistic_change(best_cost, best_values)
+
+        self.new_cycle()
+        self.current_cycle, self.next_cycle = self.next_cycle, {}
+        if self.stop_cycle and self.cycle_count >= self.stop_cycle:
+            self.finished()
+            self.stop()
+            return
+        self.post_to_all_neighbors(DsaMessage(self.current_value))
+
+    def _probabilistic_change(self, best_cost, best_values):
+        if self.probability > random.random():
+            self.value_selection(random.choice(best_values), best_cost)
+
+    def _exists_violated(self) -> bool:
+        asst = dict(self.current_cycle)
+        asst[self.name] = self.current_value
+        for c in self.constraints:
+            cost = c(**{v.name: asst[v.name] for v in c.dimensions})
+            if cost != self._best_constraint_costs[c.name]:
+                return True
+        return False
+
+
+# --------------------------------------------------------------------- #
+# MGM (two-phase rounds)
+
+MgmValueMessage = message_type("mgm_value", ["value"])
+MgmGainMessage = message_type("mgm_gain", ["value", "random_nb"])
+
+
+class MgmComputation(VariableComputation):
+    """MGM rounds: value phase then gain phase, with postponed queues
+    for early messages (reference mgm.py:213-609)."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        params = comp_def.algo.params
+        self.break_mode = params.get("break_mode", "lexic")
+        self.stop_cycle = params.get("stop_cycle", 0)
+        self.constraints = list(comp_def.node.constraints)
+        self._neighbors = list(dict.fromkeys(
+            v.name for c in self.constraints for v in c.dimensions
+            if v.name != self.name
+        ))
+        self._state = "values"
+        self._neighbors_values: Dict[str, Any] = {}
+        self._neighbors_gains: Dict[str, Tuple[float, float]] = {}
+        self._postponed_values: List[Tuple] = []
+        self._postponed_gains: List[Tuple] = []
+        self._gain = 0.0
+        self._new_value = None
+        self._random_nb = 0.0
+
+    @property
+    def neighbors(self) -> List[str]:
+        return self._neighbors
+
+    def on_start(self):
+        if not self._neighbors:
+            value, cost = optimal_cost_value(self._variable, self.mode)
+            self.value_selection(value, cost)
+            self.finished()
+            self.stop()
+            return
+        self.random_value_selection()
+        self._send_value()
+
+    def _send_value(self):
+        self.new_cycle()
+        if self.stop_cycle and self.cycle_count >= self.stop_cycle:
+            self.finished()
+            self.stop()
+            return
+        self.post_to_all_neighbors(MgmValueMessage(self.current_value))
+
+    @register("mgm_value")
+    def _on_value_msg(self, sender, msg, t):
+        if self._state == "values":
+            self._handle_value(sender, msg.value)
+        else:
+            self._postponed_values.append((sender, msg.value))
+
+    def _handle_value(self, sender, value):
+        self._neighbors_values[sender] = value
+        if len(self._neighbors_values) < len(self._neighbors):
+            return
+        # All values in: compute current cost, best response and gain.
+        asst = dict(self._neighbors_values)
+        asst[self.name] = self.current_value
+        current_cost = assignment_cost(asst, self.constraints)
+        current_cost += self._variable.cost_for_val(self.current_value)
+        self.value_selection(self.current_value, current_cost)
+
+        best_values, best_cost = find_optimal(
+            self._variable, self._neighbors_values, self.constraints,
+            self.mode,
+        )
+        # Include own unary cost in the comparison:
+        best_with_unary = None
+        chosen = []
+        for v in best_values:
+            c = best_cost + self._variable.cost_for_val(v)
+            if best_with_unary is None or c < best_with_unary:
+                best_with_unary, chosen = c, [v]
+            elif c == best_with_unary:
+                chosen.append(v)
+        self._gain = current_cost - best_with_unary
+        if (self.mode == "min" and self._gain > 0) or (
+            self.mode == "max" and self._gain < 0
+        ):
+            self._new_value = random.choice(chosen)
+        else:
+            self._new_value = self.current_value
+        self._random_nb = random.random()
+        self.post_to_all_neighbors(
+            MgmGainMessage(self._gain, self._random_nb)
+        )
+        self._state = "gain"
+        for sender2, msg2 in self._postponed_gains:
+            self._handle_gain(sender2, msg2)
+        self._postponed_gains.clear()
+
+    @register("mgm_gain")
+    def _on_gain_msg(self, sender, msg, t):
+        if self._state == "gain":
+            self._handle_gain(sender, msg)
+        else:
+            self._postponed_gains.append((sender, msg))
+
+    def _handle_gain(self, sender, msg):
+        self._neighbors_gains[sender] = (msg.value, msg.random_nb)
+        if len(self._neighbors_gains) < len(self._neighbors):
+            return
+        max_gain = max(g for g, _ in self._neighbors_gains.values())
+        if self._gain > max_gain:
+            self.value_selection(
+                self._new_value, self.current_cost - self._gain
+            )
+        elif self._gain == max_gain:
+            if self.break_mode == "random":
+                ties = sorted(
+                    [
+                        (rnd, name)
+                        for name, (g, rnd) in
+                        self._neighbors_gains.items()
+                        if g == max_gain
+                    ]
+                    + [(self._random_nb, self.name)]
+                )
+            else:
+                ties = sorted(
+                    [
+                        (name, name)
+                        for name, (g, _) in
+                        self._neighbors_gains.items()
+                        if g == max_gain
+                    ]
+                    + [(self.name, self.name)]
+                )
+            if ties[0][1] == self.name:
+                self.value_selection(
+                    self._new_value, self.current_cost - self._gain
+                )
+        self._neighbors_gains.clear()
+        self._neighbors_values.clear()
+        self._state = "values"
+        self._send_value()
+        for sender2, value in self._postponed_values:
+            self._handle_value(sender2, value)
+        self._postponed_values.clear()
+
+
+# --------------------------------------------------------------------- #
+# Registry
+
+
+def build(algo_name: str, comp_def):
+    from pydcop_tpu.computations_graph.factor_graph import (
+        FactorComputationNode,
+        VariableComputationNode,
+    )
+
+    if algo_name in ("maxsum", "amaxsum"):
+        node = comp_def.node
+        if isinstance(node, FactorComputationNode):
+            return MaxSumFactorComputation(comp_def)
+        if isinstance(node, VariableComputationNode):
+            return MaxSumVariableComputation(comp_def)
+        raise TypeError(f"Unsupported node for maxsum: {node}")
+    if algo_name in ("dsa", "adsa", "dsatuto", "mixeddsa"):
+        return DsaComputation(comp_def)
+    if algo_name == "mgm":
+        return MgmComputation(comp_def)
+    raise NotImplementedError(
+        f"No agent-mode computation for algorithm {algo_name!r} yet"
+    )

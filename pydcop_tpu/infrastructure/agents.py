@@ -1,0 +1,242 @@
+"""Agent runtime: one thread per agent hosting N computations.
+
+Reference parity: pydcop/infrastructure/agents.py (Agent :78 — thread
+:140, add_computation :175, run/start :324, main loop _run :785-838,
+clean_shutdown :431, metrics :717, set_periodic_action :743;
+AgentMetrics :878; ResilientAgent :927).
+"""
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from pydcop_tpu.dcop.objects import AgentDef
+from pydcop_tpu.infrastructure.communication import (
+    CommunicationLayer,
+    Messaging,
+)
+from pydcop_tpu.infrastructure.computations import (
+    MessagePassingComputation,
+)
+from pydcop_tpu.infrastructure.discovery import Discovery
+
+
+class AgentException(Exception):
+    pass
+
+
+class Agent:
+    """A container running computations on its own thread.
+
+    The agent pops messages from its Messaging priority queue, dispatches
+    them to hosted computations, and runs registered periodic actions in
+    between (reference loop: agents.py:785-838).
+    """
+
+    def __init__(self, name: str, comm: CommunicationLayer,
+                 agent_def: Optional[AgentDef] = None,
+                 delay: Optional[float] = None):
+        self._name = name
+        self.agent_def = agent_def
+        self._comm = comm
+        self._messaging = Messaging(name, comm, delay=delay or 0)
+        self.discovery = Discovery(name, comm.address)
+        comm.discovery = self.discovery
+        self._computations: Dict[str, MessagePassingComputation] = {}
+        self._thread = threading.Thread(
+            target=self._run, name=f"agent_{name}", daemon=True
+        )
+        self._running = False
+        self._stopping = threading.Event()
+        self.logger = logging.getLogger(f"pydcop.agent.{name}")
+        self._periodic: List[List] = []  # [period, action, next_due]
+        self.t_active = 0.0
+        self._start_time: Optional[float] = None
+        # Orchestration hooks, set by OrchestratedAgent:
+        self.on_value_change: Optional[Callable] = None
+        self.on_cycle_change: Optional[Callable] = None
+        self.on_computation_finished: Optional[Callable] = None
+        self.add_computation(self.discovery.discovery_computation)
+
+    # -- properties ---------------------------------------------------- #
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def address(self):
+        return self._comm.address
+
+    @property
+    def messaging(self) -> Messaging:
+        return self._messaging
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    @property
+    def computations(self) -> List[MessagePassingComputation]:
+        return list(self._computations.values())
+
+    def computation(self, name: str) -> MessagePassingComputation:
+        try:
+            return self._computations[name]
+        except KeyError:
+            raise AgentException(
+                f"Agent {self.name} does not host computation {name}"
+            )
+
+    def has_computation(self, name: str) -> bool:
+        return name in self._computations
+
+    # -- computations -------------------------------------------------- #
+
+    def add_computation(self, computation: MessagePassingComputation,
+                        comp_name: Optional[str] = None):
+        """Host a computation: wire its message sender to our queue,
+        register it in messaging + discovery, and hook notifications
+        (reference agents.py:175-221)."""
+        name = comp_name or computation.name
+        computation.message_sender = self._messaging.post_msg
+        computation._periodic_action_handler = self._add_periodic
+        for period, action in computation._periodic_actions:
+            self._add_periodic(period, action)
+        self._computations[name] = computation
+        self._messaging.register_computation(name)
+        if not name.startswith("_"):
+            self.discovery.register_computation(name, self._name)
+        computation._on_value_cb = self._notify_value
+        computation._on_cycle_cb = self._notify_cycle
+        computation._on_finish_cb = self._notify_finished
+
+    def remove_computation(self, name: str):
+        comp = self._computations.pop(name, None)
+        if comp is not None:
+            comp.stop()
+            self._messaging.unregister_computation(name)
+            if not name.startswith("_"):
+                self.discovery.unregister_computation(name)
+
+    def _notify_value(self, comp):
+        if self.on_value_change:
+            self.on_value_change(comp)
+
+    def _notify_cycle(self, comp):
+        if self.on_cycle_change:
+            self.on_cycle_change(comp)
+
+    def _notify_finished(self, comp):
+        if self.on_computation_finished:
+            self.on_computation_finished(comp)
+
+    # -- periodic actions ---------------------------------------------- #
+
+    def _add_periodic(self, period: float, action: Callable):
+        self._periodic.append([period, action, time.monotonic() + period])
+
+    def set_periodic_action(self, period: float, action: Callable):
+        """Run `action` every `period` seconds on the agent thread
+        (reference agents.py:743)."""
+        self._add_periodic(period, action)
+        return action
+
+    def remove_periodic_action(self, action):
+        self._periodic = [p for p in self._periodic if p[1] is not action]
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self):
+        if self._running:
+            raise AgentException(f"Agent {self.name} already started")
+        self._running = True
+        self._start_time = time.monotonic()
+        self._thread.start()
+
+    def run(self, computations: Optional[List[str]] = None):
+        """Start hosted computations (all non-service ones by default)."""
+        if computations is None:
+            computations = [
+                n for n in self._computations if not n.startswith("_")
+            ]
+        for name in computations:
+            comp = self.computation(name)
+            if not comp.is_running:
+                comp.start()
+
+    def _run(self):
+        while not self._stopping.is_set():
+            cmsg = self._messaging.next_msg(0.05)
+            if cmsg is not None:
+                t0 = time.monotonic()
+                self._handle_message(cmsg)
+                self.t_active += time.monotonic() - t0
+            self._process_periodic()
+
+    def _handle_message(self, cmsg):
+        comp = self._computations.get(cmsg.dest_comp)
+        if comp is None:
+            self.logger.warning(
+                "Message for unknown computation %s: %s",
+                cmsg.dest_comp, cmsg.msg,
+            )
+            return
+        try:
+            comp.on_message(cmsg.src_comp, cmsg.msg, time.monotonic())
+        except Exception:
+            self.logger.exception(
+                "Error handling message %s for %s", cmsg.msg, cmsg.dest_comp
+            )
+
+    def _process_periodic(self):
+        now = time.monotonic()
+        for entry in self._periodic:
+            period, action, due = entry
+            if now >= due:
+                entry[2] = now + period
+                try:
+                    action()
+                except Exception:
+                    self.logger.exception("Error in periodic action")
+
+    def stop(self):
+        self._stopping.set()
+
+    def clean_shutdown(self, timeout: float = 5):
+        """Stop computations, drain, stop the thread and transport."""
+        for comp in list(self._computations.values()):
+            try:
+                comp.stop()
+            except Exception:
+                self.logger.exception(
+                    "Error stopping computation %s", comp.name
+                )
+        self.stop()
+        self.join(timeout)
+        self._messaging.shutdown()
+
+    def join(self, timeout: Optional[float] = None):
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    # -- metrics ------------------------------------------------------- #
+
+    def metrics(self) -> Dict:
+        cycles = {}
+        for name, comp in self._computations.items():
+            if hasattr(comp, "cycle_count"):
+                cycles[name] = comp.cycle_count
+        return {
+            "count_ext_msg": dict(self._messaging.count_ext_msg),
+            "size_ext_msg": dict(self._messaging.size_ext_msg),
+            "cycles": cycles,
+            "activity_ratio": (
+                self.t_active / (time.monotonic() - self._start_time)
+                if self._start_time else 0
+            ),
+        }
+
+    def __repr__(self):
+        return f"Agent({self.name})"

@@ -1,0 +1,280 @@
+"""Transport layer: communication layers + per-agent messaging queues.
+
+Reference parity: pydcop/infrastructure/communication.py
+(ComputationMessage :51, CommunicationLayer :56, InProcessCommunicationLayer
+:207, HttpCommunicationLayer :313, Messaging :500, priorities :495-497).
+
+Message priorities order queue pops: discovery (5) < management (10) <
+value (15) < algo (20) — lower value pops first.
+"""
+
+import json
+import logging
+import queue
+import threading
+import time
+from collections import namedtuple
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib import request as urlrequest
+
+from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+MSG_DISCOVERY = 5
+MSG_MGT = 10
+MSG_VALUE = 15
+MSG_ALGO = 20
+
+ComputationMessage = namedtuple(
+    "ComputationMessage", ["src_comp", "dest_comp", "msg", "msg_type"]
+)
+
+logger = logging.getLogger("pydcop.communication")
+
+
+class UnknownComputation(Exception):
+    pass
+
+
+class UnreachableAgent(Exception):
+    pass
+
+
+class CommunicationLayer:
+    """Protocol: transport between agents."""
+
+    def __init__(self):
+        self.messaging: Optional["Messaging"] = None
+        self.discovery = None
+
+    @property
+    def address(self):
+        raise NotImplementedError
+
+    def send_msg(self, src_agent: str, dest_agent: str,
+                 msg: ComputationMessage, on_error=None):
+        raise NotImplementedError
+
+    def receive_msg(self, src_agent: str, dest_agent: str,
+                    msg: ComputationMessage):
+        """Deliver an incoming message to the local messaging queue."""
+        self.messaging.post_local(msg)
+
+    def shutdown(self):
+        pass
+
+
+class InProcessCommunicationLayer(CommunicationLayer):
+    """Address = the layer object itself; send = direct method call
+    (reference communication.py:207-294)."""
+
+    @property
+    def address(self):
+        return self
+
+    def send_msg(self, src_agent: str, dest_agent: str,
+                 msg: ComputationMessage, on_error=None):
+        address = self.discovery.agent_address(dest_agent)
+        address.receive_msg(src_agent, dest_agent, msg)
+
+    def __repr__(self):
+        return f"InProcessCommunicationLayer({id(self):x})"
+
+
+class Messaging:
+    """Per-agent priority message queue + routing.
+
+    Local destinations go straight to the queue; remote ones through the
+    communication layer.  Messages to not-yet-known computations are
+    parked and retried when discovery learns the destination (reference
+    communication.py:636-726).
+    """
+
+    def __init__(self, agent_name: str, comm: CommunicationLayer,
+                 delay: float = 0):
+        self._agent_name = agent_name
+        self._comm = comm
+        comm.messaging = self
+        self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._local_computations: Dict[str, bool] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._delay = delay
+        self._shutdown = False
+        # Metrics (reference :542-577):
+        self.count_ext_msg: Dict[str, int] = {}
+        self.size_ext_msg: Dict[str, int] = {}
+        self.msg_queue_count = 0
+        # Parked messages waiting for discovery: comp -> list of msgs.
+        self._parked: Dict[str, list] = {}
+
+    @property
+    def communication(self) -> CommunicationLayer:
+        return self._comm
+
+    @property
+    def discovery(self):
+        return self._comm.discovery
+
+    def register_computation(self, name: str):
+        with self._lock:
+            self._local_computations[name] = True
+
+    def unregister_computation(self, name: str):
+        with self._lock:
+            self._local_computations.pop(name, None)
+
+    def post_msg(self, src_comp: str, dest_comp: str, msg: Message,
+                 prio: int = MSG_ALGO, on_error=None):
+        cmsg = ComputationMessage(src_comp, dest_comp, msg, prio)
+        if dest_comp in self._local_computations:
+            self.post_local(cmsg)
+            return
+        # Remote: resolve the hosting agent through discovery.
+        try:
+            dest_agent = self.discovery.computation_agent(dest_comp)
+        except KeyError:
+            with self._lock:
+                self._parked.setdefault(dest_comp, []).append(cmsg)
+            self.discovery.subscribe_computation(
+                dest_comp, self._on_computation_discovered
+            )
+            return
+        self._send_remote(dest_agent, cmsg)
+
+    def _send_remote(self, dest_agent: str, cmsg: ComputationMessage):
+        self.count_ext_msg[cmsg.src_comp] = (
+            self.count_ext_msg.get(cmsg.src_comp, 0) + 1
+        )
+        self.size_ext_msg[cmsg.src_comp] = (
+            self.size_ext_msg.get(cmsg.src_comp, 0) + cmsg.msg.size
+        )
+        self._comm.send_msg(self._agent_name, dest_agent, cmsg)
+
+    def _on_computation_discovered(self, event: str, computation: str,
+                                   agent: str):
+        if event != "computation_added":
+            return
+        with self._lock:
+            parked = self._parked.pop(computation, [])
+        for cmsg in parked:
+            if computation in self._local_computations:
+                self.post_local(cmsg)
+            else:
+                self._send_remote(agent, cmsg)
+
+    def post_local(self, cmsg: ComputationMessage):
+        if self._delay:
+            time.sleep(self._delay)
+        with self._lock:
+            self._seq += 1
+            self.msg_queue_count += 1
+            self._queue.put((cmsg.msg_type, self._seq, cmsg))
+
+    def next_msg(self, timeout: float = 0.05
+                 ) -> Optional[ComputationMessage]:
+        try:
+            _, _, cmsg = self._queue.get(timeout=timeout)
+            return cmsg
+        except queue.Empty:
+            return None
+
+    def shutdown(self):
+        self._shutdown = True
+        self._comm.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# HTTP transport (process / multi-machine modes)
+
+
+class HttpCommunicationLayer(CommunicationLayer):
+    """JSON-over-HTTP transport: one HTTP server thread per agent,
+    messages POSTed with simple_repr bodies (reference :313-492)."""
+
+    def __init__(self, address_port: Tuple[str, int]):
+        super().__init__()
+        self._host, self._port = address_port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._start_server()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    def _start_server(self):
+        layer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    data = json.loads(body.decode("utf-8"))
+                    msg = from_repr(data["msg"])
+                    cmsg = ComputationMessage(
+                        data["src_comp"], data["dest_comp"], msg,
+                        data.get("msg_type", MSG_ALGO),
+                    )
+                except Exception as e:  # malformed message
+                    self.send_response(400)
+                    self.end_headers()
+                    logger.warning("Malformed message: %s", e)
+                    return
+                layer.receive_msg(
+                    self.headers.get("sender-agent", "?"),
+                    self.headers.get("dest-agent", "?"), cmsg,
+                )
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(
+            (self._host, self._port), Handler
+        )
+        t = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"http_comm_{self._port}", daemon=True,
+        )
+        t.start()
+
+    def send_msg(self, src_agent: str, dest_agent: str,
+                 msg: ComputationMessage, on_error=None):
+        dest_address = self.discovery.agent_address(dest_agent)
+        host, port = dest_address
+        body = json.dumps({
+            "src_comp": msg.src_comp,
+            "dest_comp": msg.dest_comp,
+            "msg": simple_repr(msg.msg),
+            "msg_type": msg.msg_type,
+        }).encode("utf-8")
+        req = urlrequest.Request(
+            f"http://{host}:{port}/pydcop",
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "sender-agent": src_agent,
+                "dest-agent": dest_agent,
+            },
+        )
+        try:
+            urlrequest.urlopen(req, timeout=2.0)
+        except Exception as e:
+            logger.warning(
+                "Could not send message to %s at %s:%s : %s",
+                dest_agent, host, port, e,
+            )
+            if on_error == "fail":
+                raise UnreachableAgent(dest_agent)
+
+    def shutdown(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def __repr__(self):
+        return f"HttpCommunicationLayer(({self._host!r}, {self._port}))"

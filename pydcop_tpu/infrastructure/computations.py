@@ -1,0 +1,499 @@
+"""Computation model: messages, message-passing computations, BSP mixin.
+
+Reference parity: pydcop/infrastructure/computations.py (Message :53,
+message_type :122, ComputationMetaClass :237, MessagePassingComputation
+:261, register :576, SynchronousComputationMixin :633, DcopComputation
+:832, VariableComputation :967, ExternalVariableComputation :1093,
+build_computation :1156).
+"""
+
+import logging
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pydcop_tpu.utils.simple_repr import SimpleRepr
+
+MSG_ALGO = 20
+MSG_VALUE = 15
+MSG_MGT = 10
+
+
+class ComputationException(Exception):
+    pass
+
+
+class Message(SimpleRepr):
+    """Base class for all messages exchanged between computations."""
+
+    def __init__(self, msg_type: str, content: Any = None):
+        self._msg_type = msg_type
+        self._content = content
+
+    @property
+    def type(self) -> str:
+        return self._msg_type
+
+    @property
+    def content(self) -> Any:
+        return self._content
+
+    @property
+    def size(self) -> int:
+        """Message size, used by communication-load metrics."""
+        return 1
+
+    def __eq__(self, other):
+        return (
+            type(self) is type(other)
+            and self._msg_type == other._msg_type
+            and self._content == other._content
+        )
+
+    def __repr__(self):
+        return f"Message({self._msg_type}, {self._content})"
+
+
+def message_type(name: str, fields: List[str]):
+    """Class factory for simple message types (reference
+    computations.py:122).
+
+    >>> ValueMsg = message_type('value_msg', ['value', 'cost'])
+    >>> m = ValueMsg(value=2, cost=1.5)
+    >>> m.value, m.type
+    (2, 'value_msg')
+    """
+
+    def __init__(self, *args, **kwargs):
+        if args:
+            kwargs.update(zip(fields, args))
+        for f in fields:
+            if f not in kwargs:
+                raise ValueError(f"Missing field {f!r} for {name} message")
+            setattr(self, "_" + f, kwargs[f])
+        Message.__init__(self, name, None)
+
+    def _simple_repr(self):
+        r = {
+            "__module__": self.__class__.__module__,
+            "__qualname__": self.__class__.__qualname__,
+        }
+        from pydcop_tpu.utils.simple_repr import simple_repr
+
+        for f in fields:
+            r[f] = simple_repr(getattr(self, "_" + f))
+        return r
+
+    def _size(self):
+        return len(fields)
+
+    attrs = {
+        "__init__": __init__,
+        "_simple_repr": _simple_repr,
+        "size": property(_size),
+        "__repr__": lambda self: f"{name}({ {f: getattr(self, '_' + f) for f in fields} })",
+        "__eq__": lambda self, other: (
+            type(self) is type(other)
+            and all(
+                getattr(self, "_" + f) == getattr(other, "_" + f)
+                for f in fields
+            )
+        ),
+    }
+    for f in fields:
+        attrs[f] = property(lambda self, _f=f: getattr(self, "_" + _f))
+    cls = type(name, (Message,), attrs)
+    return cls
+
+
+def register(msg_type: str):
+    """Decorator declaring a method as the handler for a message type
+    (reference computations.py:576)."""
+
+    def decorate(handler):
+        handler._registered_handler_for = msg_type
+        return handler
+
+    return decorate
+
+
+class ComputationMetaClass(type):
+    """Collects @register-ed handlers into ``_decorated_handlers``."""
+
+    def __new__(mcs, name, bases, namespace):
+        cls = super().__new__(mcs, name, bases, namespace)
+        handlers: Dict[str, Callable] = {}
+        for base in reversed(cls.__mro__):
+            for attr in base.__dict__.values():
+                msg_type = getattr(attr, "_registered_handler_for", None)
+                if msg_type:
+                    handlers[msg_type] = attr
+        cls._decorated_handlers = handlers
+        return cls
+
+
+class MessagePassingComputation(metaclass=ComputationMetaClass):
+    """A named computation exchanging messages through its agent.
+
+    Lifecycle: created -> start() -> running; pause()/resume(); stop().
+    Messages received while paused are buffered and delivered on resume
+    (reference computations.py:354-446).  Single-threaded by design: the
+    hosting agent delivers messages sequentially, so handlers need no
+    locking (reference :279-281).
+    """
+
+    def __init__(self, name: str):
+        self._name = name
+        self._msg_sender: Optional[Callable] = None
+        self._periodic_action_handler = None
+        self._running = False
+        self._is_paused = False
+        self._paused_messages_post: List[Tuple] = []
+        self._paused_messages_recv: List[Tuple] = []
+        self.logger = logging.getLogger(f"pydcop.computation.{name}")
+        self._periodic_actions: List[Tuple[float, Callable]] = []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    @property
+    def is_paused(self) -> bool:
+        return self._is_paused
+
+    @property
+    def message_sender(self) -> Optional[Callable]:
+        return self._msg_sender
+
+    @message_sender.setter
+    def message_sender(self, sender: Callable):
+        if self._msg_sender is not None and sender is not self._msg_sender:
+            raise ComputationException(
+                f"Computation {self.name} already has a message sender"
+            )
+        self._msg_sender = sender
+
+    def start(self):
+        self._running = True
+        self.on_start()
+
+    def stop(self):
+        if self._running:
+            self._running = False
+            self.on_stop()
+
+    def pause(self, paused: bool = True):
+        if paused == self._is_paused:
+            return
+        self._is_paused = paused
+        if paused:
+            self.on_pause(True)
+        else:
+            self.on_pause(False)
+            # Flush buffered traffic in reception order.
+            for sender, msg, t in self._paused_messages_recv:
+                self._dispatch(sender, msg, t)
+            self._paused_messages_recv.clear()
+            for target, msg, prio, on_error in self._paused_messages_post:
+                self.post_msg(target, msg, prio, on_error)
+            self._paused_messages_post.clear()
+
+    # Hooks:
+    def on_start(self):
+        pass
+
+    def on_stop(self):
+        pass
+
+    def on_pause(self, paused: bool):
+        pass
+
+    def on_message(self, sender: str, msg: Message, t: float):
+        """Entry point used by the agent to deliver a message."""
+        if self._is_paused:
+            self._paused_messages_recv.append((sender, msg, t))
+            return
+        self._dispatch(sender, msg, t)
+
+    def _dispatch(self, sender: str, msg: Message, t: float):
+        handler = self._decorated_handlers.get(msg.type)
+        if handler is None:
+            raise ComputationException(
+                f"No handler for message type {msg.type!r} in "
+                f"computation {self.name}"
+            )
+        handler(self, sender, msg, t)
+
+    def post_msg(self, target: str, msg: Message, prio: int = MSG_ALGO,
+                 on_error=None):
+        if self._is_paused:
+            self._paused_messages_post.append((target, msg, prio, on_error))
+            return
+        if self._msg_sender is None:
+            raise ComputationException(
+                f"Computation {self.name} is not attached to an agent, "
+                "cannot send messages"
+            )
+        self._msg_sender(self.name, target, msg, prio, on_error)
+
+    def add_periodic_action(self, period: float, action: Callable):
+        """Register `action` to run every `period` seconds on the agent
+        thread (reference computations.py:546)."""
+        self._periodic_actions.append((period, action))
+        if self._periodic_action_handler:
+            self._periodic_action_handler(period, action)
+        return action
+
+    def remove_periodic_action(self, action):
+        self._periodic_actions = [
+            (p, a) for p, a in self._periodic_actions if a is not action
+        ]
+
+    def finished(self):
+        """Signal the end of this computation (picked up by the hosting
+        agent / orchestration)."""
+        if getattr(self, "_on_finish_cb", None):
+            self._on_finish_cb(self)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class SynchronousComputationMixin:
+    """Network-level synchronous (BSP) execution.
+
+    Messages are stamped with the sender's cycle id; a computation
+    advances to cycle N+1 once it has one message from *every* neighbor
+    for cycle N, then ``on_new_cycle(messages, cycle_id)`` fires.
+    Neighbors with nothing to say send a SynchronizationMsg filler
+    (reference computations.py:633-830: cycle stamping :731-739,
+    collection :684-725, fillers :777-785).  Receiving two messages from
+    the same neighbor for one cycle, or a message more than one cycle
+    ahead, raises ComputationException.
+    """
+
+    SYNC_MSG_TYPE = "_sync"
+
+    def __init_sync(self):
+        if not hasattr(self, "_sync_initialized"):
+            self._sync_initialized = True
+            self._current_cycle_messages: Dict[str, Tuple] = {}
+            self._next_cycle_messages: Dict[str, Tuple] = {}
+            self._cycle_id = 0
+            self._posted_this_cycle = set()
+
+    @property
+    def cycle_id(self) -> int:
+        self.__init_sync()
+        return self._cycle_id
+
+    @property
+    def current_cycle(self) -> Dict[str, Tuple]:
+        self.__init_sync()
+        return self._current_cycle_messages
+
+    def start(self):  # overrides MessagePassingComputation.start
+        self.__init_sync()
+        self._running = True
+        self.on_start()
+        # Fire the first cycle immediately so computations with no
+        # on_start sends still participate.
+        self._fire_cycle()
+
+    def on_message(self, sender: str, msg, t: float):
+        self.__init_sync()
+        if self._is_paused:
+            self._paused_messages_recv.append((sender, msg, t))
+            return
+        cycle, inner = msg.content if msg.type == "_cycle" else (None, msg)
+        if cycle is None:
+            # Non-algo message (mgt): dispatch directly.
+            self._dispatch(sender, msg, t)
+            return
+        if cycle == self._cycle_id:
+            if sender in self._current_cycle_messages:
+                raise ComputationException(
+                    f"{self.name}: duplicate message from {sender} for "
+                    f"cycle {cycle}"
+                )
+            self._current_cycle_messages[sender] = (inner, t)
+            self._maybe_switch_cycle()
+        elif cycle == self._cycle_id + 1:
+            if sender in self._next_cycle_messages:
+                raise ComputationException(
+                    f"{self.name}: duplicate message from {sender} for "
+                    f"next cycle {cycle}"
+                )
+            self._next_cycle_messages[sender] = (inner, t)
+        else:
+            raise ComputationException(
+                f"{self.name}: message from {sender} for cycle {cycle} "
+                f"while in cycle {self._cycle_id} (skew > 1)"
+            )
+
+    def post_msg(self, target: str, msg, prio: int = MSG_ALGO,
+                 on_error=None):
+        """Algo messages are wrapped with the current cycle id."""
+        self.__init_sync()
+        self._posted_this_cycle.add(target)
+        wrapped = Message("_cycle", (self._cycle_id, msg))
+        MessagePassingComputation.post_msg(
+            self, target, wrapped, prio, on_error
+        )
+
+    def _fire_cycle(self):
+        """Send sync fillers to neighbors we did not message this cycle."""
+        self.__init_sync()
+        for n in self.neighbors:
+            if n not in self._posted_this_cycle:
+                filler = Message("_cycle", (self._cycle_id, None))
+                MessagePassingComputation.post_msg(
+                    self, n, filler, MSG_ALGO, None
+                )
+
+    def _maybe_switch_cycle(self):
+        neighbors = set(self.neighbors)
+        if not neighbors or not self._running:
+            return  # neighborless computations never cycle
+        if set(self._current_cycle_messages) < neighbors:
+            return
+        messages = {
+            s: (m, t)
+            for s, (m, t) in self._current_cycle_messages.items()
+            if m is not None
+        }
+        self._cycle_id += 1
+        self._current_cycle_messages = self._next_cycle_messages
+        self._next_cycle_messages = {}
+        self._posted_this_cycle = set()
+        if hasattr(self, "new_cycle"):
+            self.new_cycle()
+        out = self.on_new_cycle(messages, self._cycle_id - 1)
+        if out:
+            for target, msg in out:
+                self.post_msg(target, msg)
+        if self._running:
+            self._fire_cycle()
+        self._maybe_switch_cycle()
+
+    def on_new_cycle(self, messages: Dict[str, Tuple], cycle_id: int
+                     ) -> Optional[List]:
+        """Override point: called once per cycle with that cycle's
+        messages {sender: (msg, t)}."""
+        return None
+
+
+class DcopComputation(MessagePassingComputation):
+    """A computation attached to a node of a computation graph."""
+
+    def __init__(self, name: str, comp_def):
+        super().__init__(name)
+        self.computation_def = comp_def
+        self._cycle_count = 0
+
+    @property
+    def neighbors(self) -> List[str]:
+        return list(self.computation_def.node.neighbors)
+
+    @property
+    def cycle_count(self) -> int:
+        return self._cycle_count
+
+    @property
+    def mode(self) -> str:
+        return self.computation_def.algo.mode
+
+    def new_cycle(self):
+        self._cycle_count += 1
+        if getattr(self, "_on_cycle_cb", None):
+            self._on_cycle_cb(self)
+
+    def footprint(self) -> float:
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        module = load_algorithm_module(self.computation_def.algo.algo)
+        return module.computation_memory(self.computation_def.node)
+
+    def post_to_all_neighbors(self, msg: Message, prio: int = MSG_ALGO):
+        for n in self.neighbors:
+            self.post_msg(n, msg, prio)
+
+
+class VariableComputation(DcopComputation):
+    """A computation responsible for selecting one variable's value."""
+
+    def __init__(self, variable, comp_def):
+        super().__init__(variable.name, comp_def)
+        self._variable = variable
+        self._current_value = None
+        self._current_cost = None
+        self._previous_val = None
+
+    @property
+    def variable(self):
+        return self._variable
+
+    @property
+    def current_value(self):
+        return self._current_value
+
+    @property
+    def current_cost(self):
+        return self._current_cost
+
+    def value_selection(self, val, cost: float = 0.0):
+        """Select a value; fires the value-change callback used by the
+        orchestration layer for metrics (reference computations.py:1058)."""
+        self._previous_val = self._current_value
+        self._current_value = val
+        self._current_cost = cost
+        if getattr(self, "_on_value_cb", None):
+            self._on_value_cb(self)
+
+    def random_value_selection(self):
+        self.value_selection(random.choice(list(self._variable.domain)))
+
+
+class ExternalVariableComputation(DcopComputation):
+    """Read-only computation publishing an external variable's value."""
+
+    def __init__(self, external_var, comp_def=None):
+        # External variables have no algorithm; build a minimal def.
+        super().__init__(external_var.name, comp_def)
+        self._external_var = external_var
+        self._subscribers = set()
+        external_var.subscribe(self._on_change)
+
+    @property
+    def neighbors(self):
+        return list(self._subscribers)
+
+    @register("subscribe")
+    def _on_subscribe_msg(self, sender, msg, t):
+        self._subscribers.add(sender)
+        self.post_msg(
+            sender, Message("external_value", self._external_var.value)
+        )
+
+    def _on_change(self, value):
+        for s in self._subscribers:
+            self.post_msg(s, Message("external_value", value))
+
+
+def build_computation(comp_def) -> MessagePassingComputation:
+    """Instantiate the right computation for a ComputationDef (reference
+    computations.py:1156): delegates to the algorithm module."""
+    from pydcop_tpu.algorithms import load_algorithm_module
+
+    module = load_algorithm_module(comp_def.algo.algo)
+    return module.build_computation(comp_def)
+
+
+def build_algo_computation(algo_name: str, comp_def):
+    """Agent-mode computation factory used by algorithm modules."""
+    from pydcop_tpu.infrastructure import agent_algorithms
+
+    return agent_algorithms.build(algo_name, comp_def)

@@ -1,0 +1,350 @@
+"""Discovery: name service mapping agents to addresses and computations
+to agents.
+
+Reference parity: pydcop/infrastructure/discovery.py (Directory :294 —
+central registry on the orchestrator agent, DirectoryComputation :121;
+per-agent Discovery :654 cache with callbacks: register_agent :770,
+register_computation :1083, subscribe_computation :1212,
+computation_agent :1034, agent_address :746; replica registry
+:1304/:1397).
+
+Everything is message-based (works identically over the in-process and
+HTTP transports): agents register/subscribe through their
+DiscoveryComputation, the directory publishes changes to subscribers.
+"""
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from pydcop_tpu.infrastructure.communication import MSG_DISCOVERY
+from pydcop_tpu.infrastructure.computations import (
+    MessagePassingComputation,
+    Message,
+    message_type,
+    register,
+)
+
+logger = logging.getLogger("pydcop.discovery")
+
+DIRECTORY_COMP = "_directory"
+
+
+class UnknownAgent(Exception):
+    pass
+
+
+class UnknownComputation(Exception):
+    pass
+
+
+class DiscoveryException(Exception):
+    pass
+
+
+RegisterAgentMessage = message_type(
+    "register_agent", ["agent", "address"])
+UnregisterAgentMessage = message_type(
+    "unregister_agent", ["agent"])
+RegisterComputationMessage = message_type(
+    "register_computation", ["computation", "agent", "address"])
+UnregisterComputationMessage = message_type(
+    "unregister_computation", ["computation", "agent"])
+SubscribeMessage = message_type(
+    "subscribe", ["kind", "name", "subscribe"])
+PublishMessage = message_type(
+    "publish", ["event", "name", "value"])
+RegisterReplicaMessage = message_type(
+    "register_replica", ["replica", "agent", "add"])
+
+
+class DirectoryComputation(MessagePassingComputation):
+    """The central registry, hosted on the directory (orchestrator)
+    agent.  When given the hosting agent's Discovery, every change is
+    mirrored into it (same-process shortcut: the directory agent sees
+    everything without subscribing to itself)."""
+
+    def __init__(self, name: str = DIRECTORY_COMP,
+                 local_discovery: Optional["Discovery"] = None):
+        super().__init__(name)
+        self.local_discovery = local_discovery
+        self.agents: Dict[str, Any] = {}
+        self.computations: Dict[str, str] = {}
+        self.replicas: Dict[str, Set[str]] = {}
+        # subscriptions: kind -> name -> set of subscriber computations
+        self._subs: Dict[str, Dict[str, Set[str]]] = {
+            "agent": {}, "computation": {}, "replica": {},
+        }
+
+    def _publish(self, kind: str, event: str, name: str, value):
+        if self.local_discovery is not None:
+            self.local_discovery._on_publish(event, name, value)
+        for sub in self._subs[kind].get(name, set()) | \
+                self._subs[kind].get("*", set()):
+            self.post_msg(
+                sub, PublishMessage(event, name, value), MSG_DISCOVERY
+            )
+
+    @register("register_agent")
+    def _on_register_agent(self, sender, msg, t):
+        self.agents[msg.agent] = msg.address
+        self._publish("agent", "agent_added", msg.agent, msg.address)
+
+    @register("unregister_agent")
+    def _on_unregister_agent(self, sender, msg, t):
+        self.agents.pop(msg.agent, None)
+        self._publish("agent", "agent_removed", msg.agent, None)
+
+    @register("register_computation")
+    def _on_register_computation(self, sender, msg, t):
+        self.computations[msg.computation] = msg.agent
+        if msg.address is not None:
+            self.agents[msg.agent] = msg.address
+        self._publish(
+            "computation", "computation_added", msg.computation,
+            (msg.agent, self.agents.get(msg.agent)),
+        )
+
+    @register("unregister_computation")
+    def _on_unregister_computation(self, sender, msg, t):
+        self.computations.pop(msg.computation, None)
+        self._publish(
+            "computation", "computation_removed", msg.computation, None
+        )
+
+    @register("register_replica")
+    def _on_register_replica(self, sender, msg, t):
+        group = self.replicas.setdefault(msg.replica, set())
+        if msg.add:
+            group.add(msg.agent)
+        else:
+            group.discard(msg.agent)
+        self._publish(
+            "replica", "replica_changed", msg.replica, sorted(group)
+        )
+
+    @register("subscribe")
+    def _on_subscribe(self, sender, msg, t):
+        subs = self._subs[msg.kind].setdefault(msg.name, set())
+        if msg.subscribe:
+            subs.add(sender)
+            # Answer with current state so the subscriber syncs up.
+            if msg.kind == "agent":
+                if msg.name in self.agents:
+                    self.post_msg(sender, PublishMessage(
+                        "agent_added", msg.name, self.agents[msg.name]
+                    ), MSG_DISCOVERY)
+            elif msg.kind == "computation":
+                if msg.name in self.computations:
+                    agt = self.computations[msg.name]
+                    self.post_msg(sender, PublishMessage(
+                        "computation_added", msg.name,
+                        (agt, self.agents.get(agt)),
+                    ), MSG_DISCOVERY)
+            elif msg.kind == "replica":
+                if msg.name in self.replicas:
+                    self.post_msg(sender, PublishMessage(
+                        "replica_changed", msg.name,
+                        sorted(self.replicas[msg.name]),
+                    ), MSG_DISCOVERY)
+        else:
+            subs.discard(sender)
+
+
+class Directory:
+    """Convenience wrapper owning the DirectoryComputation (reference
+    discovery.py:294)."""
+
+    def __init__(self, discovery: "Discovery"):
+        self.discovery = discovery
+        self.directory_computation = DirectoryComputation(
+            local_discovery=discovery
+        )
+
+    @property
+    def address(self):
+        return self.discovery.agent_address(self.discovery.agent_name)
+
+
+class DiscoveryComputation(MessagePassingComputation):
+    """Per-agent client computation receiving directory publications."""
+
+    def __init__(self, discovery: "Discovery", agent_name: str):
+        super().__init__(f"_discovery_{agent_name}")
+        self._discovery = discovery
+
+    @register("publish")
+    def _on_publish(self, sender, msg, t):
+        self._discovery._on_publish(msg.event, msg.name, msg.value)
+
+
+class Discovery:
+    """Per-agent discovery cache + client API.
+
+    The cache is pre-seeded with the directory agent's address at agent
+    construction (bootstrap) and kept in sync through publications.
+    """
+
+    def __init__(self, agent_name: str, address):
+        self.agent_name = agent_name
+        self.discovery_computation = DiscoveryComputation(self, agent_name)
+        self._agents: Dict[str, Any] = {agent_name: address}
+        self._computations: Dict[str, str] = {}
+        self._replicas: Dict[str, List[str]] = {}
+        self._lock = threading.RLock()
+        # callbacks: name -> list of cb(event, name, value)
+        self._agent_cbs: Dict[str, List[Callable]] = {}
+        self._computation_cbs: Dict[str, List[Callable]] = {}
+        self._replica_cbs: Dict[str, List[Callable]] = {}
+        self.directory_agent: Optional[str] = None
+
+    # -- wiring -------------------------------------------------------- #
+
+    def use_directory(self, agent_name: str, address):
+        """Point this discovery at the directory agent (reference
+        :707).  Seeds the cache so directory-bound messages resolve."""
+        self.directory_agent = agent_name
+        with self._lock:
+            self._agents[agent_name] = address
+            self._computations[DIRECTORY_COMP] = agent_name
+
+    def _send_to_directory(self, msg: Message):
+        if self.directory_agent is None:
+            return  # standalone mode: local cache only
+        self.discovery_computation.post_msg(
+            DIRECTORY_COMP, msg, MSG_DISCOVERY
+        )
+
+    # -- registration -------------------------------------------------- #
+
+    def register_agent(self, agent_name: str, address,
+                       publish: bool = True):
+        with self._lock:
+            self._agents[agent_name] = address
+        if publish:
+            self._send_to_directory(
+                RegisterAgentMessage(agent_name, address))
+
+    def unregister_agent(self, agent_name: str, publish: bool = True):
+        with self._lock:
+            self._agents.pop(agent_name, None)
+        if publish:
+            self._send_to_directory(UnregisterAgentMessage(agent_name))
+
+    def register_computation(self, computation: str,
+                             agent_name: Optional[str] = None,
+                             address=None, publish: bool = True):
+        agent_name = agent_name or self.agent_name
+        with self._lock:
+            self._computations[computation] = agent_name
+            if address is not None:
+                self._agents[agent_name] = address
+        if publish:
+            self._send_to_directory(RegisterComputationMessage(
+                computation, agent_name,
+                address if address is not None
+                else self._agents.get(agent_name),
+            ))
+
+    def unregister_computation(self, computation: str,
+                               agent_name: Optional[str] = None,
+                               publish: bool = True):
+        with self._lock:
+            self._computations.pop(computation, None)
+        if publish:
+            self._send_to_directory(UnregisterComputationMessage(
+                computation, agent_name or self.agent_name))
+
+    def register_replica(self, replica: str, agent_name: str):
+        self._send_to_directory(
+            RegisterReplicaMessage(replica, agent_name, True))
+
+    def unregister_replica(self, replica: str, agent_name: str):
+        self._send_to_directory(
+            RegisterReplicaMessage(replica, agent_name, False))
+
+    # -- lookups ------------------------------------------------------- #
+
+    def agents(self) -> List[str]:
+        with self._lock:
+            return list(self._agents)
+
+    def computations(self) -> List[str]:
+        with self._lock:
+            return list(self._computations)
+
+    def agent_address(self, agent_name: str):
+        with self._lock:
+            try:
+                return self._agents[agent_name]
+            except KeyError:
+                raise UnknownAgent(agent_name)
+
+    def computation_agent(self, computation: str) -> str:
+        with self._lock:
+            try:
+                return self._computations[computation]
+            except KeyError:
+                raise KeyError(computation)
+
+    def replica_agents(self, replica: str) -> List[str]:
+        with self._lock:
+            return list(self._replicas.get(replica, []))
+
+    # -- subscriptions ------------------------------------------------- #
+
+    def subscribe_agent(self, agent_name: str,
+                        cb: Optional[Callable] = None):
+        if cb:
+            self._agent_cbs.setdefault(agent_name, []).append(cb)
+        self._send_to_directory(SubscribeMessage("agent", agent_name, True))
+
+    def subscribe_computation(self, computation: str,
+                              cb: Optional[Callable] = None):
+        if cb:
+            self._computation_cbs.setdefault(computation, []).append(cb)
+        self._send_to_directory(
+            SubscribeMessage("computation", computation, True))
+
+    def subscribe_replica(self, replica: str,
+                          cb: Optional[Callable] = None):
+        if cb:
+            self._replica_cbs.setdefault(replica, []).append(cb)
+        self._send_to_directory(SubscribeMessage("replica", replica, True))
+
+    def unsubscribe_computation(self, computation: str):
+        self._computation_cbs.pop(computation, None)
+        self._send_to_directory(
+            SubscribeMessage("computation", computation, False))
+
+    # -- publication handling ------------------------------------------ #
+
+    def _on_publish(self, event: str, name: str, value):
+        cbs: List[Callable] = []
+        with self._lock:
+            if event == "agent_added":
+                self._agents[name] = value
+                cbs = list(self._agent_cbs.get(name, []))
+            elif event == "agent_removed":
+                self._agents.pop(name, None)
+                cbs = list(self._agent_cbs.get(name, []))
+            elif event == "computation_added":
+                agent, address = value
+                self._computations[name] = agent
+                if address is not None:
+                    self._agents[agent] = address
+                value = agent
+                cbs = list(self._computation_cbs.get(name, []))
+            elif event == "computation_removed":
+                self._computations.pop(name, None)
+                cbs = list(self._computation_cbs.get(name, []))
+            elif event == "replica_changed":
+                self._replicas[name] = list(value)
+                cbs = list(self._replica_cbs.get(name, []))
+        for cb in cbs:
+            try:
+                cb(event, name, value)
+            except Exception:
+                logger.exception(
+                    "Discovery callback error for %s %s", event, name
+                )

@@ -1,0 +1,36 @@
+"""Scenario event processing for dynamic DCOPs.
+
+Reference parity: pydcop/infrastructure/orchestrator.py:340 (_process_event
+scheduling) and :955-1010 (_orchestrator_scenario_event: pause, apply
+agent removals, trigger repair, resume).
+
+Current support: delay events and remove_agent actions (the removed
+agent's computations are reported; repair-based migration arrives with
+the replication layer).  Unknown action types are logged and skipped.
+"""
+
+import logging
+import time
+
+logger = logging.getLogger("pydcop.scenario")
+
+
+def run_scenario_events(orchestrator, scenario):
+    """Execute scenario events against a running orchestrator."""
+    for event in scenario.events:
+        if event.is_delay:
+            time.sleep(event.delay)
+            continue
+        logger.info("Scenario event %s", event.id)
+        orchestrator.pause_agents()
+        for action in event.actions or []:
+            if action.type == "remove_agent":
+                agent = action.args.get("agent")
+                logger.info("Scenario: removing agent %s", agent)
+                orchestrator.remove_agent(agent)
+            else:
+                logger.warning(
+                    "Unsupported scenario action %s (skipped)",
+                    action.type,
+                )
+        orchestrator.resume_agents()

@@ -1,0 +1,168 @@
+"""Remote-controlled agents: deploy/run/stop driven by the orchestrator.
+
+Reference parity: pydcop/infrastructure/orchestratedagents.py
+(OrchestratedAgent :71, OrchestrationComputation :178) — the agent-side
+management computation handling deploy/run/pause/resume/stop messages
+and reporting value changes, cycle changes and computation completion to
+the orchestrator.
+"""
+
+import logging
+from typing import Optional
+
+from pydcop_tpu.dcop.objects import AgentDef
+from pydcop_tpu.infrastructure.agents import Agent
+from pydcop_tpu.infrastructure.communication import (
+    CommunicationLayer,
+    MSG_MGT,
+)
+from pydcop_tpu.infrastructure.computations import (
+    MessagePassingComputation,
+    build_computation,
+    message_type,
+    register,
+)
+
+ORCHESTRATOR_AGENT = "orchestrator"
+ORCHESTRATOR_MGT = "_mgt_orchestrator"
+
+DeployMessage = message_type("deploy", ["comp_def"])
+RunAgentMessage = message_type("run_computations", ["computations"])
+PauseMessage = message_type("pause_computations", ["computations"])
+ResumeMessage = message_type("resume_computations", ["computations"])
+StopAgentMessage = message_type("stop_agent", [])
+AgentStoppedMessage = message_type("agent_stopped", ["agent", "metrics"])
+ValueChangeMessage = message_type(
+    "value_change", ["agent", "computation", "value", "cost", "cycle"])
+CycleChangeMessage = message_type(
+    "cycle_change", ["agent", "computation", "cycle"])
+ComputationFinishedMessage = message_type(
+    "computation_finished", ["agent", "computation"])
+AgentReadyMessage = message_type("agent_ready", ["agent", "address"])
+
+logger = logging.getLogger("pydcop.orchestratedagent")
+
+
+class OrchestrationComputation(MessagePassingComputation):
+    """Agent-side management computation (name: ``_mgt_<agent>``)."""
+
+    def __init__(self, agent: Agent):
+        super().__init__(f"_mgt_{agent.name}")
+        self.agent = agent
+        agent.on_value_change = self._on_value_change
+        agent.on_cycle_change = self._on_cycle_change
+        agent.on_computation_finished = self._on_comp_finished
+
+    def on_start(self):
+        # Announce ourselves to the orchestrator.
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            AgentReadyMessage(self.agent.name, None),
+            MSG_MGT,
+        )
+
+    @register("deploy")
+    def _on_deploy(self, sender, msg, t):
+        comp_def = msg.comp_def
+        computation = build_computation(comp_def)
+        self.agent.add_computation(computation)
+        logger.debug(
+            "Deployed computation %s on agent %s",
+            comp_def.name, self.agent.name,
+        )
+
+    @register("run_computations")
+    def _on_run(self, sender, msg, t):
+        computations = msg.computations
+        self.agent.run(computations if computations else None)
+
+    @register("pause_computations")
+    def _on_pause(self, sender, msg, t):
+        for name in msg.computations or [
+            c.name for c in self.agent.computations
+            if not c.name.startswith("_")
+        ]:
+            if self.agent.has_computation(name):
+                self.agent.computation(name).pause(True)
+
+    @register("resume_computations")
+    def _on_resume(self, sender, msg, t):
+        for name in msg.computations or [
+            c.name for c in self.agent.computations
+            if not c.name.startswith("_")
+        ]:
+            if self.agent.has_computation(name):
+                self.agent.computation(name).pause(False)
+
+    @register("stop_agent")
+    def _on_stop(self, sender, msg, t):
+        metrics = self.agent.metrics()
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            AgentStoppedMessage(self.agent.name, metrics),
+            MSG_MGT,
+        )
+        self.agent.stop()
+
+    # -- reporting ----------------------------------------------------- #
+
+    def _on_value_change(self, comp):
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            ValueChangeMessage(
+                self.agent.name, comp.name, comp.current_value,
+                comp.current_cost, getattr(comp, "cycle_count", 0),
+            ),
+            MSG_MGT,
+        )
+
+    def _on_cycle_change(self, comp):
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            CycleChangeMessage(
+                self.agent.name, comp.name,
+                getattr(comp, "cycle_count", 0),
+            ),
+            MSG_MGT,
+        )
+
+    def _on_comp_finished(self, comp):
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            ComputationFinishedMessage(self.agent.name, comp.name),
+            MSG_MGT,
+        )
+
+
+class OrchestratedAgent(Agent):
+    """An agent bootstrapped against an orchestrator's directory."""
+
+    def __init__(self, agent_def: AgentDef, comm: CommunicationLayer,
+                 orchestrator_address,
+                 delay: Optional[float] = None):
+        super().__init__(agent_def.name, comm, agent_def, delay=delay)
+        self.discovery.use_directory(
+            ORCHESTRATOR_AGENT, orchestrator_address
+        )
+        # Seed the orchestrator's management computation address.
+        self.discovery.register_computation(
+            ORCHESTRATOR_MGT, ORCHESTRATOR_AGENT,
+            orchestrator_address, publish=False,
+        )
+        self._orchestration = OrchestrationComputation(self)
+        self.add_computation(self._orchestration)
+        self.discovery.register_agent(self.name, comm.address)
+        # Register the service computations globally so the orchestrator
+        # (mgt) and the directory (publications to _discovery_<agent>)
+        # can reach us.
+        self.discovery.register_computation(
+            self._orchestration.name, self.name, comm.address
+        )
+        self.discovery.register_computation(
+            self.discovery.discovery_computation.name, self.name,
+            comm.address,
+        )
+
+    def start(self):
+        super().start()
+        self._orchestration.start()

@@ -1,0 +1,286 @@
+"""The orchestrator: central bootstrap, monitoring and control.
+
+Reference parity: pydcop/infrastructure/orchestrator.py (Orchestrator
+:62 — own agent + directory :128, deploy_computations :203, run :245,
+stop_agents :291, wait_ready :318; AgentsMgt :535 — metrics aggregation
+:802-900, global_metrics :1215).
+"""
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from pydcop_tpu.algorithms import AlgorithmDef, ComputationDef
+from pydcop_tpu.computations_graph.objects import ComputationGraph
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.infrastructure.agents import Agent
+from pydcop_tpu.infrastructure.communication import (
+    CommunicationLayer,
+    MSG_MGT,
+)
+from pydcop_tpu.infrastructure.computations import (
+    MessagePassingComputation,
+    register,
+)
+from pydcop_tpu.infrastructure.discovery import Directory
+from pydcop_tpu.infrastructure.orchestratedagents import (
+    AgentReadyMessage,
+    AgentStoppedMessage,
+    ComputationFinishedMessage,
+    CycleChangeMessage,
+    DeployMessage,
+    ORCHESTRATOR_AGENT,
+    ORCHESTRATOR_MGT,
+    PauseMessage,
+    ResumeMessage,
+    RunAgentMessage,
+    StopAgentMessage,
+    ValueChangeMessage,
+)
+
+logger = logging.getLogger("pydcop.orchestrator")
+
+
+class AgentsMgt(MessagePassingComputation):
+    """Orchestrator-side management computation: aggregates value/cycle
+    reports into a global view, tracks completion."""
+
+    def __init__(self, orchestrator: "Orchestrator"):
+        super().__init__(ORCHESTRATOR_MGT)
+        self.orchestrator = orchestrator
+        self.assignment: Dict[str, Any] = {}
+        self.cycles: Dict[str, int] = {}
+        self.agent_metrics: Dict[str, Dict] = {}
+        self.finished_computations: set = set()
+        self.ready_agents: set = set()
+        self.start_time: Optional[float] = None
+        self.last_stop_time: Optional[float] = None
+
+    @register("agent_ready")
+    def _on_agent_ready(self, sender, msg, t):
+        self.ready_agents.add(msg.agent)
+        self.orchestrator._ready_evt.set()
+
+    @register("value_change")
+    def _on_value_change(self, sender, msg, t):
+        self.assignment[msg.computation] = msg.value
+        self.cycles[msg.computation] = max(
+            self.cycles.get(msg.computation, 0), msg.cycle
+        )
+        self.orchestrator._on_progress()
+
+    @register("cycle_change")
+    def _on_cycle_change(self, sender, msg, t):
+        self.cycles[msg.computation] = max(
+            self.cycles.get(msg.computation, 0), msg.cycle
+        )
+
+    @register("computation_finished")
+    def _on_comp_finished(self, sender, msg, t):
+        self.finished_computations.add(msg.computation)
+        self.orchestrator._check_all_finished()
+
+    @register("agent_stopped")
+    def _on_agent_stopped(self, sender, msg, t):
+        self.agent_metrics[msg.agent] = msg.metrics
+        self.last_stop_time = time.monotonic()
+        self.orchestrator._on_agent_stopped(msg.agent)
+
+    def global_metrics(self, status: str) -> Dict:
+        """Reference-shaped result dict (orchestrator.py:1215-1274)."""
+        dcop = self.orchestrator.dcop
+        dcop_assignment = {
+            k: v for k, v in self.assignment.items()
+            if k in dcop.variables
+        }
+        try:
+            cost, violation = dcop.solution_cost(
+                dcop_assignment, self.orchestrator.infinity
+            )
+        except ValueError:
+            cost, violation = None, None
+        msg_count, msg_size = 0, 0
+        for metrics in self.agent_metrics.values():
+            msg_count += sum(metrics.get("count_ext_msg", {}).values())
+            msg_size += sum(metrics.get("size_ext_msg", {}).values())
+        total_time = (
+            time.monotonic() - self.start_time
+            if self.start_time else 0
+        )
+        return {
+            "status": status,
+            "assignment": self.assignment,
+            "cost": cost,
+            "violation": violation,
+            "time": total_time,
+            "msg_count": msg_count,
+            "msg_size": msg_size,
+            "cycle": max(self.cycles.values(), default=0),
+            "agt_metrics": self.agent_metrics,
+        }
+
+
+class Orchestrator:
+    """Bootstraps a distributed run: deploys computations onto agents,
+    starts them, monitors progress and stops everything."""
+
+    def __init__(self, algo: AlgorithmDef,
+                 cg: ComputationGraph,
+                 agent_mapping: Distribution,
+                 comm: CommunicationLayer,
+                 dcop: DCOP,
+                 infinity: float = float("inf"),
+                 collector=None,
+                 collect_moment: str = "value_change"):
+        self.algo = algo
+        self.cg = cg
+        self.distribution = agent_mapping
+        self.dcop = dcop
+        self.infinity = infinity
+        self.status = "INIT"
+
+        self._agent = Agent(ORCHESTRATOR_AGENT, comm)
+        self.directory = Directory(self._agent.discovery)
+        self._agent.add_computation(self.directory.directory_computation)
+        self._agent.discovery.use_directory(
+            ORCHESTRATOR_AGENT, comm.address
+        )
+        self.mgt = AgentsMgt(self)
+        self._agent.add_computation(self.mgt)
+
+        self._ready_evt = threading.Event()
+        self._finished_evt = threading.Event()
+        self._stopped_agents: set = set()
+        self._all_stopped_evt = threading.Event()
+        self._expected_computations = [
+            n.name for n in cg.nodes
+        ]
+
+    @property
+    def address(self):
+        return self._agent.address
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self):
+        self._agent.start()
+        self.directory.directory_computation.start()
+        self.mgt.start()
+
+    def stop(self):
+        self._agent.clean_shutdown()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every agent of the distribution has reported in."""
+        expected = {
+            a for a in self.distribution.agents
+            if self.distribution.computations_hosted(a)
+        }
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        while not expected <= self.mgt.ready_agents:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+            self._ready_evt.clear()
+            self._ready_evt.wait(
+                min(0.1, remaining) if remaining else 0.1
+            )
+        return True
+
+    def deploy_computations(self):
+        """Send each computation's definition to its hosting agent
+        (reference :203 → DeployMessage per computation :1197-1209)."""
+        for comp_name in self._expected_computations:
+            agent = self.distribution.agent_for(comp_name)
+            node = self.cg.computation(comp_name)
+            comp_def = ComputationDef(node, self.algo)
+            self.mgt.post_msg(
+                f"_mgt_{agent}", DeployMessage(comp_def), MSG_MGT
+            )
+
+    def run(self, scenario=None, timeout: Optional[float] = None):
+        """Start all computations; block until finished or timeout."""
+        self.status = "RUNNING"
+        self.mgt.start_time = time.monotonic()
+        for agent in self.distribution.agents:
+            if self.distribution.computations_hosted(agent):
+                self.mgt.post_msg(
+                    f"_mgt_{agent}", RunAgentMessage([]), MSG_MGT
+                )
+        if scenario is not None:
+            self._run_scenario(scenario)
+        finished = self._finished_evt.wait(timeout)
+        if finished:
+            self.status = "FINISHED"
+        else:
+            self.status = "TIMEOUT"
+
+    def _run_scenario(self, scenario):
+        from pydcop_tpu.infrastructure.events_handler import (
+            run_scenario_events,
+        )
+
+        threading.Thread(
+            target=run_scenario_events, args=(self, scenario),
+            daemon=True, name="scenario",
+        ).start()
+
+    def remove_agent(self, agent: str):
+        """Scenario-driven agent removal: stop the agent; its orphaned
+        computations are tracked (repair-based migration arrives with
+        the replication layer)."""
+        orphaned = self.distribution.computations_hosted(agent)
+        logger.warning(
+            "Agent %s removed; orphaned computations: %s", agent, orphaned
+        )
+        self.mgt.post_msg(f"_mgt_{agent}", StopAgentMessage(), MSG_MGT)
+
+    def pause_agents(self):
+        for agent in self.distribution.agents:
+            self.mgt.post_msg(f"_mgt_{agent}", PauseMessage([]), MSG_MGT)
+
+    def resume_agents(self):
+        for agent in self.distribution.agents:
+            self.mgt.post_msg(f"_mgt_{agent}", ResumeMessage([]), MSG_MGT)
+
+    def stop_agents(self, timeout: float = 5):
+        for agent in self.distribution.agents:
+            if self.distribution.computations_hosted(agent):
+                self.mgt.post_msg(
+                    f"_mgt_{agent}", StopAgentMessage(), MSG_MGT
+                )
+        self._all_stopped_evt.wait(timeout)
+
+    # -- callbacks from mgt -------------------------------------------- #
+
+    def _on_progress(self):
+        pass
+
+    def _check_all_finished(self):
+        if set(self._expected_computations) <= \
+                self.mgt.finished_computations:
+            self._finished_evt.set()
+
+    def _on_agent_stopped(self, agent: str):
+        self._stopped_agents.add(agent)
+        expected = {
+            a for a in self.distribution.agents
+            if self.distribution.computations_hosted(a)
+        }
+        if expected <= self._stopped_agents:
+            self._all_stopped_evt.set()
+
+    # -- results ------------------------------------------------------- #
+
+    def current_global_cost(self):
+        metrics = self.mgt.global_metrics(self.status)
+        return metrics["cost"], metrics["violation"]
+
+    def end_metrics(self) -> Dict:
+        return self.mgt.global_metrics(self.status)

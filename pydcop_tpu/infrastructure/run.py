@@ -1,0 +1,146 @@
+"""Entry-point helpers for agent-mode runs.
+
+Reference parity: pydcop/infrastructure/run.py (solve :52,
+run_local_thread_dcop :145, run_local_process_dcop :225).
+"""
+
+import importlib
+import logging
+from typing import Dict, Optional
+
+from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
+from pydcop_tpu.computations_graph import load_graph_module
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.infrastructure.communication import (
+    InProcessCommunicationLayer,
+)
+from pydcop_tpu.infrastructure.orchestratedagents import OrchestratedAgent
+from pydcop_tpu.infrastructure.orchestrator import Orchestrator
+
+logger = logging.getLogger("pydcop.run")
+
+
+def _build_distribution(dcop: DCOP, cg, algo_module,
+                        distribution: str) -> Distribution:
+    if distribution.endswith((".yaml", ".yml")):
+        from pydcop_tpu.dcop.yamldcop import load_dist_from_file
+
+        return load_dist_from_file(distribution)
+    dist_module = importlib.import_module(
+        f"pydcop_tpu.distribution.{distribution}"
+    )
+    return dist_module.distribute(
+        cg, dcop.agents.values(), hints=dcop.dist_hints,
+        computation_memory=getattr(
+            algo_module, "computation_memory", None),
+        communication_load=getattr(
+            algo_module, "communication_load", None),
+    )
+
+
+def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
+                          infinity=float("inf"), delay=None,
+                          ) -> Orchestrator:
+    """One OrchestratedAgent thread per AgentDef + an orchestrator, all
+    with in-process transports (reference run.py:145)."""
+    comm = InProcessCommunicationLayer()
+    orchestrator = Orchestrator(
+        algo, cg, distribution, comm, dcop, infinity
+    )
+    orchestrator.start()
+    hosting = {
+        a for a in distribution.agents
+        if distribution.computations_hosted(a)
+    }
+    for agent_def in dcop.agents.values():
+        if agent_def.name not in hosting:
+            continue
+        agent_comm = InProcessCommunicationLayer()
+        agent = OrchestratedAgent(
+            agent_def, agent_comm, orchestrator.address, delay=delay
+        )
+        agent.start()
+    return orchestrator
+
+
+def solve(dcop: DCOP, algo_def, distribution="oneagent",
+          timeout: Optional[float] = 5, delay=None) -> Dict:
+    """One-call solve with the threaded runtime; returns the assignment
+    (reference run.py:52)."""
+    if isinstance(algo_def, str):
+        algo_def = AlgorithmDef.build_with_default_param(
+            algo_def, mode=dcop.objective
+        )
+    algo_module = load_algorithm_module(algo_def.algo)
+    cg = load_graph_module(
+        algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+    if isinstance(distribution, str):
+        distribution = _build_distribution(
+            dcop, cg, algo_module, distribution)
+    orchestrator = run_local_thread_dcop(
+        algo_def, cg, distribution, dcop, delay=delay
+    )
+    try:
+        orchestrator.deploy_computations()
+        orchestrator.run(timeout=timeout)
+        assignment = orchestrator.end_metrics()["assignment"]
+        return assignment
+    finally:
+        orchestrator.stop_agents(5)
+        orchestrator.stop()
+
+
+def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
+                      timeout: Optional[float] = 5,
+                      max_cycles: int = 0) -> Dict:
+    """Full-metrics variant used by the api/CLI thread backend."""
+    if isinstance(algo_def, str):
+        algo_def = AlgorithmDef.build_with_default_param(
+            algo_def, mode=dcop.objective
+        )
+    algo_module = load_algorithm_module(algo_def.algo)
+    # Map max_cycles onto the algorithm's stop_cycle parameter when it
+    # has one and none was given, so the -c CLI bound takes effect.
+    if max_cycles:
+        param_names = {p.name for p in algo_module.algo_params}
+        if ("stop_cycle" in param_names
+                and not algo_def.params.get("stop_cycle")):
+            params = algo_def.params
+            params["stop_cycle"] = max_cycles
+            algo_def = AlgorithmDef(algo_def.algo, params, algo_def.mode)
+    cg = load_graph_module(
+        algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+    if isinstance(distribution, str):
+        distribution = _build_distribution(
+            dcop, cg, algo_module, distribution)
+    orchestrator = run_local_thread_dcop(algo_def, cg, distribution, dcop)
+    stopped = False
+    try:
+        if not orchestrator.wait_ready(10):
+            raise RuntimeError("Agents did not become ready in time")
+        orchestrator.deploy_computations()
+        orchestrator.run(timeout=timeout)
+        # Stop agents first: final metrics arrive with AgentStopped.
+        orchestrator.stop_agents(5)
+        stopped = True
+        metrics = orchestrator.end_metrics()
+        return {
+            "status": orchestrator.status,
+            "assignment": {
+                k: v for k, v in metrics["assignment"].items()
+                if k in dcop.variables
+            },
+            "cost": metrics["cost"],
+            "violations": metrics["violation"],
+            "cycles": metrics["cycle"],
+            "time": metrics["time"],
+            "msg_count": metrics["msg_count"],
+            "msg_size": metrics["msg_size"],
+            "agt_metrics": metrics["agt_metrics"],
+            "backend": "thread",
+        }
+    finally:
+        if not stopped:
+            orchestrator.stop_agents(5)
+        orchestrator.stop()

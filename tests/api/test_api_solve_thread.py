@@ -1,0 +1,51 @@
+"""API tests: full in-process stack through solve(backend='thread').
+
+Mirrors the reference's api tests (tests/api/test_api_solve.py:36-44):
+real orchestrator + threaded agents + in-process transport, bounded by
+short timeouts, asserting on solution quality.
+"""
+
+import pytest
+
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+FIXTURE = "/root/reference/tests/instances/graph_coloring1.yaml"
+
+
+def _dcop():
+    return load_dcop_from_file(FIXTURE)
+
+
+def test_thread_solve_maxsum():
+    res = solve(_dcop(), "maxsum", backend="thread", timeout=3)
+    assert res["violations"] == 0
+    assert res["cost"] == pytest.approx(-0.1)
+    assert set(res["assignment"]) == {"v1", "v2", "v3"}
+    assert res["msg_count"] > 0
+
+
+@pytest.mark.parametrize("algo", ["dsa", "mgm"])
+def test_thread_solve_local_search(algo):
+    res = solve(_dcop(), algo, backend="thread", timeout=3)
+    assert res["violations"] == 0
+    # Stochastic local search: global optimum (-0.1) or the 1-opt local
+    # optimum (0.1) are both legitimate outcomes.
+    assert res["cost"] in (pytest.approx(-0.1), pytest.approx(0.1))
+    assert res["msg_count"] > 0
+
+
+def test_thread_solve_with_stop_cycle():
+    res = solve(
+        _dcop(), "dsa", backend="thread", timeout=10,
+        algo_params={"stop_cycle": 30},
+    )
+    assert res["status"] == "FINISHED"
+    assert res["cycles"] == 30
+
+
+def test_thread_and_device_agree():
+    d = _dcop()
+    r_thread = solve(d, "maxsum", backend="thread", timeout=3)
+    r_device = solve(d, "maxsum", backend="device", max_cycles=100)
+    assert r_thread["cost"] == pytest.approx(r_device["cost"])

@@ -1,0 +1,260 @@
+"""Infrastructure unit tests.
+
+The reference's testing trick (tests/unit/
+test_infra_synchronous_computation.py:25): drive computations directly
+with a mocked message sender — no agents, no threads.
+"""
+
+from unittest.mock import MagicMock
+
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef, ComputationDef
+from pydcop_tpu.computations_graph import constraints_hypergraph as chg
+from pydcop_tpu.computations_graph import factor_graph as fg
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.infrastructure.agent_algorithms import (
+    DsaComputation,
+    MaxSumFactorComputation,
+    MaxSumVariableComputation,
+    MgmComputation,
+    MaxSumMessage,
+    approx_match,
+    costs_for_factor,
+    factor_costs_for_var,
+)
+from pydcop_tpu.infrastructure.computations import (
+    ComputationException,
+    Message,
+    MessagePassingComputation,
+    message_type,
+    register,
+)
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+d3 = Domain("d", "", [0, 1, 2])
+
+
+class TestMessageType:
+    def test_factory(self):
+        VMsg = message_type("vmsg", ["value", "cost"])
+        m = VMsg(value=1, cost=2.0)
+        assert m.value == 1 and m.cost == 2.0
+        assert m.type == "vmsg"
+        assert m.size == 2
+
+    def test_positional(self):
+        VMsg = message_type("vmsg", ["value"])
+        assert VMsg(7).value == 7
+
+    def test_missing_field_raises(self):
+        VMsg = message_type("vmsg", ["value"])
+        with pytest.raises(ValueError):
+            VMsg()
+
+    def test_simple_repr_roundtrip(self):
+        m = MaxSumMessage({0: 1.5, 1: 2.5})
+        m2 = from_repr(simple_repr(m))
+        assert m2 == m
+
+
+class TestMessagePassingComputation:
+    def _comp(self):
+        class C(MessagePassingComputation):
+            seen = []
+
+            @register("test_msg")
+            def on_test(self, sender, msg, t):
+                self.seen.append((sender, msg.content))
+
+        c = C("c1")
+        c._msg_sender = MagicMock()
+        return c
+
+    def test_dispatch(self):
+        c = self._comp()
+        c.start()
+        c.on_message("other", Message("test_msg", 42), 0)
+        assert c.seen == [("other", 42)]
+
+    def test_unknown_type_raises(self):
+        c = self._comp()
+        c.start()
+        with pytest.raises(ComputationException):
+            c.on_message("other", Message("nope", 1), 0)
+
+    def test_pause_buffers_messages(self):
+        c = self._comp()
+        c.seen = []
+        c.start()
+        c.pause(True)
+        c.on_message("o", Message("test_msg", 1), 0)
+        assert c.seen == []
+        c.pause(False)
+        assert c.seen == [("o", 1)]
+
+    def test_post_msg_uses_sender(self):
+        c = self._comp()
+        c.start()
+        c.post_msg("target", Message("test_msg", 5))
+        c._msg_sender.assert_called_once()
+        args = c._msg_sender.call_args[0]
+        assert args[0] == "c1" and args[1] == "target"
+
+
+def _maxsum_comp_defs():
+    v1 = Variable("v1", d3)
+    v2 = Variable("v2", d3)
+    c1 = constraint_from_str("c1", "abs(v1 - v2)", [v1, v2])
+    graph = fg.build_computation_graph(variables=[v1, v2],
+                                       constraints=[c1])
+    algo = AlgorithmDef.build_with_default_param("maxsum", {}, "min")
+    defs = {
+        n.name: ComputationDef(n, algo) for n in graph.nodes
+    }
+    return defs
+
+
+class TestMaxSumComputations:
+    def test_factor_costs_for_var(self):
+        v1, v2 = Variable("v1", d3), Variable("v2", d3)
+        c = constraint_from_str("c", "v1 * 3 + v2", [v1, v2])
+        costs = factor_costs_for_var(c, v1, {"v2": {0: 0, 1: 5, 2: 5}},
+                                     "min")
+        # For v1=d: min over v2 of (3d + v2 + recv[v2]) = 3d + 0
+        assert costs == {0: 0, 1: 3, 2: 6}
+
+    def test_costs_for_factor_normalized(self):
+        v = Variable("v", d3)
+        costs = costs_for_factor(
+            v, "f1", ["f1", "f2"], {"f2": {0: 3, 1: 6, 2: 0}}
+        )
+        assert costs == {0: 0, 1: 3, 2: -3}
+        assert abs(sum(costs.values())) < 1e-9
+
+    def test_approx_match(self):
+        assert approx_match({0: 1.0}, {0: 1.0}, 0.1)
+        assert approx_match({0: 1.0}, {0: 1.01}, 0.1)
+        assert not approx_match({0: 1.0}, {0: 2.0}, 0.1)
+        assert not approx_match({0: 1.0}, None, 0.1)
+
+    def test_computation_wiring(self):
+        defs = _maxsum_comp_defs()
+        vc = MaxSumVariableComputation(defs["v1"])
+        fc = MaxSumFactorComputation(defs["c1"])
+        assert vc.neighbors == ["c1"]
+        assert set(fc.neighbors) == {"v1", "v2"}
+        vc._msg_sender = MagicMock()
+        vc.start()
+        # Initial value selected from (noisy) own costs
+        assert vc.current_value in d3
+        # Sync mixin sent cycle-stamped messages to the factor
+        sent = [c[0][2] for c in vc._msg_sender.call_args_list]
+        assert all(m.type == "_cycle" for m in sent)
+
+    def test_sync_cycle_advance(self):
+        defs = _maxsum_comp_defs()
+        fc = MaxSumFactorComputation(defs["c1"])
+        fc._msg_sender = MagicMock()
+        fc.start()
+        assert fc.cycle_id == 0
+        # Deliver one cycle-0 message from each neighbor variable:
+        for v in ("v1", "v2"):
+            fc.on_message(
+                v, Message("_cycle", (0, MaxSumMessage({0: 0, 1: 0, 2: 0}))),
+                0,
+            )
+        assert fc.cycle_id == 1
+
+    def test_sync_duplicate_message_raises(self):
+        defs = _maxsum_comp_defs()
+        fc = MaxSumFactorComputation(defs["c1"])
+        fc._msg_sender = MagicMock()
+        fc.start()
+        fc.on_message(
+            "v1", Message("_cycle", (0, MaxSumMessage({0: 0}))), 0)
+        with pytest.raises(ComputationException):
+            fc.on_message(
+                "v1", Message("_cycle", (0, MaxSumMessage({0: 1}))), 0)
+
+    def test_sync_out_of_cycle_raises(self):
+        defs = _maxsum_comp_defs()
+        fc = MaxSumFactorComputation(defs["c1"])
+        fc._msg_sender = MagicMock()
+        fc.start()
+        with pytest.raises(ComputationException):
+            fc.on_message(
+                "v1", Message("_cycle", (5, MaxSumMessage({0: 0}))), 0)
+
+
+class TestDsaComputation:
+    def _dsa(self, variant="B"):
+        v1 = Variable("v1", d3)
+        v2 = Variable("v2", d3)
+        c1 = constraint_from_str("c1", "1 if v1 == v2 else 0", [v1, v2])
+        graph = chg.build_computation_graph(
+            variables=[v1, v2], constraints=[c1])
+        algo = AlgorithmDef.build_with_default_param(
+            "dsa", {"variant": variant, "probability": 1.0}, "min")
+        node = next(n for n in graph.nodes if n.name == "v1")
+        comp = DsaComputation(ComputationDef(node, algo))
+        comp._msg_sender = MagicMock()
+        return comp
+
+    def test_start_sends_value(self):
+        comp = self._dsa()
+        comp.start()
+        assert comp.current_value in d3
+        comp._msg_sender.assert_called()
+        msg = comp._msg_sender.call_args[0][2]
+        assert msg.type == "dsa_value"
+
+    def test_cycle_on_neighbor_value(self):
+        comp = self._dsa()
+        comp.start()
+        from pydcop_tpu.infrastructure.agent_algorithms import DsaMessage
+
+        comp.on_message("v2", DsaMessage(comp.current_value), 0)
+        # With probability 1 and a conflicting neighbor value, B changes
+        assert comp.cycle_count == 1
+
+    def test_isolated_variable_finishes(self):
+        v = Variable("x", d3)
+        graph = chg.build_computation_graph(variables=[v], constraints=[])
+        algo = AlgorithmDef.build_with_default_param("dsa", {}, "min")
+        comp = DsaComputation(ComputationDef(graph.nodes[0], algo))
+        comp._msg_sender = MagicMock()
+        finished = []
+        comp._on_finish_cb = lambda c: finished.append(c.name)
+        comp.start()
+        assert finished == ["x"]
+        assert not comp.is_running
+
+
+class TestMgmComputation:
+    def test_two_phase_round(self):
+        v1 = Variable("v1", d3)
+        v2 = Variable("v2", d3)
+        c1 = constraint_from_str("c1", "1 if v1 == v2 else 0", [v1, v2])
+        graph = chg.build_computation_graph(
+            variables=[v1, v2], constraints=[c1])
+        algo = AlgorithmDef.build_with_default_param("mgm", {}, "min")
+        node = next(n for n in graph.nodes if n.name == "v1")
+        comp = MgmComputation(ComputationDef(node, algo))
+        comp._msg_sender = MagicMock()
+        comp.start()
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            MgmGainMessage,
+            MgmValueMessage,
+        )
+
+        comp.on_message("v2", MgmValueMessage(comp.current_value), 0)
+        # After receiving all values, a gain message must have been sent:
+        types = [
+            c[0][2].type for c in comp._msg_sender.call_args_list
+        ]
+        assert "mgm_gain" in types
+        # Deliver neighbor gain lower than ours -> we change value
+        comp.on_message("v2", MgmGainMessage(-1.0, 0.5), 0)
+        assert comp.cycle_count >= 1
