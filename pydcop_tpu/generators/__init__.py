@@ -1,0 +1,10 @@
+"""Benchmark problem generators.
+
+Reference parity: pydcop/commands/generators/ (graphcoloring.py,
+ising.py, meetingscheduling.py, secp.py, agents.py, iot.py, scenario.py,
+smallworld.py — CLI glue in commands/generate.py).
+
+All generators here accept an explicit ``seed`` (the reference uses the
+unseeded global ``random`` module; deterministic generation is required
+for reproducible benchmarks and CPU/TPU parity runs).
+"""

@@ -1,0 +1,43 @@
+"""Dynamic-DCOP scenario generator: random agent-removal events.
+
+Reference parity: pydcop/commands/generators/scenario.py — evts_count
+events of actions_count remove_agent actions each, separated by fixed
+delays; never removes the orchestrator or already-removed agents.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+
+from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, Scenario
+
+
+def generate_scenario(
+    evts_count: int,
+    actions_count: int,
+    delay: float,
+    agents: List[str],
+    initial_delay: float = 20,
+    end_delay: float = 20,
+    seed: Optional[int] = None,
+) -> Scenario:
+    rng = np.random.default_rng(seed)
+    available = list(agents)
+    events = [DcopEvent("init_delay", delay=initial_delay)]
+    for e in range(evts_count):
+        if len(available) < actions_count:
+            break
+        chosen = rng.choice(
+            len(available), size=actions_count, replace=False)
+        removed = [available[i] for i in sorted(chosen, reverse=True)]
+        for name in removed:
+            available.remove(name)
+        events.append(DcopEvent(
+            f"e{e}",
+            actions=[
+                EventAction("remove_agent", agent=a) for a in removed
+            ],
+        ))
+        events.append(DcopEvent(f"d{e}", delay=delay))
+    events.append(DcopEvent("end_delay", delay=end_delay))
+    return Scenario(events)

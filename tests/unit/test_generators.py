@@ -1,0 +1,169 @@
+"""Generator tests: structure, determinism, solvability."""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.yamldcop import dcop_yaml, load_dcop
+from pydcop_tpu.generators import graphs
+from pydcop_tpu.generators.agents_gen import generate_agents
+from pydcop_tpu.generators.graphcoloring import generate_graph_coloring
+from pydcop_tpu.generators.iot import generate_iot
+from pydcop_tpu.generators.ising import generate_ising
+from pydcop_tpu.generators.meetingscheduling import generate_meetings
+from pydcop_tpu.generators.scenario_gen import generate_scenario
+from pydcop_tpu.generators.secp import generate_secp
+from pydcop_tpu.generators.smallworld import generate_small_world
+
+
+class TestGraphs:
+    def test_random_connected(self):
+        edges = graphs.random_graph(30, 0.05, seed=0)
+        # connectivity check by BFS
+        adj = {i: set() for i in range(30)}
+        for a, b in edges:
+            adj[a].add(b)
+            adj[b].add(a)
+        seen, stack = {0}, [0]
+        while stack:
+            for nb in adj[stack.pop()]:
+                if nb not in seen:
+                    seen.add(nb)
+                    stack.append(nb)
+        assert len(seen) == 30
+
+    def test_random_deterministic(self):
+        assert graphs.random_graph(20, 0.2, seed=5) == \
+            graphs.random_graph(20, 0.2, seed=5)
+
+    def test_grid_requires_square(self):
+        with pytest.raises(ValueError):
+            graphs.grid_graph(7)
+        edges = graphs.grid_graph(9)
+        assert len(edges) == 12  # 3x3 grid: 2*3*2
+
+    def test_grid_2d_toroidal_degree(self):
+        edges = graphs.grid_2d_graph(4, 4, periodic=True)
+        # toroidal grid: every node has degree 4 -> 2*n edges
+        assert len(edges) == 32
+
+    def test_scalefree(self):
+        edges = graphs.scalefree_graph(30, 2, seed=0)
+        assert len(edges) >= 28 * 2 * 0.9
+        degs = {}
+        for a, b in edges:
+            degs[a] = degs.get(a, 0) + 1
+            degs[b] = degs.get(b, 0) + 1
+        assert max(degs.values()) > 4  # hubs exist
+
+    def test_small_world(self):
+        edges = graphs.small_world_graph(20, k=4, seed=0)
+        # ~n*k/2, minus rewiring collisions with lattice edges
+        assert 35 <= len(edges) <= 40
+
+
+class TestGraphColoring:
+    def test_basic(self):
+        dcop = generate_graph_coloring(
+            10, 3, "random", p_edge=0.3, seed=1)
+        assert len(dcop.variables) == 10
+        assert len(dcop.agents) == 10
+        assert all(c.arity == 2 for c in dcop.constraints.values())
+
+    def test_deterministic(self):
+        d1 = generate_graph_coloring(10, 3, "random", p_edge=0.3, seed=7)
+        d2 = generate_graph_coloring(10, 3, "random", p_edge=0.3, seed=7)
+        assert dcop_yaml(d1) == dcop_yaml(d2)
+
+    def test_soft_random_costs(self):
+        dcop = generate_graph_coloring(
+            10, 3, "random", soft=True, p_edge=0.3, seed=1)
+        c = next(iter(dcop.constraints.values()))
+        assert c.to_array().max() <= 9
+
+    def test_intentional_hard(self):
+        dcop = generate_graph_coloring(
+            6, 3, "random", intentional=True, p_edge=0.3, seed=1)
+        c = next(iter(dcop.constraints.values()))
+        v1, v2 = c.dimensions
+        assert c(**{v1.name: "R", v2.name: "R"}) == 1000
+        assert c(**{v1.name: "R", v2.name: "G"}) == 0
+
+    def test_yaml_roundtrip(self):
+        dcop = generate_graph_coloring(
+            8, 3, "random", p_edge=0.3, seed=2)
+        again = load_dcop(dcop_yaml(dcop))
+        assert set(again.variables) == set(dcop.variables)
+        asst = {v: "R" for v in dcop.variables}
+        assert again.solution_cost(asst) == dcop.solution_cost(asst)
+
+
+class TestIsing:
+    def test_structure(self):
+        dcop, var_map, fg_map = generate_ising(
+            4, 4, seed=0, var_dist=True, fg_dist=True)
+        assert len(dcop.variables) == 16
+        # 16 unary + 32 binary (toroidal degree 4)
+        arities = [c.arity for c in dcop.constraints.values()]
+        assert arities.count(1) == 16
+        assert arities.count(2) == 32
+        assert len(var_map) == 16
+        # fg mapping: every computation appears exactly once and every
+        # constraint/variable is covered.
+        comps = [c for lst in fg_map.values() for c in lst]
+        assert len(comps) == len(set(comps))
+        assert set(comps) == set(dcop.constraints) | set(dcop.variables)
+
+    def test_cost_symmetry(self):
+        dcop, _, _ = generate_ising(3, 3, seed=1)
+        for c in dcop.constraints.values():
+            if c.arity == 2:
+                arr = c.to_array()
+                assert arr[0, 0] == arr[1, 1] == -arr[0, 1]
+
+
+class TestOtherGenerators:
+    def test_meetings(self):
+        dcop = generate_meetings(4, 3, 3, 2, seed=0)
+        assert dcop.objective == "max"
+        assert dcop.variables
+        # every variable's domain includes the unscheduled slot 0
+        v = next(iter(dcop.variables.values()))
+        assert 0 in v.domain
+
+    def test_secp(self):
+        dcop = generate_secp(6, 2, 3, seed=0)
+        assert sum(1 for v in dcop.variables if v.startswith("l")) == 6
+        assert sum(1 for v in dcop.variables if v.startswith("m")) == 2
+        assert len(dcop.agents) == 6
+
+    def test_iot_and_smallworld_solvable(self):
+        from pydcop_tpu.api import solve
+
+        for dcop in (generate_iot(12, seed=0),
+                     generate_small_world(12, 4, seed=0)):
+            res = solve(dcop, "dsa", max_cycles=20)
+            assert res["violations"] == 0
+
+    def test_agents_count_mode(self):
+        agents = generate_agents(
+            mode="count", count=5, capacity=50)
+        assert len(agents) == 5
+        assert agents[0].capacity == 50
+
+    def test_agents_variables_mode(self):
+        agents = generate_agents(
+            mode="variables", variables=["v1", "v2"], capacity=10,
+            hosting="name_mapping", hosting_default=100,
+        )
+        assert len(agents) == 2
+        assert agents[0].hosting_cost("v1") == 0
+        assert agents[0].hosting_cost("v2") == 100
+
+    def test_scenario(self):
+        s = generate_scenario(
+            2, 1, 5, ["a1", "a2", "a3", "a4"], seed=0)
+        removals = [
+            a.args["agent"] for e in s.events if e.actions
+            for a in e.actions
+        ]
+        assert len(removals) == len(set(removals)) == 2
