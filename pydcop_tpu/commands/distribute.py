@@ -1,14 +1,115 @@
-"""``pydcop distribute`` — placeholder, implemented later this round.
+"""``pydcop distribute``: offline computation-to-agent distribution.
 
-Reference parity target: pydcop/commands/distribute.py.
+Reference parity: pydcop/commands/distribute.py (:170-225) — loads a
+DCOP, builds the computation graph (from --graph or --algo's
+GRAPH_TYPE), runs the chosen distribution method and emits a
+distribution YAML with inputs + cost.
 """
+
+import importlib
+import time
+
+from pydcop_tpu.commands._utils import emit_result
+
+DIST_METHODS = [
+    "oneagent", "adhoc", "ilp_fgdp", "ilp_compref", "ilp_compref_fg",
+    "heur_comhost", "gh_secp_cgdp", "gh_secp_fgdp", "oilp_secp_fgdp",
+    "oilp_secp_cgdp", "oilp_cgdp", "gh_cgdp",
+]
 
 
 def set_parser(subparsers):
-    parser = subparsers.add_parser("distribute", help="distribute (not yet implemented)")
+    parser = subparsers.add_parser(
+        "distribute", help="distribute a static dcop")
+    parser.add_argument("dcop_files", type=str, nargs="+")
+    parser.add_argument(
+        "-g", "--graph", required=False,
+        choices=["factor_graph", "pseudotree",
+                 "constraints_hypergraph", "ordered_graph"],
+    )
+    parser.add_argument(
+        "-d", "--distribution", required=True, choices=DIST_METHODS)
+    parser.add_argument(
+        "--cost", choices=DIST_METHODS, default=None,
+        help="method whose cost function evaluates the distribution",
+    )
+    parser.add_argument("-a", "--algo", required=False)
     parser.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
-    print("pydcop distribute: not implemented yet in pydcop-tpu")
-    return 3
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.computations_graph import load_graph_module
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+    if not args.graph and not args.algo:
+        print("Error: one of --graph or --algo is required")
+        return 2
+
+    from pydcop_tpu.distribution.objects import (
+        ImpossibleDistributionException,
+    )
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_module = None
+    computation_memory = communication_load = None
+    if args.algo:
+        algo_module = load_algorithm_module(args.algo)
+        if args.graph and args.graph != algo_module.GRAPH_TYPE:
+            print(
+                f"Error: incompatible graph model {args.graph} and "
+                f"algorithm {args.algo} (expects "
+                f"{algo_module.GRAPH_TYPE})"
+            )
+            return 2
+        computation_memory = algo_module.computation_memory
+        communication_load = algo_module.communication_load
+    graph_type = args.graph or algo_module.GRAPH_TYPE
+    cg = load_graph_module(graph_type).build_computation_graph(dcop)
+
+    inputs = {
+        "dist_algo": args.distribution,
+        "dcop": args.dcop_files,
+        "graph": graph_type,
+        "algo": args.algo,
+    }
+    dist_module = importlib.import_module(
+        f"pydcop_tpu.distribution.{args.distribution}")
+    t0 = time.perf_counter()
+    try:
+        dist = dist_module.distribute(
+            cg, dcop.agents.values(), hints=dcop.dist_hints,
+            computation_memory=computation_memory,
+            communication_load=communication_load,
+            timeout=args.timeout,
+        )
+    except ImpossibleDistributionException as e:
+        emit_result({
+            "inputs": inputs,
+            "status": "FAIL",
+            "error": str(e),
+        }, args.output)
+        return 0
+    elapsed = time.perf_counter() - t0
+
+    cost_module = dist_module
+    if args.cost:
+        cost_module = importlib.import_module(
+            f"pydcop_tpu.distribution.{args.cost}")
+    cost, comm, hosting = cost_module.distribution_cost(
+        dist, cg, dcop.agents.values(),
+        computation_memory=computation_memory,
+        communication_load=communication_load,
+    )
+
+    result = {
+        "inputs": inputs,
+        "status": "SUCCESS",
+        "distribution": dist.mapping,
+        "cost": cost,
+        "communication_cost": comm,
+        "hosting_cost": hosting,
+        "duration": elapsed,
+    }
+    emit_result(result, args.output)
+    return 0

@@ -1,0 +1,275 @@
+"""Shared machinery for distribution methods.
+
+Reference parity: the common structure behind pydcop/distribution/*
+modules — footprint/capacity accounting, communication edges, hosting
+and route costs, plus two placement engines:
+
+- a greedy engine (used by adhoc/heur_comhost/gh_*): place computations
+  one at a time on the cheapest feasible agent;
+- an ILP engine (used by ilp_*/oilp_*): binary x[c,a] placement
+  variables with per-edge y[e,a1,a2] linearization of route costs,
+  solved with scipy.optimize.milp (the reference uses PuLP, which is
+  not available in this image; the model is the same).
+
+Distribution cost convention (reference ilp_compref.py): total =
+RATIO * comm + (1 - RATIO) * hosting, with comm = sum over edges of
+route(a1,a2) * communication_load, hosting = sum of hosting_cost(a,c).
+"""
+
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+RATIO_HOST_COMM = 0.8
+
+
+def footprints(cg, computation_memory: Optional[Callable]
+               ) -> Dict[str, float]:
+    out = {}
+    for node in cg.nodes:
+        if computation_memory is None:
+            out[node.name] = 0.0
+        else:
+            try:
+                out[node.name] = float(computation_memory(node))
+            except (NotImplementedError, TypeError):
+                out[node.name] = 0.0
+    return out
+
+
+def comm_edges(cg, communication_load: Optional[Callable]
+               ) -> List[Tuple[str, str, float]]:
+    """Unique (comp1, comp2, load) pairs for linked computations."""
+    edges = {}
+    for node in cg.nodes:
+        for neighbor in node.neighbors:
+            key = tuple(sorted((node.name, neighbor)))
+            if key in edges:
+                continue
+            if communication_load is None:
+                load = 1.0
+            else:
+                try:
+                    load = float(communication_load(node, neighbor))
+                except (NotImplementedError, TypeError, ValueError):
+                    load = 1.0
+            edges[key] = load
+    return [(a, b, load) for (a, b), load in edges.items()]
+
+
+def agent_capacity(agent) -> float:
+    try:
+        return float(agent.capacity)
+    except (AttributeError, TypeError):
+        return float("inf")
+
+
+def distribution_cost_impl(distribution: Distribution, cg, agentsdef,
+                           computation_memory=None,
+                           communication_load=None,
+                           ratio: float = RATIO_HOST_COMM
+                           ) -> Tuple[float, float, float]:
+    """(total, comm, hosting) costs of a distribution."""
+    agents = {a.name: a for a in agentsdef}
+    comm = 0.0
+    for c1, c2, load in comm_edges(cg, communication_load):
+        a1 = distribution.agent_for(c1)
+        a2 = distribution.agent_for(c2)
+        comm += agents[a1].route(a2) * load
+    hosting = 0.0
+    for comp in distribution.computations:
+        agent = agents[distribution.agent_for(comp)]
+        hosting += agent.hosting_cost(comp)
+    total = ratio * comm + (1 - ratio) * hosting
+    return total, comm, hosting
+
+
+def greedy_place(
+    cg, agentsdef: Iterable, hints: Optional[DistributionHints],
+    computation_memory, communication_load,
+    order_key: Optional[Callable] = None,
+    comm_weight: float = 1.0,
+    hosting_weight: float = 1.0,
+) -> Distribution:
+    """Greedy placement: hints first, then computations in `order_key`
+    order, each on the cheapest feasible agent (capacity respected)."""
+    agents = {a.name: a for a in agentsdef}
+    if not agents:
+        raise ImpossibleDistributionException("No agents")
+    fp = footprints(cg, computation_memory)
+    edges = comm_edges(cg, communication_load)
+    neighbors_of: Dict[str, List[Tuple[str, float]]] = {}
+    for a, b, load in edges:
+        neighbors_of.setdefault(a, []).append((b, load))
+        neighbors_of.setdefault(b, []).append((a, load))
+
+    remaining_capacity = {
+        name: agent_capacity(a) for name, a in agents.items()
+    }
+    placed: Dict[str, str] = {}
+
+    def host(comp: str, agent: str):
+        if fp[comp] > remaining_capacity[agent]:
+            raise ImpossibleDistributionException(
+                f"Agent {agent} has no capacity left for {comp} "
+                f"(needs {fp[comp]}, has {remaining_capacity[agent]})"
+            )
+        remaining_capacity[agent] -= fp[comp]
+        placed[comp] = agent
+
+    comp_names = {n.name for n in cg.nodes}
+
+    # 1. must_host hints.
+    if hints is not None:
+        for agent in agents:
+            for comp in hints.must_host(agent):
+                if comp in comp_names and comp not in placed:
+                    host(comp, agent)
+
+    # 2. Remaining computations, ordered.
+    todo = [n.name for n in cg.nodes if n.name not in placed]
+    if order_key is not None:
+        todo.sort(key=lambda c: order_key(c, fp, neighbors_of))
+
+    for comp in todo:
+        best_agent, best_cost = None, None
+        for name, agent in agents.items():
+            if fp[comp] > remaining_capacity[name]:
+                continue
+            cost = hosting_weight * agent.hosting_cost(comp)
+            for other, load in neighbors_of.get(comp, []):
+                if other in placed:
+                    cost += comm_weight * load * agent.route(
+                        placed[other])
+            # Prefer hint co-location.
+            if hints is not None:
+                group = hints.host_with(comp)
+                if any(placed.get(g) == name for g in group):
+                    cost -= 1000
+            if best_cost is None or cost < best_cost:
+                best_agent, best_cost = name, cost
+        if best_agent is None:
+            raise ImpossibleDistributionException(
+                f"No agent has capacity for computation {comp}"
+            )
+        host(comp, best_agent)
+
+    mapping: Dict[str, List[str]] = {a: [] for a in agents}
+    for comp, agent in placed.items():
+        mapping[agent].append(comp)
+    return Distribution(mapping)
+
+
+def ilp_place(
+    cg, agentsdef: Iterable, hints: Optional[DistributionHints],
+    computation_memory, communication_load,
+    comm_weight: float = 1.0,
+    hosting_weight: float = 0.0,
+    timeout: Optional[float] = None,
+) -> Distribution:
+    """Optimal placement by mixed-integer programming.
+
+    Variables: x[c,a] in {0,1} (computation c on agent a) and, per comm
+    edge e=(c1,c2) and agent pair (a1,a2), y[e,a1,a2] >= x[c1,a1] +
+    x[c2,a2] - 1 (continuous in [0,1]; minimization makes it exact).
+    """
+    from scipy.optimize import LinearConstraint, milp
+    from scipy.sparse import lil_matrix
+
+    agents = list(agentsdef)
+    agent_names = [a.name for a in agents]
+    comps = [n.name for n in cg.nodes]
+    if not agents:
+        raise ImpossibleDistributionException("No agents")
+    na, nc = len(agents), len(comps)
+    fp = footprints(cg, computation_memory)
+    edges = comm_edges(cg, communication_load) if comm_weight > 0 else []
+
+    def xi(c: int, a: int) -> int:
+        return c * na + a
+
+    n_x = nc * na
+    n_y = len(edges) * na * na
+    n_vars = n_x + n_y
+
+    def yi(e: int, a1: int, a2: int) -> int:
+        return n_x + (e * na + a1) * na + a2
+
+    objective = np.zeros(n_vars)
+    if hosting_weight:
+        for c, comp in enumerate(comps):
+            for a, agent in enumerate(agents):
+                objective[xi(c, a)] = (
+                    hosting_weight * agent.hosting_cost(comp)
+                )
+    comp_index = {comp: i for i, comp in enumerate(comps)}
+    for e, (c1, c2, load) in enumerate(edges):
+        for a1 in range(na):
+            for a2 in range(na):
+                route = agents[a1].route(agent_names[a2])
+                objective[yi(e, a1, a2)] = comm_weight * load * route
+
+    constraints = []
+    # Each computation hosted exactly once.
+    m = lil_matrix((nc, n_vars))
+    for c in range(nc):
+        for a in range(na):
+            m[c, xi(c, a)] = 1
+    constraints.append(LinearConstraint(m.tocsr(), 1, 1))
+    # Capacity.
+    m = lil_matrix((na, n_vars))
+    ub = np.zeros(na)
+    for a, agent in enumerate(agents):
+        for c, comp in enumerate(comps):
+            m[a, xi(c, a)] = fp[comp]
+        ub[a] = agent_capacity(agent)
+    constraints.append(LinearConstraint(m.tocsr(), -np.inf, ub))
+    # Edge linearization: y >= x1 + x2 - 1.
+    if edges:
+        m = lil_matrix((len(edges) * na * na, n_vars))
+        row = 0
+        for e, (c1, c2, _) in enumerate(edges):
+            i1, i2 = comp_index[c1], comp_index[c2]
+            for a1 in range(na):
+                for a2 in range(na):
+                    m[row, xi(i1, a1)] = 1
+                    m[row, xi(i2, a2)] = 1
+                    m[row, yi(e, a1, a2)] = -1
+                    row += 1
+        constraints.append(
+            LinearConstraint(m.tocsr(), -np.inf, 1))
+    # must_host hints pin x variables.
+    lb = np.zeros(n_vars)
+    ub_v = np.ones(n_vars)
+    if hints is not None:
+        for a, agent in enumerate(agents):
+            for comp in hints.must_host(agent.name):
+                if comp in comp_index:
+                    lb[xi(comp_index[comp], a)] = 1
+
+    integrality = np.zeros(n_vars)
+    integrality[:n_x] = 1  # x binary, y continuous
+
+    from scipy.optimize import Bounds
+
+    options = {"time_limit": timeout} if timeout else None
+    res = milp(
+        c=objective, constraints=constraints,
+        integrality=integrality, bounds=Bounds(lb, ub_v),
+        options=options,
+    )
+    if not res.success:
+        raise ImpossibleDistributionException(
+            f"ILP infeasible: {res.message}"
+        )
+    x = res.x[:n_x].reshape(nc, na)
+    mapping: Dict[str, List[str]] = {a: [] for a in agent_names}
+    for c, comp in enumerate(comps):
+        mapping[agent_names[int(np.argmax(x[c]))]].append(comp)
+    return Distribution(mapping)

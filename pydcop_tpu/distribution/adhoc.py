@@ -1,0 +1,27 @@
+"""adhoc distribution: capacity-aware heuristic honoring hints.
+
+Reference parity: pydcop/distribution/adhoc.py (distribute :56,
+IJCAI-16): must_host hints placed first, host_with groups co-located,
+remaining computations placed next to their neighbors under capacity.
+"""
+
+from pydcop_tpu.distribution._base import (
+    distribution_cost_impl,
+    greedy_place,
+)
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None, **_):
+    return greedy_place(
+        computation_graph, agentsdef, hints,
+        computation_memory, communication_load,
+        hosting_weight=0.0,
+    )
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return distribution_cost_impl(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load, ratio=1.0)

@@ -1,0 +1,30 @@
+"""gh_cgdp: greedy heuristic for the Constraint-Graph Distribution
+Problem.
+
+Reference parity: pydcop/distribution/gh_cgdp.py (:69): highest-degree
+computations first, cheapest (comm + hosting) feasible agent.
+"""
+
+from pydcop_tpu.distribution._base import (
+    RATIO_HOST_COMM,
+    distribution_cost_impl,
+    greedy_place,
+)
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None, **_):
+    return greedy_place(
+        computation_graph, agentsdef, hints,
+        computation_memory, communication_load,
+        order_key=lambda c, fp, nb: -len(nb.get(c, [])),
+        comm_weight=RATIO_HOST_COMM,
+        hosting_weight=1 - RATIO_HOST_COMM,
+    )
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return distribution_cost_impl(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

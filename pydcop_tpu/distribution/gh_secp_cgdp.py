@@ -1,0 +1,30 @@
+"""gh_secp_cgdp: SECP-specialized greedy heuristic, constraint graph.
+
+Reference parity: pydcop/distribution/gh_secp_cgdp.py.  SECP placement
+preferences are expressed through hosting costs (device computations
+have cost 0 on their own agent), so the generic greedy engine with a
+strong hosting weight realizes the SECP policy.
+"""
+
+from pydcop_tpu.distribution._base import (
+    distribution_cost_impl,
+    greedy_place,
+)
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None, **_):
+    return greedy_place(
+        computation_graph, agentsdef, hints,
+        computation_memory, communication_load,
+        order_key=lambda c, fp, nb: -fp[c],
+        comm_weight=0.5,
+        hosting_weight=1.0,
+    )
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return distribution_cost_impl(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

@@ -1,0 +1,11 @@
+"""gh_secp_fgdp: SECP-specialized greedy heuristic, factor graph.
+
+Reference parity: pydcop/distribution/gh_secp_fgdp.py — same policy as
+gh_secp_cgdp applied to factor-graph computations (variables AND
+factors are placed).
+"""
+
+from pydcop_tpu.distribution.gh_secp_cgdp import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

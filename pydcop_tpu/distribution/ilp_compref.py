@@ -1,0 +1,30 @@
+"""ilp_compref: optimal ILP over weighted communication + hosting costs.
+
+Reference parity: pydcop/distribution/ilp_compref.py (distribute :79,
+AAMAS-18; RATIO_HOST_COMM weighting; PuLP replaced by scipy milp).
+"""
+
+from pydcop_tpu.distribution._base import (
+    RATIO_HOST_COMM,
+    distribution_cost_impl,
+    ilp_place,
+)
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None,
+               timeout=None, **_):
+    return ilp_place(
+        computation_graph, agentsdef, hints,
+        computation_memory, communication_load,
+        timeout=timeout,
+        comm_weight=RATIO_HOST_COMM,
+        hosting_weight=1 - RATIO_HOST_COMM,
+    )
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return distribution_cost_impl(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

@@ -1,0 +1,11 @@
+"""ilp_compref_fg: ilp_compref applied to factor graphs.
+
+Reference parity: pydcop/distribution/ilp_compref_fg.py — the placement
+model is graph-agnostic; factor graphs simply contribute more
+computations (variables and factors).
+"""
+
+from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

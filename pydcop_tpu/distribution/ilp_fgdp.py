@@ -1,0 +1,29 @@
+"""ilp_fgdp: optimal ILP for factor-graph distribution, communication
+cost only.
+
+Reference parity: pydcop/distribution/ilp_fgdp.py (distribute :68,
+OPTMAS-17; PuLP replaced by scipy.optimize.milp — same model).
+"""
+
+from pydcop_tpu.distribution._base import (
+    distribution_cost_impl,
+    ilp_place,
+)
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None,
+               timeout=None, **_):
+    return ilp_place(
+        computation_graph, agentsdef, hints,
+        computation_memory, communication_load,
+        timeout=timeout,
+        comm_weight=1.0, hosting_weight=0.0,
+    )
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return distribution_cost_impl(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load, ratio=1.0)

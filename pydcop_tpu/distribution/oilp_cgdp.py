@@ -1,0 +1,9 @@
+"""oilp_cgdp: optimal ILP for the Constraint-Graph Distribution Problem.
+
+Reference parity: pydcop/distribution/oilp_cgdp.py.
+"""
+
+from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

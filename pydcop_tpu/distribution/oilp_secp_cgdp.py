@@ -1,0 +1,11 @@
+"""oilp_secp_cgdp: optimal ILP, SECP flavor, constraint graph.
+
+Reference parity: pydcop/distribution/oilp_secp_cgdp.py — SECP
+preferences come in through hosting costs; the weighted ILP model
+applies unchanged.
+"""
+
+from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

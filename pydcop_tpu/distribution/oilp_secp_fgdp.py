@@ -1,0 +1,9 @@
+"""oilp_secp_fgdp: optimal ILP, SECP flavor, factor graph.
+
+Reference parity: pydcop/distribution/oilp_secp_fgdp.py (:72).
+"""
+
+from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
+    distribute,
+    distribution_cost,
+)

@@ -31,5 +31,6 @@ def distribute(computation_graph, agentsdef: Iterable,
 
 def distribution_cost(distribution: Distribution, computation_graph,
                       agentsdef, computation_memory=None,
-                      communication_load=None) -> float:
-    return 0
+                      communication_load=None):
+    """(total, comm, hosting) — all zero by definition for oneagent."""
+    return 0, 0, 0

@@ -1,0 +1,11 @@
+"""Distribution-file IO.
+
+Reference parity: pydcop/distribution/yamlformat.py
+(load_dist_from_file :44) — delegates to the yaml layer.
+"""
+
+from pydcop_tpu.dcop.yamldcop import (  # noqa: F401
+    load_dist,
+    load_dist_from_file,
+    yaml_dist,
+)

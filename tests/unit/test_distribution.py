@@ -1,0 +1,151 @@
+"""Distribution-method tests: validity, capacity, hints, ILP optimality."""
+
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module
+from pydcop_tpu.computations_graph import constraints_hypergraph, factor_graph
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.distribution import objects as dist_objects
+from pydcop_tpu.distribution.objects import (
+    DistributionHints,
+    ImpossibleDistributionException,
+)
+
+METHODS = [
+    "oneagent", "adhoc", "heur_comhost", "gh_cgdp", "gh_secp_cgdp",
+    "gh_secp_fgdp", "ilp_fgdp", "ilp_compref", "ilp_compref_fg",
+    "oilp_cgdp", "oilp_secp_cgdp", "oilp_secp_fgdp",
+]
+
+
+def _problem():
+    d = Domain("d", "", [0, 1, 2])
+    vs = [Variable(f"v{i}", d) for i in range(4)]
+    cs = [
+        constraint_from_str("c0", "v0 + v1", vs),
+        constraint_from_str("c1", "v1 + v2", vs),
+        constraint_from_str("c2", "v2 + v3", vs),
+    ]
+    return vs, cs
+
+
+def _import(method):
+    import importlib
+
+    return importlib.import_module(f"pydcop_tpu.distribution.{method}")
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_every_method_produces_valid_distribution(method):
+    vs, cs = _problem()
+    cg = factor_graph.build_computation_graph(
+        variables=vs, constraints=cs)
+    agents = [AgentDef(f"a{i}", capacity=1000) for i in range(8)]
+    module = _import(method)
+    algo = load_algorithm_module("maxsum")
+    dist = module.distribute(
+        cg, agents, hints=None,
+        computation_memory=algo.computation_memory,
+        communication_load=algo.communication_load,
+    )
+    hosted = sorted(dist.computations)
+    assert hosted == sorted(n.name for n in cg.nodes)
+    cost, comm, hosting = module.distribution_cost(
+        dist, cg, agents,
+        computation_memory=algo.computation_memory,
+        communication_load=algo.communication_load,
+    )
+    assert cost >= 0 and comm >= 0 and hosting >= 0
+
+
+def test_greedy_respects_capacity():
+    vs, cs = _problem()
+    cg = constraints_hypergraph.build_computation_graph(
+        variables=vs, constraints=cs)
+    # Footprint of each var-computation is its neighbor count (1-2);
+    # capacity 2 forces spreading over agents.
+    agents = [AgentDef(f"a{i}", capacity=2) for i in range(4)]
+    module = _import("heur_comhost")
+    algo = load_algorithm_module("dsa")
+    dist = module.distribute(
+        cg, agents, None, algo.computation_memory,
+        algo.communication_load)
+    for a in dist.agents:
+        used = sum(
+            algo.computation_memory(cg.computation(c))
+            for c in dist.computations_hosted(a)
+        )
+        assert used <= 2
+
+
+def test_greedy_impossible_capacity_raises():
+    vs, cs = _problem()
+    cg = constraints_hypergraph.build_computation_graph(
+        variables=vs, constraints=cs)
+    agents = [AgentDef("a0", capacity=0)]
+    module = _import("adhoc")
+    algo = load_algorithm_module("dsa")
+    with pytest.raises(ImpossibleDistributionException):
+        module.distribute(
+            cg, agents, None, algo.computation_memory,
+            algo.communication_load)
+
+
+def test_must_host_hints_respected():
+    vs, cs = _problem()
+    cg = constraints_hypergraph.build_computation_graph(
+        variables=vs, constraints=cs)
+    agents = [AgentDef(f"a{i}", capacity=100) for i in range(4)]
+    hints = DistributionHints(must_host={"a2": ["v0"], "a3": ["v3"]})
+    for method in ("adhoc", "ilp_compref"):
+        module = _import(method)
+        dist = module.distribute(cg, agents, hints, None, None)
+        assert dist.agent_for("v0") == "a2"
+        assert dist.agent_for("v3") == "a3"
+
+
+def test_ilp_minimizes_communication():
+    """Two clusters of tightly-linked computations and two agents with
+    free intra-agent routes: the ILP must put each cluster on one
+    agent."""
+    d = Domain("d", "", [0, 1])
+    vs = [Variable(f"v{i}", d) for i in range(4)]
+    cs = [
+        constraint_from_str("c01", "v0 + v1", vs),
+        constraint_from_str("c23", "v2 + v3", vs),
+    ]
+    cg = constraints_hypergraph.build_computation_graph(
+        variables=vs, constraints=cs)
+    agents = [
+        AgentDef("a0", capacity=100, default_route=10),
+        AgentDef("a1", capacity=100, default_route=10),
+    ]
+    module = _import("ilp_fgdp")
+    dist = module.distribute(cg, agents, None, None, lambda s, t: 1)
+    assert dist.agent_for("v0") == dist.agent_for("v1")
+    assert dist.agent_for("v2") == dist.agent_for("v3")
+    cost, comm, hosting = module.distribution_cost(
+        dist, cg, agents, None, lambda s, t: 1)
+    assert comm == 0  # all communication intra-agent
+
+
+def test_ilp_hosting_costs_matter():
+    d = Domain("d", "", [0, 1])
+    v = Variable("v0", d)
+    cg = constraints_hypergraph.build_computation_graph(
+        variables=[v], constraints=[])
+    agents = [
+        AgentDef("cheap", default_hosting_cost=1),
+        AgentDef("pricey", default_hosting_cost=50),
+    ]
+    module = _import("ilp_compref")
+    dist = module.distribute(cg, agents, None, None, None)
+    assert dist.agent_for("v0") == "cheap"
+
+
+def test_distribution_object_roundtrip():
+    from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+    dist = dist_objects.Distribution({"a1": ["v1"], "a2": []})
+    assert from_repr(simple_repr(dist)) == dist
