@@ -1,0 +1,71 @@
+"""DBA: Distributed Breakout Algorithm — constraint satisfaction.
+
+Reference parity: pydcop/algorithms/dba.py (params :264-268: infinity
+10000, max_distance 50; semantics :272-595).  Kernels:
+pydcop_tpu/ops/dba.py.
+
+DBA minimizes the number of violated constraints (a constraint is
+violated when its cost reaches `infinity`); it only supports
+minimization (dba.py:295-298).
+"""
+
+from functools import partial
+from typing import Optional
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.computations_graph import constraints_hypergraph as chg
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.engine.runner import DeviceRunResult, run_device_fn
+from pydcop_tpu.ops.dba import run_dba
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+HEADER_SIZE = 100
+UNIT_SIZE = 5
+
+algo_params = [
+    AlgoParameterDef("infinity", "int", None, 10000),
+    AlgoParameterDef("max_distance", "int", None, 50),
+    AlgoParameterDef("seed", "int", None, 0),
+]
+
+
+def computation_memory(node) -> float:
+    return chg.computation_memory(node)
+
+
+def communication_load(src, target: str) -> float:
+    # ok/improve messages carry a value and an improvement (dba.py:92).
+    return 2 * UNIT_SIZE + HEADER_SIZE
+
+
+def build_computation(comp_def):
+    from pydcop_tpu.infrastructure.computations import build_algo_computation
+
+    return build_algo_computation("dba", comp_def)
+
+
+def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
+                    max_cycles: int = 1000, mesh=None,
+                    n_devices: Optional[int] = None,
+                    **_) -> DeviceRunResult:
+    if dcop.objective != "min":
+        raise ValueError(
+            "DBA is a constraint satisfaction algorithm and only "
+            "supports minimization (reference dba.py:295)"
+        )
+    from pydcop_tpu.algorithms.mgm import lexic_ranks
+
+    params = algo_def.params
+    pad_to = mesh.size if mesh is not None else (n_devices or 1)
+    graph, meta = compile_dcop(dcop, pad_to=pad_to)
+    fn = partial(
+        run_dba,
+        max_cycles=max_cycles,
+        infinity=float(params.get("infinity", 10000)),
+        max_distance=int(params.get("max_distance", 50)),
+        lexic_ranks=lexic_ranks(meta),
+        seed=params.get("seed", 0),
+    )
+    return run_device_fn(graph, meta, fn, mesh=mesh, n_devices=n_devices)

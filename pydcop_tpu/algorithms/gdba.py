@@ -1,0 +1,64 @@
+"""GDBA: Generalized Distributed Breakout Algorithm.
+
+Reference parity: pydcop/algorithms/gdba.py (params :181-186: modifier
+A/M, violation NZ/NM/MX, increase_mode E/R/C/T; semantics :189-654).
+Kernels: pydcop_tpu/ops/gdba.py.
+"""
+
+from functools import partial
+from typing import Optional
+
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
+from pydcop_tpu.computations_graph import constraints_hypergraph as chg
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.engine.runner import DeviceRunResult, run_device_fn
+from pydcop_tpu.ops.gdba import run_gdba
+
+GRAPH_TYPE = "constraints_hypergraph"
+
+HEADER_SIZE = 100
+UNIT_SIZE = 5
+
+algo_params = [
+    AlgoParameterDef("modifier", "str", ["A", "M"], "A"),
+    AlgoParameterDef("violation", "str", ["NZ", "NM", "MX"], "NZ"),
+    AlgoParameterDef("increase_mode", "str", ["E", "R", "C", "T"], "E"),
+    AlgoParameterDef("seed", "int", None, 0),
+]
+
+
+def computation_memory(node) -> float:
+    return chg.computation_memory(node)
+
+
+def communication_load(src, target: str) -> float:
+    # ok/improve messages carry a value or an improvement (gdba.py:100).
+    return 2 * UNIT_SIZE + HEADER_SIZE
+
+
+def build_computation(comp_def):
+    from pydcop_tpu.infrastructure.computations import build_algo_computation
+
+    return build_algo_computation("gdba", comp_def)
+
+
+def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
+                    max_cycles: int = 1000, mesh=None,
+                    n_devices: Optional[int] = None,
+                    **_) -> DeviceRunResult:
+    from pydcop_tpu.algorithms.mgm import lexic_ranks
+
+    params = algo_def.params
+    pad_to = mesh.size if mesh is not None else (n_devices or 1)
+    graph, meta = compile_dcop(dcop, pad_to=pad_to)
+    fn = partial(
+        run_gdba,
+        max_cycles=max_cycles,
+        modifier_mode=params.get("modifier", "A"),
+        violation_mode=params.get("violation", "NZ"),
+        increase_mode=params.get("increase_mode", "E"),
+        lexic_ranks=lexic_ranks(meta),
+        seed=params.get("seed", 0),
+    )
+    return run_device_fn(graph, meta, fn, mesh=mesh, n_devices=n_devices)

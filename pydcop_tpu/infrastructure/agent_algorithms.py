@@ -537,7 +537,7 @@ def build(algo_name: str, comp_def):
         if isinstance(node, VariableComputationNode):
             return MaxSumVariableComputation(comp_def)
         raise TypeError(f"Unsupported node for maxsum: {node}")
-    if algo_name in ("dsa", "adsa", "dsatuto", "mixeddsa"):
+    if algo_name in ("dsa", "adsa", "dsatuto"):
         return DsaComputation(comp_def)
     if algo_name == "mgm":
         return MgmComputation(comp_def)

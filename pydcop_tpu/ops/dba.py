@@ -1,0 +1,182 @@
+"""DBA (Distributed Breakout Algorithm) step kernel.
+
+Reference parity: pydcop/algorithms/dba.py:272-595 (Yokoo & Hirayama
+1996 semantics).  DBA is a constraint-*satisfaction* local search: the
+objective is the weighted count of violated constraints (violated =
+cost >= `infinity`), with per-(variable, constraint) breakout weights
+that start at 1 and increase when a neighborhood is stuck in a
+quasi-local minimum.
+
+One lockstep cycle = the reference's ok-phase + improve-phase:
+
+- each variable computes its weighted violation count for every
+  candidate value, with neighbors fixed at previous-cycle values
+  (compute_eval_value, dba.py:452), and its best improvement
+  (_compute_best_improvement :424);
+- improvements are exchanged; a variable moves iff its improvement is
+  positive and strictly largest in its neighborhood, lexically-smallest
+  name winning ties (dba.py:507-517);
+- a neighborhood where nobody can improve is a quasi-local minimum: its
+  variables increase their own weights of currently-violated constraints
+  by 1 (breakout, dba.py:553-565);
+- termination detection: each variable tracks a counter, reset when its
+  own eval is non-zero (dba.py:405), set to the min of its neighbors'
+  counters (:509), incremented while the whole neighborhood is
+  consistent (:541); the run stops when any counter reaches
+  `max_distance` (the reference then broadcasts DbaEndMessage, :545).
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.engine.compile import CompiledFactorGraph
+from pydcop_tpu.ops.localsearch import (
+    _fix_other_axes,
+    factor_current_costs,
+    neighbor_max,
+    neighborhood_winners,
+    random_initial_values,
+)
+
+
+class DbaState(NamedTuple):
+    values: jnp.ndarray             # [V+1] int32
+    weights: Tuple[jnp.ndarray, ...]  # per bucket [F, arity] f32
+    term_counter: jnp.ndarray       # [V+1] f32
+    key: jnp.ndarray
+    cycle: jnp.ndarray
+
+
+def init_state(graph: CompiledFactorGraph, seed: int = 0) -> DbaState:
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    return DbaState(
+        values=random_initial_values(k0, graph),
+        weights=tuple(
+            jnp.ones(b.var_ids.shape, dtype=jnp.float32)
+            for b in graph.buckets
+        ),
+        term_counter=jnp.zeros(
+            (graph.var_costs.shape[0],), dtype=jnp.float32
+        ),
+        key=key,
+        cycle=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def _weighted_violation_counts(graph: CompiledFactorGraph,
+                               weights: Tuple[jnp.ndarray, ...],
+                               values: jnp.ndarray,
+                               infinity: float) -> jnp.ndarray:
+    """[V+1, D]: per variable and candidate value, the weighted count of
+    incident violated constraints, neighbors at `values`
+    (compute_eval_value, dba.py:452 — constraints only, no unary costs)."""
+    n_segments = graph.var_costs.shape[0]
+    cand = jnp.zeros_like(graph.var_costs)
+    for bucket, w in zip(graph.buckets, weights):
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            fixed = _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
+            viol = (fixed >= infinity).astype(jnp.float32)
+            cand = cand + jax.ops.segment_sum(
+                w[:, p:p + 1] * viol, bucket.var_ids[:, p],
+                num_segments=n_segments,
+            )
+    return cand
+
+
+def violation_count(graph: CompiledFactorGraph, values: jnp.ndarray,
+                    infinity: float) -> jnp.ndarray:
+    """Scalar unweighted count of violated constraints — DBA's solution
+    quality measure (a consistent assignment has count 0)."""
+    total = jnp.asarray(0.0, dtype=jnp.float32)
+    for cur in factor_current_costs(graph, values):
+        total = total + jnp.sum((cur >= infinity).astype(jnp.float32))
+    return total
+
+
+def dba_step(state: DbaState, graph: CompiledFactorGraph, *,
+             infinity: float, lexic_ranks: jnp.ndarray) -> DbaState:
+    """One lockstep DBA cycle (ok + improve phases)."""
+    key, k_choice = jax.random.split(state.key)
+    values = state.values
+
+    cand = _weighted_violation_counts(
+        graph, state.weights, values, infinity
+    )
+    cur_eval = jnp.take_along_axis(cand, values[:, None], axis=1).squeeze(1)
+    improve, proposed, nmax, wins = neighborhood_winners(
+        graph, cand, values, k_choice, lexic_ranks
+    )
+    new_vals = jnp.where(improve > 0, proposed, values)
+    can_move = (improve > 0) & wins
+    # Quasi-local minimum: nobody in the neighborhood (self included)
+    # can improve (dba.py:409-414, cleared at :514).
+    qlm = (improve <= 0) & (nmax <= improve)
+
+    # Consistency: own eval zero and every neighbor's eval zero
+    # (dba.py:403-407 own, :518-519 via improve messages).
+    n_eval_max = neighbor_max(graph, cur_eval)
+    consistent = (cur_eval == 0) & (n_eval_max <= 0)
+
+    # Termination counters (dba.py:405 reset, :509 neighbor-min, :541 inc).
+    tc = jnp.where(cur_eval == 0, state.term_counter, 0.0)
+    n_tc_min = -neighbor_max(graph, -tc)
+    tc = jnp.minimum(tc, n_tc_min)
+    tc = jnp.where(consistent, tc + 1.0, tc)
+
+    # Breakout: QLM variables increase their weight of each incident
+    # violated constraint by 1 (dba.py:563-565).
+    cur_viol = tuple(
+        (cur >= infinity) for cur in factor_current_costs(graph, values)
+    )
+    new_weights = []
+    for bucket, w, viol in zip(graph.buckets, state.weights, cur_viol):
+        arity = bucket.var_ids.shape[1]
+        bumps = []
+        for p in range(arity):
+            bump = (qlm[bucket.var_ids[:, p]] & viol).astype(jnp.float32)
+            bumps.append(bump)
+        new_weights.append(w + jnp.stack(bumps, axis=1))
+
+    values = jnp.where(can_move, new_vals, values)
+    return DbaState(
+        values=values,
+        weights=tuple(new_weights),
+        term_counter=tc,
+        key=key,
+        cycle=state.cycle + 1,
+    )
+
+
+def run_dba(graph: CompiledFactorGraph, max_cycles: int, *,
+            infinity: float = 10000.0, max_distance: int = 50,
+            lexic_ranks: jnp.ndarray, seed: int = 0,
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full DBA run in one XLA program.
+
+    Returns (values [V], final unweighted violation count, cycles).
+    Stops early when *every* variable's termination counter reaches
+    `max_distance` — the lockstep analogue of the reference's run
+    ending once all computations have finished (a DbaEndMessage only
+    propagates within a connected component, dba.py:576-590, and the
+    orchestrator waits for all of them); stopping on *any* counter
+    would let an unconstrained variable or an early-satisfied component
+    abort components that still have violations."""
+    state = init_state(graph, seed)
+
+    def cond(s: DbaState):
+        return (s.cycle < max_cycles) & ~jnp.all(
+            s.term_counter[:-1] >= max_distance
+        )
+
+    def body(s: DbaState):
+        return dba_step(
+            s, graph, infinity=infinity, lexic_ranks=lexic_ranks
+        )
+
+    state = jax.lax.while_loop(cond, body, state)
+    cost = violation_count(graph, state.values, infinity)
+    return state.values[:-1], cost, state.cycle

@@ -1,0 +1,216 @@
+"""GDBA (Generalized Distributed Breakout) step kernel.
+
+Reference parity: pydcop/algorithms/gdba.py:189-654 (Okamoto et al.
+generalized breakout).  Unlike DBA, GDBA works on *optimization*
+problems: each variable keeps, for every incident constraint, a
+*modifier hypercube* the same shape as the constraint's cost table
+(reference `__constraints_modifiers__`, gdba.py:277-279 — a dict keyed
+by assignment; here a dense tensor).  The effective cost of an entry is
+``base + modifier`` (modifier mode A) or ``base * modifier`` (mode M)
+(_eff_cost, gdba.py:574-597).
+
+One lockstep cycle (ok + improve phases, gdba.py:352-540):
+
+- candidate evaluation uses effective costs with neighbors at
+  previous-cycle values, plus unary variable costs (compute_eval_value
+  :428 — the reference re-adds unary costs once per constraint due to
+  an accumulation quirk; we add them exactly once);
+- a variable moves iff its improvement is positive and largest in its
+  neighborhood, lexically-smallest name winning ties (break_ties
+  :657 picks the sorted-first name);
+- when nobody in the neighborhood can improve (max improve == 0), each
+  variable increases modifiers of its *violated* incident constraints
+  (_increase_cost :627); violation is judged on base costs at the
+  current assignment per `violation` mode (gdba.py:552-571):
+  NZ: cost != 0, NM: cost != constraint minimum, MX: cost == maximum;
+- which modifier entries increase depends on `increase_mode`
+  (gdba.py:627-654): E: the current-assignment entry; R: all values of
+  the own variable, others fixed; C: own value fixed, all assignments
+  of the others (the reference keys C-entries with out-of-scope
+  variables so they are never read back — we use the documented
+  intent); T: every entry.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.engine.compile import CompiledFactorGraph
+from pydcop_tpu.ops.localsearch import (
+    _fix_other_axes,
+    assignment_cost,
+    factor_current_costs,
+    factor_max_over_valid,
+    factor_min_over_valid,
+    factor_valid_masks,
+    neighborhood_winners,
+    random_initial_values,
+)
+
+
+class GdbaState(NamedTuple):
+    values: jnp.ndarray                 # [V+1] int32
+    modifiers: Tuple[jnp.ndarray, ...]  # per bucket [F, arity, D^arity]
+    key: jnp.ndarray
+    cycle: jnp.ndarray
+
+
+def init_state(graph: CompiledFactorGraph, modifier: str = "A",
+               seed: int = 0) -> GdbaState:
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    base = 0.0 if modifier == "A" else 1.0  # gdba.py:247
+    modifiers = tuple(
+        jnp.full(
+            (b.n_factors, b.arity) + b.costs.shape[1:], base,
+            dtype=jnp.float32,
+        )
+        for b in graph.buckets
+    )
+    return GdbaState(
+        values=random_initial_values(k0, graph),
+        modifiers=modifiers,
+        key=key,
+        cycle=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def factor_min_max(graph: CompiledFactorGraph
+                   ) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]:
+    """Per bucket: (min [F], max [F]) of each factor's base costs over
+    the *valid* region (padded domain slots hold BIG and must not win
+    the max) — reference records these at init (gdba.py:252-273)."""
+    return tuple(
+        (factor_min_over_valid(bucket, valid),
+         factor_max_over_valid(bucket, valid))
+        for bucket, valid in zip(graph.buckets, factor_valid_masks(graph))
+    )
+
+
+def _candidate_eff_costs(graph: CompiledFactorGraph,
+                         modifiers: Tuple[jnp.ndarray, ...],
+                         values: jnp.ndarray,
+                         modifier_mode: str) -> jnp.ndarray:
+    """[V+1, D]: effective cost per variable and candidate value, others
+    at `values` (compute_eval_value + _eff_cost, gdba.py:428-461)."""
+    n_segments = graph.var_costs.shape[0]
+    cand = graph.var_costs
+    for bucket, mods in zip(graph.buckets, modifiers):
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            if modifier_mode == "A":
+                eff = bucket.costs + mods[:, p]
+            else:
+                eff = bucket.costs * mods[:, p]
+            fixed = _fix_other_axes(eff, bucket.var_ids, values, p)
+            cand = cand + jax.ops.segment_sum(
+                fixed, bucket.var_ids[:, p], num_segments=n_segments
+            )
+    return cand
+
+
+def _increase_delta(bucket, values: jnp.ndarray, mask: jnp.ndarray,
+                    p: int, increase_mode: str) -> jnp.ndarray:
+    """[F, D^arity] one-increment tensor for position p's modifier:
+    outer product over axes of one-hot(current value) or ones, gated by
+    `mask` (gdba.py:627-654)."""
+    arity = bucket.var_ids.shape[1]
+    dmax = bucket.costs.shape[1]
+    out = mask.astype(jnp.float32)  # [F]
+    for q in range(arity):
+        if increase_mode == "T":
+            hot = False
+        elif increase_mode == "E":
+            hot = True
+        elif increase_mode == "R":
+            hot = q != p     # own axis free, others at current
+        else:  # "C"
+            hot = q == p     # own axis at current, others free
+        if hot:
+            wq = jax.nn.one_hot(
+                values[bucket.var_ids[:, q]], dmax, dtype=jnp.float32
+            )
+        else:
+            wq = jnp.ones((bucket.n_factors, dmax), dtype=jnp.float32)
+        shape = (bucket.n_factors,) + (1,) * q + (dmax,)
+        out = out[..., None] * wq.reshape(shape)
+    return out
+
+
+def gdba_step(state: GdbaState, graph: CompiledFactorGraph, *,
+              modifier_mode: str, violation_mode: str, increase_mode: str,
+              minmax: Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...],
+              lexic_ranks: jnp.ndarray) -> GdbaState:
+    """One lockstep GDBA cycle (ok + improve phases)."""
+    key, k_choice = jax.random.split(state.key)
+    values = state.values
+
+    cand = _candidate_eff_costs(
+        graph, state.modifiers, values, modifier_mode
+    )
+    improve, proposed, nmax, wins = neighborhood_winners(
+        graph, cand, values, k_choice, lexic_ranks
+    )
+    new_vals = jnp.where(improve > 0, proposed, values)
+    can_move = (improve > 0) & wins
+    # Breakout condition: nobody in the neighborhood can improve
+    # (gdba.py:529 `elif maxi == 0`; improvements are non-negative).
+    stuck = (improve <= 0) & (nmax <= 0)
+
+    # Violation on *base* costs at the current assignment (gdba.py:552).
+    cur_costs = factor_current_costs(graph, values)
+    new_modifiers = []
+    for bucket, mods, cur, (fmin, fmax) in zip(
+        graph.buckets, state.modifiers, cur_costs, minmax
+    ):
+        if violation_mode == "NZ":
+            violated = cur != 0
+        elif violation_mode == "NM":
+            violated = cur != fmin
+        else:  # "MX"
+            violated = cur == fmax
+        arity = bucket.var_ids.shape[1]
+        deltas = []
+        for p in range(arity):
+            mask = stuck[bucket.var_ids[:, p]] & violated
+            deltas.append(
+                _increase_delta(bucket, values, mask, p, increase_mode)
+            )
+        new_modifiers.append(mods + jnp.stack(deltas, axis=1))
+
+    values = jnp.where(can_move, new_vals, values)
+    return GdbaState(
+        values=values,
+        modifiers=tuple(new_modifiers),
+        key=key,
+        cycle=state.cycle + 1,
+    )
+
+
+def run_gdba(graph: CompiledFactorGraph, max_cycles: int, *,
+             modifier_mode: str = "A", violation_mode: str = "NZ",
+             increase_mode: str = "E", lexic_ranks: jnp.ndarray,
+             seed: int = 0,
+             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full GDBA run in one XLA program.
+
+    Returns (values [V], final *base* assignment cost, cycles) — the
+    modifiers only steer the search; solution quality is judged on real
+    costs."""
+    state = init_state(graph, modifier=modifier_mode, seed=seed)
+    minmax = factor_min_max(graph)
+    state = jax.lax.fori_loop(
+        0, max_cycles,
+        lambda i, s: gdba_step(
+            s, graph,
+            modifier_mode=modifier_mode,
+            violation_mode=violation_mode,
+            increase_mode=increase_mode,
+            minmax=minmax,
+            lexic_ranks=lexic_ranks,
+        ),
+        state,
+    )
+    cost = assignment_cost(graph, state.values)
+    return state.values[:-1], cost, state.cycle

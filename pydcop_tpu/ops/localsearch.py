@@ -144,6 +144,64 @@ def neighbor_min_rank_where(graph: CompiledFactorGraph,
     return out
 
 
+def factor_valid_masks(graph: CompiledFactorGraph
+                       ) -> Tuple[jnp.ndarray, ...]:
+    """Per bucket: [F, D^arity] bool — the valid region of each factor's
+    cost table (outer product of its variables' valid domain slots).
+    Padding rows point at the all-invalid sentinel row, so their region
+    is empty."""
+    out = []
+    for bucket in graph.buckets:
+        arity = bucket.var_ids.shape[1]
+        valid = jnp.ones((bucket.n_factors,), dtype=bool)
+        for q in range(arity):
+            vq = graph.var_valid[bucket.var_ids[:, q]]  # [F, D]
+            shape = (bucket.n_factors,) + (1,) * q + (vq.shape[1],)
+            valid = valid[..., None] & vq.reshape(shape)
+        out.append(valid)
+    return tuple(out)
+
+
+def factor_min_over_valid(bucket, valid: jnp.ndarray) -> jnp.ndarray:
+    """[F]: each factor's min cost over its valid region (+inf when
+    empty — padding rows)."""
+    axes = tuple(range(1, bucket.costs.ndim))
+    return jnp.min(jnp.where(valid, bucket.costs, jnp.inf), axis=axes)
+
+
+def factor_max_over_valid(bucket, valid: jnp.ndarray) -> jnp.ndarray:
+    """[F]: each factor's max cost over its valid region (-inf when
+    empty)."""
+    axes = tuple(range(1, bucket.costs.ndim))
+    return jnp.max(jnp.where(valid, bucket.costs, -jnp.inf), axis=axes)
+
+
+def neighborhood_winners(graph: CompiledFactorGraph, cand: jnp.ndarray,
+                         values: jnp.ndarray, key: jnp.ndarray,
+                         ranks: jnp.ndarray
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                    jnp.ndarray]:
+    """Shared evaluate → propose → tie-break step of the breakout/MGM
+    family (mgm.py:515-590, dba.py:507-517, gdba.py:505-527).
+
+    Given per-candidate costs `cand` [V+1, D], returns
+    (improve, proposed, nmax, wins):
+    - improve [V+1]: current cost minus best candidate cost (>= 0);
+    - proposed [V+1]: uniform-random choice among best candidates;
+    - nmax [V+1]: max improvement among neighbors;
+    - wins [V+1]: strictly-largest improvement in the neighborhood,
+      lexically-smallest `ranks` winning ties.
+    """
+    cur = jnp.take_along_axis(cand, values[:, None], axis=1).squeeze(1)
+    best, is_best = best_candidates(graph, cand)
+    improve = cur - best
+    proposed = random_best_choice(key, is_best)
+    nmax = neighbor_max(graph, improve)
+    nrank = neighbor_min_rank_where(graph, improve, improve, ranks)
+    wins = (improve > nmax) | ((improve == nmax) & (ranks < nrank))
+    return improve, proposed, nmax, wins
+
+
 def best_candidates(graph: CompiledFactorGraph, cand: jnp.ndarray
                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(best_cost [V+1], is_best [V+1, D]) over valid domain slots."""

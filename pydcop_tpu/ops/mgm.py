@@ -24,11 +24,8 @@ import jax.numpy as jnp
 from pydcop_tpu.engine.compile import CompiledFactorGraph
 from pydcop_tpu.ops.localsearch import (
     assignment_cost,
-    best_candidates,
     candidate_costs,
-    neighbor_max,
-    neighbor_min_rank_where,
-    random_best_choice,
+    neighborhood_winners,
     random_initial_values,
 )
 
@@ -55,23 +52,17 @@ def mgm_step(state: MgmState, graph: CompiledFactorGraph, *,
     key, k_choice, k_rand = jax.random.split(state.key, 3)
     values = state.values
 
-    cand = candidate_costs(graph, values)                 # [V+1, D]
-    cur = jnp.take_along_axis(cand, values[:, None], axis=1).squeeze(1)
-    best, is_best = best_candidates(graph, cand)
-    gain = cur - best                                     # >= 0
-
-    proposed = random_best_choice(k_choice, is_best)
-    new_vals = jnp.where(gain > 0, proposed, values)
-
     if break_mode == "random":
         # Fresh draw every cycle (reference :547-553 random_nb).
-        ranks = jax.random.uniform(k_rand, gain.shape)
+        ranks = jax.random.uniform(k_rand, values.shape)
     else:
         ranks = lexic_ranks
 
-    nmax = neighbor_max(graph, gain)
-    nrank = neighbor_min_rank_where(graph, gain, gain, ranks)
-    wins = (gain > nmax) | ((gain == nmax) & (ranks < nrank))
+    cand = candidate_costs(graph, values)                 # [V+1, D]
+    gain, proposed, _, wins = neighborhood_winners(
+        graph, cand, values, k_choice, ranks
+    )
+    new_vals = jnp.where(gain > 0, proposed, values)
     values = jnp.where(wins, new_vals, values)
     return MgmState(values=values, key=key, cycle=state.cycle + 1)
 

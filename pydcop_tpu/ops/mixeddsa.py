@@ -1,0 +1,193 @@
+"""MixedDSA step kernel — DSA for problems mixing hard and soft
+constraints.
+
+Reference parity: pydcop/algorithms/mixeddsa.py:154-470.  A constraint
+is *hard* when its table contains an infinite cost (mixeddsa.py:215-222
+detects ``float('inf')`` while scanning assignments); in the compiled
+graph any entry >= BIG (the framework's infinity stand-in) counts.
+
+Per cycle each variable evaluates candidates lexicographically:
+first minimize the number of violated hard constraints, then the DCOP
+cost *excluding* violated hard constraints' infinities
+(_compute_dcop_cost :410, _compute_best_value :381).  Moves
+(mixeddsa.py:301-345):
+
+- hard improvement possible (delta_dcsp > 0): move w.p. `proba_hard`;
+- only soft improvement (delta_dcsp == 0, delta_dcop > 0): move w.p.
+  `proba_soft`;
+- no improvement but hard conflicts remain and other optimal values
+  exist: move to a different optimum w.p. `proba_hard` (escape, :317);
+- no improvement, no hard conflict, but a violated soft constraint
+  (cost above its own optimum) and variant B/C: move to a different
+  optimum w.p. `proba_soft` (:330).
+
+(The reference's final variant-C branch duplicates an earlier elif
+condition and is unreachable; it is intentionally not reproduced.)
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.engine.compile import BIG, CompiledFactorGraph
+from pydcop_tpu.ops.localsearch import (
+    _fix_other_axes,
+    assignment_cost,
+    factor_current_costs,
+    factor_min_over_valid,
+    factor_valid_masks,
+    random_best_choice,
+    random_initial_values,
+)
+
+
+class MixedDsaState(NamedTuple):
+    values: jnp.ndarray  # [V+1] int32
+    key: jnp.ndarray
+    cycle: jnp.ndarray
+
+
+def init_state(graph: CompiledFactorGraph, seed: int = 0) -> MixedDsaState:
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    return MixedDsaState(
+        values=random_initial_values(k0, graph),
+        key=key,
+        cycle=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def classify_factors(graph: CompiledFactorGraph
+                     ) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]:
+    """Per bucket: (hard [F] bool, soft_optimum [F]).
+
+    hard = some valid entry is infinite (>= BIG); soft_optimum = the
+    factor's min over the valid region (the reference's boundary,
+    mixeddsa.py:209-224), used to detect violated soft constraints.
+    Padding rows (all-BIG valid region is empty via the sentinel var's
+    all-False validity) come out hard=False, optimum=+inf and are
+    harmless: their cost rows are zero.
+    """
+    out = []
+    for bucket, valid in zip(graph.buckets, factor_valid_masks(graph)):
+        axes = tuple(range(1, bucket.costs.ndim))
+        hard = jnp.any(valid & (bucket.costs >= BIG), axis=axes)
+        opt = factor_min_over_valid(bucket, valid)
+        out.append((hard, opt))
+    return tuple(out)
+
+
+def _candidate_metrics(graph: CompiledFactorGraph, values: jnp.ndarray,
+                       classes) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(nb_viol [V+1, D], cost [V+1, D]): per candidate value, the count
+    of violated hard constraints and the DCOP cost without their
+    infinities (_compute_dcop_cost, mixeddsa.py:410-446)."""
+    n_segments = graph.var_costs.shape[0]
+    nb_viol = jnp.zeros_like(graph.var_costs)
+    cost = graph.var_costs
+    for bucket, (hard, _) in zip(graph.buckets, classes):
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            fixed = _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
+            viol = hard[:, None] & (fixed >= BIG)
+            nb_viol = nb_viol + jax.ops.segment_sum(
+                viol.astype(jnp.float32), bucket.var_ids[:, p],
+                num_segments=n_segments,
+            )
+            cost = cost + jax.ops.segment_sum(
+                jnp.where(viol, 0.0, fixed), bucket.var_ids[:, p],
+                num_segments=n_segments,
+            )
+    return nb_viol, cost
+
+
+def _soft_violated_vars(graph: CompiledFactorGraph, values: jnp.ndarray,
+                        classes) -> jnp.ndarray:
+    """[V+1] bool: has an incident soft constraint above its optimum
+    (exists_violated_soft_constraint, mixeddsa.py:464)."""
+    n_segments = graph.var_costs.shape[0]
+    out = jnp.zeros((n_segments,), dtype=jnp.int32)
+    for bucket, cur, (hard, opt) in zip(
+        graph.buckets, factor_current_costs(graph, values), classes
+    ):
+        sv = ((~hard) & (cur != opt)).astype(jnp.int32)
+        for p in range(bucket.var_ids.shape[1]):
+            out = jnp.maximum(out, jax.ops.segment_max(
+                sv, bucket.var_ids[:, p], num_segments=n_segments
+            ))
+    return out > 0
+
+
+def mixeddsa_step(state: MixedDsaState, graph: CompiledFactorGraph, *,
+                  variant: str, proba_hard: float, proba_soft: float,
+                  classes) -> MixedDsaState:
+    """One lockstep MixedDSA cycle."""
+    key, k_choice, k_change = jax.random.split(state.key, 3)
+    values = state.values
+    valid = graph.var_valid
+
+    nb_viol, cost = _candidate_metrics(graph, values, classes)
+    cur_nb = jnp.take_along_axis(nb_viol, values[:, None], axis=1).squeeze(1)
+    cur_cost = jnp.take_along_axis(cost, values[:, None], axis=1).squeeze(1)
+
+    # Lexicographic best: fewest violated hard constraints, then cost
+    # (_compute_best_value, mixeddsa.py:381-402).
+    min_nb = jnp.min(jnp.where(valid, nb_viol, jnp.inf), axis=1)
+    tie = valid & (nb_viol == min_nb[:, None])
+    best_cost = jnp.min(jnp.where(tie, cost, jnp.inf), axis=1)
+    is_best = tie & (cost == best_cost[:, None])
+    n_best = jnp.sum(is_best, axis=1)
+
+    delta_dcsp = cur_nb - min_nb
+    delta_dcop = cur_cost - best_cost
+
+    one_hot_cur = (
+        jnp.arange(cost.shape[1])[None, :] == values[:, None]
+    )
+    alt_best = is_best & ~one_hot_cur  # bests minus current value
+
+    soft_viol = _soft_violated_vars(graph, values, classes)
+    variant_bc = variant in ("B", "C")
+
+    b_hard = delta_dcsp > 0
+    b_soft = (delta_dcsp == 0) & (delta_dcop > 0)
+    no_improve = (delta_dcsp == 0) & (delta_dcop == 0)
+    b_escape_hard = no_improve & (min_nb > 0) & (n_best > 1)
+    b_escape_soft = (
+        no_improve & (min_nb == 0) & soft_viol & (n_best > 1)
+        if variant_bc else jnp.zeros_like(b_hard)
+    )
+
+    proba = (
+        jnp.where(b_hard | b_escape_hard, proba_hard, 0.0)
+        + jnp.where(b_soft | b_escape_soft, proba_soft, 0.0)
+    )
+    escape = b_escape_hard | b_escape_soft
+    choice_mask = jnp.where(escape[:, None], alt_best, is_best)
+
+    new_vals = random_best_choice(k_choice, choice_mask)
+    u = jax.random.uniform(k_change, (values.shape[0],))
+    values = jnp.where(u < proba, new_vals, values)
+    return MixedDsaState(values=values, key=key, cycle=state.cycle + 1)
+
+
+def run_mixeddsa(graph: CompiledFactorGraph, max_cycles: int, *,
+                 variant: str = "B", proba_hard: float = 0.7,
+                 proba_soft: float = 0.5, seed: int = 0,
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full MixedDSA run in one XLA program.
+
+    Returns (values [V], final cost incl. hard infinities, cycles)."""
+    state = init_state(graph, seed)
+    classes = classify_factors(graph)
+    state = jax.lax.fori_loop(
+        0, max_cycles,
+        lambda i, s: mixeddsa_step(
+            s, graph, variant=variant, proba_hard=proba_hard,
+            proba_soft=proba_soft, classes=classes,
+        ),
+        state,
+    )
+    cost = assignment_cost(graph, state.values)
+    return state.values[:-1], cost, state.cycle

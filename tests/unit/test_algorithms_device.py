@@ -179,3 +179,138 @@ def brute_force_sample(dcop, n=2000, seed=0):
         if cost < best:
             best, best_asst = cost, asst
     return best, best_asst
+
+
+def coloring_csp(n_vars=10, d=3, infinity=10000.0, seed=0,
+                 extra_soft=False):
+    """Ring + random chords graph coloring: equal colors cost
+    `infinity`, else 0 (a DBA-style CSP; 3-colorable for sparse rings).
+    With extra_soft, adds small random soft preferences."""
+    rng = np.random.default_rng(seed)
+    dom = Domain("colors", "color", list(range(d)))
+    variables = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    dcop = DCOP("csp", objective="min")
+    eq = np.where(np.eye(d) > 0, infinity, 0.0)
+    edges = [(i, (i + 1) % n_vars) for i in range(n_vars)]
+    for k in range(n_vars // 3):
+        i, j = rng.choice(n_vars, size=2, replace=False)
+        if (i, j) not in edges and (j, i) not in edges:
+            edges.append((i, j))
+    for k, (i, j) in enumerate(edges):
+        dcop.add_constraint(NAryMatrixRelation(
+            [variables[i], variables[j]], eq, f"c{k}"))
+    if extra_soft:
+        for k, (i, j) in enumerate(edges[: n_vars // 2]):
+            table = rng.random((d, d))
+            dcop.add_constraint(NAryMatrixRelation(
+                [variables[i], variables[j]], table, f"s{k}"))
+    return dcop
+
+
+class TestDba:
+    def test_solves_colorable_csp(self):
+        dcop = coloring_csp(n_vars=12, d=3, seed=0)
+        res = solve(dcop, "dba", max_cycles=200)
+        # All constraints satisfied: no pair at cost 10000.
+        assert res["cost"] == 0
+
+    def test_breakout_escapes_local_minima(self):
+        # Denser problem where plain best-response can get stuck.
+        dcop = coloring_csp(n_vars=20, d=3, seed=1)
+        res = solve(dcop, "dba", max_cycles=400,
+                    algo_params={"seed": 3})
+        assert res["cost"] == 0
+
+    def test_early_termination(self):
+        dcop = coloring_csp(n_vars=8, d=3, seed=2)
+        res = solve(dcop, "dba", max_cycles=1000,
+                    algo_params={"max_distance": 8})
+        # Stops via the termination counter well before max_cycles.
+        assert res["cycles"] < 1000
+        assert res["cost"] == 0
+
+    def test_rejects_max_mode(self):
+        dcop = random_dcop(seed=3, objective="max")
+        with pytest.raises(ValueError):
+            solve(dcop, "dba", max_cycles=10)
+
+    def test_deterministic_given_seed(self):
+        dcop = coloring_csp(n_vars=10, seed=4)
+        r1 = solve(dcop, "dba", max_cycles=50, algo_params={"seed": 7})
+        r2 = solve(dcop, "dba", max_cycles=50, algo_params={"seed": 7})
+        assert r1["assignment"] == r2["assignment"]
+
+    def test_isolated_variable_does_not_abort_run(self):
+        # Regression: an unconstrained variable's termination counter
+        # must not stop components that still have violations.
+        dcop = coloring_csp(n_vars=20, d=3, seed=5)
+        dom = Domain("d", "", [0, 1, 2])
+        dcop.add_variable(Variable("lonely", dom))
+        res = solve(dcop, "dba", max_cycles=400,
+                    algo_params={"max_distance": 10})
+        assert res["cost"] == 0
+
+
+class TestGdba:
+    def test_reaches_reasonable_quality(self):
+        dcop = random_dcop(seed=20, n_vars=15, n_constraints=25)
+        sampled, _ = brute_force_sample(dcop)
+        res = solve(dcop, "gdba", max_cycles=100)
+        assert res["violations"] == 0
+        assert res["cost"] <= sampled * 2 + 10
+
+    @pytest.mark.parametrize("modifier", ["A", "M"])
+    @pytest.mark.parametrize("violation", ["NZ", "NM", "MX"])
+    def test_modifier_violation_modes(self, modifier, violation):
+        dcop = random_dcop(seed=21, n_vars=8, n_constraints=12)
+        res = solve(dcop, "gdba", max_cycles=30, algo_params={
+            "modifier": modifier, "violation": violation})
+        assert res["assignment"]
+
+    @pytest.mark.parametrize("mode", ["E", "R", "C", "T"])
+    def test_increase_modes(self, mode):
+        dcop = random_dcop(seed=22, n_vars=8, n_constraints=12)
+        res = solve(dcop, "gdba", max_cycles=30,
+                    algo_params={"increase_mode": mode})
+        assert res["assignment"]
+
+    def test_arity3(self):
+        dcop = random_dcop(seed=23, arity3=True)
+        res = solve(dcop, "gdba", max_cycles=30)
+        assert res["assignment"]
+
+    def test_cost_reported_on_base_costs(self):
+        dcop = random_dcop(seed=24)
+        res = solve(dcop, "gdba", max_cycles=50)
+        assert res["metrics"]["device_cost"] == pytest.approx(
+            res["cost"], rel=1e-5)
+
+
+class TestMixedDsa:
+    def test_satisfies_hard_and_optimizes_soft(self):
+        dcop = coloring_csp(n_vars=12, d=3, seed=30,
+                            infinity=float("inf"), extra_soft=True)
+        res = solve(dcop, "mixeddsa", max_cycles=200)
+        assert res["violations"] == 0
+
+    @pytest.mark.parametrize("variant", ["A", "B", "C"])
+    def test_variants(self, variant):
+        dcop = coloring_csp(n_vars=10, d=3, seed=31,
+                            infinity=float("inf"), extra_soft=True)
+        res = solve(dcop, "mixeddsa", max_cycles=100,
+                    algo_params={"variant": variant})
+        assert res["assignment"]
+
+    def test_soft_only_behaves_like_dsa(self):
+        dcop = random_dcop(seed=32, n_vars=15, n_constraints=25)
+        sampled, _ = brute_force_sample(dcop)
+        res = solve(dcop, "mixeddsa", max_cycles=100)
+        assert res["cost"] <= sampled * 2 + 10
+
+    def test_deterministic_given_seed(self):
+        dcop = coloring_csp(n_vars=10, seed=33, infinity=float("inf"))
+        r1 = solve(dcop, "mixeddsa", max_cycles=40,
+                   algo_params={"seed": 9})
+        r2 = solve(dcop, "mixeddsa", max_cycles=40,
+                   algo_params={"seed": 9})
+        assert r1["assignment"] == r2["assignment"]
