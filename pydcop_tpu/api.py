@@ -71,12 +71,17 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         )
 
     if backend == "thread":
-        try:
-            from pydcop_tpu.infrastructure.run import solve_with_agents
-        except ModuleNotFoundError:
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            has_agent_computation,
+        )
+        from pydcop_tpu.infrastructure.run import solve_with_agents
+
+        # Reject before deployment rather than crashing mid-run on the
+        # first build_computation call.
+        if not has_agent_computation(algo_def.algo):
             raise NotImplementedError(
-                "thread backend not available yet (agent runtime under "
-                "construction); use backend='device'"
+                f"Algorithm {algo_def.algo!r} has no agent-mode "
+                "computation yet; use backend='device'"
             )
 
         # Bound non-terminating algorithms: without an explicit timeout a

@@ -524,6 +524,17 @@ class MgmComputation(VariableComputation):
 # Registry
 
 
+# Algorithms with an agent-mode (message-passing) computation; others
+# are device-engine only for now and rejected up front.
+AGENT_MODE_ALGOS = frozenset(
+    {"maxsum", "amaxsum", "dsa", "adsa", "dsatuto", "mgm"}
+)
+
+
+def has_agent_computation(algo_name: str) -> bool:
+    return algo_name in AGENT_MODE_ALGOS
+
+
 def build(algo_name: str, comp_def):
     from pydcop_tpu.computations_graph.factor_graph import (
         FactorComputationNode,

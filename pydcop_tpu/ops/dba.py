@@ -110,7 +110,6 @@ def dba_step(state: DbaState, graph: CompiledFactorGraph, *,
     improve, proposed, nmax, wins = neighborhood_winners(
         graph, cand, values, k_choice, lexic_ranks
     )
-    new_vals = jnp.where(improve > 0, proposed, values)
     can_move = (improve > 0) & wins
     # Quasi-local minimum: nobody in the neighborhood (self included)
     # can improve (dba.py:409-414, cleared at :514).
@@ -141,7 +140,7 @@ def dba_step(state: DbaState, graph: CompiledFactorGraph, *,
             bumps.append(bump)
         new_weights.append(w + jnp.stack(bumps, axis=1))
 
-    values = jnp.where(can_move, new_vals, values)
+    values = jnp.where(can_move, proposed, values)
     return DbaState(
         values=values,
         weights=tuple(new_weights),

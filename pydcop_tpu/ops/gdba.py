@@ -152,7 +152,6 @@ def gdba_step(state: GdbaState, graph: CompiledFactorGraph, *,
     improve, proposed, nmax, wins = neighborhood_winners(
         graph, cand, values, k_choice, lexic_ranks
     )
-    new_vals = jnp.where(improve > 0, proposed, values)
     can_move = (improve > 0) & wins
     # Breakout condition: nobody in the neighborhood can improve
     # (gdba.py:529 `elif maxi == 0`; improvements are non-negative).
@@ -179,7 +178,7 @@ def gdba_step(state: GdbaState, graph: CompiledFactorGraph, *,
             )
         new_modifiers.append(mods + jnp.stack(deltas, axis=1))
 
-    values = jnp.where(can_move, new_vals, values)
+    values = jnp.where(can_move, proposed, values)
     return GdbaState(
         values=values,
         modifiers=tuple(new_modifiers),

@@ -1,0 +1,302 @@
+"""MGM2 step kernel — coordinated 2-opt local search.
+
+Reference parity: pydcop/algorithms/mgm2.py:399-1050 (Maheswaran et al.
+2004, 5-phase protocol: value / offer / answer? / gain / go?).  One
+lockstep cycle here performs all five phases with neighbor values from
+the previous cycle:
+
+1. **value**: every variable computes its unilateral best response and
+   gain (mgm2.py:742-779); with probability `threshold` it becomes an
+   *offerer* and picks a random partner among its neighbors (:755-758).
+2. **offer**: an offerer sends its partner all joint (my_value,
+   partner_value) moves that strictly improve its own local view,
+   tagged with its local gain (_compute_offers_to_send :520).
+3. **answer**: a non-offerer picks, among incoming offers, the joint
+   move with the best *global* gain (own delta + partner delta with
+   shared constraints counted once, _find_best_offer :552) and commits
+   to it if that gain beats (or per `favor`, ties with) its unilateral
+   gain (:808-827).  Offerers reject offers they receive (:790).
+4. **gain**: everyone announces its potential gain — the joint gain
+   for committed pairs, the unilateral gain otherwise (:880).
+5. **go**: a committed pair moves iff *both* sides' joint gain beats
+   every other neighbor's announced gain (:889-903 + :941-955);
+   an uncommitted variable moves alone iff its gain is the strict
+   neighborhood max, lexically-smallest name winning ties (:907-935).
+
+Device-form notes (documented divergences, all distribution-level, not
+cost-level):
+
+- partners are drawn uniformly over incident (factor, position) edges
+  rather than distinct neighbor variables — identical unless two
+  variables share several constraints or a constraint has arity > 2;
+- the joint gain counts shared constraints exactly once *for the
+  chosen edge's factor*; additional constraints shared by the same
+  pair are treated as fixed-context (the reference excludes them all;
+  exact for the common one-constraint-per-pair case).  The reference
+  additionally inflates the global gain by the shared constraints'
+  current cost (mgm2.py:577 uses the full current cost while the new
+  cost excludes shared relations); we compute the true joint gain
+  instead.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.engine.compile import CompiledFactorGraph
+from pydcop_tpu.ops.localsearch import (
+    assignment_cost,
+    best_candidates,
+    candidate_costs,
+    neighbor_max,
+    neighbor_min_rank_where,
+    random_best_choice,
+    random_initial_values,
+)
+
+NEG = -jnp.inf
+
+
+class Mgm2State(NamedTuple):
+    values: jnp.ndarray  # [V+1] int32
+    key: jnp.ndarray
+    cycle: jnp.ndarray
+
+
+def init_state(graph: CompiledFactorGraph, seed: int = 0) -> Mgm2State:
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    return Mgm2State(
+        values=random_initial_values(k0, graph),
+        key=key,
+        cycle=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def _fix_two_axes(costs: jnp.ndarray, var_ids: jnp.ndarray,
+                  values: jnp.ndarray, p: int, q: int) -> jnp.ndarray:
+    """Reduce a bucket cost tensor [F, D^arity] to [F, Dp, Dq] by fixing
+    every axis except p and q at its variable's current value."""
+    arity = var_ids.shape[1]
+    out = costs
+    for a in range(arity - 1, -1, -1):
+        if a in (p, q):
+            continue
+        va = values[var_ids[:, a]]
+        idx = va.reshape((-1,) + (1,) * (out.ndim - 1))
+        out = jnp.squeeze(
+            jnp.take_along_axis(out, idx, axis=a + 1), axis=a + 1
+        )
+    if p > q:  # remaining axes are in original order (q before p)
+        out = jnp.swapaxes(out, 1, 2)
+    return out
+
+
+def _families(graph: CompiledFactorGraph):
+    """All ordered (bucket, p, q) position pairs — the directed edge
+    families of the interaction graph."""
+    for bucket in graph.buckets:
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            for q in range(arity):
+                if p != q:
+                    yield bucket, p, q
+
+
+def mgm2_step(state: Mgm2State, graph: CompiledFactorGraph, *,
+              threshold: float, favor: str,
+              lexic_ranks: jnp.ndarray) -> Mgm2State:
+    """One lockstep MGM2 cycle (all 5 phases)."""
+    values = state.values
+    n_seg = graph.var_costs.shape[0]
+    sentinel = n_seg - 1
+    dmax = graph.dmax
+    key, k_uni, k_offer, k_coin, k_fam = jax.random.split(state.key, 5)
+
+    # ---- phase 1: unilateral best response ----------------------------
+    cand = candidate_costs(graph, values)                  # [V+1, D]
+    cur = jnp.take_along_axis(cand, values[:, None], axis=1).squeeze(1)
+    best, is_best = best_candidates(graph, cand)
+    uni_gain = cur - best                                  # >= 0
+    uni_prop = random_best_choice(k_uni, is_best)
+    uni_value = jnp.where(uni_gain > 0, uni_prop, values)
+    g_delta = cur[:, None] - cand                          # [V+1, D]
+
+    is_offerer = (
+        jax.random.uniform(k_offer, (n_seg,)) < threshold
+    ).at[sentinel].set(False)
+
+    # ---- partner selection: random incident edge per offerer ---------
+    fams = list(_families(graph))
+    fam_keys = [jax.random.fold_in(k_fam, i) for i in range(len(fams))]
+    scores = []
+    score_max = jnp.full((n_seg,), NEG)
+    for (bucket, p, q), fk in zip(fams, fam_keys):
+        src, dst = bucket.var_ids[:, p], bucket.var_ids[:, q]
+        real = (src != sentinel) & (dst != sentinel)
+        s = jnp.where(
+            real & is_offerer[src],
+            jax.random.uniform(jax.random.fold_in(fk, 0),
+                               (bucket.n_factors,)),
+            NEG,
+        )
+        scores.append(s)
+        score_max = jnp.maximum(score_max, jax.ops.segment_max(
+            s, src, num_segments=n_seg
+        ))
+
+    # ---- phases 2-3: offers, global gains, acceptance ----------------
+    # Collected per family, then reduced per acceptor variable.
+    acc_best = jnp.full((n_seg,), NEG)      # best incoming global gain
+    fam_results = []
+    for (bucket, p, q), fk, s in zip(fams, fam_keys, scores):
+        src, dst = bucket.var_ids[:, p], bucket.var_ids[:, q]
+        chosen = jnp.isfinite(s) & (s == score_max[src])
+        T = _fix_two_axes(bucket.costs, bucket.var_ids, values, p, q)
+        a_cur, b_cur = values[src], values[dst]
+        t_a = jnp.take_along_axis(T, b_cur[:, None, None].repeat(
+            dmax, axis=1), axis=2).squeeze(2)     # [F, D] T(da, b_cur)
+        t_b = jnp.take_along_axis(T, a_cur[:, None, None].repeat(
+            dmax, axis=2), axis=1).squeeze(1)     # [F, D] T(a_cur, db)
+        t_cur = jnp.take_along_axis(
+            t_a, a_cur[:, None], axis=1
+        ).squeeze(1)                              # [F] T(a_cur, b_cur)
+        # True joint gain (see module docstring):
+        # G(da,db) = gA(da) + gB(db) + T(da,b) + T(a,db) - T(a,b) - T(da,db)
+        G = (
+            g_delta[src][:, :, None] + g_delta[dst][:, None, :]
+            + t_a[:, :, None] + t_b[:, None, :]
+            - t_cur[:, None, None] - T
+        )
+        # Offer condition: the offerer's own local view strictly
+        # improves (mgm2.py:544-549).
+        local_a = cand[src][:, :, None] - t_a[:, :, None] + T
+        offer_ok = local_a < cur[src][:, None, None]
+        valid = (
+            graph.var_valid[src][:, :, None]
+            & graph.var_valid[dst][:, None, :]
+        )
+        G = jnp.where(offer_ok & valid, G, NEG)
+        bestG = jnp.max(G.reshape(bucket.n_factors, -1), axis=1)
+        # Random choice among tied best joint moves (mgm2.py:822).
+        u = jax.random.uniform(
+            jax.random.fold_in(fk, 1), (bucket.n_factors, dmax * dmax)
+        )
+        flat_pick = jnp.argmax(jnp.where(
+            G.reshape(bucket.n_factors, -1) == bestG[:, None], u, -1.0
+        ), axis=1)
+        da, db = flat_pick // dmax, flat_pick % dmax
+        # An offer reaches the acceptor only if the target is not
+        # itself an offerer (offerers reject, mgm2.py:790-797).
+        offered = chosen & ~is_offerer[dst] & (bestG > 0)
+        bestG = jnp.where(offered, bestG, NEG)
+        acc_best = jnp.maximum(acc_best, jax.ops.segment_max(
+            bestG, dst, num_segments=n_seg
+        ))
+        fam_results.append((src, dst, offered, bestG, da, db))
+
+    # Acceptor commit decision (mgm2.py:808-827).
+    has_offer = jnp.isfinite(acc_best)
+    if favor == "coordinated":
+        tie_ok = jnp.ones((n_seg,), dtype=bool)
+    elif favor == "no":
+        tie_ok = jax.random.uniform(k_coin, (n_seg,)) > 0.5
+    else:  # "unilateral"
+        tie_ok = jnp.zeros((n_seg,), dtype=bool)
+    acc_commit = has_offer & (
+        (acc_best > uni_gain) | ((acc_best == uni_gain) & tie_ok)
+    )
+
+    # Pick ONE accepted edge per committed acceptor (random among
+    # gain-ties), then scatter pair state to both endpoints.
+    partner = jnp.full((n_seg,), -1, dtype=jnp.int32)
+    pair_gain = jnp.full((n_seg,), NEG)
+    pair_val = jnp.zeros((n_seg,), dtype=jnp.int32)
+    committed = jnp.zeros((n_seg,), dtype=bool)
+    win_max = jnp.full((n_seg,), NEG)
+    fam_w = []
+    for i, (src, dst, offered, bestG, da, db) in enumerate(fam_results):
+        w = jnp.where(
+            offered & (bestG == acc_best[dst]) & acc_commit[dst],
+            jax.random.uniform(jax.random.fold_in(k_fam, 10_000 + i),
+                               (src.shape[0],)),
+            NEG,
+        )
+        fam_w.append(w)
+        win_max = jnp.maximum(win_max, jax.ops.segment_max(
+            w, dst, num_segments=n_seg
+        ))
+    for (src, dst, offered, bestG, da, db), w in zip(fam_results, fam_w):
+        accepted = jnp.isfinite(w) & (w == win_max[dst])
+        idx_s = jnp.where(accepted, src, n_seg)
+        idx_d = jnp.where(accepted, dst, n_seg)
+        partner = partner.at[idx_s].set(dst, mode="drop")
+        partner = partner.at[idx_d].set(src, mode="drop")
+        pair_gain = pair_gain.at[idx_s].set(bestG, mode="drop")
+        pair_gain = pair_gain.at[idx_d].set(bestG, mode="drop")
+        pair_val = pair_val.at[idx_s].set(
+            da.astype(jnp.int32), mode="drop")
+        pair_val = pair_val.at[idx_d].set(
+            db.astype(jnp.int32), mode="drop")
+        committed = committed.at[idx_s].set(True, mode="drop")
+        committed = committed.at[idx_d].set(True, mode="drop")
+
+    # ---- phase 4: gain exchange --------------------------------------
+    g = jnp.where(committed, pair_gain, uni_gain)
+
+    # Max neighbor gain excluding the partner (mgm2.py:889-893).
+    nmax_excl = jnp.full((n_seg,), NEG)
+    for bucket, p, q in _families(graph):
+        src, dst = bucket.var_ids[:, p], bucket.var_ids[:, q]
+        contrib = jnp.where(dst != partner[src], g[dst], NEG)
+        nmax_excl = jnp.maximum(nmax_excl, jax.ops.segment_max(
+            contrib, src, num_segments=n_seg
+        ))
+
+    # ---- phase 5: moves ----------------------------------------------
+    can_move = committed & (g > nmax_excl)
+    new_values = values
+    for (src, dst, offered, bestG, da, db), w in zip(fam_results, fam_w):
+        accepted = jnp.isfinite(w) & (w == win_max[dst])
+        go = accepted & can_move[src] & can_move[dst]
+        idx_s = jnp.where(go, src, n_seg)
+        idx_d = jnp.where(go, dst, n_seg)
+        new_values = new_values.at[idx_s].set(
+            da.astype(jnp.int32), mode="drop")
+        new_values = new_values.at[idx_d].set(
+            db.astype(jnp.int32), mode="drop")
+
+    # Uncommitted unilateral winners (mgm2.py:907-935).
+    nmax_all = neighbor_max(graph, g)
+    nrank = neighbor_min_rank_where(graph, g, g, lexic_ranks)
+    uni_win = (
+        ~committed & (uni_gain > 0)
+        & ((uni_gain > nmax_all)
+           | ((uni_gain == nmax_all) & (lexic_ranks < nrank)))
+    )
+    new_values = jnp.where(uni_win, uni_value, new_values)
+
+    return Mgm2State(
+        values=new_values, key=key, cycle=state.cycle + 1
+    )
+
+
+def run_mgm2(graph: CompiledFactorGraph, max_cycles: int, *,
+             threshold: float = 0.5, favor: str = "unilateral",
+             lexic_ranks: jnp.ndarray, seed: int = 0,
+             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full MGM2 run in one XLA program.
+
+    Returns (values [V], final cost, cycles)."""
+    state = init_state(graph, seed)
+    state = jax.lax.fori_loop(
+        0, max_cycles,
+        lambda i, s: mgm2_step(
+            s, graph, threshold=threshold, favor=favor,
+            lexic_ranks=lexic_ranks,
+        ),
+        state,
+    )
+    cost = assignment_cost(graph, state.values)
+    return state.values[:-1], cost, state.cycle

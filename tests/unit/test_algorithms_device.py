@@ -314,3 +314,56 @@ class TestMixedDsa:
         r2 = solve(dcop, "mixeddsa", max_cycles=40,
                    algo_params={"seed": 9})
         assert r1["assignment"] == r2["assignment"]
+
+
+class TestMgm2:
+    def test_reaches_reasonable_quality(self):
+        dcop = random_dcop(seed=40, n_vars=15, n_constraints=25)
+        sampled, _ = brute_force_sample(dcop)
+        res = solve(dcop, "mgm2", max_cycles=100)
+        assert res["violations"] == 0
+        assert res["cost"] <= sampled * 2 + 10
+
+    def test_beats_or_matches_mgm_on_average(self):
+        # 2-opt moves escape 1-opt local minima; over a few seeds MGM2
+        # should never be much worse than MGM.
+        deltas = []
+        for seed in (41, 42, 43):
+            dcop = random_dcop(seed=seed, n_vars=12, n_constraints=24)
+            r2 = solve(dcop, "mgm2", max_cycles=80,
+                       algo_params={"threshold": 0.5})
+            r1 = solve(dcop, "mgm", max_cycles=80)
+            deltas.append(r2["cost"] - r1["cost"])
+        assert np.mean(deltas) <= 2.0
+
+    @pytest.mark.parametrize("favor", ["unilateral", "no", "coordinated"])
+    def test_favor_modes(self, favor):
+        dcop = random_dcop(seed=44)
+        res = solve(dcop, "mgm2", max_cycles=40,
+                    algo_params={"favor": favor})
+        assert res["assignment"]
+
+    def test_threshold_extremes(self):
+        dcop = random_dcop(seed=45)
+        # threshold 0: nobody offers -> pure MGM behavior; 1: everyone
+        # offers (and everyone being an offerer, nobody accepts).
+        for th in (0.0, 1.0):
+            res = solve(dcop, "mgm2", max_cycles=40,
+                        algo_params={"threshold": th})
+            assert res["assignment"]
+
+    def test_arity3(self):
+        dcop = random_dcop(seed=46, arity3=True)
+        res = solve(dcop, "mgm2", max_cycles=40)
+        assert res["assignment"]
+
+    def test_deterministic_given_seed(self):
+        dcop = random_dcop(seed=47)
+        r1 = solve(dcop, "mgm2", max_cycles=40, algo_params={"seed": 3})
+        r2 = solve(dcop, "mgm2", max_cycles=40, algo_params={"seed": 3})
+        assert r1["assignment"] == r2["assignment"]
+
+    def test_stop_cycle(self):
+        dcop = random_dcop(seed=48)
+        res = solve(dcop, "mgm2", algo_params={"stop_cycle": 7})
+        assert res["cycles"] == 7
