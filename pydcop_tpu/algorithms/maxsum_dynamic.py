@@ -1,0 +1,69 @@
+"""Dynamic MaxSum: factor functions and scopes that change at run time.
+
+Reference parity: pydcop/algorithms/maxsum_dynamic.py —
+``DynamicFunctionFactorComputation`` (:40, same-scope function swap),
+``FactorWithReadOnlyVariableComputation`` (:113, relation sliced on
+subscribed read-only/sensor variables), ``DynamicFactorComputation``
+(:188, scope changes with ADD/REMOVE variable notifications) and
+``DynamicFactorVariableComputation`` (:352).  The reference classes are
+documented in-tree as broken after the maxsum refactor (maxsum_dynamic
+.py:57-60); the agent computations here (in
+pydcop_tpu.infrastructure.agent_algorithms) are working equivalents on
+the BSP MaxSum computations.
+
+Device path: the batched engine handles dynamic problems by recompiling
+the factor-graph tensors on topology events and warm-starting messages
+(see engine.compile); a static problem solved through this module is
+plain MaxSum, so ``solve_on_device`` delegates.
+"""
+
+from pydcop_tpu.algorithms import maxsum as _maxsum
+
+GRAPH_TYPE = "factor_graph"
+
+algo_params = _maxsum.algo_params
+
+
+def computation_memory(node) -> float:
+    return _maxsum.computation_memory(node)
+
+
+def communication_load(src, target: str) -> float:
+    return _maxsum.communication_load(src, target)
+
+
+def build_computation(comp_def):
+    from pydcop_tpu.infrastructure.computations import build_algo_computation
+
+    return build_algo_computation("maxsum_dynamic", comp_def)
+
+
+def _slice_externals(dcop):
+    """DCOP with every external variable frozen at its current value:
+    constraints over externals are sliced, others pass through.  The
+    device engine optimizes the writable variables only; external value
+    changes are handled by re-slicing + recompiling."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import ExternalVariable
+
+    if not dcop.external_variables:
+        return dcop
+    sliced = DCOP(dcop.name, objective=dcop.objective)
+    for v in dcop.variables.values():
+        sliced.add_variable(v)
+    for c in dcop.constraints.values():
+        ext = {
+            v.name: v.value for v in c.dimensions
+            if isinstance(v, ExternalVariable)
+        }
+        sliced.add_constraint(c.slice(ext) if ext else c)
+    for a in dcop.agents.values():
+        sliced.add_agents([a])
+    return sliced
+
+
+def solve_on_device(dcop, algo_def, **kwargs):
+    """Freeze external variables at their current values, then run the
+    batched MaxSum engine on the writable problem."""
+    return _maxsum.solve_on_device(_slice_externals(dcop), algo_def,
+                                   **kwargs)

@@ -14,7 +14,7 @@ from pydcop_tpu.computations_graph.objects import (
     Link,
 )
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.dcop.objects import Variable
+from pydcop_tpu.dcop.objects import ExternalVariable, Variable
 from pydcop_tpu.dcop.relations import Constraint
 
 GRAPH_NODE_TYPE_VARIABLE = "VariableComputation"
@@ -116,13 +116,18 @@ def build_computation_graph(
     for c in constraints:
         links = []
         for v in c.dimensions:
-            link = FactorGraphLink(c.name, v.name)
-            links.append(link)
             if v.name not in links_by_var:
+                # External (read-only) variables get no computation node
+                # — dynamic factors subscribe to them instead (reference
+                # factor_graph.py:276: only listed variables get nodes).
+                if isinstance(v, ExternalVariable):
+                    continue
                 raise ValueError(
                     f"Constraint {c.name} references unknown variable "
                     f"{v.name}"
                 )
+            link = FactorGraphLink(c.name, v.name)
+            links.append(link)
             links_by_var[v.name].append(link)
         factor_nodes.append(FactorComputationNode(c, links))
     var_nodes = [

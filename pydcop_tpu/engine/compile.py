@@ -118,6 +118,15 @@ def compile_factor_graph(
     variables = list(variables)
     constraints = list(constraints)
     var_index = {v.name: i for i, v in enumerate(variables)}
+    for c in constraints:
+        for v in c.dimensions:
+            if v.name not in var_index:
+                raise ValueError(
+                    f"Constraint {c.name} references variable {v.name} "
+                    "which has no computation node — external (read-"
+                    "only) variables require the 'maxsum_dynamic' "
+                    "algorithm, which slices them out before compiling"
+                )
     v_count = len(variables)
     dmax = max((len(v.domain) for v in variables), default=1)
     sign = 1.0 if mode == "min" else -1.0

@@ -28,6 +28,7 @@ from pydcop_tpu.dcop.relations import (
 from pydcop_tpu.infrastructure.computations import (
     DcopComputation,
     Message,
+    MessagePassingComputation,
     SynchronousComputationMixin,
     VariableComputation,
     message_type,
@@ -165,10 +166,26 @@ class MaxSumFactorComputation(SynchronousComputationMixin,
                               DcopComputation):
     """One computation per factor (constraint) in the factor graph."""
 
+    # Dynamic subclasses (maxsum_dynamic) slice external variables out;
+    # the plain computation would silently marginalize over them instead
+    # of fixing their value, so it refuses them up front.
+    HANDLES_EXTERNALS = False
+
     def __init__(self, comp_def):
         super().__init__(comp_def.node.factor.name, comp_def)
         self.factor = comp_def.node.factor
         self.variables = self.factor.dimensions
+        if not self.HANDLES_EXTERNALS:
+            ext = [
+                v.name for v in self.variables
+                if isinstance(v, _external_variable_type())
+            ]
+            if ext:
+                raise ValueError(
+                    f"Factor {self.name} depends on external variable(s) "
+                    f"{ext}: use algorithm 'maxsum_dynamic' for problems "
+                    "with external (read-only) variables"
+                )
         self._costs: Dict[str, Dict] = {}
         params = comp_def.algo.params
         self.damping = params.get("damping", 0.5)
@@ -258,6 +275,227 @@ class MaxSumVariableComputation(SynchronousComputationMixin,
                 self.post_msg(f_name, MaxSumMessage(costs_f))
                 self._prev[f_name] = (costs_f, count + 1)
         return None
+
+
+# --------------------------------------------------------------------- #
+# Dynamic MaxSum (reference maxsum_dynamic.py:40-405 — the reference
+# classes are documented there as broken post-refactor; these are working
+# equivalents on the BSP computations above).
+
+
+class DynamicFunctionFactorComputation(MaxSumFactorComputation):
+    """MaxSum factor whose cost function can be swapped at run time.
+
+    The new function must keep the same scope (reference
+    maxsum_dynamic.py:84-100).  Under BSP semantics the swap is applied
+    lazily: the new costs flow with the next cycle's messages (an
+    immediate re-send would produce duplicate per-cycle messages, which
+    the synchronous mixin rejects by design).
+    """
+
+    def change_factor_function(self, fn) -> None:
+        old_names = {v.name for v in self.factor.dimensions}
+        new_names = {v.name for v in fn.dimensions}
+        if old_names != new_names:
+            raise ValueError(
+                "Dimensions must be the same when changing function in "
+                f"DynamicFunctionFactorComputation: {old_names} vs "
+                f"{new_names}"
+            )
+        self.factor = fn
+        self.variables = fn.dimensions
+        # Drop send-suppression state so updated costs are guaranteed to
+        # go out on the next cycle.
+        self._prev.clear()
+
+
+class FactorWithReadOnlyVariableComputation(DynamicFunctionFactorComputation):
+    """Factor whose relation depends on read-only (external/sensor)
+    variables: subscribes to them and optimizes the relation sliced on
+    their current values (reference maxsum_dynamic.py:113-186).
+    """
+
+    HANDLES_EXTERNALS = True
+
+    def __init__(self, comp_def, relation=None, read_only_variables=None):
+        super().__init__(comp_def)
+        self._relation = relation if relation is not None else self.factor
+        if read_only_variables is None:
+            read_only_variables = [
+                v for v in self._relation.dimensions
+                if isinstance(v, _external_variable_type())
+            ]
+        self._read_only_variables = list(read_only_variables)
+        ro_names = {v.name for v in self._read_only_variables}
+        for v in self._read_only_variables:
+            if v.name not in self._relation.scope_names:
+                raise ValueError(
+                    f"Read-only variable {v.name} must be in relation "
+                    f"scope {self._relation.scope_names}"
+                )
+        self._read_only_values: Dict[str, Any] = {}
+        # Until every sensor value is known, optimize a neutral relation
+        # over the writable scope (reference :144-147).
+        from pydcop_tpu.dcop.relations import NeutralRelation
+
+        writable = [
+            v for v in self._relation.dimensions if v.name not in ro_names
+        ]
+        self.factor = NeutralRelation(writable, name=self._relation.name)
+        self.variables = writable
+
+    @property
+    def neighbors(self) -> List[str]:
+        # Only writable variables take part in BSP cycles; read-only
+        # (external) ones are plain-message subscriptions.
+        return [v.name for v in self.variables]
+
+    def on_start(self):
+        for v in self._read_only_variables:
+            # Plain (non-cycle) message: the external-variable
+            # computation is not synchronous.
+            MessagePassingComputation.post_msg(
+                self, v.name, Message("subscribe", None)
+            )
+
+    @register("external_value")
+    def _on_external_value(self, sender, msg, t):
+        self._read_only_values[sender] = msg.content
+        if len(self._read_only_values) < len(self._read_only_variables):
+            return
+        new_sliced = self._relation.slice(self._read_only_values)
+        if set(new_sliced.scope_names) != {
+            v.name for v in self.factor.dimensions
+        } or not _same_costs(new_sliced, self.factor):
+            self.change_factor_function(new_sliced)
+
+
+class DynamicFactorComputation(MaxSumFactorComputation):
+    """MaxSum factor whose function — and scope — can change at run
+    time (reference maxsum_dynamic.py:188-350).
+
+    Scope changes notify the affected variables with plain ``maxsum_add``
+    / ``maxsum_remove`` messages so they adjust their factor lists.
+    External variables in the scope are subscribed to automatically and
+    sliced out of the optimized relation.
+    """
+
+    HANDLES_EXTERNALS = True
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def)
+        self._relation = self.factor
+        self._external_variables = {
+            v.name: v for v in self.factor.dimensions
+            if isinstance(v, _external_variable_type())
+        }
+        if self._external_variables:
+            values = {
+                n: v.value for n, v in self._external_variables.items()
+            }
+            self.factor = self._relation.slice(values)
+            self.variables = self.factor.dimensions
+
+    @property
+    def neighbors(self) -> List[str]:
+        return [v.name for v in self.variables]
+
+    def on_start(self):
+        for name in self._external_variables:
+            MessagePassingComputation.post_msg(
+                self, name, Message("subscribe", None)
+            )
+
+    @register("external_value")
+    def _on_external_value(self, sender, msg, t):
+        if sender not in self._external_variables:
+            return
+        self._external_variables[sender].value = msg.content
+        values = {
+            n: v.value for n, v in self._external_variables.items()
+        }
+        new_sliced = self._relation.slice(values)
+        if set(new_sliced.scope_names) != {
+            v.name for v in self.factor.dimensions
+        } or not _same_costs(new_sliced, self.factor):
+            self.change_factor_function(new_sliced)
+
+    def change_factor_function(self, fn) -> None:
+        removed = [
+            v for v in self.factor.dimensions
+            if v.name not in fn.scope_names
+        ]
+        added = [
+            v for v in fn.dimensions
+            if v.name not in self.factor.scope_names
+        ]
+        self.factor = fn
+        self.variables = fn.dimensions
+        self._prev.clear()
+        for v in removed:
+            self._costs.pop(v.name, None)
+            MessagePassingComputation.post_msg(
+                self, v.name, Message("maxsum_remove", self.name)
+            )
+        for v in added:
+            self._costs.setdefault(
+                v.name, {d: 0 for d in v.domain}
+            )
+            MessagePassingComputation.post_msg(
+                self, v.name, Message("maxsum_add", self.name)
+            )
+
+
+class DynamicFactorVariableComputation(MaxSumVariableComputation):
+    """MaxSum variable that supports factors joining/leaving its scope
+    via ``maxsum_add`` / ``maxsum_remove`` messages (reference
+    maxsum_dynamic.py:352-405)."""
+
+    @property
+    def neighbors(self) -> List[str]:
+        return list(self.factor_names)
+
+    @register("maxsum_remove")
+    def _on_remove_msg(self, sender, msg, t):
+        factor_name = msg.content
+        if factor_name not in self.factor_names:
+            raise ValueError(
+                f"Cannot remove factor {factor_name} from variable "
+                f"{self.name}: not in {self.factor_names}"
+            )
+        self.factor_names.remove(factor_name)
+        self._costs.pop(factor_name, None)
+        self._prev.clear()
+        # Sync-mixin bookkeeping: drop any message already collected
+        # from the departed factor, then re-check completion — with the
+        # neighbor set shrunk, the current cycle may already be full.
+        self.current_cycle.pop(factor_name, None)
+        value, cost = select_value(self._variable, self._costs, self.mode)
+        self.value_selection(value, cost)
+        self._maybe_switch_cycle()
+
+    @register("maxsum_add")
+    def _on_add_msg(self, sender, msg, t):
+        factor_name = msg.content
+        if factor_name not in self.factor_names:
+            self.factor_names.append(factor_name)
+
+
+def _external_variable_type():
+    from pydcop_tpu.dcop.objects import ExternalVariable
+
+    return ExternalVariable
+
+
+def _same_costs(r1, r2) -> bool:
+    """True when two relations over the same scope have identical cost
+    tables (cheap dims are fine: dynamic factors stay small)."""
+    import numpy as np
+
+    try:
+        return bool(np.array_equal(r1.to_array(), r2.to_array()))
+    except MemoryError:
+        return False
 
 
 # --------------------------------------------------------------------- #
@@ -521,13 +759,188 @@ class MgmComputation(VariableComputation):
 
 
 # --------------------------------------------------------------------- #
+# NCBB (reference ncbb.py:139-350)
+
+
+NcbbValueMessage = message_type("ncbb_value", ["value"])
+NcbbCostMessage = message_type("ncbb_cost", ["cost"])
+NcbbStopMessage = message_type("ncbb_stop", [])
+
+
+class NcbbComputation(SynchronousComputationMixin, VariableComputation):
+    """NCBB computation: synchronous two-phase over a DFS pseudo-tree.
+
+    INIT phase per the reference (ncbb.py:216-330): the root picks a
+    random value and sends it down; every variable accumulates its
+    ancestors' values, greedily optimizes against them, forwards its own
+    value to descendants; leaves start COST messages whose subtree upper
+    bounds accumulate back up to the root.  The reference's search phase
+    is an empty stub, so once the root holds the global bound we
+    terminate cleanly (stop messages down the tree) with the greedy
+    assignment instead of idling until timeout.  Two deliberate fixes
+    over the reference: leaves send COST only to their tree parent (the
+    reference posts to pseudo-parents too, which its own cost handler
+    rejects), and termination is explicit.
+    """
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        assert comp_def.algo.algo == "ncbb"
+        node = comp_def.node
+        self._parent = node.parent
+        self._pseudo_parents = list(node.pseudo_parents)
+        self._children = list(node.children)
+        self._pseudo_children = list(node.pseudo_children)
+        self._ancestors = self._pseudo_parents + (
+            [self._parent] if self._parent else []
+        )
+        self._descendants = self._pseudo_children + self._children
+        self.phase = "INIT"
+        self._upper_bound = None
+        self._constraints = []
+        for c in node.constraints:
+            if c.arity > 2:
+                from pydcop_tpu.infrastructure.computations import (
+                    ComputationException,
+                )
+
+                raise ComputationException(
+                    f"Invalid constraint {c} with arity {c.arity} for "
+                    f"variable {self.name}: NCBB only supports binary "
+                    "constraints."
+                )
+            self._constraints.append(c)
+        self._parents_values: Dict[str, Any] = {}
+        self._children_costs: Dict[str, float] = {}
+
+    @register("ncbb_value")
+    def _on_value_registration(self, sender, msg, t):
+        pass
+
+    @register("ncbb_cost")
+    def _on_cost_registration(self, sender, msg, t):
+        pass
+
+    @register("ncbb_stop")
+    def _on_stop_registration(self, sender, msg, t):
+        pass
+
+    @property
+    def is_root(self) -> bool:
+        return self._parent is None
+
+    @property
+    def is_leaf(self) -> bool:
+        return len(self._children) == 0
+
+    def _greedy_select(self):
+        """Best value given the known ancestor values, counting the
+        variable's own costs, unary constraints charged here, and every
+        constraint whose scope is fully known (self + ancestors) — the
+        same accounting as the engine path's unary[] + charged[]."""
+        better = (
+            (lambda a, b: a < b) if self.mode == "min"
+            else (lambda a, b: a > b)
+        )
+        known = dict(self._parents_values)
+        best_val, best_cost = None, None
+        for val in self.variable.domain:
+            cost = self.variable.cost_for_val(val)
+            asst = {**known, self.name: val}
+            for c in self._constraints:
+                if all(s in asst for s in c.scope_names):
+                    cost += c(**{s: asst[s] for s in c.scope_names})
+            if best_cost is None or better(cost, best_cost):
+                best_val, best_cost = val, cost
+        return best_val, best_cost
+
+    def on_start(self):
+        if not self.is_root:
+            return
+        # Root: no ancestors, select greedily from own costs and send
+        # down the tree (reference picks at random, :225; greedy is
+        # deterministic and never worse).
+        value, cost = self._greedy_select()
+        self.value_selection(value)
+        self._upper_bound = cost
+        for child in self._descendants:
+            self.post_msg(child, NcbbValueMessage(self.current_value))
+        if self.is_leaf:
+            self._finish_and_stop()
+
+    def on_new_cycle(self, messages, cycle_id) -> Optional[List]:
+        for sender, (msg, t) in sorted(messages.items()):
+            if msg.type == "ncbb_value":
+                self._value_phase(sender, msg.value)
+            elif msg.type == "ncbb_cost":
+                self._cost_phase(sender, msg.cost)
+            elif msg.type == "ncbb_stop":
+                self._on_stop(sender)
+        return None
+
+    def _value_phase(self, sender: str, value):
+        if sender not in self._ancestors:
+            from pydcop_tpu.infrastructure.computations import (
+                ComputationException,
+            )
+
+            raise ComputationException(
+                f"{self.name}: ncbb value from non-ancestor {sender}"
+            )
+        self._parents_values[sender] = value
+        if len(self._parents_values) < len(self._ancestors):
+            return
+        # Greedy selection against known ancestors (reference :286-300,
+        # plus own/unary costs so the bound matches a real assignment).
+        value, cost = self._greedy_select()
+        self.value_selection(value)
+        self._upper_bound = cost
+        for child in self._descendants:
+            self.post_msg(child, NcbbValueMessage(self.current_value))
+        if self.is_leaf and self._parent:
+            self.post_msg(self._parent, NcbbCostMessage(cost))
+
+    def _cost_phase(self, sender: str, cost: float):
+        if sender not in self._children:
+            from pydcop_tpu.infrastructure.computations import (
+                ComputationException,
+            )
+
+            raise ComputationException(
+                f"{self.name}: ncbb cost from non-child {sender}"
+            )
+        self._children_costs[sender] = cost
+        self._upper_bound += cost
+        if len(self._children_costs) < len(self._children):
+            return
+        self.phase = "SEARCH"
+        if not self.is_root:
+            self.post_msg(self._parent, NcbbCostMessage(self._upper_bound))
+        else:
+            # Root holds the global upper bound: terminate the run.
+            self._finish_and_stop()
+
+    def _finish_and_stop(self):
+        for child in self._children:
+            self.post_msg(child, NcbbStopMessage())
+        self.finished()
+
+    def _on_stop(self, sender: str):
+        self.phase = "SEARCH"
+        for child in self._children:
+            self.post_msg(child, NcbbStopMessage())
+        self.finished()
+
+
+# --------------------------------------------------------------------- #
 # Registry
 
 
 # Algorithms with an agent-mode (message-passing) computation; others
 # are device-engine only for now and rejected up front.
 AGENT_MODE_ALGOS = frozenset(
-    {"maxsum", "amaxsum", "dsa", "adsa", "dsatuto", "mgm"}
+    {"maxsum", "amaxsum", "maxsum_dynamic", "dsa", "adsa", "dsatuto",
+     "mgm", "ncbb"}
 )
 
 
@@ -548,10 +961,19 @@ def build(algo_name: str, comp_def):
         if isinstance(node, VariableComputationNode):
             return MaxSumVariableComputation(comp_def)
         raise TypeError(f"Unsupported node for maxsum: {node}")
+    if algo_name == "maxsum_dynamic":
+        node = comp_def.node
+        if isinstance(node, FactorComputationNode):
+            return DynamicFactorComputation(comp_def)
+        if isinstance(node, VariableComputationNode):
+            return DynamicFactorVariableComputation(comp_def)
+        raise TypeError(f"Unsupported node for maxsum_dynamic: {node}")
     if algo_name in ("dsa", "adsa", "dsatuto"):
         return DsaComputation(comp_def)
     if algo_name == "mgm":
         return MgmComputation(comp_def)
+    if algo_name == "ncbb":
+        return NcbbComputation(comp_def)
     raise NotImplementedError(
         f"No agent-mode computation for algorithm {algo_name!r} yet"
     )

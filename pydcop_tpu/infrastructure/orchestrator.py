@@ -150,6 +150,20 @@ class Orchestrator:
         self.mgt = AgentsMgt(self)
         self._agent.add_computation(self.mgt)
 
+        # External (read-only/sensor) variables are published by
+        # computations hosted on the orchestrator's agent: dynamic
+        # factors subscribe to them by name and receive value changes
+        # (reference computations.py:1093 ExternalVariableComputation).
+        self._external_computations = []
+        for ev in dcop.external_variables.values():
+            from pydcop_tpu.infrastructure.computations import (
+                ExternalVariableComputation,
+            )
+
+            comp = ExternalVariableComputation(ev)
+            self._agent.add_computation(comp)
+            self._external_computations.append(comp)
+
         self._ready_evt = threading.Event()
         self._finished_evt = threading.Event()
         self._stopped_agents: set = set()
@@ -168,6 +182,8 @@ class Orchestrator:
         self._agent.start()
         self.directory.directory_computation.start()
         self.mgt.start()
+        for comp in self._external_computations:
+            comp.start()
 
     def stop(self):
         self._agent.clean_shutdown()

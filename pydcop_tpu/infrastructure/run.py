@@ -100,6 +100,17 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             algo_def, mode=dcop.objective
         )
     algo_module = load_algorithm_module(algo_def.algo)
+    # Fail in the caller, not on an agent thread during deployment:
+    # only the dynamic maxsum computations subscribe to external
+    # (read-only) variables; other algorithms would silently treat them
+    # as free optimization variables.
+    if dcop.external_variables and algo_def.algo != "maxsum_dynamic":
+        raise ValueError(
+            f"DCOP has external variable(s) "
+            f"{sorted(dcop.external_variables)} but algorithm "
+            f"{algo_def.algo!r} does not support them: use "
+            "'maxsum_dynamic'"
+        )
     # Map max_cycles onto the algorithm's stop_cycle parameter when it
     # has one and none was given, so the -c CLI bound takes effect.
     if max_cycles:

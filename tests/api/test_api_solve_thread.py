@@ -44,6 +44,15 @@ def test_thread_solve_with_stop_cycle():
     assert res["cycles"] == 30
 
 
+def test_thread_solve_ncbb():
+    # NCBB agent mode runs the INIT phase (greedy top-down + bound
+    # propagation) and terminates cleanly; the assignment is the greedy
+    # one, so only feasibility-level quality is guaranteed.
+    res = solve(_dcop(), "ncbb", backend="thread", timeout=5)
+    assert res["status"] == "FINISHED"
+    assert set(res["assignment"]) == {"v1", "v2", "v3"}
+
+
 def test_thread_and_device_agree():
     d = _dcop()
     r_thread = solve(d, "maxsum", backend="thread", timeout=3)

@@ -115,6 +115,48 @@ class TestSyncBB:
         assert r1["cost"] == pytest.approx(r2["cost"])
 
 
+class TestNcbb:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_optimal_vs_bruteforce(self, seed):
+        dcop = random_dcop(seed=seed)
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "ncbb")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_optimal_with_var_costs(self):
+        dcop = random_dcop(seed=3, with_var_costs=True)
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "ncbb")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_max_mode(self):
+        dcop = random_dcop(seed=4, objective="max")
+        expected_cost, _ = brute_force(dcop)
+        res = solve(dcop, "ncbb")
+        assert res["cost"] == pytest.approx(expected_cost)
+
+    def test_rejects_arity3(self):
+        from pydcop_tpu.infrastructure.computations import (
+            ComputationException,
+        )
+
+        dcop = random_dcop(seed=5, arity3=True)
+        with pytest.raises(ComputationException):
+            solve(dcop, "ncbb")
+
+    def test_agrees_with_dpop(self):
+        dcop = random_dcop(seed=11, n_vars=12, n_constraints=20)
+        r1 = solve(dcop, "dpop")
+        r2 = solve(dcop, "ncbb")
+        assert r1["cost"] == pytest.approx(r2["cost"])
+
+    def test_upper_bound_reported(self):
+        dcop = random_dcop(seed=12)
+        res = solve(dcop, "ncbb")
+        # Greedy INIT bound is never better than the optimum.
+        assert res["metrics"]["upper_bound"] >= res["cost"] - 1e-9
+
+
 class TestLocalSearch:
     def test_dsa_reaches_reasonable_quality(self):
         dcop = random_dcop(seed=9, n_vars=20, n_constraints=30)

@@ -258,3 +258,251 @@ class TestMgmComputation:
         # Deliver neighbor gain lower than ours -> we change value
         comp.on_message("v2", MgmGainMessage(-1.0, 0.5), 0)
         assert comp.cycle_count >= 1
+
+
+class TestDynamicMaxSum:
+    """Dynamic MaxSum computations (reference maxsum_dynamic.py),
+    driven directly with mocked senders."""
+
+    def _defs(self, algo_name="maxsum_dynamic"):
+        v1 = Variable("v1", d3)
+        v2 = Variable("v2", d3)
+        c1 = constraint_from_str("c1", "abs(v1 - v2)", [v1, v2])
+        graph = fg.build_computation_graph(
+            variables=[v1, v2], constraints=[c1])
+        algo = AlgorithmDef.build_with_default_param(algo_name, {}, "min")
+        return (
+            {n.name: ComputationDef(n, algo) for n in graph.nodes},
+            (v1, v2, c1),
+        )
+
+    def test_change_function_same_scope(self):
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            DynamicFunctionFactorComputation,
+        )
+
+        defs, (v1, v2, c1) = self._defs()
+        fc = DynamicFunctionFactorComputation(defs["c1"])
+        fc._msg_sender = MagicMock()
+        fc.start()
+        new_c = constraint_from_str("c1", "(v1 + v2) * 2", [v1, v2])
+        fc.change_factor_function(new_c)
+        assert fc.factor is new_c
+        # Costs computed after the swap use the new function:
+        costs = factor_costs_for_var(fc.factor, v1, {}, "min")
+        assert costs == {0: 0, 1: 2, 2: 4}
+
+    def test_change_function_different_scope_raises(self):
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            DynamicFunctionFactorComputation,
+        )
+
+        defs, (v1, v2, c1) = self._defs()
+        fc = DynamicFunctionFactorComputation(defs["c1"])
+        v3 = Variable("v3", d3)
+        bad = constraint_from_str("c1", "v1 + v3", [v1, v3])
+        with pytest.raises(ValueError):
+            fc.change_factor_function(bad)
+
+    def test_read_only_factor_slices_on_sensor_values(self):
+        from pydcop_tpu.dcop.objects import ExternalVariable
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            FactorWithReadOnlyVariableComputation,
+        )
+
+        v1 = Variable("v1", d3)
+        e1 = ExternalVariable("e1", d3, value=0)
+        rule = constraint_from_str("r1", "v1 * e1", [v1, e1])
+        graph = fg.build_computation_graph(
+            variables=[v1], constraints=[rule])
+        algo = AlgorithmDef.build_with_default_param(
+            "maxsum_dynamic", {}, "min")
+        comp_def = ComputationDef(
+            next(n for n in graph.nodes if n.name == "r1"), algo)
+        fc = FactorWithReadOnlyVariableComputation(
+            comp_def, relation=rule, read_only_variables=[e1])
+        fc._msg_sender = MagicMock()
+        # Before sensor values arrive: neutral relation over v1 only.
+        assert fc.neighbors == ["v1"]
+        assert fc.factor(v1=2) == 0
+        fc.start()
+        # Subscription message went out as a plain (non-cycle) message:
+        subs = [
+            c[0] for c in fc._msg_sender.call_args_list
+            if c[0][2].type == "subscribe"
+        ]
+        assert [s[1] for s in subs] == ["e1"]
+        # Sensor reports e1=2: relation becomes v1*2.
+        fc.on_message("e1", Message("external_value", 2), 0)
+        assert fc.factor(v1=1) == 2
+        assert fc.factor.scope_names == ["v1"]
+
+    def test_dynamic_factor_scope_change_notifies_variables(self):
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            DynamicFactorComputation,
+        )
+
+        defs, (v1, v2, c1) = self._defs()
+        fc = DynamicFactorComputation(defs["c1"])
+        fc._msg_sender = MagicMock()
+        fc.start()
+        v3 = Variable("v3", d3)
+        new_c = constraint_from_str("c1", "v1 + v3", [v1, v3])
+        fc.change_factor_function(new_c)
+        assert set(fc.neighbors) == {"v1", "v3"}
+        plain = [
+            (c[0][1], c[0][2].type)
+            for c in fc._msg_sender.call_args_list
+            if c[0][2].type in ("maxsum_add", "maxsum_remove")
+        ]
+        assert ("v2", "maxsum_remove") in plain
+        assert ("v3", "maxsum_add") in plain
+
+    def test_dynamic_factor_slices_external_at_init(self):
+        from pydcop_tpu.dcop.objects import ExternalVariable
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            DynamicFactorComputation,
+        )
+
+        v1 = Variable("v1", d3)
+        e1 = ExternalVariable("e1", d3, value=1)
+        rule = constraint_from_str("r1", "v1 * e1", [v1, e1])
+        graph = fg.build_computation_graph(
+            variables=[v1], constraints=[rule])
+        algo = AlgorithmDef.build_with_default_param(
+            "maxsum_dynamic", {}, "min")
+        comp_def = ComputationDef(
+            next(n for n in graph.nodes if n.name == "r1"), algo)
+        fc = DynamicFactorComputation(comp_def)
+        assert fc.neighbors == ["v1"]
+        assert fc.factor(v1=2) == 2
+        # Sensor change re-slices:
+        fc._msg_sender = MagicMock()
+        fc.on_message("e1", Message("external_value", 2), 0)
+        assert fc.factor(v1=2) == 4
+
+    def test_dynamic_variable_add_remove(self):
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            DynamicFactorVariableComputation,
+        )
+
+        defs, (v1, v2, c1) = self._defs()
+        vc = DynamicFactorVariableComputation(defs["v1"])
+        vc._msg_sender = MagicMock()
+        vc.start()
+        assert vc.neighbors == ["c1"]
+        vc.on_message("c2", Message("maxsum_add", "c2"), 0)
+        assert set(vc.neighbors) == {"c1", "c2"}
+        vc.on_message("c1", Message("maxsum_remove", "c1"), 0)
+        assert vc.neighbors == ["c2"]
+        with pytest.raises(ValueError):
+            vc.on_message("c9", Message("maxsum_remove", "c9"), 0)
+
+    def test_solve_on_device_matches_maxsum(self):
+        from pydcop_tpu.api import solve
+        from pydcop_tpu.dcop.dcop import DCOP
+
+        v1 = Variable("v1", d3)
+        v2 = Variable("v2", d3)
+        c1 = constraint_from_str("c1", "abs(v1 - v2)", [v1, v2])
+        dcop = DCOP("t")
+        dcop.add_constraint(c1)
+        r1 = solve(dcop, "maxsum_dynamic", max_cycles=30)
+        r2 = solve(dcop, "maxsum", max_cycles=30)
+        assert r1["cost"] == pytest.approx(r2["cost"])
+
+
+class TestDynamicMaxSumRegressions:
+    """Regressions found in review: BSP stall on factor removal,
+    external-variable handling in plain vs dynamic maxsum."""
+
+    def test_remove_completes_stalled_cycle(self):
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            DynamicFactorVariableComputation,
+        )
+
+        v1 = Variable("v1", d3)
+        v2 = Variable("v2", d3)
+        c1 = constraint_from_str("c1", "abs(v1 - v2)", [v1, v2])
+        c2 = constraint_from_str("c2", "v1 + v2", [v1, v2])
+        graph = fg.build_computation_graph(
+            variables=[v1, v2], constraints=[c1, c2])
+        algo = AlgorithmDef.build_with_default_param(
+            "maxsum_dynamic", {}, "min")
+        node = next(n for n in graph.nodes if n.name == "v1")
+        vc = DynamicFactorVariableComputation(ComputationDef(node, algo))
+        vc._msg_sender = MagicMock()
+        vc.start()
+        # c2's cycle-0 message arrives; cycle waits on c1.
+        vc.on_message(
+            "c2", Message("_cycle", (0, MaxSumMessage({0: 0, 1: 0, 2: 0}))),
+            0,
+        )
+        assert vc.cycle_id == 0
+        # c1 leaves: the shrunk neighbor set makes cycle 0 complete.
+        vc.on_message("c1", Message("maxsum_remove", "c1"), 0)
+        assert vc.cycle_id == 1
+        # Subsequent cycles from c2 keep flowing without skew errors.
+        vc.on_message(
+            "c2", Message("_cycle", (1, MaxSumMessage({0: 0, 1: 0, 2: 0}))),
+            0,
+        )
+        assert vc.cycle_id == 2
+
+    def test_device_solve_slices_external_variables(self):
+        from pydcop_tpu.api import solve
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import ExternalVariable
+
+        v1 = Variable("v1", d3)
+        v2 = Variable("v2", d3)
+        e1 = ExternalVariable("e1", d3, value=2)
+        dcop = DCOP("t")
+        dcop.add_external_variable(e1)
+        dcop.add_constraint(
+            constraint_from_str("c1", "v1 * e1 + abs(v1 - v2)",
+                                [v1, v2, e1]))
+        res = solve(dcop, "maxsum_dynamic", max_cycles=50)
+        # With e1=2: cost = 2*v1 + |v1-v2|, optimum v1=v2=0.
+        assert res["assignment"] == {"v1": 0, "v2": 0}
+
+    def test_plain_maxsum_rejects_external_variables(self):
+        from pydcop_tpu.api import solve
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import ExternalVariable
+
+        from pydcop_tpu.dcop.objects import AgentDef
+
+        v1 = Variable("v1", d3)
+        e1 = ExternalVariable("e1", d3, value=1)
+        dcop = DCOP("t")
+        dcop.add_external_variable(e1)
+        dcop.add_constraint(
+            constraint_from_str("c1", "v1 * e1", [v1, e1]))
+        dcop.add_agents([AgentDef("a1"), AgentDef("a2")])
+        with pytest.raises(ValueError, match="maxsum_dynamic"):
+            solve(dcop, "maxsum", max_cycles=10)
+        with pytest.raises(ValueError, match="maxsum_dynamic"):
+            solve(dcop, "maxsum", backend="thread", timeout=2)
+
+
+class TestNcbbGreedyCosts:
+    def test_thread_greedy_counts_own_costs(self):
+        from pydcop_tpu.api import solve
+        from pydcop_tpu.dcop.dcop import DCOP
+        from pydcop_tpu.dcop.objects import VariableWithCostFunc
+
+        from pydcop_tpu.dcop.objects import AgentDef
+
+        d2 = Domain("d", "", [0, 1])
+        v1 = Variable("v1", d2)
+        v2 = VariableWithCostFunc("v2", d2, cost_func=lambda x: 10 * x)
+        dcop = DCOP("t")
+        dcop.add_variable(v2)
+        dcop.add_constraint(
+            constraint_from_str("c1", "1 - abs(v1 - v2)", [v1, v2]))
+        dcop.add_agents([AgentDef("a1"), AgentDef("a2")])
+        res = solve(dcop, "ncbb", backend="thread", timeout=5)
+        # Greedy INIT must count v2's own cost: picks v2=0 (cost 1)
+        # rather than v2=1 (cost 10).
+        assert res["cost"] == pytest.approx(1.0)
