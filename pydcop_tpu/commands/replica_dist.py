@@ -1,14 +1,71 @@
-"""``pydcop replica_dist`` — placeholder, implemented later this round.
+"""``pydcop replica_dist``: offline replica placement.
 
-Reference parity target: pydcop/commands/replica_dist.py.
+Reference parity: pydcop/commands/replica_dist.py — compute where k
+replicas of each computation would be placed (the same distributed UCS
+used by ``pydcop run``), without solving the DCOP.  Output is YAML:
+
+    replica_dist:
+      <computation>: [agent, agent, ...]
 """
+
+import json
+
+from pydcop_tpu.commands._utils import build_algo_def
 
 
 def set_parser(subparsers):
-    parser = subparsers.add_parser("replica_dist", help="replica_dist (not yet implemented)")
+    parser = subparsers.add_parser(
+        "replica_dist", help="compute an offline replica placement")
+    parser.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    parser.add_argument("-a", "--algo", required=True,
+                        help="algorithm (for computation footprints)")
+    parser.add_argument("-d", "--distribution", default="oneagent",
+                        help="distribution method or file")
+    parser.add_argument("-k", "--ktarget", type=int, required=True,
+                        help="number of replicas per computation")
     parser.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
-    print("pydcop replica_dist: not implemented yet in pydcop-tpu")
-    return 3
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.computations_graph import load_graph_module
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.infrastructure.run import (
+        _build_distribution,
+        run_local_thread_dcop,
+    )
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    algo_def = build_algo_def(args.algo, None, dcop.objective)
+    algo_module = load_algorithm_module(algo_def.algo)
+    cg = load_graph_module(
+        algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+    distribution = _build_distribution(
+        dcop, cg, algo_module, args.distribution
+    )
+    orchestrator = run_local_thread_dcop(
+        algo_def, cg, distribution, dcop, replication=True
+    )
+    try:
+        if not orchestrator.wait_ready(10):
+            print("Error: agents did not become ready")
+            return 3
+        orchestrator.deploy_computations()
+        timeout = args.timeout if args.timeout is not None else 30.0
+        replica_dist = orchestrator.start_replication(
+            args.ktarget, timeout=timeout
+        )
+    finally:
+        orchestrator.stop_agents(5)
+        orchestrator.stop()
+
+    lines = ["replica_dist:"]
+    for comp in sorted(replica_dist.mapping):
+        hosts = replica_dist.mapping[comp]
+        lines.append(f"  {comp}: {json.dumps(hosts)}")
+    text = "\n".join(lines) + "\n"
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text)
+    print(text)
+    return 0

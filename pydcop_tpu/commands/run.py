@@ -1,14 +1,130 @@
-"""``pydcop run`` — placeholder, implemented later this round.
+"""``pydcop run``: solve a *dynamic* DCOP — scenario events (agent
+departures) fire during the run, replicas keep computations alive.
 
-Reference parity target: pydcop/commands/run.py.
+Reference parity: pydcop/commands/run.py (run_cmd :314: solve +
+``--scenario`` events + replication ``--ktarget``).  Result JSON shape
+matches ``pydcop solve``; replication/repair state is reported under
+``replication``.
 """
+
+import logging
+
+from pydcop_tpu.commands._utils import build_algo_def, emit_result
+
+logger = logging.getLogger("pydcop.cli.run")
 
 
 def set_parser(subparsers):
-    parser = subparsers.add_parser("run", help="run (not yet implemented)")
+    parser = subparsers.add_parser(
+        "run", help="run a dynamic DCOP with scenario events")
+    parser.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    parser.add_argument("-a", "--algo", required=True,
+                        help="algorithm name")
+    parser.add_argument("-p", "--algo_params", action="append",
+                        help="algorithm parameter as name:value")
+    parser.add_argument("-d", "--distribution", default="oneagent",
+                        help="distribution method or file")
+    parser.add_argument("-s", "--scenario", required=True,
+                        help="scenario yaml file")
+    parser.add_argument("-k", "--ktarget", type=int, default=3,
+                        help="number of replicas per computation")
+    parser.add_argument("-m", "--mode", default="thread",
+                        choices=["thread"],
+                        help="execution mode (dynamic runs are "
+                             "agent-based)")
+    parser.add_argument("-c", "--cycles", type=int, default=0,
+                        help="max cycles (0: unbounded)")
+    parser.add_argument("--collect_on", default="value_change",
+                        choices=["value_change", "cycle_change", "period"])
+    parser.add_argument("--period", type=float, default=1.0)
+    parser.add_argument("--run_metrics", default=None)
+    parser.add_argument("--end_metrics", default=None)
+    parser.add_argument("--infinity", type=float, default=float("inf"))
     parser.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
-    print("pydcop run: not implemented yet in pydcop-tpu")
-    return 3
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.computations_graph import load_graph_module
+    from pydcop_tpu.dcop.yamldcop import (
+        load_dcop_from_file,
+        load_scenario_from_file,
+    )
+    from pydcop_tpu.infrastructure.run import (
+        _build_distribution,
+        run_local_thread_dcop,
+    )
+
+    from pydcop_tpu.algorithms import AlgorithmDef
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    scenario = load_scenario_from_file(args.scenario)
+    algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
+    algo_module = load_algorithm_module(algo_def.algo)
+    # -c bounds algorithms exposing a stop_cycle parameter (same
+    # mapping as solve, infrastructure/run.py solve_with_agents).
+    if args.cycles:
+        param_names = {p.name for p in algo_module.algo_params}
+        if ("stop_cycle" in param_names
+                and not algo_def.params.get("stop_cycle")):
+            params = algo_def.params
+            params["stop_cycle"] = args.cycles
+            algo_def = AlgorithmDef(algo_def.algo, params, algo_def.mode)
+    cg = load_graph_module(
+        algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+    distribution = _build_distribution(
+        dcop, cg, algo_module, args.distribution
+    )
+
+    timeout = args.timeout if args.timeout is not None else 20.0
+    orchestrator = run_local_thread_dcop(
+        algo_def, cg, distribution, dcop, infinity=args.infinity,
+        replication=True,
+    )
+    stopped = False
+    try:
+        if not orchestrator.wait_ready(10):
+            print("Error: agents did not become ready")
+            return 3
+        orchestrator.deploy_computations()
+        replica_dist = orchestrator.start_replication(args.ktarget)
+        orchestrator.run(scenario=scenario, timeout=timeout)
+        orchestrator.stop_agents(5)
+        stopped = True
+        metrics = orchestrator.end_metrics()
+        result = {
+            "status": metrics["status"],
+            "assignment": {
+                k: v for k, v in metrics["assignment"].items()
+                if k in dcop.variables
+            },
+            "cost": metrics["cost"],
+            "violation": metrics["violation"],
+            "time": metrics["time"],
+            "msg_count": metrics["msg_count"],
+            "msg_size": metrics["msg_size"],
+            "cycle": metrics["cycle"],
+            "agt_metrics": metrics["agt_metrics"],
+            "replication": {
+                "ktarget": args.ktarget,
+                "replica_distribution": replica_dist.mapping,
+                "repaired": sorted(
+                    orchestrator.mgt.repaired_computations
+                ),
+            },
+            "backend": "thread",
+        }
+    finally:
+        if not stopped:
+            orchestrator.stop_agents(5)
+        orchestrator.stop()
+
+    if args.run_metrics or args.end_metrics:
+        from pydcop_tpu.commands.metrics_io import add_csvline
+
+        for path in (args.run_metrics, args.end_metrics):
+            if path:
+                add_csvline(path, args.collect_on, result)
+
+    emit_result(result, args.output)
+    return 0

@@ -139,7 +139,8 @@ class OrchestratedAgent(Agent):
 
     def __init__(self, agent_def: AgentDef, comm: CommunicationLayer,
                  orchestrator_address,
-                 delay: Optional[float] = None):
+                 delay: Optional[float] = None,
+                 replication: bool = False):
         super().__init__(agent_def.name, comm, agent_def, delay=delay)
         self.discovery.use_directory(
             ORCHESTRATOR_AGENT, orchestrator_address
@@ -162,7 +163,36 @@ class OrchestratedAgent(Agent):
             self.discovery.discovery_computation.name, self.name,
             comm.address,
         )
+        # Resilience: host a replica-placement computation so this
+        # agent can replicate its computations and adopt others'
+        # replicas on repair (reference ResilientAgent, agents.py:927).
+        self.replication_comp = None
+        if replication:
+            from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+                build_replication_computation,
+            )
+
+            self.replication_comp = build_replication_computation(
+                self, self.discovery
+            )
+            self.add_computation(self.replication_comp)
+            self.discovery.register_computation(
+                self.replication_comp.name, self.name, comm.address
+            )
 
     def start(self):
         super().start()
         self._orchestration.start()
+        if self.replication_comp is not None:
+            self.replication_comp.start()
+
+
+def ResilientAgent(agent_def: AgentDef, comm: CommunicationLayer,
+                   orchestrator_address, delay: Optional[float] = None
+                   ) -> OrchestratedAgent:
+    """An orchestrated agent with replication enabled (reference
+    agents.py:927 ResilientAgent)."""
+    return OrchestratedAgent(
+        agent_def, comm, orchestrator_address, delay=delay,
+        replication=True,
+    )

@@ -57,6 +57,11 @@ class AgentsMgt(MessagePassingComputation):
         self.ready_agents: set = set()
         self.start_time: Optional[float] = None
         self.last_stop_time: Optional[float] = None
+        # Resilience bookkeeping: replica placement + repair progress.
+        self.replica_hosts: Dict[str, List[str]] = {}
+        self.replication_done_agents: set = set()
+        self.repaired_computations: set = set()
+        self.repair_event_count: int = 0
 
     @register("agent_ready")
     def _on_agent_ready(self, sender, msg, t):
@@ -81,6 +86,19 @@ class AgentsMgt(MessagePassingComputation):
     def _on_comp_finished(self, sender, msg, t):
         self.finished_computations.add(msg.computation)
         self.orchestrator._check_all_finished()
+
+    @register("replication_done")
+    def _on_replication_done(self, sender, msg, t):
+        for comp, hosts in msg.replica_hosts.items():
+            self.replica_hosts[comp] = list(hosts)
+        self.replication_done_agents.add(msg.agent)
+        self.orchestrator._replication_evt.set()
+
+    @register("repair_done")
+    def _on_repair_done(self, sender, msg, t):
+        self.repaired_computations.update(msg.computations)
+        self.repair_event_count += len(msg.computations)
+        self.orchestrator._repair_evt.set()
 
     @register("agent_stopped")
     def _on_agent_stopped(self, sender, msg, t):
@@ -166,6 +184,8 @@ class Orchestrator:
 
         self._ready_evt = threading.Event()
         self._finished_evt = threading.Event()
+        self._replication_evt = threading.Event()
+        self._repair_evt = threading.Event()
         self._stopped_agents: set = set()
         self._all_stopped_evt = threading.Event()
         self._expected_computations = [
@@ -247,15 +267,298 @@ class Orchestrator:
             daemon=True, name="scenario",
         ).start()
 
+    # -- resilience: replication + repair ------------------------------- #
+
+    def start_replication(self, k: int, timeout: float = 30):
+        """Ask every hosting agent to place k replicas of each of its
+        computations (reference orchestrator.py:223), then collect the
+        resulting replica distribution."""
+        from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+            ReplicateRequestMessage,
+            replication_computation_name,
+        )
+        from pydcop_tpu.replication.objects import ReplicaDistribution
+
+        # Every agent that registered a replication computation can
+        # host replicas; only agents with computations run a search.
+        prefix = replication_computation_name("")
+        resilient = sorted(
+            c[len(prefix):]
+            for c in self._agent.discovery.computations()
+            if c.startswith(prefix)
+        )
+        expected = sorted(
+            a for a in resilient
+            if self.distribution.computations_hosted(a)
+        )
+        self.mgt.replication_done_agents = set()
+        # Everyone gets the trigger (it carries the resilient-agent
+        # set used to bound the search graph); agents hosting nothing
+        # answer done immediately.
+        for agent in resilient:
+            self.mgt.post_msg(
+                replication_computation_name(agent),
+                ReplicateRequestMessage(k, resilient),
+                MSG_MGT,
+            )
+        deadline = time.monotonic() + timeout
+        while not set(expected) <= self.mgt.replication_done_agents:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                logger.warning(
+                    "Replication timed out; done agents: %s",
+                    sorted(self.mgt.replication_done_agents),
+                )
+                break
+            self._replication_evt.clear()
+            self._replication_evt.wait(min(0.1, remaining))
+        return ReplicaDistribution(self.mgt.replica_hosts)
+
     def remove_agent(self, agent: str):
-        """Scenario-driven agent removal: stop the agent; its orphaned
-        computations are tracked (repair-based migration arrives with
-        the replication layer)."""
+        """Scenario-driven agent removal: stop the agent, then migrate
+        its orphaned computations onto agents holding their replicas by
+        solving the repair DCOP (reference orchestrator.py:955-1178)."""
         orphaned = self.distribution.computations_hosted(agent)
         logger.warning(
             "Agent %s removed; orphaned computations: %s", agent, orphaned
         )
         self.mgt.post_msg(f"_mgt_{agent}", StopAgentMessage(), MSG_MGT)
+        mapping = self.distribution.mapping
+        mapping.pop(agent, None)
+        self.distribution = Distribution(mapping)
+        # Replicas hosted on the departed agent are gone with it.
+        for hosts in self.mgt.replica_hosts.values():
+            if agent in hosts:
+                hosts.remove(agent)
+        if orphaned:
+            self.repair(orphaned, departed=[agent])
+
+    def repair(self, orphaned: List[str], departed: List[str],
+               timeout: float = 10):
+        """Re-host orphaned computations on live replica holders.
+
+        The repair problem is built as a DCOP (reparation builders) and
+        solved centrally on the device engine — the TPU-native stand-in
+        for the reference's distributed MaxSum repair (see
+        pydcop_tpu/reparation/__init__.py docstring).  Falls back to a
+        greedy assignment when the DCOP solve violates hard constraints.
+        """
+        from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+            ActivateReplicaMessage,
+            replication_computation_name,
+        )
+        from pydcop_tpu.replication.objects import ReplicaDistribution
+        from pydcop_tpu.reparation.removal import (
+            candidate_agents,
+            unrepairable_computations,
+        )
+
+        replicas = ReplicaDistribution(self.mgt.replica_hosts)
+        candidates = candidate_agents(orphaned, replicas, departed)
+        lost = unrepairable_computations(candidates)
+        if lost:
+            logger.error(
+                "Computations lost (no live replica): %s", lost
+            )
+        repairable = [c for c in orphaned if c not in lost]
+        if not repairable:
+            return {}
+        placement = self._solve_repair_dcop(repairable, candidates)
+        # repaired_computations is cumulative across events; count
+        # completions to detect this call's activations (a computation
+        # can be repaired once per event).
+        pre_events = self.mgt.repair_event_count
+        for comp, host in placement.items():
+            self.mgt.post_msg(
+                replication_computation_name(host),
+                ActivateReplicaMessage(comp),
+                MSG_MGT,
+            )
+            self.distribution.host_on_agent(host, [comp])
+            # The activated replica is consumed.
+            if host in self.mgt.replica_hosts.get(comp, []):
+                self.mgt.replica_hosts[comp].remove(host)
+        deadline = time.monotonic() + timeout
+        while self.mgt.repair_event_count < pre_events + len(placement):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                logger.warning("Repair timed out")
+                break
+            self._repair_evt.clear()
+            self._repair_evt.wait(min(0.1, remaining))
+        logger.info("Repair placement: %s", placement)
+        return placement
+
+    def _solve_repair_dcop(self, orphaned: List[str],
+                           candidates: Dict[str, List[str]]
+                           ) -> Dict[str, str]:
+        """Build + solve the repair DCOP; returns comp -> agent."""
+        from pydcop_tpu.reparation import (
+            create_agent_capacity_constraint,
+            create_agent_comp_comm_constraint,
+            create_agent_hosting_constraint,
+            create_computation_hosted_constraint,
+            create_binary_variables_for,
+        )
+
+        agent_defs = self.dcop.agents
+        variables = create_binary_variables_for(orphaned, candidates)
+        repair = DCOP("_repair", objective="min")
+        for var in variables.values():
+            repair.add_variable(var)
+        by_agent: Dict[str, Dict[str, Any]] = {}
+        for (comp, agt), var in variables.items():
+            by_agent.setdefault(agt, {})[comp] = var
+        for comp in orphaned:
+            repair.add_constraint(create_computation_hosted_constraint(
+                comp, [variables[(comp, a)] for a in candidates[comp]]
+            ))
+        for agt, agt_vars in by_agent.items():
+            agent_def = agent_defs.get(agt)
+            capacity = (
+                agent_def.capacity if agent_def is not None else None
+            )
+            if capacity is not None:
+                repair.add_constraint(create_agent_capacity_constraint(
+                    agt, self._remaining_capacity(agt),
+                    {c: self._footprint(c) for c in agt_vars},
+                    agt_vars,
+                ))
+            hosting_costs = {
+                c: (agent_def.hosting_cost(c)
+                    if agent_def is not None else 0.0)
+                for c in agt_vars
+            }
+            if any(hosting_costs.values()):
+                repair.add_constraint(create_agent_hosting_constraint(
+                    agt, hosting_costs, agt_vars
+                ))
+            # Soft communication costs: route to each neighbor
+            # computation's current host (orphaned neighbors skipped —
+            # their future host is part of the same repair problem).
+            for comp, var in agt_vars.items():
+                neighbor_agents = {}
+                try:
+                    node = self.cg.computation(comp)
+                    for neighbor in node.neighbors:
+                        if neighbor in orphaned:
+                            continue
+                        try:
+                            neighbor_agents[neighbor] = \
+                                self.distribution.agent_for(neighbor)
+                        except KeyError:
+                            pass
+                except Exception:
+                    pass
+                if neighbor_agents and agent_def is not None:
+                    repair.add_constraint(
+                        create_agent_comp_comm_constraint(
+                            agt, comp, neighbor_agents,
+                            lambda a, b: agent_defs[a].route(b)
+                            if a in agent_defs else 1.0,
+                            self._comm_load,
+                            var,
+                        ))
+        placement = self._assign_from_repair_solve(
+            repair, variables, orphaned, candidates
+        )
+        return placement
+
+    def _remaining_capacity(self, agent: str) -> float:
+        """Capacity minus active computations and known replicas."""
+        agent_def = self.dcop.agents.get(agent)
+        if agent_def is None or agent_def.capacity is None:
+            return float("inf")
+        used = sum(
+            self._footprint(c)
+            for c in self.distribution.computations_hosted(agent)
+        )
+        used += sum(
+            self._footprint(c)
+            for c, hosts in self.mgt.replica_hosts.items()
+            if agent in hosts
+        )
+        return agent_def.capacity - used
+
+    def _comm_load(self, computation: str, neighbor: str) -> float:
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        try:
+            module = load_algorithm_module(self.algo.algo)
+            return float(module.communication_load(
+                self.cg.computation(computation), neighbor
+            ))
+        except Exception:
+            return 1.0
+
+    def _assign_from_repair_solve(self, repair: DCOP, variables,
+                                  orphaned, candidates
+                                  ) -> Dict[str, str]:
+        assignment = None
+        try:
+            from pydcop_tpu.api import solve as api_solve
+
+            res = api_solve(
+                repair, "maxsum", backend="device", max_cycles=60,
+            )
+            assignment = res["assignment"]
+        except Exception:
+            logger.exception(
+                "Device solve of repair DCOP failed; using greedy"
+            )
+        placement: Dict[str, str] = {}
+        if assignment is not None:
+            for comp in orphaned:
+                chosen = [
+                    a for a in candidates[comp]
+                    if assignment.get(
+                        variables[(comp, a)].name, 0
+                    ) == 1
+                ]
+                if len(chosen) == 1:
+                    placement[comp] = chosen[0]
+                else:
+                    placement = {}
+                    break
+        if not placement:
+            # Greedy fallback: cheapest (hosting cost, load) candidate
+            # with enough remaining capacity (capacity-less agents are
+            # always eligible); if no candidate fits, least-loaded
+            # wins — better oversubscribed than lost.
+            agent_defs = self.dcop.agents
+            loads: Dict[str, float] = {}
+            for comp in sorted(
+                orphaned, key=lambda c: -self._footprint(c)
+            ):
+                footprint = self._footprint(comp)
+                fitting = [
+                    a for a in candidates[comp]
+                    if self._remaining_capacity(a) - loads.get(a, 0.0)
+                    >= footprint
+                ]
+                pool = fitting or candidates[comp]
+                best = min(
+                    pool,
+                    key=lambda a: (
+                        (agent_defs[a].hosting_cost(comp)
+                         if a in agent_defs else 0.0),
+                        loads.get(a, 0.0),
+                    ),
+                )
+                placement[comp] = best
+                loads[best] = loads.get(best, 0.0) + footprint
+        return placement
+
+    def _footprint(self, comp_name: str) -> float:
+        from pydcop_tpu.algorithms import load_algorithm_module
+
+        try:
+            module = load_algorithm_module(self.algo.algo)
+            return float(
+                module.computation_memory(self.cg.computation(comp_name))
+            )
+        except Exception:
+            return 1.0
 
     def pause_agents(self):
         for agent in self.distribution.agents:

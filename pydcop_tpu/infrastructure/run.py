@@ -41,9 +41,12 @@ def _build_distribution(dcop: DCOP, cg, algo_module,
 
 def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                           infinity=float("inf"), delay=None,
+                          replication: bool = False,
                           ) -> Orchestrator:
     """One OrchestratedAgent thread per AgentDef + an orchestrator, all
-    with in-process transports (reference run.py:145)."""
+    with in-process transports (reference run.py:145).  With
+    ``replication=True`` agents are resilient: they host a
+    replica-placement computation for dynamic-DCOP repair."""
     comm = InProcessCommunicationLayer()
     orchestrator = Orchestrator(
         algo, cg, distribution, comm, dcop, infinity
@@ -54,11 +57,12 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
         if distribution.computations_hosted(a)
     }
     for agent_def in dcop.agents.values():
-        if agent_def.name not in hosting:
+        if agent_def.name not in hosting and not replication:
             continue
         agent_comm = InProcessCommunicationLayer()
         agent = OrchestratedAgent(
-            agent_def, agent_comm, orchestrator.address, delay=delay
+            agent_def, agent_comm, orchestrator.address, delay=delay,
+            replication=replication,
         )
         agent.start()
     return orchestrator

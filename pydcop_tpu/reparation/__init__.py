@@ -1,0 +1,136 @@
+"""Repair-as-DCOP: rebuild a distribution after agent departures.
+
+Reference parity: pydcop/reparation/__init__.py (:39
+create_computation_hosted_constraint, :70
+create_agent_capacity_constraint, :117 create_agent_hosting_constraint,
+:158 create_agent_comp_comm_constraint).
+
+The repair problem is itself a DCOP over binary variables
+``x_<computation>_<agent>`` ("computation is hosted on agent"):
+
+- hard: each orphaned computation is hosted exactly once;
+- hard: an agent's added load fits its remaining capacity;
+- soft: hosting costs of the chosen (agent, computation) pairs;
+- soft: communication cost between a candidate computation and its
+  neighbor computations, given where those are hosted.
+
+TPU note: unlike the reference — which solves this DCOP with MaxSum
+message-passing among the candidate agents — pydcop-tpu solves the
+repair DCOP *on device* with the batched engine (see
+``pydcop_tpu.infrastructure.orchestrator.Orchestrator.remove_agent``):
+the problem is small (|orphans| x |candidates| binary variables), so a
+single jitted solve is faster than any distributed protocol round.
+"""
+
+from typing import Callable, Dict, Iterable, List, Tuple
+
+from pydcop_tpu.dcop.objects import BinaryVariable
+from pydcop_tpu.dcop.relations import Constraint, NAryFunctionRelation
+
+DEFAULT_INFINITY = 10_000
+
+
+def binary_variable_name(computation: str, agent: str) -> str:
+    return f"x_{computation}_{agent}"
+
+
+def create_binary_variables_for(
+    orphaned: Iterable[str], candidates: Dict[str, List[str]]
+) -> Dict[Tuple[str, str], BinaryVariable]:
+    """One x_c_a variable per (orphaned computation, candidate agent)."""
+    variables = {}
+    for comp in orphaned:
+        for agent in candidates[comp]:
+            variables[(comp, agent)] = BinaryVariable(
+                binary_variable_name(comp, agent)
+            )
+    return variables
+
+
+def create_computation_hosted_constraint(
+    computation: str,
+    comp_variables: List[BinaryVariable],
+    infinity: float = DEFAULT_INFINITY,
+) -> Constraint:
+    """Hard: exactly one candidate hosts `computation`
+    (reference :39-68)."""
+
+    def hosted(*values):
+        return 0 if sum(values) == 1 else infinity
+
+    return NAryFunctionRelation(
+        hosted, list(comp_variables), name=f"c_hosted_{computation}"
+    )
+
+
+def create_agent_capacity_constraint(
+    agent: str,
+    remaining_capacity: float,
+    footprints: Dict[str, float],
+    agent_variables: Dict[str, BinaryVariable],
+    infinity: float = DEFAULT_INFINITY,
+) -> Constraint:
+    """Hard: total footprint accepted by `agent` fits its remaining
+    capacity (reference :70-114).
+
+    `footprints` and `agent_variables` are keyed by computation name.
+    """
+    comps = sorted(agent_variables)
+    variables = [agent_variables[c] for c in comps]
+    weights = [footprints[c] for c in comps]
+
+    def capacity(*values):
+        load = sum(w * v for w, v in zip(weights, values))
+        return 0 if load <= remaining_capacity else infinity
+
+    return NAryFunctionRelation(
+        capacity, variables, name=f"c_capacity_{agent}"
+    )
+
+
+def create_agent_hosting_constraint(
+    agent: str,
+    hosting_costs: Dict[str, float],
+    agent_variables: Dict[str, BinaryVariable],
+) -> Constraint:
+    """Soft: hosting cost incurred by `agent` for the computations it
+    accepts (reference :117-155)."""
+    comps = sorted(agent_variables)
+    variables = [agent_variables[c] for c in comps]
+    costs = [hosting_costs[c] for c in comps]
+
+    def hosting(*values):
+        return sum(c * v for c, v in zip(costs, values))
+
+    return NAryFunctionRelation(
+        hosting, variables, name=f"c_hosting_{agent}"
+    )
+
+
+def create_agent_comp_comm_constraint(
+    agent: str,
+    computation: str,
+    neighbor_agents: Dict[str, str],
+    route: Callable[[str, str], float],
+    comm_load: Callable[[str, str], float],
+    variable: BinaryVariable,
+) -> Constraint:
+    """Soft: communication cost if `agent` hosts `computation`, summed
+    over its neighbor computations' hosting agents (reference
+    :158-199).
+
+    neighbor_agents: neighbor computation -> agent currently hosting it.
+    route(a, b): route cost between agents; comm_load(c, n): message
+    load between the computation and neighbor n.
+    """
+    total = sum(
+        route(agent, other) * comm_load(computation, neighbor)
+        for neighbor, other in neighbor_agents.items()
+    )
+
+    def comm(value):
+        return total * value
+
+    return NAryFunctionRelation(
+        comm, [variable], name=f"c_comm_{computation}_{agent}"
+    )

@@ -1,0 +1,66 @@
+"""Agent-removal analysis: what broke, who can fix it, with what.
+
+Reference parity: pydcop/reparation/removal.py
+(_removal_orphaned_computations :38, _removal_candidate_agents :61,
+_removal_candidate_computations_for_agt :84,
+_removal_candidate_computation_info :101,
+_removal_candidate_agt_info :145).
+"""
+
+from typing import Dict, List, Tuple
+
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.replication.objects import ReplicaDistribution
+
+
+def orphaned_computations(departed: List[str],
+                          distribution: Distribution) -> List[str]:
+    """Computations left without a host after `departed` agents left."""
+    orphaned = []
+    for agent in departed:
+        orphaned.extend(distribution.computations_hosted(agent))
+    return sorted(set(orphaned))
+
+
+def candidate_agents(orphaned: List[str],
+                     replicas: ReplicaDistribution,
+                     departed: List[str]) -> Dict[str, List[str]]:
+    """For each orphaned computation, the live agents holding one of
+    its replicas — the only agents able to restart it."""
+    departed_set = set(departed)
+    candidates: Dict[str, List[str]] = {}
+    for comp in orphaned:
+        try:
+            hosts = replicas.agents_for_computation(comp)
+        except KeyError:
+            hosts = []
+        candidates[comp] = sorted(
+            a for a in hosts if a not in departed_set
+        )
+    return candidates
+
+
+def candidate_computations_for_agent(
+    agent: str, candidates: Dict[str, List[str]]
+) -> List[str]:
+    """The orphaned computations `agent` could take over."""
+    return sorted(c for c, agts in candidates.items() if agent in agts)
+
+
+def unrepairable_computations(
+    candidates: Dict[str, List[str]]
+) -> List[str]:
+    """Orphans with no live replica: lost until agents come back."""
+    return sorted(c for c, agts in candidates.items() if not agts)
+
+
+def removal_info(
+    departed: List[str],
+    distribution: Distribution,
+    replicas: ReplicaDistribution,
+) -> Tuple[List[str], Dict[str, List[str]], List[str]]:
+    """One-call summary: (orphaned, candidates per orphan, lost)."""
+    orphaned = orphaned_computations(departed, distribution)
+    candidates = candidate_agents(orphaned, replicas, departed)
+    lost = unrepairable_computations(candidates)
+    return orphaned, candidates, lost

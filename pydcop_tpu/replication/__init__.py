@@ -1,0 +1,20 @@
+"""Resilience: k-replication of computations across agents.
+
+Reference parity: pydcop/replication/ (dist_ucs_hostingcosts.py — the
+AAMAS-18 distributed UCS replica placement; objects.py
+ReplicaDistribution :40; path_utils.py path-table algebra).
+"""
+
+from pydcop_tpu.replication.objects import ReplicaDistribution
+from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+    UCSReplication,
+    build_replication_computation,
+    replication_computation_name,
+)
+
+__all__ = [
+    "ReplicaDistribution",
+    "UCSReplication",
+    "build_replication_computation",
+    "replication_computation_name",
+]

@@ -1,0 +1,376 @@
+"""Distributed replica placement: uniform-cost search over agents with
+hosting costs.
+
+Reference parity: pydcop/replication/dist_ucs_hostingcosts.py
+(build_replication_computation :89, UCSReplicateMessage :118,
+ReplicationTracker :231, UCSReplication :265) — the AAMAS-18 algorithm
+placing k replicas of each of an agent's computations on other agents,
+exploring candidate hosts in increasing (route + hosting) cost order.
+
+Redesign notes (not a translation).  The reference's fully decentralised
+token-walk is replaced by an *owner-driven* uniform-cost search with the
+same cost model and the same message-passing constraints:
+
+- the search graph is agents + one virtual ``__hosting__`` node per
+  agent whose edge cost is that agent's hosting cost for the
+  computation (reference's virtual-node trick, dist_ucs_hostingcosts.py
+  module docstring);
+- route and hosting costs are *private* to each agent: the owner only
+  learns them through probe answers, so cost discovery stays
+  distributed — only the frontier bookkeeping is centralised on the
+  computation's owner, which removes the reference's budget-based
+  iterative deepening while preserving visit order (cheapest first);
+- capacity admission is decided by the remote agent at placement time
+  (two-phase: probe, then place), so concurrent searches from several
+  owners cannot oversubscribe an agent.
+
+Each agent runs one ``UCSReplication`` computation
+(``_replication_<agent>``).  The orchestrator triggers replication with
+a ``replicate`` message; when every hosted computation has k replicas
+(or candidates are exhausted), the owner reports a
+``replication_done`` message with the replica hosts.
+"""
+
+import heapq
+import logging
+from typing import Dict, List, Optional, Set, Tuple
+
+from pydcop_tpu.infrastructure.communication import MSG_MGT
+from pydcop_tpu.infrastructure.computations import (
+    MessagePassingComputation,
+    build_computation,
+    message_type,
+    register,
+)
+from pydcop_tpu.replication.path_utils import Path, before_last, last
+
+logger = logging.getLogger("pydcop.replication")
+
+# Virtual terminal node: a path ending here means "host on the node
+# before it" (hosting-cost edge).
+HOSTING = "__hosting__"
+
+# Replication runs between algorithm phases; give it management-level
+# priority so it finishes before the next event (reference :116).
+MSG_REPLICATION = MSG_MGT
+
+ReplicateRequestMessage = message_type(
+    "replicate", ["k", "agents"])
+UCSProbeMessage = message_type(
+    "ucs_probe", ["computation", "path", "footprint"])
+UCSProbeAnswerMessage = message_type(
+    "ucs_probe_answer",
+    ["computation", "path", "can_host", "hosting_cost", "routes"])
+PlaceReplicaMessage = message_type(
+    "place_replica", ["computation", "comp_def", "footprint", "path"])
+PlaceReplicaAnswerMessage = message_type(
+    "place_replica_answer", ["computation", "accepted", "path"])
+ActivateReplicaMessage = message_type(
+    "activate_replica", ["computation"])
+ReplicationDoneMessage = message_type(
+    "replication_done", ["agent", "replica_hosts"])
+RepairDoneMessage = message_type(
+    "repair_done", ["agent", "computations"])
+
+
+def replication_computation_name(agent_name: str) -> str:
+    return f"_replication_{agent_name}"
+
+
+def build_replication_computation(agent, discovery) -> "UCSReplication":
+    """Factory mirroring reference :89."""
+    return UCSReplication(agent, discovery)
+
+
+class _Search:
+    """Owner-side UCS state for one computation being replicated."""
+
+    def __init__(self, comp_name: str, comp_def, footprint: float,
+                 k: int, origin: str):
+        self.comp_name = comp_name
+        self.comp_def = comp_def
+        self.footprint = footprint
+        self.k_remaining = k
+        self.origin = origin
+        self.frontier: List[Tuple[float, int, Path]] = []
+        self._tie = 0
+        self.visited: Set[str] = {origin}
+        self.hosts: List[str] = []
+        self.rejected: Set[str] = set()
+        # (kind, path) of the in-flight request, or None.
+        self.awaiting: Optional[Tuple[str, Path]] = None
+        self.done = False
+
+    def push(self, cost: float, path: Path):
+        self._tie += 1
+        heapq.heappush(self.frontier, (cost, self._tie, path))
+
+    def pop(self) -> Tuple[float, Path]:
+        cost, _, path = heapq.heappop(self.frontier)
+        return cost, path
+
+
+class UCSReplication(MessagePassingComputation):
+    """Replica-placement computation, one per resilient agent.
+
+    Owner role: runs the UCS for each computation its agent hosts.
+    Host role: answers probes with private route/hosting costs and
+    admits replicas under its remaining capacity.
+    """
+
+    def __init__(self, agent, discovery):
+        super().__init__(replication_computation_name(agent.name))
+        self.agent = agent
+        self.discovery = discovery
+        # Replicas hosted here: comp -> (comp_def, footprint, origin).
+        self.replicas: Dict[str, Tuple] = {}
+        # Outcome of our own searches: comp -> hosts.
+        self.replica_hosts: Dict[str, List[str]] = {}
+        self._searches: Dict[str, _Search] = {}
+        self._known_agents: List[str] = []
+
+    # -- cost model ---------------------------------------------------- #
+
+    @property
+    def agent_def(self):
+        return self.agent.agent_def
+
+    def route(self, other: str) -> float:
+        if self.agent_def is None:
+            return 1.0
+        return self.agent_def.route(other)
+
+    def hosting_cost(self, computation: str) -> float:
+        if self.agent_def is None:
+            return 0.0
+        return self.agent_def.hosting_cost(computation)
+
+    def _remaining_capacity(self) -> float:
+        """Capacity minus active computations and hosted replicas
+        (reference _remaining_capacity :1226)."""
+        capacity = None
+        if self.agent_def is not None:
+            capacity = self.agent_def.capacity
+        if capacity is None:
+            return float("inf")
+        used = 0.0
+        for comp in self._own_computations():
+            used += _footprint(comp)
+        for _, footprint, _ in self.replicas.values():
+            used += footprint
+        return capacity - used
+
+    def _own_computations(self):
+        return [
+            c for c in self.agent.computations
+            if not c.name.startswith("_")
+            and getattr(c, "computation_def", None) is not None
+        ]
+
+    def _routes_to_known(self) -> Dict[str, float]:
+        """Private route costs to the other *resilient* agents.
+
+        Restricted to the resilient set announced by the trigger so the
+        search graph stays closed over agents that can actually answer
+        probes."""
+        return {
+            other: self.route(other) for other in self._known_agents
+            if other != self.agent.name
+        }
+
+    # -- owner side: running the searches ------------------------------ #
+
+    @register("replicate")
+    def _on_replicate(self, sender, msg, t):
+        """Trigger: place msg.k replicas of each hosted computation."""
+        self._known_agents = [
+            a for a in msg.agents if a != self.agent.name
+        ]
+        self._searches = {}
+        own = self._own_computations()
+        if not own:
+            self._report_done()
+            return
+        for comp in own:
+            search = _Search(
+                comp.name, comp.computation_def, _footprint(comp),
+                msg.k, self.agent.name,
+            )
+            for other in self._known_agents:
+                search.push(
+                    self.route(other), (self.agent.name, other)
+                )
+            self._searches[comp.name] = search
+        for name in list(self._searches):
+            self._continue_search(name)
+
+    def _continue_search(self, comp_name: str):
+        search = self._searches[comp_name]
+        while search.awaiting is None and not search.done:
+            if search.k_remaining == 0 or not search.frontier:
+                search.done = True
+                break
+            cost, path = search.pop()
+            if last(path) == HOSTING:
+                target = before_last(path)
+                if target in search.hosts or target in search.rejected:
+                    continue
+                search.awaiting = ("place", path, cost)
+                self.post_msg(
+                    replication_computation_name(target),
+                    PlaceReplicaMessage(
+                        comp_name, search.comp_def, search.footprint,
+                        path,
+                    ),
+                    MSG_REPLICATION,
+                )
+            else:
+                target = last(path)
+                if target in search.visited:
+                    continue
+                search.visited.add(target)
+                search.awaiting = ("probe", path, cost)
+                self.post_msg(
+                    replication_computation_name(target),
+                    UCSProbeMessage(comp_name, path, search.footprint),
+                    MSG_REPLICATION,
+                )
+        if all(s.done for s in self._searches.values()):
+            self._report_done()
+
+    @register("ucs_probe_answer")
+    def _on_probe_answer(self, sender, msg, t):
+        search = self._searches.get(msg.computation)
+        if search is None or search.awaiting is None:
+            return
+        _, path, cost = search.awaiting
+        if tuple(msg.path) != tuple(path):
+            return  # stale answer
+        search.awaiting = None
+        path = tuple(msg.path)
+        if msg.can_host:
+            search.push(cost + msg.hosting_cost, path + (HOSTING,))
+        for other, route_cost in msg.routes.items():
+            if other not in search.visited and other != search.origin:
+                search.push(cost + route_cost, path + (other,))
+        self._continue_search(msg.computation)
+
+    @register("place_replica_answer")
+    def _on_place_answer(self, sender, msg, t):
+        search = self._searches.get(msg.computation)
+        if search is None or search.awaiting is None:
+            return
+        search.awaiting = None
+        target = before_last(tuple(msg.path))
+        if msg.accepted:
+            search.hosts.append(target)
+            search.k_remaining -= 1
+        else:
+            # Capacity changed between probe and placement.
+            search.rejected.add(target)
+        self._continue_search(msg.computation)
+
+    def _report_done(self):
+        self.replica_hosts = {
+            name: list(s.hosts) for name, s in self._searches.items()
+        }
+        for name, s in self._searches.items():
+            if s.k_remaining > 0:
+                logger.warning(
+                    "Replication of %s incomplete: %d replicas placed, "
+                    "%d requested", name, len(s.hosts),
+                    len(s.hosts) + s.k_remaining,
+                )
+        from pydcop_tpu.infrastructure.orchestratedagents import (
+            ORCHESTRATOR_MGT,
+        )
+
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            ReplicationDoneMessage(self.agent.name, self.replica_hosts),
+            MSG_REPLICATION,
+        )
+
+    # -- host side: admitting replicas --------------------------------- #
+
+    @register("ucs_probe")
+    def _on_probe(self, sender, msg, t):
+        can_host = (
+            msg.footprint <= self._remaining_capacity()
+            and msg.computation not in self.replicas
+            and not any(
+                c.name == msg.computation
+                for c in self._own_computations()
+            )
+        )
+        self.post_msg(
+            sender,
+            UCSProbeAnswerMessage(
+                msg.computation, msg.path, can_host,
+                self.hosting_cost(msg.computation),
+                self._routes_to_known(),
+            ),
+            MSG_REPLICATION,
+        )
+
+    @register("place_replica")
+    def _on_place(self, sender, msg, t):
+        accepted = (
+            msg.footprint <= self._remaining_capacity()
+            and msg.computation not in self.replicas
+        )
+        if accepted:
+            self.replicas[msg.computation] = (
+                msg.comp_def, msg.footprint, sender,
+            )
+            self.discovery.register_replica(
+                msg.computation, self.agent.name
+            )
+        self.post_msg(
+            sender,
+            PlaceReplicaAnswerMessage(msg.computation, accepted, msg.path),
+            MSG_REPLICATION,
+        )
+
+    @register("activate_replica")
+    def _on_activate(self, sender, msg, t):
+        """Repair: promote a hosted replica to a live computation
+        (reference repair flow, orchestrator.py:440-534 /
+        agents.py:1384)."""
+        from pydcop_tpu.infrastructure.orchestratedagents import (
+            ORCHESTRATOR_MGT,
+        )
+
+        entry = self.replicas.pop(msg.computation, None)
+        if entry is None:
+            logger.error(
+                "Cannot activate %s on %s: no replica here",
+                msg.computation, self.agent.name,
+            )
+            return
+        comp_def, _, _ = entry
+        computation = build_computation(comp_def)
+        self.agent.add_computation(computation)
+        computation.start()
+        self.discovery.unregister_replica(
+            msg.computation, self.agent.name
+        )
+        self.post_msg(
+            ORCHESTRATOR_MGT,
+            RepairDoneMessage(self.agent.name, [msg.computation]),
+            MSG_REPLICATION,
+        )
+
+    def hosted_replicas(self) -> Dict[str, Tuple[str, float]]:
+        """comp -> (origin agent, footprint), reference :332."""
+        return {
+            c: (origin, footprint)
+            for c, (_, footprint, origin) in self.replicas.items()
+        }
+
+
+def _footprint(comp) -> float:
+    try:
+        return float(comp.footprint())
+    except Exception:
+        return 1.0

@@ -1,0 +1,77 @@
+"""Replica placement data objects.
+
+Reference parity: pydcop/replication/objects.py (ReplicaDistribution
+:40-80: mapping computation -> hosting agents, replicas_on :64,
+agents_for_computation :72).
+"""
+
+from typing import Dict, List
+
+from pydcop_tpu.utils.simple_repr import SimpleRepr
+
+
+class ReplicaDistribution(SimpleRepr):
+    """Mapping computation-name -> list of agents hosting a replica.
+
+    >>> rd = ReplicaDistribution({'c1': ['a1', 'a2'], 'c2': ['a2']})
+    >>> rd.agents_for_computation('c1')
+    ['a1', 'a2']
+    >>> rd.replicas_on('a2')
+    ['c1', 'c2']
+    """
+
+    def __init__(self, mapping: Dict[str, List[str]]):
+        self._mapping = {c: list(agts) for c, agts in mapping.items()}
+
+    @property
+    def mapping(self) -> Dict[str, List[str]]:
+        return {c: list(agts) for c, agts in self._mapping.items()}
+
+    @property
+    def computations(self) -> List[str]:
+        return list(self._mapping)
+
+    def agents_for_computation(self, computation: str) -> List[str]:
+        return list(self._mapping[computation])
+
+    def replicas_on(self, agent: str,
+                    raise_on_unknown: bool = False) -> List[str]:
+        found = sorted(
+            c for c, agts in self._mapping.items() if agent in agts
+        )
+        if not found and raise_on_unknown and not any(
+            agent in agts for agts in self._mapping.values()
+        ):
+            raise ValueError(f"No replicas on agent {agent}")
+        return found
+
+    def add_replica(self, computation: str, agent: str):
+        hosts = self._mapping.setdefault(computation, [])
+        if agent not in hosts:
+            hosts.append(agent)
+
+    def remove_agent(self, agent: str):
+        """Drop every replica hosted on a departed agent."""
+        for hosts in self._mapping.values():
+            if agent in hosts:
+                hosts.remove(agent)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ReplicaDistribution)
+            and self._mapping == other._mapping
+        )
+
+    def __repr__(self):
+        return f"ReplicaDistribution({self._mapping})"
+
+    def _simple_repr(self):
+        return {
+            "__module__": self.__class__.__module__,
+            "__qualname__": self.__class__.__qualname__,
+            "mapping": self.mapping,
+        }
+
+    @classmethod
+    def _from_repr(cls, r):
+        return cls(r["mapping"])

@@ -1,0 +1,76 @@
+"""Path-table algebra for the UCS replica-placement search.
+
+Reference parity: pydcop/replication/path_utils.py (cheapest_path_to
+:99, affordable_path_from :125, filter_missing_agents_paths :135,
+head/last/before_last :38-78).
+
+A *path* is a tuple of agent names from the replication origin to a
+candidate host; a *paths table* is a list of ``(cost, path)`` entries
+kept sorted by cost (cheapest first).  All functions are pure — they
+return new tables instead of mutating, which keeps the search state
+easy to reason about (and to snapshot into messages).
+"""
+
+import bisect
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+Path = Tuple[str, ...]
+PathsTable = List[Tuple[float, Path]]
+
+
+def head(path: Sequence[str]) -> Optional[str]:
+    """First node of a path (origin agent)."""
+    return path[0] if path else None
+
+
+def last(path: Sequence[str]) -> Optional[str]:
+    """Last node of a path (the candidate host)."""
+    return path[-1] if path else None
+
+
+def before_last(path: Sequence[str]) -> Optional[str]:
+    """The node just before the last one."""
+    if len(path) < 2:
+        raise IndexError(f"Path {path} has no before-last element")
+    return path[-2]
+
+
+def add_path(paths: PathsTable, cost: float, path: Path) -> PathsTable:
+    """Return a new table with (cost, path) inserted in sorted order."""
+    new = list(paths)
+    bisect.insort(new, (cost, path))
+    return new
+
+
+def remove_path(paths: PathsTable, path: Path) -> PathsTable:
+    """Return a new table without any entry for `path`."""
+    return [(c, p) for c, p in paths if p != path]
+
+
+def cheapest_path_to(target: str, paths: PathsTable
+                     ) -> Tuple[float, Path]:
+    """Cheapest path ending at `target`; (inf, ()) if none."""
+    for cost, path in paths:
+        if last(path) == target:
+            return cost, path
+    return float("inf"), ()
+
+
+def affordable_path_from(prefix: Path, max_cost: float,
+                         paths: PathsTable) -> PathsTable:
+    """All paths extending `prefix` whose cost is <= max_cost."""
+    n = len(prefix)
+    return [
+        (cost, path) for cost, path in paths
+        if cost <= max_cost and path[:n] == prefix and len(path) > n
+    ]
+
+
+def filter_missing_agents_paths(paths: PathsTable,
+                                available: Iterable[str]) -> PathsTable:
+    """Drop paths that traverse an agent that has left the system."""
+    available = set(available)
+    return [
+        (cost, path) for cost, path in paths
+        if all(node in available for node in path[1:])
+    ]

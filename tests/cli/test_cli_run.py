@@ -1,0 +1,61 @@
+"""CLI tests for the dynamic-DCOP commands: run + replica_dist.
+
+Mirrors the reference's CLI test strategy (subprocess + JSON results,
+tests/dcop_cli/).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REF_INSTANCES = "/root/reference/tests/instances"
+INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def run_cli(args, timeout=120):
+    out = subprocess.check_output(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli"] + args,
+        timeout=timeout, env=ENV,
+    )
+    return json.loads(out)
+
+
+def test_replica_dist_places_replicas():
+    out = subprocess.check_output(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli",
+         "replica_dist", "-a", "dsa", "-d", "adhoc", "-k", "2",
+         os.path.join(REF_INSTANCES,
+                      "graph_coloring_4agts_10vars.yaml")],
+        timeout=120, env=ENV,
+    ).decode()
+    assert "replica_dist:" in out
+    # Every variable computation must have 2 replicas.
+    import yaml
+
+    data = yaml.safe_load(out)
+    mapping = data["replica_dist"]
+    assert len(mapping) == 10
+    for comp, hosts in mapping.items():
+        assert len(hosts) == 2, f"{comp}: {hosts}"
+
+
+def test_run_with_scenario_repairs():
+    result = run_cli([
+        "-t", "8",
+        "run", "-a", "dsa", "-d", "adhoc", "-k", "2",
+        "-s", os.path.join(INSTANCES, "scenario_remove_a1.yaml"),
+        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+    ], timeout=180)
+    assert result["status"] in ("FINISHED", "TIMEOUT")
+    # All 10 variables still have a value despite a1's departure.
+    assert len(result["assignment"]) == 10
+    replication = result["replication"]
+    assert replication["ktarget"] == 2
+    # a1 hosted at least v1 (must_host hint): repair happened.
+    assert replication["repaired"], "no computation was repaired"

@@ -1,0 +1,242 @@
+"""Replication + repair tests.
+
+Mirrors the reference test strategy for resilience
+(tests/unit/test_reparation.py, test_reparation_removal.py): pure
+builders tested in-memory, plus an end-to-end threaded run exercising
+replication, agent removal and repair.
+"""
+
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.computations_graph import constraints_hypergraph as chg
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.replication.objects import ReplicaDistribution
+from pydcop_tpu.replication.path_utils import (
+    add_path,
+    affordable_path_from,
+    before_last,
+    cheapest_path_to,
+    filter_missing_agents_paths,
+    head,
+    last,
+    remove_path,
+)
+from pydcop_tpu.reparation import (
+    create_agent_capacity_constraint,
+    create_agent_hosting_constraint,
+    create_computation_hosted_constraint,
+    create_binary_variables_for,
+)
+from pydcop_tpu.reparation.removal import (
+    candidate_agents,
+    orphaned_computations,
+    removal_info,
+    unrepairable_computations,
+)
+
+
+class TestPathUtils:
+    def test_head_last(self):
+        assert head(("a", "b", "c")) == "a"
+        assert last(("a", "b", "c")) == "c"
+        assert before_last(("a", "b", "c")) == "b"
+        assert head(()) is None
+        with pytest.raises(IndexError):
+            before_last(("a",))
+
+    def test_table_sorted_insert(self):
+        t = add_path([], 2.0, ("a", "b"))
+        t = add_path(t, 1.0, ("a", "c"))
+        assert t[0] == (1.0, ("a", "c"))
+
+    def test_cheapest_path_to(self):
+        t = [(1.0, ("a", "c")), (2.0, ("a", "b", "c")), (3.0, ("a", "b"))]
+        cost, path = cheapest_path_to("c", t)
+        assert cost == 1.0 and path == ("a", "c")
+        cost, path = cheapest_path_to("z", t)
+        assert cost == float("inf") and path == ()
+
+    def test_affordable_path_from(self):
+        t = [
+            (1.0, ("a", "b")),
+            (2.0, ("a", "b", "c")),
+            (5.0, ("a", "b", "d")),
+            (2.0, ("a", "x")),
+        ]
+        found = affordable_path_from(("a", "b"), 3.0, t)
+        assert found == [(2.0, ("a", "b", "c"))]
+
+    def test_filter_missing(self):
+        t = [(1.0, ("a", "b")), (2.0, ("a", "c", "d"))]
+        kept = filter_missing_agents_paths(t, {"b", "d"})
+        assert kept == [(1.0, ("a", "b"))]
+
+    def test_remove_path(self):
+        t = [(1.0, ("a", "b")), (2.0, ("a", "c"))]
+        assert remove_path(t, ("a", "b")) == [(2.0, ("a", "c"))]
+
+
+class TestReplicaDistribution:
+    def test_mapping(self):
+        rd = ReplicaDistribution({"c1": ["a1", "a2"], "c2": ["a2"]})
+        assert rd.agents_for_computation("c1") == ["a1", "a2"]
+        assert rd.replicas_on("a2") == ["c1", "c2"]
+        assert rd.replicas_on("a1") == ["c1"]
+
+    def test_add_remove(self):
+        rd = ReplicaDistribution({"c1": ["a1"]})
+        rd.add_replica("c1", "a3")
+        rd.add_replica("c1", "a3")  # idempotent
+        assert rd.agents_for_computation("c1") == ["a1", "a3"]
+        rd.remove_agent("a1")
+        assert rd.agents_for_computation("c1") == ["a3"]
+
+
+class TestReparationBuilders:
+    def _vars(self):
+        return create_binary_variables_for(
+            ["c1", "c2"], {"c1": ["a1", "a2"], "c2": ["a2"]}
+        )
+
+    def test_binary_variables(self):
+        variables = self._vars()
+        assert set(variables) == {("c1", "a1"), ("c1", "a2"),
+                                  ("c2", "a2")}
+        assert variables[("c1", "a1")].name == "x_c1_a1"
+
+    def test_hosted_constraint(self):
+        variables = self._vars()
+        c = create_computation_hosted_constraint(
+            "c1", [variables[("c1", "a1")], variables[("c1", "a2")]]
+        )
+        assert c(0, 1) == 0
+        assert c(1, 0) == 0
+        assert c(1, 1) >= 10_000
+        assert c(0, 0) >= 10_000
+
+    def test_capacity_constraint(self):
+        variables = self._vars()
+        agt_vars = {"c1": variables[("c1", "a2")],
+                    "c2": variables[("c2", "a2")]}
+        c = create_agent_capacity_constraint(
+            "a2", 10.0, {"c1": 6.0, "c2": 7.0}, agt_vars
+        )
+        # order of args follows sorted comp names: c1, c2
+        assert c(1, 0) == 0
+        assert c(0, 1) == 0
+        assert c(1, 1) >= 10_000
+
+    def test_hosting_constraint(self):
+        variables = self._vars()
+        agt_vars = {"c1": variables[("c1", "a2")],
+                    "c2": variables[("c2", "a2")]}
+        c = create_agent_hosting_constraint(
+            "a2", {"c1": 3.0, "c2": 5.0}, agt_vars
+        )
+        assert c(1, 1) == 8.0
+        assert c(1, 0) == 3.0
+        assert c(0, 0) == 0.0
+
+
+class TestRemoval:
+    def test_orphaned(self):
+        dist = Distribution({"a1": ["c1", "c2"], "a2": ["c3"]})
+        assert orphaned_computations(["a1"], dist) == ["c1", "c2"]
+
+    def test_candidates_exclude_departed(self):
+        replicas = ReplicaDistribution(
+            {"c1": ["a2", "a3"], "c2": ["a1", "a3"]}
+        )
+        cands = candidate_agents(["c1", "c2"], replicas, ["a1", "a2"])
+        assert cands == {"c1": ["a3"], "c2": ["a3"]}
+
+    def test_unrepairable(self):
+        cands = {"c1": ["a3"], "c2": []}
+        assert unrepairable_computations(cands) == ["c2"]
+
+    def test_removal_info(self):
+        dist = Distribution({"a1": ["c1"], "a2": ["c2"]})
+        replicas = ReplicaDistribution({"c1": ["a2"]})
+        orphaned, cands, lost = removal_info(["a1"], dist, replicas)
+        assert orphaned == ["c1"]
+        assert cands == {"c1": ["a2"]}
+        assert lost == []
+
+
+def _coloring_dcop(n_agents=4):
+    """3-variable coloring over n agents with capacity + costs."""
+    d = Domain("colors", "", ["R", "G", "B"])
+    dcop = DCOP("resilient", objective="min")
+    variables = [Variable(f"v{i}", d) for i in range(3)]
+    for v in variables:
+        dcop.add_variable(v)
+    for i, j in [(0, 1), (1, 2)]:
+        dcop.add_constraint(constraint_from_str(
+            f"diff_{i}_{j}",
+            f"10 if v{i} == v{j} else 0",
+            [variables[i], variables[j]],
+        ))
+    dcop.add_agents([
+        AgentDef(f"a{i}", capacity=100, default_hosting_cost=i)
+        for i in range(n_agents)
+    ])
+    return dcop
+
+
+class TestReplicationEndToEnd:
+    def _setup(self, k=2):
+        from pydcop_tpu.infrastructure.run import run_local_thread_dcop
+
+        dcop = _coloring_dcop()
+        algo = AlgorithmDef.build_with_default_param("dsa", mode="min")
+        cg = chg.build_computation_graph(dcop)
+        # v0,v1 on a0; v2 on a1; a2/a3 idle but resilient.
+        dist = Distribution(
+            {"a0": ["v0", "v1"], "a1": ["v2"], "a2": [], "a3": []}
+        )
+        orchestrator = run_local_thread_dcop(
+            algo, cg, dist, dcop, replication=True
+        )
+        return orchestrator
+
+    def test_replication_places_k_replicas(self):
+        orchestrator = self._setup()
+        try:
+            assert orchestrator.wait_ready(10)
+            orchestrator.deploy_computations()
+            rd = orchestrator.start_replication(2, timeout=20)
+            for comp in ["v0", "v1", "v2"]:
+                hosts = rd.agents_for_computation(comp)
+                assert len(hosts) == 2, f"{comp}: {hosts}"
+                owner = orchestrator.distribution.agent_for(comp)
+                assert owner not in hosts
+                assert len(set(hosts)) == 2
+        finally:
+            orchestrator.stop_agents(5)
+            orchestrator.stop()
+
+    def test_repair_after_removal(self):
+        orchestrator = self._setup()
+        try:
+            assert orchestrator.wait_ready(10)
+            orchestrator.deploy_computations()
+            orchestrator.start_replication(2, timeout=20)
+            placement = None
+            orchestrator.pause_agents()
+            orchestrator.remove_agent("a0")
+            orchestrator.resume_agents()
+            # v0 and v1 must have been re-hosted on live agents.
+            dist = orchestrator.distribution
+            assert "a0" not in dist.agents
+            for comp in ["v0", "v1"]:
+                host = dist.agent_for(comp)
+                assert host in {"a1", "a2", "a3"}
+            assert set(orchestrator.mgt.repaired_computations) == \
+                {"v0", "v1"}
+        finally:
+            orchestrator.stop_agents(5)
+            orchestrator.stop()
