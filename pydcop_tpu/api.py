@@ -32,6 +32,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           max_cycles: int = 1000,
           algo_params: Optional[Dict[str, Any]] = None,
           mesh=None, n_devices: Optional[int] = None,
+          ui_port: Optional[int] = None,
           ) -> SolveResult:
     """Solve a DCOP and return assignment + quality metrics.
 
@@ -70,7 +71,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             backend="device",
         )
 
-    if backend == "thread":
+    if backend in ("thread", "process"):
         from pydcop_tpu.infrastructure.agent_algorithms import (
             has_agent_computation,
         )
@@ -90,7 +91,8 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             timeout = 15.0
         return solve_with_agents(
             dcop, algo_def, distribution=distribution,
-            timeout=timeout, max_cycles=max_cycles,
+            timeout=timeout, max_cycles=max_cycles, mode=backend,
+            ui_port=ui_port,
         )
 
     raise ValueError(f"Unknown backend {backend!r}")

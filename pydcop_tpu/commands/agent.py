@@ -1,14 +1,88 @@
-"""``pydcop agent`` — placeholder, implemented later this round.
+"""``pydcop agent``: standalone agents connecting to a remote
+orchestrator.
 
-Reference parity target: pydcop/commands/agent.py.
+Reference parity: pydcop/commands/agent.py (run_cmd :223) — start N
+named agents on this machine, each with its own HTTP transport,
+registering with the orchestrator given by ``--orchestrator host:port``.
+``--restart`` relaunches the agents after a run ends (long-lived
+worker machines surviving successive runs).
 """
+
+import logging
+import time
+
+logger = logging.getLogger("pydcop.cli.agent")
 
 
 def set_parser(subparsers):
-    parser = subparsers.add_parser("agent", help="agent (not yet implemented)")
+    parser = subparsers.add_parser(
+        "agent", help="standalone agents for multi-machine runs")
+    parser.add_argument("-n", "--names", nargs="+", required=True,
+                        help="agent names (one agent per name)")
+    parser.add_argument("-o", "--orchestrator", required=True,
+                        help="orchestrator address as host:port")
+    parser.add_argument("--address", default="127.0.0.1",
+                        help="local address to listen on")
+    parser.add_argument("-p", "--port", type=int, default=9001,
+                        help="first local port (one per agent)")
+    parser.add_argument("--capacity", type=int, default=100,
+                        help="agent capacity")
+    parser.add_argument("--replication", action="store_true",
+                        default=False,
+                        help="host a replication computation "
+                             "(resilient agents)")
+    parser.add_argument("--restart", action="store_true", default=False,
+                        help="restart agents after each run")
     parser.set_defaults(func=run_cmd)
 
 
+def _start_agents(args, orchestrator_address):
+    from pydcop_tpu.dcop.objects import AgentDef
+    from pydcop_tpu.infrastructure.communication import (
+        HttpCommunicationLayer,
+    )
+    from pydcop_tpu.infrastructure.orchestratedagents import (
+        OrchestratedAgent,
+    )
+
+    agents = []
+    port = args.port
+    for name in args.names:
+        comm = HttpCommunicationLayer((args.address, port))
+        agent = OrchestratedAgent(
+            AgentDef(name, capacity=args.capacity), comm,
+            orchestrator_address, replication=args.replication,
+        )
+        agent.start()
+        logger.info("Agent %s on %s:%s", name, args.address, port)
+        agents.append(agent)
+        port += 1
+    return agents
+
+
 def run_cmd(args) -> int:
-    print("pydcop agent: not implemented yet in pydcop-tpu")
-    return 3
+    host, _, port_str = args.orchestrator.partition(":")
+    orchestrator_address = (host, int(port_str or 9000))
+    while True:
+        agents = _start_agents(args, orchestrator_address)
+        try:
+            # Block until every agent has been stopped by the
+            # orchestrator (StopAgentMessage) or the global timeout.
+            deadline = (
+                time.monotonic() + args.timeout
+                if args.timeout else None
+            )
+            while any(a._thread.is_alive() for a in agents):
+                for a in agents:
+                    a.join(0.5)
+                if deadline and time.monotonic() > deadline:
+                    for a in agents:
+                        a.clean_shutdown(2)
+                    break
+        finally:
+            for a in agents:
+                a.messaging.shutdown()
+        if not args.restart:
+            return 0
+        logger.info("Run finished; restarting agents (--restart)")
+        time.sleep(0.5)

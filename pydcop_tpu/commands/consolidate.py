@@ -1,14 +1,116 @@
-"""``pydcop consolidate`` — placeholder, implemented later this round.
+"""``pydcop consolidate``: extract statistics from result files.
 
-Reference parity target: pydcop/commands/consolidate.py.
+Reference parity: pydcop/commands/consolidate.py — two modes:
+
+- ``--solution``: extract end metrics (time, cost, cycle, msg_count,
+  msg_size, status) from JSON result files into CSV rows;
+- ``--distribution_cost <dist glob>``: evaluate distribution files
+  against a DCOP (cost / hosting / communication, using the
+  ilp_compref cost model).
 """
+
+import csv
+import glob
+import io
+import json
+import logging
+import os
+
+logger = logging.getLogger("pydcop.cli.consolidate")
+
+SOLUTION_HEADER = ["time", "cost", "cycle", "msg_count", "msg_size",
+                   "status"]
+DIST_HEADER = ["dcop", "distribution", "cost", "hosting",
+               "communication"]
 
 
 def set_parser(subparsers):
-    parser = subparsers.add_parser("consolidate", help="consolidate (not yet implemented)")
+    parser = subparsers.add_parser(
+        "consolidate", help="consolidate result files into csv")
+    parser.add_argument("files", nargs="+", help="input file(s)")
+    parser.add_argument("--solution", action="store_true", default=False,
+                        help="extract end metrics from json results")
+    parser.add_argument("--distribution_cost", default=None,
+                        help="distribution file (or glob) to cost "
+                             "against the dcop given in files")
+    parser.add_argument("--algo", default=None,
+                        help="algorithm (for distribution costs)")
+    parser.add_argument("--replace_output", action="store_true",
+                        default=False,
+                        help="overwrite the output file instead of "
+                             "appending")
     parser.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
-    print("pydcop consolidate: not implemented yet in pydcop-tpu")
-    return 3
+    if args.output and args.replace_output and \
+            os.path.exists(args.output):
+        os.remove(args.output)
+    if args.solution:
+        rows = []
+        for f in args.files:
+            try:
+                rows.append(_solution_row(f))
+            except Exception as e:
+                logger.warning("Skipping %s: %s", f, e)
+        _emit(rows, SOLUTION_HEADER, args.output)
+        return 0
+    if args.distribution_cost:
+        rows = _distribution_rows(
+            args.files, args.distribution_cost, args.algo
+        )
+        _emit(rows, DIST_HEADER, args.output)
+        return 0
+    print("Error: choose --solution or --distribution_cost")
+    return 2
+
+
+def _solution_row(path: str):
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return [data.get(k) for k in SOLUTION_HEADER]
+
+
+def _distribution_rows(dcop_files, dist_glob, algo):
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.computations_graph import load_graph_module
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.distribution import ilp_compref
+    from pydcop_tpu.distribution.yamlformat import load_dist_from_file
+
+    dcop = load_dcop_from_file(dcop_files)
+    algo_module = load_algorithm_module(algo)
+    cg = load_graph_module(
+        algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+    rows = []
+    for dist_file in sorted(
+        glob.glob(os.path.expanduser(dist_glob))
+    ):
+        try:
+            distribution = load_dist_from_file(dist_file)
+            cost, comm, hosting = ilp_compref.distribution_cost(
+                distribution, cg, dcop.agents.values(),
+                computation_memory=algo_module.computation_memory,
+                communication_load=algo_module.communication_load,
+            )
+            rows.append(
+                [dcop_files[0], dist_file, cost, hosting, comm]
+            )
+        except Exception as e:
+            logger.warning("Skipping %s: %s", dist_file, e)
+    return rows
+
+
+def _emit(rows, header, output):
+    if output:
+        new_file = not os.path.exists(output)
+        with open(output, "a", newline="", encoding="utf-8") as f:
+            writer = csv.writer(f)
+            if new_file:
+                writer.writerow(header)
+            writer.writerows(rows)
+    else:
+        buffer = io.StringIO()
+        writer = csv.writer(buffer)
+        writer.writerows(rows)
+        print(buffer.getvalue(), end="")

@@ -1,14 +1,124 @@
-"""``pydcop orchestrator`` — placeholder, implemented later this round.
+"""``pydcop orchestrator``: standalone orchestrator for multi-machine
+deployments.
 
-Reference parity target: pydcop/commands/orchestrator.py.
+Reference parity: pydcop/commands/orchestrator.py (run_cmd :391) — the
+orchestrator listens on an HTTP transport, waits for standalone agents
+(``pydcop agent`` on other machines/shells) to register through the
+directory, then deploys, runs and reports like ``pydcop solve``.
 """
+
+import logging
+
+from pydcop_tpu.commands._utils import build_algo_def, emit_result
+
+logger = logging.getLogger("pydcop.cli.orchestrator")
 
 
 def set_parser(subparsers):
-    parser = subparsers.add_parser("orchestrator", help="orchestrator (not yet implemented)")
+    parser = subparsers.add_parser(
+        "orchestrator",
+        help="standalone orchestrator for multi-machine runs")
+    parser.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
+    parser.add_argument("-a", "--algo", required=True,
+                        help="algorithm name")
+    parser.add_argument("-p", "--algo_params", action="append",
+                        help="algorithm parameter as name:value")
+    parser.add_argument("-d", "--distribution", default="oneagent",
+                        help="distribution method or file")
+    parser.add_argument("--address", default="127.0.0.1",
+                        help="address to listen on")
+    parser.add_argument("--port", type=int, default=9000,
+                        help="port to listen on")
+    parser.add_argument("-s", "--scenario", default=None,
+                        help="optional scenario yaml (dynamic run)")
+    parser.add_argument("-k", "--ktarget", type=int, default=0,
+                        help="replicate computations k times before "
+                             "running (requires agents started with "
+                             "--replication)")
+    parser.add_argument("--wait_ready_timeout", type=float, default=60,
+                        help="how long to wait for agents to register")
     parser.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
-    print("pydcop orchestrator: not implemented yet in pydcop-tpu")
-    return 3
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.computations_graph import load_graph_module
+    from pydcop_tpu.dcop.yamldcop import (
+        load_dcop_from_file,
+        load_scenario_from_file,
+    )
+    from pydcop_tpu.infrastructure.communication import (
+        HttpCommunicationLayer,
+    )
+    from pydcop_tpu.infrastructure.orchestrator import Orchestrator
+    from pydcop_tpu.infrastructure.run import _build_distribution
+
+    dcop = load_dcop_from_file(args.dcop_files)
+    scenario = (
+        load_scenario_from_file(args.scenario)
+        if args.scenario else None
+    )
+    algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
+    algo_module = load_algorithm_module(algo_def.algo)
+    cg = load_graph_module(
+        algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+    distribution = _build_distribution(
+        dcop, cg, algo_module, args.distribution
+    )
+
+    comm = HttpCommunicationLayer((args.address, args.port))
+    orchestrator = Orchestrator(
+        algo_def, cg, distribution, comm, dcop, args.infinity
+        if hasattr(args, "infinity") else float("inf"),
+    )
+    orchestrator.start()
+    stopped = False
+    try:
+        logger.info(
+            "Orchestrator on %s:%s, waiting for agents...",
+            args.address, args.port,
+        )
+        if not orchestrator.wait_ready(args.wait_ready_timeout):
+            print("Error: agents did not register in time")
+            return 3
+        orchestrator.deploy_computations()
+        replica_mapping = None
+        if args.ktarget:
+            replica_mapping = orchestrator.start_replication(
+                args.ktarget
+            ).mapping
+        timeout = args.timeout if args.timeout is not None else 30.0
+        orchestrator.run(scenario=scenario, timeout=timeout)
+        orchestrator.stop_agents(10)
+        stopped = True
+        metrics = orchestrator.end_metrics()
+        result = {
+            "status": metrics["status"],
+            "assignment": {
+                k: v for k, v in metrics["assignment"].items()
+                if k in dcop.variables
+            },
+            "cost": metrics["cost"],
+            "violation": metrics["violation"],
+            "time": metrics["time"],
+            "msg_count": metrics["msg_count"],
+            "msg_size": metrics["msg_size"],
+            "cycle": metrics["cycle"],
+            "agt_metrics": metrics["agt_metrics"],
+            "backend": "multi-machine",
+        }
+        if replica_mapping is not None:
+            result["replication"] = {
+                "ktarget": args.ktarget,
+                "replica_distribution": replica_mapping,
+                "repaired": sorted(
+                    orchestrator.mgt.repaired_computations
+                ),
+            }
+    finally:
+        if not stopped:
+            orchestrator.stop_agents(10)
+        orchestrator.stop()
+
+    emit_result(result, args.output)
+    return 0

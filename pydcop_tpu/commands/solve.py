@@ -37,12 +37,23 @@ def set_parser(subparsers):
     parser.add_argument("--end_metrics", default=None,
                         help="csv file for end metrics")
     parser.add_argument("--infinity", type=float, default=float("inf"))
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="first websocket UI port (one per agent, "
+                             "thread mode)")
+    parser.add_argument("--trace", default=None,
+                        help="per-step trace CSV file (thread mode, "
+                             "infrastructure/stats.py)")
     parser.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
     from pydcop_tpu.api import solve
     from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+    if args.trace:
+        from pydcop_tpu.infrastructure import stats
+
+        stats.set_stats_file(args.trace)
 
     dcop = load_dcop_from_file(args.dcop_files)
     algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
@@ -66,17 +77,13 @@ def run_cmd(args) -> int:
             "backend": "device",
         }
     else:
-        if args.mode == "process":
-            print("Error: --mode process not implemented yet; use "
-                  "device or thread")
-            return 3
         # Algorithms without a termination condition would run forever:
-        # bound thread runs when no explicit timeout was given.
+        # bound thread/process runs when no explicit timeout was given.
         timeout = args.timeout if args.timeout is not None else 15.0
         res = solve(
             dcop, algo_def, distribution=args.distribution,
-            backend="thread", timeout=timeout,
-            max_cycles=args.cycles,
+            backend=args.mode, timeout=timeout,
+            max_cycles=args.cycles, ui_port=args.uiport,
         )
         result = {
             "status": res["status"],
@@ -88,7 +95,7 @@ def run_cmd(args) -> int:
             "msg_size": res.get("msg_size", 0),
             "cycle": res.get("cycles", 0),
             "agt_metrics": res.get("agt_metrics", {}),
-            "backend": "thread",
+            "backend": res.get("backend", args.mode),
         }
 
     if args.run_metrics or args.end_metrics:

@@ -36,7 +36,8 @@ class Agent:
 
     def __init__(self, name: str, comm: CommunicationLayer,
                  agent_def: Optional[AgentDef] = None,
-                 delay: Optional[float] = None):
+                 delay: Optional[float] = None,
+                 ui_port: Optional[int] = None):
         self._name = name
         self.agent_def = agent_def
         self._comm = comm
@@ -58,6 +59,13 @@ class Agent:
         self.on_cycle_change: Optional[Callable] = None
         self.on_computation_finished: Optional[Callable] = None
         self.add_computation(self.discovery.discovery_computation)
+        # Optional live-observability websocket server (ui.py).
+        self.ui_server = None
+        if ui_port:
+            from pydcop_tpu.infrastructure.ui import UiServer
+
+            self.ui_server = UiServer(self, ui_port)
+            self.ui_server.start()
 
     # -- properties ---------------------------------------------------- #
 
@@ -167,12 +175,22 @@ class Agent:
                 comp.start()
 
     def _run(self):
+        from pydcop_tpu.infrastructure import stats
+
         while not self._stopping.is_set():
             cmsg = self._messaging.next_msg(0.05)
             if cmsg is not None:
                 t0 = time.monotonic()
                 self._handle_message(cmsg)
-                self.t_active += time.monotonic() - t0
+                duration = time.monotonic() - t0
+                self.t_active += duration
+                if stats.tracing_enabled():
+                    comp = self._computations.get(cmsg.dest_comp)
+                    stats.trace_computation(
+                        cmsg.dest_comp, duration,
+                        msg_in_count=1, msg_in_size=cmsg.msg.size,
+                        value=getattr(comp, "current_value", None),
+                    )
             self._process_periodic()
 
     def _handle_message(self, cmsg):
@@ -215,6 +233,8 @@ class Agent:
                 )
         self.stop()
         self.join(timeout)
+        if self.ui_server is not None:
+            self.ui_server.stop()
         self._messaging.shutdown()
 
     def join(self, timeout: Optional[float] = None):

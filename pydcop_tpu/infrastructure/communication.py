@@ -193,10 +193,20 @@ class HttpCommunicationLayer(CommunicationLayer):
     """JSON-over-HTTP transport: one HTTP server thread per agent,
     messages POSTed with simple_repr bodies (reference :313-492)."""
 
+    # Undeliverable messages are retried for this long before being
+    # dropped (covers agents starting before their orchestrator —
+    # reference communication.py:66-78 on_error retry semantics).
+    RETRY_WINDOW = 30.0
+    RETRY_INTERVAL = 0.5
+
     def __init__(self, address_port: Tuple[str, int]):
         super().__init__()
         self._host, self._port = address_port
         self._server: Optional[ThreadingHTTPServer] = None
+        self._retry_lock = threading.Lock()
+        self._retry_queue = []  # (expire_time, src, dest, cmsg)
+        self._retry_thread: Optional[threading.Thread] = None
+        self._shutdown = False
         self._start_server()
 
     @property
@@ -243,7 +253,19 @@ class HttpCommunicationLayer(CommunicationLayer):
 
     def send_msg(self, src_agent: str, dest_agent: str,
                  msg: ComputationMessage, on_error=None):
-        dest_address = self.discovery.agent_address(dest_agent)
+        error = self._try_send(src_agent, dest_agent, msg)
+        if error is not None:
+            if on_error == "fail":
+                raise UnreachableAgent(dest_agent)
+            self._schedule_retry(src_agent, dest_agent, msg, error)
+
+    def _try_send(self, src_agent: str, dest_agent: str,
+                  msg: ComputationMessage) -> Optional[str]:
+        """Attempt one delivery; returns an error string on failure."""
+        try:
+            dest_address = self.discovery.agent_address(dest_agent)
+        except Exception as e:
+            return f"unknown agent: {e}"
         host, port = dest_address
         body = json.dumps({
             "src_comp": msg.src_comp,
@@ -262,15 +284,58 @@ class HttpCommunicationLayer(CommunicationLayer):
         )
         try:
             urlrequest.urlopen(req, timeout=2.0)
+            return None
         except Exception as e:
-            logger.warning(
-                "Could not send message to %s at %s:%s : %s",
-                dest_agent, host, port, e,
+            return f"{host}:{port} unreachable: {e}"
+
+    def _schedule_retry(self, src_agent: str, dest_agent: str,
+                        msg: ComputationMessage, error: str):
+        logger.debug(
+            "Send to %s failed (%s); will retry for up to %.0fs",
+            dest_agent, error, self.RETRY_WINDOW,
+        )
+        with self._retry_lock:
+            self._retry_queue.append(
+                (time.monotonic() + self.RETRY_WINDOW,
+                 src_agent, dest_agent, msg)
             )
-            if on_error == "fail":
-                raise UnreachableAgent(dest_agent)
+            if self._retry_thread is None or \
+                    not self._retry_thread.is_alive():
+                self._retry_thread = threading.Thread(
+                    target=self._retry_loop,
+                    name=f"http_retry_{self._port}", daemon=True,
+                )
+                self._retry_thread.start()
+
+    def _retry_loop(self):
+        while not self._shutdown:
+            time.sleep(self.RETRY_INTERVAL)
+            with self._retry_lock:
+                pending, self._retry_queue = self._retry_queue, []
+                if not pending:
+                    # Drained: clear the thread ref under the lock so a
+                    # concurrent _schedule_retry starts a fresh thread
+                    # instead of relying on this dying one.
+                    self._retry_thread = None
+                    return
+            still_failing = []
+            for expire, src, dest, cmsg in pending:
+                error = self._try_send(src, dest, cmsg)
+                if error is None:
+                    continue
+                if time.monotonic() >= expire:
+                    logger.warning(
+                        "Dropping message to %s after %.0fs of "
+                        "retries: %s", dest, self.RETRY_WINDOW, error,
+                    )
+                else:
+                    still_failing.append((expire, src, dest, cmsg))
+            if still_failing:
+                with self._retry_lock:
+                    self._retry_queue.extend(still_failing)
 
     def shutdown(self):
+        self._shutdown = True
         if self._server:
             self._server.shutdown()
             self._server.server_close()

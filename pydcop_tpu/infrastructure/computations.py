@@ -11,6 +11,7 @@ import logging
 import random
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from pydcop_tpu.infrastructure.events import event_bus
 from pydcop_tpu.utils.simple_repr import SimpleRepr
 
 MSG_ALGO = 20
@@ -102,6 +103,14 @@ def message_type(name: str, fields: List[str]):
     for f in fields:
         attrs[f] = property(lambda self, _f=f: getattr(self, "_" + _f))
     cls = type(name, (Message,), attrs)
+    # Anchor the class in its *defining* module (not this factory's)
+    # and expose it there under the wire name, so from_repr can resolve
+    # "<defining module>.<name>" when deserializing over HTTP.
+    import sys
+
+    caller_globals = sys._getframe(1).f_globals
+    cls.__module__ = caller_globals.get("__name__", cls.__module__)
+    caller_globals.setdefault(name, cls)
     return cls
 
 
@@ -213,6 +222,10 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
 
     def on_message(self, sender: str, msg: Message, t: float):
         """Entry point used by the agent to deliver a message."""
+        if event_bus.enabled:
+            event_bus.emit(
+                f"computations.message_rcv.{self.name}", (sender, msg)
+            )
         if self._is_paused:
             self._paused_messages_recv.append((sender, msg, t))
             return
@@ -229,6 +242,10 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
 
     def post_msg(self, target: str, msg: Message, prio: int = MSG_ALGO,
                  on_error=None):
+        if event_bus.enabled:
+            event_bus.emit(
+                f"computations.message_snd.{self.name}", (target, msg)
+            )
         if self._is_paused:
             self._paused_messages_post.append((target, msg, prio, on_error))
             return
@@ -410,6 +427,10 @@ class DcopComputation(MessagePassingComputation):
         self._cycle_count += 1
         if getattr(self, "_on_cycle_cb", None):
             self._on_cycle_cb(self)
+        if event_bus.enabled:
+            event_bus.emit(
+                f"computations.cycle.{self.name}", self._cycle_count
+            )
 
     def footprint(self) -> float:
         from pydcop_tpu.algorithms import load_algorithm_module
@@ -447,11 +468,17 @@ class VariableComputation(DcopComputation):
     def value_selection(self, val, cost: float = 0.0):
         """Select a value; fires the value-change callback used by the
         orchestration layer for metrics (reference computations.py:1058)."""
+        from pydcop_tpu.infrastructure.events import event_bus
+
         self._previous_val = self._current_value
         self._current_value = val
         self._current_cost = cost
         if getattr(self, "_on_value_cb", None):
             self._on_value_cb(self)
+        if event_bus.enabled:
+            event_bus.emit(
+                f"computations.value.{self.name}", (val, cost)
+            )
 
     def random_value_selection(self):
         self.value_selection(random.choice(list(self._variable.domain)))

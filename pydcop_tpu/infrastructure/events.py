@@ -1,0 +1,72 @@
+"""Process-local event bus.
+
+Reference parity: pydcop/infrastructure/Events.py (EventDispatcher :41,
+singleton event_bus :98, get_bus :103).  Topics are dot-separated
+strings; subscriptions ending in ``*`` match any suffix
+(``computations.value.*`` matches ``computations.value.v1``).
+
+Emission is cheap when nobody listens (the common case: metrics off):
+one boolean check, no string matching.
+"""
+
+import logging
+import threading
+from typing import Callable, Dict, List
+
+logger = logging.getLogger("pydcop.events")
+
+
+class EventDispatcher:
+    """Topic-based pub/sub with ``*``-suffix wildcards."""
+
+    def __init__(self):
+        self._exact: Dict[str, List[Callable]] = {}
+        self._prefix: Dict[str, List[Callable]] = {}
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def subscribe(self, topic: str, cb: Callable) -> Callable:
+        with self._lock:
+            if topic.endswith("*"):
+                self._prefix.setdefault(topic[:-1], []).append(cb)
+            else:
+                self._exact.setdefault(topic, []).append(cb)
+            self.enabled = True
+        return cb
+
+    def unsubscribe(self, cb: Callable):
+        with self._lock:
+            for subs in (self._exact, self._prefix):
+                for topic in list(subs):
+                    if cb in subs[topic]:
+                        subs[topic].remove(cb)
+                    if not subs[topic]:
+                        del subs[topic]
+            self.enabled = bool(self._exact or self._prefix)
+
+    def emit(self, topic: str, data=None):
+        if not self.enabled:
+            return
+        with self._lock:
+            cbs = list(self._exact.get(topic, []))
+            for prefix, subs in self._prefix.items():
+                if topic.startswith(prefix):
+                    cbs.extend(subs)
+        for cb in cbs:
+            try:
+                cb(topic, data)
+            except Exception:
+                logger.exception("Event callback error for %s", topic)
+
+    def reset(self):
+        with self._lock:
+            self._exact.clear()
+            self._prefix.clear()
+            self.enabled = False
+
+
+event_bus = EventDispatcher()
+
+
+def get_bus() -> EventDispatcher:
+    return event_bus

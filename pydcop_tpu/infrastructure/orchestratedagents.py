@@ -140,8 +140,10 @@ class OrchestratedAgent(Agent):
     def __init__(self, agent_def: AgentDef, comm: CommunicationLayer,
                  orchestrator_address,
                  delay: Optional[float] = None,
-                 replication: bool = False):
-        super().__init__(agent_def.name, comm, agent_def, delay=delay)
+                 replication: bool = False,
+                 ui_port: Optional[int] = None):
+        super().__init__(agent_def.name, comm, agent_def, delay=delay,
+                         ui_port=ui_port)
         self.discovery.use_directory(
             ORCHESTRATOR_AGENT, orchestrator_address
         )

@@ -569,11 +569,14 @@ class Orchestrator:
             self.mgt.post_msg(f"_mgt_{agent}", ResumeMessage([]), MSG_MGT)
 
     def stop_agents(self, timeout: float = 5):
-        for agent in self.distribution.agents:
-            if self.distribution.computations_hosted(agent):
-                self.mgt.post_msg(
-                    f"_mgt_{agent}", StopAgentMessage(), MSG_MGT
-                )
+        # Every agent that registered gets a stop — idle agents (no
+        # hosted computation, e.g. spare resilient agents) must exit
+        # too.
+        for agent in set(self.distribution.agents) \
+                | self.mgt.ready_agents:
+            self.mgt.post_msg(
+                f"_mgt_{agent}", StopAgentMessage(), MSG_MGT
+            )
         self._all_stopped_evt.wait(timeout)
 
     # -- callbacks from mgt -------------------------------------------- #

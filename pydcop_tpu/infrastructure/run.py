@@ -42,6 +42,7 @@ def _build_distribution(dcop: DCOP, cg, algo_module,
 def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                           infinity=float("inf"), delay=None,
                           replication: bool = False,
+                          ui_port: Optional[int] = None,
                           ) -> Orchestrator:
     """One OrchestratedAgent thread per AgentDef + an orchestrator, all
     with in-process transports (reference run.py:145).  With
@@ -62,9 +63,69 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
         agent_comm = InProcessCommunicationLayer()
         agent = OrchestratedAgent(
             agent_def, agent_comm, orchestrator.address, delay=delay,
-            replication=replication,
+            replication=replication, ui_port=ui_port,
         )
         agent.start()
+        if ui_port:
+            ui_port += 1
+    return orchestrator
+
+
+def _process_agent_main(agent_def, port: int, orchestrator_address,
+                        replication: bool = False):
+    """Child-process entry: one agent on its own HTTP transport
+    (reference run.py:268 _build_process_agent)."""
+    import time as _time
+
+    from pydcop_tpu.infrastructure.communication import (
+        HttpCommunicationLayer,
+    )
+
+    comm = HttpCommunicationLayer(("127.0.0.1", port))
+    agent = OrchestratedAgent(
+        agent_def, comm, tuple(orchestrator_address),
+        replication=replication,
+    )
+    agent.start()
+    # Keep the process alive until the agent thread stops (StopAgent).
+    while agent._thread.is_alive():
+        agent.join(1.0)
+    _time.sleep(0.2)  # let the final AgentStopped POST drain
+    comm.shutdown()
+
+
+def run_local_process_dcop(algo: AlgorithmDef, cg, distribution, dcop,
+                           infinity=float("inf"),
+                           replication: bool = False,
+                           port: int = 9000) -> Orchestrator:
+    """One OS process per agent, JSON-over-HTTP transports on localhost
+    ports (reference run.py:225) — the single-host stand-in for true
+    multi-machine deployments."""
+    import multiprocessing
+
+    from pydcop_tpu.infrastructure.communication import (
+        HttpCommunicationLayer,
+    )
+
+    comm = HttpCommunicationLayer(("127.0.0.1", port))
+    orchestrator = Orchestrator(
+        algo, cg, distribution, comm, dcop, infinity
+    )
+    orchestrator.start()
+    ctx = multiprocessing.get_context("spawn")
+    for agent_def in dcop.agents.values():
+        if not distribution.computations_hosted(agent_def.name) \
+                and not replication:
+            continue
+        port += 1
+        p = ctx.Process(
+            target=_process_agent_main,
+            name=f"p_{agent_def.name}",
+            args=(agent_def, port, orchestrator.address),
+            kwargs={"replication": replication},
+            daemon=True,
+        )
+        p.start()
     return orchestrator
 
 
@@ -97,7 +158,9 @@ def solve(dcop: DCOP, algo_def, distribution="oneagent",
 
 def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
                       timeout: Optional[float] = 5,
-                      max_cycles: int = 0) -> Dict:
+                      max_cycles: int = 0,
+                      mode: str = "thread",
+                      ui_port: Optional[int] = None) -> Dict:
     """Full-metrics variant used by the api/CLI thread backend."""
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
@@ -129,10 +192,17 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
     if isinstance(distribution, str):
         distribution = _build_distribution(
             dcop, cg, algo_module, distribution)
-    orchestrator = run_local_thread_dcop(algo_def, cg, distribution, dcop)
+    if mode == "process":
+        orchestrator = run_local_process_dcop(
+            algo_def, cg, distribution, dcop
+        )
+    else:
+        orchestrator = run_local_thread_dcop(
+            algo_def, cg, distribution, dcop, ui_port=ui_port
+        )
     stopped = False
     try:
-        if not orchestrator.wait_ready(10):
+        if not orchestrator.wait_ready(30 if mode == "process" else 10):
             raise RuntimeError("Agents did not become ready in time")
         orchestrator.deploy_computations()
         orchestrator.run(timeout=timeout)
@@ -153,7 +223,7 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             "msg_count": metrics["msg_count"],
             "msg_size": metrics["msg_size"],
             "agt_metrics": metrics["agt_metrics"],
-            "backend": "thread",
+            "backend": mode,
         }
     finally:
         if not stopped:

@@ -1,0 +1,63 @@
+"""Opt-in per-step computation trace, written as CSV.
+
+Reference parity: pydcop/infrastructure/stats.py (column schema
+:49-64, set_stats_file :71, trace_computation :81 — off by default).
+
+Columns: timestamp, computation, step duration, messages in/out,
+message sizes in/out, current value.
+"""
+
+import csv
+import threading
+import time
+from typing import Optional
+
+COLUMNS = [
+    "time",
+    "computation",
+    "duration",
+    "msg_in_count",
+    "msg_in_size",
+    "msg_out_count",
+    "msg_out_size",
+    "value",
+]
+
+_lock = threading.Lock()
+_stats_file = None
+_writer = None
+
+
+def set_stats_file(path: Optional[str]):
+    """Enable (or disable with None) step tracing to a CSV file."""
+    global _stats_file, _writer
+    with _lock:
+        if _stats_file is not None:
+            _stats_file.close()
+            _stats_file = None
+            _writer = None
+        if path is not None:
+            _stats_file = open(path, "w", newline="",
+                               encoding="utf-8")
+            _writer = csv.writer(_stats_file)
+            _writer.writerow(COLUMNS)
+
+
+def tracing_enabled() -> bool:
+    return _stats_file is not None
+
+
+def trace_computation(computation: str, duration: float,
+                      msg_in_count: int = 0, msg_in_size: int = 0,
+                      msg_out_count: int = 0, msg_out_size: int = 0,
+                      value=None):
+    """Append one step row (no-op unless set_stats_file was called)."""
+    with _lock:
+        if _writer is None:
+            return
+        _writer.writerow([
+            f"{time.time():.6f}", computation, f"{duration:.6f}",
+            msg_in_count, msg_in_size, msg_out_count, msg_out_size,
+            "" if value is None else value,
+        ])
+        _stats_file.flush()

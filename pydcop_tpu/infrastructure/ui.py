@@ -1,0 +1,294 @@
+"""Per-agent websocket server for live observability (GUI clients).
+
+Reference parity: pydcop/infrastructure/ui.py (UiServer :43 — one
+websocket server per agent forwarding event-bus topics and answering
+agent/computation/value queries).
+
+The reference uses the third-party ``websocket-server`` package, which
+is not available here; this is a dependency-free RFC 6455 server
+(stdlib socket + hashlib/base64) supporting the subset GUI clients
+need: text frames, server push, small request/response commands.
+
+Protocol (JSON text frames):
+- client -> server: {"cmd": "agent"} | {"cmd": "computations"}
+  | {"cmd": "value", "computation": <name>}
+- server -> client: {"topic": <event topic>, "data": ...} for every
+  event-bus emission, plus {"reply": <cmd>, ...} answers.
+"""
+
+import base64
+import hashlib
+import json
+import logging
+import socket
+import struct
+import threading
+from typing import List, Optional
+
+from pydcop_tpu.infrastructure.events import event_bus
+
+logger = logging.getLogger("pydcop.ui")
+
+WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def _accept_key(client_key: str) -> str:
+    digest = hashlib.sha1(
+        (client_key + WS_GUID).encode("ascii")).digest()
+    return base64.b64encode(digest).decode("ascii")
+
+
+def encode_text_frame(payload: str) -> bytes:
+    """Server-to-client text frame (FIN + opcode 0x1, unmasked)."""
+    data = payload.encode("utf-8")
+    header = b"\x81"
+    n = len(data)
+    if n < 126:
+        header += struct.pack("!B", n)
+    elif n < 65536:
+        header += struct.pack("!BH", 126, n)
+    else:
+        header += struct.pack("!BQ", 127, n)
+    return header + data
+
+
+def decode_frame(sock: socket.socket):
+    """Read one client frame; returns (opcode, payload) or None on
+    EOF.  Client frames are masked per RFC 6455 §5.3."""
+    head = _read_exact(sock, 2)
+    if head is None:
+        return None
+    opcode = head[0] & 0x0F
+    masked = bool(head[1] & 0x80)
+    length = head[1] & 0x7F
+    if length == 126:
+        ext = _read_exact(sock, 2)
+        if ext is None:
+            return None
+        length = struct.unpack("!H", ext)[0]
+    elif length == 127:
+        ext = _read_exact(sock, 8)
+        if ext is None:
+            return None
+        length = struct.unpack("!Q", ext)[0]
+    mask = b""
+    if masked:
+        mask = _read_exact(sock, 4)
+        if mask is None:
+            return None
+    payload = _read_exact(sock, length) if length else b""
+    if payload is None:
+        return None
+    if masked:
+        payload = bytes(
+            b ^ mask[i % 4] for i, b in enumerate(payload)
+        )
+    return opcode, payload
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    data = b""
+    while len(data) < n:
+        try:
+            chunk = sock.recv(n - len(data))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        data += chunk
+    return data
+
+
+class UiServer:
+    """Websocket server attached to one agent."""
+
+    def __init__(self, agent, port: int):
+        self.agent = agent
+        self.port = port
+        self._server_sock: Optional[socket.socket] = None
+        self._clients: List[socket.socket] = []
+        self._clients_lock = threading.Lock()
+        self._running = False
+        self._forwarder = None
+
+    def start(self):
+        self._server_sock = socket.socket(
+            socket.AF_INET, socket.SOCK_STREAM)
+        self._server_sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server_sock.bind(("127.0.0.1", self.port))
+        self._server_sock.listen(5)
+        self._running = True
+        threading.Thread(
+            target=self._accept_loop, name=f"ui_{self.port}",
+            daemon=True,
+        ).start()
+        # Forward the whole computations.* topic space to clients
+        # (reference ui.py:68-74).
+        self._forwarder = event_bus.subscribe(
+            "computations.*", self._on_event
+        )
+        logger.info(
+            "UI server for agent %s on port %s",
+            self.agent.name, self.port,
+        )
+
+    def stop(self):
+        self._running = False
+        if self._forwarder is not None:
+            event_bus.unsubscribe(self._forwarder)
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+        with self._clients_lock:
+            for client in self._clients:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+            self._clients.clear()
+
+    # -- connections --------------------------------------------------- #
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                client, _ = self._server_sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._client_loop, args=(client,),
+                daemon=True,
+            ).start()
+
+    def _client_loop(self, client: socket.socket):
+        if not self._handshake(client):
+            client.close()
+            return
+        with self._clients_lock:
+            self._clients.append(client)
+        try:
+            while self._running:
+                frame = decode_frame(client)
+                if frame is None:
+                    break
+                opcode, payload = frame
+                if opcode == 0x8:  # close
+                    break
+                if opcode == 0x9:  # ping -> pong
+                    client.sendall(
+                        b"\x8a" + bytes([len(payload)]) + payload)
+                    continue
+                if opcode == 0x1:
+                    self._on_command(client, payload)
+        finally:
+            with self._clients_lock:
+                if client in self._clients:
+                    self._clients.remove(client)
+            try:
+                client.close()
+            except OSError:
+                pass
+
+    def _handshake(self, client: socket.socket) -> bool:
+        try:
+            request = client.recv(4096).decode("latin-1")
+        except OSError:
+            return False
+        key = None
+        for line in request.split("\r\n"):
+            if line.lower().startswith("sec-websocket-key:"):
+                key = line.split(":", 1)[1].strip()
+        if key is None:
+            return False
+        response = (
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {_accept_key(key)}\r\n\r\n"
+        )
+        client.sendall(response.encode("latin-1"))
+        return True
+
+    # -- push + commands ----------------------------------------------- #
+
+    def _on_event(self, topic: str, data):
+        # The bus is process-global: only forward events for
+        # computations this agent actually hosts.
+        comp = topic.rsplit(".", 1)[-1]
+        if not self.agent.has_computation(comp):
+            return
+        try:
+            payload = json.dumps(
+                {"topic": topic, "data": _jsonable(data)}
+            )
+        except Exception:
+            return
+        self._broadcast(payload)
+
+    def _broadcast(self, payload: str):
+        frame = encode_text_frame(payload)
+        with self._clients_lock:
+            clients = list(self._clients)
+        for client in clients:
+            try:
+                client.sendall(frame)
+            except OSError:
+                with self._clients_lock:
+                    if client in self._clients:
+                        self._clients.remove(client)
+
+    def _on_command(self, client: socket.socket, payload: bytes):
+        try:
+            request = json.loads(payload.decode("utf-8"))
+            cmd = request.get("cmd")
+        except Exception:
+            return
+        if cmd == "agent":
+            reply = {
+                "reply": "agent",
+                "agent": self.agent.name,
+                "computations": [
+                    c.name for c in self.agent.computations
+                ],
+            }
+        elif cmd == "computations":
+            reply = {
+                "reply": "computations",
+                "computations": {
+                    c.name: {
+                        "running": c.is_running,
+                        "value": getattr(c, "current_value", None),
+                    }
+                    for c in self.agent.computations
+                    if not c.name.startswith("_")
+                },
+            }
+        elif cmd == "value":
+            name = request.get("computation")
+            value = None
+            if self.agent.has_computation(name):
+                value = getattr(
+                    self.agent.computation(name),
+                    "current_value", None,
+                )
+            reply = {
+                "reply": "value", "computation": name,
+                "value": _jsonable(value),
+            }
+        else:
+            reply = {"reply": "error", "error": f"unknown cmd {cmd}"}
+        try:
+            client.sendall(encode_text_frame(json.dumps(reply)))
+        except OSError:
+            pass
+
+
+def _jsonable(data):
+    try:
+        json.dumps(data)
+        return data
+    except (TypeError, ValueError):
+        return repr(data)

@@ -1,0 +1,129 @@
+"""CLI tests for batch + consolidate.
+
+Mirrors the reference strategy: real subprocesses, temp work dirs
+(reference tests/dcop_cli/).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import yaml
+
+REF_INSTANCES = "/root/reference/tests/instances"
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def cli(args, cwd=None, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli"] + args,
+        cwd=cwd, timeout=timeout, env=ENV, capture_output=True,
+        text=True,
+    )
+
+
+def _batch_def(tmp_path):
+    return {
+        "sets": {
+            "colorings": {
+                "path": os.path.join(
+                    REF_INSTANCES, "graph_coloring1.yaml"),
+                "iterations": 1,
+            },
+        },
+        "global_options": {"timeout": 3},
+        "batches": {
+            "sweep": {
+                "command": "solve",
+                "command_options": {
+                    "algo": "dsa",
+                    "algo_params": {"variant": ["A", "B"],
+                                    "stop_cycle": 20},
+                    "mode": "thread",
+                },
+                "global_options": {
+                    "output": str(
+                        tmp_path / "out_{algo_params[variant]}.json"
+                    ),
+                },
+            },
+        },
+    }
+
+
+def test_batch_simulate_lists_jobs(tmp_path):
+    bench = tmp_path / "bench.yaml"
+    bench.write_text(yaml.safe_dump(_batch_def(tmp_path)))
+    res = cli(["batch", "--simulate", str(bench)])
+    assert res.returncode == 0
+    lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 2  # variant sweep: A, B
+    assert all("--algo dsa" in ln for ln in lines)
+    assert any("variant:A" in ln for ln in lines)
+    assert any("variant:B" in ln for ln in lines)
+
+
+def test_batch_runs_and_resumes(tmp_path):
+    bench = tmp_path / "bench.yaml"
+    spec = _batch_def(tmp_path)
+    bench.write_text(yaml.safe_dump(spec))
+    res = cli(["batch", str(bench)])
+    assert res.returncode == 0, res.stderr
+    for variant in ("A", "B"):
+        out = tmp_path / f"out_{variant}.json"
+        assert out.exists()
+        data = json.loads(out.read_text())
+        assert data["status"] in ("FINISHED", "TIMEOUT")
+    # Completed: progress file renamed to done_*.
+    assert not (tmp_path / "progress_bench.yaml").exists()
+    done = [f for f in os.listdir(tmp_path) if f.startswith("done_")]
+    assert done
+    # Seed a progress file marking all jobs done: nothing runs.
+    for variant in ("A", "B"):
+        (tmp_path / f"out_{variant}.json").unlink()
+    os.rename(tmp_path / done[0], tmp_path / "progress_bench.yaml")
+    res = cli(["batch", str(bench)])
+    assert res.returncode == 0
+    assert not (tmp_path / "out_A.json").exists()
+
+
+def test_consolidate_solution(tmp_path):
+    result = {
+        "time": 1.5, "cost": 2.0, "cycle": 10, "msg_count": 5,
+        "msg_size": 9, "status": "FINISHED",
+    }
+    f = tmp_path / "r.json"
+    f.write_text(json.dumps(result))
+    res = cli(["consolidate", "--solution", str(f)])
+    assert res.returncode == 0
+    assert res.stdout.strip() == "1.5,2.0,10,5,9,FINISHED"
+    # With --output: header + append.
+    out = tmp_path / "all.csv"
+    cli(["--output", str(out), "consolidate", "--solution", str(f)])
+    cli(["--output", str(out), "consolidate", "--solution", str(f)])
+    lines = out.read_text().strip().splitlines()
+    assert lines[0].startswith("time,cost")
+    assert len(lines) == 3
+
+
+def test_consolidate_distribution_cost(tmp_path):
+    dist = tmp_path / "dist.yaml"
+    dist.write_text(
+        "distribution:\n"
+        "  a1: [v1, v2, diff_1_2]\n"
+        "  a2: [v3, diff_2_3]\n"
+    )
+    res = cli([
+        "consolidate", "--distribution_cost", str(dist),
+        "--algo", "maxsum",
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    assert res.returncode == 0, res.stderr
+    row = res.stdout.strip().split(",")
+    assert len(row) == 5
+    assert row[1] == str(dist)

@@ -1,0 +1,60 @@
+"""CLI tests for process mode + standalone orchestrator/agent commands.
+
+This is how multi-node behavior is tested without a cluster (reference
+strategy, tests/dcop_cli/test_solve.py:55-58): HTTP transports on
+localhost ports.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REF_INSTANCES = "/root/reference/tests/instances"
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+FIXTURE = os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml")
+
+
+def test_solve_mode_process():
+    out = subprocess.check_output(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "5",
+         "solve", "-a", "dsa", "-d", "adhoc", "-m", "process",
+         FIXTURE],
+        timeout=180, env=ENV,
+    )
+    result = json.loads(out)
+    assert result["backend"] == "process"
+    assert len(result["assignment"]) == 10
+    assert result["msg_count"] > 0
+
+
+def test_orchestrator_and_agent_commands(tmp_path):
+    port = 19340
+    agent_proc = subprocess.Popen(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "40",
+         "agent", "-n", "a1", "a2", "a3", "a4",
+         "-o", f"127.0.0.1:{port}", "-p", str(port + 1),
+         "--capacity", "100"],
+        env=ENV, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        time.sleep(0.5)
+        out = subprocess.check_output(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "4",
+             "orchestrator", "-a", "dsa", "-d", "adhoc",
+             "--port", str(port), FIXTURE],
+            timeout=120, env=ENV, stderr=subprocess.DEVNULL,
+        )
+        result = json.loads(out)
+        assert result["backend"] == "multi-machine"
+        assert len(result["assignment"]) == 10
+        # Agents exit once the orchestrator stops them.
+        assert agent_proc.wait(timeout=30) == 0
+    finally:
+        if agent_proc.poll() is None:
+            agent_proc.kill()

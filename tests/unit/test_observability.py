@@ -1,0 +1,179 @@
+"""Tests for the observability subsystems: event bus, step tracing,
+websocket UI server.
+
+Reference parity targets: Events.py (event bus), stats.py (trace CSV),
+ui.py (per-agent websocket server).
+"""
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import time
+
+import pytest
+
+from pydcop_tpu.infrastructure import stats
+from pydcop_tpu.infrastructure.events import EventDispatcher, event_bus
+from pydcop_tpu.infrastructure.ui import (
+    WS_GUID,
+    decode_frame,
+    encode_text_frame,
+)
+
+
+class TestEventBus:
+    def test_exact_topic(self):
+        bus = EventDispatcher()
+        seen = []
+        bus.subscribe("a.b", lambda t, d: seen.append((t, d)))
+        bus.emit("a.b", 1)
+        bus.emit("a.c", 2)
+        assert seen == [("a.b", 1)]
+
+    def test_wildcard(self):
+        bus = EventDispatcher()
+        seen = []
+        bus.subscribe("computations.value.*",
+                      lambda t, d: seen.append(t))
+        bus.emit("computations.value.v1", 0)
+        bus.emit("computations.cycle.v1", 0)
+        assert seen == ["computations.value.v1"]
+
+    def test_disabled_when_no_subscribers(self):
+        bus = EventDispatcher()
+        assert not bus.enabled
+        cb = bus.subscribe("x", lambda t, d: None)
+        assert bus.enabled
+        bus.unsubscribe(cb)
+        assert not bus.enabled
+
+    def test_value_selection_emits(self):
+        from pydcop_tpu.infrastructure.computations import (
+            VariableComputation,
+        )
+        from pydcop_tpu.dcop.objects import Domain, Variable
+
+        seen = []
+        cb = event_bus.subscribe(
+            "computations.value.*", lambda t, d: seen.append((t, d))
+        )
+        try:
+            v = Variable("vx", Domain("d", "", [0, 1]))
+            comp = VariableComputation(v, None)
+            comp.value_selection(1, 0.5)
+        finally:
+            event_bus.unsubscribe(cb)
+        assert seen == [("computations.value.vx", (1, 0.5))]
+
+
+class TestStats:
+    def test_trace_rows(self, tmp_path):
+        path = tmp_path / "trace.csv"
+        stats.set_stats_file(str(path))
+        try:
+            assert stats.tracing_enabled()
+            stats.trace_computation("v1", 0.01, 1, 3, 2, 4, value="R")
+        finally:
+            stats.set_stats_file(None)
+        assert not stats.tracing_enabled()
+        lines = path.read_text().strip().splitlines()
+        assert lines[0].split(",")[:3] == ["time", "computation",
+                                           "duration"]
+        row = lines[1].split(",")
+        assert row[1] == "v1"
+        assert row[3:8] == ["1", "3", "2", "4", "R"]
+
+    def test_noop_without_file(self):
+        stats.trace_computation("v1", 0.01)  # must not raise
+
+
+class _WsClient:
+    """Minimal RFC6455 client for tests."""
+
+    def __init__(self, port: int):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=5)
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        self.sock.sendall(
+            (f"GET / HTTP/1.1\r\nHost: localhost:{port}\r\n"
+             "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             "Sec-WebSocket-Version: 13\r\n\r\n").encode()
+        )
+        response = self.sock.recv(4096).decode("latin-1")
+        assert "101" in response.split("\r\n")[0]
+        expected = base64.b64encode(
+            hashlib.sha1((key + WS_GUID).encode()).digest()
+        ).decode()
+        assert expected in response
+
+    def send_json(self, obj):
+        payload = json.dumps(obj).encode()
+        mask = b"\x01\x02\x03\x04"
+        masked = bytes(
+            b ^ mask[i % 4] for i, b in enumerate(payload)
+        )
+        header = b"\x81"
+        assert len(payload) < 126
+        header += struct.pack("!B", 0x80 | len(payload))
+        self.sock.sendall(header + mask + masked)
+
+    def recv_json(self):
+        frame = decode_frame(self.sock)
+        assert frame is not None
+        opcode, payload = frame
+        assert opcode == 0x1
+        return json.loads(payload.decode())
+
+    def close(self):
+        self.sock.close()
+
+
+class TestUiServer:
+    def test_frame_roundtrip(self):
+        frame = encode_text_frame("hello")
+        assert frame[0] == 0x81
+        assert frame[2:] == b"hello"
+
+    def test_server_commands_and_push(self):
+        from pydcop_tpu.infrastructure.communication import (
+            InProcessCommunicationLayer,
+        )
+        from pydcop_tpu.infrastructure.agents import Agent
+        from pydcop_tpu.infrastructure.computations import (
+            VariableComputation,
+        )
+        from pydcop_tpu.dcop.objects import Domain, Variable
+
+        agent = Agent("ui_agent", InProcessCommunicationLayer(),
+                      ui_port=18765)
+        try:
+            v = Variable("v1", Domain("d", "", ["R", "G"]))
+            comp = VariableComputation(v, None)
+            agent.add_computation(comp)
+            client = _WsClient(18765)
+            try:
+                client.send_json({"cmd": "agent"})
+                reply = client.recv_json()
+                assert reply["reply"] == "agent"
+                assert reply["agent"] == "ui_agent"
+                assert "v1" in reply["computations"]
+
+                # Event push: a value selection lands on the socket.
+                comp.value_selection("R", 0.0)
+                deadline = time.time() + 5
+                pushed = client.recv_json()
+                assert pushed["topic"] == "computations.value.v1"
+                assert pushed["data"] == ["R", 0.0]
+                assert time.time() < deadline
+
+                client.send_json(
+                    {"cmd": "value", "computation": "v1"})
+                reply = client.recv_json()
+                assert reply["value"] == "R"
+            finally:
+                client.close()
+        finally:
+            agent.ui_server.stop()
