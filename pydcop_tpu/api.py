@@ -33,6 +33,9 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           algo_params: Optional[Dict[str, Any]] = None,
           mesh=None, n_devices: Optional[int] = None,
           ui_port: Optional[int] = None,
+          collector=None,
+          collect_moment: str = "value_change",
+          collect_period: float = 1.0,
           ) -> SolveResult:
     """Solve a DCOP and return assignment + quality metrics.
 
@@ -92,7 +95,9 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         return solve_with_agents(
             dcop, algo_def, distribution=distribution,
             timeout=timeout, max_cycles=max_cycles, mode=backend,
-            ui_port=ui_port,
+            ui_port=ui_port, collector=collector,
+            collect_moment=collect_moment,
+            collect_period=collect_period,
         )
 
     raise ValueError(f"Unknown backend {backend!r}")

@@ -76,10 +76,18 @@ def run_cmd(args) -> int:
         dcop, cg, algo_module, args.distribution
     )
 
+    collector = None
+    if args.run_metrics:
+        from pydcop_tpu.commands.metrics_io import add_csvline
+
+        def collector(metrics):
+            add_csvline(args.run_metrics, args.collect_on, metrics)
+
     timeout = args.timeout if args.timeout is not None else 20.0
     orchestrator = run_local_thread_dcop(
         algo_def, cg, distribution, dcop, infinity=args.infinity,
-        replication=True,
+        replication=True, collector=collector,
+        collect_moment=args.collect_on, collect_period=args.period,
     )
     stopped = False
     try:
@@ -122,6 +130,8 @@ def run_cmd(args) -> int:
     if args.run_metrics or args.end_metrics:
         from pydcop_tpu.commands.metrics_io import add_csvline
 
+        # Run metrics streamed live above; both files always get the
+        # final summary row so they exist even on event-less runs.
         for path in (args.run_metrics, args.end_metrics):
             if path:
                 add_csvline(path, args.collect_on, result)

@@ -80,10 +80,19 @@ def run_cmd(args) -> int:
         # Algorithms without a termination condition would run forever:
         # bound thread/process runs when no explicit timeout was given.
         timeout = args.timeout if args.timeout is not None else 15.0
+        collector = None
+        if args.run_metrics and args.mode == "thread":
+            from pydcop_tpu.commands.metrics_io import add_csvline
+
+            def collector(metrics):
+                add_csvline(args.run_metrics, args.collect_on, metrics)
+
         res = solve(
             dcop, algo_def, distribution=args.distribution,
             backend=args.mode, timeout=timeout,
             max_cycles=args.cycles, ui_port=args.uiport,
+            collector=collector, collect_moment=args.collect_on,
+            collect_period=args.period,
         )
         result = {
             "status": res["status"],
@@ -101,6 +110,9 @@ def run_cmd(args) -> int:
     if args.run_metrics or args.end_metrics:
         from pydcop_tpu.commands.metrics_io import add_csvline
 
+        # Thread mode streams run metrics live through the collector;
+        # the final summary row is always appended so the file exists
+        # even when no collection event fired.
         for path in (args.run_metrics, args.end_metrics):
             if path:
                 add_csvline(path, args.collect_on, result)

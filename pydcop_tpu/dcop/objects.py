@@ -31,8 +31,8 @@ class Domain(SimpleRepr):
     3
     >>> d.index('G')
     1
-    >>> d.to_domain_value('2')
-    (2, 'B')
+    >>> Domain('d', 'd', [1, 2, 3]).to_domain_value('2')
+    (1, 2)
     """
 
     def __init__(self, name: str, domain_type: str, values: Iterable):
@@ -383,8 +383,11 @@ def create_variables(name_prefix: str, indexes, domain: Domain,
                      separator: str = "_") -> Dict:
     """Mass-create variables from a prefix and index ranges.
 
+    The prefix carries its own separator (reference objects.py:258:
+    ``create_variables('x_', ...)`` names variables ``x_a_0``):
+
     >>> d = Domain('d', 'd', [0, 1])
-    >>> vs = create_variables('x', [['a', 'b'], range(2)], d)
+    >>> vs = create_variables('x_', [['a', 'b'], range(2)], d)
     >>> sorted(vs)[0]
     ('a', 0)
     >>> vs[('a', 0)].name

@@ -65,6 +65,29 @@ def make_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def cli(args=None):
+    """Console-script entry point (NOT for in-process use).
+
+    Agent-mode runs leave daemon threads behind (agents, HTTP servers,
+    websocket servers, JAX clients); interpreter teardown can race them
+    into an abort after the result is already printed.  Flush and exit
+    hard — all user-visible work is done.  Programmatic callers should
+    use :func:`main`, which returns normally.
+    """
+    rc = main(args)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    import os
+    import threading
+
+    if any(
+        t.daemon and t.is_alive() and t is not threading.main_thread()
+        for t in threading.enumerate()
+    ):
+        os._exit(rc)
+    sys.exit(rc)
+
+
 def main(args=None) -> int:
     parser = make_parser()
     parsed = parser.parse_args(args)
@@ -102,4 +125,4 @@ def main(args=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    cli()

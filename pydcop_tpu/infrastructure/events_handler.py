@@ -22,15 +22,36 @@ def run_scenario_events(orchestrator, scenario):
             time.sleep(event.delay)
             continue
         logger.info("Scenario event %s", event.id)
+        membership_changed = any(
+            a.type in ("add_agent", "remove_agent")
+            for a in event.actions or []
+        )
         orchestrator.pause_agents()
         for action in event.actions or []:
             if action.type == "remove_agent":
                 agent = action.args.get("agent")
                 logger.info("Scenario: removing agent %s", agent)
                 orchestrator.remove_agent(agent)
+            elif action.type == "add_agent":
+                from pydcop_tpu.dcop.objects import AgentDef
+
+                agent = action.args.get("agent")
+                extras = {
+                    k: v for k, v in action.args.items()
+                    if k != "agent"
+                }
+                logger.info("Scenario: adding agent %s", agent)
+                orchestrator.add_agent(AgentDef(agent, **extras))
             else:
                 logger.warning(
                     "Unsupported scenario action %s (skipped)",
                     action.type,
                 )
+        # Heal replica counts after membership changes: replication is
+        # idempotent (existing replica holders count toward k), so
+        # re-triggering only places the missing replicas (reference
+        # analogue: _replicate_on_agent_lost,
+        # pydcop/replication/dist_ucs_hostingcosts.py:1067).
+        if membership_changed and orchestrator.replication_k:
+            orchestrator.start_replication(orchestrator.replication_k)
         orchestrator.resume_agents()

@@ -75,12 +75,14 @@ class AgentsMgt(MessagePassingComputation):
             self.cycles.get(msg.computation, 0), msg.cycle
         )
         self.orchestrator._on_progress()
+        self.orchestrator._collect("value_change")
 
     @register("cycle_change")
     def _on_cycle_change(self, sender, msg, t):
         self.cycles[msg.computation] = max(
             self.cycles.get(msg.computation, 0), msg.cycle
         )
+        self.orchestrator._collect("cycle_change")
 
     @register("computation_finished")
     def _on_comp_finished(self, sender, msg, t):
@@ -151,13 +153,23 @@ class Orchestrator:
                  dcop: DCOP,
                  infinity: float = float("inf"),
                  collector=None,
-                 collect_moment: str = "value_change"):
+                 collect_moment: str = "value_change",
+                 collect_period: float = 1.0):
         self.algo = algo
         self.cg = cg
         self.distribution = agent_mapping
         self.dcop = dcop
         self.infinity = infinity
         self.status = "INIT"
+        # Run-metrics collection (reference solve.py:386-443): the
+        # collector callable receives a metrics dict at each
+        # value_change / cycle_change event or every collect_period
+        # seconds.
+        self.collector = collector
+        self.collect_moment = collect_moment
+        self.collect_period = collect_period
+        self._collect_timer: Optional[threading.Timer] = None
+        self._collecting = False
 
         self._agent = Agent(ORCHESTRATOR_AGENT, comm)
         self.directory = Directory(self._agent.discovery)
@@ -191,6 +203,15 @@ class Orchestrator:
         self._expected_computations = [
             n.name for n in cg.nodes
         ]
+        # Set by the runner (run_local_thread_dcop): called with an
+        # AgentDef to create + start a new agent for add_agent
+        # scenario events.
+        self.agent_factory = None
+        self._removed_agents: set = set()
+        # Last requested replica count; scenario events re-trigger
+        # replication with it to heal replica counts after
+        # membership changes.
+        self.replication_k: Optional[int] = None
 
     @property
     def address(self):
@@ -206,7 +227,36 @@ class Orchestrator:
             comp.start()
 
     def stop(self):
+        # Disarm BEFORE cancel: a timer callback racing the cancel
+        # re-checks this flag before re-arming, so no new timer can be
+        # created after stop.
+        self._collecting = False
+        if self._collect_timer is not None:
+            self._collect_timer.cancel()
+            self._collect_timer = None
         self._agent.clean_shutdown()
+
+    # -- run-metrics collection ---------------------------------------- #
+
+    def _collect(self, moment: str):
+        if self.collector is None or self.collect_moment != moment:
+            return
+        try:
+            self.collector(self.mgt.global_metrics(self.status))
+        except Exception:
+            logger.exception("Metrics collector failed")
+
+    def _schedule_periodic_collect(self):
+        if not self._collecting or self.status != "RUNNING":
+            return
+        self._collect("period")
+        if not self._collecting:
+            return
+        self._collect_timer = threading.Timer(
+            self.collect_period, self._schedule_periodic_collect
+        )
+        self._collect_timer.daemon = True
+        self._collect_timer.start()
 
     def wait_ready(self, timeout: Optional[float] = None) -> bool:
         """Wait until every agent of the distribution has reported in."""
@@ -244,6 +294,10 @@ class Orchestrator:
         """Start all computations; block until finished or timeout."""
         self.status = "RUNNING"
         self.mgt.start_time = time.monotonic()
+        if self.collector is not None and \
+                self.collect_moment == "period":
+            self._collecting = True
+            self._schedule_periodic_collect()
         for agent in self.distribution.agents:
             if self.distribution.computations_hosted(agent):
                 self.mgt.post_msg(
@@ -286,7 +340,9 @@ class Orchestrator:
             c[len(prefix):]
             for c in self._agent.discovery.computations()
             if c.startswith(prefix)
+            and c[len(prefix):] not in self._removed_agents
         )
+        self.replication_k = k
         expected = sorted(
             a for a in resilient
             if self.distribution.computations_hosted(a)
@@ -314,6 +370,34 @@ class Orchestrator:
             self._replication_evt.wait(min(0.1, remaining))
         return ReplicaDistribution(self.mgt.replica_hosts)
 
+    def add_agent(self, agent_def, timeout: float = 10):
+        """Scenario-driven agent arrival: spin up a new (empty) agent
+        that can host replicas and repaired computations (reference
+        scenario add_agent action, dcop/scenario.py:37).
+
+        Blocks until the new agent has registered with the directory
+        and reported ready, so a subsequent replication heal can see
+        it (registration is asynchronous message traffic)."""
+        if self.agent_factory is None:
+            logger.warning(
+                "No agent factory: cannot add agent %s", agent_def.name
+            )
+            return
+        self.dcop.add_agents([agent_def])
+        self.agent_factory(agent_def)
+        self.distribution.host_on_agent(agent_def.name, [])
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if agent_def.name in self.mgt.ready_agents:
+                break
+            time.sleep(0.05)
+        else:
+            logger.warning(
+                "Agent %s did not report ready within %.0fs",
+                agent_def.name, timeout,
+            )
+        logger.info("Agent %s added", agent_def.name)
+
     def remove_agent(self, agent: str):
         """Scenario-driven agent removal: stop the agent, then migrate
         its orphaned computations onto agents holding their replicas by
@@ -323,6 +407,7 @@ class Orchestrator:
             "Agent %s removed; orphaned computations: %s", agent, orphaned
         )
         self.mgt.post_msg(f"_mgt_{agent}", StopAgentMessage(), MSG_MGT)
+        self._removed_agents.add(agent)
         mapping = self.distribution.mapping
         mapping.pop(agent, None)
         self.distribution = Distribution(mapping)

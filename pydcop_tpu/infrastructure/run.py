@@ -43,6 +43,9 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                           infinity=float("inf"), delay=None,
                           replication: bool = False,
                           ui_port: Optional[int] = None,
+                          collector=None,
+                          collect_moment: str = "value_change",
+                          collect_period: float = 1.0,
                           ) -> Orchestrator:
     """One OrchestratedAgent thread per AgentDef + an orchestrator, all
     with in-process transports (reference run.py:145).  With
@@ -50,24 +53,32 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
     replica-placement computation for dynamic-DCOP repair."""
     comm = InProcessCommunicationLayer()
     orchestrator = Orchestrator(
-        algo, cg, distribution, comm, dcop, infinity
+        algo, cg, distribution, comm, dcop, infinity,
+        collector=collector, collect_moment=collect_moment,
+        collect_period=collect_period,
     )
     orchestrator.start()
     hosting = {
         a for a in distribution.agents
         if distribution.computations_hosted(a)
     }
-    for agent_def in dcop.agents.values():
-        if agent_def.name not in hosting and not replication:
-            continue
+    def _start_agent(agent_def, ui=None):
         agent_comm = InProcessCommunicationLayer()
         agent = OrchestratedAgent(
             agent_def, agent_comm, orchestrator.address, delay=delay,
-            replication=replication, ui_port=ui_port,
+            replication=replication, ui_port=ui,
         )
         agent.start()
+        return agent
+
+    for agent_def in dcop.agents.values():
+        if agent_def.name not in hosting and not replication:
+            continue
+        _start_agent(agent_def, ui_port)
         if ui_port:
             ui_port += 1
+    # add_agent scenario events create fresh agents through this hook.
+    orchestrator.agent_factory = _start_agent
     return orchestrator
 
 
@@ -160,7 +171,10 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
                       timeout: Optional[float] = 5,
                       max_cycles: int = 0,
                       mode: str = "thread",
-                      ui_port: Optional[int] = None) -> Dict:
+                      ui_port: Optional[int] = None,
+                      collector=None,
+                      collect_moment: str = "value_change",
+                      collect_period: float = 1.0) -> Dict:
     """Full-metrics variant used by the api/CLI thread backend."""
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
@@ -198,7 +212,9 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
         )
     else:
         orchestrator = run_local_thread_dcop(
-            algo_def, cg, distribution, dcop, ui_port=ui_port
+            algo_def, cg, distribution, dcop, ui_port=ui_port,
+            collector=collector, collect_moment=collect_moment,
+            collect_period=collect_period,
         )
     stopped = False
     try:

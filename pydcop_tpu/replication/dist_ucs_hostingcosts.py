@@ -191,11 +191,19 @@ class UCSReplication(MessagePassingComputation):
         if not own:
             self._report_done()
             return
+        known = set(self._known_agents)
         for comp in own:
             search = _Search(
                 comp.name, comp.computation_def, _footprint(comp),
                 msg.k, self.agent.name,
             )
+            # Idempotent re-replication: replicas already placed on
+            # still-live agents count toward k, so a re-trigger after
+            # a membership change only fills the gap.
+            for host in self.replica_hosts.get(comp.name, []):
+                if host in known and search.k_remaining > 0:
+                    search.hosts.append(host)
+                    search.k_remaining -= 1
             for other in self._known_agents:
                 search.push(
                     self.route(other), (self.agent.name, other)

@@ -1,0 +1,115 @@
+"""Graph helpers over variables and constraints.
+
+Reference parity: pydcop/utils/graphs.py (as_networkx_graph :131,
+as_networkx_bipartite_graph :157, calc_diameter :86, cycles_count
+:263, graph_diameter :270, all_pairs :289).
+
+Structural metrics are computed with plain BFS over adjacency dicts
+(no graph-library dependency on the hot paths); the networkx bridges
+are kept for interop/analysis since generators already use networkx.
+"""
+
+import itertools
+from collections import deque
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+
+def constraint_adjacency(variables, constraints) -> Dict[str, Set[str]]:
+    """Variable adjacency: two variables are neighbors when they share
+    a constraint scope."""
+    adj: Dict[str, Set[str]] = {v.name: set() for v in variables}
+    for c in constraints:
+        names = [v.name for v in c.dimensions]
+        for a, b in itertools.combinations(names, 2):
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set()).add(a)
+    return adj
+
+
+def _bfs_depths(adj: Dict[str, Set[str]], root: str) -> Dict[str, int]:
+    depths = {root: 0}
+    queue = deque([root])
+    while queue:
+        node = queue.popleft()
+        for neighbor in adj.get(node, ()):
+            if neighbor not in depths:
+                depths[neighbor] = depths[node] + 1
+                queue.append(neighbor)
+    return depths
+
+
+def calc_diameter(adj: Dict[str, Set[str]]) -> int:
+    """Exact diameter of an adjacency dict (max eccentricity over the
+    largest value found from every node; inf-free: disconnected parts
+    are ignored per component)."""
+    best = 0
+    for root in adj:
+        depths = _bfs_depths(adj, root)
+        if depths:
+            best = max(best, max(depths.values()))
+    return best
+
+
+def graph_diameter(variables, constraints) -> List[int]:
+    """Diameter of each connected component of the constraint graph
+    (reference graphs.py:270)."""
+    adj = constraint_adjacency(variables, constraints)
+    seen: Set[str] = set()
+    diameters = []
+    for root in adj:
+        if root in seen:
+            continue
+        component = set(_bfs_depths(adj, root))
+        seen |= component
+        sub = {n: adj[n] & component for n in component}
+        diameters.append(calc_diameter(sub))
+    return diameters
+
+
+def cycles_count(variables, constraints) -> int:
+    """Number of independent cycles of the constraint graph
+    (E - V + components, reference graphs.py:263)."""
+    adj = constraint_adjacency(variables, constraints)
+    n_edges = sum(len(neigh) for neigh in adj.values()) // 2
+    seen: Set[str] = set()
+    components = 0
+    for root in adj:
+        if root in seen:
+            continue
+        seen |= set(_bfs_depths(adj, root))
+        components += 1
+    return n_edges - len(adj) + components
+
+
+def all_pairs(elements: Sequence) -> Iterable[Tuple]:
+    """All unordered pairs (reference graphs.py:289)."""
+    return itertools.combinations(elements, 2)
+
+
+# -- networkx bridges (analysis / display interop) -------------------- #
+
+
+def as_networkx_graph(variables, constraints):
+    """Constraint graph as a networkx Graph (reference :131)."""
+    import networkx as nx
+
+    graph = nx.Graph()
+    graph.add_nodes_from(v.name for v in variables)
+    for c in constraints:
+        names = [v.name for v in c.dimensions]
+        graph.add_edges_from(itertools.combinations(names, 2))
+    return graph
+
+
+def as_networkx_bipartite_graph(variables, constraints):
+    """Factor graph as a networkx bipartite Graph (reference :157)."""
+    import networkx as nx
+
+    graph = nx.Graph()
+    graph.add_nodes_from((v.name for v in variables), bipartite=0)
+    graph.add_nodes_from((c.name for c in constraints), bipartite=1)
+    for c in constraints:
+        graph.add_edges_from(
+            (c.name, v.name) for v in c.dimensions
+        )
+    return graph

@@ -47,7 +47,7 @@ def test_replica_dist_places_replicas():
 
 def test_run_with_scenario_repairs():
     result = run_cli([
-        "-t", "8",
+        "-t", "12",
         "run", "-a", "dsa", "-d", "adhoc", "-k", "2",
         "-s", os.path.join(INSTANCES, "scenario_remove_a1.yaml"),
         os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),

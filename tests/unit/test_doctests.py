@@ -1,0 +1,29 @@
+"""Run every pydcop_tpu module's doctests as part of the suite.
+
+Reference parity: the reference Makefile runs
+``pytest --doctest-modules ./pydcop`` (Makefile:8-24); this keeps the
+same guarantee inside the normal `pytest tests/` invocation.
+"""
+
+import doctest
+import importlib
+import pkgutil
+
+import pydcop_tpu
+
+
+def _walk_modules():
+    for info in pkgutil.walk_packages(
+        pydcop_tpu.__path__, prefix="pydcop_tpu."
+    ):
+        yield info.name
+
+
+def test_all_module_doctests():
+    total_failures = []
+    for name in _walk_modules():
+        module = importlib.import_module(name)
+        result = doctest.testmod(module, verbose=False)
+        if result.failed:
+            total_failures.append((name, result.failed))
+    assert not total_failures, f"doctest failures: {total_failures}"

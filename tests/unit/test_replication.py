@@ -219,6 +219,48 @@ class TestReplicationEndToEnd:
             orchestrator.stop_agents(5)
             orchestrator.stop()
 
+    def test_add_agent_then_removal(self):
+        """Scenario flow: a new agent joins, replication heals onto it,
+        then a departure is repaired."""
+        from pydcop_tpu.dcop.scenario import (
+            DcopEvent,
+            EventAction,
+            Scenario,
+        )
+        from pydcop_tpu.infrastructure.events_handler import (
+            run_scenario_events,
+        )
+
+        orchestrator = self._setup()
+        try:
+            assert orchestrator.wait_ready(10)
+            orchestrator.deploy_computations()
+            orchestrator.start_replication(2, timeout=20)
+            scenario = Scenario([
+                DcopEvent("e_add", actions=[
+                    EventAction("add_agent", agent="a9", capacity=100),
+                ]),
+                DcopEvent("e_rm", actions=[
+                    EventAction("remove_agent", agent="a0"),
+                ]),
+            ])
+            run_scenario_events(orchestrator, scenario)
+            dist = orchestrator.distribution
+            assert "a9" in dist.agents
+            assert "a0" not in dist.agents
+            for comp in ["v0", "v1"]:
+                assert dist.agent_for(comp) != "a0"
+            # Replication healed: every computation has k=2 *live*
+            # replica hosts again despite a0's departure.
+            live = set(dist.agents)
+            for comp, hosts in orchestrator.mgt.replica_hosts.items():
+                assert "a0" not in hosts
+                assert len(hosts) == 2, f"{comp}: {hosts}"
+                assert set(hosts) <= live
+        finally:
+            orchestrator.stop_agents(5)
+            orchestrator.stop()
+
     def test_repair_after_removal(self):
         orchestrator = self._setup()
         try:

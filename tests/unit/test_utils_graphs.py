@@ -1,0 +1,88 @@
+"""Tests for utils.graphs + utils.various (reference parity:
+pydcop/utils/graphs.py, various.py)."""
+
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.utils.graphs import (
+    all_pairs,
+    as_networkx_bipartite_graph,
+    as_networkx_graph,
+    calc_diameter,
+    constraint_adjacency,
+    cycles_count,
+    graph_diameter,
+)
+from pydcop_tpu.utils.various import func_args
+
+d = Domain("d", "", [0, 1])
+
+
+def _chain(n):
+    """v0 - v1 - ... - v(n-1)."""
+    variables = [Variable(f"v{i}", d) for i in range(n)]
+    constraints = [
+        constraint_from_str(
+            f"c{i}", f"v{i} + v{i + 1}",
+            [variables[i], variables[i + 1]],
+        )
+        for i in range(n - 1)
+    ]
+    return variables, constraints
+
+
+def test_adjacency():
+    variables, constraints = _chain(3)
+    adj = constraint_adjacency(variables, constraints)
+    assert adj["v0"] == {"v1"}
+    assert adj["v1"] == {"v0", "v2"}
+
+
+def test_diameter_chain():
+    variables, constraints = _chain(4)
+    adj = constraint_adjacency(variables, constraints)
+    assert calc_diameter(adj) == 3
+    assert graph_diameter(variables, constraints) == [3]
+
+
+def test_diameter_components():
+    variables, constraints = _chain(3)
+    lone = Variable("w0", d)
+    lone2 = Variable("w1", d)
+    extra = constraint_from_str("cw", "w0 + w1", [lone, lone2])
+    diameters = graph_diameter(
+        variables + [lone, lone2], constraints + [extra]
+    )
+    assert sorted(diameters) == [1, 2]
+
+
+def test_cycles_count():
+    variables, constraints = _chain(3)
+    assert cycles_count(variables, constraints) == 0
+    closing = constraint_from_str(
+        "c_close", "v0 + v2", [variables[0], variables[2]]
+    )
+    assert cycles_count(variables, constraints + [closing]) == 1
+
+
+def test_all_pairs():
+    assert list(all_pairs([1, 2, 3])) == [(1, 2), (1, 3), (2, 3)]
+
+
+def test_networkx_bridges():
+    variables, constraints = _chain(3)
+    g = as_networkx_graph(variables, constraints)
+    assert set(g.nodes) == {"v0", "v1", "v2"}
+    assert g.number_of_edges() == 2
+    b = as_networkx_bipartite_graph(variables, constraints)
+    assert set(b.nodes) == {"v0", "v1", "v2", "c0", "c1"}
+    assert b.number_of_edges() == 4
+
+
+def test_func_args():
+    assert func_args(lambda x, y: x) == ["x", "y"]
+
+    def f(a, b, *, c):
+        return a
+
+    assert func_args(f) == ["a", "b"]
+    assert func_args(len) in ([], ["obj"])
