@@ -37,13 +37,18 @@ def run_cmd(args) -> int:
     dcop = load_dcop_from_file(args.dcop_files)
     graph = load_graph_module(graph_type).build_computation_graph(dcop)
 
-    from pydcop_tpu.utils.graphs import cycles_count, graph_diameter
+    from pydcop_tpu.utils.graphs import (
+        constraint_adjacency,
+        cycles_count,
+        graph_diameter,
+    )
 
     degrees = {}
     for node in graph.nodes:
         degrees[node.name] = len(node.neighbors)
     variables = list(dcop.variables.values())
     constraints = list(dcop.constraints.values())
+    adj = constraint_adjacency(variables, constraints)
     result = {
         "graph": graph_type,
         "dcop": dcop.name,
@@ -57,8 +62,9 @@ def run_cmd(args) -> int:
         "avg_degree": (
             sum(degrees.values()) / len(degrees) if degrees else 0
         ),
-        "cycles": cycles_count(variables, constraints),
-        "component_diameters": graph_diameter(variables, constraints),
+        "cycles": cycles_count(variables, constraints, adj=adj),
+        "component_diameters": graph_diameter(
+            variables, constraints, adj=adj),
     }
     emit_result(result, args.output)
     return 0

@@ -117,14 +117,9 @@ class ComputationPseudoTree(ComputationGraph):
 
 def _adjacency(variables: List[Variable],
                constraints: List[Constraint]) -> Dict[str, Set[str]]:
-    adj: Dict[str, Set[str]] = {v.name: set() for v in variables}
-    for c in constraints:
-        scope = [v.name for v in c.dimensions]
-        for a in scope:
-            for b in scope:
-                if a != b:
-                    adj[a].add(b)
-    return adj
+    from pydcop_tpu.utils.graphs import constraint_adjacency
+
+    return constraint_adjacency(variables, constraints)
 
 
 def build_computation_graph(

@@ -383,6 +383,8 @@ class Orchestrator:
                 "No agent factory: cannot add agent %s", agent_def.name
             )
             return
+        # A departed agent can come back under the same name.
+        self._removed_agents.discard(agent_def.name)
         self.dcop.add_agents([agent_def])
         self.agent_factory(agent_def)
         self.distribution.host_on_agent(agent_def.name, [])

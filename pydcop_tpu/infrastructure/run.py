@@ -108,7 +108,10 @@ def _process_agent_main(agent_def, port: int, orchestrator_address,
 def run_local_process_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                            infinity=float("inf"),
                            replication: bool = False,
-                           port: int = 9000) -> Orchestrator:
+                           port: int = 9000,
+                           collector=None,
+                           collect_moment: str = "value_change",
+                           collect_period: float = 1.0) -> Orchestrator:
     """One OS process per agent, JSON-over-HTTP transports on localhost
     ports (reference run.py:225) — the single-host stand-in for true
     multi-machine deployments."""
@@ -120,7 +123,9 @@ def run_local_process_dcop(algo: AlgorithmDef, cg, distribution, dcop,
 
     comm = HttpCommunicationLayer(("127.0.0.1", port))
     orchestrator = Orchestrator(
-        algo, cg, distribution, comm, dcop, infinity
+        algo, cg, distribution, comm, dcop, infinity,
+        collector=collector, collect_moment=collect_moment,
+        collect_period=collect_period,
     )
     orchestrator.start()
     ctx = multiprocessing.get_context("spawn")
@@ -208,7 +213,9 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             dcop, cg, algo_module, distribution)
     if mode == "process":
         orchestrator = run_local_process_dcop(
-            algo_def, cg, distribution, dcop
+            algo_def, cg, distribution, dcop,
+            collector=collector, collect_moment=collect_moment,
+            collect_period=collect_period,
         )
     else:
         orchestrator = run_local_thread_dcop(

@@ -11,7 +11,7 @@ are kept for interop/analysis since generators already use networkx.
 
 import itertools
 from collections import deque
-from typing import Dict, Iterable, List, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 
 def constraint_adjacency(variables, constraints) -> Dict[str, Set[str]]:
@@ -38,47 +38,73 @@ def _bfs_depths(adj: Dict[str, Set[str]], root: str) -> Dict[str, int]:
     return depths
 
 
-def calc_diameter(adj: Dict[str, Set[str]]) -> int:
-    """Exact diameter of an adjacency dict (max eccentricity over the
-    largest value found from every node; inf-free: disconnected parts
-    are ignored per component)."""
-    best = 0
-    for root in adj:
-        depths = _bfs_depths(adj, root)
-        if depths:
-            best = max(best, max(depths.values()))
-    return best
+# Above this node count, component diameters fall back to the
+# double-BFS-sweep lower bound (exact on trees, very tight on sparse
+# graphs) instead of all-node BFS — O(V+E) instead of O(V*(V+E)).
+EXACT_DIAMETER_LIMIT = 2000
 
 
-def graph_diameter(variables, constraints) -> List[int]:
-    """Diameter of each connected component of the constraint graph
-    (reference graphs.py:270)."""
-    adj = constraint_adjacency(variables, constraints)
+def components(adj: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Connected components of an adjacency dict."""
     seen: Set[str] = set()
-    diameters = []
+    out = []
     for root in adj:
         if root in seen:
             continue
         component = set(_bfs_depths(adj, root))
         seen |= component
+        out.append(component)
+    return out
+
+
+def calc_diameter(adj: Dict[str, Set[str]],
+                  exact: bool = True) -> int:
+    """Diameter of an adjacency dict.
+
+    exact=True: max eccentricity by BFS from every node.
+    exact=False: double-sweep lower bound (one BFS to find the
+    furthest node, one BFS from it)."""
+    if not adj:
+        return 0
+    if exact:
+        best = 0
+        for root in adj:
+            depths = _bfs_depths(adj, root)
+            if depths:
+                best = max(best, max(depths.values()))
+        return best
+    root = next(iter(adj))
+    depths = _bfs_depths(adj, root)
+    far = max(depths, key=depths.get)
+    depths = _bfs_depths(adj, far)
+    return max(depths.values(), default=0)
+
+
+def graph_diameter(variables, constraints,
+                   adj: Optional[Dict[str, Set[str]]] = None,
+                   ) -> List[int]:
+    """Diameter of each connected component of the constraint graph
+    (reference graphs.py:270).  Components above EXACT_DIAMETER_LIMIT
+    nodes use the double-sweep estimate."""
+    if adj is None:
+        adj = constraint_adjacency(variables, constraints)
+    diameters = []
+    for component in components(adj):
         sub = {n: adj[n] & component for n in component}
-        diameters.append(calc_diameter(sub))
+        diameters.append(calc_diameter(
+            sub, exact=len(component) <= EXACT_DIAMETER_LIMIT
+        ))
     return diameters
 
 
-def cycles_count(variables, constraints) -> int:
+def cycles_count(variables, constraints,
+                 adj: Optional[Dict[str, Set[str]]] = None) -> int:
     """Number of independent cycles of the constraint graph
     (E - V + components, reference graphs.py:263)."""
-    adj = constraint_adjacency(variables, constraints)
+    if adj is None:
+        adj = constraint_adjacency(variables, constraints)
     n_edges = sum(len(neigh) for neigh in adj.values()) // 2
-    seen: Set[str] = set()
-    components = 0
-    for root in adj:
-        if root in seen:
-            continue
-        seen |= set(_bfs_depths(adj, root))
-        components += 1
-    return n_edges - len(adj) + components
+    return n_edges - len(adj) + len(components(adj))
 
 
 def all_pairs(elements: Sequence) -> Iterable[Tuple]:

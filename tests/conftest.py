@@ -14,6 +14,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# CPU tests must not depend on the TPU tunnel: without this, every CLI
+# subprocess re-registers the axon PJRT plugin and hangs if the tunnel
+# is down (the pytest process itself registered at interpreter start,
+# but jax_platforms=cpu below keeps it unused).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 
