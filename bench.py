@@ -147,9 +147,9 @@ def _ensure_live_backend():
             "bench: accelerator backend unresponsive; falling back "
             "to CPU", file=sys.stderr,
         )
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    from pydcop_tpu.utils.cleanenv import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env()
     env["PYDCOP_BENCH_NO_PROBE"] = "1"
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 

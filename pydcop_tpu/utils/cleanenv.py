@@ -1,0 +1,41 @@
+"""Scrubbed-environment helper for JAX backend selection.
+
+This image's sitecustomize registers the axon TPU PJRT plugin in every
+python interpreter (gated on ``PALLAS_AXON_POOL_IPS``); once registered,
+a wedged tunnel hangs backend init and no in-process ``jax.config``
+update can recover. Every entry point that needs a guaranteed-live CPU
+backend (tests, bench fallback, multichip dryrun) builds its child env
+through this one helper so the scrub recipe cannot drift between copies.
+
+No jax import here — this module must be importable before any backend
+is initialized.
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def scrubbed_cpu_env(n_devices=None, base=None):
+    """Return an env dict that forces a clean CPU JAX backend.
+
+    - drops ``PALLAS_AXON_POOL_IPS`` so sitecustomize skips plugin
+      registration entirely in the child interpreter;
+    - sets ``JAX_PLATFORMS=cpu``;
+    - when ``n_devices`` is given, forces exactly that virtual host
+      device count in ``XLA_FLAGS`` (replacing any inherited value —
+      an inherited smaller count would make sharded code fail even
+      though it is healthy).
+    """
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = re.sub(
+            _COUNT_FLAG + r"=\d+", "", env.get("XLA_FLAGS", "")
+        ).strip()
+        env["XLA_FLAGS"] = (
+            flags + f" {_COUNT_FLAG}={n_devices}"
+        ).strip()
+    return env
