@@ -44,6 +44,7 @@ class Agent:
         self._messaging = Messaging(name, comm, delay=delay or 0)
         self.discovery = Discovery(name, comm.address)
         comm.discovery = self.discovery
+        self.discovery.agent_change_hooks.append(comm.on_agent_change)
         self._computations: Dict[str, MessagePassingComputation] = {}
         self._thread = threading.Thread(
             target=self._run, name=f"agent_{name}", daemon=True

@@ -48,6 +48,11 @@ class CommunicationLayer:
         self.messaging: Optional["Messaging"] = None
         self.discovery = None
 
+    def on_agent_change(self, event: str, agent_name: str):
+        """Hook fired by discovery on agent add/remove (see
+        Discovery.agent_change_hooks); transports with retry queues
+        override it to purge traffic for departed agents."""
+
     @property
     def address(self):
         raise NotImplementedError
@@ -206,8 +211,31 @@ class HttpCommunicationLayer(CommunicationLayer):
         self._retry_lock = threading.Lock()
         self._retry_queue = []  # (expire_time, src, dest, cmsg)
         self._retry_thread: Optional[threading.Thread] = None
+        # Agents known to have departed: their traffic is dropped
+        # instead of lingering in the retry queue for RETRY_WINDOW
+        # (and possibly re-delivering to a re-added namesake).
+        self._removed_agents: set = set()
         self._shutdown = False
         self._start_server()
+
+    def on_agent_change(self, event: str, agent_name: str):
+        if event == "agent_removed":
+            with self._retry_lock:
+                self._removed_agents.add(agent_name)
+                before = len(self._retry_queue)
+                self._retry_queue = [
+                    entry for entry in self._retry_queue
+                    if entry[2] != agent_name
+                ]
+                purged = before - len(self._retry_queue)
+            if purged:
+                logger.info(
+                    "Purged %d queued messages for departed agent %s",
+                    purged, agent_name,
+                )
+        elif event == "agent_added":
+            with self._retry_lock:
+                self._removed_agents.discard(agent_name)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -253,6 +281,13 @@ class HttpCommunicationLayer(CommunicationLayer):
 
     def send_msg(self, src_agent: str, dest_agent: str,
                  msg: ComputationMessage, on_error=None):
+        with self._retry_lock:
+            removed = dest_agent in self._removed_agents
+        if removed:
+            logger.debug(
+                "Dropping message to departed agent %s", dest_agent
+            )
+            return
         error = self._try_send(src_agent, dest_agent, msg)
         if error is not None:
             if on_error == "fail":
@@ -295,6 +330,8 @@ class HttpCommunicationLayer(CommunicationLayer):
             dest_agent, error, self.RETRY_WINDOW,
         )
         with self._retry_lock:
+            if dest_agent in self._removed_agents:
+                return
             self._retry_queue.append(
                 (time.monotonic() + self.RETRY_WINDOW,
                  src_agent, dest_agent, msg)
@@ -320,6 +357,12 @@ class HttpCommunicationLayer(CommunicationLayer):
                     return
             still_failing = []
             for expire, src, dest, cmsg in pending:
+                with self._retry_lock:
+                    if dest in self._removed_agents:
+                        # The agent departed while this entry was
+                        # swapped out of the queue; a purge cannot see
+                        # it, so drop it here.
+                        continue
                 error = self._try_send(src, dest, cmsg)
                 if error is None:
                     continue
@@ -332,7 +375,10 @@ class HttpCommunicationLayer(CommunicationLayer):
                     still_failing.append((expire, src, dest, cmsg))
             if still_failing:
                 with self._retry_lock:
-                    self._retry_queue.extend(still_failing)
+                    self._retry_queue.extend(
+                        entry for entry in still_failing
+                        if entry[2] not in self._removed_agents
+                    )
 
     def shutdown(self):
         self._shutdown = True

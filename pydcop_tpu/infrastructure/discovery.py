@@ -197,6 +197,10 @@ class Discovery:
         self._computation_cbs: Dict[str, List[Callable]] = {}
         self._replica_cbs: Dict[str, List[Callable]] = {}
         self.directory_agent: Optional[str] = None
+        # Global hooks cb(event, agent_name) fired on every agent
+        # add/remove (local or published) — used by transports to purge
+        # retry queues for departed agents.
+        self.agent_change_hooks: List[Callable] = []
 
     # -- wiring -------------------------------------------------------- #
 
@@ -221,6 +225,7 @@ class Discovery:
                        publish: bool = True):
         with self._lock:
             self._agents[agent_name] = address
+        self._fire_agent_change("agent_added", agent_name)
         if publish:
             self._send_to_directory(
                 RegisterAgentMessage(agent_name, address))
@@ -228,8 +233,18 @@ class Discovery:
     def unregister_agent(self, agent_name: str, publish: bool = True):
         with self._lock:
             self._agents.pop(agent_name, None)
+        self._fire_agent_change("agent_removed", agent_name)
         if publish:
             self._send_to_directory(UnregisterAgentMessage(agent_name))
+
+    def _fire_agent_change(self, event: str, agent_name: str):
+        for hook in self.agent_change_hooks:
+            try:
+                hook(event, agent_name)
+            except Exception:
+                logger.exception(
+                    "Agent-change hook error for %s %s", event, agent_name
+                )
 
     def register_computation(self, computation: str,
                              agent_name: Optional[str] = None,
@@ -341,6 +356,8 @@ class Discovery:
             elif event == "replica_changed":
                 self._replicas[name] = list(value)
                 cbs = list(self._replica_cbs.get(name, []))
+        if event in ("agent_added", "agent_removed"):
+            self._fire_agent_change(event, name)
         for cb in cbs:
             try:
                 cb(event, name, value)

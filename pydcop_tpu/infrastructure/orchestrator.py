@@ -62,6 +62,10 @@ class AgentsMgt(MessagePassingComputation):
         self.replication_done_agents: set = set()
         self.repaired_computations: set = set()
         self.repair_event_count: int = 0
+        # Per-activation acks from hosts: comp -> agent that confirmed
+        # (or refused) activating its replica.
+        self.repair_acked: Dict[str, str] = {}
+        self.repair_failed: Dict[str, str] = {}
 
     @register("agent_ready")
     def _on_agent_ready(self, sender, msg, t):
@@ -100,6 +104,14 @@ class AgentsMgt(MessagePassingComputation):
     def _on_repair_done(self, sender, msg, t):
         self.repaired_computations.update(msg.computations)
         self.repair_event_count += len(msg.computations)
+        for comp in msg.computations:
+            self.repair_acked[comp] = msg.agent
+        self.orchestrator._repair_evt.set()
+
+    @register("repair_failed")
+    def _on_repair_failed(self, sender, msg, t):
+        for comp in msg.computations:
+            self.repair_failed[comp] = msg.agent
         self.orchestrator._repair_evt.set()
 
     @register("agent_stopped")
@@ -451,30 +463,95 @@ class Orchestrator:
         if not repairable:
             return {}
         placement = self._solve_repair_dcop(repairable, candidates)
-        # repaired_computations is cumulative across events; count
-        # completions to detect this call's activations (a computation
-        # can be repaired once per event).
-        pre_events = self.mgt.repair_event_count
-        for comp, host in placement.items():
-            self.mgt.post_msg(
-                replication_computation_name(host),
-                ActivateReplicaMessage(comp),
-                MSG_MGT,
-            )
-            self.distribution.host_on_agent(host, [comp])
-            # The activated replica is consumed.
-            if host in self.mgt.replica_hosts.get(comp, []):
-                self.mgt.replica_hosts[comp].remove(host)
+        # Activation is two-phase: distribution / replica bookkeeping is
+        # only committed once the host *acknowledges* promoting its
+        # replica.  A nacked activation (no replica on the host) fails
+        # over to the next candidate; an unacked one (lost message) is
+        # re-sent to the same host — activation is idempotent on the
+        # host side, so redelivery is safe — until the deadline.
+        committed: Dict[str, str] = {}
+        tried: Dict[str, set] = {c: set() for c in placement}
+        pending = dict(placement)
+        # Acks are cumulative across scenario events; a previous
+        # event's ack for the same (comp, host) pair must not satisfy
+        # this round's activation.
+        for comp in placement:
+            self.mgt.repair_acked.pop(comp, None)
+            self.mgt.repair_failed.pop(comp, None)
         deadline = time.monotonic() + timeout
-        while self.mgt.repair_event_count < pre_events + len(placement):
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                logger.warning("Repair timed out")
+        while pending:
+            for comp, host in pending.items():
+                tried[comp].add(host)
+                self.mgt.post_msg(
+                    replication_computation_name(host),
+                    ActivateReplicaMessage(
+                        comp,
+                        [
+                            h
+                            for h in self.mgt.replica_hosts.get(comp, [])
+                            if h != host
+                        ],
+                    ),
+                    MSG_MGT,
+                )
+            # Wait one round for acks / nacks.
+            round_deadline = min(deadline, time.monotonic() + 2.0)
+            while True:
+                acked = {
+                    c for c in pending
+                    if self.mgt.repair_acked.get(c) == pending[c]
+                }
+                failed = {
+                    c for c in pending
+                    if c not in acked
+                    and self.mgt.repair_failed.get(c) == pending[c]
+                }
+                if acked | failed == set(pending):
+                    break
+                remaining = round_deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._repair_evt.clear()
+                self._repair_evt.wait(min(0.1, remaining))
+            for comp in acked:
+                host = pending.pop(comp)
+                committed[comp] = host
+                self.distribution.host_on_agent(host, [comp])
+                # The activated replica is consumed.
+                if host in self.mgt.replica_hosts.get(comp, []):
+                    self.mgt.replica_hosts[comp].remove(host)
+            if time.monotonic() >= deadline:
+                if pending:
+                    logger.warning(
+                        "Repair timed out; unrepaired: %s",
+                        sorted(pending),
+                    )
                 break
-            self._repair_evt.clear()
-            self._repair_evt.wait(min(0.1, remaining))
-        logger.info("Repair placement: %s", placement)
-        return placement
+            retry: Dict[str, str] = {}
+            for comp, host in pending.items():
+                if comp not in failed:
+                    # Unacked: lost request or delayed ack — re-send to
+                    # the same host next round.
+                    retry[comp] = host
+                    continue
+                self.mgt.repair_failed.pop(comp, None)
+                if host in self.mgt.replica_hosts.get(comp, []):
+                    # The host refused, so its replica record is stale.
+                    self.mgt.replica_hosts[comp].remove(host)
+                untried = [
+                    a for a in candidates.get(comp, [])
+                    if a not in tried[comp]
+                ]
+                if untried:
+                    retry[comp] = untried[0]
+                else:
+                    logger.error(
+                        "Repair of %s failed: all candidates refused",
+                        comp,
+                    )
+            pending = retry
+        logger.info("Repair placement: %s", committed)
+        return committed
 
     def _solve_repair_dcop(self, orphaned: List[str],
                            candidates: Dict[str, List[str]]
@@ -508,7 +585,10 @@ class Orchestrator:
             if capacity is not None:
                 repair.add_constraint(create_agent_capacity_constraint(
                     agt, self._remaining_capacity(agt),
-                    {c: self._footprint(c) for c in agt_vars},
+                    {
+                        c: self._effective_repair_footprint(c, agt)
+                        for c in agt_vars
+                    },
                     agt_vars,
                 ))
             hosting_costs = {
@@ -567,6 +647,17 @@ class Orchestrator:
         )
         return agent_def.capacity - used
 
+    def _effective_repair_footprint(self, comp: str, agent: str) -> float:
+        """Extra capacity needed to host ``comp`` on ``agent`` during
+        repair.  ``_remaining_capacity`` already charges the agent for
+        every replica it holds; promoting one of *its own* replicas to
+        live converts that charge in place, so the net cost is zero —
+        charging the footprint again would falsely reject near-capacity
+        replica holders."""
+        if agent in self.mgt.replica_hosts.get(comp, []):
+            return 0.0
+        return self._footprint(comp)
+
     def _comm_load(self, computation: str, neighbor: str) -> float:
         from pydcop_tpu.algorithms import load_algorithm_module
 
@@ -607,6 +698,22 @@ class Orchestrator:
                 else:
                     placement = {}
                     break
+        if placement:
+            # The device solve is approximate: a one-host-per-comp
+            # solution can still violate the capacity hard constraint.
+            # Verify before accepting, else fall back to greedy.
+            load: Dict[str, float] = {}
+            for comp, agt in placement.items():
+                load[agt] = load.get(agt, 0.0) + \
+                    self._effective_repair_footprint(comp, agt)
+            for agt, used in load.items():
+                if used > self._remaining_capacity(agt):
+                    logger.warning(
+                        "Repair solve oversubscribes %s; using greedy",
+                        agt,
+                    )
+                    placement = {}
+                    break
         if not placement:
             # Greedy fallback: cheapest (hosting cost, load) candidate
             # with enough remaining capacity (capacity-less agents are
@@ -617,11 +724,10 @@ class Orchestrator:
             for comp in sorted(
                 orphaned, key=lambda c: -self._footprint(c)
             ):
-                footprint = self._footprint(comp)
                 fitting = [
                     a for a in candidates[comp]
                     if self._remaining_capacity(a) - loads.get(a, 0.0)
-                    >= footprint
+                    >= self._effective_repair_footprint(comp, a)
                 ]
                 pool = fitting or candidates[comp]
                 best = min(
@@ -633,7 +739,8 @@ class Orchestrator:
                     ),
                 )
                 placement[comp] = best
-                loads[best] = loads.get(best, 0.0) + footprint
+                loads[best] = loads.get(best, 0.0) + \
+                    self._effective_repair_footprint(comp, best)
         return placement
 
     def _footprint(self, comp_name: str) -> float:
@@ -659,8 +766,8 @@ class Orchestrator:
         # Every agent that registered gets a stop — idle agents (no
         # hosted computation, e.g. spare resilient agents) must exit
         # too.
-        for agent in set(self.distribution.agents) \
-                | self.mgt.ready_agents:
+        for agent in (set(self.distribution.agents)
+                      | self.mgt.ready_agents) - self._removed_agents:
             self.mgt.post_msg(
                 f"_mgt_{agent}", StopAgentMessage(), MSG_MGT
             )

@@ -66,11 +66,13 @@ PlaceReplicaMessage = message_type(
 PlaceReplicaAnswerMessage = message_type(
     "place_replica_answer", ["computation", "accepted", "path"])
 ActivateReplicaMessage = message_type(
-    "activate_replica", ["computation"])
+    "activate_replica", ["computation", "surviving_hosts"])
 ReplicationDoneMessage = message_type(
     "replication_done", ["agent", "replica_hosts"])
 RepairDoneMessage = message_type(
     "repair_done", ["agent", "computations"])
+RepairFailedMessage = message_type(
+    "repair_failed", ["agent", "computations"])
 
 
 def replication_computation_name(agent_name: str) -> str:
@@ -124,6 +126,10 @@ class UCSReplication(MessagePassingComputation):
         self.discovery = discovery
         # Replicas hosted here: comp -> (comp_def, footprint, origin).
         self.replicas: Dict[str, Tuple] = {}
+        # Computations this agent has already promoted from replica to
+        # live: duplicate activate requests (HTTP at-least-once
+        # delivery) are re-acked instead of nacked.
+        self._activated: Set[str] = set()
         # Outcome of our own searches: comp -> hosts.
         self.replica_hosts: Dict[str, List[str]] = {}
         self._searches: Dict[str, _Search] = {}
@@ -251,9 +257,9 @@ class UCSReplication(MessagePassingComputation):
         search = self._searches.get(msg.computation)
         if search is None or search.awaiting is None:
             return
-        _, path, cost = search.awaiting
-        if tuple(msg.path) != tuple(path):
-            return  # stale answer
+        kind, path, cost = search.awaiting
+        if kind != "probe" or tuple(msg.path) != tuple(path):
+            return  # stale or duplicate answer
         search.awaiting = None
         path = tuple(msg.path)
         if msg.can_host:
@@ -268,11 +274,18 @@ class UCSReplication(MessagePassingComputation):
         search = self._searches.get(msg.computation)
         if search is None or search.awaiting is None:
             return
+        kind, path, _ = search.awaiting
+        if kind != "place" or tuple(msg.path) != tuple(path):
+            # Stale answer from a previous replication round or a
+            # duplicate delivery (HTTP retry): accepting it would clear
+            # the wrong in-flight request and corrupt k_remaining.
+            return
         search.awaiting = None
         target = before_last(tuple(msg.path))
         if msg.accepted:
-            search.hosts.append(target)
-            search.k_remaining -= 1
+            if target not in search.hosts:
+                search.hosts.append(target)
+                search.k_remaining -= 1
         else:
             # Capacity changed between probe and placement.
             search.rejected.add(target)
@@ -349,20 +362,46 @@ class UCSReplication(MessagePassingComputation):
             ORCHESTRATOR_MGT,
         )
 
+        if msg.computation in self._activated:
+            # Duplicate delivery of a processed request: re-ack, never
+            # nack — a nack here could race ahead of the original ack
+            # and trigger activation on a second agent.
+            self.post_msg(
+                ORCHESTRATOR_MGT,
+                RepairDoneMessage(self.agent.name, [msg.computation]),
+                MSG_REPLICATION,
+            )
+            return
         entry = self.replicas.pop(msg.computation, None)
         if entry is None:
             logger.error(
                 "Cannot activate %s on %s: no replica here",
                 msg.computation, self.agent.name,
             )
+            # Explicit nack so the orchestrator can retry another
+            # candidate instead of waiting out the repair timeout.
+            self.post_msg(
+                ORCHESTRATOR_MGT,
+                RepairFailedMessage(self.agent.name, [msg.computation]),
+                MSG_REPLICATION,
+            )
             return
         comp_def, _, _ = entry
         computation = build_computation(comp_def)
         self.agent.add_computation(computation)
         computation.start()
+        self._activated.add(msg.computation)
         self.discovery.unregister_replica(
             msg.computation, self.agent.name
         )
+        # As the computation's new owner, seed our search bookkeeping
+        # with the replicas that survive elsewhere so the next
+        # replication heal only fills the gap instead of re-placing k
+        # fresh replicas (and leaking the survivors' capacity).
+        self.replica_hosts[msg.computation] = [
+            h for h in (msg.surviving_hosts or [])
+            if h != self.agent.name
+        ]
         self.post_msg(
             ORCHESTRATOR_MGT,
             RepairDoneMessage(self.agent.name, [msg.computation]),

@@ -282,3 +282,115 @@ class TestReplicationEndToEnd:
         finally:
             orchestrator.stop_agents(5)
             orchestrator.stop()
+
+
+class _StubAgent:
+    def __init__(self, name):
+        self.name = name
+        self.computations = []
+        self.agent_def = None
+
+
+class TestPlaceAnswerGuards:
+    """Stale / duplicate place answers must not corrupt UCS state
+    (late answers from a previous round, HTTP duplicate delivery)."""
+
+    def _search_awaiting_place(self):
+        from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+            HOSTING,
+            UCSReplication,
+            _Search,
+        )
+
+        comp = UCSReplication(_StubAgent("a0"), discovery=None)
+        comp._msg_sender = lambda *a, **kw: None
+        search = _Search("v0", None, 1.0, k=2, origin="a0")
+        path = ("a0", "a1", HOSTING)
+        search.awaiting = ("place", path, 3.0)
+        comp._searches = {"v0": search}
+        return comp, search, path
+
+    def test_stale_path_ignored(self):
+        from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+            HOSTING,
+            PlaceReplicaAnswerMessage,
+        )
+
+        comp, search, _ = self._search_awaiting_place()
+        stale = PlaceReplicaAnswerMessage(
+            "v0", True, ("a0", "a2", HOSTING)
+        )
+        comp._on_place_answer("_replication_a2", stale, 0.0)
+        assert search.awaiting is not None
+        assert search.k_remaining == 2
+        assert search.hosts == []
+
+    def test_probe_answer_does_not_clear_place_wait(self):
+        from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+            UCSProbeAnswerMessage,
+        )
+
+        comp, search, _ = self._search_awaiting_place()
+        probe_ans = UCSProbeAnswerMessage(
+            "v0", ("a0", "a1"), True, 1.0, {}
+        )
+        comp._on_probe_answer("_replication_a1", probe_ans, 0.0)
+        assert search.awaiting is not None
+        assert search.frontier == []
+
+    def test_duplicate_accept_decrements_once(self):
+        from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+            PlaceReplicaAnswerMessage,
+        )
+
+        comp, search, path = self._search_awaiting_place()
+        answer = PlaceReplicaAnswerMessage("v0", True, path)
+        comp._on_place_answer("_replication_a1", answer, 0.0)
+        assert search.hosts == ["a1"]
+        assert search.k_remaining == 1
+        # Duplicate delivery (e.g. HTTP retry after a timed-out but
+        # processed POST): awaiting was cleared, so it is a no-op.
+        comp._on_place_answer("_replication_a1", answer, 0.0)
+        assert search.hosts == ["a1"]
+        assert search.k_remaining == 1
+
+
+class TestHttpRetryPurge:
+    def test_departed_agent_traffic_purged_and_dropped(self):
+        from pydcop_tpu.infrastructure.communication import (
+            ComputationMessage,
+            HttpCommunicationLayer,
+            MSG_ALGO,
+        )
+        from pydcop_tpu.infrastructure.computations import Message
+        from pydcop_tpu.infrastructure.discovery import Discovery
+
+        layer = HttpCommunicationLayer(("127.0.0.1", 0))
+        try:
+            # Port 0 picks an ephemeral port for our own server; the
+            # peer address is unreachable on purpose.
+            discovery = Discovery("me", ("127.0.0.1", 1))
+            discovery.agent_change_hooks.append(layer.on_agent_change)
+            layer.discovery = discovery
+            discovery.register_agent(
+                "peer", ("127.0.0.1", 1), publish=False
+            )
+            cmsg = ComputationMessage(
+                "c1", "c2", Message("test", None), MSG_ALGO
+            )
+            layer.send_msg("me", "peer", cmsg)
+            assert len(layer._retry_queue) == 1
+            discovery.unregister_agent("peer", publish=False)
+            assert layer._retry_queue == []
+            # New sends to the departed agent are dropped outright.
+            layer.send_msg("me", "peer", cmsg)
+            assert layer._retry_queue == []
+            # Re-added under the same name: traffic flows (and fails
+            # into the retry queue) again.
+            discovery.register_agent(
+                "peer", ("127.0.0.1", 1), publish=False
+            )
+            layer.send_msg("me", "peer", cmsg)
+            assert len(layer._retry_queue) == 1
+        finally:
+            layer.shutdown()
