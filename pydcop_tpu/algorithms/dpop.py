@@ -7,18 +7,23 @@ itself out, :313-386), then VALUE assignments flow root→leaves (each node
 slices its joined table on the received separator assignment and picks
 its first-optimal value, :389-439).
 
-Execution model here: the pseudo-tree sweep is *scheduled by tree level*
-on the host, but every UTIL table is a dense hypercube and join/
-projection are numpy broadcast-add / axis-reductions
-(pydcop_tpu.dcop.relations.join/projection) — the same math the
-reference runs per-assignment in python loops (relations.py:1672,:1717).
+Execution model here (two paths, selected by the ``engine`` param):
+
+- ``jit`` (default): level-batched tensor sweep — all nodes of a tree
+  level with the same table signature are joined + projected by ONE
+  jitted XLA kernel on stacked hypercubes (pydcop_tpu/ops/dpop.py).
+- ``numpy``: per-node host sweep using the dense relation algebra
+  (pydcop_tpu.dcop.relations.join/projection) — the fallback when jax
+  is unavailable, and the reference execution to diff against.
+
 UTIL width is exponential in separator size; oversized tables raise
-MemoryError (footprint accounting mirror: computation_memory below).
+MemoryError in both paths (footprint accounting mirror:
+computation_memory below, reference dpop.py:80-85).
 """
 
 from typing import Dict, Optional
 
-from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
 from pydcop_tpu.computations_graph import pseudotree as pt
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.relations import (
@@ -28,10 +33,13 @@ from pydcop_tpu.dcop.relations import (
     projection,
 )
 from pydcop_tpu.engine.runner import DeviceRunResult
+from pydcop_tpu.ops.dpop import UtilTooLargeError, solve_sweep
 
 GRAPH_TYPE = "pseudotree"
 
-algo_params = []
+algo_params = [
+    AlgoParameterDef("engine", "str", ["auto", "jit", "numpy"], "auto"),
+]
 
 
 def computation_memory(node) -> float:
@@ -55,22 +63,68 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
     """Exact solve via level-scheduled UTIL/VALUE sweeps."""
     import time
 
+    requested = "auto"
+    if algo_def is not None and algo_def.params:
+        requested = algo_def.params.get("engine", "auto")
+    engine = requested
     t0 = time.perf_counter()
     graph = pt.build_computation_graph(dcop)
-    nodes = {n.name: n for n in graph.nodes}
     mode = dcop.objective
 
+    if engine == "auto":
+        # Batching pays when levels are wide (many nodes per kernel
+        # call); deep narrow trees are dispatch-overhead-bound and run
+        # faster through the per-node numpy sweep.
+        depth = pt.node_depths(graph)
+        levels = max(depth.values(), default=0) + 1
+        mean_width = len(depth) / levels
+        engine = "jit" if mean_width >= 16 else "numpy"
+
+    if engine == "jit":
+        try:
+            assignment, stats = solve_sweep(graph, mode)
+            elapsed = time.perf_counter() - t0
+            cost, _ = dcop.solution_cost(assignment)
+            stats["device_cost"] = cost
+            stats["engine"] = "jit"
+            return DeviceRunResult(
+                assignment=assignment,
+                cycles=stats.pop("levels"),
+                converged=True,
+                time_s=elapsed,
+                compile_time_s=0.0,
+                metrics=stats,
+            )
+        except (ImportError, UtilTooLargeError) as e:
+            if requested == "jit":
+                raise
+            # No jax, or a UTIL table beyond the device cap (the host
+            # sweep can still stream it): fall back, audibly.
+            import logging
+
+            logging.getLogger("pydcop.algo.dpop").warning(
+                "jit sweep unavailable (%s); using numpy sweep", e
+            )
+
+    assignment, stats = _solve_numpy(graph, mode)
+    elapsed = time.perf_counter() - t0
+    cost, _ = dcop.solution_cost(assignment)
+    return DeviceRunResult(
+        assignment=assignment,
+        cycles=stats.pop("levels"),
+        converged=True,
+        time_s=elapsed,
+        compile_time_s=0.0,
+        metrics={**stats, "device_cost": cost, "engine": "numpy"},
+    )
+
+
+def _solve_numpy(graph, mode: str):
+    """Host-side per-node sweep (dense numpy relation algebra)."""
+    nodes = {n.name: n for n in graph.nodes}
+
     # Order nodes deepest-first for the UTIL sweep.
-    depth: Dict[str, int] = {}
-
-    def _depth(name: str) -> int:
-        if name not in depth:
-            parent = nodes[name].parent
-            depth[name] = 0 if parent is None else _depth(parent) + 1
-        return depth[name]
-
-    for name in nodes:
-        _depth(name)
+    depth = pt.node_depths(graph)
     util_order = sorted(nodes, key=lambda n: -depth[n])
 
     # UTIL phase: joined[n] = join(own constraints, children UTILs);
@@ -114,17 +168,9 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
         if node.children:
             msg_count += len(node.children)
 
-    elapsed = time.perf_counter() - t0
-    cost, _ = dcop.solution_cost(assignment)
-    return DeviceRunResult(
-        assignment=assignment,
-        cycles=max(depth.values(), default=0) + 1,
-        converged=True,
-        time_s=elapsed,
-        compile_time_s=0.0,
-        metrics={
-            "msg_count": msg_count,
-            "msg_size": msg_size,
-            "device_cost": cost,
-        },
-    )
+    stats = {
+        "msg_count": msg_count,
+        "msg_size": msg_size,
+        "levels": max(depth.values(), default=0) + 1,
+    }
+    return assignment, stats

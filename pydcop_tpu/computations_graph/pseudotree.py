@@ -200,6 +200,22 @@ def build_computation_graph(
     return ComputationPseudoTree(nodes)
 
 
+def node_depths(graph: ComputationPseudoTree) -> Dict[str, int]:
+    """Depth of every node (root = 0), memoized over parent links."""
+    nodes = {n.name: n for n in graph.nodes}
+    depth: Dict[str, int] = {}
+
+    def _depth(name: str) -> int:
+        if name not in depth:
+            parent = nodes[name].parent
+            depth[name] = 0 if parent is None else _depth(parent) + 1
+        return depth[name]
+
+    for name in nodes:
+        _depth(name)
+    return depth
+
+
 def computation_memory(node: ComputationNode) -> float:
     """DPOP UTIL-table footprint upper bound: product of separator domain
     sizes (exponential in separator size)."""

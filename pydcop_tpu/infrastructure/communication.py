@@ -215,6 +215,10 @@ class HttpCommunicationLayer(CommunicationLayer):
         # instead of lingering in the retry queue for RETRY_WINDOW
         # (and possibly re-delivering to a re-added namesake).
         self._removed_agents: set = set()
+        # Last removal time per agent name — never cleared on re-add,
+        # so retry entries enqueued before a removal are dropped even
+        # when the name is re-registered within one retry sweep.
+        self._removed_at: Dict[str, float] = {}
         self._shutdown = False
         self._start_server()
 
@@ -222,6 +226,7 @@ class HttpCommunicationLayer(CommunicationLayer):
         if event == "agent_removed":
             with self._retry_lock:
                 self._removed_agents.add(agent_name)
+                self._removed_at[agent_name] = time.monotonic()
                 before = len(self._retry_queue)
                 self._retry_queue = [
                     entry for entry in self._retry_queue
@@ -323,6 +328,18 @@ class HttpCommunicationLayer(CommunicationLayer):
         except Exception as e:
             return f"{host}:{port} unreachable: {e}"
 
+    def _is_stale(self, expire: float, dest: str) -> bool:
+        """True when the entry targets a currently-removed agent, or
+        was enqueued before the agent's last removal (delivery would
+        reach a re-added namesake).  Call with _retry_lock held."""
+        if dest in self._removed_agents:
+            return True
+        removed_at = self._removed_at.get(dest)
+        return (
+            removed_at is not None
+            and expire - self.RETRY_WINDOW <= removed_at
+        )
+
     def _schedule_retry(self, src_agent: str, dest_agent: str,
                         msg: ComputationMessage, error: str):
         logger.debug(
@@ -358,10 +375,11 @@ class HttpCommunicationLayer(CommunicationLayer):
             still_failing = []
             for expire, src, dest, cmsg in pending:
                 with self._retry_lock:
-                    if dest in self._removed_agents:
-                        # The agent departed while this entry was
-                        # swapped out of the queue; a purge cannot see
-                        # it, so drop it here.
+                    if self._is_stale(expire, dest):
+                        # The agent departed after this entry was
+                        # enqueued (and possibly re-registered since);
+                        # a purge cannot see swapped-out entries, so
+                        # drop them here.
                         continue
                 error = self._try_send(src, dest, cmsg)
                 if error is None:
@@ -377,7 +395,7 @@ class HttpCommunicationLayer(CommunicationLayer):
                 with self._retry_lock:
                     self._retry_queue.extend(
                         entry for entry in still_failing
-                        if entry[2] not in self._removed_agents
+                        if not self._is_stale(entry[0], entry[2])
                     )
 
     def shutdown(self):

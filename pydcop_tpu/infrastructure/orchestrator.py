@@ -102,10 +102,13 @@ class AgentsMgt(MessagePassingComputation):
 
     @register("repair_done")
     def _on_repair_done(self, sender, msg, t):
-        self.repaired_computations.update(msg.computations)
-        self.repair_event_count += len(msg.computations)
         for comp in msg.computations:
+            # Duplicate re-acks (host-side activation dedupe) must not
+            # inflate the event counter.
+            if self.repair_acked.get(comp) != msg.agent:
+                self.repair_event_count += 1
             self.repair_acked[comp] = msg.agent
+            self.repaired_computations.add(comp)
         self.orchestrator._repair_evt.set()
 
     @register("repair_failed")
@@ -529,11 +532,14 @@ class Orchestrator:
                 break
             retry: Dict[str, str] = {}
             for comp, host in pending.items():
-                if comp not in failed:
+                if comp not in failed and \
+                        host not in self._removed_agents:
                     # Unacked: lost request or delayed ack — re-send to
                     # the same host next round.
                     retry[comp] = host
                     continue
+                # Nacked, or the host itself departed mid-repair (it
+                # will never answer): fail over to the next candidate.
                 self.mgt.repair_failed.pop(comp, None)
                 if host in self.mgt.replica_hosts.get(comp, []):
                     # The host refused, so its replica record is stale.

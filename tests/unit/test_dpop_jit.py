@@ -1,0 +1,175 @@
+"""Tensorized (level-batched, jitted) DPOP vs the numpy sweep.
+
+The jit path must be bit-compatible with the per-node host sweep on the
+solution *cost* (assignments can differ only on exact-tie optima, which
+the seeded float costs below make improbable).  Reference semantics:
+pydcop/algorithms/dpop.py:313-439.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.dpop import solve_on_device
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+
+def random_dcop(n, d, seed, extra_edges=0, objective="min", wide=True):
+    """Random spanning tree + optional extra (cycle-creating) edges."""
+    rng = np.random.default_rng(seed)
+    dom = Domain("c", "", list(range(d)))
+    dcop = DCOP("t", objective=objective)
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    k = 0
+    for i in range(1, n):
+        p = rng.integers(0, i) if wide else rng.integers(max(0, i - 2), i)
+        m = rng.random((d, d))
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[p], vs[i]], m, f"c{k}")
+        )
+        k += 1
+    for _ in range(extra_edges):
+        i, j = rng.choice(n, size=2, replace=False)
+        m = rng.random((d, d))
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[j]], m, f"c{k}")
+        )
+        k += 1
+    return dcop
+
+
+def _solve(dcop, engine):
+    algo = AlgorithmDef.build_with_default_param(
+        "dpop", {"engine": engine}, mode=dcop.objective
+    )
+    return solve_on_device(dcop, algo)
+
+
+class TestJitNumpyParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("d", [2, 4])
+    def test_tree_parity(self, seed, d):
+        dcop = random_dcop(60, d, seed)
+        r_jit = _solve(dcop, "jit")
+        r_np = _solve(dcop, "numpy")
+        assert r_jit.metrics["engine"] == "jit"
+        assert r_np.metrics["engine"] == "numpy"
+        assert r_jit.metrics["device_cost"] == pytest.approx(
+            r_np.metrics["device_cost"], abs=1e-3
+        )
+
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_cyclic_graph_parity(self, seed):
+        """Back edges create pseudo-parents and wider separators."""
+        dcop = random_dcop(40, 3, seed, extra_edges=12)
+        r_jit = _solve(dcop, "jit")
+        r_np = _solve(dcop, "numpy")
+        assert r_jit.metrics["device_cost"] == pytest.approx(
+            r_np.metrics["device_cost"], abs=1e-3
+        )
+
+    def test_max_mode_parity(self):
+        dcop = random_dcop(50, 3, 7, extra_edges=5, objective="max")
+        r_jit = _solve(dcop, "jit")
+        r_np = _solve(dcop, "numpy")
+        assert r_jit.metrics["device_cost"] == pytest.approx(
+            r_np.metrics["device_cost"], abs=1e-3
+        )
+
+    def test_forest_parity(self):
+        """Disconnected components: several roots, independent sweeps."""
+        rng = np.random.default_rng(11)
+        dom = Domain("c", "", [0, 1, 2])
+        dcop = DCOP("f", objective="min")
+        vs = [Variable(f"v{i}", dom) for i in range(30)]
+        for v in vs:
+            dcop.add_variable(v)
+        # Three 10-node trees.
+        for base in (0, 10, 20):
+            for i in range(base + 1, base + 10):
+                p = rng.integers(base, i)
+                dcop.add_constraint(NAryMatrixRelation(
+                    [vs[p], vs[i]], rng.random((3, 3)), f"c{i}"
+                ))
+        r_jit = _solve(dcop, "jit")
+        r_np = _solve(dcop, "numpy")
+        assert r_jit.metrics["device_cost"] == pytest.approx(
+            r_np.metrics["device_cost"], abs=1e-3
+        )
+        assert len(r_jit.assignment) == 30
+
+    def test_mixed_domain_sizes(self):
+        rng = np.random.default_rng(13)
+        doms = [Domain(f"d{k}", "", list(range(k))) for k in (2, 3, 5)]
+        dcop = DCOP("m", objective="min")
+        vs = [Variable(f"v{i}", doms[i % 3]) for i in range(24)]
+        for v in vs:
+            dcop.add_variable(v)
+        for i in range(1, 24):
+            p = rng.integers(0, i)
+            shape = (len(vs[p].domain), len(vs[i].domain))
+            dcop.add_constraint(NAryMatrixRelation(
+                [vs[p], vs[i]], rng.random(shape), f"c{i}"
+            ))
+        r_jit = _solve(dcop, "jit")
+        r_np = _solve(dcop, "numpy")
+        assert r_jit.metrics["device_cost"] == pytest.approx(
+            r_np.metrics["device_cost"], abs=1e-3
+        )
+
+    def test_ternary_constraints(self):
+        rng = np.random.default_rng(17)
+        dom = Domain("c", "", [0, 1, 2])
+        dcop = DCOP("t3", objective="min")
+        vs = [Variable(f"v{i}", dom) for i in range(12)]
+        for v in vs:
+            dcop.add_variable(v)
+        for i in range(2, 12):
+            dcop.add_constraint(NAryMatrixRelation(
+                [vs[i - 2], vs[i - 1], vs[i]],
+                rng.random((3, 3, 3)), f"c{i}",
+            ))
+        r_jit = _solve(dcop, "jit")
+        r_np = _solve(dcop, "numpy")
+        assert r_jit.metrics["device_cost"] == pytest.approx(
+            r_np.metrics["device_cost"], abs=1e-3
+        )
+
+
+class TestGuards:
+    def test_util_too_large_refused(self):
+        from pydcop_tpu.computations_graph import pseudotree as pt
+        from pydcop_tpu.ops.dpop import UtilTooLargeError, compile_tree
+
+        rng = np.random.default_rng(19)
+        dom = Domain("c", "", list(range(30)))
+        dcop = DCOP("wide", objective="min")
+        # A clique of 8 30-value variables: separator width 7 at the
+        # deepest node -> 30^8 elements, far beyond the cap.
+        vs = [Variable(f"v{i}", dom) for i in range(8)]
+        for v in vs:
+            dcop.add_variable(v)
+        k = 0
+        for i in range(8):
+            for j in range(i + 1, 8):
+                dcop.add_constraint(NAryMatrixRelation(
+                    [vs[i], vs[j]], rng.random((30, 30)), f"c{k}"
+                ))
+                k += 1
+        graph = pt.build_computation_graph(dcop)
+        with pytest.raises(UtilTooLargeError):
+            compile_tree(graph, "min")
+
+    def test_auto_prefers_numpy_on_deep_chains(self):
+        dcop = random_dcop(40, 3, 23, wide=False)
+        res = _solve(dcop, "auto")
+        assert res.metrics["engine"] == "numpy"
+
+    def test_auto_prefers_jit_on_wide_trees(self):
+        dcop = random_dcop(300, 3, 29, wide=True)
+        res = _solve(dcop, "auto")
+        assert res.metrics["engine"] == "jit"
