@@ -27,6 +27,7 @@ UNIT_SIZE = 5
 algo_params = [
     AlgoParameterDef("infinity", "int", None, 10000),
     AlgoParameterDef("max_distance", "int", None, 50),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
     AlgoParameterDef("seed", "int", None, 0),
 ]
 

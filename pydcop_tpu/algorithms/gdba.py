@@ -24,6 +24,7 @@ algo_params = [
     AlgoParameterDef("modifier", "str", ["A", "M"], "A"),
     AlgoParameterDef("violation", "str", ["NZ", "NM", "MX"], "NZ"),
     AlgoParameterDef("increase_mode", "str", ["E", "R", "C", "T"], "E"),
+    AlgoParameterDef("stop_cycle", "int", None, 0),
     AlgoParameterDef("seed", "int", None, 0),
 ]
 

@@ -936,11 +936,13 @@ class NcbbComputation(SynchronousComputationMixin, VariableComputation):
 # Registry
 
 
-# Algorithms with an agent-mode (message-passing) computation; others
-# are device-engine only for now and rejected up front.
+# Every algorithm module has an agent-mode (message-passing)
+# computation (reference parity: all 14 reference algorithms are
+# distributed computations).
 AGENT_MODE_ALGOS = frozenset(
     {"maxsum", "amaxsum", "maxsum_dynamic", "dsa", "adsa", "dsatuto",
-     "mgm", "ncbb"}
+     "mgm", "ncbb", "dpop", "syncbb", "mgm2", "dba", "gdba",
+     "mixeddsa"}
 )
 
 
@@ -952,6 +954,16 @@ def build(algo_name: str, comp_def):
     from pydcop_tpu.computations_graph.factor_graph import (
         FactorComputationNode,
         VariableComputationNode,
+    )
+    from pydcop_tpu.infrastructure.agent_breakout import (
+        DbaComputation,
+        GdbaComputation,
+        MixedDsaComputation,
+        Mgm2Computation,
+    )
+    from pydcop_tpu.infrastructure.agent_search import (
+        DpopComputation,
+        SyncBBComputation,
     )
 
     if algo_name in ("maxsum", "amaxsum"):
@@ -974,6 +986,18 @@ def build(algo_name: str, comp_def):
         return MgmComputation(comp_def)
     if algo_name == "ncbb":
         return NcbbComputation(comp_def)
+    if algo_name == "dpop":
+        return DpopComputation(comp_def)
+    if algo_name == "syncbb":
+        return SyncBBComputation(comp_def)
+    if algo_name == "mgm2":
+        return Mgm2Computation(comp_def)
+    if algo_name == "dba":
+        return DbaComputation(comp_def)
+    if algo_name == "gdba":
+        return GdbaComputation(comp_def)
+    if algo_name == "mixeddsa":
+        return MixedDsaComputation(comp_def)
     raise NotImplementedError(
         f"No agent-mode computation for algorithm {algo_name!r} yet"
     )

@@ -1,0 +1,827 @@
+"""Agent-mode computations for the breakout / local-search family:
+DBA, GDBA, MixedDSA and MGM2.
+
+Reference parity (semantics, not translation):
+- dba: pydcop/algorithms/dba.py:272-595 — ok/improve waves, per-agent
+  constraint weights bumped at quasi-local minima, termination via
+  distance counters.
+- gdba: pydcop/algorithms/gdba.py:189-654 — generalized breakout on
+  optimization problems with modifier tables (A/M), violation tests
+  (NZ/NM/MX) and increase scopes (E/R/C/T).
+- mixeddsa: pydcop/algorithms/mixeddsa.py:154-470 — DSA distinguishing
+  hard (infinite-cost) from soft constraints, with proba_hard /
+  proba_soft move probabilities.
+- mgm2: pydcop/algorithms/mgm2.py:399-1050 — 5-phase coordinated
+  2-opt: value / offer / response / gain / go.
+
+The device kernels for the same algorithms live in pydcop_tpu/ops/
+(dba.py, gdba.py, mixeddsa.py, mgm2.py); these message-passing
+versions mirror their decision rules so thread-mode and device-mode
+runs explore comparable search spaces.
+"""
+
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.dcop.relations import optimal_cost_value
+from pydcop_tpu.infrastructure.computations import (
+    VariableComputation,
+    message_type,
+    register,
+)
+
+# -- shared helpers ----------------------------------------------------- #
+
+
+def _constraint_cost(constraint, assignment: Dict[str, Any]) -> float:
+    return constraint(
+        **{n: assignment[n] for n in constraint.scope_names}
+    )
+
+
+class _HypergraphComputation(VariableComputation):
+    """Base for constraints-hypergraph computations: neighbor set from
+    the node's constraints, sign normalization, unary costs."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        self.constraints = list(comp_def.node.constraints)
+        self._neighbors = list(dict.fromkeys(
+            v.name for c in self.constraints for v in c.dimensions
+            if v.name != self.name
+        ))
+
+    @property
+    def neighbors(self) -> List[str]:
+        return self._neighbors
+
+    @property
+    def sign(self) -> float:
+        # Internally always minimize sign*cost.
+        return 1.0 if self.mode == "min" else -1.0
+
+    def _finish_no_neighbors(self) -> bool:
+        if self._neighbors:
+            return False
+        value, cost = optimal_cost_value(self._variable, self.mode)
+        self.value_selection(value, cost)
+        self.finished()
+        self.stop()
+        return True
+
+
+# -- DBA ---------------------------------------------------------------- #
+
+DbaOkMessage = message_type("dba_ok", ["value"])
+DbaImproveMessage = message_type(
+    "dba_improve", ["improve", "eval", "termination_counter"])
+DbaEndMessage = message_type("dba_end", [])
+
+
+class DbaComputation(_HypergraphComputation):
+    """Distributed Breakout: ok-phase / improve-phase waves.
+
+    Violation = constraint cost >= ``infinity``; eval(value) = weighted
+    count of violated incident constraints with neighbors at their last
+    announced values; each agent keeps its own weight per incident
+    constraint, bumped by 1 at quasi-local minima (reference
+    dba.py:452, :563-565; device twin ops/dba.py).
+    """
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def)
+        params = comp_def.algo.params
+        self.infinity = params.get("infinity", 10000)
+        self.max_distance = params.get("max_distance", 50)
+        self.stop_cycle = params.get("stop_cycle", 0)
+        self._weights = {c.name: 1.0 for c in self.constraints}
+        self._term_counter = 0.0
+        self._state = "ok"
+        self._neighbor_values: Dict[str, Any] = {}
+        self._neighbor_improves: Dict[str, Tuple[float, float, float]] = {}
+        self._postponed_ok: List[Tuple] = []
+        self._postponed_improve: List[Tuple] = []
+        self._improve = 0.0
+        self._proposed = None
+        self._ended = False
+
+    def on_start(self):
+        if self._finish_no_neighbors():
+            return
+        self.random_value_selection()
+        self.post_to_all_neighbors(DbaOkMessage(self.current_value))
+
+    def _eval(self, value) -> float:
+        asst = dict(self._neighbor_values)
+        asst[self.name] = value
+        total = 0.0
+        for c in self.constraints:
+            if _constraint_cost(c, asst) >= self.infinity:
+                total += self._weights[c.name]
+        return total
+
+    @register("dba_ok")
+    def _on_ok(self, sender, msg, t):
+        if self._ended:
+            return
+        if self._state == "ok":
+            self._handle_ok(sender, msg.value)
+        else:
+            self._postponed_ok.append((sender, msg.value))
+
+    def _handle_ok(self, sender, value):
+        self._neighbor_values[sender] = value
+        if len(self._neighbor_values) < len(self._neighbors):
+            return
+        cur_eval = self._eval(self.current_value)
+        best_eval, best_vals = None, []
+        for v in self._variable.domain:
+            e = self._eval(v)
+            if best_eval is None or e < best_eval:
+                best_eval, best_vals = e, [v]
+            elif e == best_eval:
+                best_vals.append(v)
+        self._improve = cur_eval - best_eval
+        self._cur_eval = cur_eval
+        self._proposed = random.choice(best_vals)
+        if cur_eval != 0:
+            self._term_counter = 0.0
+        self._state = "improve"
+        self.post_to_all_neighbors(DbaImproveMessage(
+            self._improve, cur_eval, self._term_counter
+        ))
+        for s, m in self._postponed_improve:
+            self._handle_improve(s, m)
+        self._postponed_improve.clear()
+
+    @register("dba_improve")
+    def _on_improve(self, sender, msg, t):
+        if self._ended:
+            return
+        if self._state == "improve":
+            self._handle_improve(sender, msg)
+        else:
+            self._postponed_improve.append((sender, msg))
+
+    def _handle_improve(self, sender, msg):
+        self._neighbor_improves[sender] = (
+            msg.improve, msg.eval, msg.termination_counter
+        )
+        if len(self._neighbor_improves) < len(self._neighbors):
+            return
+        n_improves = {
+            s: i for s, (i, _, _) in self._neighbor_improves.items()
+        }
+        n_max = max(n_improves.values())
+        wins = self._improve > n_max or (
+            self._improve == n_max
+            and all(
+                self.name < s for s, i in n_improves.items()
+                if i == n_max
+            )
+        )
+        if self._improve > 0 and wins:
+            self.value_selection(
+                self._proposed, self._cur_eval - self._improve
+            )
+        # Quasi-local minimum: nobody can improve -> breakout.
+        if self._improve <= 0 and n_max <= 0:
+            asst = dict(self._neighbor_values)
+            asst[self.name] = self.current_value
+            for c in self.constraints:
+                if _constraint_cost(c, asst) >= self.infinity:
+                    self._weights[c.name] += 1.0
+        # Termination counters (dba.py:405,:509,:541).
+        n_tc_min = min(
+            tc for _, _, tc in self._neighbor_improves.values()
+        )
+        self._term_counter = min(self._term_counter, n_tc_min)
+        consistent = self._cur_eval == 0 and all(
+            e == 0 for _, e, _ in self._neighbor_improves.values()
+        )
+        if consistent:
+            self._term_counter += 1
+        self._neighbor_values.clear()
+        self._neighbor_improves.clear()
+        self._state = "ok"
+        self.new_cycle()
+        if self._term_counter >= self.max_distance or (
+            self.stop_cycle and self.cycle_count >= self.stop_cycle
+        ):
+            self._end()
+            return
+        self.post_to_all_neighbors(DbaOkMessage(self.current_value))
+        for s, v in self._postponed_ok:
+            self._handle_ok(s, v)
+        self._postponed_ok.clear()
+
+    def _end(self):
+        if self._ended:
+            return
+        self._ended = True
+        self.post_to_all_neighbors(DbaEndMessage())
+        self.finished()
+
+    @register("dba_end")
+    def _on_end(self, sender, msg, t):
+        self._end()
+
+
+# -- GDBA --------------------------------------------------------------- #
+
+GdbaOkMessage = message_type("gdba_ok", ["value"])
+GdbaImproveMessage = message_type("gdba_improve", ["improve"])
+
+
+class GdbaComputation(_HypergraphComputation):
+    """Generalized Distributed Breakout (optimization problems).
+
+    Each agent keeps a modifier table per incident constraint (same
+    shape as its cost hypercube); effective cost = base + modifier
+    (mode A) or base * modifier (mode M).  At neighborhood minima the
+    modifiers of *violated* constraints increase on entries selected by
+    ``increase_mode`` (reference gdba.py:552-654; device twin
+    ops/gdba.py).
+    """
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def)
+        params = comp_def.algo.params
+        self.modifier_mode = params.get("modifier", "A")
+        self.violation_mode = params.get("violation", "NZ")
+        self.increase_mode = params.get("increase_mode", "E")
+        self.stop_cycle = params.get("stop_cycle", 0)
+        base = 0.0 if self.modifier_mode == "A" else 1.0
+        self._modifiers = {
+            c.name: np.full(c.shape, base, dtype=np.float64)
+            for c in self.constraints
+        }
+        self._tables = {
+            c.name: self.sign * np.asarray(
+                c.to_array(), dtype=np.float64
+            )
+            for c in self.constraints
+        }
+        self._minmax = {
+            name: (float(t.min()), float(t.max()))
+            for name, t in self._tables.items()
+        }
+        self._state = "ok"
+        self._neighbor_values: Dict[str, Any] = {}
+        self._neighbor_improves: Dict[str, float] = {}
+        self._postponed_ok: List[Tuple] = []
+        self._postponed_improve: List[Tuple] = []
+        self._improve = 0.0
+        self._proposed = None
+
+    def on_start(self):
+        if self._finish_no_neighbors():
+            return
+        self.random_value_selection()
+        self.post_to_all_neighbors(GdbaOkMessage(self.current_value))
+
+    def _indices(self, constraint, assignment) -> Tuple[int, ...]:
+        return tuple(
+            v.domain.index(assignment[v.name])
+            for v in constraint.dimensions
+        )
+
+    def _eff_cost(self, constraint, assignment) -> float:
+        idx = self._indices(constraint, assignment)
+        base = self._tables[constraint.name][idx]
+        mod = self._modifiers[constraint.name][idx]
+        return base + mod if self.modifier_mode == "A" else base * mod
+
+    def _eval(self, value) -> float:
+        asst = dict(self._neighbor_values)
+        asst[self.name] = value
+        total = self.sign * self._variable.cost_for_val(value)
+        for c in self.constraints:
+            total += self._eff_cost(c, asst)
+        return total
+
+    @register("gdba_ok")
+    def _on_ok(self, sender, msg, t):
+        if self._state == "ok":
+            self._handle_ok(sender, msg.value)
+        else:
+            self._postponed_ok.append((sender, msg.value))
+
+    def _handle_ok(self, sender, value):
+        self._neighbor_values[sender] = value
+        if len(self._neighbor_values) < len(self._neighbors):
+            return
+        cur_eval = self._eval(self.current_value)
+        best_eval, best_vals = None, []
+        for v in self._variable.domain:
+            e = self._eval(v)
+            if best_eval is None or e < best_eval:
+                best_eval, best_vals = e, [v]
+            elif e == best_eval:
+                best_vals.append(v)
+        self._improve = cur_eval - best_eval
+        self._proposed = random.choice(best_vals)
+        self._state = "improve"
+        self.post_to_all_neighbors(GdbaImproveMessage(self._improve))
+        for s, m in self._postponed_improve:
+            self._handle_improve(s, m)
+        self._postponed_improve.clear()
+
+    @register("gdba_improve")
+    def _on_improve(self, sender, msg, t):
+        if self._state == "improve":
+            self._handle_improve(sender, msg)
+        else:
+            self._postponed_improve.append((sender, msg))
+
+    def _handle_improve(self, sender, msg):
+        self._neighbor_improves[sender] = msg.improve
+        if len(self._neighbor_improves) < len(self._neighbors):
+            return
+        n_max = max(self._neighbor_improves.values())
+        wins = self._improve > n_max or (
+            self._improve == n_max
+            and all(
+                self.name < s
+                for s, i in self._neighbor_improves.items()
+                if i == n_max
+            )
+        )
+        if self._improve > 0 and wins:
+            self.value_selection(self._proposed, 0.0)
+        if self._improve <= 0 and n_max <= 0:
+            self._increase_modifiers()
+        self._neighbor_values.clear()
+        self._neighbor_improves.clear()
+        self._state = "ok"
+        self.new_cycle()
+        if self.stop_cycle and self.cycle_count >= self.stop_cycle:
+            self.finished()
+            self.stop()
+            return
+        self.post_to_all_neighbors(GdbaOkMessage(self.current_value))
+        for s, v in self._postponed_ok:
+            self._handle_ok(s, v)
+        self._postponed_ok.clear()
+
+    def _increase_modifiers(self):
+        asst = dict(self._neighbor_values)
+        asst[self.name] = self.current_value
+        for c in self.constraints:
+            idx = self._indices(c, asst)
+            base = self._tables[c.name][idx]
+            fmin, fmax = self._minmax[c.name]
+            if self.violation_mode == "NZ":
+                violated = base != 0
+            elif self.violation_mode == "NM":
+                violated = base != fmin
+            else:  # MX
+                violated = base == fmax
+            if not violated:
+                continue
+            mods = self._modifiers[c.name]
+            own_axis = [
+                i for i, v in enumerate(c.dimensions)
+                if v.name == self.name
+            ][0]
+            sel: List[Any] = []
+            for q in range(len(c.dimensions)):
+                if self.increase_mode == "T":
+                    sel.append(slice(None))
+                elif self.increase_mode == "E":
+                    sel.append(idx[q])
+                elif self.increase_mode == "R":
+                    # Own axis free, others at current.
+                    sel.append(
+                        slice(None) if q == own_axis else idx[q]
+                    )
+                else:  # C: own at current, others free
+                    sel.append(
+                        idx[q] if q == own_axis else slice(None)
+                    )
+            mods[tuple(sel)] += 1.0
+
+
+# -- MixedDSA ----------------------------------------------------------- #
+
+MixedDsaMessage = message_type("mixed_dsa_value", ["value"])
+
+
+class MixedDsaComputation(_HypergraphComputation):
+    """DSA over mixed hard (infinite-cost) / soft constraint problems
+    (reference mixeddsa.py:154-470; device twin ops/mixeddsa.py).
+
+    Candidates are ranked lexicographically: fewest violated hard
+    constraints first, then DCOP cost excluding violated hard
+    infinities.  Moves use proba_hard when a hard improvement (or hard
+    escape) is available, proba_soft for soft improvements/escapes.
+    """
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def)
+        params = comp_def.algo.params
+        self.proba_hard = params.get("proba_hard", 0.7)
+        self.proba_soft = params.get("proba_soft", 0.5)
+        self.variant = params.get("variant", "B")
+        self.stop_cycle = params.get("stop_cycle", 0)
+        self._hard = {}
+        self._soft_opt = {}
+        for c in self.constraints:
+            table = self.sign * np.asarray(
+                c.to_array(), dtype=np.float64
+            )
+            is_hard = bool(np.isinf(table).any())
+            self._hard[c.name] = is_hard
+            if not is_hard:
+                self._soft_opt[c.name] = float(table.min())
+        self.current_cycle: Dict[str, Any] = {}
+        self.next_cycle: Dict[str, Any] = {}
+
+    def on_start(self):
+        if self._finish_no_neighbors():
+            return
+        self.random_value_selection()
+        self.post_to_all_neighbors(MixedDsaMessage(self.current_value))
+
+    @register("mixed_dsa_value")
+    def _on_value(self, sender, msg, t):
+        if not self._running:
+            return
+        if sender not in self.current_cycle:
+            self.current_cycle[sender] = msg.value
+            self._evaluate_cycle()
+        else:
+            self.next_cycle[sender] = msg.value
+
+    def _metrics(self, value) -> Tuple[int, float]:
+        """(violated-hard count, cost excluding their infinities)."""
+        asst = dict(self.current_cycle)
+        asst[self.name] = value
+        nb_viol = 0
+        cost = self.sign * self._variable.cost_for_val(value)
+        for c in self.constraints:
+            c_cost = self.sign * _constraint_cost(c, asst)
+            if self._hard[c.name] and np.isinf(c_cost):
+                nb_viol += 1
+            else:
+                cost += c_cost
+        return nb_viol, cost
+
+    def _soft_violated(self) -> bool:
+        asst = dict(self.current_cycle)
+        asst[self.name] = self.current_value
+        for c in self.constraints:
+            if self._hard[c.name]:
+                continue
+            if self.sign * _constraint_cost(c, asst) != \
+                    self._soft_opt[c.name]:
+                return True
+        return False
+
+    def _evaluate_cycle(self):
+        if len(self.current_cycle) < len(self._neighbors):
+            return
+        cur_nb, cur_cost = self._metrics(self.current_value)
+        best: List[Any] = []
+        best_nb, best_cost = None, None
+        for v in self._variable.domain:
+            nb, cost = self._metrics(v)
+            key = (nb, cost)
+            if best_nb is None or key < (best_nb, best_cost):
+                best_nb, best_cost = nb, cost
+                best = [v]
+            elif key == (best_nb, best_cost):
+                best.append(v)
+        delta_dcsp = cur_nb - best_nb
+        delta_dcop = cur_cost - best_cost
+        alt = [v for v in best if v != self.current_value]
+        variant_bc = self.variant in ("B", "C")
+
+        proba, pool = 0.0, best
+        if delta_dcsp > 0:
+            proba = self.proba_hard
+        elif delta_dcsp == 0 and delta_dcop > 0:
+            proba = self.proba_soft
+        elif delta_dcsp == 0 and delta_dcop == 0:
+            if best_nb > 0 and alt:
+                proba, pool = self.proba_hard, alt
+            elif (
+                variant_bc and best_nb == 0 and alt
+                and self._soft_violated()
+            ):
+                proba, pool = self.proba_soft, alt
+        if proba > 0 and random.random() < proba:
+            self.value_selection(random.choice(pool), best_cost)
+
+        self.new_cycle()
+        self.current_cycle, self.next_cycle = self.next_cycle, {}
+        if self.stop_cycle and self.cycle_count >= self.stop_cycle:
+            self.finished()
+            self.stop()
+            return
+        self.post_to_all_neighbors(MixedDsaMessage(self.current_value))
+
+
+# -- MGM2 --------------------------------------------------------------- #
+
+Mgm2ValueMessage = message_type("mgm2_value", ["value"])
+Mgm2OfferMessage = message_type("mgm2_offer", ["offers"])
+Mgm2ResponseMessage = message_type(
+    "mgm2_response", ["accept", "my_value", "your_value", "gain"])
+Mgm2GainMessage = message_type("mgm2_gain", ["gain"])
+Mgm2GoMessage = message_type("mgm2_go", ["go"])
+
+
+class Mgm2Computation(_HypergraphComputation):
+    """MGM2: coordinated 2-opt local search, 5 phases per round
+    (reference mgm2.py:399-1050).
+
+    Round structure: every agent broadcasts its value; with probability
+    ``threshold`` an agent becomes an *offerer* and proposes joint
+    moves to one random neighbor (offers carry the offerer-side gain
+    over *all its incident constraints*, the partner adds its own gain
+    over its non-shared constraints — no double counting); partners
+    accept the best positive offer (``favor`` arbitrates ties against
+    the unilateral gain); everyone then broadcasts its committed gain,
+    committed pairs exchange go/no-go (move iff the pair's gain beats
+    both neighborhoods), unilateral movers follow MGM's strict-winner
+    rule.  Global cost is monotone non-increasing: contested ties stay
+    put.
+    """
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def)
+        params = comp_def.algo.params
+        self.threshold = params.get("threshold", 0.5)
+        self.favor = params.get("favor", "unilateral")
+        self.stop_cycle = params.get("stop_cycle", 0)
+        self._vars_by_name = {
+            v.name: v
+            for c in self.constraints for v in c.dimensions
+        }
+        self._phase = "value"
+        self._neighbor_values: Dict[str, Any] = {}
+        self._offers_in: Dict[str, Any] = {}
+        self._gains_in: Dict[str, float] = {}
+        self._postponed: Dict[str, List[Tuple]] = {
+            "value": [], "offer": [], "response": [], "gain": [],
+            "go": [],
+        }
+        self._is_offerer = False
+        self._partner: Optional[str] = None
+        self._committed_gain = 0.0
+        self._new_value = None
+        self._coordinated = False
+        self._response_in: Optional[Tuple] = None
+        self._go_in: Optional[bool] = None
+
+    def on_start(self):
+        if self._finish_no_neighbors():
+            return
+        self.random_value_selection()
+        self.post_to_all_neighbors(Mgm2ValueMessage(self.current_value))
+
+    # -- cost helpers -------------------------------------------------- #
+
+    def _local_cost(self, my_value, overrides: Dict[str, Any] = None,
+                    exclude_with: Optional[str] = None) -> float:
+        """Sign-normalized cost of incident constraints (+ own unary)
+        with neighbors at announced values, optionally overriding some
+        and excluding constraints involving ``exclude_with``."""
+        asst = dict(self._neighbor_values)
+        if overrides:
+            asst.update(overrides)
+        asst[self.name] = my_value
+        total = self.sign * self._variable.cost_for_val(my_value)
+        for c in self.constraints:
+            if exclude_with is not None and \
+                    exclude_with in c.scope_names:
+                continue
+            total += self.sign * _constraint_cost(c, asst)
+        return total
+
+    def _best_unilateral(self) -> Tuple[Any, float]:
+        cur = self._local_cost(self.current_value)
+        best_v, best_c = self.current_value, cur
+        for v in self._variable.domain:
+            c = self._local_cost(v)
+            if c < best_c:
+                best_v, best_c = v, c
+        return best_v, cur - best_c
+
+    # -- phase machinery ------------------------------------------------ #
+
+    def _enter(self, phase: str):
+        self._phase = phase
+        handler = {
+            "value": self._handle_value,
+            "offer": self._handle_offer,
+            "response": self._handle_response,
+            "gain": self._handle_gain,
+            "go": self._handle_go,
+        }[phase]
+        postponed, self._postponed[phase] = self._postponed[phase], []
+        for args in postponed:
+            handler(*args)
+
+    @register("mgm2_value")
+    def _on_value(self, sender, msg, t):
+        if self._phase == "value":
+            self._handle_value(sender, msg.value)
+        else:
+            self._postponed["value"].append((sender, msg.value))
+
+    def _handle_value(self, sender, value):
+        self._neighbor_values[sender] = value
+        if len(self._neighbor_values) < len(self._neighbors):
+            return
+        # All values in: decide role, send offers (real to one random
+        # neighbor when offerer, empty to everyone else so the phase
+        # completes by counting).
+        self._is_offerer = random.random() < self.threshold
+        self._partner = None
+        self._coordinated = False
+        self._response_in = None
+        self._go_in = None
+        if self._is_offerer:
+            self._partner = random.choice(self._neighbors)
+            partner_var = self._vars_by_name.get(self._partner)
+            offers = []
+            cur = self._local_cost(self.current_value)
+            for mv in self._variable.domain:
+                for pv in partner_var.domain:
+                    gain = cur - self._local_cost(
+                        mv, overrides={self._partner: pv}
+                    )
+                    offers.append((mv, pv, gain))
+            for n in self._neighbors:
+                self.post_msg(
+                    n,
+                    Mgm2OfferMessage(
+                        offers if n == self._partner else []
+                    ),
+                )
+        else:
+            for n in self._neighbors:
+                self.post_msg(n, Mgm2OfferMessage([]))
+        self._enter("offer")
+
+    @register("mgm2_offer")
+    def _on_offer(self, sender, msg, t):
+        if self._phase == "offer":
+            self._handle_offer(sender, msg.offers)
+        else:
+            self._postponed["offer"].append((sender, msg.offers))
+
+    def _handle_offer(self, sender, offers):
+        self._offers_in[sender] = offers
+        if len(self._offers_in) < len(self._neighbors):
+            return
+        real_offers = {
+            s: o for s, o in self._offers_in.items() if o
+        }
+        self._offers_in = {}
+        uni_value, uni_gain = self._best_unilateral()
+        if self._is_offerer or not real_offers:
+            # Offerers ignore incoming offers (reject all).
+            for s in real_offers:
+                self.post_msg(s, Mgm2ResponseMessage(
+                    False, None, None, 0.0
+                ))
+            self._new_value, self._committed_gain = uni_value, uni_gain
+            if self._is_offerer:
+                self._enter("response")  # await partner's response
+            else:
+                self._broadcast_gain()
+            return
+        # Non-offerer with offers: pick the globally best.
+        best = None  # (total, offerer, my_new, their_new)
+        for offerer, offers_o in real_offers.items():
+            cur_excl = self._local_cost(
+                self.current_value, exclude_with=offerer
+            )
+            for their_v, my_v, offerer_gain in offers_o:
+                my_gain = cur_excl - self._local_cost(
+                    my_v, overrides={offerer: their_v},
+                    exclude_with=offerer,
+                )
+                total = offerer_gain + my_gain
+                if best is None or total > best[0]:
+                    best = (total, offerer, my_v, their_v)
+        accept = best is not None and best[0] > 0 and (
+            best[0] > uni_gain
+            if self.favor != "coordinated" else best[0] >= uni_gain
+        )
+        for s in real_offers:
+            if accept and s == best[1]:
+                self.post_msg(s, Mgm2ResponseMessage(
+                    True, best[3], best[2], best[0]
+                ))
+            else:
+                self.post_msg(s, Mgm2ResponseMessage(
+                    False, None, None, 0.0
+                ))
+        if accept:
+            self._partner = best[1]
+            self._coordinated = True
+            self._new_value = best[2]
+            self._committed_gain = best[0]
+        else:
+            self._new_value, self._committed_gain = uni_value, uni_gain
+        self._broadcast_gain()
+
+    @register("mgm2_response")
+    def _on_response(self, sender, msg, t):
+        if self._phase == "response":
+            self._handle_response(sender, msg)
+        else:
+            self._postponed["response"].append((sender, msg))
+
+    def _handle_response(self, sender, msg):
+        if sender != self._partner:
+            return  # stale reject from an earlier round
+        self._response_in = msg
+        if msg.accept:
+            self._coordinated = True
+            self._new_value = msg.my_value
+            self._committed_gain = msg.gain
+        self._broadcast_gain()
+
+    def _broadcast_gain(self):
+        self.post_to_all_neighbors(
+            Mgm2GainMessage(self._committed_gain)
+        )
+        self._enter("gain")
+
+    @register("mgm2_gain")
+    def _on_gain(self, sender, msg, t):
+        if self._phase == "gain":
+            self._handle_gain(sender, msg.gain)
+        else:
+            self._postponed["gain"].append((sender, msg.gain))
+
+    def _handle_gain(self, sender, gain):
+        self._gains_in[sender] = gain
+        if len(self._gains_in) < len(self._neighbors):
+            return
+        others = {
+            s: g for s, g in self._gains_in.items()
+            if not (self._coordinated and s == self._partner)
+        }
+        n_max = max(others.values()) if others else float("-inf")
+        if self._coordinated:
+            # Pair moves only on a strict win in both neighborhoods:
+            # an equal-gain contender might move simultaneously.
+            ok = (
+                self._committed_gain > 0
+                and self._committed_gain > n_max
+            )
+        else:
+            # Unilateral movers follow MGM's rule: strict win, or tie
+            # broken by lexically-smallest name (guarantees progress
+            # when gains are symmetric).
+            ok = self._committed_gain > 0 and (
+                self._committed_gain > n_max
+                or (
+                    self._committed_gain == n_max
+                    and all(
+                        self.name < s for s, g in others.items()
+                        if g == n_max
+                    )
+                )
+            )
+        self._gains_in = {}
+        if self._coordinated:
+            self.post_msg(self._partner, Mgm2GoMessage(ok))
+            self._my_go = ok
+            self._enter("go")
+        else:
+            if ok:
+                self.value_selection(self._new_value, 0.0)
+            self._next_round()
+
+    @register("mgm2_go")
+    def _on_go(self, sender, msg, t):
+        if self._phase == "go":
+            self._handle_go(sender, msg.go)
+        else:
+            self._postponed["go"].append((sender, msg.go))
+
+    def _handle_go(self, sender, go):
+        if sender != self._partner:
+            return
+        if go and self._my_go:
+            self.value_selection(self._new_value, 0.0)
+        self._next_round()
+
+    def _next_round(self):
+        self._neighbor_values.clear()
+        self.new_cycle()
+        if self.stop_cycle and self.cycle_count >= self.stop_cycle:
+            self.finished()
+            self.stop()
+            return
+        self.post_to_all_neighbors(Mgm2ValueMessage(self.current_value))
+        self._enter("value")

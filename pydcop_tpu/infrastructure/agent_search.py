@@ -1,0 +1,306 @@
+"""Agent-mode computations for the search algorithms: DPOP and SyncBB.
+
+Reference parity:
+- dpop: pydcop/algorithms/dpop.py:115-441 — event-driven two-phase
+  sweep over the DFS pseudo-tree; UTIL messages (dense cost tables)
+  flow leaves→root, VALUE assignments flow root→leaves; first-optimum
+  tie-breaking (relations.py:1554).
+- syncbb: pydcop/algorithms/syncbb.py:176-512 — complete branch &
+  bound over the lexical variable order; ONE token (forward/backward
+  message) in flight at any time; termination broadcast carries the
+  best assignment.
+
+The relation algebra (join/projection/slice) is shared with the device
+sweeps (pydcop_tpu/ops/dpop.py, algorithms/syncbb.py), so agent-mode
+and device-mode costs agree exactly on the same problem.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from pydcop_tpu.dcop.relations import (
+    NAryMatrixRelation,
+    find_arg_optimal,
+    join,
+    projection,
+)
+from pydcop_tpu.infrastructure.computations import (
+    Message,
+    VariableComputation,
+    message_type,
+    register,
+)
+
+# -- DPOP -------------------------------------------------------------- #
+
+
+class DpopUtilMessage(Message):
+    """UTIL table sent child→parent (reference DpopMessage, dpop.py:88:
+    size = product of the table's dims)."""
+
+    def __init__(self, util: NAryMatrixRelation):
+        super().__init__("dpop_util", None)
+        self._util = util
+
+    @property
+    def util(self) -> NAryMatrixRelation:
+        return self._util
+
+    @property
+    def size(self) -> int:
+        return int(self._util.matrix.size)
+
+    def _simple_repr(self):
+        from pydcop_tpu.utils.simple_repr import simple_repr
+
+        return {
+            "__module__": self.__class__.__module__,
+            "__qualname__": self.__class__.__qualname__,
+            "util": simple_repr(self._util),
+        }
+
+    @classmethod
+    def _from_repr(cls, r):
+        from pydcop_tpu.utils.simple_repr import from_repr
+
+        return cls(from_repr(r["util"]))
+
+    def __repr__(self):
+        return f"DpopUtilMessage({self._util.scope_names})"
+
+
+DpopValueMessage = message_type("dpop_value", ["assignment"])
+
+
+class DpopComputation(VariableComputation):
+    """One computation per pseudo-tree node.
+
+    UTIL phase: seed with own unary costs, join assigned constraints,
+    join children's UTIL tables as they arrive; when all children have
+    reported, project self out and send UTIL to the parent (or, at the
+    root, start the VALUE phase).  VALUE phase: slice the joined table
+    on the ancestors' assignment, pick the first-optimal own value,
+    extend the assignment and forward to children.
+    """
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        node = comp_def.node
+        self._parent: Optional[str] = node.parent
+        self._children: List[str] = list(node.children)
+        self._constraints = list(node.constraints)
+        self._pending_children = set(self._children)
+        self._joined: Optional[NAryMatrixRelation] = None
+
+    @property
+    def neighbors(self) -> List[str]:
+        return ([self._parent] if self._parent else []) + self._children
+
+    def footprint(self) -> float:
+        from pydcop_tpu.computations_graph.pseudotree import (
+            computation_memory,
+        )
+
+        return computation_memory(self.computation_def.node)
+
+    def on_start(self):
+        self._joined = NAryMatrixRelation(
+            [self._variable], self._variable.cost_vector(),
+            name=f"util_{self.name}",
+        )
+        for c in self._constraints:
+            self._joined = join(
+                self._joined, NAryMatrixRelation.from_func_relation(c)
+            )
+        if not self._pending_children:
+            self._utils_complete()
+
+    @register("dpop_util")
+    def _on_util(self, sender, msg, t):
+        if sender not in self._pending_children:
+            return  # duplicate delivery
+        self._pending_children.discard(sender)
+        self._joined = join(self._joined, msg.util)
+        if not self._pending_children:
+            self._utils_complete()
+
+    def _utils_complete(self):
+        if self._parent is None:
+            # Root: its joined table only spans itself.
+            values, cost = find_arg_optimal(
+                self._variable, self._joined, self.mode
+            )
+            self.value_selection(values[0], cost)
+            self._forward_value({self.name: values[0]})
+            self.finished()
+        else:
+            util = projection(self._joined, self._variable, self.mode)
+            self.post_msg(self._parent, DpopUtilMessage(util))
+
+    @register("dpop_value")
+    def _on_value(self, sender, msg, t):
+        ancestors: Dict[str, Any] = dict(msg.assignment)
+        known = {
+            v: ancestors[v] for v in self._joined.scope_names
+            if v != self.name and v in ancestors
+        }
+        rel = self._joined.slice(known) if known else self._joined
+        values, cost = find_arg_optimal(self._variable, rel, self.mode)
+        self.value_selection(values[0], cost)
+        ancestors[self.name] = values[0]
+        self._forward_value(ancestors)
+        self.finished()
+
+    def _forward_value(self, assignment: Dict[str, Any]):
+        for child in self._children:
+            self.post_msg(child, DpopValueMessage(dict(assignment)))
+
+
+# -- SyncBB ------------------------------------------------------------ #
+
+SyncBBForwardMessage = message_type(
+    "syncbb_forward", ["path", "pcost", "bound", "best", "best_cost"])
+SyncBBBackwardMessage = message_type(
+    "syncbb_backward", ["bound", "best", "best_cost"])
+SyncBBTerminateMessage = message_type(
+    "syncbb_terminate", ["assignment", "cost"])
+
+
+class SyncBBComputation(VariableComputation):
+    """Branch & bound over the lexical order, one token in flight.
+
+    The token carries the partial path (list of (var, value) pairs),
+    its accumulated cost, the current bound and incumbent.  Each node
+    charges its unary cost plus the constraints whose scope completes
+    at it (last variable in lexical order), exactly like the device
+    search (algorithms/syncbb.py), so partial costs — and therefore
+    pruning and the final cost — agree between modes.  Costs are
+    sign-normalized so max-mode problems minimize the negated tables.
+    """
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        node = comp_def.node
+        self._next = node.next_node
+        self._previous = node.previous_node
+        # Constraints charged here: those whose scope's last variable
+        # (lexical order = the ordered-graph order) is this one.
+        self._charged = [
+            c for c in node.constraints
+            if max(c.scope_names) == self.name
+        ]
+        self._prefix: List[Tuple[str, Any]] = []
+        self._prefix_cost = 0.0
+        self._tried = -1  # index of the last tried domain value
+
+    @property
+    def neighbors(self) -> List[str]:
+        return [n for n in (self._previous, self._next) if n]
+
+    def _sign(self) -> float:
+        return 1.0 if self.mode == "min" else -1.0
+
+    def _charge(self, value) -> float:
+        """Own contribution given the stored prefix: unary + charged
+        constraints (sign-normalized)."""
+        sign = self._sign()
+        asst = dict(self._prefix)
+        asst[self.name] = value
+        cost = sign * self._variable.cost_for_val(value)
+        for c in self._charged:
+            cost += sign * c(**{n: asst[n] for n in c.scope_names})
+        return cost
+
+    def on_start(self):
+        if self._previous is None:
+            if self._next is None:
+                # Single-variable problem: trivial optimum.
+                costs = NAryMatrixRelation(
+                    [self._variable], self._variable.cost_vector(),
+                )
+                values, cost = find_arg_optimal(
+                    self._variable, costs, self.mode
+                )
+                self.value_selection(values[0], cost)
+                self.finished()
+                return
+            self._advance(float("inf"), None, float("inf"))
+
+    def _advance(self, bound: float, best, best_cost: float):
+        """Try own values after self._tried; forward, record or
+        backtrack (reference get_next_assignment, syncbb.py)."""
+        domain = list(self._variable.domain)
+        if self._next is None:
+            # Last variable: scan remaining values, keep the best
+            # completion under the bound, then backtrack.
+            for i in range(self._tried + 1, len(domain)):
+                value = domain[i]
+                total = self._prefix_cost + self._charge(value)
+                if total < bound:
+                    bound = total
+                    best = dict(self._prefix)
+                    best[self.name] = value
+                    best_cost = total
+            self._tried = len(domain)
+            self.post_msg(
+                self._previous,
+                SyncBBBackwardMessage(bound, best, best_cost),
+            )
+            return
+        for i in range(self._tried + 1, len(domain)):
+            value = domain[i]
+            cost = self._prefix_cost + self._charge(value)
+            if cost < bound:
+                self._tried = i
+                path = list(self._prefix) + [(self.name, value)]
+                self.post_msg(
+                    self._next,
+                    SyncBBForwardMessage(
+                        path, cost, bound, best, best_cost
+                    ),
+                )
+                return
+        # Exhausted under the current bound.
+        self._tried = len(domain)
+        if self._previous is None:
+            self._terminate(best, best_cost)
+        else:
+            self.post_msg(
+                self._previous,
+                SyncBBBackwardMessage(bound, best, best_cost),
+            )
+
+    @register("syncbb_forward")
+    def _on_forward(self, sender, msg, t):
+        self._prefix = [tuple(p) for p in msg.path]
+        self._prefix_cost = msg.pcost
+        self._tried = -1
+        self._advance(msg.bound, msg.best, msg.best_cost)
+
+    @register("syncbb_backward")
+    def _on_backward(self, sender, msg, t):
+        self._advance(msg.bound, msg.best, msg.best_cost)
+
+    @register("syncbb_terminate")
+    def _on_terminate(self, sender, msg, t):
+        self._finish_with(dict(msg.assignment), msg.cost)
+        if self._next is not None:
+            self.post_msg(
+                self._next,
+                SyncBBTerminateMessage(msg.assignment, msg.cost),
+            )
+
+    def _terminate(self, best, best_cost: float):
+        if best is None:
+            # No assignment under the bound (all-infinite problem):
+            # keep the current/initial value.
+            best, best_cost = {}, float("inf")
+        self._finish_with(dict(best), best_cost)
+        if self._next is not None:
+            self.post_msg(
+                self._next, SyncBBTerminateMessage(best, best_cost)
+            )
+
+    def _finish_with(self, assignment: Dict[str, Any], cost: float):
+        value = assignment.get(self.name, self.current_value)
+        self.value_selection(value, self._sign() * cost)
+        self.finished()
