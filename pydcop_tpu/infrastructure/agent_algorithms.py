@@ -25,6 +25,7 @@ from pydcop_tpu.dcop.relations import (
     find_optimum,
     optimal_cost_value,
 )
+from pydcop_tpu.infrastructure.agent_common import HypergraphComputation
 from pydcop_tpu.infrastructure.computations import (
     DcopComputation,
     Message,
@@ -125,6 +126,55 @@ def select_value(variable, costs: Dict[str, Dict], mode: str
     return best_d, best_c
 
 
+def _wrap_noisy(variable, params):
+    """Wrap a plain variable in VariableNoisyCostFunc per the `noise`
+    param (reference maxsum.py:477-487)."""
+    noise = params.get("noise", 0.01)
+    if noise and not isinstance(variable, VariableNoisyCostFunc):
+        cost_func = (
+            variable.cost_func
+            if hasattr(variable, "cost_func")
+            else (lambda _: 0)
+        )
+        variable = VariableNoisyCostFunc(
+            variable.name, variable.domain, cost_func,
+            initial_value=variable.initial_value, noise_level=noise,
+        )
+    return variable
+
+
+def _reject_externals(factor, comp_name: str):
+    """Plain MaxSum computations would silently marginalize over
+    external (read-only) variables instead of fixing their value."""
+    ext = [
+        v.name for v in factor.dimensions
+        if isinstance(v, _external_variable_type())
+    ]
+    if ext:
+        raise ValueError(
+            f"Factor {comp_name} depends on external variable(s) "
+            f"{ext}: use algorithm 'maxsum_dynamic' for problems "
+            "with external (read-only) variables"
+        )
+
+
+def send_damped(comp, prev_map: Dict, target: str, costs: Dict,
+                damp: bool, damping: float, stability: float):
+    """Shared damping + approx_match + SAME_COUNT send-suppression
+    (reference maxsum.py:366-377,:679).  ``prev_map`` keeps the last
+    SENT message per target so sender and receiver views stay
+    consistent; suppressed values are never recorded."""
+    prev, count = prev_map.get(target, (None, 0))
+    if damp:
+        costs = apply_damping(costs, prev, damping)
+    if not approx_match(costs, prev, stability):
+        comp.post_msg(target, MaxSumMessage(costs))
+        prev_map[target] = (costs, 1)
+    elif count < SAME_COUNT:
+        comp.post_msg(target, MaxSumMessage(costs))
+        prev_map[target] = (costs, count + 1)
+
+
 class MaxSumMessage(Message):
     def __init__(self, costs: Dict):
         super().__init__("max_sum", None)
@@ -176,16 +226,7 @@ class MaxSumFactorComputation(SynchronousComputationMixin,
         self.factor = comp_def.node.factor
         self.variables = self.factor.dimensions
         if not self.HANDLES_EXTERNALS:
-            ext = [
-                v.name for v in self.variables
-                if isinstance(v, _external_variable_type())
-            ]
-            if ext:
-                raise ValueError(
-                    f"Factor {self.name} depends on external variable(s) "
-                    f"{ext}: use algorithm 'maxsum_dynamic' for problems "
-                    "with external (read-only) variables"
-                )
+            _reject_externals(self.factor, self.name)
         self._costs: Dict[str, Dict] = {}
         params = comp_def.algo.params
         self.damping = params.get("damping", 0.5)
@@ -207,17 +248,13 @@ class MaxSumFactorComputation(SynchronousComputationMixin,
             costs_v = factor_costs_for_var(
                 self.factor, v, self._costs, self.mode
             )
-            prev, count = self._prev.get(v.name, (None, 0))
-            if self.damping_nodes in ("factors", "both"):
-                costs_v = apply_damping(costs_v, prev, self.damping)
-            if not approx_match(costs_v, prev, self.stability):
-                self.post_msg(v.name, MaxSumMessage(costs_v))
-                self._prev[v.name] = (costs_v, 1)
-            elif count < SAME_COUNT:
-                self.post_msg(v.name, MaxSumMessage(costs_v))
-                self._prev[v.name] = (costs_v, count + 1)
-            # else: send suppression (reference :366-377); the sync
-            # mixin emits a filler instead.
+            # On suppression (reference :366-377) the sync mixin emits
+            # a filler instead.
+            send_damped(
+                self, self._prev, v.name, costs_v,
+                self.damping_nodes in ("factors", "both"),
+                self.damping, self.stability,
+            )
         return None
 
 
@@ -226,19 +263,8 @@ class MaxSumVariableComputation(SynchronousComputationMixin,
     """One computation per variable in the factor graph."""
 
     def __init__(self, comp_def):
-        variable = comp_def.node.variable
         params = comp_def.algo.params
-        noise = params.get("noise", 0.01)
-        if noise and not isinstance(variable, VariableNoisyCostFunc):
-            cost_func = (
-                variable.cost_func
-                if hasattr(variable, "cost_func")
-                else (lambda _: 0)
-            )
-            variable = VariableNoisyCostFunc(
-                variable.name, variable.domain, cost_func,
-                initial_value=variable.initial_value, noise_level=noise,
-            )
+        variable = _wrap_noisy(comp_def.node.variable, params)
         super().__init__(variable, comp_def)
         self.factor_names = [l.factor_node for l in comp_def.node.links]
         self._costs: Dict[str, Dict] = {}
@@ -265,16 +291,232 @@ class MaxSumVariableComputation(SynchronousComputationMixin,
             costs_f = costs_for_factor(
                 self._variable, f_name, self.factor_names, self._costs
             )
-            prev, count = self._prev.get(f_name, (None, 0))
-            if self.damping_nodes in ("vars", "both"):
-                costs_f = apply_damping(costs_f, prev, self.damping)
-            if not approx_match(costs_f, prev, self.stability):
-                self.post_msg(f_name, MaxSumMessage(costs_f))
-                self._prev[f_name] = (costs_f, 1)
-            elif count < SAME_COUNT:
-                self.post_msg(f_name, MaxSumMessage(costs_f))
-                self._prev[f_name] = (costs_f, count + 1)
+            send_damped(
+                self, self._prev, f_name, costs_f,
+                self.damping_nodes in ("vars", "both"),
+                self.damping, self.stability,
+            )
         return None
+
+
+# --------------------------------------------------------------------- #
+# Asynchronous MaxSum (amaxsum): per-message firing, no sync mixin
+# (reference amaxsum.py:108-424; resume re-sends :165-180).
+
+
+class AMaxSumFactorComputation(DcopComputation):
+    """Asynchronous MaxSum factor: every incoming cost message fires an
+    immediate recomputation and (suppression permitting) a send to the
+    *other* variables — no cycle barrier."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.factor.name, comp_def)
+        self.factor = comp_def.node.factor
+        self.variables = self.factor.dimensions
+        _reject_externals(self.factor, self.name)
+        params = comp_def.algo.params
+        self.damping = params.get("damping", 0.5)
+        self.damping_nodes = params.get("damping_nodes", "both")
+        self.stability = params.get("stability", 0.1)
+        self._costs: Dict[str, Dict] = {}
+        self._prev: Dict[str, Tuple[Optional[Dict], int]] = {}
+
+    def on_start(self):
+        self._send_to(self.variables)
+
+    def on_pause(self, paused: bool):
+        if not paused:
+            # Dynamic-DCOP support: re-send current marginals on resume
+            # so re-deployed neighbors re-enter the flow.
+            self._prev.clear()
+            self._send_to(self.variables)
+
+    @register("max_sum")
+    def _on_costs(self, sender, msg, t):
+        self._costs[sender] = msg.costs
+        self.new_cycle()
+        # Fire to EVERY variable, sender included: with damping, each
+        # (possibly identical) incoming message must re-trigger a
+        # damped recomputation or messages freeze mid-trajectory —
+        # SAME_COUNT re-sends keep the iteration alive until it is
+        # within `stability` of the fixpoint (reference amaxsum
+        # re-fires the full update per message the same way).
+        self._send_to(self.variables)
+
+    def _send_to(self, variables):
+        for v in variables:
+            costs_v = factor_costs_for_var(
+                self.factor, v, self._costs, self.mode
+            )
+            send_damped(
+                self, self._prev, v.name, costs_v,
+                self.damping_nodes in ("factors", "both"),
+                self.damping, self.stability,
+            )
+
+
+class AMaxSumVariableComputation(VariableComputation):
+    """Asynchronous MaxSum variable: fires on every factor message,
+    re-selecting its value immediately (reference amaxsum.py:251-424)."""
+
+    def __init__(self, comp_def):
+        params = comp_def.algo.params
+        variable = _wrap_noisy(comp_def.node.variable, params)
+        super().__init__(variable, comp_def)
+        self.factor_names = [l.factor_node for l in comp_def.node.links]
+        self.damping = params.get("damping", 0.5)
+        self.damping_nodes = params.get("damping_nodes", "both")
+        self.stability = params.get("stability", 0.1)
+        self._costs: Dict[str, Dict] = {}
+        self._prev: Dict[str, Tuple[Optional[Dict], int]] = {}
+
+    @property
+    def neighbors(self) -> List[str]:
+        return list(self.factor_names)
+
+    def on_start(self):
+        value, cost = optimal_cost_value(self._variable, self.mode)
+        self.value_selection(value, cost)
+        self._send_to(self.factor_names)
+
+    def on_pause(self, paused: bool):
+        if not paused:
+            self._prev.clear()
+            self._send_to(self.factor_names)
+
+    @register("max_sum")
+    def _on_costs(self, sender, msg, t):
+        self._costs[sender] = msg.costs
+        value, cost = select_value(self._variable, self._costs, self.mode)
+        if value != self.current_value:
+            self.value_selection(value, cost)
+        self.new_cycle()
+        # Fire to every factor, sender included (see the factor-side
+        # comment: damped iteration needs identical-message re-fires).
+        self._send_to(self.factor_names)
+
+    def _send_to(self, factor_names):
+        for f_name in factor_names:
+            costs_f = costs_for_factor(
+                self._variable, f_name, self.factor_names, self._costs
+            )
+            send_damped(
+                self, self._prev, f_name, costs_f,
+                self.damping_nodes in ("vars", "both"),
+                self.damping, self.stability,
+            )
+
+
+# --------------------------------------------------------------------- #
+# A-DSA: clock-driven DSA (reference adsa.py:121-131 — re-evaluate on a
+# periodic tick with the latest known neighbor values; no cycle sync).
+
+AdsaValueMessage = message_type("adsa_value", ["value"])
+
+
+class ADsaComputation(HypergraphComputation):
+    """Asynchronous DSA: a periodic action on the agent clock
+    re-evaluates the variable against whatever neighbor values have
+    been seen so far; value messages carry no cycle bookkeeping."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def)
+        params = comp_def.algo.params
+        self.probability = params.get("probability", 0.7)
+        self.variant = params.get("variant", "B")
+        self.period = params.get("period", 0.5)
+        self.stop_cycle = params.get("stop_cycle", 0)
+        self._neighbor_values: Dict[str, Any] = {}
+        if self.variant == "B":
+            self._best_constraint_costs = {
+                c.name: find_optimum(c, self.mode)
+                for c in self.constraints
+            }
+
+    def on_start(self):
+        if self._finish_no_neighbors():
+            return
+        self.random_value_selection()
+        self.post_to_all_neighbors(AdsaValueMessage(self.current_value))
+        self.add_periodic_action(self.period, self.tick)
+
+    @register("adsa_value")
+    def _on_value(self, sender, msg, t):
+        self._neighbor_values[sender] = msg.value
+
+    def tick(self):
+        """Periodic re-evaluation (reference adsa.py:131)."""
+        if not self._running or self.is_paused:
+            return
+        if len(self._neighbor_values) < len(self._neighbors):
+            # Bootstrap: make sure everyone has our value.
+            self.post_to_all_neighbors(
+                AdsaValueMessage(self.current_value)
+            )
+            return
+        asst = dict(self._neighbor_values)
+        asst[self.name] = self.current_value
+        best_values, best_cost = find_optimal(
+            self._variable, self._neighbor_values, self.constraints,
+            self.mode,
+        )
+        current_cost = assignment_cost(asst, self.constraints)
+        delta = abs(current_cost - best_cost)
+        changed = False
+        if self.variant == "A":
+            if delta > 0:
+                changed = self._probabilistic_change(
+                    best_cost, best_values
+                )
+        elif self.variant == "B":
+            if delta > 0:
+                changed = self._probabilistic_change(
+                    best_cost, best_values
+                )
+            elif delta == 0 and self._exists_violated():
+                if len(best_values) > 1 and \
+                        self.current_value in best_values:
+                    best_values.remove(self.current_value)
+                changed = self._probabilistic_change(
+                    best_cost, best_values
+                )
+        else:  # C
+            if delta > 0:
+                changed = self._probabilistic_change(
+                    best_cost, best_values
+                )
+            elif delta == 0:
+                if len(best_values) > 1 and \
+                        self.current_value in best_values:
+                    best_values.remove(self.current_value)
+                changed = self._probabilistic_change(
+                    best_cost, best_values
+                )
+        self.new_cycle()
+        if changed:
+            self.post_to_all_neighbors(
+                AdsaValueMessage(self.current_value)
+            )
+        if self.stop_cycle and self.cycle_count >= self.stop_cycle:
+            self.finished()
+            self.stop()
+
+    def _probabilistic_change(self, best_cost, best_values) -> bool:
+        if best_values and self.probability > random.random():
+            value = random.choice(best_values)
+            if value != self.current_value:
+                self.value_selection(value, best_cost)
+                return True
+        return False
+
+    def _exists_violated(self) -> bool:
+        asst = dict(self._neighbor_values)
+        asst[self.name] = self.current_value
+        for c in self.constraints:
+            cost = c(**{v.name: asst[v.name] for v in c.dimensions})
+            if cost != self._best_constraint_costs[c.name]:
+                return True
+        return False
 
 
 # --------------------------------------------------------------------- #
@@ -966,13 +1208,20 @@ def build(algo_name: str, comp_def):
         SyncBBComputation,
     )
 
-    if algo_name in ("maxsum", "amaxsum"):
+    if algo_name == "maxsum":
         node = comp_def.node
         if isinstance(node, FactorComputationNode):
             return MaxSumFactorComputation(comp_def)
         if isinstance(node, VariableComputationNode):
             return MaxSumVariableComputation(comp_def)
         raise TypeError(f"Unsupported node for maxsum: {node}")
+    if algo_name == "amaxsum":
+        node = comp_def.node
+        if isinstance(node, FactorComputationNode):
+            return AMaxSumFactorComputation(comp_def)
+        if isinstance(node, VariableComputationNode):
+            return AMaxSumVariableComputation(comp_def)
+        raise TypeError(f"Unsupported node for amaxsum: {node}")
     if algo_name == "maxsum_dynamic":
         node = comp_def.node
         if isinstance(node, FactorComputationNode):
@@ -980,8 +1229,10 @@ def build(algo_name: str, comp_def):
         if isinstance(node, VariableComputationNode):
             return DynamicFactorVariableComputation(comp_def)
         raise TypeError(f"Unsupported node for maxsum_dynamic: {node}")
-    if algo_name in ("dsa", "adsa", "dsatuto"):
+    if algo_name in ("dsa", "dsatuto"):
         return DsaComputation(comp_def)
+    if algo_name == "adsa":
+        return ADsaComputation(comp_def)
     if algo_name == "mgm":
         return MgmComputation(comp_def)
     if algo_name == "ncbb":

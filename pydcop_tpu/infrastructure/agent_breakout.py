@@ -25,9 +25,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from pydcop_tpu.dcop.relations import optimal_cost_value
+from pydcop_tpu.infrastructure.agent_common import (
+    HypergraphComputation as _HypergraphComputation,
+    scan_best,
+    wins_neighborhood,
+)
 from pydcop_tpu.infrastructure.computations import (
-    VariableComputation,
     message_type,
     register,
 )
@@ -39,37 +42,6 @@ def _constraint_cost(constraint, assignment: Dict[str, Any]) -> float:
     return constraint(
         **{n: assignment[n] for n in constraint.scope_names}
     )
-
-
-class _HypergraphComputation(VariableComputation):
-    """Base for constraints-hypergraph computations: neighbor set from
-    the node's constraints, sign normalization, unary costs."""
-
-    def __init__(self, comp_def):
-        super().__init__(comp_def.node.variable, comp_def)
-        self.constraints = list(comp_def.node.constraints)
-        self._neighbors = list(dict.fromkeys(
-            v.name for c in self.constraints for v in c.dimensions
-            if v.name != self.name
-        ))
-
-    @property
-    def neighbors(self) -> List[str]:
-        return self._neighbors
-
-    @property
-    def sign(self) -> float:
-        # Internally always minimize sign*cost.
-        return 1.0 if self.mode == "min" else -1.0
-
-    def _finish_no_neighbors(self) -> bool:
-        if self._neighbors:
-            return False
-        value, cost = optimal_cost_value(self._variable, self.mode)
-        self.value_selection(value, cost)
-        self.finished()
-        self.stop()
-        return True
 
 
 # -- DBA ---------------------------------------------------------------- #
@@ -136,13 +108,9 @@ class DbaComputation(_HypergraphComputation):
         if len(self._neighbor_values) < len(self._neighbors):
             return
         cur_eval = self._eval(self.current_value)
-        best_eval, best_vals = None, []
-        for v in self._variable.domain:
-            e = self._eval(v)
-            if best_eval is None or e < best_eval:
-                best_eval, best_vals = e, [v]
-            elif e == best_eval:
-                best_vals.append(v)
+        best_eval, best_vals = scan_best(
+            self._variable.domain, self._eval
+        )
         self._improve = cur_eval - best_eval
         self._cur_eval = cur_eval
         self._proposed = random.choice(best_vals)
@@ -175,13 +143,7 @@ class DbaComputation(_HypergraphComputation):
             s: i for s, (i, _, _) in self._neighbor_improves.items()
         }
         n_max = max(n_improves.values())
-        wins = self._improve > n_max or (
-            self._improve == n_max
-            and all(
-                self.name < s for s, i in n_improves.items()
-                if i == n_max
-            )
-        )
+        wins = wins_neighborhood(self.name, self._improve, n_improves)
         if self._improve > 0 and wins:
             self.value_selection(
                 self._proposed, self._cur_eval - self._improve
@@ -314,13 +276,9 @@ class GdbaComputation(_HypergraphComputation):
         if len(self._neighbor_values) < len(self._neighbors):
             return
         cur_eval = self._eval(self.current_value)
-        best_eval, best_vals = None, []
-        for v in self._variable.domain:
-            e = self._eval(v)
-            if best_eval is None or e < best_eval:
-                best_eval, best_vals = e, [v]
-            elif e == best_eval:
-                best_vals.append(v)
+        best_eval, best_vals = scan_best(
+            self._variable.domain, self._eval
+        )
         self._improve = cur_eval - best_eval
         self._proposed = random.choice(best_vals)
         self._state = "improve"
@@ -341,13 +299,8 @@ class GdbaComputation(_HypergraphComputation):
         if len(self._neighbor_improves) < len(self._neighbors):
             return
         n_max = max(self._neighbor_improves.values())
-        wins = self._improve > n_max or (
-            self._improve == n_max
-            and all(
-                self.name < s
-                for s, i in self._neighbor_improves.items()
-                if i == n_max
-            )
+        wins = wins_neighborhood(
+            self.name, self._improve, self._neighbor_improves
         )
         if self._improve > 0 and wins:
             self.value_selection(self._proposed, 0.0)
@@ -782,15 +735,8 @@ class Mgm2Computation(_HypergraphComputation):
             # Unilateral movers follow MGM's rule: strict win, or tie
             # broken by lexically-smallest name (guarantees progress
             # when gains are symmetric).
-            ok = self._committed_gain > 0 and (
-                self._committed_gain > n_max
-                or (
-                    self._committed_gain == n_max
-                    and all(
-                        self.name < s for s, g in others.items()
-                        if g == n_max
-                    )
-                )
+            ok = self._committed_gain > 0 and wins_neighborhood(
+                self.name, self._committed_gain, others
             )
         self._gains_in = {}
         if self._coordinated:

@@ -1,0 +1,65 @@
+"""Shared bases/helpers for agent-mode algorithm computations."""
+
+from typing import Any, Dict, List, Tuple
+
+from pydcop_tpu.dcop.relations import optimal_cost_value
+from pydcop_tpu.infrastructure.computations import VariableComputation
+
+
+class HypergraphComputation(VariableComputation):
+    """Base for constraints-hypergraph computations: neighbor set from
+    the node's constraints, sign normalization, unary costs."""
+
+    def __init__(self, comp_def):
+        super().__init__(comp_def.node.variable, comp_def)
+        self.constraints = list(comp_def.node.constraints)
+        self._neighbors = list(dict.fromkeys(
+            v.name for c in self.constraints for v in c.dimensions
+            if v.name != self.name
+        ))
+
+    @property
+    def neighbors(self) -> List[str]:
+        return self._neighbors
+
+    @property
+    def sign(self) -> float:
+        # Internally always minimize sign*cost.
+        return 1.0 if self.mode == "min" else -1.0
+
+    def _finish_no_neighbors(self) -> bool:
+        if self._neighbors:
+            return False
+        value, cost = optimal_cost_value(self._variable, self.mode)
+        self.value_selection(value, cost)
+        self.finished()
+        self.stop()
+        return True
+
+
+def scan_best(domain, eval_fn) -> Tuple[float, List[Any]]:
+    """(best_eval, values-at-best) of ``eval_fn`` over ``domain``,
+    values kept in domain order — the shared candidate scan of the
+    breakout-family wave protocols."""
+    best_eval, best_vals = None, []
+    for v in domain:
+        e = eval_fn(v)
+        if best_eval is None or e < best_eval:
+            best_eval, best_vals = e, [v]
+        elif e == best_eval:
+            best_vals.append(v)
+    return best_eval, best_vals
+
+
+def wins_neighborhood(name: str, improve: float,
+                      neighbor_improves: Dict[str, float]) -> bool:
+    """Strict max in the neighborhood, lexically-smallest name winning
+    ties (reference dba.py:507-517 / gdba.py:657)."""
+    n_max = max(neighbor_improves.values())
+    return improve > n_max or (
+        improve == n_max
+        and all(
+            name < s for s, i in neighbor_improves.items()
+            if i == n_max
+        )
+    )
