@@ -1,17 +1,25 @@
-"""Benchmark: MaxSum on 10k-variable graph coloring (the north-star
-config from BASELINE.json), device engine vs reference-style python loop.
+"""North-star benchmark: MaxSum on 10k-variable graph coloring
+(BASELINE.json config #4/#1 scale), device engine vs this repo's OWN
+threaded agent runtime on the same problem — the comparison the
+reference architecture implies (pydcop/infrastructure/run.py:145
+run_local_thread_dcop hosts every computation on an agent thread; the
+hot loop is factor_costs_for_var maxsum.py:382 + costs_for_factor :623).
 
 Prints ONE json line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-The baseline is a faithful dict-based reimplementation of the reference's
-per-computation hot loop (factor_costs_for_var maxsum.py:382 +
-costs_for_factor :623: python dicts, per-assignment enumeration), timed
-on the same problem for a few cycles — the reference itself cannot run
-in this image (py3.12-incompatible imports, missing pulp).
+extra keys: backend ("tpu"/"cpu"), baseline_cycles_per_s, cost-parity
+evidence (device vs thread cost on a converged mid-size run), and a
+modeled roofline (flops/bytes per superstep, achieved GFLOP/s, MFU vs
+v5e bf16 peak, HBM utilization — see pydcop_tpu/engine/roofline.py for
+the counting rules and why HBM util is the meaningful number).
+
+Both paths share one problem builder and the same seeded tie-breaking
+noise (_stable_noise), so costs are directly comparable.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -19,110 +27,131 @@ import numpy as np
 N_VARS = 10_000
 N_COLORS = 3
 DEVICE_CYCLES = 200
-BASELINE_CYCLES = 2
+THREAD_TIMEOUT_S = 30.0
+THREAD_AGENTS = 8
+PARITY_VARS = 60
+PARITY_SEED = 3
+PARITY_TIMEOUT_S = 8.0
+# Matched-cycle quality tolerance at 10k vars, as a fraction of the
+# constraint count: thread mode stops on wall clock with computations at
+# slightly skewed cycles, so mid-descent costs can differ by a few
+# cycles' worth of improvement.
+QUALITY_TOL_FRAC = 0.025
 
 
-def build_problem(seed: int = 0):
+def build_dcop(n_vars: int, seed: int = 0):
+    """n_vars-variable 3-coloring: cost-1 equality penalty per edge,
+    ~1.5 edges/var (the round-1 bench problem, now as a real DCOP so
+    the agent runtime can solve the identical instance)."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
     rng = np.random.default_rng(seed)
-    eq = np.eye(N_COLORS, dtype=np.float32)
-    edges = []
+    dom = Domain("colors", "color", list(range(N_COLORS)))
+    dcop = DCOP(f"gc_{n_vars}", objective="min")
+    variables = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    eq = np.eye(N_COLORS, dtype=np.float64)
     seen = set()
-    for _ in range(int(N_VARS * 1.5)):
-        i, j = rng.choice(N_VARS, size=2, replace=False)
+    k = 0
+    for _ in range(int(n_vars * 1.5)):
+        i, j = rng.choice(n_vars, size=2, replace=False)
         key = (min(i, j), max(i, j))
         if key in seen:
             continue
         seen.add(key)
-        edges.append(key)
-    return edges, eq
+        dcop.add_constraint(NAryMatrixRelation(
+            [variables[i], variables[j]], eq, f"c{k}"))
+        k += 1
+    dcop.add_agents([AgentDef(f"a{a}") for a in range(THREAD_AGENTS)])
+    return dcop
 
 
-def bench_device(edges):
-    from pydcop_tpu.engine.compile import CompiledFactorGraph, FactorBucket
+def bench_device(dcop, max_cycles: int, timed: bool = True):
+    """Compile + run the device engine; returns (cycles/s, result,
+    engine).  With timed=True a warmup run precedes the timed run so
+    the number is steady-state execution, not compilation."""
+    from pydcop_tpu.engine.compile import compile_dcop
     from pydcop_tpu.engine.runner import MaxSumEngine
-    from pydcop_tpu.engine.compile import FactorGraphMeta
 
-    n_f = len(edges)
-    costs = np.broadcast_to(
-        np.eye(N_COLORS, dtype=np.float32), (n_f, N_COLORS, N_COLORS)
-    ).copy()
-    var_ids = np.array(edges, dtype=np.int32)
-    var_costs = np.zeros((N_VARS + 1, N_COLORS), dtype=np.float32)
-    rng = np.random.default_rng(42)
-    var_costs[:N_VARS] = rng.random((N_VARS, N_COLORS)) * 0.01
-    var_costs[N_VARS] = 1e9
-    var_valid = np.ones((N_VARS + 1, N_COLORS), dtype=bool)
-    var_valid[N_VARS] = False
-    graph = CompiledFactorGraph(
-        var_costs=var_costs,
-        var_valid=var_valid,
-        buckets=(FactorBucket(costs, var_ids),),
-    )
-    meta = FactorGraphMeta(
-        var_names=tuple(f"v{i}" for i in range(N_VARS)),
-        domains=tuple(tuple(range(N_COLORS)) for _ in range(N_VARS)),
-        factor_names=tuple(f"c{k}" for k in range(n_f)),
-        bucket_sizes=(n_f,),
-        mode="min",
-    )
+    graph, meta = compile_dcop(dcop, noise_level=0.01)
     engine = MaxSumEngine(graph, meta)
-    # Warmup with the same program key so the timed run is compile-free:
-    engine.run(max_cycles=DEVICE_CYCLES, stop_on_convergence=False)
-    res = engine.run(max_cycles=DEVICE_CYCLES, stop_on_convergence=False)
-    elapsed = res.time_s
-    cps = DEVICE_CYCLES / elapsed
-    # Solution quality: conflicts at selected assignment.
-    vals = np.array(
-        [res.assignment[f"v{i}"] for i in range(N_VARS)], dtype=np.int64
-    )
-    conflicts = int(np.sum(vals[var_ids[:, 0]] == vals[var_ids[:, 1]]))
-    return cps, elapsed, conflicts
+    if timed:
+        engine.run(max_cycles=max_cycles, stop_on_convergence=False)
+    res = engine.run(max_cycles=max_cycles, stop_on_convergence=False)
+    cps = res.cycles / res.time_s if res.time_s > 0 else 0.0
+    return cps, res, engine
 
 
-def bench_python_reference_style(edges, var_costs_arr):
-    """Reference-semantics hot loop: dicts of dicts, python enumeration."""
-    dom = list(range(N_COLORS))
-    f2v = {}  # (f, side) -> {val: cost}
-    v2f = {}
-    var_factors = {}
-    for f, (i, j) in enumerate(edges):
-        var_factors.setdefault(i, []).append((f, 0))
-        var_factors.setdefault(j, []).append((f, 1))
+def bench_thread(dcop, timeout: float):
+    """The repo's own threaded agent runtime on the same DCOP: one
+    orchestrator + THREAD_AGENTS OrchestratedAgent threads, in-process
+    transport, computations round-robined over agents.  Returns
+    (cycles/s, completed cycles, cost at stop, assignment)."""
+    from pydcop_tpu.algorithms import AlgorithmDef, load_algorithm_module
+    from pydcop_tpu.computations_graph import load_graph_module
+    from pydcop_tpu.distribution.objects import Distribution
+    from pydcop_tpu.infrastructure.run import run_local_thread_dcop
 
-    t0 = time.perf_counter()
-    for _cycle in range(BASELINE_CYCLES):
-        # factor -> var (factor_costs_for_var semantics)
-        for f, (i, j) in enumerate(edges):
-            for side, (tgt, other) in enumerate(((i, j), (j, i))):
-                recv = v2f.get((f, 1 - side))
-                costs = {}
-                for d in dom:
-                    best = float("inf")
-                    for d2 in dom:
-                        val = 1.0 if d == d2 else 0.0
-                        if recv is not None:
-                            val += recv[d2]
-                        best = min(best, val)
-                    costs[d] = best
-                f2v[(f, side)] = costs
-        # var -> factor (costs_for_factor semantics, mean-normalized)
-        for v, incident in var_factors.items():
-            for f, side in incident:
-                msg = {d: var_costs_arr[v][d] for d in dom}
-                sum_cost = 0.0
-                for f2, side2 in incident:
-                    if (f2, side2) == (f, side):
-                        continue
-                    c2 = f2v.get((f2, side2))
-                    if c2 is None:
-                        continue
-                    for d in dom:
-                        msg[d] += c2[d]
-                        sum_cost += c2[d]
-                avg = sum_cost / len(dom)
-                v2f[(f, side)] = {d: msg[d] - avg for d in dom}
-    elapsed = time.perf_counter() - t0
-    return BASELINE_CYCLES / elapsed
+    algo_def = AlgorithmDef.build_with_default_param("maxsum", mode="min")
+    module = load_algorithm_module("maxsum")
+    cg = load_graph_module(module.GRAPH_TYPE).build_computation_graph(dcop)
+    agents = sorted(dcop.agents)
+    mapping = {a: [] for a in agents}
+    for i, node in enumerate(cg.nodes):
+        mapping[agents[i % len(agents)]].append(node.name)
+    dist = Distribution(mapping)
+
+    orch = run_local_thread_dcop(algo_def, cg, dist, dcop)
+    try:
+        if not orch.wait_ready(30):
+            raise RuntimeError("agents not ready")
+        orch.deploy_computations()
+        t0 = time.perf_counter()
+        orch.run(timeout=timeout)
+        elapsed = time.perf_counter() - t0
+        orch.stop_agents(10)
+        metrics = orch.end_metrics()
+        cycles = int(metrics["cycle"])
+        cost = float(metrics["cost"]) if metrics["cost"] is not None \
+            else float("nan")
+        assignment = {
+            k: v for k, v in metrics["assignment"].items()
+            if k in dcop.variables
+        }
+        return cycles / elapsed, cycles, cost, assignment
+    finally:
+        orch.stop_agents(5)
+        orch.stop()
+
+
+def exact_parity():
+    """Semantic-equivalence leg of the north-star claim: on a problem
+    the BSP trajectory freezes on (send-suppression quiets every edge),
+    the device engine and the threaded agent runtime must produce the
+    IDENTICAL assignment, hence identical cost.  Larger loopy instances
+    oscillate within the stability band and the thread runtime stops on
+    wall clock mid-oscillation, so exactness is asserted here and a
+    matched-cycle quality bound is asserted at full scale."""
+    dcop = build_dcop(PARITY_VARS, seed=PARITY_SEED)
+    _, thread_cycles, thread_cost, thread_asg = bench_thread(
+        dcop, PARITY_TIMEOUT_S)
+    _, res, _ = bench_device(
+        dcop, max_cycles=max(thread_cycles, 50), timed=False)
+    device_cost, _ = dcop.solution_cost(res.assignment)
+    differing = [
+        v for v in thread_asg if thread_asg[v] != res.assignment[v]
+    ]
+    if differing or device_cost != thread_cost:
+        print(
+            f"bench: EXACT PARITY FAILED device={device_cost} "
+            f"thread={thread_cost} differing_vars={len(differing)}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    return device_cost, thread_cost
 
 
 def _ensure_live_backend():
@@ -131,7 +160,6 @@ def _ensure_live_backend():
     the CPU backend so the bench always emits its JSON line."""
     import os
     import subprocess
-    import sys
 
     if os.environ.get("PYDCOP_BENCH_NO_PROBE"):
         return
@@ -151,24 +179,89 @@ def _ensure_live_backend():
 
     env = scrubbed_cpu_env()
     env["PYDCOP_BENCH_NO_PROBE"] = "1"
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    import os as _os
+    _os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def main():
     _ensure_live_backend()
-    edges, _ = build_problem()
-    device_cps, elapsed, conflicts = bench_device(edges)
+    import jax
 
-    rng = np.random.default_rng(42)
-    var_costs_arr = rng.random((N_VARS, N_COLORS)) * 0.01
-    python_cps = bench_python_reference_style(edges, var_costs_arr)
+    from pydcop_tpu.engine.roofline import roofline_report
 
-    print(json.dumps({
+    platform = jax.devices()[0].platform
+    parity_device_cost, parity_thread_cost = exact_parity()
+
+    dcop = build_dcop(N_VARS)
+    device_cps, res, engine = bench_device(dcop, DEVICE_CYCLES)
+    thread_cps, thread_cycles, thread_cost, _asg = bench_thread(
+        dcop, THREAD_TIMEOUT_S)
+    if thread_cycles <= 0 or thread_cps <= 0:
+        # Degenerate baseline (no full BSP cycle within the timeout):
+        # still emit the JSON line rather than dying on a divide.
+        print(json.dumps({
+            "metric": "maxsum_cycles_per_sec_10kvar_graphcoloring",
+            "value": round(device_cps, 2),
+            "unit": "cycles/s",
+            "vs_baseline": None,
+            "backend": platform,
+            "baseline_cycles_completed": thread_cycles,
+            "note": "threaded baseline completed no full cycle in "
+                    f"{THREAD_TIMEOUT_S}s",
+        }))
+        return
+
+    # Cost-vs-cycle trace on the device: the quality check is one-sided
+    # (fail only if the device is WORSE than the thread runtime at the
+    # matched cycle count, beyond skew tolerance), and the trace gives
+    # the north-star number — wall-clock to reach the thread runtime's
+    # final cost.
+    trace_res = engine.run_trace(max_cycles=thread_cycles)
+    trace = trace_res.metrics["cost_trace"]
+    quality_cost = float(trace[thread_cycles - 1])
+    n_constraints = len(dcop.constraints)
+    if quality_cost - thread_cost > QUALITY_TOL_FRAC * n_constraints:
+        print(
+            f"bench: QUALITY CHECK FAILED device@{thread_cycles}="
+            f"{quality_cost} thread={thread_cost} "
+            f"tol={QUALITY_TOL_FRAC * n_constraints}", file=sys.stderr,
+        )
+        sys.exit(1)
+    # First cycle at which the device matches the thread's final cost.
+    below = np.nonzero(trace <= thread_cost)[0]
+    cycles_to_cost = int(below[0]) + 1 if below.size else None
+    time_to_cost = (
+        cycles_to_cost / device_cps if cycles_to_cost else None
+    )
+    thread_elapsed = thread_cycles / thread_cps
+    speedup_equal_cost = (
+        round(thread_elapsed / time_to_cost, 1)
+        if time_to_cost else None
+    )
+
+    roofline = roofline_report(engine.graph, device_cps, platform)
+    out = {
         "metric": "maxsum_cycles_per_sec_10kvar_graphcoloring",
         "value": round(device_cps, 2),
         "unit": "cycles/s",
-        "vs_baseline": round(device_cps / python_cps, 1),
-    }))
+        "vs_baseline": round(device_cps / thread_cps, 1),
+        "backend": platform,
+        "baseline": "own threaded agent runtime "
+                    f"({THREAD_AGENTS} agent threads, same problem)",
+        "baseline_cycles_per_s": round(thread_cps, 3),
+        "baseline_cycles_completed": thread_cycles,
+        "parity_cost_device": round(parity_device_cost, 4),
+        "parity_cost_thread": round(parity_thread_cost, 4),
+        "quality_cost_device_matched_cycles": round(quality_cost, 2),
+        "quality_cost_thread": round(thread_cost, 2),
+        "device_cycles_to_thread_cost": cycles_to_cost,
+        "device_seconds_to_thread_cost": (
+            round(time_to_cost, 4) if time_to_cost else None
+        ),
+        "speedup_at_equal_cost": speedup_equal_cost,
+        **roofline,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -29,9 +29,12 @@ def set_parser(subparsers):
     parser.add_argument("-k", "--ktarget", type=int, default=3,
                         help="number of replicas per computation")
     parser.add_argument("-m", "--mode", default="thread",
-                        choices=["thread"],
-                        help="execution mode (dynamic runs are "
-                             "agent-based)")
+                        choices=["thread", "device"],
+                        help="execution mode: 'thread' = agent runtime "
+                             "with replication/repair; 'device' = "
+                             "dynamic device engine (warm-started "
+                             "across events, placement re-homed on "
+                             "agent departure)")
     parser.add_argument("-c", "--cycles", type=int, default=0,
                         help="max cycles (0: unbounded)")
     parser.add_argument("--collect_on", default="value_change",
@@ -60,6 +63,9 @@ def run_cmd(args) -> int:
     dcop = load_dcop_from_file(args.dcop_files)
     scenario = load_scenario_from_file(args.scenario)
     algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
+
+    if args.mode == "device":
+        return _run_device_cmd(args, dcop, scenario, algo_def)
     algo_module = load_algorithm_module(algo_def.algo)
     # -c bounds algorithms exposing a stop_cycle parameter (same
     # mapping as solve, infrastructure/run.py solve_with_agents).
@@ -136,5 +142,123 @@ def run_cmd(args) -> int:
             if path:
                 add_csvline(path, args.collect_on, result)
 
+    emit_result(result, args.output)
+    return 0
+
+
+# Cycles run per event-delay second in device mode: device cycles are
+# orders of magnitude faster than wall-clock agent cycles, so delays
+# are interpreted as computation budget rather than sleeps.
+DEVICE_CYCLES_PER_DELAY_SECOND = 200
+
+
+def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
+    """Dynamic run on the device engine: scenario events are applied to
+    a warm-started DynamicMaxSumEngine (messages survive every event,
+    cost stays continuous), and agent departures re-home the departed
+    agent's computations in the placement map — the device-side
+    analogue of replica-based repair (thread mode solves a repair DCOP
+    instead, infrastructure/orchestrator.py)."""
+    import time as _time
+
+    from pydcop_tpu.algorithms import load_algorithm_module
+    from pydcop_tpu.computations_graph import load_graph_module
+    from pydcop_tpu.engine.dynamic import DynamicMaxSumEngine
+    from pydcop_tpu.infrastructure.run import _build_distribution
+
+    if algo_def.algo not in ("maxsum", "amaxsum", "maxsum_dynamic"):
+        print(
+            f"Error: device-mode dynamic runs support the maxsum "
+            f"family, not {algo_def.algo!r} (use --mode thread)"
+        )
+        return 2
+
+    algo_module = load_algorithm_module(algo_def.algo)
+    cg = load_graph_module(
+        algo_module.GRAPH_TYPE).build_computation_graph(dcop)
+    distribution = _build_distribution(
+        dcop, cg, algo_module, args.distribution)
+    placement = {
+        c: a for a in distribution.agents
+        for c in distribution.computations_hosted(a)
+    }
+    live_agents = set(distribution.agents)
+
+    params = algo_def.params
+    engine = DynamicMaxSumEngine(
+        list(dcop.variables.values()),
+        list(dcop.constraints.values()),
+        mode=dcop.objective,
+        noise_level=params.get("noise", 0.01),
+        damping=params.get("damping", 0.5),
+        damping_nodes=params.get("damping_nodes", "both"),
+        stability=params.get("stability", 0.1),
+    )
+
+    t0 = _time.perf_counter()
+    repaired = set()
+    events_log = []
+    last = engine.run(1, stop_on_convergence=False)
+    for event in scenario:
+        if event.is_delay:
+            cycles = max(
+                1, int(event.delay * DEVICE_CYCLES_PER_DELAY_SECOND))
+            last = engine.run(cycles, stop_on_convergence=False)
+            continue
+        for action in event.actions or []:
+            if action.type == "remove_agent":
+                agent = action.args["agent"]
+                live_agents.discard(agent)
+                orphans = [
+                    c for c, a in placement.items() if a == agent
+                ]
+                # Re-home on the least-loaded survivors.
+                for c in orphans:
+                    if not live_agents:
+                        break
+                    target = min(
+                        live_agents,
+                        key=lambda a: sum(
+                            1 for x in placement.values() if x == a
+                        ),
+                    )
+                    placement[c] = target
+                    repaired.add(c)
+            elif action.type == "add_agent":
+                live_agents.add(action.args["agent"])
+            else:
+                logger.warning(
+                    "Unknown scenario action %r ignored in device "
+                    "mode", action.type)
+        # Snapshot at event time: the warm-started engine keeps its
+        # cycle counter and message state across the event — the
+        # continuity evidence (the trajectory-preservation math itself
+        # is asserted in tests/api/test_dynamic_device.py
+        # split-run == single-run).
+        events_log.append({
+            "id": event.id,
+            "cycle": last.cycles,
+            "cost": engine.cost(last.assignment),
+        })
+
+    max_cycles = args.cycles or 2000
+    final = engine.run(max_cycles)
+    cost, violations = dcop.solution_cost(final.assignment)
+    result = {
+        "status": "FINISHED" if final.converged else "TIMEOUT",
+        "assignment": final.assignment,
+        "cost": cost,
+        "violation": violations,
+        "time": _time.perf_counter() - t0,
+        "cycle": final.cycles,
+        "events": events_log,
+        "replication": {
+            "ktarget": args.ktarget,
+            "repaired": sorted(repaired),
+            "placement_agents": sorted(live_agents),
+        },
+        "recompiles": final.metrics["recompiles"],
+        "backend": "device",
+    }
     emit_result(result, args.output)
     return 0

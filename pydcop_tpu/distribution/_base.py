@@ -172,12 +172,19 @@ def ilp_place(
     comm_weight: float = 1.0,
     hosting_weight: float = 0.0,
     timeout: Optional[float] = None,
+    pinned: Optional[Dict[str, str]] = None,
+    require_nonempty_agents: bool = False,
 ) -> Distribution:
     """Optimal placement by mixed-integer programming.
 
     Variables: x[c,a] in {0,1} (computation c on agent a) and, per comm
     edge e=(c1,c2) and agent pair (a1,a2), y[e,a1,a2] >= x[c1,a1] +
     x[c2,a2] - 1 (continuous in [0,1]; minimization makes it exact).
+
+    ``pinned`` forces computation -> agent assignments (the SECP
+    actuator rule); ``require_nonempty_agents`` adds the oilp_secp_*
+    constraint that every agent with no pinned computation hosts at
+    least one (reference oilp_secp_fgdp.py:229-236).
     """
     from scipy.optimize import LinearConstraint, milp
     from scipy.sparse import lil_matrix
@@ -244,7 +251,21 @@ def ilp_place(
                     row += 1
         constraints.append(
             LinearConstraint(m.tocsr(), -np.inf, 1))
-    # must_host hints pin x variables.
+    # Each agent without a pinned computation hosts at least one.
+    if require_nonempty_agents:
+        pinned_agents = set((pinned or {}).values())
+        empty = [
+            a for a, agent in enumerate(agents)
+            if agent.name not in pinned_agents
+        ]
+        if empty:
+            m = lil_matrix((len(empty), n_vars))
+            for row, a in enumerate(empty):
+                for c in range(nc):
+                    m[row, xi(c, a)] = 1
+            constraints.append(
+                LinearConstraint(m.tocsr(), 1, np.inf))
+    # must_host hints and pinned assignments fix x variables.
     lb = np.zeros(n_vars)
     ub_v = np.ones(n_vars)
     if hints is not None:
@@ -252,6 +273,11 @@ def ilp_place(
             for comp in hints.must_host(agent.name):
                 if comp in comp_index:
                     lb[xi(comp_index[comp], a)] = 1
+    if pinned:
+        agent_index = {name: i for i, name in enumerate(agent_names)}
+        for comp, agent_name in pinned.items():
+            if comp in comp_index and agent_name in agent_index:
+                lb[xi(comp_index[comp], agent_index[agent_name])] = 1
 
     integrality = np.zeros(n_vars)
     integrality[:n_x] = 1  # x binary, y continuous

@@ -1,30 +1,45 @@
 """gh_secp_cgdp: SECP-specialized greedy heuristic, constraint graph.
 
-Reference parity: pydcop/distribution/gh_secp_cgdp.py.  SECP placement
-preferences are expressed through hosting costs (device computations
-have cost 0 on their own agent), so the generic greedy engine with a
-strong hosting weight realizes the SECP policy.
+Reference parity: pydcop/distribution/gh_secp_cgdp.py:75-124.  Two-step
+policy for SECPs modeled as constraint graphs (only actuator and
+physical-model variables exist as computations):
+
+1. pin every actuator variable (hosting cost 0) on its agent;
+2. place each remaining (model) variable on the agent that hosts the
+   most of its neighbors and still has capacity, ties broken on
+   remaining capacity (find_candidates, reference :142-166).
+
+Communication load is not used; the footprint is required.
 """
 
-from pydcop_tpu.distribution._base import (
-    distribution_cost_impl,
-    greedy_place,
+from pydcop_tpu.distribution import oilp_secp_cgdp
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
+)
+from pydcop_tpu.distribution.secp_rules import (
+    pin_actuators,
+    place_by_affinity,
 )
 
 
 def distribute(computation_graph, agentsdef, hints=None,
                computation_memory=None, communication_load=None, **_):
-    return greedy_place(
-        computation_graph, agentsdef, hints,
-        computation_memory, communication_load,
-        order_key=lambda c, fp, nb: -fp[c],
-        comm_weight=0.5,
-        hosting_weight=1.0,
+    if computation_memory is None:
+        raise ImpossibleDistributionException(
+            "gh_secp_cgdp requires a computation_memory function")
+    agentsdef = list(agentsdef)
+    mapping, capa, remaining, _unused = pin_actuators(
+        computation_graph, agentsdef, computation_memory)
+    place_by_affinity(
+        computation_graph, computation_memory, mapping, capa,
+        [(comp,) for comp in remaining],
     )
+    return Distribution({a: list(cs) for a, cs in mapping.items()})
 
 
 def distribution_cost(distribution, computation_graph, agentsdef,
                       computation_memory=None, communication_load=None):
-    return distribution_cost_impl(
+    return oilp_secp_cgdp.distribution_cost(
         distribution, computation_graph, agentsdef,
         computation_memory, communication_load)

@@ -1,11 +1,62 @@
 """gh_secp_fgdp: SECP-specialized greedy heuristic, factor graph.
 
-Reference parity: pydcop/distribution/gh_secp_fgdp.py — same policy as
-gh_secp_cgdp applied to factor-graph computations (variables AND
-factors are placed).
+Reference parity: pydcop/distribution/gh_secp_fgdp.py:92-198.  SECPs
+modeled as factor graphs have four computation kinds, placed in order:
+
+1. actuator variables (hosting cost 0) pinned on their agent, each
+   pulling its ``c_<actuator>`` energy cost factor along;
+2. every remaining variable is a physical-model variable ``m`` whose
+   defining factor is ``c_<m>``: the pair is placed *together* on the
+   agent hosting the most of the factor's neighbors (with capacity for
+   both footprints);
+3. the remaining factors are rule factors, placed one at a time by the
+   same neighbor-affinity rule.
 """
 
-from pydcop_tpu.distribution.gh_secp_cgdp import (  # noqa: F401
-    distribute,
-    distribution_cost,
+from pydcop_tpu.distribution import oilp_secp_fgdp
+from pydcop_tpu.distribution.objects import (
+    Distribution,
+    ImpossibleDistributionException,
 )
+from pydcop_tpu.distribution.secp_rules import (
+    pin_actuators,
+    place_by_affinity,
+    split_fg_nodes,
+)
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None, **_):
+    if computation_memory is None:
+        raise ImpossibleDistributionException(
+            "gh_secp_fgdp requires a computation_memory function")
+    agentsdef = list(agentsdef)
+    variables, factors = split_fg_nodes(computation_graph)
+    mapping, capa, model_vars, factors = pin_actuators(
+        computation_graph, agentsdef, computation_memory,
+        candidates=variables, cost_factors=factors,
+    )
+
+    # Model (factor, variable) pairs; whatever factors remain are rules.
+    models = []
+    for model_var in model_vars:
+        paired = f"c_{model_var}"
+        if paired in factors:
+            models.append((paired, model_var))
+            factors.remove(paired)
+    rules = factors
+
+    place_by_affinity(
+        computation_graph, computation_memory, mapping, capa, models)
+    place_by_affinity(
+        computation_graph, computation_memory, mapping, capa,
+        [(r,) for r in rules],
+    )
+    return Distribution({a: list(cs) for a, cs in mapping.items()})
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return oilp_secp_fgdp.distribution_cost(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

@@ -1,9 +1,42 @@
 """oilp_cgdp: optimal ILP for the Constraint-Graph Distribution Problem.
 
-Reference parity: pydcop/distribution/oilp_cgdp.py.
+Reference parity: pydcop/distribution/oilp_cgdp.py (AAMAS-18).  The
+weighted MILP over RATIO * communication + (1-RATIO) * hosting costs,
+with one SECP-friendly twist the generic ilp_compref model does not
+have: any computation with a hosting cost of 0 on some agent is forced
+onto that agent before solving (reference :174-185 "Force computation
+with hosting cost of 0 to be hosted on that agent").
 """
 
-from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
-    distribute,
-    distribution_cost,
+from pydcop_tpu.distribution._base import (
+    RATIO_HOST_COMM,
+    distribution_cost_impl,
+    ilp_place,
 )
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None,
+               timeout=None, **_):
+    agentsdef = list(agentsdef)
+    pinned = {}
+    for node in computation_graph.nodes:
+        for agent in agentsdef:
+            if agent.hosting_cost(node.name) == 0:
+                pinned[node.name] = agent.name
+                break
+    return ilp_place(
+        computation_graph, agentsdef, hints,
+        computation_memory, communication_load,
+        timeout=timeout,
+        comm_weight=RATIO_HOST_COMM,
+        hosting_weight=1 - RATIO_HOST_COMM,
+        pinned=pinned,
+    )
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    return distribution_cost_impl(
+        distribution, computation_graph, agentsdef,
+        computation_memory, communication_load)

@@ -1,11 +1,88 @@
 """oilp_secp_cgdp: optimal ILP, SECP flavor, constraint graph.
 
-Reference parity: pydcop/distribution/oilp_secp_cgdp.py — SECP
-preferences come in through hosting costs; the weighted ILP model
-applies unchanged.
+Reference parity: pydcop/distribution/oilp_secp_cgdp.py.  SECP policy
+on top of the generic MILP engine:
+
+1. actuator variables (hosting cost 0) are pinned on their agent
+   *before* solving;
+2. the ILP minimizes pure communication cost (route x load) over the
+   remaining placements — hosting costs are NOT in the objective, they
+   only express the pinning;
+3. every agent that got no pinned computation must host at least one
+   computation (reference's "each agent must host at least one"
+   constraint).
+
+Capacity is a hard constraint throughout.
 """
 
-from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
-    distribute,
-    distribution_cost,
+from itertools import combinations
+
+from pydcop_tpu.distribution._base import ilp_place
+from pydcop_tpu.distribution.objects import (
+    ImpossibleDistributionException,
 )
+from pydcop_tpu.distribution.secp_rules import pin_actuators
+
+
+def _secp_ilp(computation_graph, agentsdef, computation_memory,
+              communication_load, timeout, cost_factors=None):
+    agentsdef = list(agentsdef)
+    kwargs = {}
+    if cost_factors is not None:
+        kwargs["candidates"] = cost_factors[0]
+        kwargs["cost_factors"] = cost_factors[1]
+    mapping, _capa, _remaining, _facs = pin_actuators(
+        computation_graph, agentsdef, computation_memory, **kwargs)
+    pinned = {
+        comp: agent for agent, comps in mapping.items()
+        for comp in comps
+    }
+    try:
+        return ilp_place(
+            computation_graph, agentsdef, None,
+            computation_memory, communication_load,
+            comm_weight=1.0, hosting_weight=0.0,
+            timeout=timeout, pinned=pinned,
+            require_nonempty_agents=True,
+        )
+    except ImpossibleDistributionException:
+        # Degenerate non-SECP inputs (more agents than computations)
+        # make the every-agent-hosts-one constraint infeasible; the
+        # placement itself is still well-defined without it.
+        return ilp_place(
+            computation_graph, agentsdef, None,
+            computation_memory, communication_load,
+            comm_weight=1.0, hosting_weight=0.0,
+            timeout=timeout, pinned=pinned,
+            require_nonempty_agents=False,
+        )
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None,
+               timeout=600, **_):
+    if computation_memory is None or communication_load is None:
+        raise ImpossibleDistributionException(
+            "oilp_secp_cgdp requires computation_memory and "
+            "communication_load functions")
+    return _secp_ilp(
+        computation_graph, agentsdef, computation_memory,
+        communication_load, timeout)
+
+
+def distribution_cost(distribution, computation_graph, agentsdef,
+                      computation_memory=None, communication_load=None):
+    """Communication cost only (no hosting/route costs), as the
+    reference's SECP cost model (oilp_secp_fgdp.py:134-172): sum of
+    communication_load over links whose ends live on different
+    agents.  Returns (total, comm, hosting=0)."""
+    comm = 0.0
+    for link in computation_graph.links:
+        for c1, c2 in combinations(link.nodes, 2):
+            if distribution.agent_for(c1) != distribution.agent_for(c2):
+                if communication_load is not None:
+                    comm += float(communication_load(
+                        computation_graph.computation(c1), c2))
+                else:
+                    comm += 1.0
+    return comm, comm, 0.0

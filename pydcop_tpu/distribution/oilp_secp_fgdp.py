@@ -1,9 +1,34 @@
 """oilp_secp_fgdp: optimal ILP, SECP flavor, factor graph.
 
-Reference parity: pydcop/distribution/oilp_secp_fgdp.py (:72).
+Reference parity: pydcop/distribution/oilp_secp_fgdp.py:72-131.  Same
+policy as oilp_secp_cgdp with the factor-graph pinning convention:
+each actuator variable's ``c_<actuator>`` energy cost factor is pinned
+alongside it before the communication-cost-only MILP solves the
+remaining (model variable / model factor / rule factor) placements,
+with capacity hard constraints and every unpinned agent hosting at
+least one computation.
 """
 
-from pydcop_tpu.distribution.ilp_compref import (  # noqa: F401
-    distribute,
-    distribution_cost,
+from pydcop_tpu.distribution.objects import (
+    ImpossibleDistributionException,
 )
+from pydcop_tpu.distribution.oilp_secp_cgdp import (
+    _secp_ilp,
+    distribution_cost,  # noqa: F401  (same comm-only cost model)
+)
+from pydcop_tpu.distribution.secp_rules import split_fg_nodes
+
+
+def distribute(computation_graph, agentsdef, hints=None,
+               computation_memory=None, communication_load=None,
+               timeout=600, **_):
+    if computation_memory is None or communication_load is None:
+        raise ImpossibleDistributionException(
+            "oilp_secp_fgdp requires computation_memory and "
+            "communication_load functions")
+    variables, factors = split_fg_nodes(computation_graph)
+    return _secp_ilp(
+        computation_graph, agentsdef, computation_memory,
+        communication_load, timeout,
+        cost_factors=(variables, factors),
+    )

@@ -1,0 +1,161 @@
+"""SECP-specific placement rules, shared by the gh_secp_* / oilp_secp_*
+distribution methods.
+
+The SECP (smart-lighting) placement conventions these encode
+(reference: pydcop/distribution/gh_secp_cgdp.py:75-124,
+gh_secp_fgdp.py:92-198, oilp_secp_fgdp.py:72-131):
+
+1. **Actuator pinning.** A variable whose hosting cost on some agent is
+   0 represents that agent's actuator (light) and MUST be hosted there.
+2. **Cost-factor co-location** (factor graph only). The actuator's
+   energy cost factor is named ``c_<actuator>`` and goes on the same
+   agent.
+3. **Physical-model pairing** (factor graph only). After pinning, every
+   remaining variable is a physical-model variable ``m`` whose defining
+   factor is named ``c_<m>``; both are placed *together*.
+4. **Neighbor affinity** (greedy flavor). Each remaining computation
+   goes to the agent that (a) has capacity left and (b) hosts the most
+   computations sharing a dependency with it; ties break on the largest
+   remaining capacity.  Every candidate must host >= 1 neighbor — model
+   factors always depend on at least one already-pinned actuator, so a
+   candidate always exists on well-formed SECPs.
+"""
+
+from collections import defaultdict
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from pydcop_tpu.distribution.objects import (
+    ImpossibleDistributionException,
+)
+
+
+def split_fg_nodes(cg) -> Tuple[List[str], List[str]]:
+    """(variable computation names, factor computation names) of a
+    factor graph, in graph order."""
+    from pydcop_tpu.computations_graph.factor_graph import (
+        FactorComputationNode,
+        VariableComputationNode,
+    )
+
+    variables, factors = [], []
+    for node in cg.nodes:
+        if isinstance(node, VariableComputationNode):
+            variables.append(node.name)
+        elif isinstance(node, FactorComputationNode):
+            factors.append(node.name)
+        else:
+            raise ImpossibleDistributionException(
+                f"{node.name} is neither a factor nor a variable "
+                "computation"
+            )
+    return variables, factors
+
+
+def _footprint(cg, computation_memory: Optional[Callable],
+               comp: str) -> float:
+    if computation_memory is None:
+        return 0.0
+    try:
+        return float(computation_memory(cg.computation(comp)))
+    except (NotImplementedError, TypeError):
+        return 0.0
+
+
+def pin_actuators(
+    cg, agentsdef: Iterable, computation_memory: Optional[Callable],
+    *, candidates: Optional[List[str]] = None,
+    cost_factors: Optional[List[str]] = None,
+) -> Tuple[Dict[str, List[str]], Dict[str, float], List[str],
+           Optional[List[str]]]:
+    """Place every actuator computation (hosting cost 0) on its agent,
+    plus — when ``cost_factors`` is given — its ``c_<name>`` factor.
+
+    Returns (mapping, remaining capacity per agent, unpinned candidate
+    computations, unpinned cost factors or None).
+    """
+    agents = list(agentsdef)
+    mapping: Dict[str, List[str]] = defaultdict(list)
+    capa = {a.name: _capacity(a) for a in agents}
+    remaining = list(
+        candidates if candidates is not None
+        else [n.name for n in cg.nodes]
+    )
+    factors = list(cost_factors) if cost_factors is not None else None
+
+    # Pin EVERY zero-hosting-cost computation of each agent (the
+    # reference's per-agent scan stops after the first hit because its
+    # generator emits exactly one actuator per agent; pinning all is
+    # the same on well-formed SECPs and consistent with oilp_cgdp's
+    # force-zero-cost rule on multi-actuator agents).
+    for agent in agents:
+        for comp in list(remaining):
+            if agent.hosting_cost(comp) == 0:
+                mapping[agent.name].append(comp)
+                remaining.remove(comp)
+                capa[agent.name] -= _footprint(
+                    cg, computation_memory, comp)
+                if factors is not None:
+                    paired = f"c_{comp}"
+                    if paired in factors:
+                        mapping[agent.name].append(paired)
+                        factors.remove(paired)
+                        capa[agent.name] -= _footprint(
+                            cg, computation_memory, paired)
+                if capa[agent.name] < 0:
+                    raise ImpossibleDistributionException(
+                        f"Not enough capacity on {agent.name} to host "
+                        f"actuator {comp}"
+                    )
+    return mapping, capa, remaining, factors
+
+
+def _capacity(agent) -> float:
+    try:
+        return float(agent.capacity)
+    except (AttributeError, TypeError):
+        return float("inf")
+
+
+def affinity_candidates(
+    capa: Dict[str, float], comp: str, footprint: float,
+    mapping: Dict[str, List[str]], neighbors: Iterable[str],
+) -> List[Tuple[int, float, str]]:
+    """Agents with capacity hosting >=1 neighbor of ``comp``, best
+    first: most hosted neighbors, then largest remaining capacity
+    (reference gh_secp_cgdp.py:142-166 find_candidates)."""
+    neighbor_set = set(neighbors)
+    out = []
+    for agent, cap in capa.items():
+        hosted = len(neighbor_set.intersection(mapping.get(agent, ())))
+        if hosted > 0 and cap >= footprint:
+            out.append((hosted, cap, agent))
+    if not out:
+        raise ImpossibleDistributionException(
+            f"No neighbor-hosting agent with capacity for {comp} "
+            f"(footprint {footprint})"
+        )
+    out.sort(reverse=True)
+    return out
+
+
+def place_by_affinity(
+    cg, computation_memory: Optional[Callable],
+    mapping: Dict[str, List[str]], capa: Dict[str, float],
+    groups: Iterable[Tuple[str, ...]],
+) -> None:
+    """Place each group of computations (together) on the best
+    affinity candidate; the group's first member is the anchor whose
+    neighbors drive the choice (e.g. the model *factor* for a
+    (c_m, m) pair, reference gh_secp_fgdp.py:166-181)."""
+    for group in groups:
+        anchor = group[0]
+        footprint = sum(
+            _footprint(cg, computation_memory, c) for c in group
+        )
+        neighbors = cg.computation(anchor).neighbors
+        best = affinity_candidates(
+            capa, anchor, footprint, mapping, neighbors)
+        selected = best[0][2]
+        for c in group:
+            mapping[selected].append(c)
+        capa[selected] -= footprint

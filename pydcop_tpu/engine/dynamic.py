@@ -1,0 +1,355 @@
+"""Dynamic-DCOP execution on the device engine.
+
+The reference handles dynamic problems with agent-level machinery:
+scenario events remove agents, replicas re-host their computations
+(pydcop/infrastructure/orchestrator.py:955-1178), and maxsum_dynamic
+factor computations swap cost functions at runtime
+(pydcop/algorithms/maxsum_dynamic.py:40-112 change_factor_function).
+
+On a device engine the graph lives in a handful of dense arrays, so the
+dynamic story becomes array surgery (SURVEY §7 "dynamic graphs ...
+recompile; mitigate with padding slack and donated buffers"):
+
+- **Padding slack.** Buckets are compiled with spare factor rows
+  (`slack` fraction, zero-cost, sentinel var ids).  Adding a factor =
+  writing one row; removing = resetting it.  Shapes stay constant, so
+  the jitted superstep program is reused — no recompile, no retrace.
+- **Warm start.** Message state (MaxSumState) survives every event;
+  after an edit the trajectory continues from the previous fixpoint
+  (ops/maxsum.py run_maxsum_from) instead of restarting, which is what
+  gives cost continuity across events.
+- **Recompile fallback.** An edit that outgrows the slack (or needs a
+  bigger domain) triggers a recompile with fresh slack; messages of
+  surviving factors are copied row-by-row into the new buckets, so even
+  the recompile path warm-starts.
+- **Placement bookkeeping.** Agent departures do not change the math on
+  device (every computation already runs in the same XLA program), but
+  ownership matters for reporting parity with the thread runtime: the
+  engine keeps a computation->agent map, and `remove_agent` re-homes
+  the departed agent's computations onto the least-loaded survivors —
+  the device-side analogue of the repair DCOP.
+"""
+
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from pydcop_tpu.dcop.objects import Variable, _stable_noise
+from pydcop_tpu.dcop.relations import Constraint
+from pydcop_tpu.engine.compile import (
+    BIG,
+    CompiledFactorGraph,
+    FactorBucket,
+)
+from pydcop_tpu.engine.runner import DeviceRunResult
+from pydcop_tpu.ops import maxsum as ops
+
+
+class DynamicMaxSumEngine:
+    """MaxSum engine whose factor graph can be edited between runs."""
+
+    def __init__(self, variables: List[Variable],
+                 constraints: List[Constraint], mode: str = "min",
+                 noise_level: float = 0.01,
+                 noise_seed: Optional[int] = None,
+                 slack: float = 0.25,
+                 damping: float = 0.5, damping_nodes: str = "both",
+                 stability: float = 0.1):
+        self.mode = mode
+        self.sign = 1.0 if mode == "min" else -1.0
+        self.noise_level = noise_level
+        self.noise_seed = noise_seed
+        self.slack = slack
+        self.damping = damping
+        self.damp_vars = damping_nodes in ("vars", "both")
+        self.damp_factors = damping_nodes in ("factors", "both")
+        self.stability = stability
+
+        self.variables: List[Variable] = list(variables)
+        self.var_index = {v.name: i for i, v in enumerate(self.variables)}
+        # constraint name -> (bucket index, row) for live factors.
+        self.slots: Dict[str, Tuple[int, int]] = {}
+        self.factors: Dict[str, Constraint] = {}
+        self.recompile_count = 0
+        self._jitted = {}
+        self._state = None
+        self._build(list(constraints))
+
+    # ------------------------------------------------------------- #
+    # compilation / array surgery
+    # ------------------------------------------------------------- #
+
+    def _slacked(self, n: int) -> int:
+        return max(n + 1, int(math.ceil(n * (1.0 + self.slack))))
+
+    def _build(self, constraints: List[Constraint]):
+        """(Re)compile buckets with slack rows; resets slots."""
+        self.dmax = max(
+            (len(v.domain) for v in self.variables), default=1)
+        v_count = len(self.variables)
+        var_costs = np.full((v_count + 1, self.dmax), BIG, np.float32)
+        var_valid = np.zeros((v_count + 1, self.dmax), bool)
+        for i, v in enumerate(self.variables):
+            d = len(v.domain)
+            costs = self.sign * v.cost_vector()[:d]
+            if self.noise_level:
+                costs = costs + _stable_noise(
+                    v.name, d, self.noise_level, self.noise_seed)
+            var_costs[i, :d] = costs
+            var_valid[i, :d] = True
+
+        by_arity: Dict[int, List[Constraint]] = {}
+        for c in constraints:
+            by_arity.setdefault(c.arity, []).append(c)
+
+        buckets = []
+        self.slots = {}
+        self.factors = {}
+        self._free: Dict[int, List[int]] = {}
+        for bi, arity in enumerate(sorted(by_arity)):
+            facs = by_arity[arity]
+            n_rows = self._slacked(len(facs))
+            shape = (n_rows,) + (self.dmax,) * arity
+            costs = np.zeros(shape, np.float32)
+            var_ids = np.full((n_rows, arity), v_count, np.int32)
+            for fi, c in enumerate(facs):
+                self._write_row(costs, var_ids, fi, c)
+                self.slots[c.name] = (bi, fi)
+                self.factors[c.name] = c
+            self._free[bi] = list(range(len(facs), n_rows))
+            buckets.append(FactorBucket(costs, var_ids))
+        self._arity_bucket = {
+            b.arity: i for i, b in enumerate(buckets)
+        }
+        self.graph = CompiledFactorGraph(
+            var_costs=var_costs, var_valid=var_valid,
+            buckets=tuple(buckets),
+        )
+        self.recompile_count += 1
+        self._jitted = {}
+
+    def _write_row(self, costs: np.ndarray, var_ids: np.ndarray,
+                   row: int, c: Constraint):
+        table = self.sign * np.asarray(c.to_array(), np.float32)
+        full = np.full(costs.shape[1:], BIG, np.float32)
+        idx = tuple(slice(0, s) for s in table.shape)
+        full[idx] = table
+        costs[row] = full
+        for p, v in enumerate(c.dimensions):
+            var_ids[row, p] = self.var_index[v.name]
+
+    def _patch_bucket(self, bi: int, row: int,
+                      c: Optional[Constraint]):
+        """Replace one bucket row on the host copy and refresh device
+        arrays without recompiling (shapes unchanged)."""
+        bucket = self.graph.buckets[bi]
+        costs = np.asarray(bucket.costs).copy()
+        var_ids = np.asarray(bucket.var_ids).copy()
+        if c is None:
+            costs[row] = 0.0
+            var_ids[row] = len(self.variables)
+        else:
+            self._write_row(costs, var_ids, row, c)
+        new_buckets = list(self.graph.buckets)
+        new_buckets[bi] = FactorBucket(costs, var_ids)
+        self.graph = self.graph._replace(buckets=tuple(new_buckets))
+
+    # ------------------------------------------------------------- #
+    # dynamic edits
+    # ------------------------------------------------------------- #
+
+    def _unfreeze(self):
+        """Every edit clears convergence: the suppression counters and
+        the stable flag would otherwise stop the warm-started loop
+        before the new costs can propagate."""
+        if self._state is not None:
+            self._state = self._state._replace(
+                stable=np.asarray(False))
+
+    def change_factor(self, name: str, new_constraint: Constraint):
+        """Swap a live factor's cost function in place (device
+        analogue of maxsum_dynamic change_factor_function).  The edge
+        messages survive, so the fixpoint adapts incrementally."""
+        if name not in self.slots:
+            raise KeyError(f"No live factor named {name}")
+        old = self.factors[name]
+        if new_constraint.arity != old.arity or any(
+            self.var_index.get(v.name) is None
+            for v in new_constraint.dimensions
+        ):
+            raise ValueError(
+                "change_factor requires same arity and known variables;"
+                " use remove_factor + add_factor for topology changes"
+            )
+        bi, row = self.slots[name]
+        self._patch_bucket(bi, row, new_constraint)
+        self.factors[name] = new_constraint
+        self._unfreeze()
+
+    def remove_factor(self, name: str):
+        """Delete a factor; its row becomes slack.  Messages of other
+        edges are untouched (warm start)."""
+        bi, row = self.slots.pop(name)
+        del self.factors[name]
+        self._patch_bucket(bi, row, None)
+        self._free[bi].append(row)
+        # Stale messages on the removed edge are neutralized: zero rows
+        # with sentinel var ids contribute nothing to beliefs.
+        if self._state is not None:
+            self._state = self._zero_state_row(self._state, bi, row)
+
+    def add_factor(self, c: Constraint):
+        """Insert a factor.  Fits into a slack row when one exists for
+        its arity and its domains fit dmax; otherwise triggers a
+        recompile with messages carried over."""
+        if c.name in self.slots:
+            raise ValueError(f"Factor {c.name} already exists")
+        for v in c.dimensions:
+            if v.name not in self.var_index:
+                self.add_variable(v)
+        bi = self._arity_bucket.get(c.arity)
+        fits = (
+            bi is not None and self._free.get(bi)
+            and all(len(v.domain) <= self.dmax for v in c.dimensions)
+        )
+        if fits:
+            row = self._free[bi].pop(0)
+            self._patch_bucket(bi, row, c)
+            self.slots[c.name] = (bi, row)
+            self.factors[c.name] = c
+            if self._state is not None:
+                self._state = self._zero_state_row(self._state, bi, row)
+        else:
+            self.factors[c.name] = c
+            self._recompile_carrying_messages(
+                list(self.factors.values()))
+
+    def add_variable(self, v: Variable):
+        """Add a variable (no incident factor yet).  Grows the var
+        tables, which changes shapes -> recompile with carry-over."""
+        if v.name in self.var_index:
+            return
+        self.variables.append(v)
+        self.var_index[v.name] = len(self.variables) - 1
+        self._recompile_carrying_messages(list(self.factors.values()))
+
+    def _zero_state_row(self, state: ops.MaxSumState, bi: int,
+                        row: int) -> ops.MaxSumState:
+        def zero(msgs):
+            arr = np.asarray(msgs[bi]).copy()
+            arr[row] = 0.0
+            out = list(msgs)
+            out[bi] = arr
+            return tuple(out)
+
+        def zero_count(counts):
+            arr = np.asarray(counts[bi]).copy()
+            arr[row] = 0
+            out = list(counts)
+            out[bi] = arr
+            return tuple(out)
+
+        return ops.MaxSumState(
+            v2f=zero(state.v2f), f2v=zero(state.f2v),
+            v2f_count=zero_count(state.v2f_count),
+            f2v_count=zero_count(state.f2v_count),
+            stable=np.asarray(False), cycle=np.asarray(state.cycle),
+        )
+
+    def _recompile_carrying_messages(self, constraints):
+        """Full rebuild; surviving factors' message rows are copied
+        into their new slots so the run continues warm."""
+        old_state = self._state
+        old_slots = dict(self.slots)
+        old_graph = self.graph
+        self._build(constraints)
+        if old_state is None:
+            return
+        d_old = np.asarray(old_graph.var_costs).shape[1]
+        d = self.dmax
+        v2f = [np.zeros(b.var_ids.shape + (d,), np.float32)
+               for b in self.graph.buckets]
+        f2v = [np.zeros(b.var_ids.shape + (d,), np.float32)
+               for b in self.graph.buckets]
+        v2f_c = [np.zeros(b.var_ids.shape, np.int32)
+                 for b in self.graph.buckets]
+        f2v_c = [np.zeros(b.var_ids.shape, np.int32)
+                 for b in self.graph.buckets]
+        old_v2f = [np.asarray(a) for a in old_state.v2f]
+        old_f2v = [np.asarray(a) for a in old_state.f2v]
+        old_v2f_c = [np.asarray(a) for a in old_state.v2f_count]
+        old_f2v_c = [np.asarray(a) for a in old_state.f2v_count]
+        dcopy = min(d, d_old)
+        for name, (bi, row) in self.slots.items():
+            old = old_slots.get(name)
+            if old is None:
+                continue
+            obi, orow = old
+            v2f[bi][row, :, :dcopy] = old_v2f[obi][orow, :, :dcopy]
+            f2v[bi][row, :, :dcopy] = old_f2v[obi][orow, :, :dcopy]
+            v2f_c[bi][row] = old_v2f_c[obi][orow]
+            f2v_c[bi][row] = old_f2v_c[obi][orow]
+        self._state = ops.MaxSumState(
+            v2f=tuple(v2f), f2v=tuple(f2v),
+            v2f_count=tuple(v2f_c), f2v_count=tuple(f2v_c),
+            stable=np.asarray(False),
+            cycle=np.asarray(old_state.cycle),
+        )
+
+    # ------------------------------------------------------------- #
+    # running
+    # ------------------------------------------------------------- #
+
+    def run(self, max_cycles: int = 1000,
+            stop_on_convergence: bool = True) -> DeviceRunResult:
+        """Continue the trajectory for up to max_cycles more cycles."""
+        key = (max_cycles, stop_on_convergence,
+               tuple(b.costs.shape for b in self.graph.buckets),
+               self.graph.var_costs.shape)
+        if key not in self._jitted:
+            import functools
+
+            self._jitted[key] = jax.jit(functools.partial(
+                ops.run_maxsum_from,
+                extra_cycles=max_cycles,
+                damping=self.damping,
+                damp_vars=self.damp_vars,
+                damp_factors=self.damp_factors,
+                stability=self.stability,
+                stop_on_convergence=stop_on_convergence,
+            ))
+        if self._state is None:
+            self._state = ops.init_state(self.graph)
+        fn = self._jitted[key]
+        t0 = time.perf_counter()
+        compiled = fn.lower(self.graph, self._state).compile()
+        t1 = time.perf_counter()
+        state, values = compiled(self.graph, self._state)
+        jax.block_until_ready(values)
+        t2 = time.perf_counter()
+        self._state = state
+        values = np.asarray(jax.device_get(values))
+        assignment = {
+            v.name: v.domain[int(values[i])]
+            for i, v in enumerate(self.variables)
+        }
+        return DeviceRunResult(
+            assignment=assignment,
+            cycles=int(state.cycle),
+            converged=bool(state.stable),
+            time_s=t2 - t1,
+            compile_time_s=t1 - t0,
+            metrics={"recompiles": self.recompile_count - 1},
+        )
+
+    def cost(self, assignment: Dict) -> float:
+        """Host-side constraint cost of an assignment."""
+        total = 0.0
+        for c in self.factors.values():
+            total += float(c(**{
+                v.name: assignment[v.name] for v in c.dimensions
+            }))
+        return total

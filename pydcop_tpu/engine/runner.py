@@ -118,6 +118,47 @@ class MaxSumEngine:
             )
         return self._jitted[key]
 
+    def run_trace(self, max_cycles: int) -> "DeviceRunResult":
+        """Fixed-cycle run that also records the constraint cost of the
+        selected assignment after every cycle (metrics['cost_trace'],
+        numpy [max_cycles]) — the curve behind time-to-equal-cost
+        claims (bench.py)."""
+        key = ("trace", max_cycles)
+        if key not in self._jitted:
+            self._jitted[key] = jax.jit(
+                partial(
+                    maxsum_ops.run_maxsum_trace,
+                    max_cycles=max_cycles,
+                    damping=self.damping,
+                    damp_vars=self.damp_vars,
+                    damp_factors=self.damp_factors,
+                    stability=self.stability,
+                )
+            )
+        fn = self._jitted[key]
+        t0 = time.perf_counter()
+        compiled = fn.lower(self.graph).compile()
+        t1 = time.perf_counter()
+        state, values, costs = compiled(self.graph)
+        jax.block_until_ready(values)
+        t2 = time.perf_counter()
+        values, cycle, stable, costs = jax.device_get(
+            (values, state.cycle, state.stable, costs)
+        )
+        values = np.asarray(values)
+        sign = 1.0 if self.meta.mode == "min" else -1.0
+        return DeviceRunResult(
+            assignment=self.meta.assignment_from_indices(values),
+            cycles=int(cycle),
+            converged=bool(stable),
+            time_s=t2 - t1,
+            compile_time_s=t1 - t0,
+            metrics={
+                "cost_trace": sign * np.asarray(costs)
+                + self.meta.constant_cost,
+            },
+        )
+
     def run(self, max_cycles: int = 1000,
             stop_on_convergence: bool = True) -> DeviceRunResult:
         fn = self._fn(max_cycles, stop_on_convergence)

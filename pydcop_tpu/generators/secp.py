@@ -37,7 +37,7 @@ def generate_secp(
         dcop.add_variable(v)
         efficiency = int(rng.integers(1, 10)) / 10
         dcop.add_constraint(constraint_from_str(
-            f"cost_l{i}", f"{efficiency} * l{i}", [v]))
+            f"c_l{i}", f"{efficiency} * l{i}", [v]))
 
     model_vars = {}
     for j in range(models):
@@ -72,8 +72,19 @@ def generate_secp(
             list(all_vars.values()),
         ))
 
+    # One agent per light with hosting cost 0 for its own light variable
+    # and the light's cost factor — the pinning convention every SECP
+    # distribution method relies on (reference generators/secp.py:178-198
+    # build_agents: hosting_costs={light: 0, light_cost: 0},
+    # default_hosting_cost=100).
     extra = {"capacity": capacity} if capacity else {}
     dcop.add_agents([
-        AgentDef(f"a{i}", **extra) for i in range(lights)
+        AgentDef(
+            f"a{i}",
+            hosting_costs={f"l{i}": 0, f"c_l{i}": 0},
+            default_hosting_cost=100,
+            **extra,
+        )
+        for i in range(lights)
     ])
     return dcop

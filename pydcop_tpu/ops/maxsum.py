@@ -45,10 +45,17 @@ from pydcop_tpu.engine.compile import BIG, CompiledFactorGraph
 
 Msgs = Tuple[jnp.ndarray, ...]  # one [F, arity, D] array per bucket
 
+# Reference maxsum.py:106 SAME_COUNT: a message that approx-matches the
+# previously sent one is re-sent at most this many times, then the edge
+# goes quiet (the receiver keeps the last value).
+SAME_COUNT = 4
+
 
 class MaxSumState(NamedTuple):
-    v2f: Msgs            # variable -> factor messages
-    f2v: Msgs            # factor -> variable messages
+    v2f: Msgs            # last SENT variable -> factor messages
+    f2v: Msgs            # last SENT factor -> variable messages
+    v2f_count: Msgs      # [F, arity] int32 consecutive-same send counts
+    f2v_count: Msgs
     stable: jnp.ndarray  # scalar bool: all messages approx-matched
     cycle: jnp.ndarray   # scalar int32
 
@@ -60,32 +67,52 @@ def init_state(graph: CompiledFactorGraph) -> MaxSumState:
         jnp.zeros(b.var_ids.shape + (d,), dtype=dtype)
         for b in graph.buckets
     )
+    counts = tuple(
+        jnp.zeros(b.var_ids.shape, dtype=jnp.int32)
+        for b in graph.buckets
+    )
     return MaxSumState(
         v2f=zeros,
         f2v=zeros,
+        v2f_count=counts,
+        f2v_count=counts,
         stable=jnp.asarray(False),
         cycle=jnp.asarray(0, dtype=jnp.int32),
     )
 
 
-def _all_match(new: Msgs, old: Msgs, stability: float,
-               valids: Msgs) -> jnp.ndarray:
-    """Reference approx_match (maxsum.py:688): relative change
-    2|Δ|/|a+b| below `stability` (exact equality always matches).
-    Slots outside `valids` (domain padding, sentinel padding rows) are
-    ignored so device padding cannot delay convergence."""
-    oks = []
-    for n, o, valid in zip(new, old, valids):
-        delta = jnp.abs(n - o)
-        s = jnp.abs(n + o)
-        ok = (delta == 0) | ((s != 0) & (2 * delta < stability * s))
-        oks.append(jnp.all(ok | ~valid))
-    if not oks:
-        return jnp.asarray(True)
-    out = oks[0]
-    for ok in oks[1:]:
-        out = out & ok
-    return out
+def _edge_match(new: jnp.ndarray, old: jnp.ndarray, stability: float,
+                valid: jnp.ndarray) -> jnp.ndarray:
+    """Per-edge reference approx_match (maxsum.py:688): relative change
+    2|Δ|/|a+b| below `stability` on every domain slot (exact equality
+    always matches).  Slots outside `valid` (domain padding, sentinel
+    padding rows) are ignored so device padding cannot delay
+    convergence.  Returns [F, arity] bool."""
+    delta = jnp.abs(new - old)
+    s = jnp.abs(new + old)
+    ok = (delta == 0) | ((s != 0) & (2 * delta < stability * s))
+    return jnp.all(ok | ~valid, axis=-1)
+
+
+def _send_or_suppress(cand: jnp.ndarray, prev: jnp.ndarray,
+                      count: jnp.ndarray, stability: float,
+                      valid: jnp.ndarray, first: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Reference send-suppression (maxsum.py:366-377 via send_damped):
+    a candidate that approx-matches the last sent message is re-sent at
+    most SAME_COUNT times, then the edge freezes on the last sent value
+    (the thread runtime's receiver keeps its cached copy; here the
+    frozen value simply stays in the state array).
+
+    Returns (sent messages, new counts, per-edge match flags).
+    """
+    match = _edge_match(cand, prev, stability, valid) & ~first
+    send = ~match | (count < SAME_COUNT)
+    sent = jnp.where(send[..., None], cand, prev)
+    new_count = jnp.where(
+        match, jnp.minimum(count + 1, SAME_COUNT + 1), 1
+    )
+    return sent, new_count, match
 
 
 def factor_to_var(graph: CompiledFactorGraph, v2f: Msgs) -> Msgs:
@@ -167,32 +194,107 @@ def _damp(new: Msgs, old: Msgs, damping: float,
 def superstep(state: MaxSumState, graph: CompiledFactorGraph, *,
               damping: float, damp_vars: bool, damp_factors: bool,
               stability: float) -> MaxSumState:
-    """One synchronous MaxSum cycle: factors fire, then variables."""
+    """One synchronous MaxSum cycle with the reference's exact BSP
+    semantics: in cycle k BOTH sides fire from the messages sent in
+    cycle k-1 (Jacobi — a factor computation and a variable computation
+    each see only last cycle's mail, reference
+    SynchronousComputationMixin), with per-edge damping and SAME_COUNT
+    send-suppression.  This cycle-for-cycle equivalence with the
+    threaded agent runtime is what makes device-vs-thread cost parity
+    assertable on large loopy graphs (bench.py cost_parity)."""
     first = state.cycle == 0
     valids = tuple(
         graph.var_valid[b.var_ids] for b in graph.buckets
     )
 
-    f2v_new = factor_to_var(graph, state.v2f)
+    f2v_cand = factor_to_var(graph, state.v2f)
     if damp_factors and damping > 0:
-        f2v_new = _damp(f2v_new, state.f2v, damping, first)
+        f2v_cand = _damp(f2v_cand, state.f2v, damping, first)
 
-    beliefs, sums = aggregate_beliefs(graph, f2v_new)
-    v2f_new = var_to_factor(graph, f2v_new, beliefs, sums)
+    # Variable side uses the factor messages from the PREVIOUS cycle.
+    beliefs, sums = aggregate_beliefs(graph, state.f2v)
+    v2f_cand = var_to_factor(graph, state.f2v, beliefs, sums)
     if damp_vars and damping > 0:
-        v2f_new = _damp(v2f_new, state.v2f, damping, first)
+        v2f_cand = _damp(v2f_cand, state.v2f, damping, first)
 
-    stable = (
-        _all_match(f2v_new, state.f2v, stability, valids)
-        & _all_match(v2f_new, state.v2f, stability, valids)
-        & ~first
-    )
+    f2v_new, f2v_count = [], []
+    v2f_new, v2f_count = [], []
+    all_match = jnp.asarray(True)
+    for i, valid in enumerate(valids):
+        sent, cnt, match = _send_or_suppress(
+            f2v_cand[i], state.f2v[i], state.f2v_count[i],
+            stability, valid, first)
+        f2v_new.append(sent)
+        f2v_count.append(cnt)
+        all_match = all_match & jnp.all(match | ~jnp.any(valid, -1))
+        sent, cnt, match = _send_or_suppress(
+            v2f_cand[i], state.v2f[i], state.v2f_count[i],
+            stability, valid, first)
+        v2f_new.append(sent)
+        v2f_count.append(cnt)
+        all_match = all_match & jnp.all(match | ~jnp.any(valid, -1))
+
     return MaxSumState(
-        v2f=v2f_new,
-        f2v=f2v_new,
-        stable=stable,
+        v2f=tuple(v2f_new),
+        f2v=tuple(f2v_new),
+        v2f_count=tuple(v2f_count),
+        f2v_count=tuple(f2v_count),
+        stable=all_match & ~first,
         cycle=state.cycle + 1,
     )
+
+
+def assignment_constraint_cost(graph: CompiledFactorGraph,
+                               values: jnp.ndarray) -> jnp.ndarray:
+    """Total factor-table cost of an assignment ([V] value indices).
+
+    Padding rows contribute 0 (their tables are all-zero and their
+    var_ids point at the sentinel row).  Variable-side costs (including
+    tie-breaking noise) are NOT included — this is the constraint cost
+    the host-side ``DCOP.solution_cost`` reports for problems whose
+    variables carry no intrinsic costs."""
+    vals = jnp.concatenate(
+        [values, jnp.zeros((1,), dtype=values.dtype)]
+    )
+    total = jnp.asarray(0.0, dtype=graph.var_costs.dtype)
+    for bucket in graph.buckets:
+        f, arity = bucket.var_ids.shape
+        d = graph.var_costs.shape[1]
+        idx = vals[bucket.var_ids]               # [F, arity]
+        flat = jnp.zeros((f,), dtype=jnp.int32)
+        for p in range(arity):
+            flat = flat * d + idx[:, p]
+        table = bucket.costs.reshape(f, -1)
+        total = total + jnp.sum(
+            jnp.take_along_axis(table, flat[:, None], axis=1)
+        )
+    return total
+
+
+def run_maxsum_trace(graph: CompiledFactorGraph, max_cycles: int, *,
+                     damping: float = 0.5, damp_vars: bool = True,
+                     damp_factors: bool = True, stability: float = 0.1,
+                     ) -> Tuple[MaxSumState, jnp.ndarray, jnp.ndarray]:
+    """Like run_maxsum without convergence stop, additionally recording
+    the constraint cost of the selected assignment after every cycle
+    ([max_cycles] array) — the cost-vs-cycle curve used for
+    time-to-equal-cost benchmark claims."""
+
+    def step(state, _):
+        state = superstep(
+            state, graph, damping=damping, damp_vars=damp_vars,
+            damp_factors=damp_factors, stability=stability,
+        )
+        beliefs, _ = aggregate_beliefs(graph, state.f2v)
+        values = select_values(graph, beliefs)
+        return state, assignment_constraint_cost(graph, values)
+
+    state, costs = jax.lax.scan(
+        step, init_state(graph), None, length=max_cycles
+    )
+    beliefs, _ = aggregate_beliefs(graph, state.f2v)
+    values = select_values(graph, beliefs)
+    return state, values, costs
 
 
 def run_maxsum(graph: CompiledFactorGraph, max_cycles: int, *,
@@ -204,6 +306,25 @@ def run_maxsum(graph: CompiledFactorGraph, max_cycles: int, *,
 
     Returns (final state, selected value indices [V]).
     """
+    return run_maxsum_from(
+        graph, init_state(graph), max_cycles,
+        damping=damping, damp_vars=damp_vars,
+        damp_factors=damp_factors, stability=stability,
+        stop_on_convergence=stop_on_convergence,
+    )
+
+
+def run_maxsum_from(graph: CompiledFactorGraph, state: MaxSumState,
+                    extra_cycles: int, *,
+                    damping: float = 0.5, damp_vars: bool = True,
+                    damp_factors: bool = True, stability: float = 0.1,
+                    stop_on_convergence: bool = True,
+                    ) -> Tuple[MaxSumState, jnp.ndarray]:
+    """Run up to ``extra_cycles`` more supersteps from an existing state
+    — the warm-start primitive for dynamic DCOPs: after a graph event
+    the surviving messages stay in place and the trajectory continues
+    instead of restarting from zero (SURVEY §7 "dynamic graphs ...
+    warm-starting messages")."""
 
     def step(state):
         return superstep(
@@ -211,16 +332,18 @@ def run_maxsum(graph: CompiledFactorGraph, max_cycles: int, *,
             damp_factors=damp_factors, stability=stability,
         )
 
-    state = init_state(graph)
+    limit = state.cycle + extra_cycles
     if stop_on_convergence:
         state = jax.lax.while_loop(
-            lambda s: (s.cycle < max_cycles) & ~s.stable,
+            lambda s: (s.cycle < limit) & ~s.stable,
             step,
             state,
         )
     else:
-        state = jax.lax.fori_loop(
-            0, max_cycles, lambda i, s: step(s), state
+        state = jax.lax.while_loop(
+            lambda s: s.cycle < limit,
+            step,
+            state,
         )
     beliefs, _ = aggregate_beliefs(graph, state.f2v)
     values = select_values(graph, beliefs)

@@ -1,0 +1,136 @@
+"""Device-path dynamic DCOP tests (VERDICT #7).
+
+The DynamicMaxSumEngine must (a) warm-start across run segments with no
+behavioral difference vs one long run, (b) absorb factor edits through
+padding slack without recompiling, (c) carry messages over a recompile
+when an edit outgrows the slack, and (d) keep cost continuity across
+events.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.engine.dynamic import DynamicMaxSumEngine
+
+D3 = Domain("colors", "color", [0, 1, 2])
+
+
+def _ring(n=12, seed=0):
+    """Ring of n variables with equality-penalty constraints."""
+    rng = np.random.default_rng(seed)
+    variables = [Variable(f"v{i}", D3) for i in range(n)]
+    eq = np.eye(3)
+    constraints = [
+        NAryMatrixRelation(
+            [variables[i], variables[(i + 1) % n]], eq, f"c{i}")
+        for i in range(n)
+    ]
+    return variables, constraints
+
+
+def test_split_run_equals_single_run():
+    variables, constraints = _ring()
+    e1 = DynamicMaxSumEngine(variables, constraints, noise_seed=4)
+    r1a = e1.run(40, stop_on_convergence=False)
+    r1b = e1.run(40, stop_on_convergence=False)
+    e2 = DynamicMaxSumEngine(variables, constraints, noise_seed=4)
+    r2 = e2.run(80, stop_on_convergence=False)
+    assert r1b.cycles == r2.cycles == 80
+    assert r1b.assignment == r2.assignment
+
+
+def test_change_factor_no_recompile():
+    variables, constraints = _ring(6)
+    eng = DynamicMaxSumEngine(variables, constraints, noise_seed=1)
+    res = eng.run(60)
+    assert res.metrics["recompiles"] == 0
+    base_conflicts = sum(
+        res.assignment[f"v{i}"] == res.assignment[f"v{(i + 1) % 6}"]
+        for i in range(6)
+    )
+    assert base_conflicts == 0
+    # Flip c0 into an equality PREFERENCE (penalize differing): the
+    # fixpoint must adapt so v0 == v1.
+    neq = 1.0 - np.eye(3)
+    eng.change_factor("c0", NAryMatrixRelation(
+        [variables[0], variables[1]], neq, "c0"))
+    res2 = eng.run(120)
+    assert res2.metrics["recompiles"] == 0  # slack edit, same program
+    assert res2.assignment["v0"] == res2.assignment["v1"]
+    assert res2.cycles > res.cycles  # warm continuation, not a restart
+
+
+def test_remove_and_add_factor_within_slack():
+    variables, constraints = _ring(8)
+    eng = DynamicMaxSumEngine(
+        variables, constraints, noise_seed=2, slack=0.5)
+    eng.run(40)
+    eng.remove_factor("c3")
+    assert "c3" not in eng.factors
+    eq = np.eye(3)
+    # New chord factor fits the freed/slack rows: no recompile.
+    eng.add_factor(NAryMatrixRelation(
+        [variables[0], variables[4]], eq, "chord"))
+    res = eng.run(80)
+    assert res.metrics["recompiles"] == 0
+    # The chord constraint is active: v0 != v4.
+    assert res.assignment["v0"] != res.assignment["v4"]
+
+
+def test_add_beyond_slack_recompiles_and_warm_starts():
+    variables, constraints = _ring(8)
+    eng = DynamicMaxSumEngine(
+        variables, constraints, noise_seed=3, slack=0.0)
+    res0 = eng.run(60)
+    cost0 = eng.cost(res0.assignment)
+    # slack=0 still keeps >=1 spare row (implementation guarantees
+    # n+1); exhaust it, then one more forces a recompile.
+    eq = np.eye(3)
+    added = 0
+    while eng._free[0]:
+        i = added + 1
+        eng.add_factor(NAryMatrixRelation(
+            [variables[0], variables[i + 1]], eq, f"x{added}"))
+        added += 1
+    eng.add_factor(NAryMatrixRelation(
+        [variables[2], variables[6]], eq, "overflow"))
+    res1 = eng.run(120)
+    assert res1.metrics["recompiles"] >= 1
+    # Warm start survived the recompile: the cycle counter continued.
+    assert res1.cycles > res0.cycles
+    # Cost continuity: the pre-event solution was conflict-free on the
+    # surviving constraints; the warm-started run must not regress on
+    # them (only the new constraints add requirements).
+    cost1 = eng.cost(res1.assignment)
+    assert cost1 <= cost0 + 1.0
+
+
+def test_add_variable_recompiles_and_links():
+    variables, constraints = _ring(6)
+    eng = DynamicMaxSumEngine(variables, constraints, noise_seed=5)
+    eng.run(40)
+    w = Variable("w0", D3)
+    eq = np.eye(3)
+    eng.add_factor(NAryMatrixRelation([variables[0], w], eq, "cw"))
+    res = eng.run(120)
+    assert "w0" in res.assignment
+    assert res.assignment["w0"] != res.assignment["v0"]
+    assert res.metrics["recompiles"] >= 1
+
+
+def test_cost_continuity_across_noop_event():
+    """An event that does not change the problem must not perturb the
+    trajectory at all: state is identical to just continuing."""
+    variables, constraints = _ring(10)
+    eng = DynamicMaxSumEngine(variables, constraints, noise_seed=6)
+    res_a = eng.run(50, stop_on_convergence=False)
+    # remove + re-add the same factor: graph returns to the same math.
+    c5 = eng.factors["c5"]
+    eng.remove_factor("c5")
+    eng.add_factor(c5)
+    res_b = eng.run(50, stop_on_convergence=False)
+    # The edge messages were reset by the edit, but the surviving state
+    # pulls the trajectory back: same conflict-free fixpoint.
+    assert eng.cost(res_b.assignment) <= eng.cost(res_a.assignment)

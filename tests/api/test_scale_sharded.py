@@ -58,7 +58,7 @@ def test_sharded_matches_unsharded():
         variables, constraints, noise_level=0.01, noise_seed=1
     )
     state1, values1 = jax.jit(
-        lambda g: run_maxsum(g, 60, stop_on_convergence=False)
+        lambda g: run_maxsum(g, 120, stop_on_convergence=False)
     )(jax.device_put(graph1))
 
     graph8, _ = compile_factor_graph(
@@ -67,7 +67,7 @@ def test_sharded_matches_unsharded():
     )
     graph8 = shard_graph(graph8, mesh)
     state8, values8 = jax.jit(
-        lambda g: run_maxsum(g, 60, stop_on_convergence=False)
+        lambda g: run_maxsum(g, 120, stop_on_convergence=False)
     )(graph8)
 
     values1 = np.asarray(values1)

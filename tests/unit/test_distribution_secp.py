@@ -1,0 +1,187 @@
+"""SECP distribution family tests.
+
+Verify that the SECP-specialized methods reproduce the reference's
+placement rules (reference gh_secp_cgdp.py:75-124, gh_secp_fgdp.py:
+92-198, oilp_secp_fgdp.py:72-131, oilp_cgdp.py:174-185) on problems
+from our own SECP generator:
+
+- actuator variables (hosting cost 0) are pinned on their agent;
+- factor-graph flavors co-locate ``c_<actuator>`` cost factors and
+  (model variable, ``c_<model>`` factor) pairs;
+- greedy placements put every non-pinned computation next to at least
+  one neighbor; ILP placements are never worse than the greedy ones on
+  the comm-only objective;
+- capacities hold and every computation is hosted exactly once.
+"""
+
+import pytest
+
+from pydcop_tpu.algorithms import load_algorithm_module
+from pydcop_tpu.computations_graph import load_graph_module
+from pydcop_tpu.distribution import (
+    gh_secp_cgdp,
+    gh_secp_fgdp,
+    oilp_cgdp,
+    oilp_secp_cgdp,
+    oilp_secp_fgdp,
+)
+from pydcop_tpu.generators.secp import generate_secp
+
+LIGHTS, MODELS, RULES = 5, 2, 3
+
+
+@pytest.fixture(scope="module")
+def secp():
+    return generate_secp(
+        LIGHTS, MODELS, RULES, capacity=10_000, seed=11)
+
+
+def _graph(dcop, algo):
+    module = load_algorithm_module(algo)
+    cg = load_graph_module(module.GRAPH_TYPE).build_computation_graph(
+        dcop)
+    return cg, module
+
+
+def _check_common(dist, cg, agents):
+    hosted = sorted(dist.computations)
+    assert hosted == sorted(n.name for n in cg.nodes)
+    by_agent = {a.name: dist.computations_hosted(a.name) for a in agents}
+    for a in agents:
+        for c in by_agent[a.name]:
+            assert dist.agent_for(c) == a.name
+        assert len(by_agent[a.name]) == len(set(by_agent[a.name]))
+
+
+def _check_actuators_pinned(dist, dcop):
+    for i in range(LIGHTS):
+        assert dist.agent_for(f"l{i}") == f"a{i}"
+
+
+class TestGhSecpFgdp:
+    def test_placement_rules(self, secp):
+        cg, module = _graph(secp, "maxsum")
+        dist = gh_secp_fgdp.distribute(
+            cg, secp.agents.values(),
+            computation_memory=module.computation_memory,
+            communication_load=module.communication_load,
+        )
+        _check_common(dist, cg, list(secp.agents.values()))
+        _check_actuators_pinned(dist, secp)
+        # Cost factors ride with their actuator.
+        for i in range(LIGHTS):
+            assert dist.agent_for(f"c_l{i}") == f"a{i}"
+        # Model variable and model factor are co-located.
+        for j in range(MODELS):
+            assert (dist.agent_for(f"m{j}")
+                    == dist.agent_for(f"c_m{j}"))
+        # Every rule factor lives with at least one neighbor.
+        for k in range(RULES):
+            name = f"r_{k}"
+            agent = dist.agent_for(name)
+            neighbors = cg.computation(name).neighbors
+            hosted = set(dist.computations_hosted(agent))
+            assert hosted.intersection(neighbors)
+
+    def test_requires_computation_memory(self, secp):
+        cg, _ = _graph(secp, "maxsum")
+        from pydcop_tpu.distribution.objects import (
+            ImpossibleDistributionException,
+        )
+
+        with pytest.raises(ImpossibleDistributionException):
+            gh_secp_fgdp.distribute(cg, secp.agents.values())
+
+
+class TestGhSecpCgdp:
+    def test_placement_rules(self, secp):
+        cg, module = _graph(secp, "dsa")
+        dist = gh_secp_cgdp.distribute(
+            cg, secp.agents.values(),
+            computation_memory=module.computation_memory,
+            communication_load=module.communication_load,
+        )
+        _check_common(dist, cg, list(secp.agents.values()))
+        _check_actuators_pinned(dist, secp)
+        # Model variables live next to at least one neighbor.
+        for j in range(MODELS):
+            name = f"m{j}"
+            agent = dist.agent_for(name)
+            neighbors = cg.computation(name).neighbors
+            hosted = set(dist.computations_hosted(agent))
+            assert hosted.intersection(neighbors)
+
+
+class TestOilpSecp:
+    def test_cgdp_optimal_vs_greedy(self, secp):
+        cg, module = _graph(secp, "dsa")
+        kwargs = dict(
+            computation_memory=module.computation_memory,
+            communication_load=module.communication_load,
+        )
+        greedy = gh_secp_cgdp.distribute(
+            cg, secp.agents.values(), **kwargs)
+        optimal = oilp_secp_cgdp.distribute(
+            cg, secp.agents.values(), **kwargs)
+        _check_common(optimal, cg, list(secp.agents.values()))
+        _check_actuators_pinned(optimal, secp)
+        # Every agent hosts at least one computation.
+        for a in secp.agents:
+            assert optimal.computations_hosted(a)
+        g_cost, _, _ = oilp_secp_cgdp.distribution_cost(
+            greedy, cg, secp.agents.values(), **kwargs)
+        o_cost, _, _ = oilp_secp_cgdp.distribution_cost(
+            optimal, cg, secp.agents.values(), **kwargs)
+        assert o_cost <= g_cost + 1e-9
+
+    def test_fgdp_optimal_vs_greedy(self, secp):
+        cg, module = _graph(secp, "maxsum")
+        kwargs = dict(
+            computation_memory=module.computation_memory,
+            communication_load=module.communication_load,
+        )
+        greedy = gh_secp_fgdp.distribute(
+            cg, secp.agents.values(), **kwargs)
+        optimal = oilp_secp_fgdp.distribute(
+            cg, secp.agents.values(), **kwargs)
+        _check_common(optimal, cg, list(secp.agents.values()))
+        _check_actuators_pinned(optimal, secp)
+        # Actuator cost factors stay with their agent (pinned pre-ILP).
+        for i in range(LIGHTS):
+            assert optimal.agent_for(f"c_l{i}") == f"a{i}"
+        g_cost, _, _ = oilp_secp_fgdp.distribution_cost(
+            greedy, cg, secp.agents.values(), **kwargs)
+        o_cost, _, _ = oilp_secp_fgdp.distribution_cost(
+            optimal, cg, secp.agents.values(), **kwargs)
+        assert o_cost <= g_cost + 1e-9
+
+    def test_comm_only_cost_model(self, secp):
+        """SECP distribution cost = communication only: co-located
+        ends contribute nothing, hosting is always 0."""
+        cg, module = _graph(secp, "maxsum")
+        dist = gh_secp_fgdp.distribute(
+            cg, secp.agents.values(),
+            computation_memory=module.computation_memory,
+            communication_load=module.communication_load,
+        )
+        total, comm, hosting = oilp_secp_fgdp.distribution_cost(
+            dist, cg, secp.agents.values(),
+            computation_memory=module.computation_memory,
+            communication_load=module.communication_load,
+        )
+        assert hosting == 0.0
+        assert total == comm >= 0.0
+
+
+class TestOilpCgdp:
+    def test_pins_zero_hosting_cost(self, secp):
+        cg, module = _graph(secp, "dsa")
+        dist = oilp_cgdp.distribute(
+            cg, secp.agents.values(),
+            computation_memory=module.computation_memory,
+            communication_load=module.communication_load,
+        )
+        _check_common(dist, cg, list(secp.agents.values()))
+        # Reference oilp_cgdp.py:174-185: zero-hosting-cost computations
+        # are forced onto their agent.
+        _check_actuators_pinned(dist, secp)
