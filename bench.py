@@ -179,8 +179,7 @@ def _ensure_live_backend():
 
     env = scrubbed_cpu_env()
     env["PYDCOP_BENCH_NO_PROBE"] = "1"
-    import os as _os
-    _os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def main():

@@ -150,6 +150,10 @@ def run_cmd(args) -> int:
 # orders of magnitude faster than wall-clock agent cycles, so delays
 # are interpreted as computation budget rather than sleeps.
 DEVICE_CYCLES_PER_DELAY_SECOND = 200
+# Delay budgets run in fixed-size chunks so every segment reuses ONE
+# compiled program (max_cycles is a static jit key; distinct per-delay
+# cycle counts would each trigger a full XLA compile).
+DEVICE_RUN_CHUNK = 200
 
 
 def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
@@ -201,9 +205,13 @@ def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
     last = engine.run(1, stop_on_convergence=False)
     for event in scenario:
         if event.is_delay:
-            cycles = max(
+            budget = max(
                 1, int(event.delay * DEVICE_CYCLES_PER_DELAY_SECOND))
-            last = engine.run(cycles, stop_on_convergence=False)
+            # Whole chunks only (rounding the budget up): every
+            # segment then shares one compiled program.
+            for _ in range(-(-budget // DEVICE_RUN_CHUNK)):
+                last = engine.run(
+                    DEVICE_RUN_CHUNK, stop_on_convergence=False)
             continue
         for action in event.actions or []:
             if action.type == "remove_agent":
@@ -213,11 +221,14 @@ def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
                     c for c, a in placement.items() if a == agent
                 ]
                 # Re-home on the least-loaded survivors.
-                for c in orphans:
+                for c in sorted(orphans):
                     if not live_agents:
                         break
+                    # Tie-break on the agent name so re-homing is
+                    # reproducible across runs (set iteration order is
+                    # hash-randomized).
                     target = min(
-                        live_agents,
+                        sorted(live_agents),
                         key=lambda a: sum(
                             1 for x in placement.values() if x == a
                         ),

@@ -176,13 +176,16 @@ class DynamicMaxSumEngine:
         if name not in self.slots:
             raise KeyError(f"No live factor named {name}")
         old = self.factors[name]
-        if new_constraint.arity != old.arity or any(
-            self.var_index.get(v.name) is None
-            for v in new_constraint.dimensions
-        ):
+        # The edge message rows (and their suppression counts) are kept
+        # across the swap, so the scope must be IDENTICAL — a factor
+        # over different variables would inherit messages computed for
+        # the old edges.  Topology changes go through
+        # remove_factor + add_factor, which reset the row state.
+        if [v.name for v in new_constraint.dimensions] != \
+                [v.name for v in old.dimensions]:
             raise ValueError(
-                "change_factor requires same arity and known variables;"
-                " use remove_factor + add_factor for topology changes"
+                "change_factor requires the same variable scope; use "
+                "remove_factor + add_factor for topology changes"
             )
         bi, row = self.slots[name]
         self._patch_bucket(bi, row, new_constraint)
@@ -207,9 +210,19 @@ class DynamicMaxSumEngine:
         recompile with messages carried over."""
         if c.name in self.slots:
             raise ValueError(f"Factor {c.name} already exists")
-        for v in c.dimensions:
-            if v.name not in self.var_index:
-                self.add_variable(v)
+        new_vars = [
+            v for v in c.dimensions if v.name not in self.var_index
+        ]
+        if new_vars:
+            # One rebuild for all new variables AND the factor itself
+            # (growing the var tables changes shapes anyway).
+            for v in new_vars:
+                self.variables.append(v)
+                self.var_index[v.name] = len(self.variables) - 1
+            self.factors[c.name] = c
+            self._recompile_carrying_messages(
+                list(self.factors.values()))
+            return
         bi = self._arity_bucket.get(c.arity)
         fits = (
             bi is not None and self._free.get(bi)

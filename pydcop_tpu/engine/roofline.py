@@ -92,11 +92,12 @@ def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
         "bytes_per_cycle": float(bytes_moved),
         "achieved_gflops": round(achieved_flops / 1e9, 3),
         "achieved_gbps": round(achieved_bw / 1e9, 3),
+        # Not rounded: on small graphs these are ~1e-9 and rounding
+        # would collapse an honest tiny number to a dishonest zero.
         "mfu": (
-            round(achieved_flops / peak_flops, 8)
-            if peak_flops else None
+            achieved_flops / peak_flops if peak_flops else None
         ),
         "hbm_util": (
-            round(achieved_bw / peak_bw, 6) if peak_bw else None
+            achieved_bw / peak_bw if peak_bw else None
         ),
     }

@@ -335,27 +335,34 @@ class Discovery:
     # -- publication handling ------------------------------------------ #
 
     def _on_publish(self, event: str, name: str, value):
+        def with_wildcard(cb_map: Dict[str, List[Callable]]):
+            # "*" subscriptions receive every publication of the kind
+            # (the directory side already fans them out; mirror that
+            # here for locally-registered callbacks).
+            return list(cb_map.get(name, [])) + list(
+                cb_map.get("*", []))
+
         cbs: List[Callable] = []
         with self._lock:
             if event == "agent_added":
                 self._agents[name] = value
-                cbs = list(self._agent_cbs.get(name, []))
+                cbs = with_wildcard(self._agent_cbs)
             elif event == "agent_removed":
                 self._agents.pop(name, None)
-                cbs = list(self._agent_cbs.get(name, []))
+                cbs = with_wildcard(self._agent_cbs)
             elif event == "computation_added":
                 agent, address = value
                 self._computations[name] = agent
                 if address is not None:
                     self._agents[agent] = address
                 value = agent
-                cbs = list(self._computation_cbs.get(name, []))
+                cbs = with_wildcard(self._computation_cbs)
             elif event == "computation_removed":
                 self._computations.pop(name, None)
-                cbs = list(self._computation_cbs.get(name, []))
+                cbs = with_wildcard(self._computation_cbs)
             elif event == "replica_changed":
                 self._replicas[name] = list(value)
-                cbs = list(self._replica_cbs.get(name, []))
+                cbs = with_wildcard(self._replica_cbs)
         if event in ("agent_added", "agent_removed"):
             self._fire_agent_change(event, name)
         for cb in cbs:

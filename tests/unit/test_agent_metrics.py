@@ -1,0 +1,133 @@
+"""Agent runtime metrics tests: t_active activity accounting,
+per-computation cycle counts, external-message counters and the
+messaging priority queue ordering (reference agents.py:806-812
+activity time, AgentMetrics :878; communication.py priorities
+:495-497)."""
+
+import time
+
+from pydcop_tpu.infrastructure.agents import Agent
+from pydcop_tpu.infrastructure.communication import (
+    MSG_ALGO,
+    MSG_MGT,
+    MSG_VALUE,
+    ComputationMessage,
+    InProcessCommunicationLayer,
+    Messaging,
+)
+from pydcop_tpu.infrastructure.computations import (
+    Message,
+    MessagePassingComputation,
+    register,
+)
+
+
+class Busy(MessagePassingComputation):
+    """Computation that burns measurable time per message."""
+
+    def __init__(self, name="busy", delay=0.02):
+        super().__init__(name)
+        self.delay = delay
+        self.handled = 0
+
+    @register("work")
+    def _on_work(self, sender, msg, t):
+        time.sleep(self.delay)
+        self.handled += 1
+
+
+def test_t_active_accumulates_and_ratio_reported():
+    comm = InProcessCommunicationLayer()
+    agent = Agent("a1", comm)
+    comp = Busy()
+    agent.add_computation(comp)
+    agent.start()
+    try:
+        agent.run()
+        for _ in range(5):
+            agent.messaging.post_msg(
+                "ext", "busy", Message("work", None), MSG_ALGO)
+        deadline = time.monotonic() + 5
+        while comp.handled < 5 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert comp.handled == 5
+        # 5 messages x >=20ms of handling were accounted.
+        assert agent.t_active >= 5 * 0.02 * 0.8
+        metrics = agent.metrics()
+        assert 0 < metrics["activity_ratio"] <= 1
+    finally:
+        agent.clean_shutdown(2)
+
+
+def test_messaging_priority_ordering():
+    """Queue pops follow priority classes, not arrival order:
+    MGT(10) < VALUE(15) < ALGO(20)."""
+    comm = InProcessCommunicationLayer()
+    messaging = Messaging("a1", comm)
+    messaging.register_computation("c")
+    messaging.post_msg("x", "c", Message("algo", 1), MSG_ALGO)
+    messaging.post_msg("x", "c", Message("value", 2), MSG_VALUE)
+    messaging.post_msg("x", "c", Message("mgt", 3), MSG_MGT)
+    kinds = [messaging.next_msg(0.1).msg.type for _ in range(3)]
+    assert kinds == ["mgt", "value", "algo"]
+
+
+def test_messaging_fifo_within_priority():
+    comm = InProcessCommunicationLayer()
+    messaging = Messaging("a1", comm)
+    messaging.register_computation("c")
+    for i in range(4):
+        messaging.post_msg("x", "c", Message("algo", i), MSG_ALGO)
+    contents = [messaging.next_msg(0.1).msg.content for _ in range(4)]
+    assert contents == [0, 1, 2, 3]
+
+
+def test_external_message_counters():
+    """Messages leaving the agent are counted/sized per source
+    computation (reference communication.py:542-577)."""
+    comm_a = InProcessCommunicationLayer()
+    messaging_a = Messaging("a", comm_a)
+
+    comm_b = InProcessCommunicationLayer()
+    messaging_b = Messaging("b", comm_b)
+    messaging_b.register_computation("remote")
+
+    class Disco:
+        def agent_address(self, name):
+            return comm_b
+
+        def computation_agent(self, comp):
+            return {"remote": "b"}.get(comp, "a")
+
+    comm_a.discovery = Disco()
+
+    messaging_a.post_msg(
+        "local", "remote", Message("algo", "xyz"), MSG_ALGO)
+    assert messaging_a.count_ext_msg.get("local") == 1
+    assert messaging_a.size_ext_msg.get("local", 0) > 0
+    # And it arrived on b's queue.
+    got = messaging_b.next_msg(0.5)
+    assert got is not None and got.msg.content == "xyz"
+
+
+def test_agent_metrics_cycle_counts():
+    from pydcop_tpu.algorithms import AlgorithmDef, ComputationDef
+    from pydcop_tpu.computations_graph import constraints_hypergraph as chg
+    from pydcop_tpu.dcop.objects import Domain, Variable
+    from pydcop_tpu.dcop.relations import constraint_from_str
+    from pydcop_tpu.infrastructure.agent_algorithms import DsaComputation
+
+    d = Domain("d", "", [0, 1])
+    v0, v1 = Variable("v0", d), Variable("v1", d)
+    c = constraint_from_str("c", "v0 + v1", [v0, v1])
+    cg = chg.build_computation_graph(
+        variables=[v0, v1], constraints=[c])
+    algo = AlgorithmDef.build_with_default_param("dsa", mode="min")
+    comp = DsaComputation(
+        ComputationDef(cg.computation("v0"), algo))
+
+    comm = InProcessCommunicationLayer()
+    agent = Agent("a1", comm)
+    agent.add_computation(comp)
+    metrics = agent.metrics()
+    assert metrics["cycles"]["v0"] == 0

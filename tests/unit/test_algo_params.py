@@ -1,0 +1,115 @@
+"""Algorithm plugin-contract tests: typed parameter validation,
+defaults injection, and discovery (reference
+algorithms/__init__.py:99-566, docs/implementation/algorithms.rst
+contract — previously untested here)."""
+
+import pytest
+
+from pydcop_tpu.algorithms import (
+    AlgoParameterDef,
+    AlgoParameterException,
+    AlgorithmDef,
+    check_param_value,
+    list_available_algorithms,
+    load_algorithm_module,
+    prepare_algo_params,
+)
+
+ALL_14 = [
+    "adsa", "amaxsum", "dba", "dpop", "dsa", "dsatuto", "gdba",
+    "maxsum", "maxsum_dynamic", "mgm", "mgm2", "mixeddsa", "ncbb",
+    "syncbb",
+]
+
+
+class TestCheckParamValue:
+    def test_none_returns_default(self):
+        p = AlgoParameterDef("damping", "float", None, 0.5)
+        assert check_param_value(None, p) == 0.5
+
+    def test_string_coercion_per_type(self):
+        assert check_param_value(
+            "7", AlgoParameterDef("x", "int", None, 0)) == 7
+        assert check_param_value(
+            "0.25", AlgoParameterDef("x", "float", None, 0.0)) == 0.25
+        assert check_param_value(
+            "true", AlgoParameterDef("x", "bool", None, False)) is True
+        assert check_param_value(
+            "no", AlgoParameterDef("x", "bool", None, True)) is False
+        assert check_param_value(
+            3, AlgoParameterDef("x", "str", None, "")) == "3"
+
+    def test_invalid_coercion_raises(self):
+        with pytest.raises(AlgoParameterException):
+            check_param_value(
+                "abc", AlgoParameterDef("x", "int", None, 0))
+        with pytest.raises(AlgoParameterException):
+            check_param_value(
+                "abc", AlgoParameterDef("x", "float", None, 0.0))
+
+    def test_allowed_values_enforced(self):
+        p = AlgoParameterDef("variant", "str", ["A", "B", "C"], "B")
+        assert check_param_value("A", p) == "A"
+        with pytest.raises(AlgoParameterException):
+            check_param_value("D", p)
+
+
+class TestPrepareAlgoParams:
+    DEFS = [
+        AlgoParameterDef("damping", "float", None, 0.5),
+        AlgoParameterDef("variant", "str", ["A", "B"], "B"),
+    ]
+
+    def test_defaults_filled(self):
+        out = prepare_algo_params({}, self.DEFS)
+        assert out == {"damping": 0.5, "variant": "B"}
+
+    def test_given_values_validated(self):
+        out = prepare_algo_params({"damping": "0.8"}, self.DEFS)
+        assert out["damping"] == 0.8
+
+    def test_unknown_param_raises(self):
+        with pytest.raises(AlgoParameterException) as exc:
+            prepare_algo_params({"dampign": 0.5}, self.DEFS)
+        assert "dampign" in str(exc.value)
+
+
+class TestPluginDiscovery:
+    def test_all_14_algorithms_discoverable(self):
+        available = list_available_algorithms()
+        for algo in ALL_14:
+            assert algo in available, algo
+
+    @pytest.mark.parametrize("algo", ALL_14)
+    def test_contract_defaults_injected(self, algo):
+        """Every module gets algo_params / communication_load /
+        computation_memory defaults and declares GRAPH_TYPE."""
+        module = load_algorithm_module(algo)
+        assert module.GRAPH_TYPE in (
+            "factor_graph", "constraints_hypergraph", "pseudotree",
+            "ordered_graph",
+        )
+        assert isinstance(module.algo_params, list)
+        assert callable(module.communication_load)
+        assert callable(module.computation_memory)
+
+    def test_build_with_default_param_validates(self):
+        with pytest.raises(AlgoParameterException):
+            AlgorithmDef.build_with_default_param(
+                "maxsum", {"no_such_param": 1})
+        ad = AlgorithmDef.build_with_default_param(
+            "maxsum", {"damping": "0.7"})
+        assert ad.params["damping"] == 0.7
+        assert ad.params["stability"] > 0  # default filled
+
+
+class TestAlgorithmDefRepr:
+    def test_simple_repr_roundtrip(self):
+        from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+        ad = AlgorithmDef.build_with_default_param(
+            "dsa", {"variant": "C"})
+        clone = from_repr(simple_repr(ad))
+        assert clone.algo == "dsa"
+        assert clone.params == ad.params
+        assert clone.mode == ad.mode

@@ -1,0 +1,142 @@
+"""HTTP transport retry / on_error / departed-agent tests (VERDICT #8:
+"HTTP retry/on_error modes" were untested; ADVICE round-1 item on
+retry-queue purging).
+
+Two real HttpCommunicationLayer servers on localhost with a stub
+discovery; delivery, retry-until-reachable, fail-fast, purge-on-removal
+and stale-namesake behavior are all observable through recorded
+receive_msg calls.
+"""
+
+import threading
+import time
+
+import pytest
+
+from pydcop_tpu.infrastructure.communication import (
+    MSG_ALGO,
+    ComputationMessage,
+    HttpCommunicationLayer,
+    UnreachableAgent,
+)
+from pydcop_tpu.infrastructure.computations import Message
+
+PORTS = iter(range(19410, 19470))
+
+
+class StubDiscovery:
+    def __init__(self):
+        self.addresses = {}
+
+    def agent_address(self, name):
+        return self.addresses[name]
+
+
+def _msg(content="x"):
+    return ComputationMessage(
+        "c_src", "c_dst", Message("test", content), MSG_ALGO)
+
+
+@pytest.fixture()
+def layers():
+    created = []
+
+    def make(name, discovery):
+        port = next(PORTS)
+        layer = HttpCommunicationLayer(("127.0.0.1", port))
+        layer.discovery = discovery
+        layer.RETRY_WINDOW = 5.0
+        layer.RETRY_INTERVAL = 0.1
+        received = []
+        done = threading.Event()
+
+        def record(src_agent, dest_agent, cmsg):
+            received.append((src_agent, dest_agent, cmsg))
+            done.set()
+
+        layer.receive_msg = record
+        created.append(layer)
+        return layer, received, done
+
+    yield make
+    for layer in created:
+        layer.shutdown()
+
+
+def test_delivery_roundtrip(layers):
+    disco = StubDiscovery()
+    a, _, _ = layers("a", disco)
+    b, received, done = layers("b", disco)
+    disco.addresses["b"] = b.address
+    a.send_msg("a", "b", _msg("hello"))
+    assert done.wait(5)
+    src, dest, cmsg = received[0]
+    assert (src, dest) == ("a", "b")
+    assert cmsg.msg.content == "hello"
+    assert cmsg.dest_comp == "c_dst"
+
+
+def test_on_error_fail_raises_for_unknown_agent(layers):
+    disco = StubDiscovery()
+    a, _, _ = layers("a", disco)
+    with pytest.raises(UnreachableAgent):
+        a.send_msg("a", "ghost", _msg(), on_error="fail")
+
+
+def test_retry_delivers_once_agent_becomes_known(layers):
+    """An undeliverable message parks in the retry queue and arrives
+    after discovery learns the destination (agents starting before
+    their orchestrator)."""
+    disco = StubDiscovery()
+    a, _, _ = layers("a", disco)
+    a.send_msg("a", "late", _msg("queued"))  # unknown -> queued
+    b, received, done = layers("b", disco)
+    disco.addresses["late"] = b.address
+    assert done.wait(5), "retry loop should deliver within the window"
+    assert received[0][2].msg.content == "queued"
+
+
+def test_removed_agent_purges_queue_and_drops_new_sends(layers):
+    disco = StubDiscovery()
+    a, _, _ = layers("a", disco)
+    a.send_msg("a", "gone", _msg())
+    assert a._retry_queue or a._retry_thread is not None
+    a.on_agent_change("agent_removed", "gone")
+    assert not a._retry_queue
+    # New sends to the departed agent are dropped immediately.
+    a.send_msg("a", "gone", _msg())
+    assert not a._retry_queue
+
+
+def test_readded_namesake_does_not_get_stale_messages(layers):
+    """Messages enqueued before an agent's removal must not reach a
+    re-added agent reusing the name."""
+    disco = StubDiscovery()
+    a, _, _ = layers("a", disco)
+    a.send_msg("a", "phoenix", _msg("stale"))
+    a.on_agent_change("agent_removed", "phoenix")
+    a.on_agent_change("agent_added", "phoenix")
+    b, received, done = layers("b", disco)
+    disco.addresses["phoenix"] = b.address
+    # Fresh message sent after the re-add is delivered...
+    a.send_msg("a", "phoenix", _msg("fresh"))
+    assert done.wait(5)
+    time.sleep(0.5)  # give the retry loop a chance to misbehave
+    contents = [c.msg.content for _, _, c in received]
+    assert "fresh" in contents
+    # ...but the pre-removal message was purged, not re-delivered.
+    assert "stale" not in contents
+
+
+def test_messages_to_unreachable_address_retry_then_drop(layers):
+    """A known address that never answers keeps retrying and is
+    dropped after RETRY_WINDOW without raising."""
+    disco = StubDiscovery()
+    a, _, _ = layers("a", disco)
+    a.RETRY_WINDOW = 0.4
+    disco.addresses["dead"] = ("127.0.0.1", 1)  # nothing listens
+    a.send_msg("a", "dead", _msg())
+    deadline = time.monotonic() + 5
+    while a._retry_queue and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert not a._retry_queue
