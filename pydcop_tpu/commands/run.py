@@ -28,6 +28,13 @@ def set_parser(subparsers):
                         help="scenario yaml file")
     parser.add_argument("-k", "--ktarget", type=int, default=3,
                         help="number of replicas per computation")
+    parser.add_argument("--repair", default="device",
+                        choices=["device", "distributed"],
+                        help="how the repair DCOP is solved on agent "
+                             "departure: centrally on the device "
+                             "engine (default) or distributed among "
+                             "the candidate agents (reference "
+                             "architecture)")
     parser.add_argument("-m", "--mode", default="thread",
                         choices=["thread", "device"],
                         help="execution mode: 'thread' = agent runtime "
@@ -94,6 +101,7 @@ def run_cmd(args) -> int:
         algo_def, cg, distribution, dcop, infinity=args.infinity,
         replication=True, collector=collector,
         collect_moment=args.collect_on, collect_period=args.period,
+        repair_mode=args.repair,
     )
     stopped = False
     try:
@@ -203,15 +211,19 @@ def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
     repaired = set()
     events_log = []
     last = engine.run(1, stop_on_convergence=False)
+    # Fractional chunk budgets carry over between delay events so the
+    # cycle count stays proportional to the scenario's timing while
+    # every segment reuses ONE compiled program of DEVICE_RUN_CHUNK
+    # cycles.
+    budget_acc = 0.0
     for event in scenario:
         if event.is_delay:
-            budget = max(
-                1, int(event.delay * DEVICE_CYCLES_PER_DELAY_SECOND))
-            # Whole chunks only (rounding the budget up): every
-            # segment then shares one compiled program.
-            for _ in range(-(-budget // DEVICE_RUN_CHUNK)):
+            budget_acc += max(
+                1.0, event.delay * DEVICE_CYCLES_PER_DELAY_SECOND)
+            while budget_acc >= DEVICE_RUN_CHUNK:
                 last = engine.run(
                     DEVICE_RUN_CHUNK, stop_on_convergence=False)
+                budget_acc -= DEVICE_RUN_CHUNK
             continue
         for action in event.actions or []:
             if action.type == "remove_agent":

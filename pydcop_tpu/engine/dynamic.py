@@ -213,30 +213,26 @@ class DynamicMaxSumEngine:
         new_vars = [
             v for v in c.dimensions if v.name not in self.var_index
         ]
-        if new_vars:
-            # One rebuild for all new variables AND the factor itself
-            # (growing the var tables changes shapes anyway).
-            for v in new_vars:
-                self.variables.append(v)
-                self.var_index[v.name] = len(self.variables) - 1
-            self.factors[c.name] = c
-            self._recompile_carrying_messages(
-                list(self.factors.values()))
-            return
+        # New variables grow the var tables (shape change), so the
+        # factor cannot take a slack row — register them and fall
+        # through to the shared recompile path (one rebuild total).
+        for v in new_vars:
+            self.variables.append(v)
+            self.var_index[v.name] = len(self.variables) - 1
         bi = self._arity_bucket.get(c.arity)
         fits = (
-            bi is not None and self._free.get(bi)
+            not new_vars
+            and bi is not None and self._free.get(bi)
             and all(len(v.domain) <= self.dmax for v in c.dimensions)
         )
+        self.factors[c.name] = c
         if fits:
             row = self._free[bi].pop(0)
             self._patch_bucket(bi, row, c)
             self.slots[c.name] = (bi, row)
-            self.factors[c.name] = c
             if self._state is not None:
                 self._state = self._zero_state_row(self._state, bi, row)
         else:
-            self.factors[c.name] = c
             self._recompile_carrying_messages(
                 list(self.factors.values()))
 

@@ -39,6 +39,8 @@ CycleChangeMessage = message_type(
 ComputationFinishedMessage = message_type(
     "computation_finished", ["agent", "computation"])
 AgentReadyMessage = message_type("agent_ready", ["agent", "address"])
+RemoveComputationsMessage = message_type(
+    "remove_computations", ["computations"])
 
 logger = logging.getLogger("pydcop.orchestratedagent")
 
@@ -93,6 +95,14 @@ class OrchestrationComputation(MessagePassingComputation):
         ]:
             if self.agent.has_computation(name):
                 self.agent.computation(name).pause(False)
+
+    @register("remove_computations")
+    def _on_remove_computations(self, sender, msg, t):
+        """Retire temporarily-hosted computations (e.g. repair-DCOP
+        variables once the repair round is decided)."""
+        for name in msg.computations:
+            if self.agent.has_computation(name):
+                self.agent.remove_computation(name)
 
     @register("stop_agent")
     def _on_stop(self, sender, msg, t):

@@ -66,6 +66,14 @@ class AgentsMgt(MessagePassingComputation):
         # (or refused) activating its replica.
         self.repair_acked: Dict[str, str] = {}
         self.repair_failed: Dict[str, str] = {}
+        # Temporarily-hosted computations (distributed repair rounds):
+        # names whose round has ENDED go to the retired set, and any
+        # in-flight value/finished message still in the queue for them
+        # is dropped on arrival — otherwise a late message re-inserts a
+        # purged repair variable into the assignment/finished sets
+        # permanently (and a later round reusing the name would read
+        # the stale value as a fresh result).
+        self.retired_transients: set = set()
 
     @register("agent_ready")
     def _on_agent_ready(self, sender, msg, t):
@@ -74,6 +82,8 @@ class AgentsMgt(MessagePassingComputation):
 
     @register("value_change")
     def _on_value_change(self, sender, msg, t):
+        if msg.computation in self.retired_transients:
+            return
         self.assignment[msg.computation] = msg.value
         self.cycles[msg.computation] = max(
             self.cycles.get(msg.computation, 0), msg.cycle
@@ -90,6 +100,8 @@ class AgentsMgt(MessagePassingComputation):
 
     @register("computation_finished")
     def _on_comp_finished(self, sender, msg, t):
+        if msg.computation in self.retired_transients:
+            return
         self.finished_computations.add(msg.computation)
         self.orchestrator._check_all_finished()
 
@@ -169,13 +181,23 @@ class Orchestrator:
                  infinity: float = float("inf"),
                  collector=None,
                  collect_moment: str = "value_change",
-                 collect_period: float = 1.0):
+                 collect_period: float = 1.0,
+                 repair_mode: str = "device"):
         self.algo = algo
         self.cg = cg
         self.distribution = agent_mapping
         self.dcop = dcop
         self.infinity = infinity
         self.status = "INIT"
+        # How the repair DCOP is solved on agent departure:
+        # "device" (default) solves it centrally on the device engine
+        # (TPU-first); "distributed" deploys the repair computations
+        # onto the candidate agents themselves and runs a bounded
+        # synchronous search among them — the reference's architecture
+        # (repair hosted in RepairComputation on candidate agents,
+        # pydcop/infrastructure/agents.py:1384, orchestrator.py:
+        # 1039-1178).
+        self.repair_mode = repair_mode
         # Run-metrics collection (reference solve.py:386-443): the
         # collector callable receives a metrics dict at each
         # value_change / cycle_change event or every collect_period
@@ -440,10 +462,12 @@ class Orchestrator:
         """Re-host orphaned computations on live replica holders.
 
         The repair problem is built as a DCOP (reparation builders) and
-        solved centrally on the device engine — the TPU-native stand-in
-        for the reference's distributed MaxSum repair (see
-        pydcop_tpu/reparation/__init__.py docstring).  Falls back to a
-        greedy assignment when the DCOP solve violates hard constraints.
+        solved per ``repair_mode``: centrally on the device engine (the
+        TPU-native default), or distributed among the candidate agents
+        themselves (``repair_mode="distributed"``, the reference's
+        architecture — repair computations hosted on candidates,
+        pydcop/infrastructure/agents.py:1384).  Falls back to a greedy
+        assignment when the solve violates hard constraints.
         """
         from pydcop_tpu.replication.dist_ucs_hostingcosts import (
             ActivateReplicaMessage,
@@ -675,21 +699,105 @@ class Orchestrator:
         except Exception:
             return 1.0
 
+    def _solve_repair_distributed(self, repair: DCOP, variables
+                                  ) -> Optional[Dict[str, Any]]:
+        """Solve the repair DCOP *among the candidate agents*: each
+        binary decision variable x_(comp, agent) is deployed on
+        `agent` itself, the group runs a bounded synchronous search,
+        and the orchestrator only collects the final values (reference
+        architecture: repair computations hosted on candidate agents,
+        pydcop/infrastructure/agents.py:1384)."""
+        from pydcop_tpu.algorithms import AlgorithmDef, ComputationDef
+        from pydcop_tpu.computations_graph import (
+            constraints_hypergraph as chg_mod,
+        )
+        from pydcop_tpu.infrastructure.orchestratedagents import (
+            RemoveComputationsMessage,
+        )
+
+        per_agent: Dict[str, List[str]] = {}
+        names = {var.name for var in variables.values()}
+        # A previous round may have retired the same variable names;
+        # re-arm them and drop any stale state BEFORE deploying.
+        self.mgt.retired_transients -= names
+        self.mgt.finished_computations -= names
+        for n in names:
+            self.mgt.assignment.pop(n, None)
+            self.mgt.cycles.pop(n, None)
+        try:
+            repair_cg = chg_mod.build_computation_graph(repair)
+            repair_algo = AlgorithmDef.build_with_default_param(
+                "dsa", {"stop_cycle": 30, "variant": "B"}, mode="min",
+            )
+            for (comp, agt), var in variables.items():
+                per_agent.setdefault(agt, []).append(var.name)
+                node = repair_cg.computation(var.name)
+                self.mgt.post_msg(
+                    f"_mgt_{agt}",
+                    DeployMessage(ComputationDef(node, repair_algo)),
+                    MSG_MGT,
+                )
+            for agt, comps in per_agent.items():
+                self.mgt.post_msg(
+                    f"_mgt_{agt}", RunAgentMessage(comps), MSG_MGT
+                )
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if names <= self.mgt.finished_computations:
+                    break
+                time.sleep(0.05)
+            assignment = {
+                n: self.mgt.assignment.get(n) for n in names
+            }
+            missing = [n for n, v in assignment.items() if v is None]
+            if missing:
+                logger.warning(
+                    "Distributed repair incomplete (no value for %s)",
+                    missing,
+                )
+                assignment = None
+            return assignment
+        finally:
+            for agt, comps in per_agent.items():
+                self.mgt.post_msg(
+                    f"_mgt_{agt}",
+                    RemoveComputationsMessage(comps), MSG_MGT,
+                )
+            # Purge repair bookkeeping so later events / final metrics
+            # never see the temporary computations — and retire the
+            # names so in-flight value/finished messages (e.g. a DSA
+            # straggler finishing right after the deadline) are dropped
+            # on arrival instead of re-inserting purged entries.
+            self.mgt.retired_transients |= names
+            self.mgt.finished_computations -= names
+            for n in names:
+                self.mgt.assignment.pop(n, None)
+                self.mgt.cycles.pop(n, None)
+
     def _assign_from_repair_solve(self, repair: DCOP, variables,
                                   orphaned, candidates
                                   ) -> Dict[str, str]:
         assignment = None
-        try:
-            from pydcop_tpu.api import solve as api_solve
+        if self.repair_mode == "distributed":
+            try:
+                assignment = self._solve_repair_distributed(
+                    repair, variables)
+            except Exception:
+                logger.exception(
+                    "Distributed repair failed; using greedy"
+                )
+        else:
+            try:
+                from pydcop_tpu.api import solve as api_solve
 
-            res = api_solve(
-                repair, "maxsum", backend="device", max_cycles=60,
-            )
-            assignment = res["assignment"]
-        except Exception:
-            logger.exception(
-                "Device solve of repair DCOP failed; using greedy"
-            )
+                res = api_solve(
+                    repair, "maxsum", backend="device", max_cycles=60,
+                )
+                assignment = res["assignment"]
+            except Exception:
+                logger.exception(
+                    "Device solve of repair DCOP failed; using greedy"
+                )
         placement: Dict[str, str] = {}
         if assignment is not None:
             for comp in orphaned:

@@ -46,6 +46,7 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                           collector=None,
                           collect_moment: str = "value_change",
                           collect_period: float = 1.0,
+                          repair_mode: str = "device",
                           ) -> Orchestrator:
     """One OrchestratedAgent thread per AgentDef + an orchestrator, all
     with in-process transports (reference run.py:145).  With
@@ -55,7 +56,7 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
     orchestrator = Orchestrator(
         algo, cg, distribution, comm, dcop, infinity,
         collector=collector, collect_moment=collect_moment,
-        collect_period=collect_period,
+        collect_period=collect_period, repair_mode=repair_mode,
     )
     orchestrator.start()
     hosting = {

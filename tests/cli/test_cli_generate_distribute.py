@@ -1,0 +1,141 @@
+"""CLI tests for `pydcop generate` and `pydcop distribute` (reference
+tests/dcop_cli covers these; ours previously exercised the generator
+functions only through the library, not the CLI surface)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+REF_INSTANCES = "/root/reference/tests/instances"
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def cli(args, timeout=120):
+    return subprocess.check_output(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli"] + args,
+        timeout=timeout, env=ENV,
+    ).decode()
+
+
+def _load_as_dcop(text):
+    from pydcop_tpu.dcop.yamldcop import load_dcop
+
+    return load_dcop(text)
+
+
+def test_generate_graph_coloring_yaml_roundtrips():
+    out = cli([
+        "generate", "graph_coloring", "-v", "12", "-c", "3",
+        "-g", "random", "-p", "0.3", "--seed", "1",
+        "--allow_subgraph",
+    ])
+    dcop = _load_as_dcop(out)
+    assert len(dcop.variables) == 12
+    assert dcop.constraints
+
+
+def test_generate_ising_grid():
+    out = cli([
+        "generate", "ising", "--row_count", "3", "--col_count", "3",
+        "--seed", "0",
+    ])
+    dcop = _load_as_dcop(out)
+    assert len(dcop.variables) == 9
+    # Grid ising: binary factors (right + down per cell, wrapping) and
+    # one unary factor per variable.
+    arities = [c.arity for c in dcop.constraints.values()]
+    assert arities.count(2) == 18
+    assert arities.count(1) == 9
+
+
+def test_generate_secp_structure():
+    out = cli([
+        "generate", "secp", "--lights", "4", "--models", "2",
+        "--rules", "2", "--seed", "3",
+    ])
+    dcop = _load_as_dcop(out)
+    names = set(dcop.variables)
+    assert {"l0", "l1", "l2", "l3", "m0", "m1"} <= names
+    # Agents carry the hosting-cost pinning convention.
+    a0 = dcop.agents["a0"]
+    assert a0.hosting_cost("l0") == 0
+    assert a0.hosting_cost("l1") > 0
+
+
+def test_generate_meetings():
+    out = cli([
+        "generate", "meetings", "--slots_count", "4",
+        "--events_count", "3", "--resources_count", "3",
+        "--max_resources_event", "2", "--seed", "0",
+    ])
+    dcop = _load_as_dcop(out)
+    assert dcop.variables and dcop.constraints
+
+
+def test_generate_scenario():
+    out = cli([
+        "generate", "scenario", "--evts_count", "3",
+        "--actions_count", "1", "--delay", "2",
+        "--initial_delay", "1", "--seed", "0",
+        "--dcop_files",
+        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+    ])
+    data = yaml.safe_load(out)
+    assert "events" in data
+    removes = [
+        a for e in data["events"] for a in e.get("actions", [])
+        if a["type"] == "remove_agent"
+    ]
+    assert removes
+
+
+@pytest.mark.parametrize("method", ["adhoc", "gh_cgdp", "ilp_compref"])
+def test_distribute_command_produces_full_distribution(method, tmp_path):
+    out = cli([
+        "distribute", "-d", method, "-a", "dsa",
+        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+    ])
+    data = json.loads(out)
+    dist = data["distribution"]
+    hosted = sorted(c for comps in dist.values() for c in comps)
+    assert hosted == sorted(f"v{i}" for i in range(10))
+    assert "cost" in data
+
+
+def test_distribute_respects_graph_for_maxsum():
+    """Factor-graph algo: distribution covers variables AND factors."""
+    out = cli([
+        "distribute", "-d", "adhoc", "-a", "maxsum",
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    data = json.loads(out)
+    hosted = sorted(
+        c for comps in data["distribution"].values() for c in comps)
+    assert "v1" in hosted
+    assert any(h.startswith("c") or h.startswith("pref") or "diff" in h
+               for h in hosted if h not in ("v1", "v2", "v3"))
+
+
+def test_solve_writes_run_metrics_csv(tmp_path):
+    metrics = tmp_path / "metrics.csv"
+    out = cli([
+        "-t", "6", "solve", "--algo", "dsa", "--mode", "thread",
+        "--collect_on", "cycle_change",
+        "--run_metrics", str(metrics),
+        "--algo_params", "stop_cycle:20",
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    result = json.loads(out)
+    assert result["status"] in ("FINISHED", "TIMEOUT")
+    lines = metrics.read_text().strip().splitlines()
+    # Header + at least one cycle row.
+    assert len(lines) >= 2
+    assert "cycle" in lines[0]

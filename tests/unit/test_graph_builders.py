@@ -1,0 +1,128 @@
+"""Computation-graph builder invariant tests: pseudotree DFS
+properties and ordered-graph total order (reference
+computations_graph/pseudotree.py:325-470, ordered_graph.py:119-182 —
+previously exercised only indirectly through dpop/syncbb solves)."""
+
+import numpy as np
+
+from pydcop_tpu.computations_graph import ordered_graph, pseudotree
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+
+D = Domain("d", "", [0, 1, 2])
+
+
+def _problem(n=8, seed=0, extra_edges=4):
+    rng = np.random.default_rng(seed)
+    vs = [Variable(f"v{i}", D) for i in range(n)]
+    cs = [
+        constraint_from_str(
+            f"c{i}", f"v{i} + v{i + 1}", [vs[i], vs[i + 1]])
+        for i in range(n - 1)
+    ]
+    k = 0
+    seen = {(i, i + 1) for i in range(n - 1)}
+    while k < extra_edges:
+        i, j = sorted(rng.choice(n, size=2, replace=False))
+        if (i, j) in seen:
+            continue
+        seen.add((i, j))
+        cs.append(constraint_from_str(
+            f"x{k}", f"v{i} * v{j}", [vs[i], vs[j]]))
+        k += 1
+    return vs, cs
+
+
+class TestPseudoTree:
+    def _tree(self, **kw):
+        vs, cs = _problem(**kw)
+        return (
+            pseudotree.build_computation_graph(
+                variables=vs, constraints=cs),
+            vs, cs,
+        )
+
+    def test_single_root_and_parent_links(self):
+        tree, vs, _ = self._tree()
+        roots = tree.roots
+        assert len(roots) == 1
+        for node in tree.nodes:
+            if node.is_root:
+                assert node.parent is None
+            else:
+                assert node.parent is not None
+                parent = tree.computation(node.parent)
+                assert node.name in parent.children
+
+    def test_every_variable_is_a_node(self):
+        tree, vs, _ = self._tree()
+        assert sorted(n.name for n in tree.nodes) == sorted(
+            v.name for v in vs)
+
+    def test_dfs_property_constraints_on_ancestor_path(self):
+        """Pseudo-tree invariant: every constraint's variables lie on
+        one root-to-leaf path (neighbors are ancestors/descendants,
+        never in different branches)."""
+        tree, vs, cs = self._tree(seed=3, extra_edges=6)
+
+        def ancestors(name):
+            out = set()
+            node = tree.computation(name)
+            while node.parent is not None:
+                out.add(node.parent)
+                node = tree.computation(node.parent)
+            return out
+
+        for c in cs:
+            names = [v.name for v in c.dimensions]
+            for a in names:
+                for b in names:
+                    if a == b:
+                        continue
+                    assert (
+                        b in ancestors(a) or a in ancestors(b)
+                    ), f"{a} and {b} ({c.name}) are in different branches"
+
+    def test_pseudo_parent_links_symmetry(self):
+        tree, _, _ = self._tree(seed=5, extra_edges=6)
+        for node in tree.nodes:
+            for pp in node.pseudo_parents:
+                assert node.name in tree.computation(pp).pseudo_children
+
+    def test_depths_consistent(self):
+        tree, _, _ = self._tree()
+        depths = pseudotree.node_depths(tree)
+        for node in tree.nodes:
+            if node.is_root:
+                assert depths[node.name] == 0
+            else:
+                assert depths[node.name] == depths[node.parent] + 1
+
+
+class TestOrderedGraph:
+    def test_lexical_total_order(self):
+        vs, cs = _problem(n=5)
+        og = ordered_graph.build_computation_graph(
+            variables=vs, constraints=cs)
+        nodes = {n.name: n for n in og.nodes}
+        # Lexical order: v0 first (no previous), v4 last (no next).
+        chain = []
+        current = next(
+            n for n in og.nodes if n.previous_node is None)
+        while current is not None:
+            chain.append(current.name)
+            current = (
+                nodes[current.next_node]
+                if current.next_node else None
+            )
+        assert chain == sorted(v.name for v in vs)
+
+    def test_constraints_attached_to_nodes(self):
+        vs, cs = _problem(n=5)
+        og = ordered_graph.build_computation_graph(
+            variables=vs, constraints=cs)
+        attached = set()
+        for node in og.nodes:
+            for c in node.constraints:
+                attached.add(c.name)
+        assert attached == {c.name for c in cs}
