@@ -72,6 +72,12 @@ def run_cmd(args) -> int:
     algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
 
     if args.mode == "device":
+        if args.repair != "device":
+            logger.warning(
+                "--repair %s is an agent-mode option; device-mode runs "
+                "re-home departed agents' computations directly "
+                "(ignored)", args.repair,
+            )
         return _run_device_cmd(args, dcop, scenario, algo_def)
     algo_module = load_algorithm_module(algo_def.algo)
     # -c bounds algorithms exposing a stop_cycle parameter (same

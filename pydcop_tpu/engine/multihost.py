@@ -1,0 +1,91 @@
+"""Multi-host (DCN) initialization for the device engine.
+
+Single-host scaling rides ICI through the one-axis mesh in
+engine/sharding.py.  Scaling past one host uses JAX's distributed
+runtime: every host calls :func:`initialize_multihost` before any jax
+call, after which ``jax.devices()`` returns the GLOBAL device list and
+the same ``make_mesh()`` / ``shard_graph()`` code paths shard buckets
+across hosts — XLA routes the per-superstep all-reduce over ICI within
+a slice and DCN across slices.  No engine code changes: the mesh is
+just bigger.
+
+This replaces the reference's multi-machine story (one agent process
+per machine + JSON-over-HTTP, pydcop/commands/agent.py +
+orchestrator.py) for the *data plane*; the HTTP stack remains for
+agent-mode deployments and control-plane traffic.
+
+Environment conventions (standard jax.distributed):
+- ``PYDCOP_COORDINATOR`` — "host:port" of process 0,
+- ``PYDCOP_NUM_PROCESSES`` / ``PYDCOP_PROCESS_ID`` — world size / rank,
+- ``PYDCOP_MULTIHOST=auto`` — call ``jax.distributed.initialize()``
+  with no arguments, letting it auto-detect the topology (TPU pods).
+With none of these set the initializer is a silent single-host no-op,
+so the same entry points work everywhere.
+"""
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("pydcop.multihost")
+
+_initialized = False
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Join the JAX distributed runtime (idempotent).
+
+    Arguments default to the ``PYDCOP_*`` environment variables; set
+    ``PYDCOP_MULTIHOST=auto`` on TPU pod slices to use
+    jax.distributed's no-argument topology auto-detection.  Returns
+    True when running distributed (more than one process), False for
+    plain single-host runs (nothing configured — a silent no-op).
+    """
+    global _initialized
+    if _initialized:
+        import jax
+
+        return jax.process_count() > 1
+
+    coordinator_address = (
+        coordinator_address or os.environ.get("PYDCOP_COORDINATOR")
+    )
+    if num_processes is None and "PYDCOP_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PYDCOP_NUM_PROCESSES"])
+    if process_id is None and "PYDCOP_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PYDCOP_PROCESS_ID"])
+
+    import jax
+
+    if coordinator_address is None and num_processes is None:
+        if os.environ.get("PYDCOP_MULTIHOST") == "auto":
+            # TPU pod: no-arg initialize auto-detects the topology.
+            jax.distributed.initialize()
+            _initialized = True
+            return jax.process_count() > 1
+        # Single-host: nothing to join.
+        _initialized = True
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "Joined distributed runtime: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(),
+        len(jax.devices()),
+    )
+    return jax.process_count() > 1
+
+
+def global_mesh(n_devices: Optional[int] = None):
+    """A mesh over the global (cross-host) device list; call
+    :func:`initialize_multihost` first on every host."""
+    from pydcop_tpu.engine.sharding import make_mesh
+
+    return make_mesh(n_devices)

@@ -67,13 +67,21 @@ class AgentsMgt(MessagePassingComputation):
         self.repair_acked: Dict[str, str] = {}
         self.repair_failed: Dict[str, str] = {}
         # Temporarily-hosted computations (distributed repair rounds):
-        # names whose round has ENDED go to the retired set, and any
-        # in-flight value/finished message still in the queue for them
-        # is dropped on arrival — otherwise a late message re-inserts a
-        # purged repair variable into the assignment/finished sets
-        # permanently (and a later round reusing the name would read
-        # the stale value as a fresh result).
+        # while a round runs its names sit in active_transients (their
+        # reports are recorded but excluded from metrics collection);
+        # when the round ends they move to retired_transients and any
+        # in-flight message still queued for them is dropped on
+        # arrival — otherwise a late message would re-insert a purged
+        # repair variable into assignment/cycles/finished permanently.
+        self.active_transients: set = set()
         self.retired_transients: set = set()
+
+    def purge_computations(self, names) -> None:
+        """Forget all bookkeeping for the given computation names."""
+        self.finished_computations -= set(names)
+        for n in names:
+            self.assignment.pop(n, None)
+            self.cycles.pop(n, None)
 
     @register("agent_ready")
     def _on_agent_ready(self, sender, msg, t):
@@ -88,14 +96,20 @@ class AgentsMgt(MessagePassingComputation):
         self.cycles[msg.computation] = max(
             self.cycles.get(msg.computation, 0), msg.cycle
         )
+        if msg.computation in self.active_transients:
+            return  # repair-internal: keep out of the metrics stream
         self.orchestrator._on_progress()
         self.orchestrator._collect("value_change")
 
     @register("cycle_change")
     def _on_cycle_change(self, sender, msg, t):
+        if msg.computation in self.retired_transients:
+            return
         self.cycles[msg.computation] = max(
             self.cycles.get(msg.computation, 0), msg.cycle
         )
+        if msg.computation in self.active_transients:
+            return
         self.orchestrator._collect("cycle_change")
 
     @register("computation_finished")
@@ -596,7 +610,12 @@ class Orchestrator:
         )
 
         agent_defs = self.dcop.agents
-        variables = create_binary_variables_for(orphaned, candidates)
+        # Round-unique variable names: stale messages from a previous
+        # (timed-out) distributed round target names that no longer
+        # exist, so they can never be misread as this round's result.
+        self._repair_round = getattr(self, "_repair_round", 0) + 1
+        variables = create_binary_variables_for(
+            orphaned, candidates, suffix=f"__r{self._repair_round}")
         repair = DCOP("_repair", objective="min")
         for var in variables.values():
             repair.add_variable(var)
@@ -717,13 +736,10 @@ class Orchestrator:
 
         per_agent: Dict[str, List[str]] = {}
         names = {var.name for var in variables.values()}
-        # A previous round may have retired the same variable names;
-        # re-arm them and drop any stale state BEFORE deploying.
-        self.mgt.retired_transients -= names
-        self.mgt.finished_computations -= names
-        for n in names:
-            self.mgt.assignment.pop(n, None)
-            self.mgt.cycles.pop(n, None)
+        # Active transients: reported values/cycles are recorded (the
+        # round's result) but excluded from metrics collection and
+        # progress events while the round runs.
+        self.mgt.active_transients |= names
         try:
             repair_cg = chg_mod.build_computation_graph(repair)
             repair_algo = AlgorithmDef.build_with_default_param(
@@ -763,16 +779,13 @@ class Orchestrator:
                     f"_mgt_{agt}",
                     RemoveComputationsMessage(comps), MSG_MGT,
                 )
-            # Purge repair bookkeeping so later events / final metrics
-            # never see the temporary computations — and retire the
-            # names so in-flight value/finished messages (e.g. a DSA
-            # straggler finishing right after the deadline) are dropped
-            # on arrival instead of re-inserting purged entries.
+            # Retire the names (straggler messages are dropped on
+            # arrival — with round-unique names a later round can never
+            # collide with them) and purge the round's bookkeeping so
+            # final metrics never see the temporary computations.
+            self.mgt.active_transients -= names
             self.mgt.retired_transients |= names
-            self.mgt.finished_computations -= names
-            for n in names:
-                self.mgt.assignment.pop(n, None)
-                self.mgt.cycles.pop(n, None)
+            self.mgt.purge_computations(names)
 
     def _assign_from_repair_solve(self, repair: DCOP, variables,
                                   orphaned, candidates

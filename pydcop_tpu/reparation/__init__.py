@@ -30,19 +30,27 @@ from pydcop_tpu.dcop.relations import Constraint, NAryFunctionRelation
 DEFAULT_INFINITY = 10_000
 
 
-def binary_variable_name(computation: str, agent: str) -> str:
-    return f"x_{computation}_{agent}"
+def binary_variable_name(computation: str, agent: str,
+                         suffix: str = "") -> str:
+    return f"x_{computation}_{agent}{suffix}"
 
 
 def create_binary_variables_for(
-    orphaned: Iterable[str], candidates: Dict[str, List[str]]
+    orphaned: Iterable[str], candidates: Dict[str, List[str]],
+    suffix: str = "",
 ) -> Dict[Tuple[str, str], BinaryVariable]:
-    """One x_c_a variable per (orphaned computation, candidate agent)."""
+    """One x_c_a variable per (orphaned computation, candidate agent).
+
+    ``suffix`` makes names unique per repair round (e.g. "__r3"):
+    distributed repair deploys these as live computations, and
+    round-unique names make any straggler message from a previous
+    round unroutable by construction.
+    """
     variables = {}
     for comp in orphaned:
         for agent in candidates[comp]:
             variables[(comp, agent)] = BinaryVariable(
-                binary_variable_name(comp, agent)
+                binary_variable_name(comp, agent, suffix)
             )
     return variables
 

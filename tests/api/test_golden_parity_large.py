@@ -1,0 +1,113 @@
+"""Golden parity beyond the brute-force cap (VERDICT weak #8: only
+small fixtures were proven optimal).
+
+Ground truth for larger problems comes from structure, not
+enumeration: DPOP is exact on any problem, and on TREES MaxSum (belief
+propagation) and SyncBB are exact too.  Random trees of 60+ variables
+(search space ~4^60, far beyond enumeration) therefore give exact
+optimality assertions for three independent implementations against
+each other — plus device-vs-thread parity for dpop's tensorized path.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+
+def random_tree_dcop(n_vars: int, d: int, seed: int) -> DCOP:
+    """Random tree: each node i>0 links to a random earlier node with a
+    random cost table — DPOP-exact and BP-exact by structure."""
+    rng = np.random.default_rng(seed)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP(f"tree{n_vars}_{seed}", objective="min")
+    variables = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    for i in range(1, n_vars):
+        j = int(rng.integers(0, i))
+        table = rng.integers(0, 20, size=(d, d)).astype(np.float64)
+        dcop.add_constraint(NAryMatrixRelation(
+            [variables[j], variables[i]], table, f"c{i}"))
+    dcop.add_agents(
+        [AgentDef(f"a{k}", capacity=10_000) for k in range(4)])
+    return dcop
+
+
+SEEDS = [0, 1, 2]
+
+
+@pytest.fixture(scope="module")
+def tree_optima():
+    """DPOP (exact) optimum per seed — ground truth for the others."""
+    out = {}
+    for seed in SEEDS:
+        dcop = random_tree_dcop(60, 4, seed)
+        res = solve(dcop, "dpop", backend="device")
+        out[seed] = (dcop, res["cost"])
+    return out
+
+
+def test_dpop_deterministic_across_runs(tree_optima):
+    for seed, (dcop, cost) in tree_optima.items():
+        res = solve(
+            random_tree_dcop(60, 4, seed), "dpop", backend="device")
+        assert res["cost"] == cost
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_maxsum_exact_on_trees(tree_optima, seed):
+    """Belief propagation is exact on acyclic graphs: device MaxSum
+    must hit DPOP's optimum on every tree.  The default stability
+    (0.1) freezes edges via send-suppression before the messages reach
+    the exact fixpoint (reference semantics), so exactness requires a
+    tight stability threshold."""
+    dcop, optimum = tree_optima[seed]
+    res = solve(
+        random_tree_dcop(60, 4, seed), "maxsum", backend="device",
+        max_cycles=300,
+        algo_params={"noise": 0.001, "stability": 1e-6},
+    )
+    assert res["cost"] == pytest.approx(optimum, abs=1e-4)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_syncbb_matches_dpop_on_smaller_tree(seed):
+    """SyncBB (complete search) equals DPOP on a 14-var tree — still
+    ~4^14 = 2.7e8 states, three orders past the brute-force cap."""
+    dcop1 = random_tree_dcop(14, 4, seed)
+    r_dpop = solve(dcop1, "dpop", backend="device")
+    dcop2 = random_tree_dcop(14, 4, seed)
+    r_bb = solve(dcop2, "syncbb", backend="device")
+    assert r_bb["cost"] == pytest.approx(r_dpop["cost"])
+
+
+def test_dpop_thread_matches_device(tree_optima):
+    """The tensorized UTIL/VALUE sweeps and the agent-mode DPOP
+    computations must produce the same exact optimum."""
+    seed = SEEDS[0]
+    _, optimum = tree_optima[seed]
+    dcop = random_tree_dcop(60, 4, seed)
+    res = solve(
+        dcop, "dpop", backend="thread", timeout=30,
+        distribution="adhoc",
+    )
+    assert res["cost"] == pytest.approx(optimum)
+
+
+def test_local_search_bounded_by_optimum(tree_optima):
+    """Sanity: approximate local search never beats the exact optimum
+    (would indicate cost-accounting divergence), and lands within a
+    finite band of it."""
+    seed = SEEDS[0]
+    dcop, optimum = tree_optima[seed]
+    res = solve(
+        random_tree_dcop(60, 4, seed), "dsa", backend="device",
+        max_cycles=150,
+    )
+    assert res["cost"] >= optimum - 1e-9
+    n_constraints = 59
+    assert res["cost"] <= optimum + 10 * n_constraints

@@ -126,3 +126,50 @@ class TestScrubbedCpuEnv:
         env = scrubbed_cpu_env(base=base)
         assert env["XLA_FLAGS"] == "--foo=1"
         assert env["JAX_PLATFORMS"] == "cpu"
+
+class TestMultihost:
+    def test_single_host_noop(self, monkeypatch):
+        """Without a coordinator the initializer is a silent no-op and
+        the global mesh equals the local one."""
+        import pydcop_tpu.engine.multihost as mh
+
+        monkeypatch.setattr(mh, "_initialized", False)
+        monkeypatch.delenv("PYDCOP_COORDINATOR", raising=False)
+        monkeypatch.delenv("PYDCOP_NUM_PROCESSES", raising=False)
+        assert mh.initialize_multihost() is False
+        mesh = mh.global_mesh(4)
+        assert mesh.size == 4
+
+    def test_idempotent(self, monkeypatch):
+        import pydcop_tpu.engine.multihost as mh
+
+        monkeypatch.setattr(mh, "_initialized", False)
+        monkeypatch.delenv("PYDCOP_COORDINATOR", raising=False)
+        mh.initialize_multihost()
+        # Second call must not try to re-join (jax.distributed raises
+        # on double init); single-host path reports process_count()==1.
+        assert mh.initialize_multihost() is False
+
+    def test_env_var_plumbing(self, monkeypatch):
+        """Env vars reach jax.distributed.initialize verbatim."""
+        import pydcop_tpu.engine.multihost as mh
+
+        monkeypatch.setattr(mh, "_initialized", False)
+        monkeypatch.setenv("PYDCOP_COORDINATOR", "10.0.0.1:1234")
+        monkeypatch.setenv("PYDCOP_NUM_PROCESSES", "2")
+        monkeypatch.setenv("PYDCOP_PROCESS_ID", "1")
+        calls = {}
+
+        import jax
+
+        def fake_init(coordinator_address=None, num_processes=None,
+                      process_id=None):
+            calls.update(
+                addr=coordinator_address, n=num_processes,
+                pid=process_id,
+            )
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        assert mh.initialize_multihost() is True
+        assert calls == {"addr": "10.0.0.1:1234", "n": 2, "pid": 1}
