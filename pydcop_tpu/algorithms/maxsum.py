@@ -41,6 +41,12 @@ algo_params = [
     AlgoParameterDef(
         "start_messages", "str", ["leafs", "leafs_vars", "all"], "all"
     ),
+    # Device-path extension beyond the reference: decimation
+    # (arXiv:1706.02209) — alternate message passing with clamping the
+    # most confident variables, warm-restarting between rounds.  0
+    # disables (reference behavior); > 0 enables with that fraction
+    # (in %) of variables fixed per round.
+    AlgoParameterDef("decimation", "int", None, 0),
 ]
 
 
@@ -62,12 +68,11 @@ def build_computation(comp_def):
     return build_algo_computation("maxsum", comp_def)
 
 
-def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
-                    max_cycles: int = 1000, mesh=None,
-                    n_devices: Optional[int] = None,
-                    stop_on_convergence: bool = True) -> DeviceRunResult:
-    """Batched BSP MaxSum on TPU/CPU devices."""
-    params = algo_def.params
+def build_engine(dcop: DCOP, params: dict, mesh=None,
+                 n_devices: Optional[int] = None) -> MaxSumEngine:
+    """Compile + construct the engine from validated algo params — the
+    single place the parameter->engine wiring lives (solve_on_device
+    and the CLI's device-mode trace reconstruction both use it)."""
     pad_to = 1
     if mesh is not None:
         pad_to = mesh.size
@@ -76,13 +81,27 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
     graph, meta = compile_dcop(
         dcop, noise_level=params.get("noise", 0.01), pad_to=pad_to
     )
-    engine = MaxSumEngine(
+    return MaxSumEngine(
         graph, meta,
         damping=params.get("damping", 0.5),
         damping_nodes=params.get("damping_nodes", "both"),
         stability=params.get("stability", STABILITY_COEFF),
         mesh=mesh, n_devices=n_devices,
     )
+
+
+def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
+                    max_cycles: int = 1000, mesh=None,
+                    n_devices: Optional[int] = None,
+                    stop_on_convergence: bool = True) -> DeviceRunResult:
+    """Batched BSP MaxSum on TPU/CPU devices."""
+    params = algo_def.params
+    engine = build_engine(dcop, params, mesh=mesh, n_devices=n_devices)
+    decimation = int(params.get("decimation", 0) or 0)
+    if decimation > 0:
+        return engine.run_decimated(
+            max_cycles=max_cycles, frac=decimation / 100.0,
+        )
     return engine.run(
         max_cycles=max_cycles, stop_on_convergence=stop_on_convergence
     )

@@ -55,6 +55,11 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                 f"Algorithm {algo_def.algo} has no device path; use "
                 "backend='thread'"
             )
+        # Join the cross-host runtime when configured (PYDCOP_* env
+        # vars / PYDCOP_MULTIHOST=auto); single-host runs no-op.
+        from pydcop_tpu.engine.multihost import initialize_multihost
+
+        initialize_multihost()
         t0 = time.perf_counter()
         res = module.solve_on_device(
             dcop, algo_def, max_cycles=max_cycles, mesh=mesh,

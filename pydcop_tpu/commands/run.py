@@ -182,6 +182,9 @@ def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
     from pydcop_tpu.algorithms import load_algorithm_module
     from pydcop_tpu.computations_graph import load_graph_module
     from pydcop_tpu.engine.dynamic import DynamicMaxSumEngine
+    from pydcop_tpu.engine.multihost import initialize_multihost
+
+    initialize_multihost()
     from pydcop_tpu.infrastructure.run import _build_distribution
 
     if algo_def.algo not in ("maxsum", "amaxsum", "maxsum_dynamic"):

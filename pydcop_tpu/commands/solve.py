@@ -76,6 +76,32 @@ def run_cmd(args) -> int:
             "compile_time": res["compile_time"],
             "backend": "device",
         }
+        # Device-mode cycle metrics: the whole solve is one XLA
+        # program, so per-cycle rows come from a cost-trace run
+        # (MaxSumEngine.run_trace) written post-hoc with the same CSV
+        # schema thread mode streams live.  Decimated solves have no
+        # equivalent single trace (host-driven clamping rounds), so
+        # they only get the final summary row.
+        if (args.run_metrics and args.collect_on == "cycle_change"
+                and algo_def.algo in ("maxsum", "amaxsum")
+                and not algo_def.params.get("decimation")):
+            from pydcop_tpu.algorithms.maxsum import build_engine
+            from pydcop_tpu.commands.metrics_io import add_csvline
+
+            trace_res = build_engine(
+                dcop, algo_def.params
+            ).run_trace(max_cycles=max(res["cycles"], 1))
+            for i, cost in enumerate(
+                    trace_res.metrics["cost_trace"]):
+                add_csvline(args.run_metrics, "cycle_change", {
+                    "time": None,
+                    "cycle": i + 1,
+                    "cost": float(cost),
+                    "violation": None,
+                    "msg_count": None,
+                    "msg_size": None,
+                    "status": "RUNNING",
+                })
     else:
         # Algorithms without a termination condition would run forever:
         # bound thread/process runs when no explicit timeout was given.

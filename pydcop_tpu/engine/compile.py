@@ -89,6 +89,10 @@ class FactorGraphMeta(NamedTuple):
     bucket_sizes: Tuple[int, ...]       # real (unpadded) factors per bucket
     mode: str                           # 'min' or 'max'
     constant_cost: float = 0.0          # folded zero-ary constraints
+    # [V, Dmax] sign-adjusted variable costs WITHOUT tie-breaking
+    # noise (zeros on domain padding) — what DCOP.solution_cost
+    # charges for variable-side costs; used by cost traces.
+    var_base_costs: Optional[np.ndarray] = None
 
     def assignment_from_indices(self, idx: Sequence[int]) -> Dict:
         return {
@@ -134,9 +138,11 @@ def compile_factor_graph(
     # Variable cost table (+ sentinel row for padding edges).
     var_costs = np.full((v_count + 1, dmax), BIG, dtype=dtype)
     var_valid = np.zeros((v_count + 1, dmax), dtype=bool)
+    var_base = np.zeros((v_count, dmax), dtype=dtype)
     for i, v in enumerate(variables):
         d = len(v.domain)
         costs = sign * v.cost_vector()[:d]
+        var_base[i, :d] = costs
         if noise_level:
             costs = costs + _stable_noise(v.name, d, noise_level, noise_seed)
         var_costs[i, :d] = costs
@@ -183,6 +189,7 @@ def compile_factor_graph(
         bucket_sizes=tuple(bucket_sizes),
         mode=mode,
         constant_cost=constant_cost,
+        var_base_costs=var_base,
     )
     return compiled, meta
 

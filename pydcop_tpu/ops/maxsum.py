@@ -274,11 +274,21 @@ def assignment_constraint_cost(graph: CompiledFactorGraph,
 def run_maxsum_trace(graph: CompiledFactorGraph, max_cycles: int, *,
                      damping: float = 0.5, damp_vars: bool = True,
                      damp_factors: bool = True, stability: float = 0.1,
+                     var_base_costs=None,
                      ) -> Tuple[MaxSumState, jnp.ndarray, jnp.ndarray]:
     """Like run_maxsum without convergence stop, additionally recording
-    the constraint cost of the selected assignment after every cycle
+    the cost of the selected assignment after every cycle
     ([max_cycles] array) — the cost-vs-cycle curve used for
-    time-to-equal-cost benchmark claims."""
+    time-to-equal-cost benchmark claims.  ``var_base_costs`` ([V, D],
+    noise-free variable costs) makes the trace match
+    ``DCOP.solution_cost`` on problems with variable-side costs."""
+
+    def cost_of(values):
+        cost = assignment_constraint_cost(graph, values)
+        if var_base_costs is not None:
+            cost = cost + jnp.sum(jnp.take_along_axis(
+                var_base_costs, values[:, None], axis=1))
+        return cost
 
     def step(state, _):
         state = superstep(
@@ -287,7 +297,7 @@ def run_maxsum_trace(graph: CompiledFactorGraph, max_cycles: int, *,
         )
         beliefs, _ = aggregate_beliefs(graph, state.f2v)
         values = select_values(graph, beliefs)
-        return state, assignment_constraint_cost(graph, values)
+        return state, cost_of(values)
 
     state, costs = jax.lax.scan(
         step, init_state(graph), None, length=max_cycles

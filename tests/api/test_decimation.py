@@ -1,0 +1,99 @@
+"""MaxSum decimation tests (device-path extension beyond the
+reference, arXiv:1706.02209): alternating message passing with
+clamping the most confident variables must substantially improve
+solution quality on loopy graphs, where plain MaxSum oscillates.
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+
+def loopy_coloring(n: int, seed: int, density: float = 2.2) -> DCOP:
+    rng = np.random.default_rng(seed)
+    dom = Domain("c", "", [0, 1, 2])
+    dcop = DCOP(f"loopy{n}_{seed}", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    eq = np.eye(3)
+    seen, k = set(), 0
+    while k < int(n * density):
+        i, j = rng.choice(n, 2, replace=False)
+        key = (min(i, j), max(i, j))
+        if key in seen:
+            continue
+        seen.add(key)
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[i], vs[j]], eq, f"c{k}"))
+        k += 1
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def test_decimation_beats_plain_maxsum_on_loopy_graphs():
+    """Aggregate over seeded dense instances: decimated MaxSum ends
+    with far fewer conflicts (measured: plain ~20-30 vs decimated <=5
+    per 150-var/330-edge instance)."""
+    plain_costs, dec_costs = [], []
+    for seed in (1, 2):
+        plain = solve(
+            loopy_coloring(150, seed), "maxsum", backend="device",
+            max_cycles=400)
+        plain_costs.append(plain["cost"])
+        dec = solve(
+            loopy_coloring(150, seed), "maxsum", backend="device",
+            max_cycles=3000, algo_params={"decimation": 10})
+        dec_costs.append(dec["cost"])
+    assert np.mean(dec_costs) < np.mean(plain_costs)
+    assert np.mean(dec_costs) <= 8
+
+
+def test_decimation_fixes_every_variable():
+    res = solve(
+        loopy_coloring(40, 0), "maxsum", backend="device",
+        max_cycles=2000, algo_params={"decimation": 20})
+    assert res["status"] == "FINISHED"
+    assert res["metrics"]["decimated_vars"] == 40
+    assert len(res["assignment"]) == 40
+
+
+def test_decimation_zero_is_reference_behavior():
+    """decimation:0 (the default) must leave the plain engine path
+    untouched — same cost as not passing the parameter at all."""
+    r1 = solve(
+        loopy_coloring(60, 3), "maxsum", backend="device",
+        max_cycles=200)
+    r2 = solve(
+        loopy_coloring(60, 3), "maxsum", backend="device",
+        max_cycles=200, algo_params={"decimation": 0})
+    assert r1["cost"] == r2["cost"]
+    assert r1["assignment"] == r2["assignment"]
+
+
+def test_decimation_exact_on_trees():
+    """On a tree, decimation must not hurt: BP is already exact, and
+    clamping confident variables keeps the optimum."""
+    rng = np.random.default_rng(5)
+    dom = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("tree", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(30)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(1, 30):
+        j = int(rng.integers(0, i))
+        table = rng.integers(0, 9, size=(3, 3)).astype(float)
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[j], vs[i]], table, f"c{i}"))
+    dcop.add_agents([AgentDef("a0")])
+    exact = solve(dcop, "dpop", backend="device")
+    dec = solve(
+        dcop, "maxsum", backend="device", max_cycles=3000,
+        algo_params={"decimation": 10, "stability": 1e-6,
+                     "noise": 0.001},
+    )
+    assert dec["cost"] == pytest.approx(exact["cost"], abs=1e-4)

@@ -139,3 +139,30 @@ def test_solve_writes_run_metrics_csv(tmp_path):
     # Header + at least one cycle row.
     assert len(lines) >= 2
     assert "cycle" in lines[0]
+
+def test_device_solve_writes_cycle_metrics(tmp_path):
+    """Device mode produces the same per-cycle CSV schema thread mode
+    streams live, reconstructed from the engine's cost trace."""
+    metrics = tmp_path / "device_metrics.csv"
+    out = cli([
+        "solve", "--algo", "maxsum", "--mode", "device",
+        "--cycles", "40",
+        "--collect_on", "cycle_change",
+        "--run_metrics", str(metrics),
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    result = json.loads(out)
+    assert result["backend"] == "device"
+    lines = metrics.read_text().strip().splitlines()
+    # Header + one row per cycle + the final summary row.
+    assert len(lines) >= result["cycle"] + 1
+    header = lines[0].split(",")
+    assert "cycle" in header and "cost" in header
+    # Costs in the trace end at the reported final cost.
+    import csv as _csv
+
+    rows = list(_csv.DictReader(metrics.read_text().splitlines()))
+    cycle_rows = [r for r in rows if r["status"] == "RUNNING"]
+    # f32 device trace vs f64 host cost: approximate equality.
+    assert float(cycle_rows[-1]["cost"]) == pytest.approx(
+        result["cost"], abs=1e-5)

@@ -58,3 +58,36 @@ def test_orchestrator_and_agent_commands(tmp_path):
     finally:
         if agent_proc.poll() is None:
             agent_proc.kill()
+
+
+def test_solve_mode_process_maxsum():
+    """MaxSum over HTTP: factor/variable computations and their custom
+    wire format (MaxSumMessage costs dict) cross real process + JSON
+    boundaries."""
+    out = subprocess.check_output(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "8",
+         "solve", "-a", "maxsum", "-d", "adhoc", "-m", "process",
+         os.path.join(REF_INSTANCES, "graph_coloring1.yaml")],
+        timeout=180, env=ENV,
+    )
+    result = json.loads(out)
+    assert result["backend"] == "process"
+    assert set(result["assignment"]) == {"v1", "v2", "v3"}
+    # Converged to a feasible coloring of the 3-chain.
+    assert result["cost"] in (-0.1, 0.1)
+
+
+def test_solve_mode_process_mgm2():
+    """MGM2's 5-phase protocol (value/offer/response/gain/go) over the
+    HTTP transport: offers are tuple-triples that JSON converts to
+    lists, so this exercises sequence-robust message handling."""
+    out = subprocess.check_output(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "10",
+         "solve", "-a", "mgm2", "-d", "adhoc", "-m", "process",
+         "-p", "stop_cycle:20",
+         os.path.join(REF_INSTANCES, "graph_coloring1.yaml")],
+        timeout=180, env=ENV,
+    )
+    result = json.loads(out)
+    assert result["backend"] == "process"
+    assert set(result["assignment"]) == {"v1", "v2", "v3"}

@@ -1,0 +1,203 @@
+"""Wire-format round-trip tests for every algorithm / infrastructure
+message type: simple_repr is the serialization used by the HTTP
+transport (multi-process and multi-machine modes), so every message a
+computation can post must survive repr -> JSON -> from_repr intact
+(reference: SimpleRepr is "the wire format", utils/simple_repr.py).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+def roundtrip(msg):
+    """repr -> real JSON text -> back (exactly what HTTP does)."""
+    wire = json.loads(json.dumps(simple_repr(msg)))
+    return from_repr(wire)
+
+
+class TestAlgorithmMessages:
+    def test_maxsum_message(self):
+        from pydcop_tpu.infrastructure.agent_algorithms import (
+            MaxSumMessage,
+        )
+
+        m = MaxSumMessage({"R": 1.5, "G": -0.25, "B": 0.0})
+        m2 = roundtrip(m)
+        assert m2.costs == m.costs
+        assert m2.size == m.size
+
+    @pytest.mark.parametrize("factory_args", [
+        ("agent_algorithms", "DsaMessage", ("R",)),
+        ("agent_algorithms", "AdsaValueMessage", (2,)),
+        ("agent_algorithms", "MgmValueMessage", (1,)),
+        ("agent_algorithms", "MgmGainMessage", (3.5, 0.77)),
+        ("agent_algorithms", "NcbbValueMessage", ("G",)),
+        ("agent_algorithms", "NcbbCostMessage", (12.5,)),
+        ("agent_algorithms", "NcbbStopMessage", ()),
+        ("agent_breakout", "DbaOkMessage", ("B",)),
+        ("agent_breakout", "DbaEndMessage", ()),
+        ("agent_breakout", "GdbaOkMessage", (0,)),
+        ("agent_breakout", "GdbaImproveMessage", (2.0,)),
+        ("agent_breakout", "MixedDsaMessage", (1,)),
+        ("agent_breakout", "Mgm2ValueMessage", ("R",)),
+        ("agent_breakout", "Mgm2GainMessage", (4.0,)),
+        ("agent_breakout", "Mgm2GoMessage", (True,)),
+    ])
+    def test_tuple_style_messages(self, factory_args):
+        import importlib
+
+        module_name, cls_name, args = factory_args
+        module = importlib.import_module(
+            f"pydcop_tpu.infrastructure.{module_name}")
+        cls = getattr(module, cls_name)
+        m = cls(*args)
+        m2 = roundtrip(m)
+        assert m2 == m
+
+    def test_dpop_util_message_carries_tables(self):
+        from pydcop_tpu.dcop.objects import Domain, Variable
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+        from pydcop_tpu.infrastructure.agent_search import (
+            DpopUtilMessage,
+        )
+
+        d = Domain("d", "", [0, 1])
+        x, y = Variable("x", d), Variable("y", d)
+        util = NAryMatrixRelation(
+            [x, y], np.arange(4).reshape(2, 2).astype(float), "u")
+        m2 = roundtrip(DpopUtilMessage(util))
+        assert [v.name for v in m2.util.dimensions] == ["x", "y"]
+        assert m2.util(1, 0) == util(1, 0)
+        assert m2.size == 4
+
+    def test_dpop_value_and_syncbb_messages(self):
+        from pydcop_tpu.infrastructure.agent_search import (
+            DpopValueMessage,
+            SyncBBBackwardMessage,
+            SyncBBForwardMessage,
+            SyncBBTerminateMessage,
+        )
+
+        m = roundtrip(DpopValueMessage({"x": 1, "y": 0}))
+        assert m.assignment == {"x": 1, "y": 0}
+        fwd = SyncBBForwardMessage(
+            [["x", 1], ["y", 0]], 12.0, 20.0, [["x", 1]], 15.0)
+        assert roundtrip(fwd) == fwd
+        bwd = SyncBBBackwardMessage(20.0, [["x", 1]], 15.0)
+        assert roundtrip(bwd) == bwd
+        term = SyncBBTerminateMessage({"x": 1}, 15.0)
+        assert roundtrip(term) == term
+
+    def test_mgm2_offer_list_survives(self):
+        """Offers are (my_value, partner_value, gain) triples; tuples
+        come back as lists from JSON, so receivers must get the same
+        content in sequence form."""
+        from pydcop_tpu.infrastructure.agent_breakout import (
+            Mgm2OfferMessage,
+        )
+
+        m = Mgm2OfferMessage([(0, 1, 2.5), (1, 0, -1.0)])
+        m2 = roundtrip(m)
+        normalized = [tuple(o) for o in m2.offers]
+        assert normalized == [(0, 1, 2.5), (1, 0, -1.0)]
+
+
+class TestInfrastructureMessages:
+    def test_orchestration_messages(self):
+        from pydcop_tpu.infrastructure.orchestratedagents import (
+            AgentReadyMessage,
+            AgentStoppedMessage,
+            ComputationFinishedMessage,
+            CycleChangeMessage,
+            RemoveComputationsMessage,
+            RunAgentMessage,
+            StopAgentMessage,
+            ValueChangeMessage,
+        )
+
+        assert roundtrip(AgentReadyMessage("a1", ["h", 80])) == \
+            AgentReadyMessage("a1", ["h", 80])
+        assert roundtrip(AgentStoppedMessage("a1", {"cycles": {}})) == \
+            AgentStoppedMessage("a1", {"cycles": {}})
+        assert roundtrip(ValueChangeMessage("a", "v1", 2, 5, 1.0)) == \
+            ValueChangeMessage("a", "v1", 2, 5, 1.0)
+        assert roundtrip(CycleChangeMessage("a", "v1", 7)) == \
+            CycleChangeMessage("a", "v1", 7)
+        assert roundtrip(ComputationFinishedMessage("a", "v1")) == \
+            ComputationFinishedMessage("a", "v1")
+        assert roundtrip(RunAgentMessage(["v1", "v2"])) == \
+            RunAgentMessage(["v1", "v2"])
+        assert roundtrip(StopAgentMessage()) == StopAgentMessage()
+        assert roundtrip(RemoveComputationsMessage(["x_a"])) == \
+            RemoveComputationsMessage(["x_a"])
+
+    def test_deploy_message_ships_computation_def(self):
+        """DeployMessage carries a full ComputationDef — the mechanism
+        that ships algorithm computations to remote agents."""
+        from pydcop_tpu.algorithms import (
+            AlgorithmDef,
+            ComputationDef,
+        )
+        from pydcop_tpu.computations_graph import (
+            constraints_hypergraph as chg,
+        )
+        from pydcop_tpu.dcop.objects import Domain, Variable
+        from pydcop_tpu.dcop.relations import constraint_from_str
+        from pydcop_tpu.infrastructure.orchestratedagents import (
+            DeployMessage,
+        )
+
+        d = Domain("d", "", [0, 1])
+        v0, v1 = Variable("v0", d), Variable("v1", d)
+        c = constraint_from_str("c", "v0 + v1", [v0, v1])
+        cg = chg.build_computation_graph(
+            variables=[v0, v1], constraints=[c])
+        algo = AlgorithmDef.build_with_default_param("dsa", mode="min")
+        comp_def = ComputationDef(cg.computation("v0"), algo)
+        m2 = roundtrip(DeployMessage(comp_def))
+        assert m2.comp_def.node.name == "v0"
+        assert m2.comp_def.algo.algo == "dsa"
+        # The shipped definition is buildable on the receiving side.
+        from pydcop_tpu.infrastructure.computations import (
+            build_computation,
+        )
+
+        comp = build_computation(m2.comp_def)
+        assert comp.name == "v0"
+
+    def test_replication_messages(self):
+        from pydcop_tpu.replication.dist_ucs_hostingcosts import (
+            ActivateReplicaMessage,
+            PlaceReplicaMessage,
+            UCSProbeMessage,
+        )
+
+        assert roundtrip(
+            ActivateReplicaMessage("v1", ["a2", "a3"])
+        ) == ActivateReplicaMessage("v1", ["a2", "a3"])
+        place = PlaceReplicaMessage("v1", None, 2.5, ["a1", "a2"])
+        assert roundtrip(place) == place
+        probe = UCSProbeMessage("v1", ["a1"], 1.0)
+        assert roundtrip(probe) == probe
+
+    def test_discovery_messages(self):
+        from pydcop_tpu.infrastructure.discovery import (
+            PublishMessage,
+            RegisterAgentMessage,
+            RegisterComputationMessage,
+            SubscribeMessage,
+        )
+
+        assert roundtrip(RegisterAgentMessage("a1", ["h", 9001])) == \
+            RegisterAgentMessage("a1", ["h", 9001])
+        assert roundtrip(
+            RegisterComputationMessage("v1", "a1", ["h", 9001])
+        ) == RegisterComputationMessage("v1", "a1", ["h", 9001])
+        assert roundtrip(SubscribeMessage("agent", "a1", True)) == \
+            SubscribeMessage("agent", "a1", True)
+        assert roundtrip(PublishMessage("agent_added", "a1", "addr")) \
+            == PublishMessage("agent_added", "a1", "addr")
