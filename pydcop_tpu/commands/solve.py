@@ -89,7 +89,7 @@ def run_cmd(args) -> int:
             from pydcop_tpu.commands.metrics_io import add_csvline
 
             trace_res = build_engine(
-                dcop, algo_def.params
+                dcop, algo_def.params, n_devices=args.n_devices,
             ).run_trace(max_cycles=max(res["cycles"], 1))
             for i, cost in enumerate(
                     trace_res.metrics["cost_trace"]):
