@@ -103,17 +103,39 @@ def main(args=None) -> int:
     try:
         return parsed.func(parsed) or 0
     except ModuleNotFoundError as e:
+        # Plugin-style lookups (algorithm / distribution / graph model
+        # names map to module imports): name the valid options.  NOTE:
+        # a bare `raise` here would escape the whole try statement
+        # (later handlers never apply once one is entered), so the
+        # generic path is handled inline.
+        name = str(e).rsplit(".", 1)[-1].rstrip("'")
         if "pydcop_tpu.algorithms." in str(e):
-            algo = str(e).rsplit(".", 1)[-1].rstrip("'")
             from pydcop_tpu.algorithms import list_available_algorithms
 
             print(
-                f"Error: unknown algorithm {algo!r}; available: "
+                f"Error: unknown algorithm {name!r}; available: "
                 f"{', '.join(list_available_algorithms())}",
                 file=sys.stderr,
             )
             return 2
-        raise
+        if "pydcop_tpu.distribution." in str(e):
+            print(
+                f"Error: unknown distribution method {name!r}",
+                file=sys.stderr,
+            )
+            return 2
+        if "pydcop_tpu.computations_graph." in str(e):
+            print(
+                f"Error: unknown graph model {name!r}; available: "
+                "factor_graph, constraints_hypergraph, pseudotree, "
+                "ordered_graph",
+                file=sys.stderr,
+            )
+            return 2
+        if parsed.verbosity >= 3:
+            raise
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
     except FileNotFoundError as e:
         print(f"Error: file not found: {e.filename}", file=sys.stderr)
         return 2

@@ -33,7 +33,6 @@ from pydcop_tpu.ops import maxsum as maxsum_ops
 def _stack_graphs(
     graphs: Sequence[CompiledFactorGraph],
 ) -> CompiledFactorGraph:
-    first = graphs[0]
     shapes = [
         (g.var_costs.shape,) + tuple(b.costs.shape for b in g.buckets)
         for g in graphs
@@ -44,6 +43,32 @@ def _stack_graphs(
             f"{sorted(set(shapes))}"
         )
     return jax.tree.map(lambda *xs: jnp.stack(xs), *graphs)
+
+
+# One jitted program per (solver-parameter) combination, reused across
+# calls — rebuilding the closure per call would retrace and recompile
+# the whole vmapped solve every time.
+_JIT_CACHE: Dict[Tuple, object] = {}
+
+
+def _batched_solver(max_cycles: int, damping: float,
+                    damp_vars: bool, damp_factors: bool,
+                    stability: float):
+    key = (max_cycles, damping, damp_vars, damp_factors, stability)
+    if key not in _JIT_CACHE:
+        def solve_one(graph):
+            state, values = maxsum_ops.run_maxsum(
+                graph, max_cycles,
+                damping=damping,
+                damp_vars=damp_vars,
+                damp_factors=damp_factors,
+                stability=stability,
+                stop_on_convergence=False,
+            )
+            return values, state.cycle
+
+        _JIT_CACHE[key] = jax.jit(jax.vmap(solve_one))
+    return _JIT_CACHE[key]
 
 
 def solve_maxsum_batch(
@@ -60,6 +85,8 @@ def solve_maxsum_batch(
     cycles.  All instances run ``max_cycles`` cycles (no convergence
     stop: a data-dependent loop bound would serialize the batch).
     """
+    if not dcops:
+        return []
     compiled: List[Tuple[CompiledFactorGraph, FactorGraphMeta]] = [
         compile_dcop(d, noise_level=noise_level) for d in dcops
     ]
@@ -67,18 +94,13 @@ def solve_maxsum_batch(
     metas = [c[1] for c in compiled]
     stacked = _stack_graphs(graphs)
 
-    def solve_one(graph):
-        state, values = maxsum_ops.run_maxsum(
-            graph, max_cycles,
-            damping=damping,
-            damp_vars=damping_nodes in ("vars", "both"),
-            damp_factors=damping_nodes in ("factors", "both"),
-            stability=stability,
-            stop_on_convergence=False,
-        )
-        return values, state.cycle
-
-    values, cycles = jax.jit(jax.vmap(solve_one))(stacked)
+    solver = _batched_solver(
+        max_cycles, damping,
+        damping_nodes in ("vars", "both"),
+        damping_nodes in ("factors", "both"),
+        stability,
+    )
+    values, cycles = solver(stacked)
     values = np.asarray(jax.device_get(values))
     cycles = np.asarray(jax.device_get(cycles))
 

@@ -362,3 +362,86 @@ class DynamicMaxSumEngine:
                 v.name: assignment[v.name] for v in c.dimensions
             }))
         return total
+
+    # ------------------------------------------------------------- #
+    # checkpoint / resume
+    # ------------------------------------------------------------- #
+
+    def checkpoint(self, path: str) -> None:
+        """Dump the solver state to an .npz file.
+
+        The reference has no computation-state checkpointing at all
+        (its only resume feature is the batch command's progress file,
+        pydcop/commands/batch.py); on device the whole solver state is
+        a handful of arrays, so checkpoint/resume is one savez away
+        (SURVEY §5 "the TPU build can do better cheaply").  Graph
+        layout is NOT saved — restore onto an engine built from the
+        same problem (slot names are verified)."""
+        if self._state is None:
+            raise ValueError("Nothing to checkpoint: engine never ran")
+        state = self._state
+        names = sorted(self.slots)
+        arrays = {
+            "cycle": np.asarray(state.cycle),
+            "stable": np.asarray(state.stable),
+            # Plain unicode dtype (not object): restore() can then load
+            # with pickle disabled — checkpoints stay data, not code.
+            "slot_names": np.array(names),
+            # The (bucket, row) each factor's messages live in: dynamic
+            # edits reuse freed rows, so row positions are NOT a pure
+            # function of the factor set — restore must remap by name.
+            "slot_pos": np.array(
+                [self.slots[n] for n in names], dtype=np.int64),
+        }
+        for bi in range(len(self.graph.buckets)):
+            arrays[f"v2f_{bi}"] = np.asarray(state.v2f[bi])
+            arrays[f"f2v_{bi}"] = np.asarray(state.f2v[bi])
+            arrays[f"v2f_count_{bi}"] = np.asarray(state.v2f_count[bi])
+            arrays[f"f2v_count_{bi}"] = np.asarray(state.f2v_count[bi])
+        np.savez(path, **arrays)
+
+    def restore(self, path: str) -> None:
+        """Load a checkpoint written by :meth:`checkpoint`; the next
+        :meth:`run` continues the trajectory from it.  Message rows are
+        remapped by factor name (same recipe as
+        _recompile_carrying_messages), so the target engine's row
+        layout may differ from the checkpointing engine's."""
+        data = np.load(path)
+        saved_names = [str(n) for n in data["slot_names"]]
+        if saved_names != sorted(self.slots):
+            raise ValueError(
+                "Checkpoint does not match this engine's factors "
+                f"(saved {len(saved_names)}, engine {len(self.slots)})"
+            )
+        saved_pos = {
+            name: tuple(pos)
+            for name, pos in zip(saved_names, data["slot_pos"])
+        }
+        d = self.dmax
+        v2f = [np.zeros(b.var_ids.shape + (d,), np.float32)
+               for b in self.graph.buckets]
+        f2v = [np.zeros(b.var_ids.shape + (d,), np.float32)
+               for b in self.graph.buckets]
+        v2f_c = [np.zeros(b.var_ids.shape, np.int32)
+                 for b in self.graph.buckets]
+        f2v_c = [np.zeros(b.var_ids.shape, np.int32)
+                 for b in self.graph.buckets]
+        for name, (bi, row) in self.slots.items():
+            sbi, srow = saved_pos[name]
+            saved_row = data[f"v2f_{sbi}"][srow]
+            if saved_row.shape != v2f[bi][row].shape:
+                raise ValueError(
+                    f"Checkpoint row for {name} has shape "
+                    f"{saved_row.shape}, engine expects "
+                    f"{v2f[bi][row].shape}"
+                )
+            v2f[bi][row] = saved_row
+            f2v[bi][row] = data[f"f2v_{sbi}"][srow]
+            v2f_c[bi][row] = data[f"v2f_count_{sbi}"][srow]
+            f2v_c[bi][row] = data[f"f2v_count_{sbi}"][srow]
+        self._state = ops.MaxSumState(
+            v2f=tuple(v2f), f2v=tuple(f2v),
+            v2f_count=tuple(v2f_c), f2v_count=tuple(f2v_c),
+            stable=np.asarray(bool(data["stable"])),
+            cycle=np.asarray(int(data["cycle"]), dtype=np.int32),
+        )

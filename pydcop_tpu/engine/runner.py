@@ -195,16 +195,22 @@ class MaxSumEngine:
 
         compile_s = 0.0
 
-        def _round_fn(extra, g, s):
-            """Compiled round runner; compiles are timed separately so
-            time_s / cycles_per_s stay execution-only (same contract
-            as run()/run_trace())."""
+        def _call_round(extra, g, s):
+            """Run one compiled round.  jax.jit (not an AOT
+            executable) so input placements can change between rounds
+            — sharded runs feed device-resident state back in.  The
+            first call per round length is timed as compile (it
+            includes one execution; compile dominates, so the split is
+            a close approximation — run()/run_trace() separate the two
+            exactly via lower/compile, which AOT-freezes placements
+            and would break the mesh path here)."""
             nonlocal compile_s
             key = ("decim", extra)
-            if key not in self._jitted:
-                def _round(g, s):
+            first_call = key not in self._jitted
+            if first_call:
+                def _round(g, s, _extra=extra):
                     s, values = maxsum_ops.run_maxsum_from(
-                        g, s, extra,
+                        g, s, _extra,
                         damping=self.damping,
                         damp_vars=self.damp_vars,
                         damp_factors=self.damp_factors,
@@ -218,12 +224,13 @@ class MaxSumEngine:
                     margin = best2[:, 1] - best2[:, 0]
                     return s, values, margin
 
-                tc = time.perf_counter()
-                self._jitted[key] = (
-                    jax.jit(_round).lower(g, s).compile()
-                )
+                self._jitted[key] = jax.jit(_round)
+            tc = time.perf_counter()
+            out = self._jitted[key](g, s)
+            if first_call:
+                jax.block_until_ready(out)
                 compile_s += time.perf_counter() - tc
-            return self._jitted[key]
+            return out
 
         def _put(arr):
             if self.mesh is not None and self.mesh.size > 1:
@@ -241,8 +248,7 @@ class MaxSumEngine:
             if remaining <= 0 and values is not None:
                 break
             extra = min(cycles_per_round, max(remaining, 1))
-            state, values, margin = _round_fn(
-                extra, graph, state)(graph, state)
+            state, values, margin = _call_round(extra, graph, state)
             if bool(np.all(fixed)) or \
                     int(state.cycle) >= max_cycles:
                 break

@@ -134,3 +134,79 @@ def test_cost_continuity_across_noop_event():
     # The edge messages were reset by the edit, but the surviving state
     # pulls the trajectory back: same conflict-free fixpoint.
     assert eng.cost(res_b.assignment) <= eng.cost(res_a.assignment)
+
+
+def test_checkpoint_resume_bit_exact(tmp_path):
+    """Checkpoint + restore into a FRESH engine continues the
+    trajectory exactly: split run across processes-worth of state
+    equals one uninterrupted run."""
+    variables, constraints = _ring(14, seed=9)
+    e1 = DynamicMaxSumEngine(variables, constraints, noise_seed=9)
+    e1.run(35, stop_on_convergence=False)
+    ckpt = str(tmp_path / "state.npz")
+    e1.checkpoint(ckpt)
+
+    v2, c2 = _ring(14, seed=9)
+    e2 = DynamicMaxSumEngine(v2, c2, noise_seed=9)
+    e2.restore(ckpt)
+    resumed = e2.run(35, stop_on_convergence=False)
+
+    e3 = DynamicMaxSumEngine(*_ring(14, seed=9), noise_seed=9)
+    single = e3.run(70, stop_on_convergence=False)
+    assert resumed.cycles == single.cycles == 70
+    assert resumed.assignment == single.assignment
+
+
+def test_checkpoint_restore_rejects_mismatched_problem(tmp_path):
+    variables, constraints = _ring(10, seed=1)
+    e1 = DynamicMaxSumEngine(variables, constraints, noise_seed=1)
+    e1.run(10, stop_on_convergence=False)
+    ckpt = str(tmp_path / "state.npz")
+    e1.checkpoint(ckpt)
+
+    other = DynamicMaxSumEngine(*_ring(12, seed=1), noise_seed=1)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        other.restore(ckpt)
+
+
+def test_checkpoint_requires_a_run(tmp_path):
+    eng = DynamicMaxSumEngine(*_ring(6, seed=0))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="never ran"):
+        eng.checkpoint(str(tmp_path / "x.npz"))
+
+
+def test_checkpoint_after_edits_remaps_rows(tmp_path):
+    """Dynamic edits reuse freed rows, so a checkpointing engine's row
+    layout can differ from a fresh engine's for the same factor set;
+    restore must remap message rows by factor name."""
+    variables, constraints = _ring(10, seed=2)
+    e1 = DynamicMaxSumEngine(
+        variables, constraints, noise_seed=2, slack=0.5)
+    e1.run(25, stop_on_convergence=False)
+    # Remove then re-add c3 with a DIFFERENT table: it lands in a
+    # freed/slack row, not its original position.
+    neq = 1.0 - np.eye(3)
+    e1.remove_factor("c3")
+    e1.add_factor(NAryMatrixRelation(
+        [variables[3], variables[4]], neq, "c3"))
+    e1.run(25, stop_on_convergence=False)
+    ckpt = str(tmp_path / "edited.npz")
+    e1.checkpoint(ckpt)
+    row_in_e1 = e1.slots["c3"]
+
+    # Fresh engine from the FINAL constraint set: c3 sits at its
+    # natural build position, which differs from e1's reused row.
+    final_constraints = list(e1.factors.values())
+    e2 = DynamicMaxSumEngine(
+        variables, final_constraints, noise_seed=2, slack=0.5)
+    assert e2.slots["c3"] != row_in_e1
+    e2.restore(ckpt)
+    r2 = e2.run(40, stop_on_convergence=False)
+    r1 = e1.run(40, stop_on_convergence=False)
+    assert r1.assignment == r2.assignment
+    # The re-added preference constraint holds in both.
+    assert r2.assignment["v3"] == r2.assignment["v4"]

@@ -1,0 +1,109 @@
+"""CLI error-path tests: bad inputs must produce clean one-line
+errors and non-zero exit codes, not tracebacks (dcop_cli.py main's
+error handling; reference CLI behaves the same way)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REF_INSTANCES = "/root/reference/tests/instances"
+FIXTURE = os.path.join(REF_INSTANCES, "graph_coloring1.yaml")
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def run_cli(args, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli"] + args,
+        timeout=timeout, env=ENV, capture_output=True, text=True,
+    )
+
+
+def test_unknown_algorithm_clean_error():
+    res = run_cli(["solve", "--algo", "nosuchalgo", FIXTURE])
+    assert res.returncode != 0
+    assert "Traceback" not in res.stderr
+    assert "nosuchalgo" in (res.stderr + res.stdout)
+
+
+def test_missing_dcop_file():
+    res = run_cli(["solve", "--algo", "dsa", "/nonexistent/x.yaml"])
+    assert res.returncode != 0
+    assert "Traceback" not in res.stderr
+
+
+def test_malformed_yaml(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("variables: [unclosed\n")
+    res = run_cli(["solve", "--algo", "dsa", str(bad)])
+    assert res.returncode != 0
+    assert "Traceback" not in res.stderr
+
+
+def test_yaml_with_unknown_variable_in_constraint(tmp_path):
+    bad = tmp_path / "bad_ref.yaml"
+    bad.write_text("""
+name: broken
+objective: min
+domains:
+  d:
+    values: [0, 1]
+variables:
+  v1:
+    domain: d
+constraints:
+  c1:
+    type: intention
+    function: v1 + v_missing
+agents: [a1]
+""")
+    res = run_cli(["solve", "--algo", "dsa", str(bad)])
+    assert res.returncode != 0
+    assert "Traceback" not in res.stderr
+
+
+def test_bad_algo_param_name():
+    res = run_cli([
+        "solve", "--algo", "dsa", "-p", "nope:1", FIXTURE])
+    assert res.returncode != 0
+    assert "Traceback" not in res.stderr
+    assert "nope" in (res.stderr + res.stdout)
+
+
+def test_bad_algo_param_value():
+    res = run_cli([
+        "solve", "--algo", "dsa", "-p", "variant:Z", FIXTURE])
+    assert res.returncode != 0
+    assert "Traceback" not in res.stderr
+
+
+def test_unknown_distribution_method():
+    res = run_cli([
+        "solve", "--algo", "dsa", "--mode", "thread",
+        "-d", "nosuchdist", FIXTURE])
+    assert res.returncode != 0
+    assert "Traceback" not in res.stderr
+
+
+def test_thread_algo_without_agent_mode_hint():
+    """Device-only situations give an actionable message."""
+    res = run_cli([
+        "run", "-a", "dba", "-m", "device", "-s",
+        os.path.join(
+            os.path.dirname(__file__), "..", "instances",
+            "scenario_remove_a1.yaml"),
+        FIXTURE])
+    assert res.returncode != 0
+    assert "maxsum" in (res.stdout + res.stderr)
+
+
+def test_graph_command_unknown_graph_model():
+    res = run_cli([
+        "graph", "--graph", "nosuchgraph", FIXTURE])
+    assert res.returncode != 0
+    assert "Traceback" not in res.stderr
