@@ -125,10 +125,17 @@ def main(args=None) -> int:
             )
             return 2
         if "pydcop_tpu.computations_graph." in str(e):
+            import pkgutil
+
+            import pydcop_tpu.computations_graph as cg_pkg
+
+            models = sorted(
+                n for _, n, ispkg in pkgutil.iter_modules(cg_pkg.__path__)
+                if not ispkg and not n.startswith("_") and n != "objects"
+            )
             print(
                 f"Error: unknown graph model {name!r}; available: "
-                "factor_graph, constraints_hypergraph, pseudotree, "
-                "ordered_graph",
+                f"{', '.join(models)}",
                 file=sys.stderr,
             )
             return 2

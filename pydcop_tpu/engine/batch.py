@@ -15,7 +15,8 @@ tables).  A shape mismatch raises instead of silently padding, so the
 caller controls the batching granularity.
 """
 
-from typing import Dict, List, Optional, Sequence, Tuple
+import functools
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,30 +46,31 @@ def _stack_graphs(
     return jax.tree.map(lambda *xs: jnp.stack(xs), *graphs)
 
 
-# One jitted program per (solver-parameter) combination, reused across
-# calls — rebuilding the closure per call would retrace and recompile
-# the whole vmapped solve every time.
-_JIT_CACHE: Dict[Tuple, object] = {}
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_cycles", "damping", "damp_vars", "damp_factors",
+        "stability",
+    ),
+)
+def _batched_solve(stacked, *, max_cycles, damping, damp_vars,
+                   damp_factors, stability):
+    """One jitted program per solver-parameter combination (jit's own
+    cache keys on the static args), reused across calls — a fresh
+    closure per call would retrace and recompile every time."""
 
+    def solve_one(graph):
+        state, values = maxsum_ops.run_maxsum(
+            graph, max_cycles,
+            damping=damping,
+            damp_vars=damp_vars,
+            damp_factors=damp_factors,
+            stability=stability,
+            stop_on_convergence=False,
+        )
+        return values, state.cycle
 
-def _batched_solver(max_cycles: int, damping: float,
-                    damp_vars: bool, damp_factors: bool,
-                    stability: float):
-    key = (max_cycles, damping, damp_vars, damp_factors, stability)
-    if key not in _JIT_CACHE:
-        def solve_one(graph):
-            state, values = maxsum_ops.run_maxsum(
-                graph, max_cycles,
-                damping=damping,
-                damp_vars=damp_vars,
-                damp_factors=damp_factors,
-                stability=stability,
-                stop_on_convergence=False,
-            )
-            return values, state.cycle
-
-        _JIT_CACHE[key] = jax.jit(jax.vmap(solve_one))
-    return _JIT_CACHE[key]
+    return jax.vmap(solve_one)(stacked)
 
 
 def solve_maxsum_batch(
@@ -94,13 +96,14 @@ def solve_maxsum_batch(
     metas = [c[1] for c in compiled]
     stacked = _stack_graphs(graphs)
 
-    solver = _batched_solver(
-        max_cycles, damping,
-        damping_nodes in ("vars", "both"),
-        damping_nodes in ("factors", "both"),
-        stability,
+    values, cycles = _batched_solve(
+        stacked,
+        max_cycles=max_cycles,
+        damping=damping,
+        damp_vars=damping_nodes in ("vars", "both"),
+        damp_factors=damping_nodes in ("factors", "both"),
+        stability=stability,
     )
-    values, cycles = solver(stacked)
     values = np.asarray(jax.device_get(values))
     cycles = np.asarray(jax.device_get(cycles))
 

@@ -409,9 +409,12 @@ class DynamicMaxSumEngine:
         data = np.load(path)
         saved_names = [str(n) for n in data["slot_names"]]
         if saved_names != sorted(self.slots):
+            only_saved = sorted(set(saved_names) - set(self.slots))
+            only_engine = sorted(set(self.slots) - set(saved_names))
             raise ValueError(
-                "Checkpoint does not match this engine's factors "
-                f"(saved {len(saved_names)}, engine {len(self.slots)})"
+                "Checkpoint does not match this engine's factors: "
+                f"only in checkpoint {only_saved}, only in engine "
+                f"{only_engine}"
             )
         saved_pos = {
             name: tuple(pos)

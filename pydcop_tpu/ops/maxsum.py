@@ -115,10 +115,34 @@ def _send_or_suppress(cand: jnp.ndarray, prev: jnp.ndarray,
     return sent, new_count, match
 
 
+def _use_pallas() -> bool:
+    """Opt-in Pallas path for the binary-factor update (TPU only;
+    evaluated at trace time).  Default off: measured at parity with
+    XLA's fusion on v5e — see ops/pallas_maxsum.py for the full
+    status."""
+    import os
+
+    return (
+        os.environ.get("PYDCOP_PALLAS_MAXSUM") == "1"
+        and jax.default_backend() == "tpu"
+        # Sharded buckets (mesh runs) cannot feed pallas_call without
+        # gathering the whole bucket per superstep — single chip only.
+        and jax.device_count() == 1
+    )
+
+
 def factor_to_var(graph: CompiledFactorGraph, v2f: Msgs) -> Msgs:
     """All factor→variable messages for one superstep."""
     out = []
+    use_pallas = _use_pallas()
     for bucket, msgs in zip(graph.buckets, v2f):
+        if use_pallas and bucket.var_ids.shape[1] == 2:
+            from pydcop_tpu.ops.pallas_maxsum import (
+                binary_factor_update,
+            )
+
+            out.append(binary_factor_update(bucket.costs, msgs))
+            continue
         f, arity, d = msgs.shape
         total = bucket.costs  # [F, D, ..., D]
         for q in range(arity):
