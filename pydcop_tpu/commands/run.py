@@ -230,8 +230,13 @@ def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
             budget_acc += max(
                 1.0, event.delay * DEVICE_CYCLES_PER_DELAY_SECOND)
             while budget_acc >= DEVICE_RUN_CHUNK:
-                last = engine.run(
-                    DEVICE_RUN_CHUNK, stop_on_convergence=False)
+                chunk = DEVICE_RUN_CHUNK
+                if args.cycles:
+                    chunk = min(chunk, args.cycles - last.cycles)
+                if chunk <= 0:
+                    budget_acc = 0.0
+                    break
+                last = engine.run(chunk, stop_on_convergence=False)
                 budget_acc -= DEVICE_RUN_CHUNK
             continue
         for action in event.actions or []:
@@ -273,8 +278,15 @@ def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
             "cost": engine.cost(last.assignment),
         })
 
-    max_cycles = args.cycles or 2000
-    final = engine.run(max_cycles)
+    # --cycles bounds the TOTAL cycle count: the scenario's delay
+    # budgets already consumed `last.cycles`, so the final run gets only
+    # the remainder (ADVICE r2: previously -c was additional cycles and
+    # runs could exceed the user's bound).
+    if args.cycles:
+        max_cycles = max(0, args.cycles - last.cycles)
+    else:
+        max_cycles = 2000
+    final = engine.run(max_cycles) if max_cycles > 0 else last
     cost, violations = dcop.solution_cost(final.assignment)
     result = {
         "status": "FINISHED" if final.converged else "TIMEOUT",

@@ -141,6 +141,16 @@ def main(args=None) -> int:
             return 2
         if parsed.verbosity >= 3:
             raise
+        if "pydcop_tpu" not in str(e):
+            # A missing THIRD-PARTY module is a broken install, not a
+            # user error (ADVICE r2): distinct exit code + -vvv hint.
+            print(
+                f"Error: missing dependency: {e}. This looks like a "
+                "broken installation; rerun with -vvv for the full "
+                "traceback.",
+                file=sys.stderr,
+            )
+            return 3
         print(f"Error: {e}", file=sys.stderr)
         return 1
     except FileNotFoundError as e:

@@ -17,17 +17,29 @@ resource is HBM bandwidth (every superstep streams all factor tables
 and messages), which is why `hbm_util` is the headline efficiency
 number.
 
-Peak numbers: TPU v5e (v5litepod) chip — 197 TFLOP/s bf16 matmul,
-819 GB/s HBM (public spec).  CPU backends get `None` peaks: the bench
-then reports achieved numbers without a utilization claim.
+Peak numbers come from public chip specs, keyed on
+`jax.devices()[0].device_kind` so each TPU generation gets its own
+roofline; unknown kinds (and CPU backends) get `None` peaks and the
+bench reports achieved numbers without a utilization claim.
 """
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from pydcop_tpu.engine.compile import CompiledFactorGraph
 
 V5E_PEAK_FLOPS_BF16 = 197e12
 V5E_HBM_BYTES_PER_S = 819e9
+
+# device_kind -> (peak bf16 matmul FLOP/s, HBM bytes/s), public specs.
+TPU_PEAKS: Dict[str, Tuple[float, float]] = {
+    "TPU v4": (275e12, 1.2e12),
+    "TPU v5 lite": (V5E_PEAK_FLOPS_BF16, V5E_HBM_BYTES_PER_S),
+    "TPU v5e": (V5E_PEAK_FLOPS_BF16, V5E_HBM_BYTES_PER_S),
+    "TPU v5": (459e12, 2.765e12),
+    "TPU v5p": (459e12, 2.765e12),
+    "TPU v6 lite": (918e12, 1.64e12),
+    "TPU v6e": (918e12, 1.64e12),
+}
 
 
 def maxsum_superstep_flops(graph: CompiledFactorGraph) -> int:
@@ -76,17 +88,24 @@ def maxsum_superstep_bytes(graph: CompiledFactorGraph) -> int:
 
 
 def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
-                    platform: str) -> Dict[str, Optional[float]]:
-    """Achieved FLOP/s + utilizations for a measured superstep rate."""
+                    platform: str,
+                    device_kind: Optional[str] = None,
+                    ) -> Dict[str, Optional[float]]:
+    """Achieved FLOP/s + utilizations for a measured superstep rate.
+
+    Utilization claims (mfu/hbm_util) are made only when the concrete
+    chip is recognized in TPU_PEAKS; `platform == "tpu"` with an
+    unknown `device_kind` reports achieved numbers with `None`
+    utilizations rather than assuming some generation's peaks.
+    """
     flops = maxsum_superstep_flops(graph)
     bytes_moved = maxsum_superstep_bytes(graph)
     achieved_flops = flops * cycles_per_s
     achieved_bw = bytes_moved * cycles_per_s
-    if platform == "tpu":
-        peak_flops: Optional[float] = V5E_PEAK_FLOPS_BF16
-        peak_bw: Optional[float] = V5E_HBM_BYTES_PER_S
-    else:
-        peak_flops = peak_bw = None
+    peak_flops: Optional[float] = None
+    peak_bw: Optional[float] = None
+    if platform == "tpu" and device_kind in TPU_PEAKS:
+        peak_flops, peak_bw = TPU_PEAKS[device_kind]
     return {
         "flops_per_cycle": float(flops),
         "bytes_per_cycle": float(bytes_moved),

@@ -115,15 +115,25 @@ def _send_or_suppress(cand: jnp.ndarray, prev: jnp.ndarray,
     return sent, new_count, match
 
 
-def _use_pallas() -> bool:
-    """Opt-in Pallas path for the binary-factor update (TPU only;
-    evaluated at trace time).  Default off: measured at parity with
-    XLA's fusion on v5e — see ops/pallas_maxsum.py for the full
-    status."""
+def _read_pallas_flag() -> bool:
     import os
 
+    return os.environ.get("PYDCOP_PALLAS_MAXSUM") == "1"
+
+
+# Read ONCE at import (ADVICE r2): the engines' jit caches do not key on
+# this flag, so a mid-process toggle would be silently ignored anyway —
+# snapshotting it here makes the set-before-import contract explicit.
+_PALLAS_FLAG = _read_pallas_flag()
+
+
+def _use_pallas() -> bool:
+    """Opt-in Pallas path for the binary-factor update (TPU only;
+    PYDCOP_PALLAS_MAXSUM=1 must be set before this module is imported).
+    Default off: measured at parity with XLA's fusion on v5e — see
+    ops/pallas_maxsum.py for the full status."""
     return (
-        os.environ.get("PYDCOP_PALLAS_MAXSUM") == "1"
+        _PALLAS_FLAG
         and jax.default_backend() == "tpu"
         # Sharded buckets (mesh runs) cannot feed pallas_call without
         # gathering the whole bucket per superstep — single chip only.

@@ -45,7 +45,8 @@ def test_bytes_formula_matches_hand_count():
 
 def test_report_tpu_vs_cpu():
     graph = _graph()
-    tpu = roofline_report(graph, cycles_per_s=1000.0, platform="tpu")
+    tpu = roofline_report(graph, cycles_per_s=1000.0, platform="tpu",
+                          device_kind="TPU v5 lite")
     assert tpu["mfu"] is not None and 0 < tpu["mfu"] < 1
     assert tpu["hbm_util"] is not None and 0 < tpu["hbm_util"] < 1
     expected_mfu = (
@@ -60,6 +61,25 @@ def test_report_tpu_vs_cpu():
     cpu = roofline_report(graph, cycles_per_s=1000.0, platform="cpu")
     assert cpu["mfu"] is None and cpu["hbm_util"] is None
     assert cpu["achieved_gflops"] == tpu["achieved_gflops"]
+
+
+def test_report_no_utilization_claim_for_unknown_tpu_kind():
+    """An unrecognized TPU generation must not borrow v5e peaks
+    (ADVICE r2): achieved numbers only, utilizations None."""
+    graph = _graph()
+    for kind in (None, "TPU v99"):
+        rep = roofline_report(graph, cycles_per_s=1000.0,
+                              platform="tpu", device_kind=kind)
+        assert rep["mfu"] is None and rep["hbm_util"] is None
+        assert rep["achieved_gflops"] > 0
+
+    v4 = roofline_report(graph, cycles_per_s=1000.0, platform="tpu",
+                         device_kind="TPU v4")
+    v5e = roofline_report(graph, cycles_per_s=1000.0, platform="tpu",
+                          device_kind="TPU v5 lite")
+    # Same achieved rate → lower utilization on the bigger chip.
+    assert v4["mfu"] < v5e["mfu"]
+    assert v4["hbm_util"] < v5e["hbm_util"]
 
 
 def test_counts_scale_with_buckets():
