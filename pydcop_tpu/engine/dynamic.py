@@ -44,7 +44,7 @@ from pydcop_tpu.engine.compile import (
     CompiledFactorGraph,
     FactorBucket,
 )
-from pydcop_tpu.engine.runner import DeviceRunResult
+from pydcop_tpu.engine.runner import DeviceRunResult, timed_jit_call
 from pydcop_tpu.ops import maxsum as ops
 
 
@@ -335,19 +335,8 @@ class DynamicMaxSumEngine:
         if self._state is None:
             self._state = ops.init_state(self.graph)
         fn = self._jitted[key]
-        # Cached-jit dispatch, NOT fn.lower().compile(): the AOT path
-        # recompiled on EVERY call (lower/compile bypasses the jit
-        # cache) and its execute path is orders of magnitude slower
-        # through the axon TPU tunnel (see MaxSumEngine._call).  First
-        # call per key pays trace+compile and reports it as compile
-        # time.
-        first = key not in self._warm
-        t0 = time.perf_counter()
-        state, values = fn(self.graph, self._state)
-        jax.block_until_ready(values)
-        elapsed = time.perf_counter() - t0
-        if first:
-            self._warm.add(key)
+        (state, values), compile_s, run_s = timed_jit_call(
+            self._warm, key, fn, self.graph, self._state)
         self._state = state
         values = np.asarray(jax.device_get(values))
         assignment = {
@@ -358,10 +347,10 @@ class DynamicMaxSumEngine:
             assignment=assignment,
             cycles=int(state.cycle),
             converged=bool(state.stable),
-            time_s=elapsed,
-            compile_time_s=elapsed if first else 0.0,
+            time_s=run_s,
+            compile_time_s=compile_s,
             metrics={"recompiles": self.recompile_count - 1,
-                     "cold_start": first},
+                     "cold_start": compile_s > 0},
         )
 
     def cost(self, assignment: Dict) -> float:

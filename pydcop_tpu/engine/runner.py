@@ -46,6 +46,31 @@ class DeviceRunResult:
     metrics: Dict[str, Any] = field(default_factory=dict)
 
 
+def timed_jit_call(warm: set, key, fn, *args):
+    """Execute a cached-jit function, splitting compile from run time.
+
+    Plain jit dispatch, NOT ``fn.lower(...).compile()``: the AOT
+    execute path measured ~1500x slower per call through the axon TPU
+    tunnel (it re-ships argument buffers per call), it recompiles on
+    every call (lower/compile bypasses the jit cache), and it freezes
+    input placements, which breaks feeding device-resident state back
+    in on mesh runs.  The first call per ``key`` includes trace+compile
+    and reports the whole elapsed interval as BOTH compile and run time
+    (the DeviceRunResult overlapping-fields convention; compile
+    dominates); warm calls report (0, elapsed).
+
+    Returns (out, compile_s, run_s).
+    """
+    first = key not in warm
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    elapsed = time.perf_counter() - t0
+    if first:
+        warm.add(key)
+        return out, elapsed, elapsed
+    return out, 0.0, elapsed
+
+
 def _place_graph(graph: CompiledFactorGraph, mesh,
                  n_devices: Optional[int]):
     """Put the graph on device(s): sharded over a mesh when requested,
@@ -123,22 +148,9 @@ class MaxSumEngine:
         self._warm: set = set()
 
     def _call(self, key, fn, *args):
-        """Execute a cached-jit function, splitting compile from run
-        time.  Plain jit dispatch, NOT ``fn.lower(...).compile()``:
-        the AOT execute path measured ~1500x slower per call through
-        the axon TPU tunnel (it re-ships argument buffers per call),
-        and it freezes input placements, which breaks feeding
-        device-resident state back in on mesh runs (see run_decimated).
-        First call per key includes trace+compile and is recorded as
-        compile time (it also executes once; compile dominates)."""
-        first = key not in self._warm
-        t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(*args))
-        elapsed = time.perf_counter() - t0
-        if first:
-            self._warm.add(key)
-            return out, elapsed, elapsed
-        return out, 0.0, elapsed
+        """See timed_jit_call (module level, shared with the dynamic
+        engine)."""
+        return timed_jit_call(self._warm, key, fn, *args)
 
     def _fn(self, max_cycles: int, stop_on_convergence: bool):
         key = (max_cycles, stop_on_convergence)
@@ -226,14 +238,11 @@ class MaxSumEngine:
         compile_s = 0.0
 
         def _call_round(extra, g, s):
-            """Run one compiled round.  jax.jit (not an AOT
-            executable) so input placements can change between rounds
-            — sharded runs feed device-resident state back in.  The
-            first call per round length is timed as compile (it
-            includes one execution; compile dominates, so the split is
-            a close approximation — run()/run_trace() separate the two
-            exactly via lower/compile, which AOT-freezes placements
-            and would break the mesh path here)."""
+            """Run one compiled round via cached-jit dispatch (see
+            timed_jit_call for why never AOT lower/compile).  The
+            first call per round length is timed as compile — it
+            includes one execution, but compile dominates, the same
+            approximation every engine entry point uses."""
             nonlocal compile_s
             key = ("decim", extra)
             first_call = key not in self._jitted
@@ -300,18 +309,22 @@ class MaxSumEngine:
             # the warm-started messages adapt.
             state = state._replace(stable=jnp.asarray(False))
         jax.block_until_ready(values)
-        elapsed = time.perf_counter() - t0 - compile_s
+        total = time.perf_counter() - t0
+        # DeviceRunResult convention: time_s = total wall including
+        # compiles; steady-state rate uses the compile-free remainder.
+        steady = max(total - compile_s, 0.0)
         values = np.asarray(jax.device_get(values))
         cycle = int(state.cycle)
         return DeviceRunResult(
             assignment=self.meta.assignment_from_indices(values),
             cycles=cycle,
             converged=bool(np.all(fixed)),
-            time_s=elapsed,
+            time_s=total,
             compile_time_s=compile_s,
             metrics={
                 "decimated_vars": int(fixed.sum()),
-                "cycles_per_s": cycle / elapsed if elapsed > 0 else 0.0,
+                "cycles_per_s": cycle / steady if steady > 0 else 0.0,
+                "cold_start": compile_s > 0,
             },
         )
 

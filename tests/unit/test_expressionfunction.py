@@ -1,0 +1,150 @@
+"""Dedicated ExpressionFunction battery (reference scope:
+tests/unit/test_utils_expressionfunction.py — behaviors re-derived from
+the module contract, not ported): expression vs function-body forms,
+AST name discovery, partial application, external source files, wire
+format."""
+
+import os
+
+import pytest
+
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+class TestNameDiscovery:
+    def test_simple_expression_names(self):
+        f = ExpressionFunction("a + b * 2")
+        assert sorted(f.variable_names) == ["a", "b"]
+
+    def test_builtins_not_variables(self):
+        f = ExpressionFunction("abs(x) + len(ys) + round(z)")
+        assert sorted(f.variable_names) == ["x", "ys", "z"]
+
+    def test_math_module_not_a_variable(self):
+        f = ExpressionFunction("math.sqrt(v) + math.pi")
+        assert list(f.variable_names) == ["v"]
+
+    def test_repeated_name_counted_once(self):
+        f = ExpressionFunction("x + x * x")
+        assert list(f.variable_names) == ["x"]
+
+    def test_comprehension_target_not_a_variable(self):
+        f = ExpressionFunction("sum(i * w for i in range(3))")
+        assert list(f.variable_names) == ["w"]
+
+    def test_ternary_collects_all_branches(self):
+        f = ExpressionFunction("a if c else b")
+        assert sorted(f.variable_names) == ["a", "b", "c"]
+
+    def test_name_order_is_appearance_order(self):
+        f = ExpressionFunction("beta + alpha")
+        assert list(f.variable_names) == ["beta", "alpha"]
+
+
+class TestEvaluation:
+    def test_keyword_call(self):
+        assert ExpressionFunction("a - b")(a=10, b=4) == 6
+
+    def test_positional_follow_appearance_order(self):
+        f = ExpressionFunction("a - b")
+        assert f(10, 4) == 6
+
+    def test_string_values(self):
+        f = ExpressionFunction("1 if v1 == v2 else 0")
+        assert f(v1="R", v2="R") == 1
+        assert f(v1="R", v2="G") == 0
+
+    def test_math_functions_available(self):
+        f = ExpressionFunction("math.floor(x)")
+        assert f(x=2.7) == 2
+
+    def test_missing_variable_raises(self):
+        f = ExpressionFunction("a + b")
+        with pytest.raises((NameError, KeyError)):
+            f(a=1)
+
+
+class TestFunctionBodyForm:
+    def test_return_body(self):
+        f = ExpressionFunction("if a > b:\n    return a\nreturn b")
+        assert sorted(f.variable_names) == ["a", "b"]
+        assert f(a=3, b=5) == 5
+        assert f(a=9, b=5) == 9
+
+    def test_body_with_local_assignment(self):
+        f = ExpressionFunction("d = x - y\nreturn d * d")
+        # The local d is assigned, so it is NOT a variable.
+        assert sorted(f.variable_names) == ["x", "y"]
+        assert f(x=5, y=2) == 9
+
+
+class TestPartial:
+    def test_partial_removes_fixed_name(self):
+        f = ExpressionFunction("a + b + c")
+        g = f.partial(b=10)
+        assert sorted(g.variable_names) == ["a", "c"]
+        assert g(a=1, c=2) == 13
+
+    def test_partial_chains(self):
+        f = ExpressionFunction("a + b + c").partial(a=1).partial(b=2)
+        assert list(f.variable_names) == ["c"]
+        assert f(c=3) == 6
+
+    def test_partial_does_not_mutate_original(self):
+        f = ExpressionFunction("a + b")
+        f.partial(a=1)
+        assert sorted(f.variable_names) == ["a", "b"]
+
+    def test_call_can_override_nothing_fixed(self):
+        g = ExpressionFunction("a * b").partial(b=4)
+        assert g(3) == 12  # positional binds the remaining name
+
+
+class TestIdentity:
+    def test_eq_same_expression(self):
+        assert ExpressionFunction("a + 1") == ExpressionFunction("a + 1")
+
+    def test_neq_different_fixed_vars(self):
+        f = ExpressionFunction("a + b")
+        assert f.partial(a=1) != f.partial(a=2)
+
+    def test_hashable_and_consistent(self):
+        f1, f2 = ExpressionFunction("x * 2"), ExpressionFunction("x * 2")
+        assert hash(f1) == hash(f2)
+        assert len({f1, f2}) == 1
+
+    def test_name_is_expression(self):
+        assert ExpressionFunction("a+1").__name__ == "a+1"
+
+
+class TestExternalSource:
+    def test_source_file_functions_usable(self, tmp_path):
+        src = tmp_path / "ext.py"
+        src.write_text("def double(v):\n    return 2 * v\n")
+        f = ExpressionFunction("source.double(x) + 1",
+                               source_file=str(src))
+        assert list(f.variable_names) == ["x"]
+        assert f(x=5) == 11
+
+    def test_missing_source_file_raises(self):
+        with pytest.raises((FileNotFoundError, OSError)):
+            ExpressionFunction("source.f(x)",
+                               source_file="/nonexistent/ext.py")
+
+
+class TestWireFormat:
+    def test_simple_repr_roundtrip(self):
+        f = ExpressionFunction("a + b").partial(b=3)
+        r = simple_repr(f)
+        g = from_repr(r)
+        assert g == f
+        assert g(a=1) == 4
+
+    def test_roundtrip_with_source_file(self, tmp_path):
+        src = tmp_path / "ext2.py"
+        src.write_text("def inc(v):\n    return v + 1\n")
+        f = ExpressionFunction("source.inc(x)", source_file=str(src))
+        g = from_repr(simple_repr(f))
+        assert g(x=41) == 42
+        assert g.source_file == str(src)
