@@ -48,19 +48,48 @@ def test_report_tpu_vs_cpu():
     tpu = roofline_report(graph, cycles_per_s=1000.0, platform="tpu",
                           device_kind="TPU v5 lite")
     assert tpu["mfu"] is not None and 0 < tpu["mfu"] < 1
-    assert tpu["hbm_util"] is not None and 0 < tpu["hbm_util"] < 1
     expected_mfu = (
         maxsum_superstep_flops(graph) * 1000.0 / V5E_PEAK_FLOPS_BF16
     )
     assert abs(tpu["mfu"] - expected_mfu) < 1e-9
-    expected_bw = (
-        maxsum_superstep_bytes(graph) * 1000.0 / V5E_HBM_BYTES_PER_S
-    )
-    assert abs(tpu["hbm_util"] - expected_bw) < 1e-6
+    # The tiny test graph fits in VMEM: no HBM-utilization claim.
+    assert tpu["vmem_resident"] is True
+    assert tpu["hbm_util"] is None and tpu["achieved_gbps"] is None
 
     cpu = roofline_report(graph, cycles_per_s=1000.0, platform="cpu")
     assert cpu["mfu"] is None and cpu["hbm_util"] is None
+    assert cpu["vmem_resident"] is None
+    assert cpu["achieved_gbps"] is not None
     assert cpu["achieved_gflops"] == tpu["achieved_gflops"]
+
+
+def test_hbm_util_claimed_only_when_not_vmem_resident(monkeypatch):
+    """A working set larger than half VMEM gets a real hbm_util; the
+    threshold logic is exercised by shrinking the VMEM table rather
+    than allocating a >64 MiB graph."""
+    import pydcop_tpu.engine.roofline as rl
+
+    graph = _graph()
+    monkeypatch.setattr(rl, "TPU_VMEM_BYTES", 2)
+    rep = rl.roofline_report(graph, cycles_per_s=1000.0,
+                             platform="tpu",
+                             device_kind="TPU v5 lite")
+    assert rep["vmem_resident"] is False
+    expected_bw = (
+        maxsum_superstep_bytes(graph) * 1000.0 / V5E_HBM_BYTES_PER_S
+    )
+    assert abs(rep["hbm_util"] - expected_bw) < 1e-6
+    assert rep["achieved_gbps"] is not None
+
+
+def test_working_set_accounts_state_and_graph():
+    from pydcop_tpu.engine.roofline import working_set_bytes
+
+    graph = _graph()
+    # var tables: costs 5*3*4 + valid 5*3*1 = 75
+    # bucket: costs 3*9*4=108, ids 3*2*4=24, msgs 2*3*2*3*4=144,
+    # counters 2*3*2*4=48
+    assert working_set_bytes(graph) == 75 + 108 + 24 + 144 + 48
 
 
 def test_report_no_utilization_claim_for_unknown_tpu_kind():
@@ -79,7 +108,6 @@ def test_report_no_utilization_claim_for_unknown_tpu_kind():
                           device_kind="TPU v5 lite")
     # Same achieved rate → lower utilization on the bigger chip.
     assert v4["mfu"] < v5e["mfu"]
-    assert v4["hbm_util"] < v5e["hbm_util"]
 
 
 def test_counts_scale_with_buckets():
