@@ -143,9 +143,14 @@ def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
     peak_flops: Optional[float] = None
     peak_bw: Optional[float] = None
     vmem_resident: Optional[bool] = None
-    if platform == "tpu" and device_kind in TPU_PEAKS:
-        peak_flops, peak_bw = TPU_PEAKS[device_kind]
+    if platform == "tpu":
+        # VMEM capacity is kind-independent (see TPU_VMEM_BYTES), so
+        # residency — and the achieved_gbps suppression it implies —
+        # applies to ANY TPU; only the peak-based utilization claims
+        # need a recognized generation.
         vmem_resident = ws < TPU_VMEM_BYTES // 2
+        if device_kind in TPU_PEAKS:
+            peak_flops, peak_bw = TPU_PEAKS[device_kind]
     return {
         "flops_per_cycle": float(flops),
         "bytes_per_cycle": float(bytes_moved),
