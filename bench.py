@@ -157,42 +157,12 @@ def exact_parity():
 
 
 def _ensure_live_backend():
-    """Guard against a wedged TPU tunnel: probe backend init in a
-    subprocess with a timeout; on hang/failure, re-exec this script on
-    the CPU backend so the bench always emits its JSON line."""
-    import os
-    import subprocess
+    """Wedged-tunnel guard (shared recipe): 3 probes — the wedge is
+    frequently transient (BENCH_r02 fell back to CPU even though the
+    chip was reachable minutes later) — then CPU re-exec."""
+    from pydcop_tpu.utils.cleanenv import ensure_live_backend
 
-    if os.environ.get("PYDCOP_BENCH_NO_PROBE"):
-        return
-    # A wedged axon tunnel is frequently transient (BENCH_r02 fell back
-    # to CPU even though the chip was reachable minutes later), so probe
-    # several times before giving up on the accelerator.
-    for attempt in range(3):
-        try:
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=120, check=True,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            )
-            return
-        except (subprocess.TimeoutExpired,
-                subprocess.CalledProcessError):
-            print(
-                f"bench: accelerator probe {attempt + 1}/3 failed",
-                file=sys.stderr,
-            )
-            if attempt < 2:
-                time.sleep(5)
-    print(
-        "bench: accelerator backend unresponsive; falling back "
-        "to CPU", file=sys.stderr,
-    )
-    from pydcop_tpu.utils.cleanenv import scrubbed_cpu_env
-
-    env = scrubbed_cpu_env()
-    env["PYDCOP_BENCH_NO_PROBE"] = "1"
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    ensure_live_backend(tag="bench", retries=3)
 
 
 def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,

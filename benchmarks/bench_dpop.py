@@ -35,28 +35,9 @@ def make_tree_dcop(n, d, seed=0):
 
 
 def _ensure_live_backend():
-    import os
-    import subprocess
+    from pydcop_tpu.utils.cleanenv import ensure_live_backend
 
-    if os.environ.get("PYDCOP_BENCH_NO_PROBE"):
-        return
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=120, check=True,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
-        return
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        print(
-            "bench_dpop: accelerator backend unresponsive; falling "
-            "back to CPU", file=sys.stderr,
-        )
-    from pydcop_tpu.utils.cleanenv import scrubbed_cpu_env
-
-    env = scrubbed_cpu_env()
-    env["PYDCOP_BENCH_NO_PROBE"] = "1"
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    ensure_live_backend(tag="bench_dpop")
 
 
 def main():

@@ -31,6 +31,13 @@ def build_arrays(n_lights, n_rules, seed=0):
     |li - ti| + |lj - tj| over light pairs)."""
     rng = np.random.default_rng(seed)
     pairs = rng.integers(0, n_lights, size=(n_rules, 2)).astype(np.int32)
+    # No self-loop factors (the generator pairs DISTINCT lights,
+    # rng.choice replace=False): resample the second slot on collision.
+    loops = pairs[:, 0] == pairs[:, 1]
+    while loops.any():
+        pairs[loops, 1] = rng.integers(
+            0, n_lights, size=int(loops.sum()))
+        loops = pairs[:, 0] == pairs[:, 1]
     ti = rng.integers(0, D, size=n_rules)
     tj = rng.integers(0, D, size=n_rules)
     grid = np.arange(D)
@@ -42,6 +49,9 @@ def build_arrays(n_lights, n_rules, seed=0):
 
 
 def main():
+    from pydcop_tpu.utils.cleanenv import ensure_live_backend
+
+    ensure_live_backend(tag="bench_secp_sharded")
     n_rules = int(sys.argv[1]) if len(sys.argv) > 1 else N_RULES
     import jax
 
