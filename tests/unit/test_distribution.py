@@ -149,3 +149,55 @@ def test_distribution_object_roundtrip():
 
     dist = dist_objects.Distribution({"a1": ["v1"], "a2": []})
     assert from_repr(simple_repr(dist)) == dist
+
+
+def test_host_with_hints_colocate():
+    """host_with hints pull computations onto the same agent (reference
+    adhoc distribution honors DistributionHints.host_with)."""
+    vs, cs = _problem()
+    cg = constraints_hypergraph.build_computation_graph(
+        variables=vs, constraints=cs)
+    agents = [AgentDef(f"a{i}", capacity=1000) for i in range(4)]
+    hints = DistributionHints(host_with={"v0": ["v3"]})
+    module = _import("adhoc")
+    algo = load_algorithm_module("dsa")
+    dist = module.distribute(
+        cg, agents, hints=hints,
+        computation_memory=algo.computation_memory,
+        communication_load=algo.communication_load,
+    )
+    assert dist.agent_for("v0") == dist.agent_for("v3")
+
+
+def test_distribution_host_on_agent_accumulates():
+    dist = dist_objects.Distribution({"a1": ["v1"], "a2": []})
+    dist.host_on_agent("a2", ["v2"])
+    dist.host_on_agent("a2", ["v3"])
+    assert sorted(dist.computations_hosted("a2")) == ["v2", "v3"]
+    assert dist.agent_for("v3") == "a2"
+    # new agent key created on demand
+    dist.host_on_agent("a9", ["v9"])
+    assert dist.agent_for("v9") == "a9"
+
+
+def test_distribution_is_hosted_and_missing_agent_raises():
+    dist = dist_objects.Distribution({"a1": ["v1", "v2"]})
+    assert dist.is_hosted(["v1", "v2"])
+    assert not dist.is_hosted(["v1", "nope"])
+    assert dist.has_computation("v1")
+    assert not dist.has_computation("zz")
+    with pytest.raises(Exception):
+        dist.agent_for("zz")
+
+
+def test_yaml_dist_file_roundtrip(tmp_path):
+    """Distribution files written to disk reload identically (the
+    `pydcop distribute --output` format)."""
+    from pydcop_tpu.dcop.yamldcop import load_dist_from_file, yaml_dist
+
+    dist = dist_objects.Distribution(
+        {"a1": ["v1", "c1"], "a2": ["v2"]})
+    p = tmp_path / "dist.yaml"
+    p.write_text(yaml_dist(dist))
+    loaded = load_dist_from_file(str(p))
+    assert loaded == dist
