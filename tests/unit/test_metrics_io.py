@@ -1,0 +1,35 @@
+"""CSV metrics writer: reference column schema (solve.py:386-443) and
+append/header semantics."""
+
+import csv
+
+from pydcop_tpu.commands.metrics_io import COLUMNS, add_csvline
+
+
+def test_reference_column_schema():
+    assert COLUMNS == [
+        "time", "cycle", "cost", "violation", "msg_count", "msg_size",
+        "status",
+    ]
+
+
+def test_header_written_once_then_appends(tmp_path):
+    p = tmp_path / "m.csv"
+    add_csvline(str(p), "cycle_change",
+                {"time": 0.5, "cycle": 1, "cost": 10.0, "violation": 0,
+                 "msg_count": 4, "msg_size": 4, "status": "RUNNING"})
+    add_csvline(str(p), "cycle_change",
+                {"time": 1.0, "cycle": 2, "cost": 7.0, "violation": 0,
+                 "msg_count": 8, "msg_size": 8, "status": "RUNNING"})
+    rows = list(csv.reader(p.open()))
+    assert rows[0] == COLUMNS
+    assert len(rows) == 3
+    assert rows[1][1] == "1" and rows[2][1] == "2"
+    assert rows[2][2] == "7.0"
+
+
+def test_missing_keys_become_empty_cells(tmp_path):
+    p = tmp_path / "m.csv"
+    add_csvline(str(p), "value_change", {"cycle": 3})
+    rows = list(csv.reader(p.open()))
+    assert rows[1][0] == "" and rows[1][1] == "3"
