@@ -43,6 +43,10 @@ def set_parser(subparsers):
     parser.add_argument("--trace", default=None,
                         help="per-step trace CSV file (thread mode, "
                              "infrastructure/stats.py)")
+    parser.add_argument("--profile", default=None,
+                        help="device mode: write a JAX profiler trace "
+                             "of the solve to this directory (inspect "
+                             "with TensorBoard / xprof)")
     parser.set_defaults(func=run_cmd)
 
 
@@ -60,10 +64,18 @@ def run_cmd(args) -> int:
 
     t0 = time.perf_counter()
     if args.mode == "device":
-        res = solve(
-            dcop, algo_def, backend="device", max_cycles=args.cycles,
-            n_devices=args.n_devices,
-        )
+        import contextlib
+
+        profile_ctx = contextlib.nullcontext()
+        if args.profile:
+            import jax
+
+            profile_ctx = jax.profiler.trace(args.profile)
+        with profile_ctx:
+            res = solve(
+                dcop, algo_def, backend="device",
+                max_cycles=args.cycles, n_devices=args.n_devices,
+            )
         result = {
             "status": res["status"],
             "assignment": res["assignment"],

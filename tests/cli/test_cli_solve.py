@@ -63,3 +63,17 @@ def test_graph_command():
     ])
     assert result["nodes"] == 5  # 3 vars + 2 constraints
     assert result["edges"] == 4
+
+
+def test_solve_device_profile_writes_trace(tmp_path):
+    """--profile wraps the device solve in a JAX profiler trace; the
+    dump directory must exist and the result must be unaffected."""
+    prof = tmp_path / "prof"
+    result = run_cli([
+        "solve", "--algo", "maxsum", "-c", "50",
+        "--profile", str(prof),
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    assert result["cost"] == -0.1
+    dumps = list((prof / "plugins" / "profile").iterdir())
+    assert len(dumps) == 1
