@@ -94,3 +94,43 @@ def test_solve_mode_process_mgm2():
     result = json.loads(out)
     assert result["backend"] == "process"
     assert set(result["assignment"]) == {"v1", "v2", "v3"}
+
+
+def test_orchestrator_scenario_repair_over_http(tmp_path):
+    """Dynamic multi-machine run: standalone orchestrator with a
+    scenario that removes agent a1 mid-run, 2-replication, repair over
+    real HTTP transports — the full reference resilience flow
+    (orchestrator.py:955-1178) end to end."""
+    port = 19480
+    scenario = os.path.join(
+        os.path.dirname(__file__), "..", "instances",
+        "scenario_remove_a1.yaml")
+    agent_proc = subprocess.Popen(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "90",
+         "agent", "-n", "a1", "a2", "a3", "a4",
+         "-o", f"127.0.0.1:{port}", "-p", str(port + 1),
+         "--capacity", "100", "--replication"],
+        env=ENV, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        time.sleep(0.5)
+        out = subprocess.check_output(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "15",
+             "orchestrator", "-a", "dsa", "-d", "adhoc",
+             "-k", "2", "-s", scenario, "--port", str(port),
+             FIXTURE],
+            timeout=120, env=ENV, stderr=subprocess.DEVNULL,
+        )
+        result = json.loads(out)
+        assert result["backend"] == "multi-machine"
+        # All 10 variables still assigned despite a1's departure.
+        assert len(result["assignment"]) == 10
+        replication = result["replication"]
+        assert replication["ktarget"] == 2
+        # a1 hosted computations; they must have been repaired onto
+        # surviving agents.
+        assert replication["repaired"]
+        assert agent_proc.wait(timeout=45) == 0
+    finally:
+        if agent_proc.poll() is None:
+            agent_proc.kill()
