@@ -111,8 +111,10 @@ class Agent:
         name = comp_name or computation.name
         computation.message_sender = self._messaging.post_msg
         computation._periodic_action_handler = self._add_periodic
-        for period, action in computation._periodic_actions:
-            self._add_periodic(period, action)
+        computation._periodic_remove_handler = self.remove_periodic_action
+        for period, _action, guarded in computation._periodic_actions:
+            # Run the pause-guarded wrapper, not the raw action.
+            self._add_periodic(period, guarded)
         self._computations[name] = computation
         self._messaging.register_computation(name)
         if not name.startswith("_"):
@@ -125,6 +127,13 @@ class Agent:
         comp = self._computations.pop(name, None)
         if comp is not None:
             comp.stop()
+            # Drop its periodic wrappers from our schedule — otherwise
+            # they keep firing for a computation we no longer host
+            # (e.g. an ADSA tick after repair migrated it away).
+            for _period, _action, guarded in comp._periodic_actions:
+                self.remove_periodic_action(guarded)
+            comp._periodic_action_handler = None
+            comp._periodic_remove_handler = None
             self._messaging.unregister_computation(name)
             if not name.startswith("_"):
                 self.discovery.unregister_computation(name)

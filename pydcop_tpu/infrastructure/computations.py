@@ -154,12 +154,15 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
         self._name = name
         self._msg_sender: Optional[Callable] = None
         self._periodic_action_handler = None
+        self._periodic_remove_handler = None
         self._running = False
         self._is_paused = False
         self._paused_messages_post: List[Tuple] = []
         self._paused_messages_recv: List[Tuple] = []
         self.logger = logging.getLogger(f"pydcop.computation.{name}")
-        self._periodic_actions: List[Tuple[float, Callable]] = []
+        # (period, action, pause-guarded wrapper the agent runs).
+        self._periodic_actions: List[
+            Tuple[float, Callable, Callable]] = []
 
     @property
     def name(self) -> str:
@@ -258,16 +261,31 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
 
     def add_periodic_action(self, period: float, action: Callable):
         """Register `action` to run every `period` seconds on the agent
-        thread (reference computations.py:546)."""
-        self._periodic_actions.append((period, action))
+        thread.  Reference semantics (computations.py:546-566): the
+        action is wrapped in a pause guard, so a paused computation's
+        periodic actions do not fire."""
+
+        def guarded():
+            if not self._is_paused:
+                action()
+
+        self._periodic_actions.append((period, action, guarded))
         if self._periodic_action_handler:
-            self._periodic_action_handler(period, action)
+            self._periodic_action_handler(period, guarded)
         return action
 
     def remove_periodic_action(self, action):
-        self._periodic_actions = [
-            (p, a) for p, a in self._periodic_actions if a is not action
-        ]
+        """Unregister every registration of `action` (equality, not
+        identity — bound methods compare equal across accesses); takes
+        effect immediately even when the computation is already
+        deployed on an agent (reference computations.py:568)."""
+        kept, removed = [], []
+        for entry in self._periodic_actions:
+            (removed if entry[1] == action else kept).append(entry)
+        self._periodic_actions = kept
+        if self._periodic_remove_handler:
+            for _, _, guarded in removed:
+                self._periodic_remove_handler(guarded)
 
     def finished(self):
         """Signal the end of this computation (picked up by the hosting

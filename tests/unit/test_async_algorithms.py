@@ -120,7 +120,7 @@ class TestAdsa:
         comp = _adsa_comp()
         comp.start()
         assert comp._periodic_actions, "no periodic action registered"
-        period, action = comp._periodic_actions[0]
+        period, action, _guard = comp._periodic_actions[0]
         assert period == 0.05
         assert action == comp.tick
 
@@ -189,3 +189,134 @@ class TestAsyncEndToEnd:
         )
         assert res["status"] == "FINISHED"
         assert res["violations"] == 0
+
+
+class TestPeriodicActionSemantics:
+    """Reference periodic-action semantics
+    (computations.py:546-568, tests at
+    test_infra_computations.py:122-278): pause suppression and
+    removal-after-deployment."""
+
+    def _agent_with(self, comp):
+        from pydcop_tpu.infrastructure.agents import Agent
+        from pydcop_tpu.infrastructure.communication import (
+            InProcessCommunicationLayer,
+        )
+
+        agent = Agent("a", InProcessCommunicationLayer())
+        agent.add_computation(comp)
+        agent.start()
+        agent.run()
+        return agent
+
+    def test_periodic_action_fires_on_agent_thread(self):
+        import time
+
+        from pydcop_tpu.infrastructure.computations import (
+            MessagePassingComputation,
+        )
+
+        comp = MessagePassingComputation("t")
+        calls = []
+        comp.add_periodic_action(0.05, lambda: calls.append(1))
+        agent = self._agent_with(comp)
+        try:
+            time.sleep(0.4)
+            assert len(calls) >= 2
+        finally:
+            agent.stop()
+
+    def test_periodic_action_not_called_when_paused(self):
+        import time
+
+        from pydcop_tpu.infrastructure.computations import (
+            MessagePassingComputation,
+        )
+
+        comp = MessagePassingComputation("t")
+        calls = []
+        comp.add_periodic_action(0.05, lambda: calls.append(1))
+        agent = self._agent_with(comp)
+        try:
+            time.sleep(0.3)
+            assert calls, "action never fired while running"
+            comp.pause(True)
+            time.sleep(0.1)      # drain an in-flight tick
+            n = len(calls)
+            time.sleep(0.3)
+            assert len(calls) == n, "action fired while paused"
+            comp.pause(False)
+            time.sleep(0.3)
+            assert len(calls) > n, "action did not resume"
+        finally:
+            agent.stop()
+
+    def test_remove_periodic_action_after_deployment(self):
+        import time
+
+        from pydcop_tpu.infrastructure.computations import (
+            MessagePassingComputation,
+        )
+
+        comp = MessagePassingComputation("t")
+        calls = []
+
+        def action():
+            calls.append(1)
+
+        comp.add_periodic_action(0.05, action)
+        agent = self._agent_with(comp)
+        try:
+            time.sleep(0.3)
+            assert calls
+            comp.remove_periodic_action(action)
+            time.sleep(0.1)
+            n = len(calls)
+            time.sleep(0.3)
+            assert len(calls) == n, "action fired after removal"
+        finally:
+            agent.stop()
+
+    def test_remove_computation_stops_periodic_actions(self):
+        import time
+
+        from pydcop_tpu.infrastructure.computations import (
+            MessagePassingComputation,
+        )
+
+        comp = MessagePassingComputation("t")
+        calls = []
+        comp.add_periodic_action(0.05, lambda: calls.append(1))
+        agent = self._agent_with(comp)
+        try:
+            time.sleep(0.3)
+            assert calls
+            agent.remove_computation("t")
+            time.sleep(0.1)
+            n = len(calls)
+            time.sleep(0.3)
+            assert len(calls) == n, \
+                "periodic action fired after remove_computation"
+        finally:
+            agent.stop()
+
+    def test_bound_method_action_removable(self):
+        """Bound methods compare equal but are not identical across
+        accesses: removal must use equality."""
+        from pydcop_tpu.infrastructure.computations import (
+            MessagePassingComputation,
+        )
+
+        class C(MessagePassingComputation):
+            def __init__(self):
+                super().__init__("t")
+                self.ticks = 0
+
+            def tick(self):
+                self.ticks += 1
+
+        comp = C()
+        comp.add_periodic_action(0.05, comp.tick)
+        assert comp.tick is not comp._periodic_actions[0][1]
+        comp.remove_periodic_action(comp.tick)
+        assert not comp._periodic_actions
