@@ -1,0 +1,122 @@
+"""Agent lifecycle battery (reference scope:
+tests/unit/test_infra_agents.py:107-351 — behaviors re-derived from the
+runtime contract): add/remove computations around start, run-by-name,
+pause fan-out, double-start."""
+
+import time
+
+import pytest
+
+from pydcop_tpu.infrastructure.agents import Agent, AgentException
+from pydcop_tpu.infrastructure.communication import (
+    InProcessCommunicationLayer,
+)
+from pydcop_tpu.infrastructure.computations import (
+    MessagePassingComputation,
+)
+
+
+class Recorder(MessagePassingComputation):
+    def __init__(self, name):
+        super().__init__(name)
+        self.started = False
+
+    def on_start(self):
+        self.started = True
+
+
+def _agent(name="a1"):
+    return Agent(name, InProcessCommunicationLayer())
+
+
+def test_add_computation_before_start():
+    agent = _agent()
+    comp = Recorder("c1")
+    agent.add_computation(comp)
+    assert "c1" in [c.name for c in agent.computations]
+    agent.start()
+    try:
+        agent.run()
+        time.sleep(0.1)
+        assert comp.started
+    finally:
+        agent.stop()
+
+
+def test_add_computation_after_start():
+    agent = _agent()
+    agent.start()
+    try:
+        comp = Recorder("c1")
+        agent.add_computation(comp)
+        assert "c1" in [c.name for c in agent.computations]
+        agent.run(["c1"])
+        time.sleep(0.1)
+        assert comp.started
+    finally:
+        agent.stop()
+
+
+def test_run_computation_by_name_only_starts_named():
+    agent = _agent()
+    c1, c2 = Recorder("c1"), Recorder("c2")
+    agent.add_computation(c1)
+    agent.add_computation(c2)
+    agent.start()
+    try:
+        agent.run(["c1"])
+        time.sleep(0.1)
+        assert c1.started and c1.is_running
+        assert not c2.started
+    finally:
+        agent.stop()
+
+
+def test_remove_running_computation():
+    agent = _agent()
+    comp = Recorder("c1")
+    agent.add_computation(comp)
+    agent.start()
+    try:
+        agent.run()
+        time.sleep(0.1)
+        agent.remove_computation("c1")
+        assert "c1" not in [c.name for c in agent.computations]
+        assert not comp.is_running
+    finally:
+        agent.stop()
+
+
+def test_pause_several_computations():
+    agent = _agent()
+    comps = [Recorder(f"c{i}") for i in range(3)]
+    for c in comps:
+        agent.add_computation(c)
+    agent.start()
+    try:
+        agent.run()
+        time.sleep(0.1)
+        for c in comps:
+            c.pause(True)
+        assert all(c.is_paused for c in comps)
+        for c in comps:
+            c.pause(False)
+        assert not any(c.is_paused for c in comps)
+    finally:
+        agent.stop()
+
+
+def test_double_start_raises():
+    agent = _agent()
+    agent.start()
+    try:
+        with pytest.raises(AgentException):
+            agent.start()
+    finally:
+        agent.stop()
+
+
+def test_computation_accessor_unknown_raises():
+    agent = _agent()
+    with pytest.raises(Exception):
+        agent.computation("nope")
