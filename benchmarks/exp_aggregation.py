@@ -1,0 +1,126 @@
+"""Aggregation-strategy experiment for the MaxSum superstep's variable
+aggregation — the op that dominates past the 100k-var scale cliff
+(BENCH_TPU.md: 2 us/cycle at 10k vars vs 8.4 ms/cycle at 100k on a
+v5e; the scatter-add and tiny-minor-dim gathers are the suspects).
+
+Three strategies, identical math (up to float reassociation):
+
+- scatter:   jax.ops.segment_sum on unsorted edge ids (current engine,
+             ops/maxsum.aggregate_beliefs).
+- sorted:    segment_sum on compile-time-sorted ids with
+             indices_are_sorted=True (static permutation; the gather of
+             messages into sorted order happens per cycle).
+- boundary:  compile-time edge sort + cumsum along edges + per-variable
+             boundary gathers — no scatter at all.
+
+Run on the target backend:  python benchmarks/exp_aggregation.py
+Prints one JSON line per size with ms/iteration for each strategy; use
+it to decide whether the engine's aggregation is worth rewriting for
+the HBM-bound regime (keep the engine unchanged until the winner is
+measured on real hardware).
+"""
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def build(n_vars, n_edges, d, seed=0):
+    rng = np.random.default_rng(seed)
+    seg = rng.integers(0, n_vars, size=n_edges).astype(np.int32)
+    msgs = rng.random((n_edges, d)).astype(np.float32)
+    perm = np.argsort(seg, kind="stable").astype(np.int32)
+    sorted_seg = seg[perm]
+    # Boundary offsets: starts[v] .. ends[v] index into the sorted
+    # edge order (searchsorted on the static sorted ids).
+    starts = np.searchsorted(sorted_seg, np.arange(n_vars),
+                             side="left").astype(np.int32)
+    ends = np.searchsorted(sorted_seg, np.arange(n_vars),
+                           side="right").astype(np.int32)
+    return seg, msgs, perm, sorted_seg, starts, ends
+
+
+def main():
+    from pydcop_tpu.utils.cleanenv import ensure_live_backend
+
+    ensure_live_backend(tag="exp_aggregation")
+    import jax
+    import jax.numpy as jnp
+
+    d = 3
+    iters = 100
+
+    def timeit(fn, *args):
+        out = jax.block_until_ready(fn(*args))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        return min(ts) / iters * 1e3, out  # ms per iteration
+
+    for n_vars in (10_000, 100_000, 1_000_000):
+        n_edges = n_vars * 3
+        seg, msgs, perm, sorted_seg, starts, ends = build(
+            n_vars, n_edges, d)
+
+        @jax.jit
+        def run_scatter(msgs, seg):
+            def step(m, _):
+                s = jax.ops.segment_sum(m, seg, num_segments=n_vars)
+                # feed result back so iterations can't collapse
+                return m + 1e-9 * s[seg], None
+            m, _ = jax.lax.scan(step, msgs, None, length=iters)
+            return jax.ops.segment_sum(m, seg, num_segments=n_vars)
+
+        @jax.jit
+        def run_sorted(msgs, seg_s, perm):
+            def agg(m):
+                return jax.ops.segment_sum(
+                    m[perm], seg_s, num_segments=n_vars,
+                    indices_are_sorted=True)
+            def step(m, _):
+                s = agg(m)
+                return m + 1e-9 * s[seg], None
+            m, _ = jax.lax.scan(step, msgs, None, length=iters)
+            return agg(m)
+
+        @jax.jit
+        def run_boundary(msgs, perm, starts, ends):
+            def agg(m):
+                cum = jnp.cumsum(m[perm], axis=0)
+                cz = jnp.concatenate(
+                    [jnp.zeros((1, d), jnp.float32), cum], axis=0)
+                return cz[ends] - cz[starts]
+            def step(m, _):
+                s = agg(m)
+                return m + 1e-9 * s[seg], None
+            m, _ = jax.lax.scan(step, msgs, None, length=iters)
+            return agg(m)
+
+        t_sc, ref = timeit(run_scatter, jnp.asarray(msgs),
+                           jnp.asarray(seg))
+        t_so, out_so = timeit(run_sorted, jnp.asarray(msgs),
+                              jnp.asarray(sorted_seg),
+                              jnp.asarray(perm))
+        t_bo, out_bo = timeit(run_boundary, jnp.asarray(msgs),
+                              jnp.asarray(perm), jnp.asarray(starts),
+                              jnp.asarray(ends))
+        err_so = float(jnp.max(jnp.abs(ref - out_so)))
+        err_bo = float(jnp.max(jnp.abs(ref - out_bo)))
+        print(json.dumps({
+            "n_vars": n_vars, "n_edges": n_edges,
+            "backend": jax.devices()[0].platform,
+            "scatter_ms": round(t_sc, 4),
+            "sorted_ms": round(t_so, 4),
+            "boundary_ms": round(t_bo, 4),
+            "sorted_err": err_so, "boundary_err": err_bo,
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
