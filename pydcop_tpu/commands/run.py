@@ -36,9 +36,11 @@ def set_parser(subparsers):
                              "the candidate agents (reference "
                              "architecture)")
     parser.add_argument("-m", "--mode", default="thread",
-                        choices=["thread", "device"],
+                        choices=["thread", "process", "device"],
                         help="execution mode: 'thread' = agent runtime "
-                             "with replication/repair; 'device' = "
+                             "with replication/repair; 'process' = one "
+                             "OS process per agent over HTTP "
+                             "(reference run.py:387); 'device' = "
                              "dynamic device engine (warm-started "
                              "across events, placement re-homed on "
                              "agent departure)")
@@ -62,6 +64,7 @@ def run_cmd(args) -> int:
     )
     from pydcop_tpu.infrastructure.run import (
         _build_distribution,
+        run_local_process_dcop,
         run_local_thread_dcop,
     )
 
@@ -103,7 +106,9 @@ def run_cmd(args) -> int:
             add_csvline(args.run_metrics, args.collect_on, metrics)
 
     timeout = args.timeout if args.timeout is not None else 20.0
-    orchestrator = run_local_thread_dcop(
+    runner = (run_local_process_dcop if args.mode == "process"
+              else run_local_thread_dcop)
+    orchestrator = runner(
         algo_def, cg, distribution, dcop, infinity=args.infinity,
         replication=True, collector=collector,
         collect_moment=args.collect_on, collect_period=args.period,
@@ -111,7 +116,8 @@ def run_cmd(args) -> int:
     )
     stopped = False
     try:
-        if not orchestrator.wait_ready(10):
+        if not orchestrator.wait_ready(
+                30 if args.mode == "process" else 10):
             print("Error: agents did not become ready")
             return 3
         orchestrator.deploy_computations()
@@ -140,7 +146,7 @@ def run_cmd(args) -> int:
                     orchestrator.mgt.repaired_computations
                 ),
             },
-            "backend": "thread",
+            "backend": args.mode,
         }
     finally:
         if not stopped:

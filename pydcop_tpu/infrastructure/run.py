@@ -112,10 +112,12 @@ def run_local_process_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                            port: int = 9000,
                            collector=None,
                            collect_moment: str = "value_change",
-                           collect_period: float = 1.0) -> Orchestrator:
+                           collect_period: float = 1.0,
+                           repair_mode: str = "device") -> Orchestrator:
     """One OS process per agent, JSON-over-HTTP transports on localhost
     ports (reference run.py:225) — the single-host stand-in for true
-    multi-machine deployments."""
+    multi-machine deployments.  Scenario ``add_agent`` events spawn
+    fresh agent processes through ``orchestrator.agent_factory``."""
     import multiprocessing
 
     from pydcop_tpu.infrastructure.communication import (
@@ -126,23 +128,30 @@ def run_local_process_dcop(algo: AlgorithmDef, cg, distribution, dcop,
     orchestrator = Orchestrator(
         algo, cg, distribution, comm, dcop, infinity,
         collector=collector, collect_moment=collect_moment,
-        collect_period=collect_period,
+        collect_period=collect_period, repair_mode=repair_mode,
     )
     orchestrator.start()
     ctx = multiprocessing.get_context("spawn")
-    for agent_def in dcop.agents.values():
-        if not distribution.computations_hosted(agent_def.name) \
-                and not replication:
-            continue
-        port += 1
+    next_port = [port]
+
+    def _spawn_agent(agent_def):
+        next_port[0] += 1
         p = ctx.Process(
             target=_process_agent_main,
             name=f"p_{agent_def.name}",
-            args=(agent_def, port, orchestrator.address),
+            args=(agent_def, next_port[0], orchestrator.address),
             kwargs={"replication": replication},
             daemon=True,
         )
         p.start()
+        return p
+
+    for agent_def in dcop.agents.values():
+        if not distribution.computations_hosted(agent_def.name) \
+                and not replication:
+            continue
+        _spawn_agent(agent_def)
+    orchestrator.agent_factory = _spawn_agent
     return orchestrator
 
 

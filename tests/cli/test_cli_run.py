@@ -87,3 +87,19 @@ def test_run_device_mode_scenario():
         assert result["cycle"] > ev["cycle"]
     # No graph change happened, so the slack path never recompiled.
     assert result["recompiles"] == 0
+
+
+def test_run_process_mode_scenario_repairs():
+    """Dynamic DCOP over OS processes (reference run.py:387): scenario
+    removes a1, repair migrates its computations, all over HTTP between
+    spawned agent processes."""
+    result = run_cli([
+        "-t", "12",
+        "run", "-a", "dsa", "-d", "adhoc", "-m", "process", "-k", "2",
+        "-s", os.path.join(INSTANCES, "scenario_remove_a1.yaml"),
+        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+    ], timeout=180)
+    assert result["backend"] == "process"
+    assert len(result["assignment"]) == 10
+    assert result["replication"]["ktarget"] == 2
+    assert result["replication"]["repaired"]
