@@ -26,6 +26,13 @@ def set_parser(subparsers):
                         help="distribution method or file")
     parser.add_argument("-s", "--scenario", required=True,
                         help="scenario yaml file")
+    parser.add_argument("-r", "--replication_method",
+                        default="dist_ucs_hostingcosts",
+                        choices=["dist_ucs_hostingcosts"],
+                        help="replication method (reference parity; "
+                             "'dist_ucs_hostingcosts' is the only one "
+                             "the reference ships, and the only one "
+                             "here)")
     parser.add_argument("-k", "--ktarget", type=int, default=3,
                         help="number of replicas per computation")
     parser.add_argument("--repair", default="device",
@@ -116,8 +123,14 @@ def run_cmd(args) -> int:
     )
     stopped = False
     try:
+        from pydcop_tpu.infrastructure.run import (
+            PROCESS_READY_TIMEOUT,
+            THREAD_READY_TIMEOUT,
+        )
+
         if not orchestrator.wait_ready(
-                30 if args.mode == "process" else 10):
+                PROCESS_READY_TIMEOUT if args.mode == "process"
+                else THREAD_READY_TIMEOUT):
             print("Error: agents did not become ready")
             return 3
         orchestrator.deploy_computations()

@@ -258,6 +258,9 @@ class Orchestrator:
         # AgentDef to create + start a new agent for add_agent
         # scenario events.
         self.agent_factory = None
+        # Readiness window for scenario-added agents; runners override
+        # (process agents pay a spawn + import before registering).
+        self.agent_ready_timeout: float = 10.0
         self._removed_agents: set = set()
         # Last requested replica count; scenario events re-trigger
         # replication with it to heal replica counts after
@@ -421,14 +424,19 @@ class Orchestrator:
             self._replication_evt.wait(min(0.1, remaining))
         return ReplicaDistribution(self.mgt.replica_hosts)
 
-    def add_agent(self, agent_def, timeout: float = 10):
+    def add_agent(self, agent_def, timeout: Optional[float] = None):
         """Scenario-driven agent arrival: spin up a new (empty) agent
         that can host replicas and repaired computations (reference
         scenario add_agent action, dcop/scenario.py:37).
 
         Blocks until the new agent has registered with the directory
         and reported ready, so a subsequent replication heal can see
-        it (registration is asynchronous message traffic)."""
+        it (registration is asynchronous message traffic).  The default
+        window is ``self.agent_ready_timeout`` — the runner sets it
+        (process-mode agents need a spawn + package import before they
+        can register)."""
+        if timeout is None:
+            timeout = self.agent_ready_timeout
         if self.agent_factory is None:
             logger.warning(
                 "No agent factory: cannot add agent %s", agent_def.name

@@ -21,6 +21,13 @@ from pydcop_tpu.infrastructure.orchestrator import Orchestrator
 logger = logging.getLogger("pydcop.run")
 
 
+# Readiness window for agents that live in spawned OS processes: the
+# child pays interpreter start + package import before it can register.
+# Thread-mode agents register in milliseconds; 10 s is generous there.
+PROCESS_READY_TIMEOUT = 30.0
+THREAD_READY_TIMEOUT = 10.0
+
+
 def _build_distribution(dcop: DCOP, cg, algo_module,
                         distribution: str) -> Distribution:
     if distribution.endswith((".yaml", ".yml")):
@@ -152,6 +159,7 @@ def run_local_process_dcop(algo: AlgorithmDef, cg, distribution, dcop,
             continue
         _spawn_agent(agent_def)
     orchestrator.agent_factory = _spawn_agent
+    orchestrator.agent_ready_timeout = PROCESS_READY_TIMEOUT
     return orchestrator
 
 
@@ -235,7 +243,9 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
         )
     stopped = False
     try:
-        if not orchestrator.wait_ready(30 if mode == "process" else 10):
+        if not orchestrator.wait_ready(
+                PROCESS_READY_TIMEOUT if mode == "process"
+                else THREAD_READY_TIMEOUT):
             raise RuntimeError("Agents did not become ready in time")
         orchestrator.deploy_computations()
         orchestrator.run(timeout=timeout)
