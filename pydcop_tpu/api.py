@@ -36,6 +36,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           collector=None,
           collect_moment: str = "value_change",
           collect_period: float = 1.0,
+          delay: Optional[float] = None,
           ) -> SolveResult:
     """Solve a DCOP and return assignment + quality metrics.
 
@@ -102,7 +103,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             timeout=timeout, max_cycles=max_cycles, mode=backend,
             ui_port=ui_port, collector=collector,
             collect_moment=collect_moment,
-            collect_period=collect_period,
+            collect_period=collect_period, delay=delay,
         )
 
     raise ValueError(f"Unknown backend {backend!r}")

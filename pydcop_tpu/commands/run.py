@@ -70,6 +70,8 @@ def run_cmd(args) -> int:
         load_scenario_from_file,
     )
     from pydcop_tpu.infrastructure.run import (
+        PROCESS_READY_TIMEOUT,
+        THREAD_READY_TIMEOUT,
         _build_distribution,
         run_local_process_dcop,
         run_local_thread_dcop,
@@ -123,11 +125,6 @@ def run_cmd(args) -> int:
     )
     stopped = False
     try:
-        from pydcop_tpu.infrastructure.run import (
-            PROCESS_READY_TIMEOUT,
-            THREAD_READY_TIMEOUT,
-        )
-
         if not orchestrator.wait_ready(
                 PROCESS_READY_TIMEOUT if args.mode == "process"
                 else THREAD_READY_TIMEOUT):

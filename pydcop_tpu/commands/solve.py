@@ -7,9 +7,12 @@ on TPU/CPU), ``--mode thread`` / ``--mode process`` (agent runtime,
 reference semantics).
 """
 
+import logging
 import time
 
 from pydcop_tpu.commands._utils import build_algo_def, emit_result
+
+logger = logging.getLogger("pydcop.cli.solve")
 
 
 def set_parser(subparsers):
@@ -47,6 +50,11 @@ def set_parser(subparsers):
                         help="device mode: write a JAX profiler trace "
                              "of the solve to this directory (inspect "
                              "with TensorBoard / xprof)")
+    parser.add_argument("--delay", type=float, default=None,
+                        help="delay (s) between message deliveries — "
+                             "for observing algorithms live, e.g. with "
+                             "--uiport (thread mode; reference solve "
+                             "--delay)")
     parser.set_defaults(func=run_cmd)
 
 
@@ -63,6 +71,11 @@ def run_cmd(args) -> int:
     algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
 
     t0 = time.perf_counter()
+    if args.delay and args.mode != "thread":
+        logger.warning(
+            "--delay only applies to thread mode (ignored in %s mode)",
+            args.mode,
+        )
     if args.mode == "device":
         import contextlib
 
@@ -130,7 +143,7 @@ def run_cmd(args) -> int:
             backend=args.mode, timeout=timeout,
             max_cycles=args.cycles, ui_port=args.uiport,
             collector=collector, collect_moment=args.collect_on,
-            collect_period=args.period,
+            collect_period=args.period, delay=args.delay,
         )
         result = {
             "status": res["status"],

@@ -197,7 +197,8 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
                       ui_port: Optional[int] = None,
                       collector=None,
                       collect_moment: str = "value_change",
-                      collect_period: float = 1.0) -> Dict:
+                      collect_period: float = 1.0,
+                      delay: Optional[float] = None) -> Dict:
     """Full-metrics variant used by the api/CLI thread backend."""
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
@@ -238,6 +239,7 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
     else:
         orchestrator = run_local_thread_dcop(
             algo_def, cg, distribution, dcop, ui_port=ui_port,
+            delay=delay,
             collector=collector, collect_moment=collect_moment,
             collect_period=collect_period,
         )

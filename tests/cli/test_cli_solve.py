@@ -77,3 +77,23 @@ def test_solve_device_profile_writes_trace(tmp_path):
     assert result["cost"] == -0.1
     dumps = list((prof / "plugins" / "profile").iterdir())
     assert len(dumps) == 1
+
+
+def test_solve_delay_throttles_messages():
+    """--delay inserts a per-message delivery delay (reference solve
+    --delay): cycle throughput collapses accordingly."""
+    slow = run_cli([
+        "-t", "2", "solve", "--algo", "maxsum", "-m", "thread",
+        "-d", "adhoc", "--delay", "0.1",
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    fast = run_cli([
+        "-t", "2", "solve", "--algo", "maxsum", "-m", "thread",
+        "-d", "adhoc",
+        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+    ])
+    # 0.1 s per message bounds the delayed run to a handful of cycles;
+    # the undelayed run does hundreds even on a loaded machine.  Avoid
+    # a fixed throughput ratio — it encodes machine speed (review).
+    assert slow["cycle"] < 50
+    assert slow["cycle"] < fast["cycle"]
