@@ -33,6 +33,12 @@ def set_parser(subparsers):
                              "(resilient agents)")
     parser.add_argument("--restart", action="store_true", default=False,
                         help="restart agents after each run")
+    parser.add_argument("--delay", type=float, default=None,
+                        help="delay (s) between message deliveries "
+                             "(live observation; reference agent "
+                             "--delay)")
+    parser.add_argument("--uiport", type=int, default=None,
+                        help="first websocket UI port (one per agent)")
     parser.set_defaults(func=run_cmd)
 
 
@@ -47,16 +53,20 @@ def _start_agents(args, orchestrator_address):
 
     agents = []
     port = args.port
+    ui_port = args.uiport
     for name in args.names:
         comm = HttpCommunicationLayer((args.address, port))
         agent = OrchestratedAgent(
             AgentDef(name, capacity=args.capacity), comm,
             orchestrator_address, replication=args.replication,
+            delay=args.delay, ui_port=ui_port,
         )
         agent.start()
         logger.info("Agent %s on %s:%s", name, args.address, port)
         agents.append(agent)
         port += 1
+        if ui_port:
+            ui_port += 1
     return agents
 
 

@@ -53,8 +53,8 @@ def set_parser(subparsers):
     parser.add_argument("--delay", type=float, default=None,
                         help="delay (s) between message deliveries — "
                              "for observing algorithms live, e.g. with "
-                             "--uiport (thread mode; reference solve "
-                             "--delay)")
+                             "--uiport (thread/process modes; "
+                             "reference solve --delay)")
     parser.set_defaults(func=run_cmd)
 
 
@@ -71,10 +71,10 @@ def run_cmd(args) -> int:
     algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
 
     t0 = time.perf_counter()
-    if args.delay and args.mode != "thread":
+    if args.delay and args.mode == "device":
         logger.warning(
-            "--delay only applies to thread mode (ignored in %s mode)",
-            args.mode,
+            "--delay only applies to agent modes (ignored in device "
+            "mode)"
         )
     if args.mode == "device":
         import contextlib

@@ -91,7 +91,8 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
 
 
 def _process_agent_main(agent_def, port: int, orchestrator_address,
-                        replication: bool = False):
+                        replication: bool = False,
+                        delay=None):
     """Child-process entry: one agent on its own HTTP transport
     (reference run.py:268 _build_process_agent)."""
     import time as _time
@@ -103,7 +104,7 @@ def _process_agent_main(agent_def, port: int, orchestrator_address,
     comm = HttpCommunicationLayer(("127.0.0.1", port))
     agent = OrchestratedAgent(
         agent_def, comm, tuple(orchestrator_address),
-        replication=replication,
+        replication=replication, delay=delay,
     )
     agent.start()
     # Keep the process alive until the agent thread stops (StopAgent).
@@ -120,7 +121,8 @@ def run_local_process_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                            collector=None,
                            collect_moment: str = "value_change",
                            collect_period: float = 1.0,
-                           repair_mode: str = "device") -> Orchestrator:
+                           repair_mode: str = "device",
+                           delay=None) -> Orchestrator:
     """One OS process per agent, JSON-over-HTTP transports on localhost
     ports (reference run.py:225) — the single-host stand-in for true
     multi-machine deployments.  Scenario ``add_agent`` events spawn
@@ -147,7 +149,7 @@ def run_local_process_dcop(algo: AlgorithmDef, cg, distribution, dcop,
             target=_process_agent_main,
             name=f"p_{agent_def.name}",
             args=(agent_def, next_port[0], orchestrator.address),
-            kwargs={"replication": replication},
+            kwargs={"replication": replication, "delay": delay},
             daemon=True,
         )
         p.start()
@@ -232,7 +234,7 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             dcop, cg, algo_module, distribution)
     if mode == "process":
         orchestrator = run_local_process_dcop(
-            algo_def, cg, distribution, dcop,
+            algo_def, cg, distribution, dcop, delay=delay,
             collector=collector, collect_moment=collect_moment,
             collect_period=collect_period,
         )
