@@ -35,6 +35,11 @@ def set_parser(subparsers):
                              "against the dcop given in files")
     parser.add_argument("--algo", default=None,
                         help="algorithm (for distribution costs)")
+    parser.add_argument("--average", action="store_true", default=False,
+                        help="average end metrics over the given json "
+                             "result files (the reference declares "
+                             "this flag but never implemented it; "
+                             "here it works)")
     parser.add_argument("--replace_output", action="store_true",
                         default=False,
                         help="overwrite the output file instead of "
@@ -61,7 +66,16 @@ def run_cmd(args) -> int:
         )
         _emit(rows, DIST_HEADER, args.output)
         return 0
-    print("Error: choose --solution or --distribution_cost")
+    if args.average:
+        row, count = _average_row(args.files)
+        if not count:
+            print("Error: no parseable result file among "
+                  f"{args.files}")
+            return 2
+        _emit([row], ["n_runs"] + SOLUTION_HEADER[:-1] +
+              ["finished_frac"], args.output)
+        return 0
+    print("Error: choose --solution, --distribution_cost or --average")
     return 2
 
 
@@ -69,6 +83,37 @@ def _solution_row(path: str):
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
     return [data.get(k) for k in SOLUTION_HEADER]
+
+
+def _average_row(files):
+    """Mean of the numeric end metrics over result files + the
+    fraction of runs that FINISHED; non-parsable files are skipped
+    with a warning (matching --solution)."""
+    numeric = SOLUTION_HEADER[:-1]  # all but status
+    sums = {k: 0.0 for k in numeric}
+    counts = {k: 0 for k in numeric}
+    finished = 0
+    n = 0
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except Exception as e:
+            logger.warning("Skipping %s: %s", path, e)
+            continue
+        n += 1
+        if data.get("status") == "FINISHED":
+            finished += 1
+        for k in numeric:
+            v = data.get(k)
+            if isinstance(v, (int, float)):
+                sums[k] += v
+                counts[k] += 1
+    row = [n] + [
+        round(sums[k] / counts[k], 6) if counts[k] else None
+        for k in numeric
+    ] + [round(finished / n, 4) if n else None]
+    return row, n
 
 
 def _distribution_rows(dcop_files, dist_glob, algo):

@@ -37,6 +37,19 @@ def set_parser(subparsers):
                              "--replication)")
     parser.add_argument("--wait_ready_timeout", type=float, default=60,
                         help="how long to wait for agents to register")
+    parser.add_argument("--collect_on", default="value_change",
+                        choices=["value_change", "cycle_change",
+                                 "period"],
+                        help="when metrics rows are collected")
+    parser.add_argument("--period", type=float, default=1.0,
+                        help="collection period for --collect_on "
+                             "period")
+    parser.add_argument("--run_metrics", default=None,
+                        help="stream metrics rows to this csv during "
+                             "the run")
+    parser.add_argument("--end_metrics", default=None,
+                        help="append the final summary row to this "
+                             "csv")
     parser.set_defaults(func=run_cmd)
 
 
@@ -66,10 +79,19 @@ def run_cmd(args) -> int:
         dcop, cg, algo_module, args.distribution
     )
 
+    collector = None
+    if args.run_metrics:
+        from pydcop_tpu.commands.metrics_io import add_csvline
+
+        def collector(metrics):
+            add_csvline(args.run_metrics, args.collect_on, metrics)
+
     comm = HttpCommunicationLayer((args.address, args.port))
     orchestrator = Orchestrator(
         algo_def, cg, distribution, comm, dcop, args.infinity
         if hasattr(args, "infinity") else float("inf"),
+        collector=collector, collect_moment=args.collect_on,
+        collect_period=args.period,
     )
     orchestrator.start()
     stopped = False
@@ -119,6 +141,16 @@ def run_cmd(args) -> int:
         if not stopped:
             orchestrator.stop_agents(10)
         orchestrator.stop()
+
+    if args.run_metrics or args.end_metrics:
+        from pydcop_tpu.commands.metrics_io import add_csvline
+
+        # Run metrics streamed live above; both files always get the
+        # final summary row so they exist even when no collection
+        # event fired (same guarantee as solve.py).
+        for path in (args.run_metrics, args.end_metrics):
+            if path:
+                add_csvline(path, args.collect_on, result)
 
     emit_result(result, args.output)
     return 0

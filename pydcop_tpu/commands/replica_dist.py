@@ -23,6 +23,16 @@ def set_parser(subparsers):
                         help="distribution method or file")
     parser.add_argument("-k", "--ktarget", type=int, required=True,
                         help="number of replicas per computation")
+    parser.add_argument("--replication",
+                        default="dist_ucs_hostingcosts",
+                        choices=["dist_ucs_hostingcosts"],
+                        help="replication algorithm (reference "
+                             "parity; hosting-cost UCS is the only "
+                             "complete one the reference ships)")
+    parser.add_argument("-m", "--mode", default="thread",
+                        choices=["thread", "process"],
+                        help="run the placement protocol on agent "
+                             "threads or one OS process per agent")
     parser.set_defaults(func=run_cmd)
 
 
@@ -31,7 +41,10 @@ def run_cmd(args) -> int:
     from pydcop_tpu.computations_graph import load_graph_module
     from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
     from pydcop_tpu.infrastructure.run import (
+        PROCESS_READY_TIMEOUT,
+        THREAD_READY_TIMEOUT,
         _build_distribution,
+        run_local_process_dcop,
         run_local_thread_dcop,
     )
 
@@ -43,11 +56,19 @@ def run_cmd(args) -> int:
     distribution = _build_distribution(
         dcop, cg, algo_module, args.distribution
     )
-    orchestrator = run_local_thread_dcop(
+    # args.replication is argparse-constrained to the single
+    # implemented algorithm (the runners hardwire the hosting-cost UCS
+    # computation); when a second algorithm lands, thread the choice
+    # through run_local_*_dcop -> OrchestratedAgent here.
+    runner = (run_local_process_dcop if args.mode == "process"
+              else run_local_thread_dcop)
+    orchestrator = runner(
         algo_def, cg, distribution, dcop, replication=True
     )
     try:
-        if not orchestrator.wait_ready(10):
+        if not orchestrator.wait_ready(
+                PROCESS_READY_TIMEOUT if args.mode == "process"
+                else THREAD_READY_TIMEOUT):
             print("Error: agents did not become ready")
             return 3
         orchestrator.deploy_computations()

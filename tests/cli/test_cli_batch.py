@@ -127,3 +127,30 @@ def test_consolidate_distribution_cost(tmp_path):
     row = res.stdout.strip().split(",")
     assert len(row) == 5
     assert row[1] == str(dist)
+
+
+def test_consolidate_average(tmp_path):
+    """--average (declared-but-unimplemented in the reference; real
+    here): numeric means + FINISHED fraction over result files."""
+    r1 = {"time": 2.0, "cost": 10, "cycle": 5, "msg_count": 100,
+          "msg_size": 200, "status": "FINISHED"}
+    r2 = {"time": 4.0, "cost": 20, "cycle": 15, "msg_count": 300,
+          "msg_size": 400, "status": "TIMEOUT"}
+    f1, f2 = tmp_path / "r1.json", tmp_path / "r2.json"
+    f1.write_text(json.dumps(r1))
+    f2.write_text(json.dumps(r2))
+    res = cli(["consolidate", "--average", str(f1), str(f2)])
+    assert res.returncode == 0
+    assert res.stdout.strip() == "2,3.0,15.0,10.0,200.0,300.0,0.5"
+
+
+def test_consolidate_average_skips_bad_files(tmp_path):
+    good = tmp_path / "g.json"
+    good.write_text(json.dumps(
+        {"time": 1.0, "cost": 4, "cycle": 2, "msg_count": 8,
+         "msg_size": 16, "status": "FINISHED"}))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    res = cli(["consolidate", "--average", str(good), str(bad)])
+    assert res.returncode == 0
+    assert res.stdout.strip().startswith("1,1.0,4.0,2.0,")
