@@ -222,3 +222,46 @@ class TestEndToEnd:
 
         assert res1.assignment == res8.assignment
         assert res1.cycles == res8.cycles
+
+
+class TestTimingConvention:
+    """DeviceRunResult timing contract (engine/runner.py docstring):
+    cold calls report the whole interval in BOTH fields with
+    cold_start=True; warm calls split compile_time_s=0."""
+
+    def _engine(self):
+        from pydcop_tpu.dcop.objects import Domain, Variable
+        from pydcop_tpu.dcop.relations import constraint_from_str
+        from pydcop_tpu.engine.compile import compile_factor_graph
+        from pydcop_tpu.engine.runner import MaxSumEngine
+
+        d = Domain("d", "", [0, 1, 2])
+        vs = [Variable(f"v{i}", d) for i in range(4)]
+        cs = [constraint_from_str(f"c{i}", f"v{i} + v{i+1}",
+                                  [vs[i], vs[i + 1]]) for i in range(3)]
+        graph, meta = compile_factor_graph(vs, cs)
+        return MaxSumEngine(graph, meta)
+
+    def test_cold_then_warm(self):
+        engine = self._engine()
+        cold = engine.run(max_cycles=5, stop_on_convergence=False)
+        assert cold.metrics["cold_start"] is True
+        assert cold.compile_time_s == cold.time_s > 0
+        warm = engine.run(max_cycles=5, stop_on_convergence=False)
+        assert warm.metrics["cold_start"] is False
+        assert warm.compile_time_s == 0.0
+        assert 0 < warm.time_s < cold.time_s
+
+    def test_distinct_keys_are_cold_again(self):
+        engine = self._engine()
+        engine.run(max_cycles=5, stop_on_convergence=False)
+        other = engine.run(max_cycles=7, stop_on_convergence=False)
+        assert other.metrics["cold_start"] is True
+
+    def test_trace_has_own_key(self):
+        engine = self._engine()
+        engine.run(max_cycles=5, stop_on_convergence=False)
+        tr = engine.run_trace(max_cycles=5)
+        assert tr.metrics["cold_start"] is True
+        tr2 = engine.run_trace(max_cycles=5)
+        assert tr2.metrics["cold_start"] is False
