@@ -1,0 +1,45 @@
+"""Library quick start: build a DCOP in code, solve on the device
+engine and on the reference-semantics threaded runtime, compare.
+
+Run: python examples/api_quickstart.py
+(mirrors the reference's tests/integration standalone-script style)
+"""
+
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+
+
+def build():
+    colors = Domain("colors", "color", ["R", "G", "B"])
+    dcop = DCOP("quickstart", objective="min")
+    v1, v2, v3 = (Variable(n, colors) for n in ("v1", "v2", "v3"))
+    for v in (v1, v2, v3):
+        dcop.add_variable(v)
+    # Soft graph coloring: conflict costs 1, v1 prefers R (cost -0.1).
+    dcop.add_constraint(constraint_from_str(
+        "diff12", "1 if v1 == v2 else 0", [v1, v2]))
+    dcop.add_constraint(constraint_from_str(
+        "diff23", "1 if v2 == v3 else 0", [v2, v3]))
+    dcop.add_constraint(constraint_from_str(
+        "pref1", "-0.1 if v1 == 'R' else 0", [v1]))
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(3)])
+    return dcop
+
+
+def main():
+    dcop = build()
+    device = solve(dcop, "maxsum", max_cycles=200)
+    print("device :", device["assignment"], "cost", device["cost"])
+
+    thread = solve(build(), "maxsum", backend="thread",
+                   distribution="adhoc", timeout=3)
+    print("thread :", thread["assignment"], "cost", thread["cost"])
+
+    assert device["cost"] == thread["cost"] == -0.1
+    print("identical optimal cost on both backends")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Dynamic DCOP on the device engine: warm-started trajectory across
+factor edits, with checkpoint/resume.
+
+Run: python examples/dynamic_dcop.py
+"""
+
+import numpy as np
+
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.engine.dynamic import DynamicMaxSumEngine
+
+
+def main():
+    d = Domain("d", "", [0, 1, 2])
+    vs = [Variable(f"v{i}", d) for i in range(12)]
+    eq = np.eye(3)
+    ring = [
+        NAryMatrixRelation([vs[i], vs[(i + 1) % 12]], eq, f"c{i}")
+        for i in range(12)
+    ]
+    engine = DynamicMaxSumEngine(vs, ring, mode="min")
+
+    res = engine.run(60)
+    print("initial ring :", "cost", engine.cost(res.assignment),
+          "after", res.cycles, "cycles")
+
+    # Live edits: drop one factor, add a chord — array surgery inside
+    # padding slack, message state warm-starts (no recompile).
+    engine.remove_factor("c0")
+    engine.add_factor(NAryMatrixRelation([vs[0], vs[6]], eq, "chord"))
+    res = engine.run(60)
+    print("after edits  :", "cost", engine.cost(res.assignment),
+          "recompiles", res.metrics["recompiles"])
+
+    # Device state is a handful of arrays: checkpoint to disk, then
+    # resume in a fresh engine bit-exactly.
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+        engine.checkpoint(f.name)
+        engine2 = DynamicMaxSumEngine(
+            vs, list(engine.factors.values()), mode="min")
+        engine2.restore(f.name)
+    r1 = engine.run(30)
+    r2 = engine2.run(30)
+    assert r1.assignment == r2.assignment
+    print("checkpoint/resume: identical trajectory after restore")
+
+
+if __name__ == "__main__":
+    main()

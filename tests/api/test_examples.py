@@ -1,0 +1,31 @@
+"""Run every examples/ script as an acceptance test (the reference
+treats its tests/integration scripts the same way, run_all.py:37)."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = sorted(glob.glob(os.path.join(
+    os.path.dirname(__file__), "..", "..", "examples", "*.py")))
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def test_examples_exist():
+    assert EXAMPLES
+
+
+@pytest.mark.parametrize(
+    "script", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_runs_clean(script):
+    out = subprocess.run(
+        [sys.executable, script], timeout=180, env=ENV,
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
