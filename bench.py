@@ -187,10 +187,19 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     )
     from pydcop_tpu.ops import maxsum as ops
 
+    if n_vars < 2:
+        raise ValueError("bench_scale needs n_vars >= 2")
     rng = np.random.default_rng(7)
     n_factors = int(n_vars * edge_factor)
     var_ids = rng.integers(
         0, n_vars, size=(n_factors, 2)).astype(np.int32)
+    # Redraw self-loops (v1 == v2) so the instance is a well-formed
+    # coloring problem and the cost semantics stay meaningful.
+    loop = var_ids[:, 0] == var_ids[:, 1]
+    while loop.any():
+        var_ids[loop, 1] = rng.integers(
+            0, n_vars, size=int(loop.sum())).astype(np.int32)
+        loop = var_ids[:, 0] == var_ids[:, 1]
     eq = np.eye(N_COLORS, dtype=np.float32)
     costs = np.ascontiguousarray(
         np.broadcast_to(eq, (n_factors, N_COLORS, N_COLORS)))

@@ -38,8 +38,10 @@ def build_computation(comp_def):
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     max_cycles: int = 1000, mesh=None,
                     n_devices: Optional[int] = None,
-                    stop_on_convergence: bool = True) -> DeviceRunResult:
+                    stop_on_convergence: bool = True,
+                    warmup: bool = False, **_) -> DeviceRunResult:
     return _maxsum.solve_on_device(
         dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
         n_devices=n_devices, stop_on_convergence=stop_on_convergence,
+        warmup=warmup,
     )

@@ -50,6 +50,7 @@ def build_computation(comp_def):
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     max_cycles: int = 1000, mesh=None,
                     n_devices: Optional[int] = None,
+                    warmup: bool = False,
                     **_) -> DeviceRunResult:
     if dcop.objective != "min":
         raise ValueError(
@@ -69,4 +70,5 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
         lexic_ranks=lexic_ranks(meta),
         seed=params.get("seed", 0),
     )
-    return run_device_fn(graph, meta, fn, mesh=mesh, n_devices=n_devices)
+    return run_device_fn(graph, meta, fn, mesh=mesh, n_devices=n_devices,
+                         warmup=warmup)

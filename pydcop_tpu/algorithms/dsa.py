@@ -65,6 +65,7 @@ def _arity_probabilities(graph, probability: float) -> np.ndarray:
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     max_cycles: int = 1000, mesh=None,
                     n_devices: Optional[int] = None,
+                    warmup: bool = False,
                     **_) -> DeviceRunResult:
     params = algo_def.params
     pad_to = mesh.size if mesh is not None else (n_devices or 1)
@@ -81,6 +82,6 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
         seed=params.get("seed", 0),
     )
     return run_device_fn(
-        graph, meta, fn, mesh=mesh, n_devices=n_devices,
+        graph, meta, fn, mesh=mesh, n_devices=n_devices, warmup=warmup,
         finished=bool(params.get("stop_cycle")),
     )

@@ -34,6 +34,7 @@ def build_computation(comp_def):
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     max_cycles: int = 1000, mesh=None,
                     n_devices: Optional[int] = None,
+                    warmup: bool = False,
                     **_) -> DeviceRunResult:
     inner = AlgorithmDef(
         "dsa",
@@ -48,5 +49,5 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
     )
     return _dsa.solve_on_device(
         dcop, inner, max_cycles=max_cycles, mesh=mesh,
-        n_devices=n_devices,
+        n_devices=n_devices, warmup=warmup,
     )

@@ -93,14 +93,26 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     max_cycles: int = 1000, mesh=None,
                     n_devices: Optional[int] = None,
-                    stop_on_convergence: bool = True) -> DeviceRunResult:
+                    stop_on_convergence: bool = True,
+                    warmup: bool = False, **_) -> DeviceRunResult:
     """Batched BSP MaxSum on TPU/CPU devices."""
     params = algo_def.params
     engine = build_engine(dcop, params, mesh=mesh, n_devices=n_devices)
     decimation = int(params.get("decimation", 0) or 0)
     if decimation > 0:
+        if warmup:
+            engine.run_decimated(
+                max_cycles=max_cycles, frac=decimation / 100.0,
+            )
         return engine.run_decimated(
             max_cycles=max_cycles, frac=decimation / 100.0,
+        )
+    if warmup:
+        # Prime the jit cache so the timed run below is steady-state
+        # (each run starts from fresh initial messages, so re-running
+        # is side-effect free).
+        engine.run(
+            max_cycles=max_cycles, stop_on_convergence=stop_on_convergence
         )
     return engine.run(
         max_cycles=max_cycles, stop_on_convergence=stop_on_convergence

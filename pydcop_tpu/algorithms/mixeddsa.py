@@ -48,6 +48,7 @@ def build_computation(comp_def):
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     max_cycles: int = 1000, mesh=None,
                     n_devices: Optional[int] = None,
+                    warmup: bool = False,
                     **_) -> DeviceRunResult:
     params = algo_def.params
     pad_to = mesh.size if mesh is not None else (n_devices or 1)
@@ -62,6 +63,6 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
         seed=params.get("seed", 0),
     )
     return run_device_fn(
-        graph, meta, fn, mesh=mesh, n_devices=n_devices,
+        graph, meta, fn, mesh=mesh, n_devices=n_devices, warmup=warmup,
         finished=bool(params.get("stop_cycle")),
     )

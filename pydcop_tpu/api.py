@@ -32,6 +32,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           max_cycles: int = 1000,
           algo_params: Optional[Dict[str, Any]] = None,
           mesh=None, n_devices: Optional[int] = None,
+          warmup: bool = False,
           ui_port: Optional[int] = None,
           collector=None,
           collect_moment: str = "value_change",
@@ -43,6 +44,9 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     backend="device": batched engine on TPU/CPU devices (default).
     backend="thread": agent-mode runtime (threads + in-process messages),
     reference-equivalent semantics.
+    warmup=True runs the compiled program once untimed before the timed
+    call, so one-shot solves report steady-state rates instead of
+    compile-dominated ones (device backend only).
     """
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
@@ -64,7 +68,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         t0 = time.perf_counter()
         res = module.solve_on_device(
             dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
-            n_devices=n_devices,
+            n_devices=n_devices, warmup=warmup,
         )
         cost, violations = dcop.solution_cost(res.assignment)
         return SolveResult(

@@ -253,7 +253,7 @@ def _run_device_cmd(args, dcop, scenario, algo_def) -> int:
                     budget_acc = 0.0
                     break
                 last = engine.run(chunk, stop_on_convergence=False)
-                budget_acc -= DEVICE_RUN_CHUNK
+                budget_acc -= chunk
             continue
         for action in event.actions or []:
             if action.type == "remove_agent":

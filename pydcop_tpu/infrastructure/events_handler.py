@@ -4,9 +4,11 @@ Reference parity: pydcop/infrastructure/orchestrator.py:340 (_process_event
 scheduling) and :955-1010 (_orchestrator_scenario_event: pause, apply
 agent removals, trigger repair, resume).
 
-Current support: delay events and remove_agent actions (the removed
-agent's computations are reported; repair-based migration arrives with
-the replication layer).  Unknown action types are logged and skipped.
+Supports delay, add_agent and remove_agent events.  Removals trigger
+repair-based migration of the orphaned computations through the
+replication layer (orchestrator.py repair orchestration, both
+device-central and distributed modes).  Unknown action types are logged
+and skipped.
 """
 
 import logging
