@@ -46,7 +46,9 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     reference-equivalent semantics.
     warmup=True runs the compiled program once untimed before the timed
     call, so one-shot solves report steady-state rates instead of
-    compile-dominated ones (device backend only).
+    compile-dominated ones (device backend only).  Host-driven sweep
+    algorithms (dpop, syncbb, ncbb) and maxsum decimation ignore it —
+    their runners already report compile time separately.
     """
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(

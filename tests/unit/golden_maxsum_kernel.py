@@ -1,0 +1,183 @@
+"""Frozen copy of the round-4 MaxSum superstep pipeline.
+
+This is the executable perf/semantics baseline for
+``test_perf_regression.py``: the live kernel (pydcop_tpu/ops/maxsum.py)
+is timed against this copy IN THE SAME PROCESS, so the ratio is immune
+to machine-load drift (the absolute cycles/s on this box moved ~30%
+between rounds from load alone — BENCH_r01 vs r03 — which is exactly
+what a wall-clock budget test would false-alarm on).
+
+Do NOT update this file when optimizing the live kernel unless the
+regression test's parity assertion demands it: it exists to stay
+behind.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.engine.compile import BIG, CompiledFactorGraph
+
+Msgs = Tuple[jnp.ndarray, ...]
+
+SAME_COUNT = 4
+
+
+class GoldenState(NamedTuple):
+    v2f: Msgs
+    f2v: Msgs
+    v2f_count: Msgs
+    f2v_count: Msgs
+    stable: jnp.ndarray
+    cycle: jnp.ndarray
+
+
+def init_state(graph: CompiledFactorGraph) -> GoldenState:
+    d = graph.var_costs.shape[1]
+    dtype = graph.var_costs.dtype
+    zeros = tuple(
+        jnp.zeros(b.var_ids.shape + (d,), dtype=dtype)
+        for b in graph.buckets
+    )
+    counts = tuple(
+        jnp.zeros(b.var_ids.shape, dtype=jnp.int32)
+        for b in graph.buckets
+    )
+    return GoldenState(
+        v2f=zeros, f2v=zeros, v2f_count=counts, f2v_count=counts,
+        stable=jnp.asarray(False),
+        cycle=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def _edge_match(new, old, stability, valid):
+    delta = jnp.abs(new - old)
+    s = jnp.abs(new + old)
+    ok = (2 * delta < stability * s) | (delta == 0)
+    return jnp.all(ok | ~valid, axis=-1)
+
+
+def _send_or_suppress(cand, prev, count, stability, valid, first):
+    match = _edge_match(cand, prev, stability, valid) & ~first
+    send = ~match | (count < SAME_COUNT)
+    sent = jnp.where(send[..., None], cand, prev)
+    new_count = jnp.where(
+        match, jnp.minimum(count + 1, SAME_COUNT + 1), 1
+    )
+    return sent, new_count, match
+
+
+def factor_to_var(graph, v2f):
+    out = []
+    for bucket, msgs in zip(graph.buckets, v2f):
+        f, arity, d = msgs.shape
+        total = bucket.costs
+        for q in range(arity):
+            shape = [f] + [1] * arity
+            shape[q + 1] = d
+            total = total + msgs[:, q].reshape(shape)
+        outs_p = []
+        for p in range(arity):
+            axes = tuple(i + 1 for i in range(arity) if i != p)
+            reduced = jnp.min(total, axis=axes) if axes else total
+            outs_p.append(reduced - msgs[:, p])
+        out.append(jnp.stack(outs_p, axis=1))
+    return tuple(out)
+
+
+def aggregate_beliefs(graph, f2v):
+    n_segments = graph.var_costs.shape[0]
+    d = graph.var_costs.shape[1]
+    sums = jnp.zeros_like(graph.var_costs)
+    for bucket, msgs in zip(graph.buckets, f2v):
+        flat = msgs.reshape(-1, d)
+        seg = bucket.var_ids.reshape(-1)
+        sums = sums + jax.ops.segment_sum(
+            flat, seg, num_segments=n_segments
+        )
+    return graph.var_costs + sums, sums
+
+
+def var_to_factor(graph, f2v, beliefs, sums):
+    out = []
+    for bucket, msgs in zip(graph.buckets, f2v):
+        valid = graph.var_valid[bucket.var_ids]
+        raw = beliefs[bucket.var_ids] - msgs
+        factor_sum = sums[bucket.var_ids] - msgs
+        n_valid = jnp.maximum(
+            jnp.sum(valid, axis=-1, keepdims=True), 1
+        )
+        avg = (
+            jnp.sum(jnp.where(valid, factor_sum, 0.0), axis=-1,
+                    keepdims=True)
+            / n_valid
+        )
+        out.append(jnp.where(valid, raw - avg, BIG))
+    return tuple(out)
+
+
+def select_values(graph, beliefs):
+    masked = jnp.where(graph.var_valid, beliefs, jnp.inf)
+    return jnp.argmin(masked[:-1], axis=1).astype(jnp.int32)
+
+
+def _damp(new, old, damping, first):
+    return tuple(
+        jnp.where(first, n, damping * o + (1.0 - damping) * n)
+        for n, o in zip(new, old)
+    )
+
+
+def superstep(state, graph, *, damping, damp_vars, damp_factors,
+              stability):
+    first = state.cycle == 0
+    valids = tuple(
+        graph.var_valid[b.var_ids] for b in graph.buckets
+    )
+    f2v_cand = factor_to_var(graph, state.v2f)
+    if damp_factors and damping > 0:
+        f2v_cand = _damp(f2v_cand, state.f2v, damping, first)
+    beliefs, sums = aggregate_beliefs(graph, state.f2v)
+    v2f_cand = var_to_factor(graph, state.f2v, beliefs, sums)
+    if damp_vars and damping > 0:
+        v2f_cand = _damp(v2f_cand, state.v2f, damping, first)
+    f2v_new, f2v_count = [], []
+    v2f_new, v2f_count = [], []
+    all_match = jnp.asarray(True)
+    for i, valid in enumerate(valids):
+        sent, cnt, match = _send_or_suppress(
+            f2v_cand[i], state.f2v[i], state.f2v_count[i],
+            stability, valid, first)
+        f2v_new.append(sent)
+        f2v_count.append(cnt)
+        all_match = all_match & jnp.all(match | ~jnp.any(valid, -1))
+        sent, cnt, match = _send_or_suppress(
+            v2f_cand[i], state.v2f[i], state.v2f_count[i],
+            stability, valid, first)
+        v2f_new.append(sent)
+        v2f_count.append(cnt)
+        all_match = all_match & jnp.all(match | ~jnp.any(valid, -1))
+    return GoldenState(
+        v2f=tuple(v2f_new),
+        f2v=tuple(f2v_new),
+        v2f_count=tuple(v2f_count),
+        f2v_count=tuple(f2v_count),
+        stable=all_match & ~first,
+        cycle=state.cycle + 1,
+    )
+
+
+def run_maxsum(graph, max_cycles, *, damping=0.5, damp_vars=True,
+               damp_factors=True, stability=0.1):
+    def step(state):
+        return superstep(
+            state, graph, damping=damping, damp_vars=damp_vars,
+            damp_factors=damp_factors, stability=stability,
+        )
+
+    state = jax.lax.while_loop(
+        lambda s: s.cycle < max_cycles, step, init_state(graph)
+    )
+    beliefs, _ = aggregate_beliefs(graph, state.f2v)
+    return state, select_values(graph, beliefs)
