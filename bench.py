@@ -19,6 +19,8 @@ noise (_stable_noise), so costs are directly comparable.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -156,23 +158,162 @@ def exact_parity():
     return device_cost, thread_cost
 
 
-def _ensure_live_backend():
-    """Wedged-tunnel guard (shared recipe): 3 probes — the wedge is
-    frequently transient (BENCH_r02 fell back to CPU even though the
-    chip was reachable minutes later) — then CPU re-exec."""
-    from pydcop_tpu.utils.cleanenv import ensure_live_backend
+# Upper bound for one supervised bench attempt (TPU runs take a few
+# minutes incl. compiles; a wedged tunnel hangs forever — this is the
+# difference between "no BENCH_r0N.json" and a diagnosed CPU fallback).
+CHILD_TIMEOUT_S = 1800
+ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TPU_LAST.json")
 
-    ensure_live_backend(tag="bench", retries=3)
+
+def _supervise():
+    """Run the actual bench in a killable child process.
+
+    A wedged axon tunnel can hang INSIDE a jax call (C++-level, not
+    interruptible by signal handlers), so probing once at startup is
+    not enough: round 1-3 all fell back to CPU, and a mid-run wedge
+    would have produced NO json line at all.  The supervisor probes
+    with backoff, runs the bench as a child sharing stdout, kills it on
+    timeout, and falls back to a scrubbed-CPU re-exec that always
+    emits the result line — with the full probe history embedded."""
+    from pydcop_tpu.utils.cleanenv import (
+        cpu_fallback_exec,
+        probe_backend,
+        record_diag,
+    )
+
+    live = False
+    for attempt in range(3):
+        ok, error, dt = probe_backend(120)
+        record_diag("probe", tag="bench", attempt=attempt + 1, of=3,
+                    ok=ok, error=error, seconds=round(dt, 1))
+        if ok:
+            live = True
+            break
+        print(f"bench: accelerator probe {attempt + 1}/3 failed "
+              f"({error})", file=sys.stderr)
+        time.sleep(10)
+    if live:
+        env = dict(os.environ)
+        env["PYDCOP_BENCH_CHILD"] = "1"
+        # Capture the child's stdout so a child that prints its result
+        # line and THEN wedges in interpreter teardown still counts as
+        # a success (otherwise the CPU fallback would print a second
+        # JSON line on the same stream).  stderr stays inherited.
+        try:
+            proc = subprocess.run(
+                [sys.executable] + sys.argv, env=env,
+                timeout=CHILD_TIMEOUT_S, stdout=subprocess.PIPE,
+                text=True,
+            )
+            child_out, rc = proc.stdout, proc.returncode
+        except subprocess.TimeoutExpired as exc:
+            out = exc.stdout
+            if isinstance(out, bytes):
+                out = out.decode("utf-8", "replace")
+            child_out, rc = out or "", None
+            record_diag("child_timeout", seconds=CHILD_TIMEOUT_S)
+        if _forward_result_line(child_out):
+            return
+        if rc is None:
+            print(
+                "bench: supervised run exceeded "
+                f"{CHILD_TIMEOUT_S}s (tunnel wedged mid-run); "
+                "falling back to CPU", file=sys.stderr,
+            )
+        else:
+            record_diag("child_failed", rc=rc)
+            print(f"bench: supervised run failed rc={rc}; falling "
+                  "back to CPU", file=sys.stderr)
+    cpu_fallback_exec("bench")
+
+
+def _forward_result_line(child_out: str) -> bool:
+    """Print the child's JSON result line if it produced one."""
+    for line in (child_out or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if "metric" in parsed:
+            print(line)
+            return True
+    return False
+
+
+def _try_revive_tpu():
+    """On the CPU-fallback path, re-probe the accelerator immediately
+    before the headline leg (the wedge is transient — BENCH_r02's chip
+    was reachable minutes after its startup probes failed) and restart
+    the whole bench on TPU when it answers.  One revival attempt per
+    bench invocation (PYDCOP_BENCH_TPU_RETRIED)."""
+    from pydcop_tpu.utils.cleanenv import (
+        DIAG_ENV,
+        probe_backend,
+        record_diag,
+        tpu_env,
+    )
+
+    env = tpu_env()
+    if env is None or os.environ.get("PYDCOP_BENCH_TPU_RETRIED"):
+        return
+    ok, error, dt = probe_backend(60, env=env)
+    record_diag("revival_probe", ok=ok, error=error,
+                seconds=round(dt, 1))
+    if not ok:
+        return
+    print("bench: TPU tunnel revived; restarting on TPU",
+          file=sys.stderr)
+    env[DIAG_ENV] = os.environ.get(DIAG_ENV, "[]")
+    env["PYDCOP_BENCH_TPU_RETRIED"] = "1"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _artifact_keys(platform, out):
+    """TPU run → persist the result as the last-known-good artifact;
+    CPU fallback → reference the artifact so the JSON line always
+    carries the best hardware evidence available."""
+    if platform == "tpu":
+        try:
+            with open(ARTIFACT, "w") as fh:
+                json.dump(
+                    {"recorded_unix": round(time.time(), 1), **out},
+                    fh, indent=1)
+        except OSError as exc:
+            # Never let artifact persistence block the result line.
+            print(f"bench: could not write {ARTIFACT}: {exc}",
+                  file=sys.stderr)
+        return {}
+    if not os.path.exists(ARTIFACT):
+        return {"last_tpu_artifact": None}
+    try:
+        with open(ARTIFACT) as fh:
+            last = json.load(fh)
+    except (OSError, ValueError):
+        return {"last_tpu_artifact": "BENCH_TPU_LAST.json (unreadable)"}
+    return {
+        "last_tpu_artifact": "BENCH_TPU_LAST.json",
+        "last_tpu_value": last.get("value"),
+        "last_tpu_recorded_unix": last.get("recorded_unix"),
+    }
 
 
 def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
-                cycles: int = SCALE_CYCLES):
+                cycles: int = SCALE_CYCLES, aggregation: str = "scatter"):
     """HBM-bound scale leg: a synthetic 1M-variable / 1.5M-factor
     3-coloring whose ~190 MB working set cannot stay VMEM-resident, so
     the measured rate reflects real HBM streaming (the 10k north-star
     problem fits in VMEM and proves nothing about bandwidth).  Arrays
     are built directly (building 1.5M Python constraint objects would
     dominate the bench); the superstep math is identical.
+
+    ``aggregation`` selects the variable-aggregation strategy
+    (engine/compile.build_aggregation_arrays); the headline leg runs
+    the strategy benchmarks/exp_aggregation.py measured fastest on the
+    target backend.
 
     Returns (cycles/s, graph) for roofline accounting.
     """
@@ -184,6 +325,7 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
         BIG,
         CompiledFactorGraph,
         FactorBucket,
+        build_aggregation_arrays,
     )
     from pydcop_tpu.ops import maxsum as ops
 
@@ -207,9 +349,13 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     var_costs[:-1] = rng.random((n_vars, N_COLORS)) * 0.01
     var_valid = np.zeros((n_vars + 1, N_COLORS), bool)
     var_valid[:-1] = True
+    buckets = (FactorBucket(costs, var_ids),)
+    perm, sorted_seg, starts, ends = build_aggregation_arrays(
+        buckets, n_vars + 1, aggregation)
     graph = jax.device_put(CompiledFactorGraph(
-        var_costs=var_costs, var_valid=var_valid,
-        buckets=(FactorBucket(costs, var_ids),),
+        var_costs=var_costs, var_valid=var_valid, buckets=buckets,
+        agg_perm=perm, agg_sorted_seg=sorted_seg,
+        agg_starts=starts, agg_ends=ends,
     ))
     fn = jax.jit(partial(ops.run_maxsum, max_cycles=cycles,
                          stop_on_convergence=False))
@@ -220,10 +366,10 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     return int(state.cycle) / elapsed, graph
 
 
-def main():
-    _ensure_live_backend()
+def run_bench():
     import jax
 
+    from pydcop_tpu.utils.cleanenv import diag_events
     from pydcop_tpu.engine.roofline import roofline_report
 
     dev = jax.devices()[0]
@@ -232,13 +378,15 @@ def main():
     parity_device_cost, parity_thread_cost = exact_parity()
 
     dcop = build_dcop(N_VARS)
+    if platform != "tpu":
+        _try_revive_tpu()   # re-probe right before the headline leg
     device_cps, res, engine = bench_device(dcop, DEVICE_CYCLES)
     thread_cps, thread_cycles, thread_cost, _asg = bench_thread(
         dcop, THREAD_TIMEOUT_S)
     if thread_cycles <= 0 or thread_cps <= 0:
         # Degenerate baseline (no full BSP cycle within the timeout):
         # still emit the JSON line rather than dying on a divide.
-        print(json.dumps({
+        out = {
             "metric": "maxsum_cycles_per_sec_10kvar_graphcoloring",
             "value": round(device_cps, 2),
             "unit": "cycles/s",
@@ -247,7 +395,10 @@ def main():
             "baseline_cycles_completed": thread_cycles,
             "note": "threaded baseline completed no full cycle in "
                     f"{THREAD_TIMEOUT_S}s",
-        }))
+        }
+        out.update(_artifact_keys(platform, out))
+        out["probe_diagnostics"] = diag_events()
+        print(json.dumps(out))
         return
 
     # Cost-vs-cycle trace on the device: the quality check is one-sided
@@ -319,7 +470,17 @@ def main():
         **roofline,
         **scale_keys,
     }
+    out.update(_artifact_keys(platform, out))
+    out["probe_diagnostics"] = diag_events()
     print(json.dumps(out))
+
+
+def main():
+    if (os.environ.get("PYDCOP_BENCH_CHILD")
+            or os.environ.get("PYDCOP_BENCH_NO_PROBE")):
+        run_bench()
+        return
+    _supervise()
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ measured on real hardware).
 """
 
 import json
+import os
 import sys
 import time
 from functools import partial
@@ -120,6 +121,32 @@ def main():
             "sorted_err": err_so, "boundary_err": err_bo,
         }))
         sys.stdout.flush()
+
+    # Engine-level decision leg: the FULL superstep (run_maxsum) per
+    # strategy on the 1M-var synthetic coloring — this is the number
+    # that decides the headline bench's aggregation choice (the
+    # op-level loops above attribute it).  NOTE: "boundary" here is a
+    # throughput measurement only — its f32 prefix sum cancels at this
+    # edge count (see ops/maxsum.aggregate_beliefs), so even if it
+    # wins on speed it needs a numerics redesign before promotion to
+    # the solve path.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench as bench_mod
+
+    for strategy in ("scatter", "sorted", "boundary"):
+        t0 = time.perf_counter()
+        cps, graph = bench_mod.bench_scale(
+            n_vars=1_000_000, cycles=50, aggregation=strategy)
+        print(json.dumps({
+            "engine_1m_vars": strategy,
+            "backend": jax.devices()[0].platform,
+            "cycles_per_s": round(cps, 2),
+            "ms_per_cycle": round(1e3 / cps, 3) if cps else None,
+            "total_s": round(time.perf_counter() - t0, 1),
+        }))
+        sys.stdout.flush()
+        del graph
 
 
 if __name__ == "__main__":

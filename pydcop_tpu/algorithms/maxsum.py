@@ -49,6 +49,19 @@ algo_params = [
     # disables (reference behavior); > 0 enables with that fraction
     # (in %) of variables fixed per round.
     AlgoParameterDef("decimation", "int", None, 0),
+    # Variable-aggregation strategy for the superstep (device path;
+    # see engine/compile.build_aggregation_arrays).  "scatter" is the
+    # parity default; "sorted" is the HBM-regime alternative measured
+    # by benchmarks/exp_aggregation.py.  The third strategy there
+    # ("boundary", prefix-sum + boundary differences) is experiment-
+    # only: f32 prefix sums over millions of edges cancel
+    # catastrophically at exactly the scale it targets, and TPUs have
+    # no f64 to accumulate in — so it is not offered for solves.
+    # Sharded runs always use scatter (shard_graph drops the sort
+    # arrays).
+    AlgoParameterDef(
+        "aggregation", "str", ["scatter", "sorted"], "scatter"
+    ),
 ]
 
 
@@ -81,7 +94,8 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
     elif n_devices:
         pad_to = n_devices
     graph, meta = compile_dcop(
-        dcop, noise_level=params.get("noise", 0.01), pad_to=pad_to
+        dcop, noise_level=params.get("noise", 0.01), pad_to=pad_to,
+        aggregation=params.get("aggregation", "scatter"),
     )
     return MaxSumEngine(
         graph, meta,

@@ -80,7 +80,17 @@ def run_cmd(args) -> int:
         orchestrator.stop_agents(5)
         orchestrator.stop()
 
-    lines = ["replica_dist:"]
+    # Provenance block first (reference replica_dist_format.yml): the
+    # parameters that produced this placement, so a placement file is
+    # reproducible on its own.
+    lines = ["inputs:"]
+    lines.append(f"  dcop: {json.dumps(list(args.dcop_files))}")
+    lines.append(f"  graph: {algo_module.GRAPH_TYPE}")
+    lines.append(f"  algo: {algo_def.algo}")
+    lines.append(f"  distribution: {args.distribution}")
+    lines.append(f"  k: {args.ktarget}")
+    lines.append(f"  replication: {args.replication}")
+    lines.append("replica_dist:")
     for comp in sorted(replica_dist.mapping):
         hosts = replica_dist.mapping[comp]
         lines.append(f"  {comp}: {json.dumps(hosts)}")

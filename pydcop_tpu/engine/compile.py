@@ -65,11 +65,29 @@ class CompiledFactorGraph(NamedTuple):
 
     Array members are numpy on the host; the runner moves them to device
     (optionally sharded).
+
+    The optional ``agg_*`` arrays select the variable-aggregation
+    strategy for the MaxSum superstep (see ops/maxsum.aggregate_beliefs
+    and benchmarks/exp_aggregation.py for the measured decision):
+
+    - all None (default): unsorted scatter-add (``segment_sum``);
+    - perm + sorted_seg: compile-time edge sort, per-cycle gather into
+      sorted order, ``segment_sum(indices_are_sorted=True)``;
+    - perm + starts/ends: edge sort + cumsum + per-variable boundary
+      gathers — no scatter at all (HBM-regime candidate).
+
+    Sharded graphs always use the scatter path (a global edge sort
+    would turn the local gather into a cross-device one), so
+    ``shard_graph`` drops these arrays.
     """
 
     var_costs: np.ndarray   # [V+1, Dmax] f32 (last row = sentinel)
     var_valid: np.ndarray   # [V+1, Dmax] bool
     buckets: Tuple[FactorBucket, ...]
+    agg_perm: Optional[np.ndarray] = None        # [E] int32
+    agg_sorted_seg: Optional[np.ndarray] = None  # [E] int32 (sorted)
+    agg_starts: Optional[np.ndarray] = None      # [V+1] int32
+    agg_ends: Optional[np.ndarray] = None        # [V+1] int32
 
     @property
     def n_vars(self) -> int:
@@ -107,6 +125,40 @@ def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+AGGREGATIONS = ("scatter", "sorted", "boundary")
+
+
+def build_aggregation_arrays(buckets: Sequence[FactorBucket],
+                             n_segments: int, aggregation: str):
+    """Compile-time edge sort for the non-scatter aggregation paths.
+
+    Edges are the flattened (bucket, factor, position) slots in bucket
+    order — the same order ``aggregate_beliefs`` flattens messages in.
+    Returns the ``agg_*`` field values for CompiledFactorGraph.
+    """
+    if aggregation == "scatter":
+        return None, None, None, None
+    if aggregation not in AGGREGATIONS:
+        raise ValueError(
+            f"aggregation must be one of {AGGREGATIONS}, "
+            f"got {aggregation!r}"
+        )
+    seg = np.concatenate(
+        [b.var_ids.reshape(-1) for b in buckets]
+    ) if buckets else np.zeros((0,), np.int32)
+    perm = np.argsort(seg, kind="stable").astype(np.int32)
+    sorted_seg = seg[perm].astype(np.int32)
+    if aggregation == "sorted":
+        return perm, sorted_seg, None, None
+    starts = np.searchsorted(
+        sorted_seg, np.arange(n_segments), side="left"
+    ).astype(np.int32)
+    ends = np.searchsorted(
+        sorted_seg, np.arange(n_segments), side="right"
+    ).astype(np.int32)
+    return perm, None, starts, ends
+
+
 def compile_factor_graph(
     variables: Sequence[Variable],
     constraints: Sequence[Constraint],
@@ -115,6 +167,7 @@ def compile_factor_graph(
     noise_seed: Optional[int] = None,
     pad_to: int = 1,
     dtype=np.float32,
+    aggregation: str = "scatter",
 ) -> Tuple[CompiledFactorGraph, FactorGraphMeta]:
     """Build the dense arrays.  `noise_level` adds deterministic
     per-variable-value noise (maxsum's tie-breaking noise, reference
@@ -177,10 +230,17 @@ def compile_factor_graph(
         buckets.append(FactorBucket(costs, var_ids))
         bucket_sizes.append(len(facs))
 
+    perm, sorted_seg, starts, ends = build_aggregation_arrays(
+        buckets, v_count + 1, aggregation
+    )
     compiled = CompiledFactorGraph(
         var_costs=var_costs,
         var_valid=var_valid,
         buckets=tuple(buckets),
+        agg_perm=perm,
+        agg_sorted_seg=sorted_seg,
+        agg_starts=starts,
+        agg_ends=ends,
     )
     meta = FactorGraphMeta(
         var_names=tuple(v.name for v in variables),
@@ -196,6 +256,7 @@ def compile_factor_graph(
 
 def compile_dcop(dcop: DCOP, noise_level: float = 0.0,
                  noise_seed: Optional[int] = None, pad_to: int = 1,
+                 aggregation: str = "scatter",
                  ) -> Tuple[CompiledFactorGraph, FactorGraphMeta]:
     return compile_factor_graph(
         list(dcop.variables.values()),
@@ -204,4 +265,5 @@ def compile_dcop(dcop: DCOP, noise_level: float = 0.0,
         noise_level=noise_level,
         noise_seed=noise_seed,
         pad_to=pad_to,
+        aggregation=aggregation,
     )

@@ -180,10 +180,44 @@ def aggregate_beliefs(graph: CompiledFactorGraph, f2v: Msgs
     """Sum incoming factor messages per variable.
 
     Returns (beliefs [V+1, D] = own costs + sums, sums [V+1, D]).
-    This segment-sum is the single cross-shard op per superstep.
+    This aggregation is the single cross-shard op per superstep, and
+    the op that dominates past the 100k-var scale cliff (BENCH_TPU.md).
+    Strategy is chosen at compile time via the graph's ``agg_*`` arrays
+    (engine/compile.build_aggregation_arrays; A/B harness
+    benchmarks/exp_aggregation.py):
+
+    - default: unsorted scatter-add, one ``segment_sum`` per bucket —
+      the only option for sharded graphs;
+    - sorted: per-cycle gather into compile-time-sorted edge order,
+      then ``segment_sum(indices_are_sorted=True)``;
+    - boundary: sorted gather + cumsum + per-variable boundary
+      difference — no scatter at all.  EXPERIMENT-ONLY: the f32
+      prefix sum grows with the total edge count, so the boundary
+      differences cancel catastrophically at the million-edge scale
+      this strategy targets (absolute error ~ulp of the running
+      total, which dwarfs the 0.01 tie-breaking noise), and TPUs
+      have no f64 to accumulate in.  Valid for throughput A/Bs
+      (exp_aggregation, bench_scale) and small problems; not offered
+      as a maxsum algo param.
     """
     n_segments = graph.var_costs.shape[0]
     d = graph.var_costs.shape[1]
+    if graph.agg_perm is not None:
+        flats = [msgs.reshape(-1, d) for msgs in f2v]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(
+            flats, axis=0)
+        in_order = flat[graph.agg_perm]
+        if graph.agg_starts is not None:
+            cum = jnp.cumsum(in_order, axis=0)
+            cz = jnp.concatenate(
+                [jnp.zeros((1, d), cum.dtype), cum], axis=0)
+            sums = cz[graph.agg_ends] - cz[graph.agg_starts]
+        else:
+            sums = jax.ops.segment_sum(
+                in_order, graph.agg_sorted_seg,
+                num_segments=n_segments, indices_are_sorted=True,
+            )
+        return graph.var_costs + sums, sums
     sums = jnp.zeros_like(graph.var_costs)
     for bucket, msgs in zip(graph.buckets, f2v):
         flat = msgs.reshape(-1, d)

@@ -1,0 +1,102 @@
+"""Aggregation-strategy equivalence (ops/maxsum.aggregate_beliefs).
+
+The scatter path is the parity default; sorted/boundary are the
+HBM-regime options (engine/compile.build_aggregation_arrays).  All
+three compute the same per-variable sums up to float reassociation, and
+full solves must select the same assignment on a well-separated
+problem.
+"""
+
+import jax
+import numpy as np
+import pytest
+from functools import partial
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.ops import maxsum as ops
+
+
+def _coloring(n_vars=300, seed=5):
+    rng = np.random.default_rng(seed)
+    dom = Domain("colors", "color", [0, 1, 2])
+    dcop = DCOP("agg_gc", objective="min")
+    variables = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    eq = np.eye(3, dtype=np.float64)
+    seen = set()
+    for k in range(int(n_vars * 1.5)):
+        i, j = rng.choice(n_vars, size=2, replace=False)
+        key = (min(i, j), max(i, j))
+        if key in seen:
+            continue
+        seen.add(key)
+        dcop.add_constraint(NAryMatrixRelation(
+            [variables[i], variables[j]], eq, f"c{k}"))
+    return dcop
+
+
+@pytest.mark.parametrize("strategy", ["sorted", "boundary"])
+def test_aggregate_matches_scatter(strategy):
+    dcop = _coloring()
+    g_sc, _ = compile_dcop(dcop, noise_level=0.01)
+    g_st, _ = compile_dcop(dcop, noise_level=0.01,
+                           aggregation=strategy)
+    state = ops.init_state(g_sc)
+    # a few real supersteps so messages are non-trivial
+    step = jax.jit(partial(
+        ops.superstep, damping=0.5, damp_vars=True, damp_factors=True,
+        stability=0.1))
+    for _ in range(3):
+        state = step(state, g_sc)
+    b_sc, s_sc = ops.aggregate_beliefs(g_sc, state.f2v)
+    b_st, s_st = ops.aggregate_beliefs(g_st, state.f2v)
+    np.testing.assert_allclose(
+        np.asarray(s_sc), np.asarray(s_st), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(b_sc), np.asarray(b_st), rtol=1e-5, atol=1e-5)
+
+
+def test_full_solve_same_assignment_sorted():
+    from pydcop_tpu.api import solve
+
+    dcop = _coloring(n_vars=150, seed=9)
+    base = solve(dcop, "maxsum", max_cycles=60)
+    alt = solve(dcop, "maxsum", max_cycles=60,
+                algo_params={"aggregation": "sorted"})
+    assert alt["cost"] == base["cost"]
+    assert alt["assignment"] == base["assignment"]
+
+
+def test_boundary_not_a_solve_option():
+    """'boundary' is experiment-only (f32 prefix-sum cancellation at
+    scale — ops/maxsum.aggregate_beliefs docstring); the maxsum param
+    validator must reject it."""
+    from pydcop_tpu.api import solve
+
+    dcop = _coloring(n_vars=20, seed=3)
+    with pytest.raises(Exception, match="aggregation"):
+        solve(dcop, "maxsum", max_cycles=5,
+              algo_params={"aggregation": "boundary"})
+
+
+def test_sharded_graph_drops_sort_arrays():
+    from pydcop_tpu.engine.sharding import make_mesh, shard_graph
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device backend")
+    dcop = _coloring(n_vars=64, seed=2)
+    mesh = make_mesh(2)
+    graph, _ = compile_dcop(dcop, pad_to=2, aggregation="sorted")
+    assert graph.agg_perm is not None
+    sharded = shard_graph(graph, mesh)
+    assert sharded.agg_perm is None  # scatter path on meshes
+
+
+def test_unknown_aggregation_rejected():
+    dcop = _coloring(n_vars=10, seed=1)
+    with pytest.raises(ValueError):
+        compile_dcop(dcop, aggregation="nope")
