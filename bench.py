@@ -178,22 +178,11 @@ def _supervise():
     emits the result line — with the full probe history embedded."""
     from pydcop_tpu.utils.cleanenv import (
         cpu_fallback_exec,
-        probe_backend,
+        probe_with_retries,
         record_diag,
     )
 
-    live = False
-    for attempt in range(3):
-        ok, error, dt = probe_backend(120)
-        record_diag("probe", tag="bench", attempt=attempt + 1, of=3,
-                    ok=ok, error=error, seconds=round(dt, 1))
-        if ok:
-            live = True
-            break
-        print(f"bench: accelerator probe {attempt + 1}/3 failed "
-              f"({error})", file=sys.stderr)
-        time.sleep(10)
-    if live:
+    if probe_with_retries("bench", retries=3):
         env = dict(os.environ)
         env["PYDCOP_BENCH_CHILD"] = "1"
         # Capture the child's stdout so a child that prints its result

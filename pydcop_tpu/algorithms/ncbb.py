@@ -17,6 +17,11 @@ Engine path: sequential host search (branch & bound is inherently
 sequential, like syncbb); constraint tables are pre-materialized dense
 numpy arrays so per-node evaluation is array indexing, and static
 per-subtree lower bounds provide admissible pruning.
+
+Agent mode implements the SEARCH phase as a distributed AND/OR search
+with message passing over the pseudo-tree (sibling subtrees explored
+concurrently, memoized per ancestor context) and also returns the
+optimum — see infrastructure/agent_algorithms.NcbbComputation.
 """
 
 from typing import Dict, List, Optional

@@ -1005,24 +1005,64 @@ class MgmComputation(VariableComputation):
 
 
 NcbbValueMessage = message_type("ncbb_value", ["value"])
-NcbbCostMessage = message_type("ncbb_cost", ["cost"])
+# COST carries the subtree's separator (the ancestors appearing in any
+# constraint of the subtree) up the tree: each node derives its
+# children's separators from these reports, which lets the SEARCH
+# phase project contexts before sending (see below).
+NcbbCostMessage = message_type("ncbb_cost", ["cost", "separator"])
 NcbbStopMessage = message_type("ncbb_stop", [])
+# SEARCH-phase messages are BATCHED (the sync mixin allows one message
+# per neighbor per cycle): a search message carries every context the
+# parent wants this child's subtree optimum for; a results message
+# carries every (context, optimal cost) answer ready this cycle.
+NcbbSearchMessage = message_type("ncbb_search", ["contexts"])
+NcbbResultsMessage = message_type("ncbb_results", ["results"])
+NcbbFinalMessage = message_type("ncbb_final", ["context"])
 
 
 class NcbbComputation(SynchronousComputationMixin, VariableComputation):
-    """NCBB computation: synchronous two-phase over a DFS pseudo-tree.
+    """NCBB computation: synchronous phases over a DFS pseudo-tree.
 
     INIT phase per the reference (ncbb.py:216-330): the root picks a
-    random value and sends it down; every variable accumulates its
-    ancestors' values, greedily optimizes against them, forwards its own
-    value to descendants; leaves start COST messages whose subtree upper
-    bounds accumulate back up to the root.  The reference's search phase
-    is an empty stub, so once the root holds the global bound we
-    terminate cleanly (stop messages down the tree) with the greedy
-    assignment instead of idling until timeout.  Two deliberate fixes
-    over the reference: leaves send COST only to their tree parent (the
+    value and sends it down; every variable accumulates its ancestors'
+    values, greedily optimizes against them, forwards its own value to
+    descendants; leaves start COST messages whose subtree upper bounds
+    accumulate back up to the root.  Two deliberate fixes over the
+    reference: leaves send COST only to their tree parent (the
     reference posts to pseudo-parents too, which its own cost handler
     rejects), and termination is explicit.
+
+    SEARCH phase — the part the reference stubs out (ncbb.py:341) —
+    is a distributed AND/OR branch-and-bound over the pseudo-tree:
+
+    - the root (then recursively every interior node) asks each tree
+      child for its subtree's optimal cost under every candidate
+      context ``{ancestor: value, ...}`` (one batched message per
+      child per cycle; sibling subtrees and candidate values are
+      explored CONCURRENTLY — NCBB's no-commitment concurrency);
+    - every node charges exactly the constraints between itself and
+      its (pseudo-)parents — in a DFS tree each constraint connects a
+      node to an ancestor, so each is charged once, at its lower
+      endpoint (same accounting as the INIT greedy and the engine
+      path, algorithms/ncbb.py);
+    - contexts are PROJECTED onto each child's separator (the
+      ancestors appearing in any constraint of the child's subtree,
+      reported upward on the INIT cost messages) before sending, so
+      the number of distinct contexts a subtree explores is
+      exponential in its separator width — DPOP's table width — not
+      in the pseudo-tree depth;
+    - values whose charged cost is already infinite (hard-constraint
+      violation) are pruned before recursing; finite-cost pruning is
+      deliberately NOT done because constraint costs may be negative,
+      which would make bound-based pruning unsound;
+    - subtree optima are memoized per context, so repeated contexts
+      (and the final VALUE sweep) are answered from cache;
+    - once the root knows its optimum it fixes its value and sends a
+      FINAL context down the tree; each node looks up its memoized
+      best value for that context, fixes it, extends the context, and
+      forwards — after which the whole tree reports finished with the
+      globally optimal assignment (asserted equal to DPOP on the
+      golden fixtures).
     """
 
     def __init__(self, comp_def):
@@ -1054,6 +1094,19 @@ class NcbbComputation(SynchronousComputationMixin, VariableComputation):
             self._constraints.append(c)
         self._parents_values: Dict[str, Any] = {}
         self._children_costs: Dict[str, float] = {}
+        # SEARCH-phase state.  Contexts are keyed on their projection
+        # onto this node's separator; child bookkeeping is keyed on
+        # (child, projection onto that child's separator).
+        self._own_sep: frozenset = frozenset()
+        self._child_sep: Dict[str, frozenset] = {}
+        self._own_costs: Dict[tuple, Dict[Any, float]] = {}
+        self._open_ctx: Dict[tuple, dict] = {}
+        self._child_results: Dict[str, Dict[tuple, float]] = {}
+        self._result_cache: Dict[tuple, float] = {}
+        self._memo_value: Dict[tuple, Any] = {}
+        self._outbox_search: Dict[str, list] = {}
+        self._outbox_results: list = []
+        self._requested: Dict[str, set] = {}
 
     @register("ncbb_value")
     def _on_value_registration(self, sender, msg, t):
@@ -1065,6 +1118,18 @@ class NcbbComputation(SynchronousComputationMixin, VariableComputation):
 
     @register("ncbb_stop")
     def _on_stop_registration(self, sender, msg, t):
+        pass
+
+    @register("ncbb_search")
+    def _on_search_registration(self, sender, msg, t):
+        pass
+
+    @register("ncbb_results")
+    def _on_results_registration(self, sender, msg, t):
+        pass
+
+    @register("ncbb_final")
+    def _on_final_registration(self, sender, msg, t):
         pass
 
     @property
@@ -1108,17 +1173,62 @@ class NcbbComputation(SynchronousComputationMixin, VariableComputation):
         for child in self._descendants:
             self.post_msg(child, NcbbValueMessage(self.current_value))
         if self.is_leaf:
+            # Isolated root: its greedy selection IS the optimum.
             self._finish_and_stop()
 
     def on_new_cycle(self, messages, cycle_id) -> Optional[List]:
+        self._outbox_search = {}
+        self._outbox_results = []
         for sender, (msg, t) in sorted(messages.items()):
             if msg.type == "ncbb_value":
                 self._value_phase(sender, msg.value)
             elif msg.type == "ncbb_cost":
-                self._cost_phase(sender, msg.cost)
+                self._cost_phase(sender, msg.cost, msg.separator)
             elif msg.type == "ncbb_stop":
                 self._on_stop(sender)
-        return None
+            elif msg.type == "ncbb_search":
+                if sender != self._parent:
+                    from pydcop_tpu.infrastructure.computations import (
+                        ComputationException,
+                    )
+
+                    raise ComputationException(
+                        f"{self.name}: ncbb search from non-parent "
+                        f"{sender}"
+                    )
+                for ctx in msg.contexts:
+                    self._handle_search_request(ctx)
+            elif msg.type == "ncbb_results":
+                if sender not in self._children:
+                    from pydcop_tpu.infrastructure.computations import (
+                        ComputationException,
+                    )
+
+                    raise ComputationException(
+                        f"{self.name}: ncbb results from non-child "
+                        f"{sender}"
+                    )
+                self._handle_results(sender, msg.results)
+            elif msg.type == "ncbb_final":
+                if sender != self._parent:
+                    from pydcop_tpu.infrastructure.computations import (
+                        ComputationException,
+                    )
+
+                    raise ComputationException(
+                        f"{self.name}: ncbb final from non-parent "
+                        f"{sender}"
+                    )
+                self._handle_final(msg.context)
+        out = []
+        for child, ctxs in self._outbox_search.items():
+            out.append((child, NcbbSearchMessage(ctxs)))
+        if self._outbox_results and self._parent:
+            out.append(
+                (self._parent,
+                 NcbbResultsMessage(self._outbox_results))
+            )
+        return out or None
 
     def _value_phase(self, sender: str, value):
         if sender not in self._ancestors:
@@ -1140,9 +1250,13 @@ class NcbbComputation(SynchronousComputationMixin, VariableComputation):
         for child in self._descendants:
             self.post_msg(child, NcbbValueMessage(self.current_value))
         if self.is_leaf and self._parent:
-            self.post_msg(self._parent, NcbbCostMessage(cost))
+            self._own_sep = self._constrained_ancestors()
+            self.post_msg(
+                self._parent,
+                NcbbCostMessage(cost, sorted(self._own_sep)),
+            )
 
-    def _cost_phase(self, sender: str, cost: float):
+    def _cost_phase(self, sender: str, cost: float, separator):
         if sender not in self._children:
             from pydcop_tpu.infrastructure.computations import (
                 ComputationException,
@@ -1152,15 +1266,25 @@ class NcbbComputation(SynchronousComputationMixin, VariableComputation):
                 f"{self.name}: ncbb cost from non-child {sender}"
             )
         self._children_costs[sender] = cost
+        self._child_sep[sender] = frozenset(separator)
         self._upper_bound += cost
         if len(self._children_costs) < len(self._children):
             return
         self.phase = "SEARCH"
+        self._own_sep = frozenset(
+            self._constrained_ancestors().union(*self._child_sep.values())
+            - {self.name}
+        )
         if not self.is_root:
-            self.post_msg(self._parent, NcbbCostMessage(self._upper_bound))
+            self.post_msg(
+                self._parent,
+                NcbbCostMessage(
+                    self._upper_bound, sorted(self._own_sep)),
+            )
         else:
-            # Root holds the global upper bound: terminate the run.
-            self._finish_and_stop()
+            # Root holds the global INIT bound: start the search with
+            # the empty context.
+            self._handle_search_request({})
 
     def _finish_and_stop(self):
         for child in self._children:
@@ -1172,6 +1296,151 @@ class NcbbComputation(SynchronousComputationMixin, VariableComputation):
         for child in self._children:
             self.post_msg(child, NcbbStopMessage())
         self.finished()
+
+    # -- SEARCH phase -------------------------------------------------- #
+
+    @staticmethod
+    def _key(ctx: dict) -> tuple:
+        return tuple(sorted(ctx.items()))
+
+    def _constrained_ancestors(self) -> set:
+        """Ancestors appearing in this variable's own constraints."""
+        names = set()
+        for c in self._constraints:
+            names.update(c.scope_names)
+        names.discard(self.name)
+        return names
+
+    def _project(self, ctx: dict, sep: frozenset) -> dict:
+        return {k: v for k, v in ctx.items() if k in sep}
+
+    def _charged_cost(self, ctx: dict, val) -> float:
+        """Own + unary costs plus every constraint between this
+        variable and an ancestor (all evaluable from ctx)."""
+        cost = self.variable.cost_for_val(val)
+        asst = {**ctx, self.name: val}
+        for c in self._constraints:
+            if all(s in asst for s in c.scope_names):
+                cost += c(**{s: asst[s] for s in c.scope_names})
+        return cost
+
+    def _pruned(self, cost: float) -> bool:
+        """Hard-violation pruning only: finite-bound pruning would be
+        unsound with negative constraint costs."""
+        if self.mode == "min":
+            return cost == float("inf")
+        return cost == float("-inf")
+
+    def _child_key(self, ctx: dict, val, child: str):
+        """(projected context, key) a child must solve when I take
+        ``val`` under my (already-projected) context ``ctx``."""
+        child_ctx = self._project(
+            {**ctx, self.name: val}, self._child_sep[child]
+        )
+        return child_ctx, self._key(child_ctx)
+
+    def _handle_search_request(self, ctx: dict):
+        """``ctx`` arrives projected onto my separator (the parent
+        projects before sending, using the separator I reported on my
+        INIT cost message)."""
+        key = self._key(ctx)
+        if key in self._result_cache:
+            self._queue_result(ctx, self._result_cache[key])
+            return
+        if key in self._open_ctx:
+            return  # already being explored
+        own = {
+            val: self._charged_cost(ctx, val)
+            for val in self.variable.domain
+        }
+        self._own_costs[key] = own
+        self._open_ctx[key] = ctx
+        if self.is_leaf:
+            self._resolve(key)
+            return
+        for val in self.variable.domain:
+            if self._pruned(own[val]):
+                continue
+            for child in self._children:
+                child_ctx, ckey = self._child_key(ctx, val, child)
+                requested = self._requested.setdefault(child, set())
+                if ckey in requested:
+                    continue
+                requested.add(ckey)
+                self._outbox_search.setdefault(child, []).append(
+                    child_ctx)
+        self._maybe_resolve(key)
+
+    def _handle_results(self, sender: str, results):
+        for child_ctx, cost in results:
+            self._child_results.setdefault(sender, {})[
+                self._key(child_ctx)] = cost
+        # Projection makes open-context counts small (bounded by the
+        # separator-width cross product), so just re-check them all.
+        for key in list(self._open_ctx):
+            self._maybe_resolve(key)
+
+    def _maybe_resolve(self, key: tuple):
+        """Resolve an open context once every non-pruned value has all
+        children's subtree optima (or everything was pruned)."""
+        if key not in self._open_ctx:
+            return
+        ctx = self._open_ctx[key]
+        own = self._own_costs[key]
+        for val in self.variable.domain:
+            if self._pruned(own[val]):
+                continue
+            for child in self._children:
+                _, ckey = self._child_key(ctx, val, child)
+                if ckey not in self._child_results.get(child, {}):
+                    return
+        self._resolve(key)
+
+    def _resolve(self, key: tuple):
+        better = (
+            (lambda a, b: a < b) if self.mode == "min"
+            else (lambda a, b: a > b)
+        )
+        ctx = self._open_ctx.pop(key)
+        own = self._own_costs.pop(key)
+        best_val, best_cost = None, None
+        for val in self.variable.domain:
+            cost = own[val]
+            if not self.is_leaf and not self._pruned(cost):
+                for child in self._children:
+                    _, ckey = self._child_key(ctx, val, child)
+                    cost += self._child_results[child][ckey]
+            if best_cost is None or better(cost, best_cost):
+                best_val, best_cost = val, cost
+        self._result_cache[key] = best_cost
+        self._memo_value[key] = best_val
+        if self.is_root:
+            self._finish_search(ctx, best_val)
+        else:
+            self._queue_result(ctx, best_cost)
+
+    def _queue_result(self, ctx: dict, cost: float):
+        self._outbox_results.append([ctx, cost])
+
+    def _finish_search(self, ctx: dict, best_val):
+        """Fix the optimal value and propagate the final context down
+        the tree (each node answers from its memo after projecting)."""
+        self.value_selection(best_val)
+        final_ctx = {**ctx, self.name: best_val}
+        for child in self._children:
+            self.post_msg(child, NcbbFinalMessage(final_ctx))
+        self.finished()
+
+    def _handle_final(self, context: dict):
+        """The final context accumulates every chosen value on the
+        path; my searched key is its projection onto my separator."""
+        key = self._key(self._project(context, self._own_sep))
+        best_val = self._memo_value.get(key)
+        if best_val is None:
+            # Never searched (subtree fully pruned upstream): fall
+            # back to the INIT greedy value already selected.
+            best_val = self.current_value
+        self._finish_search(context, best_val)
 
 
 # --------------------------------------------------------------------- #

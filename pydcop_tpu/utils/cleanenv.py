@@ -110,10 +110,19 @@ def ensure_live_backend(tag="bench", retries=1, probe_timeout=120,
     survives the re-exec — benchmarks embed it in their JSON so a CPU
     fallback is always accompanied by evidence of the wedge.
     """
-    import sys
-
     if os.environ.get("PYDCOP_BENCH_NO_PROBE"):
         return
+    if not probe_with_retries(tag, retries, probe_timeout, backoff):
+        cpu_fallback_exec(tag)
+
+
+def probe_with_retries(tag, retries, probe_timeout=120, backoff=10.0):
+    """Probe the backend up to ``retries`` times with ``backoff``
+    seconds between failures (none after the last), recording every
+    attempt in the diagnostic log.  Returns True when a probe
+    succeeds."""
+    import sys
+
     for attempt in range(retries):
         ok, error, dt = probe_backend(probe_timeout)
         record_diag(
@@ -121,14 +130,14 @@ def ensure_live_backend(tag="bench", retries=1, probe_timeout=120,
             ok=ok, error=error, seconds=round(dt, 1),
         )
         if ok:
-            return
+            return True
         print(
             f"{tag}: accelerator probe {attempt + 1}/{retries} "
             f"failed ({error})", file=sys.stderr,
         )
         if attempt < retries - 1:
             time.sleep(backoff)
-    cpu_fallback_exec(tag)
+    return False
 
 
 def cpu_fallback_exec(tag):

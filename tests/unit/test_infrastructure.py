@@ -503,6 +503,9 @@ class TestNcbbGreedyCosts:
             constraint_from_str("c1", "1 - abs(v1 - v2)", [v1, v2]))
         dcop.add_agents([AgentDef("a1"), AgentDef("a2")])
         res = solve(dcop, "ncbb", backend="thread", timeout=5)
-        # Greedy INIT must count v2's own cost: picks v2=0 (cost 1)
-        # rather than v2=1 (cost 10).
-        assert res["cost"] == pytest.approx(1.0)
+        # The search must count v2's own cost: the optimum is
+        # v1=1, v2=0 (constraint 0, own cost 0) — ignoring own costs
+        # would allow v2=1 assignments whose true cost is >= 10.
+        # (Before the SEARCH phase landed this asserted the INIT
+        # greedy's 1.0; search now reaches the optimum.)
+        assert res["cost"] == pytest.approx(0.0)

@@ -36,7 +36,10 @@ class TestAlgorithmMessages:
         ("agent_algorithms", "MgmValueMessage", (1,)),
         ("agent_algorithms", "MgmGainMessage", (3.5, 0.77)),
         ("agent_algorithms", "NcbbValueMessage", ("G",)),
-        ("agent_algorithms", "NcbbCostMessage", (12.5,)),
+        ("agent_algorithms", "NcbbCostMessage", (12.5, ["v1", "v2"])),
+        ("agent_algorithms", "NcbbSearchMessage", ([{"v1": "R"}, {"v1": "G"}],)),
+        ("agent_algorithms", "NcbbResultsMessage", ([[{"v1": "R"}, 2.0]],)),
+        ("agent_algorithms", "NcbbFinalMessage", ({"v1": "R", "v2": "G"},)),
         ("agent_algorithms", "NcbbStopMessage", ()),
         ("agent_breakout", "DbaOkMessage", ("B",)),
         ("agent_breakout", "DbaEndMessage", ()),
