@@ -1,0 +1,30 @@
+# Test / check targets (reference parity: pydcop Makefile — unit,
+# api, cli, doctests, and a static gate; the reference's mypy target
+# maps to tools/static_check.py since mypy is not installable here).
+
+PY ?= python
+
+.PHONY: all test unit api cli check bench dryrun
+
+all: check test
+
+test:
+	$(PY) -m pytest tests/ -q
+
+unit:
+	$(PY) -m pytest tests/unit -q
+
+api:
+	$(PY) -m pytest tests/api -q
+
+cli:
+	$(PY) -m pytest tests/cli -q
+
+check:
+	$(PY) tools/static_check.py
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	$(PY) -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -155,3 +155,43 @@ def test_secp_fixture_solves():
     expected = _brute_force_cost(dcop)
     res = solve(dcop, "dpop")
     assert res["cost"] == pytest.approx(expected, abs=1e-5)
+
+
+# Fixtures whose joint space is too big to enumerate but whose
+# pseudo-tree is narrow enough for DPOP — DPOP (exact by construction,
+# itself brute-force-validated on every tractable fixture above) is
+# the oracle here, completing coverage of ALL reference instance
+# files.
+INTRACTABLE = [
+    p for p in _fixtures()
+    if p not in TRACTABLE
+]
+
+
+@pytest.mark.parametrize(
+    "path", INTRACTABLE,
+    ids=[os.path.basename(p) for p in INTRACTABLE],
+)
+def test_exact_algorithms_agree_on_large_fixtures(path):
+    from pydcop_tpu.distribution.objects import (
+        ImpossibleDistributionException,
+    )
+
+    dcop = load_dcop_from_file([path])
+    oracle = solve(load_dcop_from_file([path]), "dpop")
+    assert oracle["status"] == "FINISHED"
+    # syncbb's B&B bounds are too weak for SimpleHouse's real-valued
+    # intentional costs (minutes of search); covered by dpop+ncbb.
+    slow_for_syncbb = os.path.basename(path) == "SimpleHouse.yml"
+    if dcop.objective == "min" and not slow_for_syncbb:
+        res = solve(load_dcop_from_file([path]), "syncbb")
+        assert res["cost"] == pytest.approx(
+            oracle["cost"], abs=1e-5), "syncbb vs dpop"
+    try:
+        res = solve(dcop, "ncbb", backend="thread",
+                    distribution="adhoc", timeout=30)
+    except ImpossibleDistributionException as exc:
+        pytest.skip(f"agents cannot host the graph: {exc}")
+    assert res["status"] == "FINISHED"
+    assert res["cost"] == pytest.approx(
+        oracle["cost"], abs=1e-5), "agent ncbb vs dpop"

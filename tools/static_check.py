@@ -1,0 +1,53 @@
+"""Static consistency gate (the reference runs mypy, Makefile:20;
+mypy is not installable in this zero-egress image, so this is the
+stdlib equivalent): byte-compile every source file, then import every
+module of the package under a scrubbed CPU backend — catching syntax
+errors, missing imports, and module-level typos across the whole tree
+in one pass.
+
+Run:  python tools/static_check.py      (exit 0 = clean)
+"""
+
+import compileall
+import importlib
+import os
+import pkgutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, REPO)
+
+    ok = compileall.compile_dir(
+        os.path.join(REPO, "pydcop_tpu"), quiet=1, force=True)
+    ok &= compileall.compile_dir(
+        os.path.join(REPO, "tests"), quiet=1, force=True)
+    if not ok:
+        print("static_check: byte-compilation failed")
+        return 1
+
+    import pydcop_tpu
+
+    failures = []
+    for mod in pkgutil.walk_packages(
+            pydcop_tpu.__path__, prefix="pydcop_tpu."):
+        try:
+            importlib.import_module(mod.name)
+        except Exception as exc:  # noqa: BLE001 - report, don't mask
+            failures.append((mod.name, f"{type(exc).__name__}: {exc}"))
+    if failures:
+        print(f"static_check: {len(failures)} module(s) failed to "
+              "import:")
+        for name, err in failures:
+            print(f"  {name}: {err}")
+        return 1
+    print("static_check: all modules compile and import cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
