@@ -66,7 +66,9 @@ def _parse_domain_values(raw_values) -> List:
         if m:
             lo, hi = int(m.group(1)), int(m.group(2))
             return list(range(lo, hi + 1))
-        return [raw_values]
+        # Single scalar string: fall through to the shared coercion so
+        # values: "7" and values: ["7"] produce the same int domain.
+        raw_values = [raw_values]
     values: List = []
     for v in raw_values:
         if isinstance(v, str):
@@ -75,15 +77,25 @@ def _parse_domain_values(raw_values) -> List:
                 values.extend(range(int(m.group(1)), int(m.group(2)) + 1))
                 continue
         values.append(v)
-    # If every value is a *string* that parses as an int, the domain is an
-    # int domain (reference behavior for ranges / quoted ints).  Values
-    # yaml already parsed as numbers/bools are kept as-is — coercing
-    # floats would corrupt the domain.
-    if values and all(isinstance(v, str) for v in values):
-        try:
-            return [int(v) for v in values]
-        except ValueError:
-            pass
+    # If every value is an int or a *string* that parses as one, the
+    # domain is an int domain (reference behavior for ranges / quoted
+    # ints) — this also covers a range mixed with quoted ints, which
+    # would otherwise produce an inconsistent [1, 2, 3, '7'] domain.
+    # Values yaml already parsed as floats/bools are kept as-is —
+    # coercing them would corrupt the domain.
+    def _is_intish(v):
+        if isinstance(v, bool) or not isinstance(v, (int, str)):
+            return False
+        if isinstance(v, str):
+            try:
+                int(v)
+            except ValueError:
+                return False
+        return True
+
+    if values and any(isinstance(v, str) for v in values) \
+            and all(_is_intish(v) for v in values):
+        return [int(v) for v in values]
     return values
 
 

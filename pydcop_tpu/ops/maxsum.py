@@ -245,7 +245,10 @@ def var_to_factor(graph: CompiledFactorGraph, f2v: Msgs,
                     keepdims=True)
             / n_valid
         )
-        out.append(jnp.where(valid, raw - avg, BIG))
+        # BIG as the message dtype: a float32 literal would silently
+        # promote bfloat16 message arrays back to f32.
+        out.append(jnp.where(valid, raw - avg,
+                             jnp.asarray(BIG, raw.dtype)))
     return tuple(out)
 
 

@@ -1,0 +1,179 @@
+"""Extended discovery battery on top of test_discovery.py's pub-sub
+scenarios — local-cache semantics, standalone (no-directory) mode,
+error paths, and multi-subscriber fan-out (reference
+test_infra_discovery.py depth)."""
+
+from typing import Dict
+
+import pytest
+
+from pydcop_tpu.infrastructure.discovery import (
+    DIRECTORY_COMP,
+    DirectoryComputation,
+    Discovery,
+    UnknownAgent,
+)
+
+
+class Bus:
+    def __init__(self):
+        self.comps: Dict[str, object] = {}
+
+    def wire_comp(self, comp):
+        self.comps[comp.name] = comp
+        comp.message_sender = (
+            lambda src, target, msg, prio=0, on_error=None:
+            self.comps[target].on_message(src, msg, 0)
+        )
+
+
+@pytest.fixture()
+def net():
+    bus = Bus()
+    directory = DirectoryComputation()
+    bus.wire_comp(directory)
+
+    def make(agent, address):
+        disco = Discovery(agent, address)
+        disco.use_directory("orchestrator", "orch_addr")
+        bus.wire_comp(disco.discovery_computation)
+        return disco
+
+    return bus, make
+
+
+class TestLocalCache:
+    def test_own_agent_preseeded(self):
+        d = Discovery("a1", "addr1")
+        assert d.agent_address("a1") == "addr1"
+        assert "a1" in d.agents()
+
+    def test_unknown_agent_raises(self):
+        d = Discovery("a1", "addr1")
+        with pytest.raises(UnknownAgent):
+            d.agent_address("ghost")
+
+    def test_unknown_computation_raises_keyerror(self):
+        d = Discovery("a1", "addr1")
+        with pytest.raises(KeyError):
+            d.computation_agent("ghost")
+
+    def test_use_directory_seeds_cache(self):
+        d = Discovery("a1", "addr1")
+        d.use_directory("orch", "orch_addr")
+        assert d.agent_address("orch") == "orch_addr"
+        assert d.computation_agent(DIRECTORY_COMP) == "orch"
+
+    def test_register_computation_defaults_to_own_agent(self):
+        d = Discovery("a1", "addr1")
+        d.register_computation("v1")
+        assert d.computation_agent("v1") == "a1"
+
+    def test_register_computation_with_address_caches_agent(self):
+        d = Discovery("a1", "addr1")
+        d.register_computation("v9", "a9", address="addr9")
+        assert d.computation_agent("v9") == "a9"
+        assert d.agent_address("a9") == "addr9"
+
+    def test_unregister_computation_clears(self):
+        d = Discovery("a1", "addr1")
+        d.register_computation("v1")
+        d.unregister_computation("v1")
+        with pytest.raises(KeyError):
+            d.computation_agent("v1")
+
+    def test_replica_agents_default_empty(self):
+        d = Discovery("a1", "addr1")
+        assert d.replica_agents("v1") == []
+
+    def test_standalone_mode_no_directory_is_local_only(self):
+        # Without use_directory, registrations stay purely local and
+        # never try to send anything (no directory to send to).
+        d = Discovery("a1", "addr1")
+        d.register_agent("a2", "addr2")
+        d.register_computation("v1", "a2")
+        d.unregister_agent("a2")
+        with pytest.raises(UnknownAgent):
+            d.agent_address("a2")
+
+
+class TestHooks:
+    def test_local_register_fires_hooks(self):
+        d = Discovery("a1", "addr1")
+        seen = []
+        d.agent_change_hooks.append(lambda e, n: seen.append((e, n)))
+        d.register_agent("a2", "x")
+        d.unregister_agent("a2")
+        assert seen == [("agent_added", "a2"), ("agent_removed", "a2")]
+
+    def test_hook_exception_does_not_break_registration(self):
+        d = Discovery("a1", "addr1")
+
+        def bad_hook(e, n):
+            raise RuntimeError("boom")
+
+        d.agent_change_hooks.append(bad_hook)
+        d.register_agent("a2", "x")   # must not raise
+        assert d.agent_address("a2") == "x"
+
+
+class TestFanOut:
+    def test_multiple_subscribers_each_notified(self, net):
+        bus, make = net
+        d1 = make("a1", "addr1")
+        d2 = make("a2", "addr2")
+        d3 = make("a3", "addr3")
+        ev2, ev3 = [], []
+        d2.subscribe_agent("ax", lambda e, n, v: ev2.append((e, n)))
+        d3.subscribe_agent("ax", lambda e, n, v: ev3.append((e, n)))
+        d1.register_agent("ax", "addrx")
+        assert ("agent_added", "ax") in ev2
+        assert ("agent_added", "ax") in ev3
+
+    def test_multiple_callbacks_same_subscriber(self, net):
+        bus, make = net
+        d1 = make("a1", "addr1")
+        d2 = make("a2", "addr2")
+        ev_a, ev_b = [], []
+        d2.subscribe_agent("ax", lambda e, n, v: ev_a.append(e))
+        d2.subscribe_agent("ax", lambda e, n, v: ev_b.append(e))
+        d1.register_agent("ax", "addrx")
+        assert ev_a == ["agent_added"] and ev_b == ["agent_added"]
+
+    def test_non_subscriber_not_notified_or_synced(self, net):
+        bus, make = net
+        d1 = make("a1", "addr1")
+        d2 = make("a2", "addr2")
+        d1.register_agent("ax", "addrx")
+        # d2 never subscribed to ax: its cache must not know it.
+        with pytest.raises(UnknownAgent):
+            d2.agent_address("ax")
+
+    def test_computation_wildcard(self, net):
+        bus, make = net
+        d1 = make("a1", "addr1")
+        d2 = make("a2", "addr2")
+        names = []
+        d2.subscribe_computation(
+            "*", lambda e, n, v: names.append(n))
+        d1.register_computation("c1", "a1", address="addr1")
+        d1.register_computation("c2", "a1", address="addr1")
+        assert {"c1", "c2"} <= set(names)
+
+    def test_replica_late_subscriber_syncs_current_hosts(self, net):
+        bus, make = net
+        d1 = make("a1", "addr1")
+        d1.register_replica("v1", "a7")
+        d2 = make("a2", "addr2")
+        d2.subscribe_replica("v1")
+        assert d2.replica_agents("v1") == ["a7"]
+
+    def test_unregister_replica_idempotent(self, net):
+        bus, make = net
+        d1 = make("a1", "addr1")
+        d1.register_replica("v1", "a7")
+        d1.unregister_replica("v1", "a7")
+        d1.unregister_replica("v1", "a7")   # second removal: no error
+        d2 = make("a2", "addr2")
+        d2.subscribe_replica("v1")
+        assert d2.replica_agents("v1") == []

@@ -1,0 +1,151 @@
+"""Battery over infrastructure/communication.Messaging — priority
+ordering, FIFO-within-priority, park-and-retry, local/remote routing,
+and the per-computation metrics counters (reference
+test_infra_communication.py depth).
+
+Messaging is driven directly with an InProcessCommunicationLayer and a
+minimal in-memory discovery — no agents, no threads.
+"""
+
+import pytest
+
+from pydcop_tpu.infrastructure.communication import (
+    MSG_ALGO,
+    MSG_MGT,
+    MSG_VALUE,
+    ComputationMessage,
+    InProcessCommunicationLayer,
+    Messaging,
+)
+from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.infrastructure.discovery import Discovery
+
+
+def make_messaging(agent="a1", delay=0):
+    comm = InProcessCommunicationLayer()
+    comm.discovery = Discovery(agent, comm)
+    m = Messaging(agent, comm, delay=delay)
+    return m, comm
+
+
+def msg(content="x"):
+    return Message("test", content)
+
+
+class TestPriorities:
+    def test_constants_order(self):
+        assert MSG_MGT < MSG_VALUE < MSG_ALGO
+
+    def test_mgt_before_algo(self):
+        m, _ = make_messaging()
+        m.register_computation("c1")
+        m.post_msg("s", "c1", msg("algo"), prio=MSG_ALGO)
+        m.post_msg("s", "c1", msg("mgt"), prio=MSG_MGT)
+        assert m.next_msg().msg.content == "mgt"
+        assert m.next_msg().msg.content == "algo"
+
+    def test_fifo_within_priority(self):
+        m, _ = make_messaging()
+        m.register_computation("c1")
+        for i in range(5):
+            m.post_msg("s", "c1", msg(i), prio=MSG_ALGO)
+        got = [m.next_msg().msg.content for _ in range(5)]
+        assert got == [0, 1, 2, 3, 4]
+
+    def test_empty_queue_returns_none(self):
+        m, _ = make_messaging()
+        assert m.next_msg(timeout=0.01) is None
+
+
+class TestRouting:
+    def test_local_delivery(self):
+        m, _ = make_messaging()
+        m.register_computation("c1")
+        m.post_msg("src", "c1", msg("hello"))
+        got = m.next_msg()
+        assert got.src_comp == "src"
+        assert got.dest_comp == "c1"
+        assert got.msg.content == "hello"
+
+    def test_unregistered_local_computation_is_remote(self):
+        """After unregister, messages to the computation are parked
+        (unknown destination), not delivered locally."""
+        m, _ = make_messaging()
+        m.register_computation("c1")
+        m.unregister_computation("c1")
+        m.post_msg("s", "c1", msg())
+        assert m.next_msg(timeout=0.01) is None
+
+    def test_remote_delivery_through_comm_layer(self):
+        m1, comm1 = make_messaging("a1")
+        m2, comm2 = make_messaging("a2")
+        m2.register_computation("c2")
+        # a1 learns that c2 lives on a2 (address = comm layer object,
+        # InProcess convention).
+        comm1.discovery.register_agent("a2", comm2)
+        comm1.discovery.register_computation("c2", "a2", publish=False)
+        m1.post_msg("c1", "c2", msg("over the wire"))
+        got = m2.next_msg()
+        assert got.msg.content == "over the wire"
+
+    def test_park_and_retry_on_discovery(self):
+        m1, comm1 = make_messaging("a1")
+        m2, comm2 = make_messaging("a2")
+        m2.register_computation("c2")
+        m1.post_msg("c1", "c2", msg("early"))   # unknown yet: parked
+        assert m2.next_msg(timeout=0.01) is None
+        # Discovery now learns the computation: parked msg flushes.
+        comm1.discovery.register_agent("a2", comm2)
+        comm1.discovery._on_publish(
+            "computation_added", "c2", ("a2", comm2))
+        got = m2.next_msg()
+        assert got is not None and got.msg.content == "early"
+
+    def test_parked_message_order_preserved(self):
+        m1, comm1 = make_messaging("a1")
+        m2, comm2 = make_messaging("a2")
+        m2.register_computation("c2")
+        m1.post_msg("c1", "c2", msg(1))
+        m1.post_msg("c1", "c2", msg(2))
+        comm1.discovery.register_agent("a2", comm2)
+        comm1.discovery._on_publish(
+            "computation_added", "c2", ("a2", comm2))
+        assert m2.next_msg().msg.content == 1
+        assert m2.next_msg().msg.content == 2
+
+
+class TestMetrics:
+    def test_remote_counters_per_source(self):
+        m1, comm1 = make_messaging("a1")
+        m2, comm2 = make_messaging("a2")
+        m2.register_computation("c2")
+        comm1.discovery.register_agent("a2", comm2)
+        comm1.discovery.register_computation("c2", "a2", publish=False)
+        m1.post_msg("cA", "c2", msg())
+        m1.post_msg("cA", "c2", msg())
+        m1.post_msg("cB", "c2", msg())
+        assert m1.count_ext_msg["cA"] == 2
+        assert m1.count_ext_msg["cB"] == 1
+        assert m1.size_ext_msg["cA"] >= 0
+
+    def test_local_messages_not_counted_as_ext(self):
+        m, _ = make_messaging()
+        m.register_computation("c1")
+        m.post_msg("cA", "c1", msg())
+        assert "cA" not in m.count_ext_msg
+
+    def test_queue_count_increments(self):
+        m, _ = make_messaging()
+        m.register_computation("c1")
+        before = m.msg_queue_count
+        m.post_msg("s", "c1", msg())
+        m.post_msg("s", "c1", msg())
+        assert m.msg_queue_count == before + 2
+
+
+class TestComputationMessage:
+    def test_fields(self):
+        cm = ComputationMessage("a", "b", msg("m"), MSG_ALGO)
+        assert cm.src_comp == "a"
+        assert cm.dest_comp == "b"
+        assert cm.msg_type == MSG_ALGO

@@ -1,0 +1,128 @@
+"""Battery over replication/path_utils.py — the pure path-table
+algebra under the UCS replica placement (reference
+test_replication_path_utils.py depth)."""
+
+import pytest
+
+from pydcop_tpu.replication.path_utils import (
+    add_path,
+    affordable_path_from,
+    before_last,
+    cheapest_path_to,
+    filter_missing_agents_paths,
+    head,
+    last,
+    remove_path,
+)
+
+
+class TestPathAccessors:
+    def test_head(self):
+        assert head(("a", "b", "c")) == "a"
+
+    def test_head_empty(self):
+        assert head(()) is None
+
+    def test_last(self):
+        assert last(("a", "b", "c")) == "c"
+
+    def test_last_single(self):
+        assert last(("a",)) == "a"
+
+    def test_last_empty(self):
+        assert last(()) is None
+
+    def test_before_last(self):
+        assert before_last(("a", "b", "c")) == "b"
+
+    def test_before_last_pair(self):
+        assert before_last(("a", "b")) == "a"
+
+    def test_before_last_too_short_raises(self):
+        with pytest.raises(IndexError):
+            before_last(("a",))
+        with pytest.raises(IndexError):
+            before_last(())
+
+
+class TestTableOps:
+    def test_add_keeps_sorted(self):
+        t = add_path([], 3.0, ("a", "b"))
+        t = add_path(t, 1.0, ("a", "c"))
+        t = add_path(t, 2.0, ("a", "d"))
+        assert [c for c, _ in t] == [1.0, 2.0, 3.0]
+
+    def test_add_is_pure(self):
+        t0 = [(1.0, ("a",))]
+        t1 = add_path(t0, 0.5, ("b",))
+        assert t0 == [(1.0, ("a",))]
+        assert len(t1) == 2
+
+    def test_add_equal_costs_both_kept(self):
+        t = add_path([(1.0, ("a", "b"))], 1.0, ("a", "c"))
+        assert len(t) == 2
+
+    def test_remove_path(self):
+        t = [(1.0, ("a", "b")), (2.0, ("a", "c"))]
+        t2 = remove_path(t, ("a", "b"))
+        assert t2 == [(2.0, ("a", "c"))]
+        assert len(t) == 2   # pure
+
+    def test_remove_all_entries_for_path(self):
+        t = [(1.0, ("a", "b")), (2.0, ("a", "b"))]
+        assert remove_path(t, ("a", "b")) == []
+
+    def test_remove_missing_is_noop(self):
+        t = [(1.0, ("a", "b"))]
+        assert remove_path(t, ("x",)) == t
+
+
+class TestQueries:
+    TABLE = [
+        (1.0, ("o", "a")),
+        (2.0, ("o", "a", "b")),
+        (3.0, ("o", "c")),
+        (4.0, ("o", "a", "d")),
+    ]
+
+    def test_cheapest_path_to_hit(self):
+        cost, path = cheapest_path_to("b", self.TABLE)
+        assert (cost, path) == (2.0, ("o", "a", "b"))
+
+    def test_cheapest_path_to_prefers_lowest_cost(self):
+        table = add_path(list(self.TABLE), 0.5, ("o", "x", "b"))
+        cost, path = cheapest_path_to("b", table)
+        assert cost == 0.5 and path == ("o", "x", "b")
+
+    def test_cheapest_path_to_miss(self):
+        cost, path = cheapest_path_to("zz", self.TABLE)
+        assert cost == float("inf") and path == ()
+
+    def test_affordable_extends_prefix(self):
+        got = affordable_path_from(("o", "a"), 10.0, self.TABLE)
+        assert got == [(2.0, ("o", "a", "b")), (4.0, ("o", "a", "d"))]
+
+    def test_affordable_respects_budget(self):
+        got = affordable_path_from(("o", "a"), 2.0, self.TABLE)
+        assert got == [(2.0, ("o", "a", "b"))]
+
+    def test_affordable_excludes_the_prefix_itself(self):
+        got = affordable_path_from(("o", "a"), 10.0, self.TABLE)
+        assert (1.0, ("o", "a")) not in got
+
+    def test_affordable_empty_prefix_matches_all_longer(self):
+        got = affordable_path_from((), 10.0, self.TABLE)
+        assert len(got) == 4
+
+    def test_filter_missing_agents(self):
+        got = filter_missing_agents_paths(
+            self.TABLE, {"a", "b", "d"})
+        # paths through "c" dropped; origin (path[0]) is exempt
+        assert (3.0, ("o", "c")) not in got
+        assert len(got) == 3
+
+    def test_filter_origin_exempt(self):
+        # The origin agent itself need not be in the available set.
+        got = filter_missing_agents_paths(
+            [(1.0, ("gone", "a"))], {"a"})
+        assert got == [(1.0, ("gone", "a"))]

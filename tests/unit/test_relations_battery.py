@@ -1,0 +1,504 @@
+"""Deep battery over the constraint algebra (dcop/relations.py) —
+every class and free function, including the edge cases the reference
+exercises heavily (its test_dcop_relations.py has ~140 tests; this
+file brings our coverage of the numeric core to comparable depth).
+"""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.objects import Domain, Variable, VariableWithCostDict
+from pydcop_tpu.dcop.relations import (
+    AsNAryFunctionRelation,
+    ConditionalRelation,
+    Constraint,
+    MAX_MATERIALIZED_ELEMENTS,
+    NAryFunctionRelation,
+    NAryMatrixRelation,
+    NeutralRelation,
+    RelationProtocol,
+    UnaryBooleanRelation,
+    UnaryFunctionRelation,
+    ZeroAryRelation,
+    add_var_to_rel,
+    assignment_cost,
+    assignment_matrix,
+    constraint_from_str,
+    count_var_match,
+    find_arg_optimal,
+    find_optimal,
+    find_optimum,
+    generate_assignment,
+    generate_assignment_as_dict,
+    join,
+    optimal_cost_value,
+    projection,
+)
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+d2 = Domain("d2", "", ["a", "b"])
+d3 = Domain("d3", "", [0, 1, 2])
+x = Variable("x", d2)
+y = Variable("y", d2)
+z = Variable("z", d3)
+
+
+# --- ZeroAryRelation ------------------------------------------------- #
+
+class TestZeroAry:
+    def test_value(self):
+        r = ZeroAryRelation("k", 7.5)
+        assert r() == 7.5
+
+    def test_arity_and_dims(self):
+        r = ZeroAryRelation("k", 1)
+        assert r.arity == 0
+        assert r.dimensions == []
+        assert r.scope_names == []
+
+    def test_to_array_scalar(self):
+        arr = ZeroAryRelation("k", 3).to_array()
+        assert arr.shape == ()
+        assert float(arr) == 3
+
+    def test_shape_empty(self):
+        assert ZeroAryRelation("k", 1).shape == ()
+
+
+# --- UnaryFunctionRelation ------------------------------------------- #
+
+class TestUnaryFunction:
+    def test_callable(self):
+        r = UnaryFunctionRelation("u", z, lambda v: v * 10)
+        assert r(2) == 20
+
+    def test_kwargs_call(self):
+        r = UnaryFunctionRelation("u", z, lambda v: v + 1)
+        assert r(z=1) == 2
+
+    def test_expression_string(self):
+        r = UnaryFunctionRelation("u", z, "z ** 2")
+        assert r(2) == 4
+        assert r.expression == "z ** 2"
+
+    def test_expression_none_for_callable(self):
+        r = UnaryFunctionRelation("u", z, lambda v: v)
+        assert r.expression is None
+
+    def test_variable_property(self):
+        r = UnaryFunctionRelation("u", z, lambda v: v)
+        assert r.variable is z
+
+    def test_to_array(self):
+        r = UnaryFunctionRelation("u", z, lambda v: v * 2)
+        np.testing.assert_array_equal(r.to_array(), [0, 2, 4])
+
+    def test_get_value_for_assignment_dict_and_list(self):
+        r = UnaryFunctionRelation("u", z, lambda v: v + 5)
+        assert r.get_value_for_assignment({"z": 1}) == 6
+        assert r.get_value_for_assignment([2]) == 7
+
+
+class TestUnaryBoolean:
+    def test_truthy(self):
+        r = UnaryBooleanRelation("b", z)
+        assert r(0) == 0
+        assert r(1) == 1
+        assert r(2) == 1
+
+    def test_kwargs(self):
+        r = UnaryBooleanRelation("b", z)
+        assert r(z=0) == 0
+
+
+# --- NAryFunctionRelation -------------------------------------------- #
+
+class TestNAryFunction:
+    def test_positional(self):
+        r = NAryFunctionRelation(lambda a, b: a + b, [z, z2()], "s")
+        assert r(1, 2) == 3
+
+    def test_keyword(self):
+        r = NAryFunctionRelation(
+            lambda a, b: a - b, [Variable("a", d3), Variable("b", d3)],
+            "s")
+        assert r(a=2, b=1) == 1
+
+    def test_expression(self):
+        r = NAryFunctionRelation("x1 + 2 * x2",
+                                 [Variable("x1", d3), Variable("x2", d3)])
+        assert r(1, 2) == 5
+
+    def test_expression_dims_order_from_ctor(self):
+        v1, v2 = Variable("x1", d3), Variable("x2", d3)
+        r = NAryFunctionRelation("x2 - x1", [v1, v2])
+        # positional args follow the ctor's variable order
+        assert r(2, 0) == -2
+
+    def test_slice_expression(self):
+        v1, v2 = Variable("x1", d3), Variable("x2", d3)
+        r = NAryFunctionRelation("x1 * 10 + x2", [v1, v2], name="e")
+        s = r.slice({"x1": 2})
+        assert s.arity == 1
+        assert s.scope_names == ["x2"]
+        assert s(1) == 21
+
+    def test_slice_callable(self):
+        v1, v2 = Variable("x1", d3), Variable("x2", d3)
+        r = NAryFunctionRelation(lambda x1, x2: x1 * 10 + x2, [v1, v2],
+                                 name="c")
+        s = r.slice({"x2": 1})
+        assert s.scope_names == ["x1"]
+        assert s(2) == 21
+
+    def test_function_property(self):
+        f = lambda a: a  # noqa: E731
+        r = NAryFunctionRelation(f, [z], "n")
+        assert r.function is f
+
+    def test_wire_roundtrip_expression(self):
+        v1, v2 = Variable("x1", d3), Variable("x2", d3)
+        r = NAryFunctionRelation("x1 + x2", [v1, v2], name="w")
+        r2 = from_repr(simple_repr(r))
+        assert r2(1, 2) == 3
+        assert r2.name == "w"
+        assert r2.scope_names == ["x1", "x2"]
+
+    def test_decorator(self):
+        @AsNAryFunctionRelation(z)
+        def my_rel(zv):
+            return zv * 3
+
+        assert my_rel.name == "my_rel"
+        assert my_rel(2) == 6
+        assert my_rel.arity == 1
+
+
+def z2():
+    return Variable("z2", d3)
+
+
+# --- NAryMatrixRelation ---------------------------------------------- #
+
+class TestMatrixRelation:
+    def test_default_zero_matrix(self):
+        r = NAryMatrixRelation([x, y])
+        assert r("a", "b") == 0.0
+        assert r.matrix.shape == (2, 2)
+
+    def test_lookup_order(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        assert r(x="a", y="b") == 2.0
+        assert r(x="b", y="a") == 3.0
+
+    def test_positional_call(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        assert r("b", "b") == 4.0
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="does not match"):
+            NAryMatrixRelation([x, y], np.zeros((2, 3)), "bad")
+
+    def test_get_value_for_assignment_list(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        assert r.get_value_for_assignment(["a", "b"]) == 2.0
+
+    def test_set_value_immutable(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        r2 = r.set_value_for_assignment({"x": "a", "y": "a"}, 9)
+        assert r2("a", "a") == 9.0
+        assert r("a", "a") == 1.0  # original untouched
+        assert r2.name == r.name
+
+    def test_slice_to_unary(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        s = r.slice({"x": "b"})
+        assert s.arity == 1
+        assert s.scope_names == ["y"]
+        np.testing.assert_array_equal(s.matrix, [3, 4])
+
+    def test_slice_empty_partial_is_identity(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        s = r.slice({})
+        assert s.scope_names == ["x", "y"]
+        np.testing.assert_array_equal(s.matrix, r.matrix)
+
+    def test_slice_all_gives_zero_ary_matrix(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        s = r.slice({"x": "a", "y": "b"})
+        assert s.arity == 0
+        assert float(s.matrix) == 2.0
+
+    def test_from_func_relation(self):
+        f = NAryFunctionRelation("x1 + x2",
+                                 [Variable("x1", d3), Variable("x2", d3)],
+                                 name="f")
+        m = NAryMatrixRelation.from_func_relation(f)
+        assert isinstance(m, NAryMatrixRelation)
+        assert m.name == "f"
+        assert m(2, 2) == 4.0
+
+    def test_equality_includes_matrix(self):
+        a = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        b = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        c = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 5]]), "m")
+        assert a == b
+        assert a != c
+
+    def test_wire_roundtrip(self):
+        r = NAryMatrixRelation([x, z], np.arange(6).reshape(2, 3), "w")
+        r2 = from_repr(simple_repr(r))
+        assert r2 == r
+        assert r2(x="b", z=2) == 5.0
+
+    def test_3d_matrix(self):
+        w = Variable("w", d2)
+        m = np.arange(8).reshape(2, 2, 2)
+        r = NAryMatrixRelation([x, y, w], m, "cube")
+        assert r("b", "a", "b") == 5.0
+        assert r.shape == (2, 2, 2)
+
+
+# --- Neutral / Conditional ------------------------------------------- #
+
+class TestNeutralConditional:
+    def test_neutral_zero_everywhere(self):
+        r = NeutralRelation([x, y])
+        for a in generate_assignment_as_dict([x, y]):
+            assert r(**a) == 0
+
+    def test_neutral_is_join_identity(self):
+        m = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        j = join(m, NeutralRelation([x, y]))
+        np.testing.assert_array_equal(j.matrix, m.matrix)
+
+    def test_conditional_applies_when_true(self):
+        cond = UnaryBooleanRelation("c", z)
+        rel = UnaryFunctionRelation("u", z, lambda v: v * 10)
+        r = ConditionalRelation(cond, rel)
+        assert r(z=2) == 20
+        assert r(z=0) == 0   # condition falsy -> default
+
+    def test_conditional_custom_default(self):
+        cond = UnaryBooleanRelation("c", z)
+        rel = UnaryFunctionRelation("u", z, lambda v: v)
+        r = ConditionalRelation(cond, rel, return_default=99)
+        assert r(z=0) == 99
+
+    def test_conditional_dims_union(self):
+        cond = UnaryBooleanRelation("c", z)
+        rel = NAryFunctionRelation(
+            "x1 + z", [Variable("x1", d3), z])
+        r = ConditionalRelation(cond, rel)
+        assert set(r.scope_names) == {"z", "x1"}
+        # z appears once even though it is in both scopes
+        assert len(r.scope_names) == 2
+
+    def test_condition_and_relation_properties(self):
+        cond = UnaryBooleanRelation("c", z)
+        rel = UnaryFunctionRelation("u", z, lambda v: v)
+        r = ConditionalRelation(cond, rel)
+        assert r.condition is cond
+        assert r.relation is rel
+
+
+# --- constraint_from_str / base class -------------------------------- #
+
+class TestFromStr:
+    def test_dims_are_free_names(self):
+        r = constraint_from_str("c", "x1 + x2", [
+            Variable("x1", d3), Variable("x2", d3), Variable("x3", d3)])
+        assert set(r.scope_names) == {"x1", "x2"}
+
+    def test_unknown_variable_raises(self):
+        with pytest.raises(ValueError, match="Unknown variable"):
+            constraint_from_str("c", "x1 + nope", [Variable("x1", d3)])
+
+    def test_builtins_allowed(self):
+        r = constraint_from_str("c", "abs(x1 - 2)", [Variable("x1", d3)])
+        assert r(0) == 2
+
+    def test_constant_expression_zero_arity(self):
+        r = constraint_from_str("c", "42", [Variable("x1", d3)])
+        assert r.arity == 0
+        assert r() == 42
+
+    def test_relation_protocol_alias(self):
+        assert RelationProtocol is Constraint
+
+    def test_materialization_cap(self):
+        big = Domain("big", "", list(range(300)))
+        vs = [Variable(f"v{i}", big) for i in range(4)]
+        r = NAryFunctionRelation(lambda **kw: 0, vs, "huge",
+                                 f_kwargs=True)
+        assert int(np.prod(r.shape)) > MAX_MATERIALIZED_ELEMENTS
+        with pytest.raises(MemoryError, match="Refusing"):
+            r.to_array()
+
+    def test_base_slice_freezes_values(self):
+        r = constraint_from_str("c", "x1 * 10 + x2", [
+            Variable("x1", d3), Variable("x2", d3)])
+        s = r.slice({"x1": 1})
+        assert s(2) == 12
+
+
+# --- free functions -------------------------------------------------- #
+
+class TestAssignments:
+    def test_assignment_matrix_default(self):
+        m = assignment_matrix([x, z], 5)
+        assert m.shape == (2, 3)
+        assert (m == 5).all()
+
+    def test_generate_assignment_order(self):
+        combos = list(generate_assignment([x, z]))
+        # last variable iterates fastest
+        assert combos[0] == ["a", 0]
+        assert combos[1] == ["a", 1]
+        assert combos[3] == ["b", 0]
+        assert len(combos) == 6
+
+    def test_generate_assignment_as_dict(self):
+        first = next(generate_assignment_as_dict([x, y]))
+        assert first == {"x": "a", "y": "a"}
+
+    def test_count_var_match(self):
+        r = NAryMatrixRelation([x, y], name="m")
+        assert count_var_match(["x", "z", "y"], r) == 2
+        assert count_var_match(["nope"], r) == 0
+
+    def test_assignment_cost_sums(self):
+        r1 = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "a")
+        r2 = UnaryFunctionRelation("b", z, lambda v: v)
+        cost = assignment_cost({"x": "b", "y": "a", "z": 2}, [r1, r2])
+        assert cost == 5
+
+    def test_assignment_cost_hard_violation_raises(self):
+        r = UnaryFunctionRelation("h", z, lambda v: float("inf"))
+        with pytest.raises(ValueError, match="Hard constraint"):
+            assignment_cost({"z": 0}, [r])
+
+
+class TestOptima:
+    def test_find_optimum_min_max(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m")
+        assert find_optimum(r, "min") == 1.0
+        assert find_optimum(r, "max") == 4.0
+
+    def test_find_arg_optimal_single(self):
+        r = UnaryFunctionRelation("u", z, lambda v: (v - 1) ** 2)
+        vals, cost = find_arg_optimal(z, r, "min")
+        assert vals == [1]
+        assert cost == 0.0
+
+    def test_find_arg_optimal_ties_in_domain_order(self):
+        r = UnaryFunctionRelation("u", z, lambda v: 0 if v != 1 else 9)
+        vals, cost = find_arg_optimal(z, r, "min")
+        assert vals == [0, 2]   # domain order preserved
+        assert cost == 0.0
+
+    def test_find_arg_optimal_max(self):
+        r = UnaryFunctionRelation("u", z, lambda v: v)
+        vals, cost = find_arg_optimal(z, r, "max")
+        assert vals == [2] and cost == 2.0
+
+    def test_find_arg_optimal_rejects_binary(self):
+        r = NAryMatrixRelation([x, y], name="m")
+        with pytest.raises(ValueError, match="unary"):
+            find_arg_optimal(x, r, "min")
+
+    def test_find_optimal_with_context(self):
+        r = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 0]]), "m")
+        vals, cost = find_optimal(y, {"x": "b"}, [r], "min")
+        assert vals == ["b"] and cost == 0
+
+    def test_find_optimal_ties(self):
+        r = NAryMatrixRelation([x, y], np.array([[5, 5], [1, 2]]), "m")
+        vals, cost = find_optimal(y, {"x": "a"}, [r], "min")
+        assert vals == ["a", "b"] and cost == 5
+
+    def test_optimal_cost_value(self):
+        v = VariableWithCostDict(
+            "v", d3, {0: 3.0, 1: 0.5, 2: 2.0})
+        assert optimal_cost_value(v, "min") == (1, 0.5)
+        assert optimal_cost_value(v, "max") == (0, 3.0)
+
+
+class TestJoinProjection:
+    def test_join_disjoint_dims(self):
+        r1 = UnaryFunctionRelation("a", x, lambda v: 1 if v == "a" else 2)
+        r2 = UnaryFunctionRelation("b", z, lambda v: v)
+        j = join(r1, r2)
+        assert j.scope_names == ["x", "z"]
+        assert j(x="b", z=2) == 4.0
+
+    def test_join_shared_dim(self):
+        m1 = NAryMatrixRelation([x, y], np.array([[1, 2], [3, 4]]), "m1")
+        m2 = NAryMatrixRelation([y], np.array([10, 20]), "m2")
+        j = join(m1, m2)
+        assert j.scope_names == ["x", "y"]
+        assert j(x="a", y="b") == 22.0
+
+    def test_join_identical_scope(self):
+        m1 = NAryMatrixRelation([x, y], np.ones((2, 2)), "m1")
+        m2 = NAryMatrixRelation([x, y], 2 * np.ones((2, 2)), "m2")
+        j = join(m1, m2)
+        assert (j.matrix == 3).all()
+
+    def test_join_respects_axis_order(self):
+        # m2's dims are reversed relative to m1: values must still line
+        # up per-assignment, not per-axis-position.
+        a = np.array([[1, 2], [3, 4]])
+        m1 = NAryMatrixRelation([x, y], a, "m1")
+        m2 = NAryMatrixRelation([y, x], a.T, "m2")
+        j = join(m1, m2)
+        for asst in generate_assignment_as_dict([x, y]):
+            assert j(**asst) == 2 * m1(**asst)
+
+    def test_join_with_zero_ary(self):
+        m = NAryMatrixRelation([x], np.array([1, 2]), "m")
+        k = ZeroAryRelation("k", 10)
+        j = join(m, k)
+        np.testing.assert_array_equal(j.matrix, [11, 12])
+
+    def test_projection_min_eliminates_axis(self):
+        m = NAryMatrixRelation([x, y], np.array([[1, 5], [4, 2]]), "m")
+        p = projection(m, y, "min")
+        assert p.scope_names == ["x"]
+        np.testing.assert_array_equal(p.matrix, [1, 2])
+
+    def test_projection_max(self):
+        m = NAryMatrixRelation([x, y], np.array([[1, 5], [4, 2]]), "m")
+        p = projection(m, x, "max")
+        np.testing.assert_array_equal(p.matrix, [4, 5])
+
+    def test_projection_missing_variable_raises(self):
+        m = NAryMatrixRelation([x], np.array([1, 2]), "m")
+        with pytest.raises(ValueError, match="not in dimensions"):
+            projection(m, z)
+
+    def test_projection_to_zero_ary(self):
+        m = NAryMatrixRelation([x], np.array([3, 1]), "m")
+        p = projection(m, x, "min")
+        assert p.arity == 0
+        assert float(p.matrix) == 1.0
+
+    def test_dpop_identity_join_then_project(self):
+        # min_y (m1 + m2) computed via the algebra equals the direct
+        # enumeration — the invariant DPOP's UTIL messages rely on.
+        m1 = NAryMatrixRelation([x, y], np.array([[1, 5], [4, 2]]), "m1")
+        m2 = NAryMatrixRelation([y, z],
+                                np.arange(6).reshape(2, 3), "m2")
+        p = projection(join(m1, m2), y, "min")
+        for asst in generate_assignment_as_dict([x, z]):
+            direct = min(
+                m1(x=asst["x"], y=vy) + m2(y=vy, z=asst["z"])
+                for vy in y.domain
+            )
+            assert p(**asst) == direct
+
+    def test_add_var_to_rel(self):
+        m = NAryMatrixRelation([x], np.array([1, 2]), "m")
+        r = add_var_to_rel("ext", m, z, lambda rel_cost, vz: rel_cost + vz)
+        assert r.scope_names == ["x", "z"]
+        assert r(x="b", z=2) == 4

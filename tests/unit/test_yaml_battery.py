@@ -1,0 +1,398 @@
+"""Deep battery over dcop/yamldcop.py — format parsing, every
+constraint/variable flavor, error paths, and dump→reload round-trips
+(reference test_dcop_serialization.py depth)."""
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.dcop.yamldcop import (
+    DcopInvalidFormatError,
+    dcop_yaml,
+    load_agents,
+    load_dcop,
+    load_scenario,
+    yaml_agents,
+    yaml_scenario,
+)
+
+BASE = """
+name: t
+objective: min
+domains:
+  d3:
+    values: [0, 1, 2]
+variables:
+  v1: {domain: d3}
+  v2: {domain: d3}
+"""
+
+
+class TestDomains:
+    def test_range_string(self):
+        d = load_dcop("""
+name: t
+domains:
+  d: {values: "1 .. 4"}
+variables:
+  v: {domain: d}
+""")
+        assert list(d.domain("d")) == [1, 2, 3, 4]
+
+    def test_range_inside_list(self):
+        d = load_dcop("""
+name: t
+domains:
+  d:
+    values: ["1 .. 3", "7"]
+variables:
+  v: {domain: d}
+""")
+        assert list(d.domain("d")) == [1, 2, 3, 7]
+
+    def test_string_ints_coerced(self):
+        d = load_dcop("""
+name: t
+domains:
+  d: {values: ["1", "2"]}
+variables:
+  v: {domain: d}
+""")
+        assert list(d.domain("d")) == [1, 2]
+
+    def test_mixed_strings_stay_strings(self):
+        d = load_dcop("""
+name: t
+domains:
+  d: {values: [R, G, B]}
+variables:
+  v: {domain: d}
+""")
+        assert list(d.domain("d")) == ["R", "G", "B"]
+
+    def test_domain_type_preserved(self):
+        d = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1], type: luminosity}
+variables:
+  v: {domain: d}
+""")
+        assert d.domain("d").type == "luminosity"
+
+
+class TestErrors:
+    def test_missing_name(self):
+        with pytest.raises(DcopInvalidFormatError, match="name"):
+            load_dcop("objective: min")
+
+    def test_empty_document(self):
+        with pytest.raises(DcopInvalidFormatError):
+            load_dcop("")
+
+    def test_unknown_constraint_type(self):
+        with pytest.raises(DcopInvalidFormatError, match="invalid type"):
+            load_dcop(BASE + """
+constraints:
+  c1:
+    type: nope
+""")
+
+    def test_extensional_unknown_variable(self):
+        with pytest.raises(DcopInvalidFormatError, match="Unknown"):
+            load_dcop(BASE + """
+constraints:
+  c1:
+    type: extensional
+    variables: [v1, ghost]
+    values:
+      1: 0 0
+""")
+
+    def test_extensional_bad_row_width(self):
+        with pytest.raises(DcopInvalidFormatError, match="expected 2"):
+            load_dcop(BASE + """
+constraints:
+  c1:
+    type: extensional
+    variables: [v1, v2]
+    values:
+      1: 0 0 0
+""")
+
+    def test_external_variable_requires_initial_value(self):
+        with pytest.raises(DcopInvalidFormatError, match="initial_value"):
+            load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1]}
+external_variables:
+  e: {domain: d}
+""")
+
+    def test_duplicate_route_rejected(self):
+        with pytest.raises(DcopInvalidFormatError, match="more than once"):
+            load_dcop(BASE + """
+agents: [a1, a2]
+routes:
+  a1: {a2: 3}
+  a2: {a1: 4}
+""")
+
+
+class TestConstraints:
+    def test_intention(self):
+        d = load_dcop(BASE + """
+constraints:
+  c1:
+    type: intention
+    function: abs(v1 - v2)
+""")
+        c = d.constraints["c1"]
+        assert set(c.scope_names) == {"v1", "v2"}
+        assert c(v1=0, v2=2) == 2
+
+    def test_intention_partial(self):
+        d = load_dcop(BASE + """
+constraints:
+  c1:
+    type: intention
+    function: v1 * 10 + v2
+    partial: {v1: 2}
+""")
+        c = d.constraints["c1"]
+        assert c.scope_names == ["v2"]
+        assert c(1) == 21
+        assert c.name == "c1"
+
+    def test_extensional_default(self):
+        d = load_dcop(BASE + """
+constraints:
+  c1:
+    type: extensional
+    default: 5
+    variables: [v1, v2]
+    values:
+      0: 1 1
+""")
+        c = d.constraints["c1"]
+        assert c(1, 1) == 0
+        assert c(0, 0) == 5
+
+    def test_extensional_multi_assignments_per_cost(self):
+        d = load_dcop(BASE + """
+constraints:
+  c1:
+    type: extensional
+    variables: [v1, v2]
+    values:
+      7: 0 0 | 1 1 | 2 2
+""")
+        c = d.constraints["c1"]
+        for i in range(3):
+            assert c(i, i) == 7
+        assert c(0, 1) == 0
+
+    def test_extensional_unary(self):
+        d = load_dcop(BASE + """
+constraints:
+  c1:
+    type: extensional
+    variables: v1
+    values:
+      2: 1
+""")
+        c = d.constraints["c1"]
+        assert c.arity == 1
+        assert c(1) == 2 and c(0) == 0
+
+    def test_extensional_quoted_string_values(self):
+        d = load_dcop("""
+name: t
+domains:
+  d: {values: ['hot water', cold]}
+variables:
+  v1: {domain: d}
+constraints:
+  c1:
+    type: extensional
+    variables: v1
+    values:
+      3: "'hot water'"
+""")
+        assert d.constraints["c1"]("hot water") == 3
+
+    def test_hard_constraint_infinity(self):
+        d = load_dcop(BASE + """
+constraints:
+  c1:
+    type: extensional
+    default: .inf
+    variables: [v1, v2]
+    values:
+      0: 0 1
+""")
+        assert d.constraints["c1"](0, 0) == float("inf")
+        assert d.constraints["c1"](0, 1) == 0
+
+
+class TestVariablesAndAgents:
+    def test_variable_with_cost_function(self):
+        d = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1, 2]}
+variables:
+  v1:
+    domain: d
+    cost_function: v1 * 2
+""")
+        assert d.variables["v1"].cost_for_val(2) == 4
+
+    def test_variable_noisy_cost(self):
+        d = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1]}
+variables:
+  v1:
+    domain: d
+    cost_function: v1 * 2
+    noise_level: 0.05
+""")
+        v = d.variables["v1"]
+        assert 0 <= v.cost_for_val(0) < 0.05
+
+    def test_initial_value(self):
+        d = load_dcop("""
+name: t
+domains:
+  d: {values: [0, 1]}
+variables:
+  v1: {domain: d, initial_value: 1}
+""")
+        assert d.variables["v1"].initial_value == 1
+
+    def test_agents_list_form(self):
+        d = load_dcop(BASE + "agents: [a1, a2]\n")
+        assert set(d.agents) == {"a1", "a2"}
+
+    def test_agents_with_capacity(self):
+        d = load_dcop(BASE + """
+agents:
+  a1: {capacity: 7}
+""")
+        assert d.agents["a1"].capacity == 7
+
+    def test_hosting_costs_and_routes(self):
+        d = load_dcop(BASE + """
+agents: [a1, a2]
+routes:
+  default: 5
+  a1: {a2: 2}
+hosting_costs:
+  default: 9
+  a1:
+    default: 3
+    computations: {v1: 1}
+""")
+        a1, a2 = d.agents["a1"], d.agents["a2"]
+        assert a1.route("a2") == 2
+        assert a2.route("a1") == 2   # symmetric
+        assert a1.hosting_cost("v1") == 1
+        assert a1.hosting_cost("other") == 3
+        assert a2.hosting_cost("v1") == 9   # global default
+
+    def test_distribution_hints(self):
+        d = load_dcop(BASE + """
+distribution_hints:
+  must_host:
+    a1: [v1]
+""")
+        assert d.dist_hints.must_host("a1") == ["v1"]
+
+
+class TestRoundTrips:
+    def _roundtrip(self, yaml_str):
+        d1 = load_dcop(yaml_str)
+        d2 = load_dcop(dcop_yaml(d1))
+        return d1, d2
+
+    def test_intention_roundtrip(self):
+        d1, d2 = self._roundtrip(BASE + """
+constraints:
+  c1:
+    type: intention
+    function: abs(v1 - v2)
+""")
+        for a in ((0, 0), (0, 2), (2, 1)):
+            assert d1.constraints["c1"](*a) == d2.constraints["c1"](*a)
+
+    def test_extensional_roundtrip(self):
+        d1, d2 = self._roundtrip(BASE + """
+constraints:
+  c1:
+    type: extensional
+    default: 4
+    variables: [v1, v2]
+    values:
+      1: 0 0 | 2 2
+""")
+        c1, c2 = d1.constraints["c1"], d2.constraints["c1"]
+        for i in range(3):
+            for j in range(3):
+                assert c1(i, j) == c2(i, j)
+
+    def test_objective_and_name_roundtrip(self):
+        d1, d2 = self._roundtrip(
+            BASE.replace("objective: min", "objective: max"))
+        assert d2.name == "t" and d2.objective == "max"
+
+    def test_agents_roundtrip(self):
+        _, d2 = self._roundtrip(BASE + """
+agents:
+  a1: {capacity: 7}
+  a2: {capacity: 8}
+routes:
+  a1: {a2: 2}
+""")
+        assert d2.agents["a1"].capacity == 7
+        assert d2.agents["a1"].route("a2") == 2
+
+    def test_yaml_agents_roundtrip(self):
+        agents = [AgentDef("a1", capacity=5), AgentDef("a2", foo="x")]
+        loaded = load_agents(yaml_agents(agents))
+        assert [a.name for a in loaded] == ["a1", "a2"]
+        assert loaded[0].capacity == 5
+
+    def test_scenario_roundtrip(self):
+        s = load_scenario("""
+events:
+  - id: e1
+    delay: 2.5
+  - id: e2
+    actions:
+      - type: remove_agent
+        agent: a1
+""")
+        s2 = load_scenario(yaml_scenario(s))
+        assert len(s2.events) == 2
+        assert s2.events[0].is_delay and s2.events[0].delay == 2.5
+        assert s2.events[1].actions[0].type == "remove_agent"
+        assert s2.events[1].actions[0].args["agent"] == "a1"
+
+    def test_device_solve_after_roundtrip(self):
+        # The dumped file must stay solvable with identical cost.
+        from pydcop_tpu.api import solve
+
+        yaml_str = BASE + """
+constraints:
+  c1:
+    type: intention
+    function: 1 if v1 == v2 else 0
+"""
+        d1, d2 = self._roundtrip(yaml_str)
+        r1 = solve(d1, "dpop")
+        r2 = solve(d2, "dpop")
+        assert r1["cost"] == r2["cost"] == 0
