@@ -69,6 +69,10 @@ def _parse_domain_values(raw_values) -> List:
         # Single scalar string: fall through to the shared coercion so
         # values: "7" and values: ["7"] produce the same int domain.
         raw_values = [raw_values]
+    elif not isinstance(raw_values, (list, tuple)):
+        # Unquoted scalar (values: 7 — yaml already parsed the type):
+        # a one-value domain, same as the quoted form.
+        raw_values = [raw_values]
     values: List = []
     for v in raw_values:
         if isinstance(v, str):

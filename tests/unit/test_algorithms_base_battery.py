@@ -1,0 +1,157 @@
+"""Battery over algorithms/__init__.py — parameter validation,
+AlgorithmDef/ComputationDef, discovery and default injection
+(reference test_algorithms_base.py depth)."""
+
+import pytest
+
+from pydcop_tpu.algorithms import (
+    AlgoParameterDef,
+    AlgoParameterException,
+    AlgorithmDef,
+    check_param_value,
+    list_available_algorithms,
+    load_algorithm_module,
+    prepare_algo_params,
+)
+
+
+class TestCheckParamValue:
+    def test_none_gives_default(self):
+        p = AlgoParameterDef("d", "float", None, 0.5)
+        assert check_param_value(None, p) == 0.5
+
+    def test_int_coercion_from_string(self):
+        p = AlgoParameterDef("n", "int", None, 0)
+        assert check_param_value("42", p) == 42
+
+    def test_float_coercion(self):
+        p = AlgoParameterDef("f", "float", None, 0.0)
+        assert check_param_value("0.25", p) == 0.25
+        assert check_param_value(1, p) == 1.0
+
+    def test_bool_string_forms(self):
+        p = AlgoParameterDef("b", "bool", None, False)
+        assert check_param_value("true", p) is True
+        assert check_param_value("YES", p) is True
+        assert check_param_value("1", p) is True
+        assert check_param_value("false", p) is False
+        assert check_param_value("0", p) is False
+
+    def test_bool_non_string(self):
+        p = AlgoParameterDef("b", "bool", None, False)
+        assert check_param_value(1, p) is True
+        assert check_param_value(0, p) is False
+
+    def test_str_coercion(self):
+        p = AlgoParameterDef("s", "str", None, "")
+        assert check_param_value(3, p) == "3"
+
+    def test_invalid_int_raises(self):
+        p = AlgoParameterDef("n", "int", None, 0)
+        with pytest.raises(AlgoParameterException, match="Invalid"):
+            check_param_value("not-a-number", p)
+
+    def test_allowed_values_enforced(self):
+        p = AlgoParameterDef("v", "str", ["A", "B"], "A")
+        assert check_param_value("B", p) == "B"
+        with pytest.raises(AlgoParameterException, match="allowed"):
+            check_param_value("C", p)
+
+    def test_allowed_values_checked_after_coercion(self):
+        p = AlgoParameterDef("n", "int", [1, 2], 1)
+        assert check_param_value("2", p) == 2
+        with pytest.raises(AlgoParameterException):
+            check_param_value("3", p)
+
+
+class TestPrepareAlgoParams:
+    DEFS = [
+        AlgoParameterDef("damping", "float", None, 0.5),
+        AlgoParameterDef("variant", "str", ["A", "B"], "B"),
+    ]
+
+    def test_defaults_filled(self):
+        out = prepare_algo_params({}, self.DEFS)
+        assert out == {"damping": 0.5, "variant": "B"}
+
+    def test_given_values_validated(self):
+        out = prepare_algo_params({"damping": "0.8"}, self.DEFS)
+        assert out["damping"] == 0.8
+
+    def test_unknown_param_rejected(self):
+        with pytest.raises(AlgoParameterException, match="Unknown"):
+            prepare_algo_params({"nope": 1}, self.DEFS)
+
+    def test_error_lists_supported_names(self):
+        with pytest.raises(AlgoParameterException,
+                           match="damping.*variant"):
+            prepare_algo_params({"zz": 1}, self.DEFS)
+
+
+class TestAlgorithmDef:
+    def test_build_with_defaults_from_module(self):
+        ad = AlgorithmDef.build_with_default_param("maxsum", mode="min")
+        assert ad.algo == "maxsum"
+        assert ad.params["damping"] == 0.5
+        assert ad.mode == "min"
+
+    def test_build_validates_params(self):
+        with pytest.raises(AlgoParameterException):
+            AlgorithmDef.build_with_default_param(
+                "dsa", {"variant": "Z"})
+
+    def test_param_value(self):
+        ad = AlgorithmDef.build_with_default_param("dsa")
+        assert ad.param_value("variant") == "B"
+        with pytest.raises(KeyError):
+            ad.param_value("nope")
+
+    def test_params_copy_not_alias(self):
+        ad = AlgorithmDef("a", {"k": 1})
+        ad.params["k"] = 99
+        assert ad.param_value("k") == 1
+
+    def test_equality(self):
+        a = AlgorithmDef("x", {"k": 1}, "min")
+        assert a == AlgorithmDef("x", {"k": 1}, "min")
+        assert a != AlgorithmDef("x", {"k": 2}, "min")
+        assert a != AlgorithmDef("x", {"k": 1}, "max")
+
+    def test_wire_roundtrip(self):
+        from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+        ad = AlgorithmDef("dsa", {"variant": "A"}, "max")
+        ad2 = from_repr(simple_repr(ad))
+        assert ad2 == ad
+
+
+class TestDiscoveryAndDefaults:
+    def test_all_14_algorithms_listed(self):
+        algos = list_available_algorithms()
+        expected = {
+            "maxsum", "amaxsum", "maxsum_dynamic", "dpop", "dsa",
+            "adsa", "dsatuto", "mgm", "mgm2", "dba", "gdba", "syncbb",
+            "ncbb", "mixeddsa",
+        }
+        assert expected <= set(algos)
+
+    def test_unknown_algorithm_raises(self):
+        with pytest.raises(Exception):
+            load_algorithm_module("definitely_not_an_algo")
+
+    def test_every_module_has_contract_surface(self):
+        # The plugin contract: GRAPH_TYPE, algo_params,
+        # computation_memory, communication_load (defaults injected at
+        # load, reference algorithms/__init__.py:528-566).
+        for name in list_available_algorithms():
+            mod = load_algorithm_module(name)
+            assert isinstance(mod.GRAPH_TYPE, str), name
+            assert isinstance(mod.algo_params, list), name
+            assert callable(mod.computation_memory), name
+            assert callable(mod.communication_load), name
+            assert callable(mod.build_computation), name
+
+    def test_module_cached(self):
+        m1 = load_algorithm_module("dsa")
+        m2 = load_algorithm_module("dsa")
+        assert m1 is m2

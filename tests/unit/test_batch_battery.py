@@ -1,0 +1,181 @@
+"""Battery over commands/batch.py's pure job-expansion machinery
+(reference test_batch.py depth): sets, iterations, file globs, option
+sweeps, variable expansion, and progress-file resume."""
+
+import os
+
+from pydcop_tpu.commands.batch import (
+    _expand,
+    _expand_option_combinations,
+    _load_progress,
+    _register_job,
+    iter_jobs,
+)
+
+
+class TestExpand:
+    def test_simple_substitution(self):
+        assert _expand("run_{set}_{iteration}",
+                       {"set": "s1", "iteration": 3}) == "run_s1_3"
+
+    def test_unknown_key_left_verbatim(self):
+        assert _expand("{nope}", {}) == "{nope}"
+
+    def test_dict_entry_expansion(self):
+        assert _expand("{opts[k]}", {"opts": {"k": "v"}}) == "v"
+
+
+class TestOptionCombinations:
+    def test_scalars_single_combo(self):
+        combos = _expand_option_combinations({"a": 1, "b": "x"})
+        assert combos == [[("a", 1), ("b", "x")]]
+
+    def test_list_sweeps(self):
+        combos = _expand_option_combinations({"algo": ["dsa", "mgm"]})
+        assert [dict(c)["algo"] for c in combos] == ["dsa", "mgm"]
+
+    def test_cartesian_product_of_lists(self):
+        combos = _expand_option_combinations(
+            {"a": [1, 2], "b": ["x", "y"]})
+        assert len(combos) == 4
+        pairs = {(dict(c)["a"], dict(c)["b"]) for c in combos}
+        assert pairs == {(1, "x"), (1, "y"), (2, "x"), (2, "y")}
+
+    def test_dict_value_sweeps_inner_lists(self):
+        combos = _expand_option_combinations(
+            {"algo_params": {"variant": ["A", "B"], "seed": 0}})
+        inner = [dict(c)["algo_params"] for c in combos]
+        assert {d["variant"] for d in inner} == {"A", "B"}
+        assert all(d["seed"] == 0 for d in inner)
+
+    def test_empty_options(self):
+        assert _expand_option_combinations({}) == [[]]
+
+
+class TestIterJobs:
+    def test_iterations_multiply_jobs(self):
+        jobs = list(iter_jobs({
+            "sets": {"s": {"iterations": 3}},
+            "batches": {"b": {"command": "solve"}},
+        }))
+        assert len(jobs) == 3
+        # job ids distinguish iterations
+        assert len({j[2] for j in jobs}) == 3
+
+    def test_file_glob_expands(self, tmp_path):
+        for n in ("p1.yaml", "p2.yaml"):
+            (tmp_path / n).write_text("x")
+        jobs = list(iter_jobs({
+            "sets": {"s": {"path": str(tmp_path / "*.yaml")}},
+            "batches": {"b": {"command": "solve"}},
+        }))
+        assert len(jobs) == 2
+        files = [j[0][-1] for j in jobs]
+        assert files == sorted(files)
+
+    def test_directory_path_means_star(self, tmp_path):
+        (tmp_path / "p1.yaml").write_text("x")
+        jobs = list(iter_jobs({
+            "sets": {"s": {"path": str(tmp_path)}},
+            "batches": {"b": {"command": "solve"}},
+        }))
+        assert len(jobs) == 1
+
+    def test_file_context_variables(self, tmp_path):
+        (tmp_path / "prob.yaml").write_text("x")
+        jobs = list(iter_jobs({
+            "sets": {"s": {"path": str(tmp_path / "*.yaml")}},
+            "batches": {"b": {
+                "command": "solve",
+                "command_options": {"output": "{file_name}_out.json"},
+            }},
+        }))
+        args = jobs[0][0]
+        assert "prob_out.json" in args
+
+    def test_env_variables_available(self):
+        jobs = list(iter_jobs({
+            "sets": {"s": {"iterations": 1, "env": {"tag": "v9"}}},
+            "batches": {"b": {
+                "command": "solve",
+                "command_options": {"output": "{tag}.json"},
+            }},
+        }))
+        assert "v9.json" in jobs[0][0]
+
+    def test_global_options_precede_command(self):
+        jobs = list(iter_jobs({
+            "global_options": {"timeout": 10},
+            "sets": {"s": {"iterations": 1}},
+            "batches": {"b": {"command": "solve"}},
+        }))
+        args = jobs[0][0]
+        assert args.index("--timeout") < args.index("solve")
+
+    def test_batch_globals_override(self):
+        jobs = list(iter_jobs({
+            "global_options": {"timeout": 10},
+            "sets": {"s": {"iterations": 1}},
+            "batches": {"b": {
+                "command": "solve",
+                "global_options": {"timeout": 99},
+            }},
+        }))
+        args = jobs[0][0]
+        assert args[args.index("--timeout") + 1] == "99"
+
+    def test_dict_options_become_name_colon_value(self):
+        jobs = list(iter_jobs({
+            "sets": {"s": {"iterations": 1}},
+            "batches": {"b": {
+                "command": "solve",
+                "command_options": {
+                    "algo_params": {"variant": "A"},
+                },
+            }},
+        }))
+        args = jobs[0][0]
+        i = args.index("--algo_params")
+        assert args[i + 1] == "variant:A"
+
+    def test_multiple_batches_per_set(self):
+        jobs = list(iter_jobs({
+            "sets": {"s": {"iterations": 2}},
+            "batches": {
+                "b1": {"command": "solve"},
+                "b2": {"command": "graph"},
+            },
+        }))
+        assert len(jobs) == 4
+
+    def test_default_set_when_missing(self):
+        jobs = list(iter_jobs({
+            "batches": {"b": {"command": "solve"}},
+        }))
+        assert len(jobs) == 1
+
+    def test_current_dir_expanded(self, tmp_path):
+        jobs = list(iter_jobs({
+            "sets": {"s": {"iterations": 1, "env": {"d": str(tmp_path)}}},
+            "batches": {"b": {
+                "command": "solve",
+                "current_dir": "{d}",
+            }},
+        }))
+        assert jobs[0][1] == str(tmp_path)
+
+
+class TestProgress:
+    def test_missing_file_empty(self, tmp_path):
+        assert _load_progress(str(tmp_path / "nope")) == set()
+
+    def test_register_and_reload(self, tmp_path):
+        pf = str(tmp_path / "progress")
+        _register_job(pf, "job one")
+        _register_job(pf, "job two")
+        assert _load_progress(pf) == {"job one", "job two"}
+
+    def test_blank_lines_ignored(self, tmp_path):
+        pf = tmp_path / "progress"
+        pf.write_text("a\n\n  \nb\n")
+        assert _load_progress(str(pf)) == {"a", "b"}

@@ -1,0 +1,138 @@
+"""Battery over utils/graphs.py — adjacency, components, diameters,
+cycle counts, networkx bridges (reference test_graphs.py depth)."""
+
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation, NeutralRelation
+from pydcop_tpu.utils.graphs import (
+    all_pairs,
+    as_networkx_bipartite_graph,
+    as_networkx_graph,
+    calc_diameter,
+    components,
+    constraint_adjacency,
+    cycles_count,
+    graph_diameter,
+)
+
+d2 = Domain("d", "", [0, 1])
+
+
+def vs(*names):
+    return [Variable(n, d2) for n in names]
+
+
+def binary(a, b, name="c"):
+    return NAryMatrixRelation([a, b], name=name)
+
+
+class TestAdjacency:
+    def test_binary_constraints(self):
+        a, b, c = vs("a", "b", "c")
+        adj = constraint_adjacency([a, b, c], [binary(a, b)])
+        assert adj["a"] == {"b"}
+        assert adj["b"] == {"a"}
+        assert adj["c"] == set()
+
+    def test_ternary_constraint_forms_clique(self):
+        a, b, c = vs("a", "b", "c")
+        r = NeutralRelation([a, b, c], "t")
+        adj = constraint_adjacency([a, b, c], [r])
+        assert adj["a"] == {"b", "c"}
+        assert adj["b"] == {"a", "c"}
+        assert adj["c"] == {"a", "b"}
+
+    def test_isolated_variables_present(self):
+        a, b = vs("a", "b")
+        adj = constraint_adjacency([a, b], [])
+        assert adj == {"a": set(), "b": set()}
+
+
+class TestComponents:
+    def test_single_component(self):
+        adj = {"a": {"b"}, "b": {"a", "c"}, "c": {"b"}}
+        comps = components(adj)
+        assert comps == [{"a", "b", "c"}]
+
+    def test_two_components(self):
+        adj = {"a": {"b"}, "b": {"a"}, "x": {"y"}, "y": {"x"}}
+        comps = components(adj)
+        assert {frozenset(c) for c in comps} == {
+            frozenset({"a", "b"}), frozenset({"x", "y"})}
+
+    def test_isolated_nodes_are_components(self):
+        comps = components({"a": set(), "b": set()})
+        assert len(comps) == 2
+
+
+class TestDiameter:
+    CHAIN = {"a": {"b"}, "b": {"a", "c"}, "c": {"b", "d"}, "d": {"c"}}
+
+    def test_exact_chain(self):
+        assert calc_diameter(self.CHAIN, exact=True) == 3
+
+    def test_double_sweep_exact_on_trees(self):
+        assert calc_diameter(self.CHAIN, exact=False) == 3
+
+    def test_single_node(self):
+        assert calc_diameter({"a": set()}) == 0
+
+    def test_empty(self):
+        assert calc_diameter({}) == 0
+
+    def test_cycle_diameter(self):
+        ring = {
+            "a": {"b", "d"}, "b": {"a", "c"},
+            "c": {"b", "d"}, "d": {"c", "a"},
+        }
+        assert calc_diameter(ring, exact=True) == 2
+
+    def test_graph_diameter_per_component(self):
+        a, b, c, x = vs("a", "b", "c", "x")
+        cons = [binary(a, b, "c1"), binary(b, c, "c2")]
+        diameters = graph_diameter([a, b, c, x], cons)
+        assert sorted(diameters) == [0, 2]
+
+
+class TestCycles:
+    def test_tree_has_no_cycles(self):
+        a, b, c = vs("a", "b", "c")
+        cons = [binary(a, b, "c1"), binary(b, c, "c2")]
+        assert cycles_count([a, b, c], cons) == 0
+
+    def test_triangle_has_one(self):
+        a, b, c = vs("a", "b", "c")
+        cons = [binary(a, b, "c1"), binary(b, c, "c2"),
+                binary(a, c, "c3")]
+        assert cycles_count([a, b, c], cons) == 1
+
+    def test_two_triangles(self):
+        a, b, c, d = vs("a", "b", "c", "d")
+        cons = [binary(a, b, "c1"), binary(b, c, "c2"),
+                binary(a, c, "c3"), binary(b, d, "c4"),
+                binary(c, d, "c5")]
+        assert cycles_count([a, b, c, d], cons) == 2
+
+    def test_disconnected_components_independent(self):
+        a, b, x, y = vs("a", "b", "x", "y")
+        cons = [binary(a, b, "c1"), binary(x, y, "c2")]
+        assert cycles_count([a, b, x, y], cons) == 0
+
+
+class TestHelpers:
+    def test_all_pairs(self):
+        assert list(all_pairs([1, 2, 3])) == [(1, 2), (1, 3), (2, 3)]
+        assert list(all_pairs([1])) == []
+
+    def test_networkx_graph(self):
+        a, b, c = vs("a", "b", "c")
+        g = as_networkx_graph([a, b, c], [binary(a, b)])
+        assert set(g.nodes) == {"a", "b", "c"}
+        assert g.has_edge("a", "b") and not g.has_edge("a", "c")
+
+    def test_networkx_bipartite(self):
+        a, b = vs("a", "b")
+        r = binary(a, b, "c1")
+        g = as_networkx_bipartite_graph([a, b], [r])
+        assert set(g.nodes) == {"a", "b", "c1"}
+        assert g.has_edge("a", "c1") and g.has_edge("b", "c1")
+        assert not g.has_edge("a", "b")
