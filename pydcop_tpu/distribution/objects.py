@@ -50,6 +50,21 @@ class Distribution(SimpleRepr):
         return list(self._mapping.get(agent, []))
 
     def host_on_agent(self, agent: str, computations: List[str]):
+        """Add computations to an agent's hosting list.
+
+        Hosting an already-hosted computation raises (reference
+        objects.py:156-175) — a silent duplicate would corrupt
+        ``agent_for``; move a computation by rebuilding the mapping.
+        """
+        hosted = set(self.computations)
+        for c in computations:
+            if c in hosted:
+                raise ValueError(
+                    f"Computation {c} is already hosted"
+                    + (f" on agent {self.agent_for(c)}"
+                       if self.is_hosted(c) else " (duplicate in call)")
+                )
+            hosted.add(c)
         self._mapping.setdefault(agent, []).extend(computations)
 
     def is_hosted(self, computations) -> bool:
