@@ -8,16 +8,13 @@ from pydcop_tpu.computations_graph import constraints_hypergraph as chg
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
 from pydcop_tpu.dcop.relations import NAryMatrixRelation
-import importlib
-
-
-def load_distribution_module(name):
-    return importlib.import_module(f"pydcop_tpu.distribution.{name}")
 from pydcop_tpu.distribution.objects import (
     Distribution,
     DistributionHints,
     ImpossibleDistributionException,
 )
+
+from tests.unit.test_distribution import _import as load_distribution_module
 
 d2 = Domain("d", "", [0, 1])
 

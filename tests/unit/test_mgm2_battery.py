@@ -1,0 +1,258 @@
+"""Protocol battery for the agent-mode MGM2 computation
+(infrastructure/agent_breakout.Mgm2Computation) — the 5-phase
+offer/response/gain/go machine, driven message by message with a
+mocked sender (reference test_algorithms_mgm2.py depth).
+"""
+
+import random
+from unittest.mock import MagicMock
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef, ComputationDef
+from pydcop_tpu.computations_graph import constraints_hypergraph as chg
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.infrastructure.agent_breakout import (
+    Mgm2Computation,
+    Mgm2GainMessage,
+    Mgm2GoMessage,
+    Mgm2OfferMessage,
+    Mgm2ResponseMessage,
+    Mgm2ValueMessage,
+)
+
+d2 = Domain("d", "", [0, 1])
+
+
+def build_comp(name, variables, constraints, **params):
+    graph = chg.build_computation_graph(
+        variables=variables, constraints=constraints)
+    algo = AlgorithmDef.build_with_default_param(
+        "mgm2", params, "min")
+    defs = {n.name: ComputationDef(n, algo) for n in graph.nodes}
+    comp = Mgm2Computation(defs[name])
+    comp._msg_sender = MagicMock()
+    return comp
+
+
+def two_var(matrix, **params):
+    """v1 -- v2 with the given 2x2 cost matrix; returns v1's comp."""
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    c = NAryMatrixRelation([v1, v2], np.array(matrix), "c1")
+    return build_comp("v1", [v1, v2], [c], **params)
+
+
+def sent(comp, msg_type=None):
+    """(target, message) pairs sent so far, optionally filtered."""
+    out = []
+    for call in comp._msg_sender.call_args_list:
+        target, msg = call[0][1], call[0][2]
+        if msg_type is None or msg.type == msg_type:
+            out.append((target, msg))
+    return out
+
+
+def start_at(comp, value):
+    """Start the computation and pin its current value."""
+    random.seed(0)
+    comp.start()
+    comp.value_selection(value, None)
+    comp._msg_sender.reset_mock()
+
+
+class TestStartAndRoles:
+    def test_start_broadcasts_value(self):
+        comp = two_var([[0, 1], [1, 0]])
+        random.seed(0)
+        comp.start()
+        msgs = sent(comp, "mgm2_value")
+        assert [t for t, _ in msgs] == ["v2"]
+
+    def test_no_neighbor_variable_finishes_immediately(self):
+        v1 = Variable("v1", d2)
+        v9 = Variable("v9", d2)
+        c = NAryMatrixRelation([v9], np.array([0, 1]), "u9")
+        comp = build_comp("v1", [v1, v9], [c])
+        comp.start()
+        assert not comp.is_running
+
+    def test_threshold_one_always_offerer(self):
+        comp = two_var([[0, 1], [1, 0]], threshold=1.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        assert comp._is_offerer
+        assert comp._partner == "v2"
+
+    def test_threshold_zero_never_offerer(self):
+        comp = two_var([[0, 1], [1, 0]], threshold=0.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        assert not comp._is_offerer
+
+
+class TestOffers:
+    def test_offerer_enumerates_joint_moves_with_gains(self):
+        # cost(v1,v2): current (0,0)=4; best joint (1,1)=0
+        comp = two_var([[4, 9], [9, 0]], threshold=1.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        offers = dict(sent(comp, "mgm2_offer"))["v2"].offers
+        assert len(offers) == 4    # 2x2 joint assignments
+        gains = {(mv, pv): g for mv, pv, g in offers}
+        assert gains[(1, 1)] == 4  # 4 -> 0
+        assert gains[(0, 0)] == 0
+        assert gains[(1, 0)] == -5
+
+    def test_non_offerer_sends_empty_offers(self):
+        comp = two_var([[0, 1], [1, 0]], threshold=0.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        offers = dict(sent(comp, "mgm2_offer"))["v2"].offers
+        assert offers == []
+
+    def test_non_offerer_accepts_best_positive_offer(self):
+        comp = two_var([[4, 9], [9, 0]], threshold=0.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        # v2 offers (their_v, my_v, offerer_gain); my side adds gain
+        # over my non-shared constraints (none here).
+        comp.on_message(
+            "v2", Mgm2OfferMessage([(1, 1, 4.0), (0, 1, -5.0)]), 0)
+        resp = dict(sent(comp, "mgm2_response"))["v2"]
+        assert resp.accept is True
+        assert resp.my_value == 1      # what I asked v2... offerer's v
+        assert comp._coordinated
+        assert comp._committed_gain == 4.0
+        assert comp._new_value == 1
+
+    def test_non_offerer_rejects_non_positive_offers(self):
+        comp = two_var([[0, 1], [1, 0]], threshold=0.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        comp.on_message(
+            "v2", Mgm2OfferMessage([(1, 1, 0.0), (1, 0, -1.0)]), 0)
+        resp = dict(sent(comp, "mgm2_response"))["v2"]
+        assert resp.accept is False
+        assert not comp._coordinated
+
+    def test_offerer_rejects_incoming_offers(self):
+        comp = two_var([[4, 9], [9, 0]], threshold=1.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        comp._msg_sender.reset_mock()
+        comp.on_message("v2", Mgm2OfferMessage([(1, 1, 9.0)]), 0)
+        resp = dict(sent(comp, "mgm2_response"))["v2"]
+        assert resp.accept is False
+
+
+class TestGainAndGo:
+    def _coordinated_comp(self):
+        comp = two_var([[4, 9], [9, 0]], threshold=0.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        comp.on_message("v2", Mgm2OfferMessage([(1, 1, 4.0)]), 0)
+        assert comp._coordinated
+        comp._msg_sender.reset_mock()
+        return comp
+
+    def test_coordinated_pair_gain_excluded_from_contest(self):
+        comp = self._coordinated_comp()
+        # The partner's own gain broadcast must not veto the pair.
+        comp.on_message("v2", Mgm2GainMessage(4.0), 0)
+        gos = sent(comp, "mgm2_go")
+        assert gos and gos[0][1].go is True
+
+    def test_coordinated_move_on_both_go(self):
+        comp = self._coordinated_comp()
+        comp.on_message("v2", Mgm2GainMessage(4.0), 0)
+        comp.on_message("v2", Mgm2GoMessage(True), 0)
+        assert comp.current_value == 1   # moved
+
+    def test_coordinated_no_move_on_partner_no_go(self):
+        comp = self._coordinated_comp()
+        comp.on_message("v2", Mgm2GainMessage(4.0), 0)
+        comp.on_message("v2", Mgm2GoMessage(False), 0)
+        assert comp.current_value == 0   # stayed
+
+    def test_unilateral_strict_winner_moves(self):
+        # 3-var chain: v1-v2, v2-v3; drive v2.
+        v1, v2, v3 = (Variable(n, d2) for n in ("v1", "v2", "v3"))
+        c1 = NAryMatrixRelation([v1, v2], np.array([[3, 0], [0, 3]]),
+                                "c1")
+        c2 = NAryMatrixRelation([v2, v3], np.array([[3, 0], [0, 3]]),
+                                "c2")
+        comp = build_comp("v2", [v1, v2, v3], [c1, c2], threshold=0.0)
+        start_at(comp, 0)
+        comp.on_message("v1", Mgm2ValueMessage(0), 0)
+        comp.on_message("v3", Mgm2ValueMessage(0), 0)
+        # both neighbors sent no real offers
+        comp.on_message("v1", Mgm2OfferMessage([]), 0)
+        comp.on_message("v3", Mgm2OfferMessage([]), 0)
+        # my unilateral gain: cost(0)=6 -> cost(1)=0, gain 6
+        gains = sent(comp, "mgm2_gain")
+        assert {t for t, _ in gains} == {"v1", "v3"}
+        assert gains[0][1].gain == 6.0
+        comp.on_message("v1", Mgm2GainMessage(2.0), 0)
+        comp.on_message("v3", Mgm2GainMessage(5.0), 0)
+        assert comp.current_value == 1   # strict winner moved
+
+    def test_unilateral_loser_stays(self):
+        v1, v2, v3 = (Variable(n, d2) for n in ("v1", "v2", "v3"))
+        c1 = NAryMatrixRelation([v1, v2], np.array([[1, 0], [0, 1]]),
+                                "c1")
+        c2 = NAryMatrixRelation([v2, v3], np.array([[1, 0], [0, 1]]),
+                                "c2")
+        comp = build_comp("v2", [v1, v2, v3], [c1, c2], threshold=0.0)
+        start_at(comp, 0)
+        for n in ("v1", "v3"):
+            comp.on_message(n, Mgm2ValueMessage(0), 0)
+        for n in ("v1", "v3"):
+            comp.on_message(n, Mgm2OfferMessage([]), 0)
+        comp.on_message("v1", Mgm2GainMessage(99.0), 0)
+        comp.on_message("v3", Mgm2GainMessage(0.0), 0)
+        assert comp.current_value == 0   # neighbor won
+
+
+class TestRobustness:
+    def test_stale_response_from_non_partner_ignored(self):
+        comp = two_var([[0, 1], [1, 0]], threshold=1.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        before = comp._coordinated
+        comp.on_message(
+            "v9", Mgm2ResponseMessage(True, 1, 1, 9.0), 0)
+        assert comp._coordinated == before
+
+    def test_early_offer_postponed_until_offer_phase(self):
+        comp = two_var([[4, 9], [9, 0]], threshold=0.0)
+        start_at(comp, 0)
+        # Offer arrives BEFORE the value phase completes.
+        comp.on_message("v2", Mgm2OfferMessage([(1, 1, 4.0)]), 0)
+        assert comp._phase == "value"
+        comp.on_message("v2", Mgm2ValueMessage(0), 0)
+        # Entering the offer phase replays the postponed offer.
+        resp = dict(sent(comp, "mgm2_response"))["v2"]
+        assert resp.accept is True
+
+    def test_new_round_rebroadcasts_value(self):
+        comp = two_var([[0, 1], [1, 0]], threshold=0.0)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(1), 0)
+        comp.on_message("v2", Mgm2OfferMessage([]), 0)
+        comp._msg_sender.reset_mock()
+        comp.on_message("v2", Mgm2GainMessage(0.0), 0)
+        # Round ended: a fresh value broadcast starts the next one.
+        values = sent(comp, "mgm2_value")
+        assert [t for t, _ in values] == ["v2"]
+        assert comp._phase == "value"
+
+    def test_stop_cycle_finishes(self):
+        comp = two_var([[0, 1], [1, 0]], threshold=0.0,
+                       stop_cycle=1)
+        start_at(comp, 0)
+        comp.on_message("v2", Mgm2ValueMessage(1), 0)
+        comp.on_message("v2", Mgm2OfferMessage([]), 0)
+        comp.on_message("v2", Mgm2GainMessage(0.0), 0)
+        assert not comp.is_running
