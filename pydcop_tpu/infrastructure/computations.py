@@ -205,13 +205,22 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
             self.on_pause(True)
         else:
             self.on_pause(False)
-            # Flush buffered traffic in reception order.
-            for sender, msg, t in self._paused_messages_recv:
-                self._dispatch(sender, msg, t)
-            self._paused_messages_recv.clear()
-            for target, msg, prio, on_error in self._paused_messages_post:
+            # Flush buffered traffic in reception order THROUGH
+            # on_message, not _dispatch: synchronous computations wrap
+            # algo messages in "_cycle" envelopes that only their
+            # on_message knows how to unwrap (a raw dispatch would
+            # raise "No handler for message type '_cycle'").
+            buffered, self._paused_messages_recv = (
+                self._paused_messages_recv, [])
+            for sender, msg, t in buffered:
+                self.on_message(sender, msg, t)
+            # Same swap idiom for the post buffer: a handler running
+            # during the recv flush may re-pause, and post_msg would
+            # then append to the very list being iterated.
+            posted, self._paused_messages_post = (
+                self._paused_messages_post, [])
+            for target, msg, prio, on_error in posted:
                 self.post_msg(target, msg, prio, on_error)
-            self._paused_messages_post.clear()
 
     # Hooks:
     def on_start(self):
@@ -225,13 +234,16 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
 
     def on_message(self, sender: str, msg: Message, t: float):
         """Entry point used by the agent to deliver a message."""
+        # Buffer BEFORE emitting: the resume flush re-enters
+        # on_message, and emitting on arrival AND on flush would
+        # double-count paused-period traffic on the event bus.
+        if self._is_paused:
+            self._paused_messages_recv.append((sender, msg, t))
+            return
         if event_bus.enabled:
             event_bus.emit(
                 f"computations.message_rcv.{self.name}", (sender, msg)
             )
-        if self._is_paused:
-            self._paused_messages_recv.append((sender, msg, t))
-            return
         self._dispatch(sender, msg, t)
 
     def _dispatch(self, sender: str, msg: Message, t: float):

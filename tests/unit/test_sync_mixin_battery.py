@@ -1,0 +1,194 @@
+"""Battery over SynchronousComputationMixin's BSP machinery
+(infrastructure/computations.py) beyond the basics test_infrastructure
+covers: filler emission, next-cycle buffering, out-of-band mgt
+dispatch, outbox returns, and cycle bookkeeping (reference
+test_infra_synchronous_computation.py depth)."""
+
+from typing import Dict, List, Optional, Tuple
+from unittest.mock import MagicMock
+
+import pytest
+
+from pydcop_tpu.infrastructure.computations import (
+    ComputationException,
+    Message,
+    MessagePassingComputation,
+    SynchronousComputationMixin,
+    message_type,
+    register,
+)
+
+PingMessage = message_type("ping", ["n"])
+
+
+class SyncProbe(SynchronousComputationMixin, MessagePassingComputation):
+    """Minimal synchronous computation with two neighbors."""
+
+    def __init__(self, name="c1", neighbors=("n1", "n2")):
+        super().__init__(name)
+        self._neighbors = list(neighbors)
+        self.cycles_seen: List[Tuple[int, Dict]] = []
+        self.outbox: Optional[List] = None
+        self._msg_sender = MagicMock()
+
+    @property
+    def neighbors(self):
+        return self._neighbors
+
+    @register("ping")
+    def _on_ping(self, sender, msg, t):
+        pass
+
+    def on_new_cycle(self, messages, cycle_id):
+        self.cycles_seen.append((cycle_id, dict(messages)))
+        out, self.outbox = self.outbox, None
+        return out
+
+
+def cycle_msg(cycle, inner):
+    return Message("_cycle", (cycle, inner))
+
+
+def sent_messages(comp):
+    return [
+        (c[0][1], c[0][2]) for c in comp._msg_sender.call_args_list
+    ]
+
+
+class TestFillers:
+    def test_start_sends_fillers_to_silent_neighbors(self):
+        comp = SyncProbe()
+        comp.start()
+        sent = sent_messages(comp)
+        targets = {t for t, _ in sent}
+        assert targets == {"n1", "n2"}
+        for _, m in sent:
+            assert m.type == "_cycle"
+            cycle, inner = m.content
+            assert cycle == 0 and inner is None
+
+    def test_algo_message_suppresses_filler(self):
+        comp = SyncProbe()
+        comp.on_start = lambda: comp.post_msg("n1", PingMessage(1))
+        comp.start()
+        by_target = {}
+        for t, m in sent_messages(comp):
+            by_target.setdefault(t, []).append(m)
+        assert len(by_target["n1"]) == 1
+        assert by_target["n1"][0].content[1].type == "ping"
+        # n2 still gets exactly one filler
+        assert len(by_target["n2"]) == 1
+        assert by_target["n2"][0].content[1] is None
+
+
+class TestCycleAdvance:
+    def test_cycle_fires_once_all_neighbors_reported(self):
+        comp = SyncProbe()
+        comp.start()
+        comp.on_message("n1", cycle_msg(0, PingMessage(1)), 0)
+        assert comp.cycles_seen == []
+        comp.on_message("n2", cycle_msg(0, PingMessage(2)), 0)
+        assert len(comp.cycles_seen) == 1
+        cycle_id, msgs = comp.cycles_seen[0]
+        assert cycle_id == 0
+        assert msgs["n1"][0].n == 1 and msgs["n2"][0].n == 2
+
+    def test_fillers_excluded_from_cycle_messages(self):
+        comp = SyncProbe()
+        comp.start()
+        comp.on_message("n1", cycle_msg(0, PingMessage(1)), 0)
+        comp.on_message("n2", cycle_msg(0, None), 0)
+        _, msgs = comp.cycles_seen[0]
+        assert "n2" not in msgs
+
+    def test_next_cycle_message_buffered(self):
+        comp = SyncProbe()
+        comp.start()
+        # n1 races ahead: its cycle-1 message arrives first.
+        comp.on_message("n1", cycle_msg(1, PingMessage(10)), 0)
+        assert comp.cycles_seen == []
+        comp.on_message("n1", cycle_msg(0, PingMessage(1)), 0)
+        comp.on_message("n2", cycle_msg(0, None), 0)
+        assert len(comp.cycles_seen) == 1
+        # cycle 1 completes with n2's report alone.
+        comp.on_message("n2", cycle_msg(1, None), 0)
+        assert len(comp.cycles_seen) == 2
+        assert comp.cycles_seen[1][1]["n1"][0].n == 10
+
+    def test_cycle_id_increments(self):
+        comp = SyncProbe()
+        comp.start()
+        for cycle in range(3):
+            comp.on_message("n1", cycle_msg(cycle, None), 0)
+            comp.on_message("n2", cycle_msg(cycle, None), 0)
+        assert [cid for cid, _ in comp.cycles_seen] == [0, 1, 2]
+        assert comp.cycle_id == 3
+
+    def test_neighborless_computation_never_cycles(self):
+        comp = SyncProbe(neighbors=())
+        comp.start()
+        assert comp.cycles_seen == []
+        assert comp.cycle_id == 0
+
+
+class TestProtocolViolations:
+    def test_duplicate_current_cycle_raises(self):
+        comp = SyncProbe()
+        comp.start()
+        comp.on_message("n1", cycle_msg(0, PingMessage(1)), 0)
+        with pytest.raises(ComputationException, match="duplicate"):
+            comp.on_message("n1", cycle_msg(0, PingMessage(2)), 0)
+
+    def test_duplicate_next_cycle_raises(self):
+        comp = SyncProbe()
+        comp.start()
+        comp.on_message("n1", cycle_msg(1, PingMessage(1)), 0)
+        with pytest.raises(ComputationException, match="duplicate"):
+            comp.on_message("n1", cycle_msg(1, PingMessage(2)), 0)
+
+    def test_skew_beyond_one_cycle_raises(self):
+        comp = SyncProbe()
+        comp.start()
+        with pytest.raises(ComputationException, match="skew"):
+            comp.on_message("n1", cycle_msg(2, PingMessage(1)), 0)
+
+
+class TestOutboxAndMgt:
+    def test_on_new_cycle_returned_messages_are_posted(self):
+        comp = SyncProbe()
+        comp.start()
+        comp.outbox = [("n1", PingMessage(7))]
+        comp._msg_sender.reset_mock()
+        comp.on_message("n1", cycle_msg(0, None), 0)
+        comp.on_message("n2", cycle_msg(0, None), 0)
+        by_target = {}
+        for t, m in sent_messages(comp):
+            by_target.setdefault(t, []).append(m)
+        inner = by_target["n1"][0].content[1]
+        assert inner.type == "ping" and inner.n == 7
+        # Returned messages are stamped with the NEW cycle id.
+        assert by_target["n1"][0].content[0] == 1
+
+    def test_non_cycle_message_dispatches_directly(self):
+        comp = SyncProbe()
+        comp.start()
+        hits = []
+        # Per-instance copy: _decorated_handlers is class-level, and
+        # mutating it in place would leak into every other SyncProbe.
+        comp._decorated_handlers = dict(comp._decorated_handlers)
+        comp._decorated_handlers["mgt_probe"] = (
+            lambda self, s, m, t: hits.append(s))
+        comp.on_message("orch", Message("mgt_probe", None), 0)
+        assert hits == ["orch"]
+        # No cycle advanced.
+        assert comp.cycles_seen == []
+
+    def test_pause_buffers_cycle_messages(self):
+        comp = SyncProbe()
+        comp.start()
+        comp.pause()
+        comp.on_message("n1", cycle_msg(0, PingMessage(1)), 0)
+        comp.on_message("n2", cycle_msg(0, None), 0)
+        assert comp.cycles_seen == []
+        comp.pause(False)
+        assert len(comp.cycles_seen) == 1
