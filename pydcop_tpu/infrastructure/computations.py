@@ -209,18 +209,36 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
             # on_message, not _dispatch: synchronous computations wrap
             # algo messages in "_cycle" envelopes that only their
             # on_message knows how to unwrap (a raw dispatch would
-            # raise "No handler for message type '_cycle'").
+            # raise "No handler for message type '_cycle'").  The
+            # buffers are swapped out first (a handler may re-pause,
+            # and appending to a list being iterated would loop) and
+            # the undelivered tail is restored if a handler raises —
+            # otherwise those messages would be silently lost.
             buffered, self._paused_messages_recv = (
                 self._paused_messages_recv, [])
-            for sender, msg, t in buffered:
-                self.on_message(sender, msg, t)
-            # Same swap idiom for the post buffer: a handler running
-            # during the recv flush may re-pause, and post_msg would
-            # then append to the very list being iterated.
+            i = 0
+            try:
+                for i, (sender, msg, t) in enumerate(buffered):
+                    self.on_message(sender, msg, t)
+            except Exception:
+                self._paused_messages_recv = (
+                    buffered[i + 1:] + self._paused_messages_recv)
+                raise
+            # Buffered posts were already wrapped by the subclass's
+            # post_msg before buffering — resend through the BASE
+            # post_msg so the sync mixin cannot wrap a second "_cycle"
+            # envelope around them.
             posted, self._paused_messages_post = (
                 self._paused_messages_post, [])
-            for target, msg, prio, on_error in posted:
-                self.post_msg(target, msg, prio, on_error)
+            i = 0
+            try:
+                for i, (target, msg, prio, on_error) in enumerate(posted):
+                    MessagePassingComputation.post_msg(
+                        self, target, msg, prio, on_error)
+            except Exception:
+                self._paused_messages_post = (
+                    posted[i + 1:] + self._paused_messages_post)
+                raise
 
     # Hooks:
     def on_start(self):
@@ -257,13 +275,16 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
 
     def post_msg(self, target: str, msg: Message, prio: int = MSG_ALGO,
                  on_error=None):
+        # Buffer BEFORE emitting (mirror of on_message): the resume
+        # flush re-sends buffered entries, and emitting on buffering
+        # AND on flush would double-count paused-period sends.
+        if self._is_paused:
+            self._paused_messages_post.append((target, msg, prio, on_error))
+            return
         if event_bus.enabled:
             event_bus.emit(
                 f"computations.message_snd.{self.name}", (target, msg)
             )
-        if self._is_paused:
-            self._paused_messages_post.append((target, msg, prio, on_error))
-            return
         if self._msg_sender is None:
             raise ComputationException(
                 f"Computation {self.name} is not attached to an agent, "

@@ -1,0 +1,131 @@
+"""Battery over utils/simple_repr.py — the wire format's invariants
+(reference test_utils_simplerepr.py depth): scalar/container
+round-trips, init-signature discovery, _repr_mapping, defaulted args,
+JSON compatibility, and the error paths."""
+
+import json
+
+import pytest
+
+from pydcop_tpu.utils.simple_repr import (
+    SimpleRepr,
+    SimpleReprException,
+    from_repr,
+    simple_repr,
+)
+
+
+class Point(SimpleRepr):
+    def __init__(self, x, y=0):
+        self._x = x
+        self._y = y
+
+    def __eq__(self, other):
+        return isinstance(other, Point) and \
+            (self._x, self._y) == (other._x, other._y)
+
+
+class Mapped(SimpleRepr):
+    _repr_mapping = {"value": "stored"}
+
+    def __init__(self, value):
+        self._stored = value
+
+
+class Nested(SimpleRepr):
+    def __init__(self, points):
+        self._points = list(points)
+
+
+class NoAttr(SimpleRepr):
+    def __init__(self, ghost):
+        pass  # never stores ghost
+
+
+class PublicAttr(SimpleRepr):
+    def __init__(self, tag):
+        self.tag = tag
+
+
+class TestScalars:
+    @pytest.mark.parametrize("value", [None, 0, 1, -3.5, True, False,
+                                       "", "text"])
+    def test_scalars_pass_through(self, value):
+        assert simple_repr(value) == value
+        assert from_repr(simple_repr(value)) == value
+
+    def test_list_and_tuple_become_lists(self):
+        assert simple_repr([1, 2]) == [1, 2]
+        assert simple_repr((1, 2)) == [1, 2]
+
+    def test_set_becomes_list(self):
+        assert sorted(simple_repr({3, 1, 2})) == [1, 2, 3]
+
+    def test_dict_values_recursed(self):
+        r = simple_repr({"k": (1, 2)})
+        assert r == {"k": [1, 2]}
+
+    def test_unserializable_raises(self):
+        with pytest.raises(SimpleReprException, match="no simple repr"):
+            simple_repr(object())
+
+
+class TestMixin:
+    def test_roundtrip(self):
+        p = Point(3, 4)
+        r = simple_repr(p)
+        assert r["__qualname__"] == "Point"
+        assert r["x"] == 3 and r["y"] == 4
+        assert from_repr(r) == p
+
+    def test_private_attribute_lookup(self):
+        # init arg x stored as _x: discovered automatically
+        assert simple_repr(Point(1))["x"] == 1
+
+    def test_public_attribute_lookup(self):
+        assert simple_repr(PublicAttr("t"))["tag"] == "t"
+
+    def test_default_used_when_attribute_missing(self):
+        class Defaulted(SimpleRepr):
+            def __init__(self, a, b=7):
+                self._a = a  # b not stored
+
+        assert simple_repr(Defaulted(1))["b"] == 7
+
+    def test_missing_required_attribute_raises(self):
+        with pytest.raises(SimpleReprException, match="ghost"):
+            simple_repr(NoAttr(5))
+
+    def test_repr_mapping(self):
+        assert simple_repr(Mapped("v"))["value"] == "v"
+
+    def test_nested_objects(self):
+        n = Nested([Point(1, 2), Point(3)])
+        n2 = from_repr(simple_repr(n))
+        assert n2._points == [Point(1, 2), Point(3)]
+
+    def test_json_round_trip(self):
+        # The whole point of the wire format: JSON-safe.
+        n = Nested([Point(1, 2)])
+        wire = json.dumps(simple_repr(n))
+        n2 = from_repr(json.loads(wire))
+        assert n2._points == [Point(1, 2)]
+
+
+class TestFromRepr:
+    def test_plain_dict_without_marker_stays_dict(self):
+        assert from_repr({"a": 1, "b": [2]}) == {"a": 1, "b": [2]}
+
+    def test_unknown_module_raises(self):
+        r = {"__module__": "no.such.module", "__qualname__": "X"}
+        with pytest.raises(ModuleNotFoundError):
+            from_repr(r)
+
+    def test_unknown_class_raises(self):
+        r = {"__module__": "builtins", "__qualname__": "NoSuchClass"}
+        with pytest.raises(AttributeError):
+            from_repr(r)
+
+    def test_non_reprable_input_raises(self):
+        with pytest.raises(SimpleReprException, match="Cannot rebuild"):
+            from_repr(object())

@@ -192,3 +192,55 @@ class TestOutboxAndMgt:
         assert comp.cycles_seen == []
         comp.pause(False)
         assert len(comp.cycles_seen) == 1
+
+    def test_paused_posts_not_double_wrapped_on_resume(self):
+        """A message posted while paused is wrapped in its '_cycle'
+        envelope ONCE: the resume flush must resend it through the
+        base post_msg, not re-wrap it through the mixin's."""
+        comp = SyncProbe()
+        comp.start()
+        comp.pause()
+        comp.post_msg("n1", PingMessage(5))
+        comp._msg_sender.reset_mock()
+        comp.pause(False)
+        sent = sent_messages(comp)
+        (target, wire), = [(t, m) for t, m in sent if t == "n1"]
+        assert wire.type == "_cycle"
+        cycle, inner = wire.content
+        assert inner.type == "ping" and inner.n == 5  # single wrap
+
+    def test_recv_flush_exception_keeps_undelivered_tail(self):
+        """If a buffered message raises during the resume flush, the
+        NOT-yet-delivered remainder must stay buffered instead of
+        being silently dropped with the swapped-out local."""
+        comp = SyncProbe()
+        comp.start()
+        comp.on_message("n1", cycle_msg(0, PingMessage(1)), 0)
+        comp.pause()
+        # duplicate from n1 (will raise on flush), then a valid one
+        comp.on_message("n1", cycle_msg(0, PingMessage(2)), 0)
+        comp.on_message("n2", cycle_msg(0, PingMessage(3)), 0)
+        with pytest.raises(ComputationException, match="duplicate"):
+            comp.pause(False)
+        assert len(comp._paused_messages_recv) == 1
+        assert comp._paused_messages_recv[0][0] == "n2"
+
+    def test_paused_send_emitted_once_on_event_bus(self):
+        from pydcop_tpu.infrastructure.events import event_bus
+
+        comp = SyncProbe()
+        comp.start()
+        events = []
+        handle = event_bus.subscribe(
+            "computations.message_snd.*",
+            lambda topic, data: events.append(topic))
+        enabled = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            comp.pause()
+            comp.post_msg("n1", PingMessage(1))
+            comp.pause(False)
+        finally:
+            event_bus.enabled = enabled
+            event_bus.unsubscribe(handle)
+        assert len(events) == 1
