@@ -205,40 +205,56 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
             self.on_pause(True)
         else:
             self.on_pause(False)
-            # Flush buffered traffic in reception order THROUGH
-            # on_message, not _dispatch: synchronous computations wrap
-            # algo messages in "_cycle" envelopes that only their
-            # on_message knows how to unwrap (a raw dispatch would
-            # raise "No handler for message type '_cycle'").  The
-            # buffers are swapped out first (a handler may re-pause,
-            # and appending to a list being iterated would loop) and
-            # the undelivered tail is restored if a handler raises —
-            # otherwise those messages would be silently lost.
-            buffered, self._paused_messages_recv = (
-                self._paused_messages_recv, [])
-            i = 0
-            try:
-                for i, (sender, msg, t) in enumerate(buffered):
-                    self.on_message(sender, msg, t)
-            except Exception:
-                self._paused_messages_recv = (
-                    buffered[i + 1:] + self._paused_messages_recv)
-                raise
+            # Flush buffered receptions THROUGH on_message, not
+            # _dispatch: synchronous computations wrap algo messages
+            # in "_cycle" envelopes that only their on_message knows
+            # how to unwrap (a raw dispatch would raise "No handler
+            # for message type '_cycle'").  A poisoned entry (e.g. a
+            # protocol-violating duplicate) is dropped — redelivering
+            # it would deterministically raise forever.
+            self._flush_paused(
+                "_paused_messages_recv",
+                lambda e: self.on_message(*e),
+                keep_failed=False,
+            )
             # Buffered posts were already wrapped by the subclass's
             # post_msg before buffering — resend through the BASE
             # post_msg so the sync mixin cannot wrap a second "_cycle"
-            # envelope around them.
-            posted, self._paused_messages_post = (
-                self._paused_messages_post, [])
-            i = 0
+            # envelope around them.  Post failures are usually
+            # environmental (e.g. not attached yet), so the failed
+            # entry itself is kept for a later flush.
+            self._flush_paused(
+                "_paused_messages_post",
+                lambda e: MessagePassingComputation.post_msg(self, *e),
+                keep_failed=True,
+            )
+
+    def _flush_paused(self, buffer_attr: str, deliver, keep_failed: bool):
+        """Drain a paused-message buffer in order, delivering EVERY
+        entry even when one raises (remaining messages must not be
+        stranded — with the sync mixin a lost message stalls a
+        neighbor's cycle barrier forever).  Failed entries are kept in
+        the buffer (``keep_failed``) or dropped; the first exception
+        is re-raised after the drain so callers still see the error.
+        The buffer is swapped out first: a handler may re-pause, and
+        appending to a list being iterated would loop."""
+        entries = getattr(self, buffer_attr)
+        setattr(self, buffer_attr, [])
+        first_error = None
+        failed = []
+        for entry in entries:
             try:
-                for i, (target, msg, prio, on_error) in enumerate(posted):
-                    MessagePassingComputation.post_msg(
-                        self, target, msg, prio, on_error)
-            except Exception:
-                self._paused_messages_post = (
-                    posted[i + 1:] + self._paused_messages_post)
-                raise
+                deliver(entry)
+            except Exception as e:  # noqa: BLE001 - rethrown below
+                if keep_failed:
+                    failed.append(entry)
+                if first_error is None:
+                    first_error = e
+        # Prepend: anything buffered DURING the drain (a handler
+        # re-paused) is newer than the failed entries.
+        setattr(self, buffer_attr, failed + getattr(self, buffer_attr))
+        if first_error is not None:
+            raise first_error
 
     # Hooks:
     def on_start(self):

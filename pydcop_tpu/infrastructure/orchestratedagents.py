@@ -89,12 +89,26 @@ class OrchestrationComputation(MessagePassingComputation):
 
     @register("resume_computations")
     def _on_resume(self, sender, msg, t):
+        # Per-computation isolation: one computation's poisoned
+        # buffered message (its resume flush re-raises the first
+        # delivery error) must not leave the agent's OTHER
+        # computations paused forever.
+        first_error = None
         for name in msg.computations or [
             c.name for c in self.agent.computations
             if not c.name.startswith("_")
         ]:
-            if self.agent.has_computation(name):
+            if not self.agent.has_computation(name):
+                continue
+            try:
                 self.agent.computation(name).pause(False)
+            except Exception as e:  # noqa: BLE001 - rethrown below
+                self.agent.logger.exception(
+                    "Error resuming computation %s", name)
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
 
     @register("remove_computations")
     def _on_remove_computations(self, sender, msg, t):

@@ -1,0 +1,121 @@
+"""Extra battery over utils/expressionfunction.py beyond
+test_expressionfunction.py: function-body form, scope modules,
+external sources, partial chains, and wire round-trips."""
+
+import pytest
+
+from pydcop_tpu.utils.expressionfunction import ExpressionFunction
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+class TestExpressionForm:
+    def test_positional_args_follow_discovery_order(self):
+        f = ExpressionFunction("a - b")
+        assert f(5, 3) == 2   # names discovered in load order: a, b
+
+    def test_math_module_available(self):
+        f = ExpressionFunction("math.floor(x / 2)")
+        assert f(x=5) == 2
+        assert list(f.variable_names) == ["x"]  # math is not a var
+
+    def test_builtins_not_variables(self):
+        f = ExpressionFunction("max(a, abs(b))")
+        assert sorted(f.variable_names) == ["a", "b"]
+        assert f(a=1, b=-5) == 5
+
+    def test_conditional_expression(self):
+        f = ExpressionFunction("0 if v1 == v2 else 10")
+        assert f(v1=1, v2=1) == 0
+        assert f(v1=1, v2=2) == 10
+
+    def test_name_property_is_expression(self):
+        f = ExpressionFunction("a + 1")
+        assert f.__name__ == "a + 1"
+
+
+class TestBodyForm:
+    BODY = """
+if x > 1:
+    return x * 10
+return 0
+"""
+
+    def test_return_body_compiles(self):
+        f = ExpressionFunction(self.BODY)
+        assert f(x=2) == 20
+        assert f(x=0) == 0
+
+    def test_body_variable_discovery(self):
+        f = ExpressionFunction(self.BODY)
+        assert list(f.variable_names) == ["x"]
+
+    def test_body_with_local_assignment(self):
+        f = ExpressionFunction("""
+tmp = a * 2
+return tmp + b
+""")
+        # tmp is assigned, so only a and b are inputs
+        assert sorted(f.variable_names) == ["a", "b"]
+        assert f(a=2, b=1) == 5
+
+
+class TestPartial:
+    def test_partial_freezes_and_shrinks_names(self):
+        f = ExpressionFunction("a + b + c")
+        g = f.partial(b=10)
+        assert sorted(g.variable_names) == ["a", "c"]
+        assert g(a=1, c=2) == 13
+        assert g.fixed_vars == {"b": 10}
+
+    def test_partial_chain(self):
+        f = ExpressionFunction("a + b + c")
+        h = f.partial(b=10).partial(c=100)
+        assert list(h.variable_names) == ["a"]
+        assert h(a=1) == 111
+
+    def test_partial_keeps_expression(self):
+        f = ExpressionFunction("a + b").partial(b=1)
+        assert f.expression == "a + b"
+
+    def test_original_unchanged_by_partial(self):
+        f = ExpressionFunction("a + b")
+        f.partial(b=1)
+        assert sorted(f.variable_names) == ["a", "b"]
+
+
+class TestExternalSource:
+    def test_source_module_callable(self, tmp_path):
+        src = tmp_path / "ext.py"
+        src.write_text("def double(v):\n    return v * 2\n")
+        f = ExpressionFunction("source.double(v1)",
+                               source_file=str(src))
+        assert f(v1=4) == 8
+        # "source" is scope, not a variable
+        assert list(f.variable_names) == ["v1"]
+
+    def test_missing_source_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            ExpressionFunction("source.f(v)", source_file="/nope.py")
+
+
+class TestEqualityAndWire:
+    def test_equality_on_expression_and_fixed(self):
+        assert ExpressionFunction("a+1") == ExpressionFunction("a+1")
+        assert ExpressionFunction("a+1") != ExpressionFunction("a+2")
+        assert (ExpressionFunction("a+b").partial(b=1)
+                != ExpressionFunction("a+b"))
+
+    def test_hashable(self):
+        s = {ExpressionFunction("a+1"), ExpressionFunction("a+1")}
+        assert len(s) == 1
+
+    def test_wire_roundtrip(self):
+        f = ExpressionFunction("a * b").partial(b=3)
+        f2 = from_repr(simple_repr(f))
+        assert f2 == f
+        assert f2(a=2) == 6
+
+    def test_wire_roundtrip_body_form(self):
+        f = ExpressionFunction("return x + 1")
+        f2 = from_repr(simple_repr(f))
+        assert f2(x=1) == 2

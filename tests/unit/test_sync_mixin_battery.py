@@ -209,21 +209,44 @@ class TestOutboxAndMgt:
         cycle, inner = wire.content
         assert inner.type == "ping" and inner.n == 5  # single wrap
 
-    def test_recv_flush_exception_keeps_undelivered_tail(self):
-        """If a buffered message raises during the resume flush, the
-        NOT-yet-delivered remainder must stay buffered instead of
-        being silently dropped with the swapped-out local."""
+    def test_recv_flush_delivers_past_poisoned_entry(self):
+        """A buffered message that raises during the resume flush must
+        not strand the messages after it: they are delivered anyway
+        (a lost message stalls a neighbor's cycle barrier forever)
+        and the first error is re-raised afterwards."""
         comp = SyncProbe()
         comp.start()
         comp.on_message("n1", cycle_msg(0, PingMessage(1)), 0)
         comp.pause()
-        # duplicate from n1 (will raise on flush), then a valid one
+        # duplicate from n1 (raises on flush), then a valid one.
         comp.on_message("n1", cycle_msg(0, PingMessage(2)), 0)
         comp.on_message("n2", cycle_msg(0, PingMessage(3)), 0)
         with pytest.raises(ComputationException, match="duplicate"):
             comp.pause(False)
-        assert len(comp._paused_messages_recv) == 1
-        assert comp._paused_messages_recv[0][0] == "n2"
+        # n2's message was delivered: the cycle completed.
+        assert len(comp.cycles_seen) == 1
+        assert comp._paused_messages_recv == []
+        assert not comp.is_paused  # resumed despite the error
+
+    def test_post_flush_keeps_failed_entry_for_retry(self):
+        """Posts that fail environmentally (here: no sender attached)
+        stay buffered — unlike poisoned receptions they are expected
+        to succeed later."""
+        comp = SyncProbe()
+        comp._msg_sender = None
+        comp.start = lambda: None  # avoid start-time fillers
+        comp._running = True
+        comp.pause()
+        comp.post_msg("n1", PingMessage(1))
+        with pytest.raises(ComputationException, match="not attached"):
+            comp.pause(False)
+        assert len(comp._paused_messages_post) == 1
+        # Once attached, a pause/resume round delivers it.
+        comp._msg_sender = MagicMock()
+        comp.pause()
+        comp.pause(False)
+        assert comp._paused_messages_post == []
+        assert comp._msg_sender.call_args_list
 
     def test_paused_send_emitted_once_on_event_bus(self):
         from pydcop_tpu.infrastructure.events import event_bus
