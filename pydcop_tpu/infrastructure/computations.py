@@ -205,14 +205,19 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
             self.on_pause(True)
         else:
             self.on_pause(False)
-            # Flush buffered receptions THROUGH on_message, not
-            # _dispatch: synchronous computations wrap algo messages
-            # in "_cycle" envelopes that only their on_message knows
-            # how to unwrap (a raw dispatch would raise "No handler
-            # for message type '_cycle'").  A poisoned entry (e.g. a
-            # protocol-violating duplicate) is dropped — redelivering
-            # it would deterministically raise forever.
-            self._flush_paused(
+            # BOTH buffers are drained even if the first drain saw an
+            # error (aborting between them would strand the posts on a
+            # now-unpaused computation); the first error across both
+            # is re-raised at the end.
+            #
+            # Receptions flush THROUGH on_message, not _dispatch:
+            # synchronous computations wrap algo messages in "_cycle"
+            # envelopes that only their on_message knows how to unwrap
+            # (a raw dispatch would raise "No handler for message type
+            # '_cycle'").  A poisoned entry (e.g. a protocol-violating
+            # duplicate) is dropped — redelivering it would
+            # deterministically raise forever.
+            recv_error = self._flush_paused(
                 "_paused_messages_recv",
                 lambda e: self.on_message(*e),
                 keep_failed=False,
@@ -223,21 +228,25 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
             # envelope around them.  Post failures are usually
             # environmental (e.g. not attached yet), so the failed
             # entry itself is kept for a later flush.
-            self._flush_paused(
+            post_error = self._flush_paused(
                 "_paused_messages_post",
                 lambda e: MessagePassingComputation.post_msg(self, *e),
                 keep_failed=True,
             )
+            error = recv_error or post_error
+            if error is not None:
+                raise error
 
     def _flush_paused(self, buffer_attr: str, deliver, keep_failed: bool):
         """Drain a paused-message buffer in order, delivering EVERY
         entry even when one raises (remaining messages must not be
         stranded — with the sync mixin a lost message stalls a
         neighbor's cycle barrier forever).  Failed entries are kept in
-        the buffer (``keep_failed``) or dropped; the first exception
-        is re-raised after the drain so callers still see the error.
-        The buffer is swapped out first: a handler may re-pause, and
-        appending to a list being iterated would loop."""
+        the buffer (``keep_failed``) or dropped with a logged
+        traceback; the first exception is RETURNED (not raised) so the
+        caller can drain every buffer before surfacing it.  The buffer
+        is swapped out first: a handler may re-pause, and appending to
+        a list being iterated would loop."""
         entries = getattr(self, buffer_attr)
         setattr(self, buffer_attr, [])
         first_error = None
@@ -245,7 +254,15 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
         for entry in entries:
             try:
                 deliver(entry)
-            except Exception as e:  # noqa: BLE001 - rethrown below
+            except Exception as e:  # noqa: BLE001 - surfaced by caller
+                # Log every failure here: only the FIRST error is
+                # surfaced to the caller, and a dropped entry would
+                # otherwise vanish without a trace.
+                self.logger.exception(
+                    "Error flushing paused message %s of %s "
+                    "(%s)", entry, self.name,
+                    "kept" if keep_failed else "dropped",
+                )
                 if keep_failed:
                     failed.append(entry)
                 if first_error is None:
@@ -253,8 +270,7 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
         # Prepend: anything buffered DURING the drain (a handler
         # re-paused) is newer than the failed entries.
         setattr(self, buffer_attr, failed + getattr(self, buffer_attr))
-        if first_error is not None:
-            raise first_error
+        return first_error
 
     # Hooks:
     def on_start(self):

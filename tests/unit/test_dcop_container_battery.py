@@ -1,0 +1,158 @@
+"""Battery over dcop/dcop.py — the DCOP container: registration,
+merge, solution_cost semantics, initial assignments, filter_dcop."""
+
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP, filter_dcop
+from pydcop_tpu.dcop.objects import (
+    AgentDef,
+    Domain,
+    ExternalVariable,
+    Variable,
+    VariableWithCostDict,
+)
+from pydcop_tpu.dcop.relations import (
+    UnaryFunctionRelation,
+    constraint_from_str,
+)
+
+d2 = Domain("d", "", [0, 1])
+
+
+def coloring():
+    v1, v2 = Variable("v1", d2), Variable("v2", d2)
+    c = constraint_from_str("c1", "1 if v1 == v2 else 0", [v1, v2])
+    dcop = DCOP("t")
+    dcop.add_constraint(c)
+    return dcop, v1, v2
+
+
+class TestRegistration:
+    def test_invalid_objective_rejected(self):
+        with pytest.raises(ValueError, match="min or max"):
+            DCOP("t", objective="maximize")
+
+    def test_add_constraint_registers_variables_and_domains(self):
+        dcop, v1, v2 = coloring()
+        assert set(dcop.variables) == {"v1", "v2"}
+        assert d2.name in dcop.domains
+        assert dcop.variable("v1") is v1
+        assert dcop.constraint("c1").arity == 2
+
+    def test_add_constraint_registers_external_variables(self):
+        e = ExternalVariable("sensor", d2, value=1)
+        v = Variable("v1", d2)
+        c = constraint_from_str("c1", "v1 + sensor", [v, e])
+        dcop = DCOP("t")
+        dcop.add_constraint(c)
+        assert "sensor" in dcop.external_variables
+        assert "sensor" not in dcop.variables
+        assert dcop.get_external_variable("sensor") is e
+
+    def test_add_agents_forms(self):
+        dcop = DCOP("t")
+        dcop.add_agents(AgentDef("a1"))
+        dcop.add_agents([AgentDef("a2"), AgentDef("a3")])
+        dcop.add_agents({"a4": AgentDef("a4")})
+        assert set(dcop.agents) == {"a1", "a2", "a3", "a4"}
+        assert dcop.agent("a2").name == "a2"
+
+    def test_all_variables(self):
+        dcop, v1, v2 = coloring()
+        assert set(v.name for v in dcop.all_variables) == {"v1", "v2"}
+
+
+class TestMerge:
+    def test_merge_combines_everything(self):
+        d1, *_ = coloring()
+        d1.add_agents(AgentDef("a1"))
+        v3 = Variable("v3", d2)
+        c2 = UnaryFunctionRelation("c2", v3, lambda x: x)
+        d2_ = DCOP("other")
+        d2_.add_constraint(c2)
+        d2_.add_agents(AgentDef("a2"))
+        merged = d1 + d2_
+        assert set(merged.variables) == {"v1", "v2", "v3"}
+        assert set(merged.constraints) == {"c1", "c2"}
+        assert set(merged.agents) == {"a1", "a2"}
+        assert merged.name == "t+other"
+
+    def test_merge_objective_mismatch_raises(self):
+        with pytest.raises(ValueError, match="objective"):
+            DCOP("a", "min") + DCOP("b", "max")
+
+
+class TestSolutionCost:
+    def test_constraint_and_variable_costs_summed(self):
+        v1 = VariableWithCostDict("v1", d2, {0: 0.5, 1: 2.0})
+        v2 = Variable("v2", d2)
+        c = constraint_from_str("c1", "3 * (v1 == v2)", [v1, v2])
+        dcop = DCOP("t")
+        dcop.add_variable(v1)
+        dcop.add_constraint(c)
+        cost, violations = dcop.solution_cost({"v1": 0, "v2": 0})
+        assert cost == 3.5 and violations == 0
+        cost, violations = dcop.solution_cost({"v1": 0, "v2": 1})
+        assert cost == 0.5
+
+    def test_hard_violations_counted_not_summed(self):
+        dcop, *_ = coloring()
+        hard = constraint_from_str(
+            "h1", "float('inf') if v1 == 1 else 0",
+            list(dcop.variables.values()))
+        dcop.add_constraint(hard)
+        cost, violations = dcop.solution_cost({"v1": 1, "v2": 0})
+        assert violations == 1
+        assert cost == 0.0   # the inf did not pollute the sum
+
+    def test_missing_variable_raises(self):
+        dcop, *_ = coloring()
+        with pytest.raises(ValueError, match="Missing variable"):
+            dcop.solution_cost({"v1": 0})
+
+    def test_external_variables_filled_from_current_value(self):
+        e = ExternalVariable("sensor", d2, value=1)
+        v = Variable("v1", d2)
+        c = constraint_from_str("c1", "10 * sensor + v1", [v, e])
+        dcop = DCOP("t")
+        dcop.add_constraint(c)
+        cost, _ = dcop.solution_cost({"v1": 1})
+        assert cost == 11
+        e.value = 0
+        cost, _ = dcop.solution_cost({"v1": 1})
+        assert cost == 1
+
+
+class TestInitialAssignment:
+    def test_uses_initial_value_else_first_domain_value(self):
+        v1 = Variable("v1", d2, initial_value=1)
+        v2 = Variable("v2", d2)
+        dcop = DCOP("t")
+        dcop.add_variable(v1)
+        dcop.add_variable(v2)
+        assert dcop.initial_assignment() == {"v1": 1, "v2": 0}
+
+
+class TestFilter:
+    def _dcop_with_orphan(self):
+        dcop, v1, v2 = coloring()
+        orphan = Variable("lonely", d2)
+        dcop.add_variable(orphan)
+        unary_target = Variable("v9", d2)
+        dcop.add_constraint(UnaryFunctionRelation(
+            "u9", unary_target, lambda x: x))
+        dcop.add_agents(AgentDef("a1"))
+        return dcop
+
+    def test_filter_drops_unconstrained_and_unary_only(self):
+        filtered = filter_dcop(self._dcop_with_orphan())
+        assert set(filtered.variables) == {"v1", "v2"}
+        assert set(filtered.constraints) == {"c1"}
+        assert "a1" in filtered.agents   # agents preserved
+
+    def test_filter_accept_unary_keeps_unary_scope(self):
+        filtered = filter_dcop(
+            self._dcop_with_orphan(), accept_unary=True)
+        assert "v9" in filtered.variables
+        assert "u9" in filtered.constraints
+        assert "lonely" not in filtered.variables

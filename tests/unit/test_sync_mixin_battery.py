@@ -228,6 +228,27 @@ class TestOutboxAndMgt:
         assert comp._paused_messages_recv == []
         assert not comp.is_paused  # resumed despite the error
 
+    def test_posts_flushed_even_when_recv_flush_errors(self):
+        """A poisoned reception must not abort the resume before the
+        buffered POSTS are drained — the posts would be stranded on a
+        now-unpaused computation forever."""
+        comp = SyncProbe()
+        comp.start()
+        comp.on_message("n1", cycle_msg(0, PingMessage(1)), 0)
+        comp.pause()
+        comp.on_message("n1", cycle_msg(0, PingMessage(2)), 0)  # dup
+        comp.post_msg("n1", PingMessage(9))
+        comp._msg_sender.reset_mock()
+        with pytest.raises(ComputationException, match="duplicate"):
+            comp.pause(False)
+        flushed = [m for t, m in sent_messages(comp) if t == "n1"]
+        assert any(
+            m.type == "_cycle" and m.content[1] is not None
+            and m.content[1].n == 9
+            for m in flushed
+        )
+        assert comp._paused_messages_post == []
+
     def test_post_flush_keeps_failed_entry_for_retry(self):
         """Posts that fail environmentally (here: no sender attached)
         stay buffered — unlike poisoned receptions they are expected
