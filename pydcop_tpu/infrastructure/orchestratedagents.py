@@ -92,10 +92,10 @@ class OrchestrationComputation(MessagePassingComputation):
         # Per-computation isolation: one computation's poisoned
         # buffered message (its resume flush re-raises the first
         # delivery error) must not leave the agent's OTHER
-        # computations paused forever.  The first error is re-raised
-        # once everyone is resumed; Agent._handle_message logs it (no
-        # local logging — the flush itself already logged each failed
-        # message).
+        # computations paused forever.  EVERY failure is logged here
+        # with the failing computation's name — resume errors can also
+        # come from on_pause hooks (before any flush logging), and
+        # only the first error is re-raised to the agent loop.
         first_error = None
         for name in msg.computations or [
             c.name for c in self.agent.computations
@@ -106,6 +106,8 @@ class OrchestrationComputation(MessagePassingComputation):
             try:
                 self.agent.computation(name).pause(False)
             except Exception as e:  # noqa: BLE001 - rethrown below
+                self.agent.logger.exception(
+                    "Error resuming computation %s", name)
                 if first_error is None:
                     first_error = e
         if first_error is not None:

@@ -1,0 +1,164 @@
+"""Battery over computations_graph base objects and the four graph
+builders' structural invariants (reference test_graph_* depth)."""
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.computations_graph import (
+    constraints_hypergraph as chg,
+    factor_graph as fg,
+    ordered_graph as og,
+    pseudotree as pt,
+)
+from pydcop_tpu.computations_graph.objects import (
+    ComputationGraph,
+    ComputationNode,
+    Link,
+)
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+d2 = Domain("d", "", [0, 1])
+
+
+def chain_dcop(n=4):
+    dcop = DCOP("t")
+    vs = [Variable(f"v{i}", d2) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n - 1):
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[i + 1]], np.zeros((2, 2)), f"c{i}"))
+    return dcop
+
+
+class TestBaseObjects:
+    def test_link_nodes_and_membership(self):
+        link = Link(["a", "b"])
+        assert set(link.nodes) == {"a", "b"}
+        assert link.has_node("a") and not link.has_node("c")
+
+    def test_link_equality_ignores_order(self):
+        assert Link(["a", "b"]) == Link(["b", "a"])
+        assert Link(["a", "b"]) != Link(["a", "c"])
+        assert Link(["a", "b"], "other") != Link(["a", "b"])
+
+    def test_node_neighbors_from_links(self):
+        n = ComputationNode("x", "t", links=[
+            Link(["x", "y"]), Link(["x", "z"])])
+        assert set(n.neighbors) == {"y", "z"}
+        assert "x" not in n.neighbors
+
+    def test_graph_dedups_links(self):
+        shared = Link(["a", "b"])
+        na = ComputationNode("a", "t", links=[shared])
+        nb = ComputationNode("b", "t", links=[Link(["a", "b"])])
+        g = ComputationGraph("t", [na, nb])
+        assert len(g.links) == 1
+
+    def test_graph_lookup(self):
+        na = ComputationNode("a", "t")
+        g = ComputationGraph("t", [na])
+        assert g.computation("a") is na
+        assert g.has_computation("a")
+        assert not g.has_computation("zz")
+        assert len(g) == 1
+
+    def test_density_bounds(self):
+        assert ComputationGraph("t").density() == 0.0
+        na = ComputationNode("a", "t", links=[Link(["a", "b"])])
+        nb = ComputationNode("b", "t", links=[Link(["a", "b"])])
+        assert ComputationGraph("t", [na, nb]).density() == 1.0
+
+
+class TestFactorGraph:
+    def test_bipartite_structure(self):
+        g = fg.build_computation_graph(chain_dcop(3))
+        var_nodes = [n for n in g.nodes
+                     if isinstance(n, fg.VariableComputationNode)]
+        factor_nodes = [n for n in g.nodes
+                        if isinstance(n, fg.FactorComputationNode)]
+        assert len(var_nodes) == 3 and len(factor_nodes) == 2
+        # every link connects one var node to one factor node
+        names_v = {n.name for n in var_nodes}
+        for link in g.links:
+            a, b = link.nodes
+            assert (a in names_v) != (b in names_v)
+
+    def test_variable_node_knows_its_factors(self):
+        g = fg.build_computation_graph(chain_dcop(3))
+        mid = g.computation("v1")
+        assert set(mid.factors) == {"c0", "c1"}
+
+    def test_factor_node_scope(self):
+        g = fg.build_computation_graph(chain_dcop(3))
+        f = g.computation("c0")
+        assert [v.name for v in f.variables] == ["v0", "v1"]
+
+
+class TestHypergraph:
+    def test_one_node_per_variable(self):
+        g = chg.build_computation_graph(chain_dcop(4))
+        assert sorted(n.name for n in g.nodes) == [
+            "v0", "v1", "v2", "v3"]
+
+    def test_neighbors_via_shared_constraints(self):
+        g = chg.build_computation_graph(chain_dcop(4))
+        assert set(g.computation("v1").neighbors) == {"v0", "v2"}
+
+    def test_footprint_positive_and_monotone_in_degree(self):
+        g = chg.build_computation_graph(chain_dcop(4))
+        end = chg.computation_memory(g.computation("v0"))
+        mid = chg.computation_memory(g.computation("v1"))
+        assert 0 < end <= mid
+
+
+class TestOrderedGraph:
+    def test_total_lexical_order(self):
+        g = og.build_computation_graph(chain_dcop(4))
+        names = [n.name for n in g.nodes]
+        assert names == sorted(names)
+        # every node except the last has a next; except first a prev
+        for i, node in enumerate(g.nodes):
+            nexts = [li for li in node.links
+                     if getattr(li, "type", "") == "next"
+                     and li.source == node.name]
+            assert bool(nexts) == (i < len(g.nodes) - 1)
+
+
+class TestPseudotree:
+    def _tree(self, n=6):
+        return pt.build_computation_graph(chain_dcop(n))
+
+    def test_single_root(self):
+        g = self._tree()
+        roots = [n for n in g.nodes if n.parent is None]
+        assert len(roots) == 1
+
+    def test_parent_child_symmetry(self):
+        g = self._tree()
+        for node in g.nodes:
+            for child in node.children:
+                assert g.computation(child).parent == node.name
+            if node.parent:
+                assert node.name in g.computation(node.parent).children
+
+    def test_every_constraint_connects_node_to_ancestor(self):
+        g = self._tree()
+
+        def ancestors(name):
+            out = []
+            cur = g.computation(name)
+            while cur.parent:
+                out.append(cur.parent)
+                cur = g.computation(cur.parent)
+            return set(out)
+
+        for node in g.nodes:
+            for c in node.constraints:
+                others = set(c.scope_names) - {node.name}
+                # each constraint is attached at its LOWEST node: all
+                # other scope members are ancestors of it
+                assert others <= ancestors(node.name), (
+                    node.name, c.name)
