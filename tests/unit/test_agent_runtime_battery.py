@@ -1,0 +1,159 @@
+"""Battery over the Agent runtime loop (infrastructure/agents.py)
+beyond lifecycle/metrics basics: periodic-action scheduling, message
+routing resilience, run() selection, and shutdown (reference
+test_infra_agents depth)."""
+
+import threading
+import time
+
+from pydcop_tpu.infrastructure.agents import Agent
+from pydcop_tpu.infrastructure.communication import (
+    InProcessCommunicationLayer,
+)
+from pydcop_tpu.infrastructure.computations import (
+    MessagePassingComputation,
+    message_type,
+    register,
+)
+
+NoteMessage = message_type("note", ["n"])
+
+
+class Recorder(MessagePassingComputation):
+    def __init__(self, name):
+        super().__init__(name)
+        self.seen = []
+        self.started = threading.Event()
+
+    def on_start(self):
+        self.started.set()
+
+    @register("note")
+    def _on_note(self, sender, msg, t):
+        self.seen.append((sender, msg.n))
+
+
+class Exploder(MessagePassingComputation):
+    @register("note")
+    def _on_note(self, sender, msg, t):
+        raise RuntimeError("boom")
+
+
+def make_agent(name="a1"):
+    return Agent(name, InProcessCommunicationLayer())
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestRuntimeLoop:
+    def test_message_delivery_on_agent_thread(self):
+        agent = make_agent()
+        comp = Recorder("c1")
+        agent.add_computation(comp)
+        agent.start()
+        try:
+            agent.run()
+            agent.messaging.post_msg("ext", "c1", NoteMessage(1))
+            assert wait_for(lambda: comp.seen == [("ext", 1)])
+        finally:
+            agent.clean_shutdown(2)
+
+    def test_handler_exception_does_not_kill_loop(self):
+        agent = make_agent()
+        bad, good = Exploder("bad"), Recorder("good")
+        agent.add_computation(bad)
+        agent.add_computation(good)
+        agent.start()
+        try:
+            agent.run()
+            agent.messaging.post_msg("ext", "bad", NoteMessage(1))
+            agent.messaging.post_msg("ext", "good", NoteMessage(2))
+            assert wait_for(lambda: good.seen == [("ext", 2)])
+        finally:
+            agent.clean_shutdown(2)
+
+    def test_unknown_computation_message_logged_not_fatal(self):
+        agent = make_agent()
+        comp = Recorder("c1")
+        agent.add_computation(comp)
+        agent.start()
+        try:
+            agent.run()
+            agent.messaging.register_computation("ghost")
+            agent.messaging.post_msg("ext", "ghost", NoteMessage(0))
+            agent.messaging.post_msg("ext", "c1", NoteMessage(1))
+            assert wait_for(lambda: comp.seen == [("ext", 1)])
+        finally:
+            agent.clean_shutdown(2)
+
+
+class TestPeriodicActions:
+    def test_periodic_fires_repeatedly(self):
+        agent = make_agent()
+        hits = []
+        agent.set_periodic_action(0.05, lambda: hits.append(1))
+        agent.start()
+        try:
+            assert wait_for(lambda: len(hits) >= 3, timeout=3)
+        finally:
+            agent.clean_shutdown(2)
+
+    def test_remove_periodic_action(self):
+        agent = make_agent()
+        hits = []
+
+        def tick():
+            hits.append(1)
+
+        agent.set_periodic_action(0.05, tick)
+        agent.start()
+        try:
+            assert wait_for(lambda: len(hits) >= 1, timeout=3)
+            agent.remove_periodic_action(tick)
+            time.sleep(0.15)
+            count = len(hits)
+            time.sleep(0.2)
+            assert len(hits) == count   # no longer firing
+        finally:
+            agent.clean_shutdown(2)
+
+    def test_periodic_exception_does_not_kill_loop(self):
+        agent = make_agent()
+        hits = []
+
+        def bad():
+            raise RuntimeError("tick boom")
+
+        agent.set_periodic_action(0.05, bad)
+        agent.set_periodic_action(0.05, lambda: hits.append(1))
+        agent.start()
+        try:
+            assert wait_for(lambda: len(hits) >= 2, timeout=3)
+        finally:
+            agent.clean_shutdown(2)
+
+
+class TestLifecycleGuards:
+    def test_clean_shutdown_stops_thread_and_computations(self):
+        agent = make_agent()
+        comp = Recorder("c1")
+        agent.add_computation(comp)
+        agent.start()
+        agent.run()
+        assert wait_for(lambda: comp.started.is_set())
+        agent.clean_shutdown(2)
+        assert not agent._thread.is_alive()
+        assert not comp.is_running
+
+    def test_clean_shutdown_idempotent(self):
+        agent = make_agent()
+        agent.start()
+        agent.clean_shutdown(2)
+        agent.clean_shutdown(2)   # second call must not raise
