@@ -1,0 +1,99 @@
+"""CLI tests for ``pydcop graph`` and ``pydcop consolidate`` output
+surfaces (reference tests/dcop_cli depth)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REF_INSTANCES = "/root/reference/tests/instances"
+FIXTURE = os.path.join(REF_INSTANCES, "graph_coloring1.yaml")
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def run_raw(args, timeout=120):
+    return subprocess.check_output(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli"] + args,
+        timeout=timeout, env=ENV, text=True,
+    )
+
+
+def run_json(args, timeout=120):
+    return json.loads(run_raw(args, timeout))
+
+
+class TestGraph:
+    def test_graph_by_model(self):
+        res = run_json(["graph", "-g", "factor_graph", FIXTURE])
+        # 3 vars + 2 factors (graph_coloring1: c1(v1,v2), c2(v2,v3))
+        assert res["nodes"] == 5
+        assert res["edges"] == 4
+        assert res["density"] > 0
+
+    def test_graph_model_from_algo(self):
+        res = run_json(["graph", "-a", "dsa", FIXTURE])
+        assert res["graph"] == "constraints_hypergraph"
+        assert res["nodes"] == 3
+
+    def test_graph_requires_model_or_algo(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "graph",
+             FIXTURE],
+            capture_output=True, text=True, env=ENV, timeout=60,
+        )
+        assert proc.returncode == 2
+        assert "one of --graph or --algo" in (
+            proc.stdout + proc.stderr)
+
+    def test_graph_degree_and_cycles(self):
+        res = run_json(["graph", "-g", "constraints_hypergraph",
+                        FIXTURE])
+        # v1-v2-v3 chain: no cycles, max degree 2, diameter 2
+        assert res["cycles"] == 0
+        assert res["max_degree"] == 2
+        assert res["min_degree"] == 1
+        assert res["component_diameters"] == [2]
+
+
+class TestConsolidate:
+    def _result_file(self, tmp_path, name, cost, time_s):
+        payload = {
+            "status": "FINISHED", "cost": cost, "time": time_s,
+            "cycle": 10, "msg_count": 100, "msg_size": 1000,
+        }
+        p = tmp_path / name
+        p.write_text(json.dumps(payload))
+        return str(p)
+
+    def test_solution_rows(self, tmp_path):
+        f1 = self._result_file(tmp_path, "r1.json", 5.0, 1.0)
+        f2 = self._result_file(tmp_path, "r2.json", 7.0, 2.0)
+        out = run_raw(["consolidate", "--solution", f1, f2])
+        lines = [ln for ln in out.strip().splitlines() if ln]
+        # rows only on stdout (header is written to --output files)
+        assert len(lines) == 2
+        assert lines[0].split(",")[:2] == ["1.0", "5.0"]
+
+    def test_solution_output_file_gets_header(self, tmp_path):
+        f1 = self._result_file(tmp_path, "r1.json", 5.0, 1.0)
+        out_file = tmp_path / "out.csv"
+        run_raw(["--output", str(out_file),
+                 "consolidate", "--solution", f1])
+        lines = out_file.read_text().strip().splitlines()
+        assert lines[0].startswith("time,cost,cycle")
+        assert len(lines) == 2
+
+    def test_average_mode(self, tmp_path):
+        f1 = self._result_file(tmp_path, "r1.json", 5.0, 1.0)
+        f2 = self._result_file(tmp_path, "r2.json", 7.0, 3.0)
+        out = run_raw(["consolidate", "--average", f1, f2])
+        row = out.strip().split(",")
+        # n_runs, time, cost, cycle, msg_count, msg_size, finished_frac
+        assert row[0] == "2"
+        assert float(row[1]) == 2.0   # mean time
+        assert float(row[2]) == 6.0   # mean cost
+        assert float(row[6]) == 1.0   # both FINISHED
