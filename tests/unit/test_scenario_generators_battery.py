@@ -1,0 +1,113 @@
+"""Battery over dcop/scenario.py objects and structural properties of
+the ising / meetingscheduling generators."""
+
+import pytest
+
+from pydcop_tpu.dcop.scenario import DcopEvent, EventAction, Scenario
+from pydcop_tpu.generators.ising import generate_ising
+from pydcop_tpu.generators.meetingscheduling import generate_meetings
+from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
+
+
+class TestScenarioObjects:
+    def test_action_fields(self):
+        a = EventAction("remove_agent", agent="a1")
+        assert a.type == "remove_agent"
+        assert a.args == {"agent": "a1"}
+
+    def test_action_equality(self):
+        assert EventAction("x", k=1) == EventAction("x", k=1)
+        assert EventAction("x", k=1) != EventAction("x", k=2)
+        assert EventAction("x") != EventAction("y")
+
+    def test_action_wire_roundtrip(self):
+        a = EventAction("add_agent", agent="a9", capacity=5)
+        a2 = from_repr(simple_repr(a))
+        assert a2 == a
+
+    def test_delay_event(self):
+        e = DcopEvent("e1", delay=2.5)
+        assert e.is_delay and e.delay == 2.5
+        assert e.actions is None
+
+    def test_action_event(self):
+        e = DcopEvent("e2", actions=[EventAction("remove_agent",
+                                                 agent="a1")])
+        assert not e.is_delay
+        assert len(e.actions) == 1
+
+    def test_event_wire_roundtrip(self):
+        e = DcopEvent("e2", actions=[EventAction("remove_agent",
+                                                 agent="a1")])
+        e2 = from_repr(simple_repr(e))
+        assert e2 == e
+
+    def test_scenario_container(self):
+        s = Scenario([DcopEvent("e1", delay=1.0)])
+        s.add_event(DcopEvent("e2", delay=2.0))
+        assert len(s) == 2
+        assert [e.id for e in s] == ["e1", "e2"]
+        assert s.events[0].is_delay
+
+
+class TestIsingGenerator:
+    def test_structure(self):
+        dcop, var_map, fg_map = generate_ising(3, 4, seed=1)
+        assert len(dcop.variables) == 12
+        # toroidal grid: 2 binary constraints per cell + 1 unary each
+        binary = [c for c in dcop.constraints.values() if c.arity == 2]
+        unary = [c for c in dcop.constraints.values() if c.arity == 1]
+        assert len(binary) == 24
+        assert len(unary) == 12
+        assert dcop.objective == "min"
+
+    def test_deterministic_by_seed(self):
+        d1, *_ = generate_ising(3, 3, seed=7)
+        d2, *_ = generate_ising(3, 3, seed=7)
+        binaries = [c for c in d1.constraints.values() if c.arity == 2]
+        assert binaries, "expected binary couplings"
+        checked = 0
+        for c1 in binaries:
+            c2 = d2.constraints[c1.name]
+            for a in ((0, 0), (0, 1), (1, 0), (1, 1)):
+                assert c1(*a) == c2(*a)
+                checked += 1
+        assert checked > 0
+
+    def test_unary_range_bounded(self):
+        dcop, *_ = generate_ising(3, 3, un_range=0.05, seed=3)
+        for c in dcop.constraints.values():
+            if c.arity == 1:
+                assert abs(c(0)) <= 0.05
+
+    def test_intentional_form_matches_extensive(self):
+        ext, *_ = generate_ising(2, 2, seed=5, extensive=True)
+        intn, *_ = generate_ising(2, 2, seed=5, extensive=False)
+        checked_unary = checked_binary = 0
+        for name, c_ext in ext.constraints.items():
+            c_int = intn.constraints[name]
+            if c_ext.arity == 1:
+                for v in (0, 1):
+                    assert c_ext(v) == pytest.approx(c_int(v))
+                checked_unary += 1
+            else:
+                for a in ((0, 0), (0, 1), (1, 0), (1, 1)):
+                    assert c_ext(*a) == pytest.approx(c_int(*a))
+                checked_binary += 1
+        assert checked_unary and checked_binary
+
+
+class TestMeetingsGenerator:
+    def test_deterministic_by_seed(self):
+        d1 = generate_meetings(4, 3, 3, 2, seed=9)
+        d2 = generate_meetings(4, 3, 3, 2, seed=9)
+        assert sorted(d1.variables) == sorted(d2.variables)
+        assert sorted(d1.constraints) == sorted(d2.constraints)
+
+    def test_solvable_by_dpop(self):
+        from pydcop_tpu.api import solve
+
+        dcop = generate_meetings(3, 2, 2, 1, seed=4)
+        res = solve(dcop, "dpop")
+        assert res["status"] == "FINISHED"
+        assert set(res["assignment"]) == set(dcop.variables)
