@@ -1,0 +1,154 @@
+"""Battery over the repair-as-DCOP builders (reparation/) and the
+removal analysis, at the reference's test_reparation*.py depth —
+asserting the constraint SEMANTICS (hard/soft shapes), not just
+wiring."""
+
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.replication.objects import ReplicaDistribution
+from pydcop_tpu.reparation import (
+    DEFAULT_INFINITY,
+    binary_variable_name,
+    create_agent_capacity_constraint,
+    create_agent_comp_comm_constraint,
+    create_agent_hosting_constraint,
+    create_binary_variables_for,
+    create_computation_hosted_constraint,
+)
+from pydcop_tpu.reparation.removal import (
+    candidate_agents,
+    candidate_computations_for_agent,
+    orphaned_computations,
+    removal_info,
+    unrepairable_computations,
+)
+
+
+def variables_for(comp, agents, suffix=""):
+    return create_binary_variables_for(
+        [comp], {comp: agents}, suffix)
+
+
+class TestBinaryVariables:
+    def test_naming(self):
+        assert binary_variable_name("v1", "a2") == "x_v1_a2"
+        assert binary_variable_name("v1", "a2", "__r3") == "x_v1_a2__r3"
+
+    def test_one_variable_per_pair(self):
+        vs = create_binary_variables_for(
+            ["c1", "c2"], {"c1": ["a1", "a2"], "c2": ["a2"]})
+        assert set(vs) == {("c1", "a1"), ("c1", "a2"), ("c2", "a2")}
+        assert vs[("c2", "a2")].name == "x_c2_a2"
+
+    def test_suffix_makes_rounds_distinct(self):
+        v1 = variables_for("c", ["a"], "__r1")[("c", "a")]
+        v2 = variables_for("c", ["a"], "__r2")[("c", "a")]
+        assert v1.name != v2.name
+
+
+class TestHostedConstraint:
+    def test_exactly_one_is_free(self):
+        vs = list(variables_for("c1", ["a1", "a2", "a3"]).values())
+        c = create_computation_hosted_constraint("c1", vs)
+        assert c(1, 0, 0) == 0
+        assert c(0, 1, 0) == 0
+
+    def test_zero_or_many_hard_violation(self):
+        vs = list(variables_for("c1", ["a1", "a2"]).values())
+        c = create_computation_hosted_constraint("c1", vs)
+        assert c(0, 0) == DEFAULT_INFINITY
+        assert c(1, 1) == DEFAULT_INFINITY
+
+
+class TestCapacityConstraint:
+    def _constraint(self, remaining):
+        vs = {
+            "c1": variables_for("c1", ["a"])[("c1", "a")],
+            "c2": variables_for("c2", ["a"])[("c2", "a")],
+        }
+        return create_agent_capacity_constraint(
+            "a", remaining, {"c1": 3.0, "c2": 4.0}, vs)
+
+    def test_fit_is_free(self):
+        c = self._constraint(remaining=7)
+        assert c(1, 1) == 0
+        assert c(0, 0) == 0
+
+    def test_overload_hard_violation(self):
+        c = self._constraint(remaining=5)
+        # sorted order: c1 (3.0) then c2 (4.0)
+        assert c(1, 1) == DEFAULT_INFINITY
+        assert c(1, 0) == 0
+        assert c(0, 1) == 0
+
+
+class TestSoftConstraints:
+    def test_hosting_cost_sums_accepted(self):
+        vs = {
+            "c1": variables_for("c1", ["a"])[("c1", "a")],
+            "c2": variables_for("c2", ["a"])[("c2", "a")],
+        }
+        c = create_agent_hosting_constraint(
+            "a", {"c1": 2.0, "c2": 5.0}, vs)
+        assert c(1, 1) == 7.0
+        assert c(1, 0) == 2.0
+        assert c(0, 0) == 0.0
+
+    def test_comm_cost_scales_with_hosting_decision(self):
+        v = variables_for("c1", ["a1"])[("c1", "a1")]
+        routes = {("a1", "a2"): 3.0, ("a1", "a3"): 1.0}
+        c = create_agent_comp_comm_constraint(
+            "a1", "c1",
+            neighbor_agents={"n1": "a2", "n2": "a3"},
+            route=lambda a, b: routes[(a, b)],
+            comm_load=lambda comp, n: 2.0,
+            variable=v,
+        )
+        # (3*2) + (1*2) = 8 when hosted, 0 when not
+        assert c(1) == 8.0
+        assert c(0) == 0.0
+
+
+class TestRemovalAnalysis:
+    DIST = Distribution({
+        "a1": ["c1", "c2"], "a2": ["c3"], "a3": [],
+    })
+    REPLICAS = ReplicaDistribution({
+        "c1": ["a2", "a3"], "c2": ["a1"], "c3": ["a1"],
+    })
+
+    def test_orphaned_computations(self):
+        assert orphaned_computations(["a1"], self.DIST) == ["c1", "c2"]
+        assert orphaned_computations(["a1", "a2"], self.DIST) == [
+            "c1", "c2", "c3"]
+        assert orphaned_computations(["a3"], self.DIST) == []
+
+    def test_candidates_exclude_departed(self):
+        cands = candidate_agents(
+            ["c1", "c2"], self.REPLICAS, departed=["a1"])
+        assert cands["c1"] == ["a2", "a3"]
+        # c2's only replica was on the departed agent itself
+        assert cands["c2"] == []
+
+    def test_candidate_computations_for_agent(self):
+        cands = {"c1": ["a2", "a3"], "c2": ["a3"]}
+        assert candidate_computations_for_agent("a3", cands) == [
+            "c1", "c2"]
+        assert candidate_computations_for_agent("a2", cands) == ["c1"]
+
+    def test_unrepairable(self):
+        cands = {"c1": ["a2"], "c2": []}
+        assert unrepairable_computations(cands) == ["c2"]
+
+    def test_removal_info_summary(self):
+        orphaned, cands, lost = removal_info(
+            ["a1"], self.DIST, self.REPLICAS)
+        assert orphaned == ["c1", "c2"]
+        assert cands["c1"] == ["a2", "a3"]
+        assert lost == ["c2"]
+
+    def test_unknown_replica_entry_is_lost(self):
+        dist = Distribution({"a1": ["ghost"]})
+        replicas = ReplicaDistribution({})
+        orphaned, cands, lost = removal_info(["a1"], dist, replicas)
+        assert orphaned == ["ghost"]
+        assert lost == ["ghost"]

@@ -103,6 +103,16 @@ class TestMeetingsGenerator:
         d2 = generate_meetings(4, 3, 3, 2, seed=9)
         assert sorted(d1.variables) == sorted(d2.variables)
         assert sorted(d1.constraints) == sorted(d2.constraints)
+        # Values too, not just names: unary value tables must match.
+        checked = 0
+        for name, c1 in d1.constraints.items():
+            if c1.arity != 1:
+                continue
+            c2 = d2.constraints[name]
+            for v in c1.dimensions[0].domain:
+                assert c1(v) == c2(v)
+                checked += 1
+        assert checked > 0
 
     def test_solvable_by_dpop(self):
         from pydcop_tpu.api import solve
