@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test unit api cli check bench dryrun
+.PHONY: all test unit api cli check bench dryrun onchip
 
 all: check test
 
@@ -28,3 +28,11 @@ bench:
 
 dryrun:
 	$(PY) -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+# Probe the TPU tunnel in a bounded loop; the moment it answers, run
+# the queued hardware decision list unattended (headline bench,
+# aggregation A/B, collective share, layout A/B) and append results to
+# BENCH_TPU.md.  Probe history goes to BENCH_TPU_PROBELOG.jsonl either
+# way.  See tools/onchip_autopilot.py.
+onchip:
+	$(PY) tools/onchip_autopilot.py

@@ -1,0 +1,255 @@
+"""On-chip autopilot: convert tunnel luck into a constant-time cost.
+
+Four rounds produced zero driver-captured TPU numbers because the axon
+tunnel wedges for hours at a time and a builder had to be at the
+keyboard the moment it revived.  This tool removes the keyboard: it
+probes the accelerator backend in a bounded loop and, the moment a
+probe answers, spends the live tunnel on the queued decision list
+unattended:
+
+  1. ``python bench.py``                       — post-dispatch-fix TPU
+     headline + the 1M-var HBM scale leg (bench.py self-supervises).
+  2. ``python benchmarks/exp_aggregation.py``  — the scatter/sorted/
+     boundary A/B whose winner becomes the scale-path default.
+  3. ``python benchmarks/exp_allreduce_share.py`` — collective share.
+  4. ``python benchmarks/exp_layout.py``       — lane-major vs
+     edge-major layout A/B for the HBM-bound regime.
+
+Every probe and every step outcome is appended as a JSON line to
+``BENCH_TPU_PROBELOG.jsonl`` (the committed proof that the tunnel
+either answered or never did), raw step output is kept under
+``benchmarks/runs/``, and each step that *ran on the TPU* gets its
+result lines appended to ``BENCH_TPU.md`` under an autopilot section.
+Steps whose output comes back ``backend: cpu`` (bench.py falls back by
+itself when the tunnel dies mid-run) are NOT marked done — the
+autopilot keeps trying them until the deadline.
+
+Usage:
+    python tools/onchip_autopilot.py [--deadline-hours H]
+        [--interval S] [--once] [--probe-timeout S]
+
+State (which steps have completed on hardware) persists in
+``benchmarks/runs/autopilot_state.json`` so a restarted autopilot
+resumes instead of re-running finished steps.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+PROBELOG = os.path.join(REPO, "BENCH_TPU_PROBELOG.jsonl")
+RUNS_DIR = os.path.join(REPO, "benchmarks", "runs")
+STATE = os.path.join(RUNS_DIR, "autopilot_state.json")
+BENCH_MD = os.path.join(REPO, "BENCH_TPU.md")
+
+# (name, argv-tail, per-step timeout seconds).  Order = priority; the
+# headline bench goes first so a tunnel that wedges again mid-queue
+# still leaves the most important number behind.
+QUEUE = [
+    ("bench", ["bench.py"], 2400),
+    ("exp_aggregation", ["benchmarks/exp_aggregation.py"], 3600),
+    ("exp_allreduce_share", ["benchmarks/exp_allreduce_share.py"], 1800),
+    ("exp_layout", ["benchmarks/exp_layout.py"], 3600),
+]
+
+
+def log_event(kind, **details):
+    event = {"unix": round(time.time(), 1),
+             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+             "event": kind, **details}
+    with open(PROBELOG, "a") as fh:
+        fh.write(json.dumps(event) + "\n")
+    print(f"autopilot: {kind} {details}", file=sys.stderr)
+    return event
+
+
+def load_state():
+    try:
+        with open(STATE) as fh:
+            state = json.load(fh)
+        return state if isinstance(state, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(state):
+    os.makedirs(RUNS_DIR, exist_ok=True)
+    with open(STATE, "w") as fh:
+        json.dump(state, fh, indent=1)
+
+
+def probe(timeout):
+    """One subprocess probe that requires a live *TPU* backend — a
+    healthy CPU backend (plugin env unset) must not count, or the
+    autopilot would burn hours re-running the whole queue on CPU
+    (ran_on_tpu would refuse to retire any step).  A wedged tunnel
+    hangs the child forever, hence subprocess + timeout."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        platform = (proc.stdout or "").strip().splitlines()[-1:]
+        platform = platform[0] if platform else ""
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip().splitlines()[-1:]
+            ok, error = False, (
+                f"exit {proc.returncode}: {' '.join(tail)[:200]}")
+        elif platform != "tpu":
+            ok, error = False, f"backend is {platform!r}, not tpu"
+        else:
+            ok, error = True, None
+    except subprocess.TimeoutExpired:
+        ok, error = False, f"timeout after {timeout}s"
+    log_event("probe", ok=ok, error=error,
+              seconds=round(time.time() - t0, 1))
+    return ok
+
+
+def json_lines(text):
+    out = []
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+def ran_on_tpu(lines):
+    """A step counts as hardware evidence only if every result line
+    that declares a backend declares the TPU (bench.py and both
+    experiments fall back to CPU by themselves when the tunnel dies
+    mid-run — a CPU line must not retire the step)."""
+    backends = [ln.get("backend") for ln in lines if "backend" in ln]
+    return bool(backends) and all(b == "tpu" for b in backends)
+
+
+def append_bench_md(name, lines, stamp):
+    block = "\n".join(json.dumps(ln) for ln in lines)
+    section = (
+        f"\n## Round 5 autopilot — {name} ({stamp} UTC, TPU)\n\n"
+        f"```json\n{block}\n```\n"
+    )
+    with open(BENCH_MD, "a") as fh:
+        fh.write(section)
+
+
+def run_step(name, argv_tail, timeout):
+    os.makedirs(RUNS_DIR, exist_ok=True)
+    stamp = time.strftime("%Y-%m-%dT%H-%M-%S", time.gmtime())
+    raw_path = os.path.join(RUNS_DIR, f"{name}_{stamp}.log")
+    log_event("step_start", step=name, timeout_s=timeout)
+    t0 = time.time()
+    # Own session + group kill on timeout: bench.py is itself a
+    # supervisor that spawns a grandchild — killing only the direct
+    # child would orphan a runner that keeps the tunnel occupied for
+    # every later step.
+    proc = subprocess.Popen(
+        [sys.executable] + argv_tail, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        out, _ = proc.communicate()
+        out, rc = out or "", None
+    with open(raw_path, "w") as fh:
+        fh.write(out)
+    lines = json_lines(out)
+    on_tpu = ran_on_tpu(lines)
+    log_event(
+        "step_done", step=name, rc=rc, seconds=round(time.time() - t0, 1),
+        result_lines=len(lines), on_tpu=on_tpu, raw=os.path.relpath(
+            raw_path, REPO),
+    )
+    if on_tpu and rc == 0 and lines:
+        append_bench_md(name, lines, stamp)
+        return True
+    return False
+
+
+def pending_steps(state, log_missing=False):
+    pending = []
+    for n, a, t in QUEUE:
+        if state.get(n, {}).get("done"):
+            continue
+        if not os.path.exists(os.path.join(REPO, a[0])):
+            if log_missing:
+                log_event("step_missing", step=n, script=a[0])
+            continue
+        pending.append((n, a, t))
+    return pending
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-hours", type=float, default=11.0)
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between failed probes")
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe attempt, then exit")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.deadline_hours * 3600
+    state = load_state()
+    log_event("autopilot_start", deadline_hours=args.deadline_hours,
+              pending=[n for n, _, _ in pending_steps(state)])
+
+    while time.time() < deadline:
+        todo = pending_steps(state)
+        if not todo:
+            # Completion is only honest if no queued script was
+            # silently absent — log any such before declaring done.
+            pending_steps(state, log_missing=True)
+            log_event("autopilot_complete",
+                      done=[n for n in state if state[n].get("done")])
+            return 0
+        if probe(args.probe_timeout):
+            for name, tail, timeout in todo:
+                done = run_step(name, tail, timeout)
+                if done:
+                    state[name] = {
+                        "done": True,
+                        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+                    }
+                    save_state(state)
+                    continue
+                # Step failed or fell back to CPU: re-probe before
+                # burning the rest of the queue on a dead tunnel.
+                if not probe(args.probe_timeout):
+                    log_event("tunnel_lost_mid_queue", after=name)
+                    break
+        if args.once:
+            break
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            break
+        time.sleep(min(args.interval, max(remaining, 0)))
+
+    still = [n for n, _, _ in pending_steps(state)]
+    log_event("autopilot_deadline", pending=still)
+    return 0 if not still else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
