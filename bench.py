@@ -291,7 +291,8 @@ def _artifact_keys(platform, out):
 
 
 def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
-                cycles: int = SCALE_CYCLES, aggregation: str = "scatter"):
+                cycles: int = SCALE_CYCLES, aggregation: str = "scatter",
+                layout: str = "edge"):
     """HBM-bound scale leg: a synthetic 1M-variable / 1.5M-factor
     3-coloring whose ~190 MB working set cannot stay VMEM-resident, so
     the measured rate reflects real HBM streaming (the 10k north-star
@@ -302,9 +303,15 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     ``aggregation`` selects the variable-aggregation strategy
     (engine/compile.build_aggregation_arrays); the headline leg runs
     the strategy benchmarks/exp_aggregation.py measured fastest on the
-    target backend.
+    target backend.  ``layout="lane"`` runs the lane-major superstep
+    (ops/maxsum_lane.py; scatter aggregation only) — the layout A/B is
+    benchmarks/exp_layout.py.
 
-    Returns (cycles/s, graph) for roofline accounting.
+    Returns (cycles/s, graph).  With the default edge layout the graph
+    feeds roofline accounting; a lane graph does NOT (the roofline
+    counters unpack edge-major shapes positionally and would count
+    garbage — they reject LaneGraph) and is returned for value-parity
+    runs only (exp_layout).
     """
     from functools import partial
 
@@ -341,12 +348,23 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     buckets = (FactorBucket(costs, var_ids),)
     perm, sorted_seg, starts, ends = build_aggregation_arrays(
         buckets, n_vars + 1, aggregation)
-    graph = jax.device_put(CompiledFactorGraph(
+    graph = CompiledFactorGraph(
         var_costs=var_costs, var_valid=var_valid, buckets=buckets,
         agg_perm=perm, agg_sorted_seg=sorted_seg,
         agg_starts=starts, agg_ends=ends,
-    ))
-    fn = jax.jit(partial(ops.run_maxsum, max_cycles=cycles,
+    )
+    if layout == "lane":
+        if aggregation != "scatter":
+            raise ValueError("layout='lane' requires scatter "
+                             "aggregation")
+        from pydcop_tpu.ops import maxsum_lane as lane_ops
+
+        graph = jax.device_put(lane_ops.to_lane_graph(graph))
+        run = lane_ops.run_maxsum
+    else:
+        graph = jax.device_put(graph)
+        run = ops.run_maxsum
+    fn = jax.jit(partial(run, max_cycles=cycles,
                          stop_on_convergence=False))
     jax.block_until_ready(fn(graph))           # compile + warm
     t0 = time.perf_counter()

@@ -1,0 +1,82 @@
+"""Layout A/B for the MaxSum superstep at scale: edge-major (current
+engine default, messages [F, arity, D]) vs lane-major (factors on the
+TPU lane axis, messages [D, arity, F] — ops/maxsum_lane.py), plus the
+edge-major "sorted" aggregation for a third column.
+
+Motivation (BENCH_TPU.md): past VMEM residency the superstep is
+scatter/layout-bound (8.4 ms/cycle at 100k vars, ~0.5% of HBM peak on
+a v5e), and an on-chip prototype of the transposed layout measured
+1.7x/1.3x on the raw message math.  This harness measures the FULL
+superstep per layout on the synthetic 3-coloring scale problem
+(bench.bench_scale) at 10k / 100k / 1M vars, so the number that
+decides the scale path's default is end-to-end, not op-level.
+
+Run on the target backend:  python benchmarks/exp_layout.py
+Prints one JSON line per size: ms/cycle per configuration + the
+selected-assignment agreement between layouts at that size (the
+layouts reassociate the per-variable float sums, so trajectories can
+split on near-ties; agreement is reported, not asserted — the
+bit-level contract is tests/unit/test_maxsum_lane.py).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    from pydcop_tpu.utils.cleanenv import ensure_live_backend
+
+    ensure_live_backend(tag="exp_layout")
+    import os
+
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench as bench_mod
+
+    configs = [
+        ("edge_scatter", {"aggregation": "scatter", "layout": "edge"}),
+        ("edge_sorted", {"aggregation": "sorted", "layout": "edge"}),
+        ("lane", {"aggregation": "scatter", "layout": "lane"}),
+    ]
+    for n_vars in (10_000, 100_000, 1_000_000):
+        cycles = 200 if n_vars <= 100_000 else 50
+        out = {"n_vars": n_vars, "cycles": cycles,
+               "backend": jax.devices()[0].platform}
+        values = {}
+        for name, kw in configs:
+            t0 = time.perf_counter()
+            cps, graph = bench_mod.bench_scale(
+                n_vars=n_vars, cycles=cycles, **kw)
+            out[f"{name}_ms_per_cycle"] = (
+                round(1e3 / cps, 4) if cps else None)
+            out[f"{name}_total_s"] = round(time.perf_counter() - t0, 1)
+            # Re-derive the selected assignment for the agreement
+            # column (one extra run; cheap next to the timed legs).
+            if name in ("edge_scatter", "lane"):
+                from functools import partial
+
+                from pydcop_tpu.ops import maxsum as ops
+                from pydcop_tpu.ops import maxsum_lane as lane_ops
+
+                run = (lane_ops.run_maxsum if name == "lane"
+                       else ops.run_maxsum)
+                _, vals = jax.jit(partial(
+                    run, max_cycles=cycles,
+                    stop_on_convergence=False))(graph)
+                values[name] = np.asarray(jax.device_get(vals))
+            del graph
+        if len(values) == 2:
+            agree = float(np.mean(
+                values["edge_scatter"] == values["lane"]))
+            out["lane_vs_edge_assignment_agreement"] = round(agree, 4)
+        print(json.dumps(out))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

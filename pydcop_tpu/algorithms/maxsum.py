@@ -62,6 +62,12 @@ algo_params = [
     AlgoParameterDef(
         "aggregation", "str", ["scatter", "sorted"], "scatter"
     ),
+    # Message-array layout (device path).  "edge" keeps messages as
+    # [F, arity, D] (domain minor); "lane" transposes to [D, arity, F]
+    # — factors on the TPU lane axis — the HBM-regime candidate
+    # measured by benchmarks/exp_layout.py (see ops/maxsum_lane.py).
+    # Single-device and scatter-aggregation only.
+    AlgoParameterDef("layout", "str", ["edge", "lane"], "edge"),
 ]
 
 
@@ -103,6 +109,7 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
         damping_nodes=params.get("damping_nodes", "both"),
         stability=params.get("stability", STABILITY_COEFF),
         mesh=mesh, n_devices=n_devices,
+        layout=params.get("layout", "edge"),
     )
 
 

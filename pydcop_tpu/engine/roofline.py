@@ -135,6 +135,14 @@ def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
     ``hbm_util`` is None and ``vmem_resident`` is True — claiming 400%
     "HBM utilization" on a VMEM-resident problem would be nonsense.
     """
+    if type(graph).__name__ == "LaneGraph":
+        # The counters below unpack edge-major shapes positionally; a
+        # lane-major graph has every axis transposed and would count
+        # garbage silently (a=F in the table term, ~1e6x off).
+        raise TypeError(
+            "roofline_report requires the edge-major "
+            "CompiledFactorGraph; convert before accounting "
+            "(ops/maxsum_lane.LaneGraph shapes are transposed)")
     flops = maxsum_superstep_flops(graph)
     bytes_moved = maxsum_superstep_bytes(graph)
     ws = working_set_bytes(graph)

@@ -23,6 +23,7 @@ from pydcop_tpu.engine.compile import (
 )
 from pydcop_tpu.engine.sharding import make_mesh, shard_graph
 from pydcop_tpu.ops import maxsum as maxsum_ops
+from pydcop_tpu.ops import maxsum_lane as lane_ops
 
 
 @dataclass
@@ -152,9 +153,32 @@ class MaxSumEngine:
     def __init__(self, graph: CompiledFactorGraph, meta: FactorGraphMeta,
                  damping: float = 0.5, damping_nodes: str = "both",
                  stability: float = 0.1,
-                 mesh=None, n_devices: Optional[int] = None):
+                 mesh=None, n_devices: Optional[int] = None,
+                 layout: str = "edge"):
+        if layout not in ("edge", "lane"):
+            raise ValueError(
+                f"layout must be 'edge' or 'lane', got {layout!r}")
         self.meta = meta
-        self.graph, self.mesh = _place_graph(graph, mesh, n_devices)
+        self.layout = layout
+        if layout == "lane":
+            # Lane-major ([D, arity, F], factors on the TPU lane axis
+            # — see ops/maxsum_lane.py).  Single-device: shard_graph's
+            # row sharding and the sort-based aggregations are
+            # edge-major concepts.
+            if (mesh is not None and mesh.size > 1) or (
+                    n_devices is not None and n_devices > 1):
+                raise ValueError(
+                    "layout='lane' is single-device; use the default "
+                    "edge layout for mesh runs")
+            if graph.agg_perm is not None:
+                raise ValueError(
+                    "layout='lane' uses its own scatter aggregation; "
+                    "compile with aggregation='scatter'")
+            self.graph = jax.device_put(lane_ops.to_lane_graph(graph))
+            self.mesh = None
+        else:
+            self.graph, self.mesh = _place_graph(graph, mesh, n_devices)
+        self._ops = lane_ops if layout == "lane" else maxsum_ops
         self.damping = damping
         self.damp_vars = damping_nodes in ("vars", "both")
         self.damp_factors = damping_nodes in ("factors", "both")
@@ -172,7 +196,7 @@ class MaxSumEngine:
         if key not in self._jitted:
             self._jitted[key] = jax.jit(
                 partial(
-                    maxsum_ops.run_maxsum,
+                    self._ops.run_maxsum,
                     max_cycles=max_cycles,
                     damping=self.damping,
                     damp_vars=self.damp_vars,
@@ -193,7 +217,7 @@ class MaxSumEngine:
             base = self.meta.var_base_costs
             self._jitted[key] = jax.jit(
                 partial(
-                    maxsum_ops.run_maxsum_trace,
+                    self._ops.run_maxsum_trace,
                     max_cycles=max_cycles,
                     damping=self.damping,
                     damp_vars=self.damp_vars,
@@ -243,6 +267,10 @@ class MaxSumEngine:
         """
         from jax.sharding import NamedSharding, PartitionSpec
 
+        if self.layout != "edge":
+            raise ValueError(
+                "decimation clamps rows of the edge-major var_costs "
+                "table; run with layout='edge'")
         n_vars = len(self.meta.var_names)
         dmax = self.graph.var_costs.shape[1]
         var_costs = np.asarray(self.graph.var_costs).copy()

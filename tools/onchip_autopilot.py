@@ -239,6 +239,12 @@ def main():
                 if not probe(args.probe_timeout):
                     log_event("tunnel_lost_mid_queue", after=name)
                     break
+            if not pending_steps(state):
+                # Whole queue retired: loop straight back so the
+                # completion branch logs autopilot_complete now, not
+                # after an interval sleep (and not as a mislabelled
+                # deadline under --once).
+                continue
         if args.once:
             break
         remaining = deadline - time.time()
