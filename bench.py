@@ -292,7 +292,7 @@ def _artifact_keys(platform, out):
 
 def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
                 cycles: int = SCALE_CYCLES, aggregation: str = "scatter",
-                layout: str = "edge"):
+                layout: str = "edge", return_values: bool = False):
     """HBM-bound scale leg: a synthetic 1M-variable / 1.5M-factor
     3-coloring whose ~190 MB working set cannot stay VMEM-resident, so
     the measured rate reflects real HBM streaming (the 10k north-star
@@ -307,11 +307,13 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     (ops/maxsum_lane.py; scatter aggregation only) — the layout A/B is
     benchmarks/exp_layout.py.
 
-    Returns (cycles/s, graph).  With the default edge layout the graph
-    feeds roofline accounting; a lane graph does NOT (the roofline
-    counters unpack edge-major shapes positionally and would count
-    garbage — they reject LaneGraph) and is returned for value-parity
-    runs only (exp_layout).
+    Returns (cycles/s, graph), or (cycles/s, graph, values) with
+    ``return_values=True`` (the timed run's selected assignment as
+    numpy — exp_layout's agreement column, free because the timed run
+    computes it anyway).  With the default edge layout the graph feeds
+    roofline accounting; a lane graph does NOT (the roofline counters
+    unpack edge-major shapes positionally and would count garbage —
+    they reject LaneGraph) and is returned for value-parity runs only.
     """
     from functools import partial
 
@@ -368,9 +370,12 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
                          stop_on_convergence=False))
     jax.block_until_ready(fn(graph))           # compile + warm
     t0 = time.perf_counter()
-    state, _values = jax.block_until_ready(fn(graph))
+    state, values = jax.block_until_ready(fn(graph))
     elapsed = time.perf_counter() - t0
-    return int(state.cycle) / elapsed, graph
+    cps = int(state.cycle) / elapsed
+    if return_values:
+        return cps, graph, np.asarray(jax.device_get(values))
+    return cps, graph
 
 
 def run_bench():

@@ -135,7 +135,9 @@ def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
     ``hbm_util`` is None and ``vmem_resident`` is True — claiming 400%
     "HBM utilization" on a VMEM-resident problem would be nonsense.
     """
-    if type(graph).__name__ == "LaneGraph":
+    from pydcop_tpu.ops.maxsum_lane import LaneGraph
+
+    if isinstance(graph, LaneGraph):
         # The counters below unpack edge-major shapes positionally; a
         # lane-major graph has every axis transposed and would count
         # garbage silently (a=F in the table term, ~1e6x off).

