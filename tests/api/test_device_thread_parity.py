@@ -24,13 +24,23 @@ from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
 from pydcop_tpu.dcop.relations import NAryMatrixRelation
 from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
 
-FIXTURE = "/root/reference/tests/instances/graph_coloring1.yaml"
+from fixtures_paths import local
+
+FIXTURE = local("coloring_chain.yaml")
 LOCAL_SEARCH = ["dsa", "mgm", "mgm2", "dba", "gdba", "mixeddsa"]
 
-# Optimal is -0.1; the 1-opt local optimum is 0.1.  Both runtimes must
-# land on one of the two (i.e. color the 3-chain feasibly).
-def _acceptable(cost: float) -> bool:
-    return cost == pytest.approx(-0.1) or cost == pytest.approx(0.1)
+
+# Both runtimes must color the 4-chain properly; costs then span
+# [-0.6, 0.6] depending on which preference-tie the run lands on (the
+# device kernels fold unary preferences in, agent mode is unary-blind
+# like the reference, so only feasibility is runtime-invariant).
+def _acceptable(res) -> bool:
+    a = res["assignment"]
+    proper = all(
+        a[left] != a[right]
+        for left, right in [("w1", "w2"), ("w2", "w3"), ("w3", "w4")]
+    )
+    return proper and -0.6 - 1e-6 <= res["cost"] <= 0.6 + 1e-6
 
 
 def _random_coloring(n_vars: int, n_colors: int, seed: int,
@@ -78,10 +88,10 @@ def _pack_distribution(dcop, algo):
 def test_device_and_thread_both_feasible_on_fixture(algo):
     d1 = load_dcop_from_file(FIXTURE)
     r_dev = solve(d1, algo, backend="device", max_cycles=100)
-    assert _acceptable(r_dev["cost"]), f"device {algo}: {r_dev['cost']}"
+    assert _acceptable(r_dev), f"device {algo}: {r_dev['cost']}"
     d2 = load_dcop_from_file(FIXTURE)
     r_thr = solve(d2, algo, backend="thread", timeout=4)
-    assert _acceptable(r_thr["cost"]), f"thread {algo}: {r_thr['cost']}"
+    assert _acceptable(r_thr), f"thread {algo}: {r_thr['cost']}"
 
 
 def _hard_csp(n_vars=8, seed=0):

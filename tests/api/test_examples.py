@@ -10,10 +10,17 @@ import pytest
 
 EXAMPLES = sorted(glob.glob(os.path.join(
     os.path.dirname(__file__), "..", "..", "examples", "*.py")))
+REPO_ROOT = os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", ".."))
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    # Examples import pydcop_tpu; as subprocess scripts their sys.path
+    # gets examples/, not the repo root, so inject it explicitly.
+    "PYTHONPATH": REPO_ROOT + (
+        os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else ""),
 }
 
 

@@ -1,29 +1,48 @@
-"""Golden-parity: device-engine solves vs brute-force optimum on the
-reference's own fixture files.
+"""Golden-parity: device-engine solves vs brute-force optimum.
 
 This is the CPU-vs-TPU / framework-vs-reference equivalence layer the
 survey calls for (SURVEY.md §4): identical problems, identical optimal
 costs.  Exact algorithms (dpop, syncbb) must hit the brute-force
 optimum on every tractable fixture; approximate ones (maxsum) must
 match it on the small fixtures they are documented to solve.
+
+Two tiers: the committed local instances under ``tests/instances``
+always run (the suite is self-contained), and when the reference
+checkout is mounted the same batteries re-run on the reference's own
+fixture files verbatim as the parity tier.
 """
 
-import glob
+import functools
 import itertools
 import os
 
 import pytest
 
+from fixtures_paths import (
+    HAVE_REFERENCE,
+    REF_INSTANCES,
+    local,
+    local_instances,
+    ref_instances,
+)
 from pydcop_tpu.api import solve
 from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
 
-REF_INSTANCES = "/root/reference/tests/instances"
 MAX_BRUTE_FORCE = 50_000
 
 
 def _fixtures():
-    for path in sorted(glob.glob(os.path.join(REF_INSTANCES, "*.y*ml"))):
-        yield path
+    yield from local_instances()
+    yield from ref_instances()
+
+
+@functools.lru_cache(maxsize=None)
+def _brute_force_cost_for(path):
+    """Optimal cost of a fixture file by enumeration, cached per path:
+    collection builds TRACTABLE/INTRACTABLE from every fixture and each
+    test needs the same value again — without the cache every pytest
+    invocation enumerates each fixture's joint space twice."""
+    return _brute_force_cost(load_dcop_from_file([path]))
 
 
 def _brute_force_cost(dcop):
@@ -51,7 +70,7 @@ def _brute_force_cost(dcop):
 
 TRACTABLE = [
     p for p in _fixtures()
-    if _brute_force_cost(load_dcop_from_file([p])) is not None
+    if _brute_force_cost_for(p) is not None
 ]
 
 
@@ -60,7 +79,7 @@ TRACTABLE = [
 )
 def test_dpop_matches_brute_force(path):
     dcop = load_dcop_from_file([path])
-    expected = _brute_force_cost(dcop)
+    expected = _brute_force_cost_for(path)
     res = solve(dcop, "dpop")
     assert res["cost"] == pytest.approx(expected, abs=1e-5), path
 
@@ -72,7 +91,7 @@ def test_syncbb_matches_brute_force(path):
     dcop = load_dcop_from_file([path])
     if dcop.objective == "max":
         pytest.skip("syncbb is a minimizer (reference parity)")
-    expected = _brute_force_cost(dcop)
+    expected = _brute_force_cost_for(path)
     res = solve(dcop, "syncbb")
     assert res["cost"] == pytest.approx(expected, abs=1e-5), path
 
@@ -90,7 +109,7 @@ def test_agent_ncbb_matches_brute_force(path):
     )
 
     dcop = load_dcop_from_file([path])
-    expected = _brute_force_cost(dcop)
+    expected = _brute_force_cost_for(path)
     try:
         res = solve(dcop, "ncbb", backend="thread",
                     distribution="adhoc", timeout=30)
@@ -133,14 +152,29 @@ def test_agent_ncbb_chain_scales_by_separator_width():
 
 
 @pytest.mark.parametrize("fixture,expected", [
+    ("coloring_chain.yaml", -0.6),
+    ("coloring_chain_func.yaml", -0.6),
+    ("coloring_chain_init.yaml", -0.6),
+    ("coloring_ext_costs.yaml", -0.6),
+    ("pref_ring.yaml", 14.0),
+])
+def test_maxsum_reaches_optimum(fixture, expected):
+    """Small colorings where maxsum reliably reaches the brute-force
+    optimum (expected values verified by enumeration)."""
+    dcop = load_dcop_from_file([local(fixture)])
+    res = solve(dcop, "maxsum", max_cycles=200)
+    assert res["cost"] == pytest.approx(expected, abs=1e-5)
+
+
+@pytest.mark.skipif(not HAVE_REFERENCE, reason="reference not mounted")
+@pytest.mark.parametrize("fixture,expected", [
     ("graph_coloring1.yaml", -0.1),
     ("graph_coloring1_func.yaml", -0.1),
     ("graph_coloring_eq.yaml", -0.3),
     ("graph_coloring_tuto.yaml", 12.0),
 ])
-def test_maxsum_reaches_optimum(fixture, expected):
-    """Small colorings where maxsum reliably reaches the brute-force
-    optimum (expected values verified by enumeration)."""
+def test_maxsum_reaches_optimum_reference(fixture, expected):
+    """Parity tier: same battery on the reference's own fixtures."""
     dcop = load_dcop_from_file(
         [os.path.join(REF_INSTANCES, fixture)]
     )
@@ -149,10 +183,8 @@ def test_maxsum_reaches_optimum(fixture, expected):
 
 
 def test_secp_fixture_solves():
-    dcop = load_dcop_from_file(
-        [os.path.join(REF_INSTANCES, "secp_simple1.yaml")]
-    )
-    expected = _brute_force_cost(dcop)
+    dcop = load_dcop_from_file([local("secp_lamps.yaml")])
+    expected = _brute_force_cost_for(local("secp_lamps.yaml"))
     res = solve(dcop, "dpop")
     assert res["cost"] == pytest.approx(expected, abs=1e-5)
 
@@ -180,13 +212,17 @@ def test_exact_algorithms_agree_on_large_fixtures(path):
     dcop = load_dcop_from_file([path])
     oracle = solve(load_dcop_from_file([path]), "dpop")
     assert oracle["status"] == "FINISHED"
-    # syncbb's B&B bounds are too weak for SimpleHouse's real-valued
-    # intentional costs (minutes of search); covered by dpop+ncbb.
-    slow_for_syncbb = os.path.basename(path) == "SimpleHouse.yml"
+    # syncbb's B&B bounds are too weak for the house-scale fixtures'
+    # real-valued intentional costs (minutes of search); covered by
+    # dpop+ncbb.
+    slow_for_syncbb = os.path.basename(path) in (
+        "SimpleHouse.yml", "loft_scene.yml")
     if dcop.objective == "min" and not slow_for_syncbb:
         res = solve(load_dcop_from_file([path]), "syncbb")
         assert res["cost"] == pytest.approx(
             oracle["cost"], abs=1e-5), "syncbb vs dpop"
+    if any(c.arity > 2 for c in dcop.constraints.values()):
+        pytest.skip("ncbb is defined on binary constraint graphs")
     try:
         res = solve(dcop, "ncbb", backend="thread",
                     distribution="adhoc", timeout=30)

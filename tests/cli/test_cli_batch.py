@@ -11,7 +11,7 @@ import sys
 
 import yaml
 
-REF_INSTANCES = "/root/reference/tests/instances"
+from fixtures_paths import LOCAL_INSTANCES as INSTANCES
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",
@@ -32,7 +32,7 @@ def _batch_def(tmp_path):
         "sets": {
             "colorings": {
                 "path": os.path.join(
-                    REF_INSTANCES, "graph_coloring1.yaml"),
+                    INSTANCES, "coloring_chain.yaml"),
                 "iterations": 1,
             },
         },
@@ -115,13 +115,13 @@ def test_consolidate_distribution_cost(tmp_path):
     dist = tmp_path / "dist.yaml"
     dist.write_text(
         "distribution:\n"
-        "  a1: [v1, v2, diff_1_2]\n"
-        "  a2: [v3, diff_2_3]\n"
+        "  b1: [w1, w2, clash_12]\n"
+        "  b2: [w3, w4, clash_23, clash_34]\n"
     )
     res = cli([
         "consolidate", "--distribution_cost", str(dist),
         "--algo", "maxsum",
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
     assert res.returncode == 0, res.stderr
     row = res.stdout.strip().split(",")

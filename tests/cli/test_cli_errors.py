@@ -8,8 +8,8 @@ import sys
 
 import pytest
 
-REF_INSTANCES = "/root/reference/tests/instances"
-FIXTURE = os.path.join(REF_INSTANCES, "graph_coloring1.yaml")
+from fixtures_paths import LOCAL_INSTANCES as INSTANCES
+FIXTURE = os.path.join(INSTANCES, "coloring_chain.yaml")
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",

@@ -10,7 +10,7 @@ import sys
 import pytest
 import yaml
 
-REF_INSTANCES = "/root/reference/tests/instances"
+from fixtures_paths import LOCAL_INSTANCES as INSTANCES
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",
@@ -86,7 +86,7 @@ def test_generate_scenario():
         "--actions_count", "1", "--delay", "2",
         "--initial_delay", "1", "--seed", "0",
         "--dcop_files",
-        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+        os.path.join(INSTANCES, "coloring_4agents_10vars.yaml"),
     ])
     data = yaml.safe_load(out)
     assert "events" in data
@@ -101,12 +101,12 @@ def test_generate_scenario():
 def test_distribute_command_produces_full_distribution(method, tmp_path):
     out = cli([
         "distribute", "-d", method, "-a", "dsa",
-        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+        os.path.join(INSTANCES, "coloring_4agents_10vars.yaml"),
     ])
     data = json.loads(out)
     dist = data["distribution"]
     hosted = sorted(c for comps in dist.values() for c in comps)
-    assert hosted == sorted(f"v{i}" for i in range(10))
+    assert hosted == sorted(f"v{i:03d}" for i in range(10))
     assert "cost" in data
 
 
@@ -114,14 +114,13 @@ def test_distribute_respects_graph_for_maxsum():
     """Factor-graph algo: distribution covers variables AND factors."""
     out = cli([
         "distribute", "-d", "adhoc", "-a", "maxsum",
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
     data = json.loads(out)
     hosted = sorted(
         c for comps in data["distribution"].values() for c in comps)
-    assert "v1" in hosted
-    assert any(h.startswith("c") or h.startswith("pref") or "diff" in h
-               for h in hosted if h not in ("v1", "v2", "v3"))
+    assert "w1" in hosted
+    assert any(h.startswith("clash") for h in hosted)
 
 
 def test_solve_writes_run_metrics_csv(tmp_path):
@@ -131,7 +130,7 @@ def test_solve_writes_run_metrics_csv(tmp_path):
         "--collect_on", "cycle_change",
         "--run_metrics", str(metrics),
         "--algo_params", "stop_cycle:20",
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
     result = json.loads(out)
     assert result["status"] in ("FINISHED", "TIMEOUT")
@@ -149,7 +148,7 @@ def test_device_solve_writes_cycle_metrics(tmp_path):
         "--cycles", "40",
         "--collect_on", "cycle_change",
         "--run_metrics", str(metrics),
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
     result = json.loads(out)
     assert result["backend"] == "device"

@@ -6,8 +6,8 @@ import os
 import subprocess
 import sys
 
-REF_INSTANCES = "/root/reference/tests/instances"
-FIXTURE = os.path.join(REF_INSTANCES, "graph_coloring1.yaml")
+from fixtures_paths import LOCAL_INSTANCES as INSTANCES
+FIXTURE = os.path.join(INSTANCES, "coloring_chain.yaml")
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",
@@ -29,15 +29,15 @@ def run_json(args, timeout=120):
 class TestGraph:
     def test_graph_by_model(self):
         res = run_json(["graph", "-g", "factor_graph", FIXTURE])
-        # 3 vars + 2 factors (graph_coloring1: c1(v1,v2), c2(v2,v3))
-        assert res["nodes"] == 5
-        assert res["edges"] == 4
+        # 4 vars + 3 factors (coloring_chain: clash_12/23/34)
+        assert res["nodes"] == 7
+        assert res["edges"] == 6
         assert res["density"] > 0
 
     def test_graph_model_from_algo(self):
         res = run_json(["graph", "-a", "dsa", FIXTURE])
         assert res["graph"] == "constraints_hypergraph"
-        assert res["nodes"] == 3
+        assert res["nodes"] == 4
 
     def test_graph_requires_model_or_algo(self):
         proc = subprocess.run(
@@ -52,11 +52,11 @@ class TestGraph:
     def test_graph_degree_and_cycles(self):
         res = run_json(["graph", "-g", "constraints_hypergraph",
                         FIXTURE])
-        # v1-v2-v3 chain: no cycles, max degree 2, diameter 2
+        # w1-w2-w3-w4 chain: no cycles, max degree 2, diameter 3
         assert res["cycles"] == 0
         assert res["max_degree"] == 2
         assert res["min_degree"] == 1
-        assert res["component_diameters"] == [2]
+        assert res["component_diameters"] == [3]
 
 
 class TestConsolidate:

@@ -9,8 +9,7 @@ import os
 import subprocess
 import sys
 
-REF_INSTANCES = "/root/reference/tests/instances"
-INSTANCES = os.path.join(os.path.dirname(__file__), "..", "instances")
+from fixtures_paths import LOCAL_INSTANCES as INSTANCES
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",
@@ -30,8 +29,8 @@ def test_replica_dist_places_replicas():
     out = subprocess.check_output(
         [sys.executable, "-m", "pydcop_tpu.dcop_cli",
          "replica_dist", "-a", "dsa", "-d", "adhoc", "-k", "2",
-         os.path.join(REF_INSTANCES,
-                      "graph_coloring_4agts_10vars.yaml")],
+         os.path.join(INSTANCES,
+                      "coloring_4agents_10vars.yaml")],
         timeout=120, env=ENV,
     ).decode()
     assert "replica_dist:" in out
@@ -50,7 +49,7 @@ def test_run_with_scenario_repairs():
         "-t", "12",
         "run", "-a", "dsa", "-d", "adhoc", "-k", "2",
         "-s", os.path.join(INSTANCES, "scenario_remove_a1.yaml"),
-        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+        os.path.join(INSTANCES, "coloring_4agents_10vars.yaml"),
     ], timeout=180)
     assert result["status"] in ("FINISHED", "TIMEOUT")
     # All 10 variables still have a value despite a1's departure.
@@ -71,7 +70,7 @@ def test_run_device_mode_scenario():
         "run", "-a", "maxsum", "-d", "adhoc", "-k", "2",
         "-m", "device", "-c", "500",
         "-s", os.path.join(INSTANCES, "scenario_remove_a1.yaml"),
-        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+        os.path.join(INSTANCES, "coloring_4agents_10vars.yaml"),
     ], timeout=240)
     assert result["backend"] == "device"
     assert len(result["assignment"]) == 10
@@ -97,7 +96,7 @@ def test_run_process_mode_scenario_repairs():
         "-t", "12",
         "run", "-a", "dsa", "-d", "adhoc", "-m", "process", "-k", "2",
         "-s", os.path.join(INSTANCES, "scenario_remove_a1.yaml"),
-        os.path.join(REF_INSTANCES, "graph_coloring_4agts_10vars.yaml"),
+        os.path.join(INSTANCES, "coloring_4agents_10vars.yaml"),
     ], timeout=180)
     assert result["backend"] == "process"
     assert len(result["assignment"]) == 10

@@ -8,7 +8,9 @@ import os
 import subprocess
 import sys
 
-REF_INSTANCES = "/root/reference/tests/instances"
+import pytest
+
+from fixtures_paths import LOCAL_INSTANCES as INSTANCES
 ENV = {
     **os.environ,
     "JAX_PLATFORMS": "cpu",
@@ -27,12 +29,12 @@ def run_cli(args, timeout=120):
 def test_solve_maxsum_graph_coloring():
     result = run_cli([
         "solve", "--algo", "maxsum",
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
     assert result["status"] in ("FINISHED", "TIMEOUT")
     assert result["violation"] == 0
-    assert result["cost"] == -0.1
-    assert set(result["assignment"]) == {"v1", "v2", "v3"}
+    assert result["cost"] == pytest.approx(-0.6)
+    assert set(result["assignment"]) == {"w1", "w2", "w3", "w4"}
 
 
 def test_solve_with_algo_params():
@@ -40,9 +42,9 @@ def test_solve_with_algo_params():
         "solve", "--algo", "maxsum",
         "--algo_params", "damping:0.7",
         "--algo_params", "stability:0.01",
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
-    assert result["cost"] == -0.1
+    assert result["cost"] == pytest.approx(-0.6)
 
 
 def test_solve_bad_algo_param_fails():
@@ -50,7 +52,7 @@ def test_solve_bad_algo_param_fails():
         code = subprocess.call(
             [sys.executable, "-m", "pydcop_tpu.dcop_cli",
              "solve", "--algo", "maxsum", "--algo_params", "bogus:1",
-             os.path.join(REF_INSTANCES, "graph_coloring1.yaml")],
+             os.path.join(INSTANCES, "coloring_chain.yaml")],
             stdout=devnull, stderr=devnull, timeout=60, env=ENV,
         )
     assert code != 0
@@ -59,10 +61,10 @@ def test_solve_bad_algo_param_fails():
 def test_graph_command():
     result = run_cli([
         "graph", "--graph", "factor_graph",
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
-    assert result["nodes"] == 5  # 3 vars + 2 constraints
-    assert result["edges"] == 4
+    assert result["nodes"] == 7  # 4 vars + 3 constraints
+    assert result["edges"] == 6
 
 
 def test_solve_device_profile_writes_trace(tmp_path):
@@ -72,9 +74,9 @@ def test_solve_device_profile_writes_trace(tmp_path):
     result = run_cli([
         "solve", "--algo", "maxsum", "-c", "50",
         "--profile", str(prof),
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
-    assert result["cost"] == -0.1
+    assert result["cost"] == pytest.approx(-0.6)
     dumps = list((prof / "plugins" / "profile").iterdir())
     assert len(dumps) == 1
 
@@ -85,12 +87,12 @@ def test_solve_delay_throttles_messages():
     slow = run_cli([
         "-t", "2", "solve", "--algo", "maxsum", "-m", "thread",
         "-d", "adhoc", "--delay", "0.1",
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
     fast = run_cli([
         "-t", "2", "solve", "--algo", "maxsum", "-m", "thread",
         "-d", "adhoc",
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"),
+        os.path.join(INSTANCES, "coloring_chain.yaml"),
     ])
     # 0.1 s per message bounds the delayed run to a handful of cycles;
     # the undelayed run does hundreds even on a loaded machine.  Avoid

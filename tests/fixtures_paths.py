@@ -1,0 +1,47 @@
+"""Shared fixture-path helpers for the test suite.
+
+The suite is self-contained: every battery runs against the original
+instances committed under ``tests/instances/``.  When the reference
+checkout is mounted at ``/root/reference`` an additional parity tier
+re-runs the loader/golden batteries against the reference's own
+fixture files verbatim; those tests skip cleanly anywhere the
+reference isn't available.
+"""
+
+import glob
+import os
+
+import pytest
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+LOCAL_INSTANCES = os.path.join(TESTS_DIR, "instances")
+# Override point so self-containment is testable without unmounting
+# the checkout: PYDCOP_TPU_REF_INSTANCES=/nonexistent pytest tests/
+REF_INSTANCES = os.environ.get(
+    "PYDCOP_TPU_REF_INSTANCES", "/root/reference/tests/instances")
+HAVE_REFERENCE = os.path.isdir(REF_INSTANCES)
+
+requires_reference = pytest.mark.skipif(
+    not HAVE_REFERENCE,
+    reason="reference checkout not mounted at /root/reference",
+)
+
+
+def local(name):
+    """Absolute path of a committed local instance file."""
+    return os.path.join(LOCAL_INSTANCES, name)
+
+
+def local_instances():
+    """All committed local DCOP instance files (yaml + yml)."""
+    return sorted(
+        p for p in glob.glob(os.path.join(LOCAL_INSTANCES, "*.y*ml"))
+        if not os.path.basename(p).startswith("scenario")
+    )
+
+
+def ref_instances():
+    """Reference fixture files, [] when the checkout isn't mounted."""
+    if not HAVE_REFERENCE:
+        return []
+    return sorted(glob.glob(os.path.join(REF_INSTANCES, "*.y*ml")))

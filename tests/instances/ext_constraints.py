@@ -1,0 +1,9 @@
+"""Constraint functions loaded from an external source file by
+``coloring_chain_func.yaml`` (the local analogue of the reference's
+external-python-constraint feature, reference
+tests/instances/graph_coloring1_func.yaml)."""
+
+
+def clash(x, y):
+    """Penalty-3 difference constraint between two hue variables."""
+    return 3 if x == y else 0

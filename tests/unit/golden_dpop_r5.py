@@ -1,0 +1,191 @@
+"""Frozen round-5 copy of the DPOP level-batched UTIL/VALUE sweep
+(pydcop_tpu/ops/dpop.py).
+
+Executable perf/semantics baseline for ``test_perf_regression.py``:
+the live sweep is raced against this copy IN THE SAME PROCESS (ratio
+immune to machine load) and must produce its exact assignment.
+
+Do NOT update this file when optimizing the live sweep unless the
+regression test's parity assertion demands it.
+"""
+
+from collections import defaultdict
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+MAX_NODE_ELEMENTS = 2 ** 26
+
+
+class GoldenUtilTooLargeError(MemoryError):
+    pass
+
+
+class _NodePlan:
+    __slots__ = (
+        "name", "dims", "shape", "components", "parent", "depth",
+    )
+
+    def __init__(self, name, dims, shape, parent, depth):
+        self.name = name
+        self.dims = dims
+        self.shape = shape
+        self.parent = parent
+        self.depth = depth
+        self.components: Dict[Tuple[int, ...], np.ndarray] = {}
+
+    def add_component(self, axes, array):
+        if axes in self.components:
+            self.components[axes] = self.components[axes] + array
+        else:
+            self.components[axes] = array
+
+
+def _transpose_to_axes(array, positions):
+    order = sorted(range(len(positions)), key=lambda i: positions[i])
+    axes = tuple(positions[i] for i in order)
+    return axes, np.ascontiguousarray(np.transpose(array, order))
+
+
+def compile_tree(graph, mode):
+    from pydcop_tpu.computations_graph.pseudotree import node_depths
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    nodes = {n.name: n for n in graph.nodes}
+    depth = node_depths(graph)
+
+    sep: Dict[str, set] = {}
+    for name in sorted(nodes, key=lambda n: -depth[n]):
+        node = nodes[name]
+        s = set()
+        for c in node.constraints:
+            s.update(v.name for v in c.dimensions)
+        for child in node.children:
+            s.update(sep[child])
+        s.discard(name)
+        sep[name] = s
+
+    plans: Dict[str, _NodePlan] = {}
+    for name, node in nodes.items():
+        var = node.variable
+        sep_sorted = sorted(sep[name], key=lambda v: (depth[v], v))
+        dims = (name,) + tuple(sep_sorted)
+        domain_of = {name: len(var.domain)}
+        for c in node.constraints:
+            for v in c.dimensions:
+                domain_of[v.name] = len(v.domain)
+        for child in node.children:
+            domain_of[nodes[child].variable.name] = \
+                len(nodes[child].variable.domain)
+        shape = tuple(
+            domain_of.get(d) or len(nodes[d].variable.domain)
+            for d in dims
+        )
+        n_elements = int(np.prod(shape, dtype=np.int64))
+        if n_elements > MAX_NODE_ELEMENTS:
+            raise GoldenUtilTooLargeError(name)
+        plan = _NodePlan(name, dims, shape, node.parent, depth[name])
+        pos = {d: i for i, d in enumerate(dims)}
+        plan.add_component(
+            (0,), np.asarray(var.cost_vector(), dtype=np.float32)
+        )
+        for c in node.constraints:
+            dense = NAryMatrixRelation.from_func_relation(c)
+            positions = [pos[v.name] for v in dense.dimensions]
+            axes, arr = _transpose_to_axes(
+                np.asarray(dense.matrix, dtype=np.float32), positions
+            )
+            plan.add_component(axes, arr)
+        plans[name] = plan
+    return plans
+
+
+_KERNEL_CACHE: Dict[Tuple, Any] = {}
+
+
+def _kernel_for(signature):
+    if signature in _KERNEL_CACHE:
+        return _KERNEL_CACHE[signature]
+    if len(_KERNEL_CACHE) >= 512:
+        _KERNEL_CACHE.clear()
+    import jax
+    import jax.numpy as jnp
+
+    shape, axes_tuples, mode, want_util = signature
+    k = len(shape)
+
+    def kernel(*comps):
+        n = comps[0].shape[0]
+        acc = jnp.zeros((n,) + shape, dtype=jnp.float32)
+        for comp, axes in zip(comps, axes_tuples):
+            newshape = (n,) + tuple(
+                shape[i] if i in axes else 1 for i in range(k)
+            )
+            acc = acc + comp.reshape(newshape)
+        if not want_util:
+            return acc, None
+        util = (
+            jnp.min(acc, axis=1) if mode == "min"
+            else jnp.max(acc, axis=1)
+        )
+        return acc, util
+
+    _KERNEL_CACHE[signature] = jax.jit(kernel)
+    return _KERNEL_CACHE[signature]
+
+
+def solve_sweep(graph, mode="min"):
+    plans = compile_tree(graph, mode)
+    nodes = {n.name: n for n in graph.nodes}
+    by_level: Dict[int, List[str]] = defaultdict(list)
+    for name, plan in plans.items():
+        by_level[plan.depth].append(name)
+    max_depth = max(by_level) if by_level else 0
+
+    joined: Dict[str, np.ndarray] = {}
+    for level in range(max_depth, -1, -1):
+        buckets: Dict[Tuple, List[str]] = defaultdict(list)
+        for name in by_level[level]:
+            plan = plans[name]
+            axes_tuples = tuple(sorted(plan.components))
+            want_util = plan.parent is not None
+            key = (plan.shape, axes_tuples, mode, want_util)
+            buckets[key].append(name)
+        for key, names in sorted(buckets.items()):
+            shape, axes_tuples, _, want_util = key
+            stacked = [
+                np.stack(
+                    [plans[n].components[axes] for n in names]
+                )
+                for axes in axes_tuples
+            ]
+            acc, util = _kernel_for(key)(*stacked)
+            acc_np = np.asarray(acc)
+            util_np = None if util is None else np.asarray(util)
+            for i, name in enumerate(names):
+                plan = plans[name]
+                joined[name] = acc_np[i]
+                if want_util:
+                    parent_plan = plans[plan.parent]
+                    ppos = {
+                        d: j for j, d in enumerate(parent_plan.dims)
+                    }
+                    positions = [ppos[d] for d in plan.dims[1:]]
+                    axes, arr = _transpose_to_axes(
+                        util_np[i], positions
+                    )
+                    parent_plan.add_component(axes, arr)
+
+    assignment: Dict[str, Any] = {}
+    argopt = np.argmin if mode == "min" else np.argmax
+    for level in range(0, max_depth + 1):
+        for name in sorted(by_level[level]):
+            plan = plans[name]
+            var = nodes[name].variable
+            idx = tuple(
+                nodes[d].variable.domain.index(assignment[d])
+                for d in plan.dims[1:]
+            )
+            vec = joined[name][(slice(None),) + idx]
+            assignment[name] = var.domain[int(argopt(vec))]
+    return assignment

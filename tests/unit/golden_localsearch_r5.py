@@ -1,0 +1,276 @@
+"""Frozen round-5 copy of the DSA and MGM device kernels (plus the
+localsearch helpers they use).
+
+Executable perf/semantics baseline for ``test_perf_regression.py``,
+same pattern as ``golden_maxsum_kernel.py``: the live kernels
+(pydcop_tpu/ops/dsa.py, ops/mgm.py, ops/localsearch.py) are raced
+against this copy IN THE SAME PROCESS, so the ratio is immune to
+machine-load drift, and must reproduce its exact seeded trajectory.
+
+Do NOT update this file when optimizing the live kernels unless the
+regression test's parity assertion demands it: it exists to stay
+behind.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pydcop_tpu.engine.compile import CompiledFactorGraph
+
+
+# ---- frozen localsearch helpers -------------------------------------- #
+
+
+def _fix_other_axes(costs, var_ids, values, keep):
+    arity = var_ids.shape[1]
+    out = costs
+    for q in range(arity - 1, -1, -1):
+        if q == keep:
+            continue
+        vq = values[var_ids[:, q]]
+        idx = vq.reshape((-1,) + (1,) * (out.ndim - 1))
+        out = jnp.squeeze(
+            jnp.take_along_axis(out, idx, axis=q + 1), axis=q + 1
+        )
+    return out
+
+
+def candidate_costs(graph, values):
+    cand = graph.var_costs
+    n_segments = graph.var_costs.shape[0]
+    for bucket in graph.buckets:
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            fixed = _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
+            cand = cand + jax.ops.segment_sum(
+                fixed, bucket.var_ids[:, p], num_segments=n_segments
+            )
+    return cand
+
+
+def factor_current_costs(graph, values):
+    out = []
+    for bucket in graph.buckets:
+        fixed = _fix_other_axes(bucket.costs, bucket.var_ids, values, 0)
+        v0 = values[bucket.var_ids[:, 0]]
+        out.append(jnp.take_along_axis(
+            fixed, v0[:, None], axis=1
+        ).squeeze(1))
+    return tuple(out)
+
+
+def assignment_cost(graph, values):
+    total = jnp.sum(
+        jnp.take_along_axis(
+            graph.var_costs[:-1], values[:-1, None], axis=1
+        )
+    )
+    for costs in factor_current_costs(graph, values):
+        total = total + jnp.sum(costs)
+    return total
+
+
+def neighbor_max(graph, per_var):
+    n_segments = graph.var_costs.shape[0]
+    out = jnp.full((n_segments,), -jnp.inf, dtype=per_var.dtype)
+    for bucket in graph.buckets:
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            for q in range(arity):
+                if p == q:
+                    continue
+                vals_q = per_var[bucket.var_ids[:, q]]
+                out = jnp.maximum(out, jax.ops.segment_max(
+                    vals_q, bucket.var_ids[:, p],
+                    num_segments=n_segments,
+                ))
+    return out
+
+
+def neighbor_min_rank_where(graph, per_var, target, ranks):
+    n_segments = graph.var_costs.shape[0]
+    ranks = jnp.asarray(ranks, dtype=jnp.float32)
+    out = jnp.full((n_segments,), jnp.inf, dtype=jnp.float32)
+    for bucket in graph.buckets:
+        arity = bucket.var_ids.shape[1]
+        for p in range(arity):
+            tgt_p = target[bucket.var_ids[:, p]]
+            for q in range(arity):
+                if p == q:
+                    continue
+                vq = bucket.var_ids[:, q]
+                eligible = per_var[vq] == tgt_p
+                cand_rank = jnp.where(eligible, ranks[vq], jnp.inf)
+                out = jnp.minimum(out, jax.ops.segment_min(
+                    cand_rank, bucket.var_ids[:, p],
+                    num_segments=n_segments,
+                ))
+    return out
+
+
+def neighborhood_winners(graph, cand, values, key, ranks):
+    cur = jnp.take_along_axis(cand, values[:, None], axis=1).squeeze(1)
+    best, is_best = best_candidates(graph, cand)
+    improve = cur - best
+    proposed = random_best_choice(key, is_best)
+    nmax = neighbor_max(graph, improve)
+    nrank = neighbor_min_rank_where(graph, improve, improve, ranks)
+    wins = (improve > nmax) | ((improve == nmax) & (ranks < nrank))
+    return improve, proposed, nmax, wins
+
+
+def best_candidates(graph, cand):
+    masked = jnp.where(graph.var_valid, cand, jnp.inf)
+    best = jnp.min(masked, axis=1)
+    return best, masked == best[:, None]
+
+
+def random_best_choice(key, is_best):
+    u = jax.random.uniform(key, is_best.shape)
+    return jnp.argmax(jnp.where(is_best, u, -1.0), axis=1).astype(jnp.int32)
+
+
+def random_initial_values(key, graph):
+    u = jax.random.uniform(key, graph.var_valid.shape)
+    return jnp.argmax(
+        jnp.where(graph.var_valid, u, -1.0), axis=1
+    ).astype(jnp.int32)
+
+
+# ---- frozen DSA ------------------------------------------------------- #
+
+
+class GoldenDsaState(NamedTuple):
+    values: jnp.ndarray
+    key: jnp.ndarray
+    cycle: jnp.ndarray
+
+
+def dsa_init(graph: CompiledFactorGraph, seed: int = 0) -> GoldenDsaState:
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    return GoldenDsaState(
+        values=random_initial_values(k0, graph),
+        key=key,
+        cycle=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def _factor_optima(graph):
+    return tuple(
+        jnp.min(b.costs, axis=tuple(range(1, b.costs.ndim)))
+        for b in graph.buckets
+    )
+
+
+def violated_vars(graph, values):
+    n_segments = graph.var_costs.shape[0]
+    out = jnp.zeros((n_segments,), dtype=jnp.int32)
+    for bucket, cur, opt in zip(
+        graph.buckets, factor_current_costs(graph, values),
+        _factor_optima(graph),
+    ):
+        viol = (cur != opt).astype(jnp.int32)
+        for p in range(bucket.var_ids.shape[1]):
+            out = jnp.maximum(out, jax.ops.segment_max(
+                viol, bucket.var_ids[:, p], num_segments=n_segments
+            ))
+    return out > 0
+
+
+def dsa_step(state, graph, *, variant, probability):
+    key, k_choice, k_change = jax.random.split(state.key, 3)
+    values = state.values
+
+    cand = candidate_costs(graph, values)
+    cur = jnp.take_along_axis(cand, values[:, None], axis=1).squeeze(1)
+    best, is_best = best_candidates(graph, cand)
+    delta = cur - best
+
+    if variant == "A":
+        eligible = delta > 0
+        choice_mask = is_best
+    else:
+        n_best = jnp.sum(is_best, axis=1)
+        one_hot_cur = (
+            jnp.arange(cand.shape[1])[None, :] == values[:, None]
+        )
+        drop_cur = ((delta == 0) & (n_best > 1))[:, None] & one_hot_cur
+        choice_mask = is_best & ~drop_cur
+        if variant == "B":
+            eligible = (delta > 0) | (
+                (delta == 0) & violated_vars(graph, values)
+            )
+        else:  # C
+            eligible = delta >= 0
+
+    new_vals = random_best_choice(k_choice, choice_mask)
+    u = jax.random.uniform(k_change, (values.shape[0],))
+    change = eligible & (u < probability)
+    values = jnp.where(change, new_vals, values)
+    return GoldenDsaState(values=values, key=key, cycle=state.cycle + 1)
+
+
+def run_dsa(graph, max_cycles, *, variant="B", probability=0.7, seed=0):
+    state = dsa_init(graph, seed)
+    state = jax.lax.fori_loop(
+        0, max_cycles,
+        lambda i, s: dsa_step(
+            s, graph, variant=variant, probability=probability
+        ),
+        state,
+    )
+    cost = assignment_cost(graph, state.values)
+    return state.values[:-1], cost, state.cycle
+
+
+# ---- frozen MGM ------------------------------------------------------- #
+
+
+class GoldenMgmState(NamedTuple):
+    values: jnp.ndarray
+    key: jnp.ndarray
+    cycle: jnp.ndarray
+
+
+def mgm_init(graph: CompiledFactorGraph, seed: int = 0) -> GoldenMgmState:
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    return GoldenMgmState(
+        values=random_initial_values(k0, graph),
+        key=key,
+        cycle=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def mgm_step(state, graph, *, lexic_ranks, break_mode):
+    key, k_choice, k_rand = jax.random.split(state.key, 3)
+    values = state.values
+
+    if break_mode == "random":
+        ranks = jax.random.uniform(k_rand, values.shape)
+    else:
+        ranks = lexic_ranks
+
+    cand = candidate_costs(graph, values)
+    gain, proposed, _, wins = neighborhood_winners(
+        graph, cand, values, k_choice, ranks
+    )
+    new_vals = jnp.where(gain > 0, proposed, values)
+    values = jnp.where(wins, new_vals, values)
+    return GoldenMgmState(values=values, key=key, cycle=state.cycle + 1)
+
+
+def run_mgm(graph, max_cycles, *, lexic_ranks, break_mode="lexic", seed=0):
+    state = mgm_init(graph, seed)
+    state = jax.lax.fori_loop(
+        0, max_cycles,
+        lambda i, s: mgm_step(
+            s, graph, lexic_ranks=lexic_ranks, break_mode=break_mode
+        ),
+        state,
+    )
+    cost = assignment_cost(graph, state.values)
+    return state.values[:-1], cost, state.cycle

@@ -249,6 +249,133 @@ class TestOutboxAndMgt:
         )
         assert comp._paused_messages_post == []
 
+    def test_recv_flush_keeps_entry_on_non_protocol_error(self):
+        """Only protocol violations (ComputationException, e.g. a
+        duplicate cycle message) are dropped by the resume flush.  A
+        reception that fails for any other reason is kept for a later
+        flush — dropping it would permanently stall the sender's cycle
+        barrier (ADVICE r4)."""
+        boom = {"armed": True}
+
+        class FlakyComp(MessagePassingComputation):
+            def __init__(self):
+                super().__init__("flaky")
+                self._msg_sender = MagicMock()
+                self.delivered = []
+
+            @register("ping")
+            def _on_ping(self, sender, msg, t):
+                if boom["armed"]:
+                    raise RuntimeError("transient handler failure")
+                self.delivered.append(msg.n)
+
+        comp = FlakyComp()
+        comp.start()
+        comp.pause()
+        comp.on_message("n1", PingMessage(7), 0)
+        with pytest.raises(RuntimeError, match="transient"):
+            comp.pause(False)
+        # The entry survived the failed flush (unlike a protocol
+        # violation, which test_recv_flush_delivers_past_poisoned_entry
+        # shows is dropped).
+        assert len(comp._paused_messages_recv) == 1
+        # Next pause/resume round delivers it.
+        boom["armed"] = False
+        comp.pause()
+        comp.pause(False)
+        assert comp._paused_messages_recv == []
+        assert comp.delivered == [7]
+
+    def test_recv_flush_retry_is_bounded(self):
+        """A kept entry whose handler fails DETERMINISTICALLY is dropped
+        after MAX_FLUSH_RETRIES failed flushes — it must not poison
+        every future pause/resume round forever (review r5)."""
+
+        class AlwaysBroken(MessagePassingComputation):
+            def __init__(self):
+                super().__init__("broken")
+                self._msg_sender = MagicMock()
+
+            @register("ping")
+            def _on_ping(self, sender, msg, t):
+                raise RuntimeError("deterministic handler bug")
+
+        comp = AlwaysBroken()
+        comp.start()
+        comp.pause()
+        comp.on_message("n1", PingMessage(1), 0)
+        retries = MessagePassingComputation.MAX_FLUSH_RETRIES
+        for i in range(retries):
+            assert len(comp._paused_messages_recv) == 1
+            with pytest.raises(RuntimeError):
+                comp.pause(False)
+            comp.pause()
+        # Dropped after the cap: the next resume is clean.
+        assert comp._paused_messages_recv == []
+        comp.pause(False)
+
+    def test_post_flush_retry_is_unbounded(self):
+        """The retry cap applies only to the RECV path: a post that
+        keeps failing environmentally (no sender attached) must survive
+        arbitrarily many pause/resume rounds — dropping it would lose a
+        message and stall the neighbor's cycle barrier (review r5)."""
+        comp = SyncProbe()
+        comp._msg_sender = None
+        comp.start = lambda: None
+        comp._running = True
+        comp.pause()
+        comp.post_msg("n1", PingMessage(1))
+        rounds = MessagePassingComputation.MAX_FLUSH_RETRIES + 2
+        for _ in range(rounds):
+            with pytest.raises(ComputationException, match="not attached"):
+                comp.pause(False)
+            assert len(comp._paused_messages_post) == 1
+            comp.pause()
+        comp._msg_sender = MagicMock()
+        comp.pause(False)
+        assert comp._paused_messages_post == []
+        assert comp._msg_sender.call_args_list
+
+    def test_retried_recv_emits_message_rcv_once(self):
+        """A kept recv entry that takes several flush attempts to
+        deliver emits computations.message_rcv exactly once (the
+        single-emission invariant; review r5)."""
+        from pydcop_tpu.infrastructure.events import event_bus
+
+        boom = {"armed": True}
+
+        class Flaky(MessagePassingComputation):
+            def __init__(self):
+                super().__init__("flaky_emit")
+                self._msg_sender = MagicMock()
+
+            @register("ping")
+            def _on_ping(self, sender, msg, t):
+                if boom["armed"]:
+                    raise RuntimeError("transient")
+
+        comp = Flaky()
+        comp.start()
+        comp.pause()
+        comp.on_message("n1", PingMessage(1), 0)
+        emitted = []
+        handle = event_bus.subscribe(
+            "computations.message_rcv.flaky_emit",
+            lambda topic, data: emitted.append(data),
+        )
+        enabled = event_bus.enabled
+        event_bus.enabled = True
+        try:
+            with pytest.raises(RuntimeError):
+                comp.pause(False)  # attempt 1: emits, handler fails
+            boom["armed"] = False
+            comp.pause()
+            comp.pause(False)      # attempt 2: delivers, NO re-emit
+        finally:
+            event_bus.unsubscribe(handle)
+            event_bus.enabled = enabled
+        assert len(emitted) == 1
+
     def test_post_flush_keeps_failed_entry_for_retry(self):
         """Posts that fail environmentally (here: no sender attached)
         stay buffered — unlike poisoned receptions they are expected

@@ -1,13 +1,22 @@
-"""YAML loader tests, including round-trips on the reference's fixtures.
+"""YAML loader tests, including round-trips on the committed local
+instances and — when mounted — the reference's own fixture files.
 
-The fixture files under /root/reference/tests/instances are the parity
-oracle: our loader must accept them and produce the same problems.
+The reference fixtures are the parity oracle: our loader must accept
+them and produce the same problems.  Those tests skip cleanly when the
+reference checkout is absent, keeping the suite self-contained.
 """
 
-import glob
 import os
 
 import pytest
+
+from fixtures_paths import (
+    REF_INSTANCES,
+    local,
+    local_instances,
+    ref_instances,
+    requires_reference,
+)
 
 from pydcop_tpu.dcop.objects import VariableNoisyCostFunc, VariableWithCostFunc
 from pydcop_tpu.dcop.yamldcop import (
@@ -19,9 +28,6 @@ from pydcop_tpu.dcop.yamldcop import (
     yaml_dist,
     yaml_scenario,
 )
-
-REF_INSTANCES = "/root/reference/tests/instances"
-
 
 def test_minimal():
     dcop = load_dcop(
@@ -244,19 +250,45 @@ agents: [a1, a2]
 
 
 @pytest.mark.parametrize(
+    "path",
+    local_instances(),
+    ids=[os.path.basename(p) for p in local_instances()],
+)
+def test_load_local_fixture(path):
+    """Every committed local instance must load without error."""
+    dcop = load_dcop_from_file(path)
+    assert dcop.name
+    assert dcop.variables
+
+
+@requires_reference
+@pytest.mark.parametrize(
     "fixture",
-    sorted(
-        os.path.basename(p)
-        for p in glob.glob(os.path.join(REF_INSTANCES, "*.y*ml"))
-    ),
+    sorted(os.path.basename(p) for p in ref_instances()),
 )
 def test_load_reference_fixture(fixture):
-    """Every reference fixture must load without error."""
+    """Parity tier: every reference fixture must load without error."""
     dcop = load_dcop_from_file(os.path.join(REF_INSTANCES, fixture))
     assert dcop.name
     assert dcop.variables
 
 
+def test_local_coloring_semantics():
+    dcop = load_dcop_from_file(local("coloring_chain.yaml"))
+    assert dcop.objective == "min"
+    c = dcop.constraint("clash_12")
+    assert c(w1="B", w2="B") == 3
+    assert c(w1="B", w2="Y") == 0
+    assert dcop.variable("w1").cost_for_val("B") == -0.2
+    cost, violations = dcop.solution_cost(
+        {"w1": "B", "w2": "B", "w3": "P", "w4": "B"})
+    # clash_12 (3) + prefs: -0.2 (w1=B) + 0.1 (w2=B) + 0.0 + -0.2
+    assert abs(cost - 2.7) < 1e-9
+    assert violations == 0
+    assert dcop.dist_hints.must_host("b1") == ["w1"]
+
+
+@requires_reference
 def test_reference_graph_coloring_semantics():
     dcop = load_dcop_from_file(
         os.path.join(REF_INSTANCES, "graph_coloring1.yaml"))
@@ -272,19 +304,18 @@ def test_reference_graph_coloring_semantics():
 
 
 def test_external_python_constraint_fixture():
-    dcop = load_dcop_from_file(
-        os.path.join(REF_INSTANCES, "graph_coloring1_func.yaml"))
-    assert dcop.variables
+    dcop = load_dcop_from_file(local("coloring_chain_func.yaml"))
+    assert dcop.constraint("clash_23")(w2="B", w3="B") == 3
+    assert dcop.constraint("clash_23")(w2="B", w3="Y") == 0
 
 
 def test_roundtrip_through_dump():
-    src = load_dcop_from_file(
-        os.path.join(REF_INSTANCES, "graph_coloring1.yaml"))
+    src = load_dcop_from_file(local("coloring_chain.yaml"))
     dumped = dcop_yaml(src)
     again = load_dcop(dumped)
     assert set(again.variables) == set(src.variables)
     assert set(again.constraints) == set(src.constraints)
-    asst = {"v1": "R", "v2": "G", "v3": "G"}
+    asst = {"w1": "B", "w2": "Y", "w3": "P", "w4": "B"}
     assert again.solution_cost(asst) == src.solution_cost(asst)
 
 
