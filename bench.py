@@ -218,18 +218,25 @@ def _supervise():
 
 
 def _forward_result_line(child_out: str) -> bool:
-    """Print the child's JSON result line if it produced one."""
+    """Print the child's JSON result line if it produced one; every
+    other stdout line (informational prints, extra JSON) is forwarded
+    to stderr so a supervised run loses nothing (ADVICE r4)."""
+    result = None
     for line in (child_out or "").splitlines():
-        line = line.strip()
-        if not line.startswith("{"):
-            continue
-        try:
-            parsed = json.loads(line)
-        except ValueError:
-            continue
-        if "metric" in parsed:
-            print(line)
-            return True
+        stripped = line.strip()
+        if result is None and stripped.startswith("{"):
+            try:
+                parsed = json.loads(stripped)
+            except ValueError:
+                parsed = None
+            if parsed is not None and "metric" in parsed:
+                result = stripped
+                continue
+        if stripped:
+            print(f"bench[child]: {line}", file=sys.stderr)
+    if result is not None:
+        print(result)
+        return True
     return False
 
 

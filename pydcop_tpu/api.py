@@ -46,7 +46,11 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     reference-equivalent semantics.
     warmup=True runs the compiled program once untimed before the timed
     call, so one-shot solves report steady-state rates instead of
-    compile-dominated ones (device backend only).  Host-driven sweep
+    compile-dominated ones (device backend only).  The warm-up run is a
+    FULL discarded solve (the cycle count is baked into the compiled
+    program, so a shorter variant would compile a different
+    executable): expect ~2x wall time for large max_cycles, and prefer
+    warmup=False when only the answer matters.  Host-driven sweep
     algorithms (dpop, syncbb, ncbb) and maxsum decimation ignore it —
     their runners already report compile time separately.
     """

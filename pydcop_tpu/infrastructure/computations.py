@@ -9,7 +9,7 @@ build_computation :1156).
 
 import logging
 import random
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from pydcop_tpu.infrastructure.events import event_bus
 from pydcop_tpu.utils.simple_repr import SimpleRepr
@@ -125,6 +125,14 @@ def register(msg_type: str):
     return decorate
 
 
+class _RetryEntry(NamedTuple):
+    """A paused-buffer entry that already failed ``attempts`` resume
+    flushes (see MessagePassingComputation._flush_paused)."""
+
+    entry: Tuple
+    attempts: int
+
+
 class ComputationMetaClass(type):
     """Collects @register-ed handlers into ``_decorated_handlers``."""
 
@@ -214,57 +222,100 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
             # synchronous computations wrap algo messages in "_cycle"
             # envelopes that only their on_message knows how to unwrap
             # (a raw dispatch would raise "No handler for message type
-            # '_cycle'").  A poisoned entry (e.g. a protocol-violating
-            # duplicate) is dropped — redelivering it would
-            # deterministically raise forever.
+            # '_cycle'").  A poisoned entry (a protocol violation such
+            # as a duplicate cycle message, i.e. ComputationException)
+            # is dropped — redelivering it would deterministically
+            # raise forever.  Entries that fail for any OTHER reason
+            # (environmental/transient) are kept like the post buffer's:
+            # for a sync-mixin computation a dropped non-duplicate cycle
+            # message would permanently stall its cycle barrier.
             recv_error = self._flush_paused(
                 "_paused_messages_recv",
-                lambda e: self.on_message(*e),
-                keep_failed=False,
+                self._redeliver_recv,
+                keep_failed=lambda exc: not isinstance(
+                    exc, ComputationException),
+                max_retries=self.MAX_FLUSH_RETRIES,
             )
             # Buffered posts were already wrapped by the subclass's
             # post_msg before buffering — resend through the BASE
             # post_msg so the sync mixin cannot wrap a second "_cycle"
             # envelope around them.  Post failures are usually
             # environmental (e.g. not attached yet), so the failed
-            # entry itself is kept for a later flush.
+            # entry itself is kept for a later flush — with NO retry
+            # cap: losing a post stalls the neighbor's cycle barrier,
+            # and unlike the recv path there is no handler to be
+            # deterministically buggy.
             post_error = self._flush_paused(
                 "_paused_messages_post",
-                lambda e: MessagePassingComputation.post_msg(self, *e),
+                lambda e, attempts: MessagePassingComputation.post_msg(
+                    self, *e),
                 keep_failed=True,
+                max_retries=None,
             )
             error = recv_error or post_error
             if error is not None:
                 raise error
 
-    def _flush_paused(self, buffer_attr: str, deliver, keep_failed: bool):
+    MAX_FLUSH_RETRIES = 3
+
+    def _redeliver_recv(self, entry, attempts):
+        """Deliver a buffered reception; on RETRY attempts the
+        message_rcv event is suppressed — it was already emitted when
+        the first delivery attempt entered on_message (single-emission
+        invariant, see test_paused_send_emitted_once_on_event_bus)."""
+        if attempts == 0:
+            self.on_message(*entry)
+            return
+        self._suppress_rcv_emit = True
+        try:
+            self.on_message(*entry)
+        finally:
+            self._suppress_rcv_emit = False
+
+    def _flush_paused(self, buffer_attr: str, deliver, keep_failed,
+                      max_retries=None):
         """Drain a paused-message buffer in order, delivering EVERY
         entry even when one raises (remaining messages must not be
         stranded — with the sync mixin a lost message stalls a
-        neighbor's cycle barrier forever).  Failed entries are kept in
-        the buffer (``keep_failed``) or dropped with a logged
-        traceback; the first exception is RETURNED (not raised) so the
-        caller can drain every buffer before surfacing it.  The buffer
-        is swapped out first: a handler may re-pause, and appending to
-        a list being iterated would loop."""
+        neighbor's cycle barrier forever).  ``keep_failed`` — a bool or
+        a predicate over the raised exception — decides per entry
+        whether a failed one is kept in the buffer or dropped with a
+        logged traceback; with ``max_retries`` set, a kept entry
+        survives at most that many failed flushes (a deterministically-
+        buggy handler must not poison every future pause/resume round;
+        the post buffer passes None — unbounded — because its failures
+        are environmental and a dropped post is a lost message).  The
+        first exception is RETURNED (not raised) so the caller can
+        drain every buffer before surfacing it.  The buffer is swapped
+        out first: a handler may re-pause, and appending to a list
+        being iterated would loop."""
         entries = getattr(self, buffer_attr)
         setattr(self, buffer_attr, [])
         first_error = None
         failed = []
-        for entry in entries:
+        for item in entries:
+            if isinstance(item, _RetryEntry):
+                entry, attempts = item.entry, item.attempts
+            else:
+                entry, attempts = item, 0
             try:
-                deliver(entry)
+                deliver(entry, attempts)
             except Exception as e:  # noqa: BLE001 - surfaced by caller
+                keep = keep_failed(e) if callable(keep_failed) \
+                    else keep_failed
+                if keep and max_retries is not None \
+                        and attempts + 1 >= max_retries:
+                    keep = False
                 # Log every failure here: only the FIRST error is
                 # surfaced to the caller, and a dropped entry would
                 # otherwise vanish without a trace.
                 self.logger.exception(
                     "Error flushing paused message %s of %s "
-                    "(%s)", entry, self.name,
-                    "kept" if keep_failed else "dropped",
+                    "(attempt %d, %s)", entry, self.name, attempts + 1,
+                    "kept" if keep else "dropped",
                 )
-                if keep_failed:
-                    failed.append(entry)
+                if keep:
+                    failed.append(_RetryEntry(entry, attempts + 1))
                 if first_error is None:
                     first_error = e
         # Prepend: anything buffered DURING the drain (a handler
@@ -290,7 +341,8 @@ class MessagePassingComputation(metaclass=ComputationMetaClass):
         if self._is_paused:
             self._paused_messages_recv.append((sender, msg, t))
             return
-        if event_bus.enabled:
+        if event_bus.enabled and not getattr(
+                self, "_suppress_rcv_emit", False):
             event_bus.emit(
                 f"computations.message_rcv.{self.name}", (sender, msg)
             )

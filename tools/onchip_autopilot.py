@@ -156,8 +156,14 @@ def run_step(name, argv_tail, timeout):
     # supervisor that spawns a grandchild — killing only the direct
     # child would orphan a runner that keeps the tunnel occupied for
     # every later step.
+    # PYTHONPATH=REPO: scripts under benchmarks/ get their own dir on
+    # sys.path, not the repo root, so `import pydcop_tpu` fails without
+    # it (bench.py at the root dodged this; the exp_* steps did not).
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     proc = subprocess.Popen(
-        [sys.executable] + argv_tail, cwd=REPO,
+        [sys.executable] + argv_tail, cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         start_new_session=True,
     )
