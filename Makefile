@@ -4,9 +4,15 @@
 
 PY ?= python
 
-.PHONY: all test unit api cli check bench dryrun onchip
+.PHONY: all test unit api cli check doctest bench dryrun onchip
 
 all: check test
+
+# Executable docstring examples across the package (reference
+# Makefile:6 `pytest --doctest-modules ./pydcop`).  Root conftest.py
+# forces the CPU backend for the examples.
+doctest:
+	$(PY) -m pytest --doctest-modules pydcop_tpu -q
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -20,7 +26,7 @@ api:
 cli:
 	$(PY) -m pytest tests/cli -q
 
-check:
+check: doctest
 	$(PY) tools/static_check.py
 
 bench:

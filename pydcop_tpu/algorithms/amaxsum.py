@@ -12,6 +12,20 @@ the "fully fired" schedule of the asynchronous execution.  Solution
 quality is equivalent (damping still applies); the asynchronous
 *schedule* itself is only observable in agent mode, where the
 infrastructure computations implement true per-message firing.
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'amaxsum', max_cycles=50)
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 from typing import Optional

@@ -19,6 +19,20 @@ Execution model here (two paths, selected by the ``engine`` param):
 UTIL width is exponential in separator size; oversized tables raise
 MemoryError in both paths (footprint accounting mirror:
 computation_memory below, reference dpop.py:80-85).
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'dpop')
+    >>> round(res['cost'], 3), sorted(res['assignment'].items())
+    (0.0, [('x', 0), ('y', 1)])
 """
 
 from typing import Dict, Optional

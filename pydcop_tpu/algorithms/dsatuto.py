@@ -4,6 +4,20 @@ Reference parity: pydcop/algorithms/dsatuto.py (:66-126) — DSA-A with
 fixed probability 0.7, written as the companion of the algorithm
 implementation tutorial (docs/tutorials/algo_implementation.rst).  The
 device path delegates to the full dsa engine pinned to variant A.
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'dsatuto', max_cycles=30, algo_params={'seed': 1})
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 from typing import Optional

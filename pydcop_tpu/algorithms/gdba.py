@@ -3,6 +3,20 @@
 Reference parity: pydcop/algorithms/gdba.py (params :181-186: modifier
 A/M, violation NZ/NM/MX, increase_mode E/R/C/T; semantics :189-654).
 Kernels: pydcop_tpu/ops/gdba.py.
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'gdba', max_cycles=30, algo_params={'seed': 1})
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 from functools import partial

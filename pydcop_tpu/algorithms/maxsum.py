@@ -13,6 +13,20 @@ point, and is accepted for compatibility.  Send-suppression after
 SAME_COUNT identical messages (reference :106) is a wire-traffic
 optimization with no effect on message *content*; on device, messages
 are array rows and the optimization is moot.
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'maxsum', max_cycles=50)
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 import time

@@ -15,6 +15,20 @@ Device path: the batched engine handles dynamic problems by recompiling
 the factor-graph tensors on topology events and warm-starting messages
 (see engine.compile); a static problem solved through this module is
 plain MaxSum, so ``solve_on_device`` delegates.
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'maxsum_dynamic', max_cycles=50)
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 from pydcop_tpu.algorithms import maxsum as _maxsum

@@ -3,6 +3,20 @@
 Reference parity: pydcop/algorithms/mgm.py (params :77-83: break_mode
 lexic/random, stop_cycle; semantics :213-609).  Kernels:
 pydcop_tpu/ops/mgm.py.
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'mgm', max_cycles=30, algo_params={'seed': 1})
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 from functools import partial

@@ -3,6 +3,20 @@
 Reference parity: pydcop/algorithms/mixeddsa.py (params :119-124:
 variant A/B/C, proba_hard 0.7, proba_soft 0.5; semantics :154-470).
 Kernels: pydcop_tpu/ops/mixeddsa.py.
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'mixeddsa', max_cycles=30, algo_params={'seed': 1})
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 from functools import partial

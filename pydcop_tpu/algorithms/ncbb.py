@@ -22,6 +22,20 @@ Agent mode implements the SEARCH phase as a distributed AND/OR search
 with message passing over the pseudo-tree (sibling subtrees explored
 concurrently, memoized per ancestor context) and also returns the
 optimum — see infrastructure/agent_algorithms.NcbbComputation.
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'ncbb')
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 from typing import Dict, List, Optional

@@ -12,6 +12,20 @@ so there is nothing to batch; constraint tables are pre-materialized
 dense so per-step evaluation is array indexing, and partial costs are
 accumulated incrementally per depth (a constraint is charged at the
 depth where its last scope variable is assigned).
+
+Example (doctest, runs on the CPU backend under ``make doctest``)::
+
+    >>> from pydcop_tpu.api import solve
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+    >>> res = solve(dcop, 'syncbb')
+    >>> round(res['cost'], 3)
+    0.0
 """
 
 from typing import Dict, List, Optional

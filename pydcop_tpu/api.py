@@ -53,6 +53,20 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     warmup=False when only the answer matters.  Host-driven sweep
     algorithms (dpop, syncbb, ncbb) and maxsum decimation ignore it —
     their runners already report compile time separately.
+
+    Example::
+
+        >>> from pydcop_tpu.dcop.dcop import DCOP
+        >>> from pydcop_tpu.dcop.objects import Domain, Variable
+        >>> from pydcop_tpu.dcop.relations import constraint_from_str
+        >>> d = Domain('d', '', [0, 1])
+        >>> x, y = Variable('x', d), Variable('y', d)
+        >>> dcop = DCOP('doc', objective='min')
+        >>> dcop.add_constraint(
+        ...     constraint_from_str('c', '(x + y - 1)**2', [x, y]))
+        >>> res = solve(dcop, 'dpop')
+        >>> res['status'], round(res['cost'], 3)
+        ('FINISHED', 0.0)
     """
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(

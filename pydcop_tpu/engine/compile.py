@@ -32,6 +32,24 @@ and the sharding communication-minimal:
 
 - **Zero-ary constraints** are folded into a host-side constant offset
   (`meta.constant_cost`).
+
+Example (compile a 2-variable problem and inspect the device layout)::
+
+    >>> from pydcop_tpu.dcop.dcop import DCOP
+    >>> from pydcop_tpu.dcop.objects import Domain, Variable
+    >>> from pydcop_tpu.dcop.relations import constraint_from_str
+    >>> from pydcop_tpu.engine.compile import compile_dcop
+    >>> d = Domain('d', '', [0, 1])
+    >>> x, y = Variable('x', d), Variable('y', d)
+    >>> dcop = DCOP('doc', objective='min')
+    >>> dcop.add_constraint(constraint_from_str('c', 'x * y', [x, y]))
+    >>> graph, meta = compile_dcop(dcop)
+    >>> graph.var_costs.shape        # V+1 sentinel row, Dmax slots
+    (3, 2)
+    >>> [b.costs.shape for b in graph.buckets]  # one binary bucket
+    [(1, 2, 2)]
+    >>> meta.var_names
+    ('x', 'y')
 """
 
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple

@@ -15,6 +15,23 @@ adsa.py).  Measured findings these tests pin down:
   runs) — simultaneous neighbor flips thrash in ways the clock-skewed
   async updates avoid.  The test BOUNDS this known gap at 10% rather
   than asserting a false equivalence.
+- adsa STAGGERED schedule at matched budgets (round-5 attempt to close
+  that gap): the variable graph is greedily colored and one class
+  flips per superstep (one cycle = one full sweep), so neighbors never
+  flip simultaneously — the device-side emulation of async clock skew.
+  RECORDED NEGATIVE RESULT: the schedule neither helps nor hurts.
+  Across repeated 20-seed batteries the thread-paired mean wandered
+  (-2.3, then +1.25) inside the thread-side noise floor (per-seed sd
+  ~15 → CI half-width ~7 = 4.5% of constraints, so a 5% bound is not
+  certifiable at n=20 regardless of the true mean); the DETERMINISTIC
+  device-device pairing (no thread noise, same seeds) measures
+  staggered - lockstep = +1.45 mean — statistically flat.  Mechanism:
+  at p=0.7 flip probability on this sparse family (~3.9 avg degree),
+  simultaneous-neighbor flips are too rare for schedule skew to
+  matter, which also means the round-4 "+3% lockstep gap" attribution
+  was itself within measurement noise.  Asserted: 10% vs thread (the
+  certifiable bound), and a deterministic |mean| <= 3%-of-constraints
+  device-device equivalence below.
 - adsa at NATIVE budgets (device 200 cycles vs thread 60): the mean
   gap disappears (~0 across runs) — device cycles are ~free, so the
   lockstep engine simply runs more of them; this is the practically
@@ -84,6 +101,12 @@ def _paired_diffs(algo, dev_cycles, dev_params, thread_kw):
     ("adsa", 200, {"seed": 0, "stop_cycle": 60},
      {"timeout": 12, "algo_params": {"stop_cycle": 60, "period": 0.05}},
      0.10),
+    # adsa staggered schedule, matched budgets: same certifiable bound
+    # as lockstep (10%) — see the module docstring's negative result.
+    ("adsa", 200,
+     {"seed": 0, "stop_cycle": 60, "schedule": "staggered"},
+     {"timeout": 12, "algo_params": {"stop_cycle": 60, "period": 0.05}},
+     0.10),
     # adsa, native budgets: device's extra (near-free) cycles close
     # the gap (mean diff ~0 across runs).  The bound is 10%, not 5%:
     # per-seed sd is ~15 cost units under CI load, so the 95% CI
@@ -104,4 +127,28 @@ def test_lockstep_vs_async_quality(algo, dev_cycles, dev_params,
         f"{algo}: lockstep quality gap beyond the documented bound: "
         f"paired diffs {diffs}, mean {mean:.2f}, CI upper "
         f"{upper:.2f} > tol {tol:.2f}"
+    )
+
+
+@pytest.mark.slow
+def test_staggered_matches_lockstep_deterministically():
+    """Device-device pairing of the two adsa schedules: both sides are
+    seeded jax kernels, so this comparison has NO thread-side sampling
+    noise and is bit-reproducible.  The staggered schedule must be
+    statistically flat vs lockstep (recorded negative result, module
+    docstring): |paired mean| <= 3% of the constraint count (measured
+    +1.45 ≈ 0.9% on this battery's family)."""
+    diffs = []
+    n_constraints = None
+    for seed in SEEDS:
+        dcop = _problem(seed)
+        n_constraints = len(dcop.constraints)
+        r_lock = solve(dcop, "adsa", max_cycles=200, algo_params={
+            "seed": seed, "stop_cycle": 60})
+        r_stag = solve(dcop, "adsa", max_cycles=200, algo_params={
+            "seed": seed, "stop_cycle": 60, "schedule": "staggered"})
+        diffs.append(r_stag["cost"] - r_lock["cost"])
+    mean = sum(diffs) / len(diffs)
+    assert abs(mean) <= 0.03 * n_constraints, (
+        f"staggered vs lockstep drifted: diffs {diffs}, mean {mean:.2f}"
     )

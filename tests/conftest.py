@@ -1,28 +1,10 @@
-"""Test configuration: force an 8-device virtual CPU platform so sharding
-tests exercise real multi-device code paths without TPU hardware.
+"""Test configuration.
 
-Note: this environment pre-imports jax (sitecustomize on PYTHONPATH) with
-JAX_PLATFORMS=axon, so env vars alone are not enough — we must override
-through jax.config before any backend is initialized.
+The CPU-backend forcing (8 virtual devices, JAX_PLATFORMS=cpu, axon
+plugin env cleared) lives in the repo-root ``conftest.py`` so the
+doctest gate shares it; pytest loads that conftest before this one for
+everything under tests/, so this file only registers markers.
 """
-
-import os
-
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-# CPU tests must not depend on the TPU tunnel: without this, every CLI
-# subprocess re-registers the axon PJRT plugin and hangs if the tunnel
-# is down (the pytest process itself registered at interpreter start,
-# but jax_platforms=cpu below keeps it unused).
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):
