@@ -51,63 +51,71 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    d = 3
-    iters = 100
+    from pydcop_tpu.engine.timing import warmed_marginal
 
-    def timeit(fn, *args):
-        out = jax.block_until_ready(fn(*args))
-        ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = jax.block_until_ready(fn(*args))
-            ts.append(time.perf_counter() - t0)
-        return min(ts) / iters * 1e3, out  # ms per iteration
+    d = 3
+    # Differencing over the scan length (engine/timing.py): the axon
+    # tunnel's block_until_ready is a partial sync with a fixed
+    # ~130 ms round-trip — a naive min-of-3 wall clock reads that
+    # constant at every size below ~1M vars, making the A/B columns
+    # identical noise.  The slope between two scan lengths cancels it.
+    IT_LO, IT_HI = 20, 120
+
+    def timeit(make_fn, *args):
+        per_iter, _, out = warmed_marginal(
+            lambda n: jax.jit(make_fn(n)), IT_LO, IT_HI,
+            args=args, reps=3)
+        return per_iter * 1e3, out             # ms per iteration
 
     for n_vars in (10_000, 100_000, 1_000_000):
         n_edges = n_vars * 3
         seg, msgs, perm, sorted_seg, starts, ends = build(
             n_vars, n_edges, d)
 
-        @jax.jit
-        def run_scatter(msgs, seg):
-            def step(m, _):
-                s = jax.ops.segment_sum(m, seg, num_segments=n_vars)
-                # feed result back so iterations can't collapse
-                return m + 1e-9 * s[seg], None
-            m, _ = jax.lax.scan(step, msgs, None, length=iters)
-            return jax.ops.segment_sum(m, seg, num_segments=n_vars)
+        def make_scatter(iters):
+            def run(msgs, seg):
+                def step(m, _):
+                    s = jax.ops.segment_sum(
+                        m, seg, num_segments=n_vars)
+                    # feed result back so iterations can't collapse
+                    return m + 1e-9 * s[seg], None
+                m, _ = jax.lax.scan(step, msgs, None, length=iters)
+                return jax.ops.segment_sum(m, seg, num_segments=n_vars)
+            return run
 
-        @jax.jit
-        def run_sorted(msgs, seg_s, perm):
-            def agg(m):
-                return jax.ops.segment_sum(
-                    m[perm], seg_s, num_segments=n_vars,
-                    indices_are_sorted=True)
-            def step(m, _):
-                s = agg(m)
-                return m + 1e-9 * s[seg], None
-            m, _ = jax.lax.scan(step, msgs, None, length=iters)
-            return agg(m)
+        def make_sorted(iters):
+            def run(msgs, seg_s, perm):
+                def agg(m):
+                    return jax.ops.segment_sum(
+                        m[perm], seg_s, num_segments=n_vars,
+                        indices_are_sorted=True)
+                def step(m, _):
+                    s = agg(m)
+                    return m + 1e-9 * s[seg], None
+                m, _ = jax.lax.scan(step, msgs, None, length=iters)
+                return agg(m)
+            return run
 
-        @jax.jit
-        def run_boundary(msgs, perm, starts, ends):
-            def agg(m):
-                cum = jnp.cumsum(m[perm], axis=0)
-                cz = jnp.concatenate(
-                    [jnp.zeros((1, d), jnp.float32), cum], axis=0)
-                return cz[ends] - cz[starts]
-            def step(m, _):
-                s = agg(m)
-                return m + 1e-9 * s[seg], None
-            m, _ = jax.lax.scan(step, msgs, None, length=iters)
-            return agg(m)
+        def make_boundary(iters):
+            def run(msgs, perm, starts, ends):
+                def agg(m):
+                    cum = jnp.cumsum(m[perm], axis=0)
+                    cz = jnp.concatenate(
+                        [jnp.zeros((1, d), jnp.float32), cum], axis=0)
+                    return cz[ends] - cz[starts]
+                def step(m, _):
+                    s = agg(m)
+                    return m + 1e-9 * s[seg], None
+                m, _ = jax.lax.scan(step, msgs, None, length=iters)
+                return agg(m)
+            return run
 
-        t_sc, ref = timeit(run_scatter, jnp.asarray(msgs),
+        t_sc, ref = timeit(make_scatter, jnp.asarray(msgs),
                            jnp.asarray(seg))
-        t_so, out_so = timeit(run_sorted, jnp.asarray(msgs),
+        t_so, out_so = timeit(make_sorted, jnp.asarray(msgs),
                               jnp.asarray(sorted_seg),
                               jnp.asarray(perm))
-        t_bo, out_bo = timeit(run_boundary, jnp.asarray(msgs),
+        t_bo, out_bo = timeit(make_boundary, jnp.asarray(msgs),
                               jnp.asarray(perm), jnp.asarray(starts),
                               jnp.asarray(ends))
         err_so = float(jnp.max(jnp.abs(ref - out_so)))

@@ -179,4 +179,13 @@ def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
             achieved_bw / peak_bw
             if peak_bw and vmem_resident is False else None
         ),
+        # Physics gate: modeled traffic x measured rate above the
+        # chip's HBM peak means the RATE is wrong (round 5: the axon
+        # tunnel's block_until_ready is a partial sync, so a naive
+        # wall-clock measured enqueue time and claimed 10x peak).  The
+        # flag makes such a line self-refuting instead of impressive.
+        "hbm_util_exceeds_peak": (
+            achieved_bw > peak_bw
+            if peak_bw and vmem_resident is False else None
+        ),
     }

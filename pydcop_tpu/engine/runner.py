@@ -22,6 +22,7 @@ from pydcop_tpu.engine.compile import (
     FactorGraphMeta,
 )
 from pydcop_tpu.engine.sharding import make_mesh, shard_graph
+from pydcop_tpu.engine.timing import sync
 from pydcop_tpu.ops import maxsum as maxsum_ops
 from pydcop_tpu.ops import maxsum_lane as lane_ops
 
@@ -60,11 +61,16 @@ def timed_jit_call(warm: set, key, fn, *args):
     (the DeviceRunResult overlapping-fields convention; compile
     dominates); warm calls report (0, elapsed).
 
+    Completion is forced with engine.timing.sync, not
+    ``jax.block_until_ready`` — the axon tunnel implements the latter
+    as a partial/no-op sync, which silently turns run times into
+    enqueue times (see timing module docstring).
+
     Returns (out, compile_s, run_s).
     """
     first = key not in warm
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(*args))
+    out = sync(fn(*args))
     elapsed = time.perf_counter() - t0
     if first:
         warm.add(key)
@@ -113,11 +119,10 @@ def run_device_fn(graph: CompiledFactorGraph, meta: FactorGraphMeta,
     compile_s = 0.0
     if warmup:
         t0 = time.perf_counter()
-        jax.block_until_ready(jitted(graph))
+        sync(jitted(graph))
         compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    out = jitted(graph)
-    jax.block_until_ready(out)
+    out = sync(jitted(graph))
     t1 = time.perf_counter()
     values, cost, cycles = jax.device_get(out)
     values = np.asarray(values)
@@ -310,7 +315,7 @@ class MaxSumEngine:
             tc = time.perf_counter()
             out = self._jitted[key](g, s)
             if first_call:
-                jax.block_until_ready(out)
+                sync(out)
                 compile_s += time.perf_counter() - tc
             return out
 
@@ -351,7 +356,7 @@ class MaxSumEngine:
             # Clamped costs changed the problem: clear convergence so
             # the warm-started messages adapt.
             state = state._replace(stable=jnp.asarray(False))
-        jax.block_until_ready(values)
+        sync(values)
         total = time.perf_counter() - t0
         # DeviceRunResult convention: time_s = total wall including
         # compiles; steady-state rate uses the compile-free remainder.

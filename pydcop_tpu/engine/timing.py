@@ -1,0 +1,130 @@
+"""Honest device timing under an unreliable async dispatch layer.
+
+Measured on the axon TPU tunnel (round 5): ``jax.block_until_ready``
+does NOT reliably block until the computation finishes — a 10-iteration
+fori_loop over a 64 MB array "completed" in 0.12 ms while the forced
+host fetch of the same result took 2.1 s draining the queue.  Every
+wall-clock number taken as ``block_until_ready(fn(x)); elapsed`` on
+that platform is therefore a lower bound on nothing: it can measure
+pure enqueue cost (bench.py's round-5 1M-var scale leg recorded
+"25,871 cycles/s", i.e. 1.9 ms for a program whose modeled HBM traffic
+alone needs >20 s at v5e peak bandwidth — 10x *over* the physical
+peak, which is how the artifact was caught).
+
+Two tools fix this:
+
+- :func:`sync` forces true completion by fetching the smallest output
+  buffer to the host.  Bytes cannot be fetched before they exist, on
+  any backend, so this is a real barrier (a scalar fetch costs one
+  tunnel round-trip, ~130 ms measured — include it in the timed window
+  and the number is end-to-end honest).
+- :func:`marginal_seconds_per_cycle` removes the fixed tunnel overhead
+  (enqueue + round-trip + fetch, independent of program length) by
+  timing the same program at two cycle counts and taking the slope.
+  This is the chip's steady-state rate — the number roofline
+  utilization claims must be based on, since the fixed latency says
+  nothing about HBM streaming.
+
+The reference's benchmarks never face this (torch CUDA synchronize is
+reliable; reference pydcop measures host wall-clock around a threaded
+runtime, e.g. pydcop/commands/solve.py run timers); an async tunnel is
+a TPU-deployment reality, so the timing discipline lives here in the
+engine, not in bench scripts.
+"""
+
+import time
+from typing import Any, Callable, Tuple
+
+import jax
+import numpy as np
+
+
+def sync(out: Any) -> Any:
+    """Block until ``out`` (any pytree of jax arrays) has actually been
+    computed, then return it unchanged.
+
+    Fetches the smallest leaf to the host: all leaves of one executed
+    program materialize together, and a host fetch cannot complete
+    before the buffer exists — unlike ``jax.block_until_ready``, which
+    the experimental axon platform implements as a no-op/partial sync.
+    Cost: one round-trip plus the smallest leaf's transfer (pick your
+    outputs so a scalar — cycle counter, convergence flag — is among
+    them, which every ops.run_* in this package does).
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(out)
+              if hasattr(x, "dtype")]
+    if leaves:
+        smallest = min(leaves, key=lambda a: getattr(a, "size", 1))
+        np.asarray(jax.device_get(smallest))
+    return out
+
+
+def timed_call(fn: Callable, *args: Any) -> Tuple[Any, float]:
+    """``(out, seconds)`` for one fully-completed call of ``fn``.
+
+    The window closes only after :func:`sync` — end-to-end honest on
+    every backend, including the fixed tunnel round-trip.
+    """
+    t0 = time.perf_counter()
+    out = sync(fn(*args))
+    return out, time.perf_counter() - t0
+
+
+def warmed_marginal(make_fn: Callable[[int], Callable], lo: int,
+                    hi: int, args: Tuple = (), reps: int = 3,
+                    ) -> Tuple[float, float, Any]:
+    """Build + warm the two programs, then difference them.
+
+    ``make_fn(n)`` returns a callable (typically jitted) running an
+    n-cycle program; it is called once per cycle count, so per-call
+    jitting inside it is fine.  Both programs are executed to
+    completion once before any timed window (compile + warm), and the
+    warm full-length output is returned as the third element so
+    callers reuse the result instead of paying another run —
+    every ops.run_* here is deterministic given its inputs, so the
+    warm output IS the run's result.
+
+    Returns ``(sec_per_cycle, fixed_s, out_hi)``.
+    """
+    fns = {c: make_fn(c) for c in (lo, hi)}
+    outs = {c: sync(f(*args)) for c, f in fns.items()}
+    per_cycle, fixed = marginal_seconds_per_cycle(
+        lambda c: fns[c](*args), lo, hi, reps=reps)
+    return per_cycle, fixed, outs[hi]
+
+
+def marginal_seconds_per_cycle(
+        run_cycles: Callable[[int], Any],
+        lo: int, hi: int, reps: int = 3) -> Tuple[float, float]:
+    """Steady-state per-cycle seconds via two-point differencing.
+
+    ``run_cycles(n)`` must execute an n-cycle program to completion
+    (caller jits per cycle count and calls :func:`sync`; both counts
+    must be pre-compiled/warmed by the caller so compile time never
+    lands in a timed window).  Returns ``(sec_per_cycle, fixed_s)``
+    where ``fixed_s`` is the per-call constant (enqueue + round-trip +
+    fetch) implied by the intercept — reported so benches can show how
+    much of the end-to-end time is tunnel, not chip.
+
+    Medians over ``reps`` repetitions: round-trip jitter on a tunnel is
+    tens of ms, so a single rep can produce a negative slope on fast
+    programs; the median plus a floor at 0 keeps the estimate sane.
+    ``hi - lo`` should be chosen so the real compute delta dominates
+    that jitter (hundreds of cycles minimum for VMEM-resident
+    problems).
+    """
+    if hi <= lo:
+        raise ValueError(f"need hi > lo, got lo={lo} hi={hi}")
+    t_lo, t_hi = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(run_cycles(lo))
+        t_lo.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        sync(run_cycles(hi))
+        t_hi.append(time.perf_counter() - t0)
+    med_lo = float(np.median(t_lo))
+    med_hi = float(np.median(t_hi))
+    per_cycle = max((med_hi - med_lo) / (hi - lo), 0.0)
+    fixed = max(med_lo - per_cycle * lo, 0.0)
+    return per_cycle, fixed
