@@ -493,12 +493,39 @@ def run_bench():
     fixed_latency = None
     if platform == "tpu":
         from pydcop_tpu.engine.timing import warmed_marginal
+        from pydcop_tpu.utils.cleanenv import record_diag
 
-        sec_per_cycle, fixed_latency, _ = warmed_marginal(
-            lambda c: engine._fn(c, False), 1_000, 201_000,
-            args=(engine.graph,), reps=5)
-        marginal_cps = (
-            1.0 / sec_per_cycle if sec_per_cycle > 0 else None)
+        # Adaptive ladder: a fixed long program is dangerous — the
+        # first attempt used 201k cycles sized from a prior "0.6 us/
+        # cycle" estimate that was itself a block_until_ready artifact,
+        # and the real program ran long enough that the tunnel KILLED
+        # the TPU worker (observed twice, ~3 min in: "TPU worker
+        # process crashed or restarted").  Start with a short delta and
+        # escalate 10x only while the measured slope projects the next
+        # rung comfortably under the watchdog.  A dead worker must not
+        # kill the bench either way: end-to-end numbers still stand.
+        try:
+            lo, hi = 200, 2_200
+            while True:
+                sec_per_cycle, fixed_latency, _ = warmed_marginal(
+                    lambda c: engine._fn(c, False), lo, hi,
+                    args=(engine.graph,), reps=3)
+                delta_s = sec_per_cycle * (hi - lo)
+                next_hi = hi * 10
+                if (delta_s >= 0.5 or next_hi > 3_000_000
+                        or sec_per_cycle * next_hi > 45):
+                    break
+                hi = next_hi
+            marginal_cps = (
+                1.0 / sec_per_cycle if sec_per_cycle > 0 else None)
+            record_diag("marginal_leg", hi_cycles=hi,
+                        sec_per_cycle=sec_per_cycle)
+        except Exception as exc:   # noqa: BLE001 — tunnel/worker death
+            record_diag("marginal_leg_failed",
+                        error=f"{type(exc).__name__}: {exc}"[:200])
+            print(f"bench: marginal leg failed ({exc}); continuing "
+                  "with end-to-end timing only", file=sys.stderr)
+            fixed_latency = None
 
     roofline = roofline_report(
         engine.graph, marginal_cps or device_cps, platform, device_kind)
