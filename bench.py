@@ -371,12 +371,12 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     var_valid = np.zeros((n_vars + 1, N_COLORS), bool)
     var_valid[:-1] = True
     buckets = (FactorBucket(costs, var_ids),)
-    perm, sorted_seg, starts, ends = build_aggregation_arrays(
+    perm, sorted_seg, starts, ends, ell = build_aggregation_arrays(
         buckets, n_vars + 1, aggregation)
     graph = CompiledFactorGraph(
         var_costs=var_costs, var_valid=var_valid, buckets=buckets,
         agg_perm=perm, agg_sorted_seg=sorted_seg,
-        agg_starts=starts, agg_ends=ends,
+        agg_starts=starts, agg_ends=ends, agg_ell=ell,
     )
     if layout == "lane":
         if aggregation != "scatter":

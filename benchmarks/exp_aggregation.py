@@ -3,7 +3,7 @@ aggregation — the op that dominates past the 100k-var scale cliff
 (BENCH_TPU.md: 2 us/cycle at 10k vars vs 8.4 ms/cycle at 100k on a
 v5e; the scatter-add and tiny-minor-dim gathers are the suspects).
 
-Three strategies, identical math (up to float reassociation):
+Four strategies, identical math (up to float reassociation):
 
 - scatter:   jax.ops.segment_sum on unsorted edge ids (current engine,
              ops/maxsum.aggregate_beliefs).
@@ -12,6 +12,10 @@ Three strategies, identical math (up to float reassociation):
              messages into sorted order happens per cycle).
 - boundary:  compile-time edge sort + cumsum along edges + per-variable
              boundary gathers — no scatter at all.
+- ell:       compile-time per-variable edge lists padded to the max
+             degree; dense gather + K-way sum — no scatter, no sort
+             (TPU scatter-add serializes row updates; this is the
+             vectorizable shape).
 
 Run on the target backend:  python benchmarks/exp_aggregation.py
 Prints one JSON line per size with ms/iteration for each strategy; use
@@ -41,7 +45,13 @@ def build(n_vars, n_edges, d, seed=0):
                              side="left").astype(np.int32)
     ends = np.searchsorted(sorted_seg, np.arange(n_vars),
                            side="right").astype(np.int32)
-    return seg, msgs, perm, sorted_seg, starts, ends
+    # ELL: per-variable edge lists padded to the max degree; dummy
+    # slots hold n_edges (a zero row is appended there by the kernel).
+    k_max = max(int((ends - starts).max()), 1)
+    ell = np.full((n_vars, k_max), n_edges, np.int32)
+    k_pos = np.arange(n_edges) - starts[sorted_seg]
+    ell[sorted_seg, k_pos] = perm
+    return seg, msgs, perm, sorted_seg, starts, ends, ell
 
 
 def main():
@@ -75,7 +85,7 @@ def main():
     # the engine-level leg below measures 1M end to end.
     for n_vars in (10_000, 100_000):
         n_edges = n_vars * 3
-        seg, msgs, perm, sorted_seg, starts, ends = build(
+        seg, msgs, perm, sorted_seg, starts, ends, ell = build(
             n_vars, n_edges, d)
 
         def make_scatter(iters):
@@ -116,6 +126,22 @@ def main():
                 return agg(m)
             return run
 
+        def make_ell(iters):
+            def run(msgs, ell):
+                def agg(m):
+                    # clip + mask, not a zero-row append: appending
+                    # copies the whole message array per iteration.
+                    safe = jnp.minimum(ell, n_edges - 1)
+                    mask = (ell < n_edges)[..., None]
+                    return jnp.sum(
+                        jnp.where(mask, m[safe], 0.0), axis=1)
+                def step(m, _):
+                    s = agg(m)
+                    return m + 1e-9 * s[seg], None
+                m, _ = jax.lax.scan(step, msgs, None, length=iters)
+                return agg(m)
+            return run
+
         t_sc, ref = timeit(make_scatter, jnp.asarray(msgs),
                            jnp.asarray(seg))
         t_so, out_so = timeit(make_sorted, jnp.asarray(msgs),
@@ -124,15 +150,20 @@ def main():
         t_bo, out_bo = timeit(make_boundary, jnp.asarray(msgs),
                               jnp.asarray(perm), jnp.asarray(starts),
                               jnp.asarray(ends))
+        t_el, out_el = timeit(make_ell, jnp.asarray(msgs),
+                              jnp.asarray(ell))
         err_so = float(jnp.max(jnp.abs(ref - out_so)))
         err_bo = float(jnp.max(jnp.abs(ref - out_bo)))
+        err_el = float(jnp.max(jnp.abs(ref - out_el)))
         print(json.dumps({
             "n_vars": n_vars, "n_edges": n_edges,
             "backend": jax.devices()[0].platform,
             "scatter_ms": round(t_sc, 4),
             "sorted_ms": round(t_so, 4),
             "boundary_ms": round(t_bo, 4),
+            "ell_ms": round(t_el, 4),
             "sorted_err": err_so, "boundary_err": err_bo,
+            "ell_err": err_el,
         }))
         sys.stdout.flush()
 
@@ -148,12 +179,14 @@ def main():
         os.path.abspath(__file__))))
     import bench as bench_mod
 
-    # "boundary" is excluded from the engine leg: numerically
-    # disqualified for solves (f32 prefix-sum cancellation, see
-    # ops/maxsum.aggregate_beliefs) AND each strategy costs two big
-    # remote compiles — spend them on the two strategies that could
-    # actually become the default.
-    for strategy in ("scatter", "sorted"):
+    # "boundary" is excluded from the engine leg (numerically
+    # disqualified for solves — f32 prefix-sum cancellation, see
+    # ops/maxsum.aggregate_beliefs) and "sorted" was measured ~=
+    # scatter on-chip at the op level; each strategy costs two big
+    # remote compiles, so spend them on the two candidates that could
+    # actually become the scale-path default: the current scatter and
+    # the dense-gather ell.
+    for strategy in ("scatter", "ell"):
         t0 = time.perf_counter()
         cps, graph = bench_mod.bench_scale(
             n_vars=1_000_000, cycles=50, aggregation=strategy)

@@ -65,16 +65,17 @@ algo_params = [
     AlgoParameterDef("decimation", "int", None, 0),
     # Variable-aggregation strategy for the superstep (device path;
     # see engine/compile.build_aggregation_arrays).  "scatter" is the
-    # parity default; "sorted" is the HBM-regime alternative measured
-    # by benchmarks/exp_aggregation.py.  The third strategy there
-    # ("boundary", prefix-sum + boundary differences) is experiment-
-    # only: f32 prefix sums over millions of edges cancel
+    # parity default; "sorted" and "ell" (padded dense-gather edge
+    # lists — no scatter at all) are the HBM-regime alternatives
+    # measured by benchmarks/exp_aggregation.py.  The fourth strategy
+    # there ("boundary", prefix-sum + boundary differences) is
+    # experiment-only: f32 prefix sums over millions of edges cancel
     # catastrophically at exactly the scale it targets, and TPUs have
     # no f64 to accumulate in — so it is not offered for solves.
     # Sharded runs always use scatter (shard_graph drops the sort
     # arrays).
     AlgoParameterDef(
-        "aggregation", "str", ["scatter", "sorted"], "scatter"
+        "aggregation", "str", ["scatter", "sorted", "ell"], "scatter"
     ),
     # Message-array layout (device path).  "edge" keeps messages as
     # [F, arity, D] (domain minor); "lane" transposes to [D, arity, F]

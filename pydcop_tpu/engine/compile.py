@@ -92,7 +92,15 @@ class CompiledFactorGraph(NamedTuple):
     - perm + sorted_seg: compile-time edge sort, per-cycle gather into
       sorted order, ``segment_sum(indices_are_sorted=True)``;
     - perm + starts/ends: edge sort + cumsum + per-variable boundary
-      gathers — no scatter at all (HBM-regime candidate).
+      gathers — no scatter at all (HBM-regime candidate);
+    - ell: per-variable edge lists padded to the maximum degree
+      ([V+1, K] indices into the flat edge order; dummy slots point
+      one past the last edge, where the kernel places a zero row) —
+      the aggregation becomes a dense gather + K-way sum with no
+      scatter and no sort, the layout XLA/TPU vectorizes best
+      (scatter-add on TPU serializes row updates; measured on-chip
+      round 5: 4.9 ms/iteration for 900k scattered rows at 100k
+      vars, ~5.5 ns/row).
 
     Sharded graphs always use the scatter path (a global edge sort
     would turn the local gather into a cross-device one), so
@@ -106,6 +114,7 @@ class CompiledFactorGraph(NamedTuple):
     agg_sorted_seg: Optional[np.ndarray] = None  # [E] int32 (sorted)
     agg_starts: Optional[np.ndarray] = None      # [V+1] int32
     agg_ends: Optional[np.ndarray] = None        # [V+1] int32
+    agg_ell: Optional[np.ndarray] = None         # [V+1, K] int32
 
     @property
     def n_vars(self) -> int:
@@ -143,19 +152,20 @@ def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-AGGREGATIONS = ("scatter", "sorted", "boundary")
+AGGREGATIONS = ("scatter", "sorted", "boundary", "ell")
 
 
 def build_aggregation_arrays(buckets: Sequence[FactorBucket],
                              n_segments: int, aggregation: str):
-    """Compile-time edge sort for the non-scatter aggregation paths.
+    """Compile-time edge indexing for the non-scatter aggregation paths.
 
     Edges are the flattened (bucket, factor, position) slots in bucket
     order — the same order ``aggregate_beliefs`` flattens messages in.
-    Returns the ``agg_*`` field values for CompiledFactorGraph.
+    Returns the 5 ``agg_*`` field values for CompiledFactorGraph:
+    (perm, sorted_seg, starts, ends, ell).
     """
     if aggregation == "scatter":
-        return None, None, None, None
+        return None, None, None, None, None
     if aggregation not in AGGREGATIONS:
         raise ValueError(
             f"aggregation must be one of {AGGREGATIONS}, "
@@ -167,14 +177,30 @@ def build_aggregation_arrays(buckets: Sequence[FactorBucket],
     perm = np.argsort(seg, kind="stable").astype(np.int32)
     sorted_seg = seg[perm].astype(np.int32)
     if aggregation == "sorted":
-        return perm, sorted_seg, None, None
+        return perm, sorted_seg, None, None, None
     starts = np.searchsorted(
         sorted_seg, np.arange(n_segments), side="left"
     ).astype(np.int32)
     ends = np.searchsorted(
         sorted_seg, np.arange(n_segments), side="right"
     ).astype(np.int32)
-    return perm, None, starts, ends
+    if aggregation == "boundary":
+        return perm, None, starts, ends, None
+    # ell: [V+1, K] edge indices per variable, K = max REAL-variable
+    # degree (the sentinel row V absorbs every padding-edge slot and
+    # would otherwise inflate K; its sum is dropped by the kernel, so
+    # its list stays all-dummy).  Dummy slots hold E — the kernel
+    # appends a zero row at that index.
+    n_edges = seg.size
+    deg = ends - starts
+    k_max = int(deg[:-1].max()) if n_segments > 1 and n_edges else 1
+    k_max = max(k_max, 1)
+    ell = np.full((n_segments, k_max), n_edges, np.int32)
+    # Position of each sorted edge within its variable's list.
+    k_pos = np.arange(n_edges) - starts[sorted_seg]
+    real = sorted_seg < (n_segments - 1)
+    ell[sorted_seg[real], k_pos[real]] = perm[real]
+    return None, None, None, None, ell
 
 
 def compile_factor_graph(
@@ -248,7 +274,7 @@ def compile_factor_graph(
         buckets.append(FactorBucket(costs, var_ids))
         bucket_sizes.append(len(facs))
 
-    perm, sorted_seg, starts, ends = build_aggregation_arrays(
+    perm, sorted_seg, starts, ends, ell = build_aggregation_arrays(
         buckets, v_count + 1, aggregation
     )
     compiled = CompiledFactorGraph(
@@ -259,6 +285,7 @@ def compile_factor_graph(
         agg_sorted_seg=sorted_seg,
         agg_starts=starts,
         agg_ends=ends,
+        agg_ell=ell,
     )
     meta = FactorGraphMeta(
         var_names=tuple(v.name for v in variables),

@@ -175,7 +175,7 @@ class MaxSumEngine:
                 raise ValueError(
                     "layout='lane' is single-device; use the default "
                     "edge layout for mesh runs")
-            if graph.agg_perm is not None:
+            if graph.agg_perm is not None or graph.agg_ell is not None:
                 raise ValueError(
                     "layout='lane' uses its own scatter aggregation; "
                     "compile with aggregation='scatter'")

@@ -199,9 +199,28 @@ def aggregate_beliefs(graph: CompiledFactorGraph, f2v: Msgs
       have no f64 to accumulate in.  Valid for throughput A/Bs
       (exp_aggregation, bench_scale) and small problems; not offered
       as a maxsum algo param.
+    - ell: dense gather + K-way sum over compile-time per-variable
+      edge lists padded to the max degree — no scatter, no sort.
+      Numerically safe (each variable's sum is over its own K terms,
+      like scatter, just in sorted-edge order) and the shape TPU
+      vectorizes best; single-device like the other non-scatter
+      paths.
     """
     n_segments = graph.var_costs.shape[0]
     d = graph.var_costs.shape[1]
+    if graph.agg_ell is not None:
+        flats = [msgs.reshape(-1, d) for msgs in f2v]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(
+            flats, axis=0)
+        # Dummy slots hold E (one past the last edge): clip + mask
+        # instead of appending a zero row — appending would copy the
+        # whole message array every cycle.
+        n_edges = flat.shape[0]
+        safe = jnp.minimum(graph.agg_ell, n_edges - 1)
+        mask = (graph.agg_ell < n_edges)[..., None]
+        sums = jnp.sum(
+            jnp.where(mask, flat[safe], 0.0), axis=1)
+        return graph.var_costs + sums, sums
     if graph.agg_perm is not None:
         flats = [msgs.reshape(-1, d) for msgs in f2v]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(

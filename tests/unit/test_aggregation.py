@@ -39,7 +39,7 @@ def _coloring(n_vars=300, seed=5):
     return dcop
 
 
-@pytest.mark.parametrize("strategy", ["sorted", "boundary"])
+@pytest.mark.parametrize("strategy", ["sorted", "boundary", "ell"])
 def test_aggregate_matches_scatter(strategy):
     dcop = _coloring()
     g_sc, _ = compile_dcop(dcop, noise_level=0.01)
@@ -69,6 +69,46 @@ def test_full_solve_same_assignment_sorted():
                 algo_params={"aggregation": "sorted"})
     assert alt["cost"] == base["cost"]
     assert alt["assignment"] == base["assignment"]
+
+
+def test_full_solve_same_assignment_ell():
+    from pydcop_tpu.api import solve
+
+    dcop = _coloring(n_vars=150, seed=9)
+    base = solve(dcop, "maxsum", max_cycles=60)
+    alt = solve(dcop, "maxsum", max_cycles=60,
+                algo_params={"aggregation": "ell"})
+    assert alt["cost"] == base["cost"]
+    assert alt["assignment"] == base["assignment"]
+
+
+def test_ell_lists_cover_every_real_edge_once():
+    """Structural invariant behind the dense-gather path: every real
+    edge index appears in exactly one variable's list, every dummy
+    slot holds E, and the sentinel row is all-dummy."""
+    dcop = _coloring(n_vars=80, seed=4)
+    graph, _ = compile_dcop(dcop, aggregation="ell")
+    seg = np.concatenate(
+        [b.var_ids.reshape(-1) for b in graph.buckets])
+    n_edges = seg.size
+    ell = np.asarray(graph.agg_ell)
+    assert ell.shape[0] == graph.var_costs.shape[0]
+    assert (ell[-1] == n_edges).all()          # sentinel row: dummies
+    real_entries = ell[ell < n_edges]
+    # Each real edge appears exactly once, in its own variable's row.
+    assert sorted(real_entries.tolist()) == list(range(n_edges))
+    rows, _ = np.nonzero(ell < n_edges)
+    np.testing.assert_array_equal(
+        seg[real_entries], rows.astype(seg.dtype))
+
+
+def test_ell_max_degree_matches_k():
+    dcop = _coloring(n_vars=80, seed=4)
+    graph, _ = compile_dcop(dcop, aggregation="ell")
+    seg = np.concatenate(
+        [b.var_ids.reshape(-1) for b in graph.buckets])
+    counts = np.bincount(seg, minlength=graph.var_costs.shape[0])
+    assert graph.agg_ell.shape[1] == counts[:-1].max()
 
 
 def test_boundary_not_a_solve_option():
