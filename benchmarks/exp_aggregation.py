@@ -46,7 +46,8 @@ def build(n_vars, n_edges, d, seed=0):
     ends = np.searchsorted(sorted_seg, np.arange(n_vars),
                            side="right").astype(np.int32)
     # ELL: per-variable edge lists padded to the max degree; dummy
-    # slots hold n_edges (a zero row is appended there by the kernel).
+    # slots hold n_edges (the kernel clips the index and masks the
+    # contribution to zero).
     k_max = max(int((ends - starts).max()), 1)
     ell = np.full((n_vars, k_max), n_edges, np.int32)
     k_pos = np.arange(n_edges) - starts[sorted_seg]

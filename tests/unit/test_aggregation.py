@@ -60,26 +60,31 @@ def test_aggregate_matches_scatter(strategy):
         np.asarray(b_sc), np.asarray(b_st), rtol=1e-5, atol=1e-5)
 
 
-def test_full_solve_same_assignment_sorted():
+@pytest.mark.parametrize("strategy", ["sorted", "ell"])
+def test_full_solve_same_assignment(strategy):
     from pydcop_tpu.api import solve
 
     dcop = _coloring(n_vars=150, seed=9)
     base = solve(dcop, "maxsum", max_cycles=60)
     alt = solve(dcop, "maxsum", max_cycles=60,
-                algo_params={"aggregation": "sorted"})
+                algo_params={"aggregation": strategy})
     assert alt["cost"] == base["cost"]
     assert alt["assignment"] == base["assignment"]
 
 
-def test_full_solve_same_assignment_ell():
+@pytest.mark.parametrize("strategy", ["sorted", "ell"])
+def test_non_scatter_aggregation_rejected_on_mesh(strategy):
+    """shard_graph drops the agg_* arrays, so a non-scatter strategy
+    on a mesh would silently measure scatter — build_engine must
+    refuse loudly instead."""
     from pydcop_tpu.api import solve
 
-    dcop = _coloring(n_vars=150, seed=9)
-    base = solve(dcop, "maxsum", max_cycles=60)
-    alt = solve(dcop, "maxsum", max_cycles=60,
-                algo_params={"aggregation": "ell"})
-    assert alt["cost"] == base["cost"]
-    assert alt["assignment"] == base["assignment"]
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device backend")
+    dcop = _coloring(n_vars=64, seed=2)
+    with pytest.raises(ValueError, match="single-device"):
+        solve(dcop, "maxsum", max_cycles=5, n_devices=2,
+              algo_params={"aggregation": strategy})
 
 
 def test_ell_lists_cover_every_real_edge_once():
