@@ -56,9 +56,33 @@ def _fix_other_axes(costs: jnp.ndarray, var_ids: jnp.ndarray,
 def candidate_costs(graph: CompiledFactorGraph,
                     values: jnp.ndarray) -> jnp.ndarray:
     """[V+1, D]: cost of each candidate value per variable, given all
-    other variables at `values` (includes own unary costs)."""
+    other variables at `values` (includes own unary costs).
+
+    With ``graph.agg_ell`` set (compile_dcop(aggregation='ell')) the
+    per-position sums use the same dense-gather edge lists as MaxSum's
+    aggregate_beliefs instead of scatter-adds: the flattened
+    (bucket, factor, position) edge order here matches the one the
+    ell lists index, so the arrays are shared between the two kernel
+    families."""
     cand = graph.var_costs
     n_segments = graph.var_costs.shape[0]
+    if graph.agg_ell is not None:
+        d = graph.var_costs.shape[1]
+        flats = []
+        for bucket in graph.buckets:
+            arity = bucket.var_ids.shape[1]
+            per_p = [
+                _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
+                for p in range(arity)
+            ]
+            flats.append(jnp.stack(per_p, axis=1).reshape(-1, d))
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(
+            flats, axis=0)
+        n_edges = flat.shape[0]
+        safe = jnp.minimum(graph.agg_ell, n_edges - 1)
+        mask = (graph.agg_ell < n_edges)[..., None]
+        return cand + jnp.sum(
+            jnp.where(mask, flat[safe], 0.0), axis=1)
     for bucket in graph.buckets:
         arity = bucket.var_ids.shape[1]
         for p in range(arity):

@@ -59,6 +59,37 @@ def test_candidate_costs_are_one_flip_costs():
     assert cand[2, 0] == 5.0 and cand[2, 1] == 0.0
 
 
+def test_candidate_costs_ell_matches_scatter():
+    """The dense-gather (ell) branch must reproduce the scatter branch
+    exactly up to float reassociation — including across MIXED-arity
+    buckets, whose flattened edge orders must line up with the
+    compile-time ell lists."""
+    from pydcop_tpu.engine.compile import build_aggregation_arrays
+
+    rng = np.random.default_rng(12)
+    d = Domain("d", "", [0, 1, 2])
+    vs = [Variable(f"v{i}", d) for i in range(40)]
+    cs = []
+    for k in range(50):
+        i, j = rng.choice(40, size=2, replace=False)
+        cs.append(NAryMatrixRelation(
+            [vs[i], vs[j]], rng.random((3, 3)).round(3), f"b{k}"))
+    for k in range(15):
+        i, j, m = rng.choice(40, size=3, replace=False)
+        cs.append(NAryMatrixRelation(
+            [vs[i], vs[j], vs[m]], rng.random((3, 3, 3)).round(3),
+            f"t{k}"))
+    graph, _ = compile_factor_graph(vs, cs, noise_level=0.0)
+    _, _, _, _, ell = build_aggregation_arrays(
+        graph.buckets, graph.var_costs.shape[0], "ell")
+    g_ell = graph._replace(agg_ell=ell)
+    values = jnp.asarray(
+        np.append(rng.integers(0, 3, size=40), 0).astype(np.int32))
+    base = np.asarray(ls.candidate_costs(graph, values))
+    got = np.asarray(ls.candidate_costs(g_ell, values))
+    np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-4)
+
+
 def test_candidate_costs_consistent_with_assignment_cost():
     """Flipping variable i to value k changes the total by exactly
     cand[i,k] - cand[i,current] (the local-search invariant)."""
