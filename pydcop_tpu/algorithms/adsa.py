@@ -63,13 +63,22 @@ import jax.numpy as jnp
 from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
 from pydcop_tpu.algorithms import dsa as _dsa
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.engine.compile import compile_dcop, validated_aggregation
 from pydcop_tpu.engine.runner import DeviceRunResult, run_device_fn
 from pydcop_tpu.ops.dsa import greedy_classes, run_dsa
 
 GRAPH_TYPE = "constraints_hypergraph"
 
 algo_params = [
+    # Variable-aggregation strategy for the shared local-search
+    # kernels (ops/localsearch.py): "scatter" is the parity
+    # default; "ell" replaces every segment_sum/max/min with
+    # compile-time dense-gather edge lists (the TPU HBM-regime
+    # candidate, benchmarks/exp_aggregation.py).  Single-device;
+    # sharded runs always use scatter.
+    AlgoParameterDef(
+        "aggregation", "str", ["scatter", "ell"], "scatter"
+    ),
     AlgoParameterDef("probability", "float", None, 0.7),
     AlgoParameterDef("variant", "str", ["A", "B", "C"], "B"),
     AlgoParameterDef("period", "float", None, 0.5),
@@ -126,7 +135,9 @@ def _solve_staggered(dcop: DCOP, algo_def: AlgorithmDef, *,
     async runtime's one per period."""
     params = algo_def.params
     pad_to = mesh.size if mesh is not None else (n_devices or 1)
-    graph, meta = compile_dcop(dcop, pad_to=pad_to)
+    graph, meta = compile_dcop(
+        dcop, pad_to=pad_to,
+        aggregation=validated_aggregation(params, pad_to))
     classes_np, n_classes = greedy_classes(graph)
     classes = jnp.asarray(classes_np)
     cycles = params.get("stop_cycle") or max_cycles

@@ -25,7 +25,7 @@ from typing import Optional
 from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
 from pydcop_tpu.computations_graph import constraints_hypergraph as chg
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.engine.compile import compile_dcop, validated_aggregation
 from pydcop_tpu.engine.runner import DeviceRunResult, run_device_fn
 from pydcop_tpu.ops.gdba import run_gdba
 
@@ -35,6 +35,15 @@ HEADER_SIZE = 100
 UNIT_SIZE = 5
 
 algo_params = [
+    # Variable-aggregation strategy for the shared local-search
+    # kernels (ops/localsearch.py): "scatter" is the parity
+    # default; "ell" replaces every segment_sum/max/min with
+    # compile-time dense-gather edge lists (the TPU HBM-regime
+    # candidate, benchmarks/exp_aggregation.py).  Single-device;
+    # sharded runs always use scatter.
+    AlgoParameterDef(
+        "aggregation", "str", ["scatter", "ell"], "scatter"
+    ),
     AlgoParameterDef("modifier", "str", ["A", "M"], "A"),
     AlgoParameterDef("violation", "str", ["NZ", "NM", "MX"], "NZ"),
     AlgoParameterDef("increase_mode", "str", ["E", "R", "C", "T"], "E"),
@@ -67,7 +76,9 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
 
     params = algo_def.params
     pad_to = mesh.size if mesh is not None else (n_devices or 1)
-    graph, meta = compile_dcop(dcop, pad_to=pad_to)
+    graph, meta = compile_dcop(
+        dcop, pad_to=pad_to,
+        aggregation=validated_aggregation(params, pad_to))
     fn = partial(
         run_gdba,
         max_cycles=max_cycles,

@@ -36,7 +36,7 @@ from typing import Optional
 from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
 from pydcop_tpu.computations_graph import factor_graph as fg
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.engine.compile import compile_dcop, validated_aggregation
 from pydcop_tpu.engine.runner import DeviceRunResult, MaxSumEngine
 
 GRAPH_TYPE = "factor_graph"
@@ -114,19 +114,9 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
         pad_to = mesh.size
     elif n_devices:
         pad_to = n_devices
-    aggregation = params.get("aggregation", "scatter")
-    if pad_to > 1 and aggregation != "scatter":
-        # shard_graph rebuilds the graph WITHOUT the agg_* arrays, so
-        # a non-scatter strategy on a mesh would silently measure
-        # scatter — refuse loudly instead (same policy as the
-        # lane-layout guard in MaxSumEngine).
-        raise ValueError(
-            f"aggregation={aggregation!r} is single-device; sharded "
-            "runs always use the scatter path (engine/sharding."
-            "shard_graph drops the aggregation arrays)")
     graph, meta = compile_dcop(
         dcop, noise_level=params.get("noise", 0.01), pad_to=pad_to,
-        aggregation=aggregation,
+        aggregation=validated_aggregation(params, pad_to),
     )
     return MaxSumEngine(
         graph, meta,

@@ -27,7 +27,7 @@ import numpy as np
 from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
 from pydcop_tpu.computations_graph import constraints_hypergraph as chg
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.engine.compile import compile_dcop, validated_aggregation
 from pydcop_tpu.engine.runner import DeviceRunResult, run_device_fn
 from pydcop_tpu.ops.mgm import run_mgm
 
@@ -37,6 +37,15 @@ HEADER_SIZE = 0
 UNIT_SIZE = 1
 
 algo_params = [
+    # Variable-aggregation strategy for the shared local-search
+    # kernels (ops/localsearch.py): "scatter" is the parity
+    # default; "ell" replaces every segment_sum/max/min with
+    # compile-time dense-gather edge lists (the TPU HBM-regime
+    # candidate, benchmarks/exp_aggregation.py).  Single-device;
+    # sharded runs always use scatter.
+    AlgoParameterDef(
+        "aggregation", "str", ["scatter", "ell"], "scatter"
+    ),
     AlgoParameterDef("break_mode", "str", ["lexic", "random"], "lexic"),
     AlgoParameterDef("stop_cycle", "int", None, 0),
     AlgoParameterDef("seed", "int", None, 0),
@@ -77,7 +86,9 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     **_) -> DeviceRunResult:
     params = algo_def.params
     pad_to = mesh.size if mesh is not None else (n_devices or 1)
-    graph, meta = compile_dcop(dcop, pad_to=pad_to)
+    graph, meta = compile_dcop(
+        dcop, pad_to=pad_to,
+        aggregation=validated_aggregation(params, pad_to))
     cycles = params.get("stop_cycle") or max_cycles
     fn = partial(
         run_mgm,

@@ -24,7 +24,7 @@ from typing import Optional
 
 from pydcop_tpu.algorithms import AlgoParameterDef, AlgorithmDef
 from pydcop_tpu.dcop.dcop import DCOP
-from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.engine.compile import compile_dcop, validated_aggregation
 from pydcop_tpu.engine.runner import DeviceRunResult, run_device_fn
 from pydcop_tpu.ops.mgm2 import run_mgm2
 
@@ -34,6 +34,15 @@ HEADER_SIZE = 100
 UNIT_SIZE = 5
 
 algo_params = [
+    # Variable-aggregation strategy for the shared local-search
+    # kernels (ops/localsearch.py): "scatter" is the parity
+    # default; "ell" replaces every segment_sum/max/min with
+    # compile-time dense-gather edge lists (the TPU HBM-regime
+    # candidate, benchmarks/exp_aggregation.py).  Single-device;
+    # sharded runs always use scatter.
+    AlgoParameterDef(
+        "aggregation", "str", ["scatter", "ell"], "scatter"
+    ),
     AlgoParameterDef("threshold", "float", None, 0.5),
     AlgoParameterDef(
         "favor", "str", ["unilateral", "no", "coordinated"], "unilateral"
@@ -79,7 +88,9 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
 
     params = algo_def.params
     pad_to = mesh.size if mesh is not None else (n_devices or 1)
-    graph, meta = compile_dcop(dcop, pad_to=pad_to)
+    graph, meta = compile_dcop(
+        dcop, pad_to=pad_to,
+        aggregation=validated_aggregation(params, pad_to))
     cycles = params.get("stop_cycle") or max_cycles
     fn = partial(
         run_mgm2,

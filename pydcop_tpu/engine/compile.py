@@ -156,6 +156,20 @@ def _round_up(n: int, multiple: int) -> int:
 AGGREGATIONS = ("scatter", "sorted", "boundary", "ell")
 
 
+def validated_aggregation(params: dict, pad_to: int) -> str:
+    """Resolve an algorithm's ``aggregation`` param against the mesh
+    size.  shard_graph rebuilds graphs WITHOUT the agg_* arrays, so a
+    non-scatter strategy on a mesh would silently measure scatter —
+    refuse loudly instead (one policy for every algorithm family)."""
+    aggregation = params.get("aggregation", "scatter")
+    if pad_to > 1 and aggregation != "scatter":
+        raise ValueError(
+            f"aggregation={aggregation!r} is single-device; sharded "
+            "runs always use the scatter path (engine/sharding."
+            "shard_graph drops the aggregation arrays)")
+    return aggregation
+
+
 def build_aggregation_arrays(buckets: Sequence[FactorBucket],
                              n_segments: int, aggregation: str):
     """Compile-time edge indexing for the non-scatter aggregation paths.

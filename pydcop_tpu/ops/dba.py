@@ -37,6 +37,7 @@ from pydcop_tpu.ops.localsearch import (
     factor_current_costs,
     neighbor_max,
     neighborhood_winners,
+    positional_sum,
     random_initial_values,
 )
 
@@ -73,18 +74,17 @@ def _weighted_violation_counts(graph: CompiledFactorGraph,
     """[V+1, D]: per variable and candidate value, the weighted count of
     incident violated constraints, neighbors at `values`
     (compute_eval_value, dba.py:452 — constraints only, no unary costs)."""
-    n_segments = graph.var_costs.shape[0]
-    cand = jnp.zeros_like(graph.var_costs)
+    per_bucket = []
     for bucket, w in zip(graph.buckets, weights):
         arity = bucket.var_ids.shape[1]
+        cols = []
         for p in range(arity):
             fixed = _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
             viol = (fixed >= infinity).astype(jnp.float32)
-            cand = cand + jax.ops.segment_sum(
-                w[:, p:p + 1] * viol, bucket.var_ids[:, p],
-                num_segments=n_segments,
-            )
-    return cand
+            cols.append(w[:, p:p + 1] * viol)
+        per_bucket.append(jnp.stack(cols, axis=1))
+    return positional_sum(
+        graph, per_bucket, jnp.zeros_like(graph.var_costs))
 
 
 def violation_count(graph: CompiledFactorGraph, values: jnp.ndarray,

@@ -27,6 +27,7 @@ from pydcop_tpu.ops.localsearch import (
     best_candidates,
     candidate_costs,
     factor_current_costs,
+    positional_max,
     random_best_choice,
     random_initial_values,
 )
@@ -111,18 +112,15 @@ def violated_vars(graph: CompiledFactorGraph,
                   values: jnp.ndarray) -> jnp.ndarray:
     """[V+1] bool: has an incident constraint not at its optimal cost
     (reference exists_violated_constraint, dsa.py:419)."""
-    n_segments = graph.var_costs.shape[0]
-    out = jnp.zeros((n_segments,), dtype=jnp.int32)
+    per_bucket = []
     for bucket, cur, opt in zip(
         graph.buckets, factor_current_costs(graph, values),
         _factor_optima(graph),
     ):
         viol = (cur != opt).astype(jnp.int32)
-        for p in range(bucket.var_ids.shape[1]):
-            out = jnp.maximum(out, jax.ops.segment_max(
-                viol, bucket.var_ids[:, p], num_segments=n_segments
-            ))
-    return out > 0
+        per_bucket.append(jnp.broadcast_to(
+            viol[:, None], bucket.var_ids.shape))
+    return positional_max(graph, per_bucket, jnp.int32(0)) > 0
 
 
 def dsa_step(state: DsaState, graph: CompiledFactorGraph, *,

@@ -45,6 +45,7 @@ from pydcop_tpu.ops.localsearch import (
     factor_min_over_valid,
     factor_valid_masks,
     neighborhood_winners,
+    positional_sum,
     random_initial_values,
 )
 
@@ -94,20 +95,19 @@ def _candidate_eff_costs(graph: CompiledFactorGraph,
                          modifier_mode: str) -> jnp.ndarray:
     """[V+1, D]: effective cost per variable and candidate value, others
     at `values` (compute_eval_value + _eff_cost, gdba.py:428-461)."""
-    n_segments = graph.var_costs.shape[0]
-    cand = graph.var_costs
+    per_bucket = []
     for bucket, mods in zip(graph.buckets, modifiers):
         arity = bucket.var_ids.shape[1]
+        cols = []
         for p in range(arity):
             if modifier_mode == "A":
                 eff = bucket.costs + mods[:, p]
             else:
                 eff = bucket.costs * mods[:, p]
-            fixed = _fix_other_axes(eff, bucket.var_ids, values, p)
-            cand = cand + jax.ops.segment_sum(
-                fixed, bucket.var_ids[:, p], num_segments=n_segments
-            )
-    return cand
+            cols.append(
+                _fix_other_axes(eff, bucket.var_ids, values, p))
+        per_bucket.append(jnp.stack(cols, axis=1))
+    return positional_sum(graph, per_bucket, graph.var_costs)
 
 
 def _increase_delta(bucket, values: jnp.ndarray, mask: jnp.ndarray,

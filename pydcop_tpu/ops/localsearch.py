@@ -53,44 +53,72 @@ def _fix_other_axes(costs: jnp.ndarray, var_ids: jnp.ndarray,
     return out  # [F, D]
 
 
-def candidate_costs(graph: CompiledFactorGraph,
-                    values: jnp.ndarray) -> jnp.ndarray:
-    """[V+1, D]: cost of each candidate value per variable, given all
-    other variables at `values` (includes own unary costs).
-
-    With ``graph.agg_ell`` set (compile_dcop(aggregation='ell')) the
-    per-position sums use the same dense-gather edge lists as MaxSum's
-    aggregate_beliefs instead of scatter-adds: the flattened
-    (bucket, factor, position) edge order here matches the one the
-    ell lists index, so the arrays are shared between the two kernel
-    families."""
-    cand = graph.var_costs
-    n_segments = graph.var_costs.shape[0]
+def positional_sum(graph: CompiledFactorGraph, per_bucket,
+                   init: jnp.ndarray) -> jnp.ndarray:
+    """``init`` [V+1, D] plus, per variable, the sum of its incident
+    (bucket, factor, position) contributions.  ``per_bucket`` is one
+    [F, arity, D] array per bucket — the same flattened edge order the
+    compile-time ell lists index, so with ``graph.agg_ell`` set the
+    sums are a dense gather + K-way masked sum (no scatter); otherwise
+    one segment_sum per position (identical addition order, so the two
+    backends of every caller stay float-comparable)."""
     if graph.agg_ell is not None:
-        d = graph.var_costs.shape[1]
-        flats = []
-        for bucket in graph.buckets:
-            arity = bucket.var_ids.shape[1]
-            per_p = [
-                _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
-                for p in range(arity)
-            ]
-            flats.append(jnp.stack(per_p, axis=1).reshape(-1, d))
+        d = init.shape[1]
+        flats = [v.reshape(-1, d) for v in per_bucket]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(
             flats, axis=0)
         n_edges = flat.shape[0]
         safe = jnp.minimum(graph.agg_ell, n_edges - 1)
         mask = (graph.agg_ell < n_edges)[..., None]
-        return cand + jnp.sum(
+        return init + jnp.sum(
             jnp.where(mask, flat[safe], 0.0), axis=1)
+    out = init
+    n_segments = init.shape[0]
+    for bucket, vals in zip(graph.buckets, per_bucket):
+        for p in range(bucket.var_ids.shape[1]):
+            out = out + jax.ops.segment_sum(
+                vals[:, p], bucket.var_ids[:, p],
+                num_segments=n_segments,
+            )
+    return out
+
+
+def positional_max(graph: CompiledFactorGraph, per_bucket,
+                   fill) -> jnp.ndarray:
+    """[V+1]: per variable, max over its incident (bucket, factor,
+    position) slots of per-edge scalars (``per_bucket``: one
+    [F, arity] array per bucket); ``fill`` for variables with no
+    incident slots."""
+    n_segments = graph.var_costs.shape[0]
+    if graph.agg_ell is not None:
+        return _ell_reduce(graph, _edge_flat(per_bucket), fill, jnp.max)
+    out = jnp.full((n_segments,), fill, dtype=per_bucket[0].dtype)
+    for bucket, vals in zip(graph.buckets, per_bucket):
+        for p in range(bucket.var_ids.shape[1]):
+            out = jnp.maximum(out, jax.ops.segment_max(
+                vals[:, p], bucket.var_ids[:, p],
+                num_segments=n_segments,
+            ))
+    return out
+
+
+def candidate_costs(graph: CompiledFactorGraph,
+                    values: jnp.ndarray) -> jnp.ndarray:
+    """[V+1, D]: cost of each candidate value per variable, given all
+    other variables at `values` (includes own unary costs).
+
+    Routed through :func:`positional_sum`, so with
+    ``graph.agg_ell`` set (compile_dcop(aggregation='ell')) the sums
+    use the same dense-gather edge lists as MaxSum's
+    aggregate_beliefs instead of scatter-adds."""
+    per_bucket = []
     for bucket in graph.buckets:
         arity = bucket.var_ids.shape[1]
-        for p in range(arity):
-            fixed = _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
-            cand = cand + jax.ops.segment_sum(
-                fixed, bucket.var_ids[:, p], num_segments=n_segments
-            )
-    return cand
+        per_bucket.append(jnp.stack([
+            _fix_other_axes(bucket.costs, bucket.var_ids, values, p)
+            for p in range(arity)
+        ], axis=1))
+    return positional_sum(graph, per_bucket, graph.var_costs)
 
 
 def factor_current_costs(graph: CompiledFactorGraph,
@@ -121,11 +149,52 @@ def assignment_cost(graph: CompiledFactorGraph,
     return total
 
 
+def _ell_reduce(graph: CompiledFactorGraph, edge_vals: jnp.ndarray,
+                fill, reduce_fn) -> jnp.ndarray:
+    """Aggregate per-edge values into per-variable reductions via the
+    compile-time ell lists ([V+1]).  ``edge_vals`` is [E] in the same
+    flattened (bucket, factor, position) order the lists index; dummy
+    slots read ``fill`` (the reduction's identity)."""
+    n_edges = edge_vals.shape[0]
+    safe = jnp.minimum(graph.agg_ell, n_edges - 1)
+    mask = graph.agg_ell < n_edges
+    gathered = jnp.where(mask, edge_vals[safe], fill)
+    return reduce_fn(gathered, axis=1)
+
+
+def _edge_flat(per_bucket) -> jnp.ndarray:
+    """Concatenate per-bucket [F, arity] edge values into the flat [E]
+    order build_aggregation_arrays indexes."""
+    flats = [v.reshape(-1) for v in per_bucket]
+    return flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+
+
 def neighbor_max(graph: CompiledFactorGraph,
                  per_var: jnp.ndarray) -> jnp.ndarray:
     """[V+1]: max of `per_var` over each variable's neighbors (variables
-    sharing a constraint), excluding the variable itself."""
+    sharing a constraint), excluding the variable itself.
+
+    With ``graph.agg_ell`` set, the per-edge co-variable maxima are
+    computed densely in edge space and reduced through the ell lists
+    (no segment_max scatter)."""
     n_segments = graph.var_costs.shape[0]
+    if graph.agg_ell is not None:
+        per_bucket = []
+        for bucket in graph.buckets:
+            arity = bucket.var_ids.shape[1]
+            vals = per_var[bucket.var_ids]          # [F, arity]
+            cols = []
+            for p in range(arity):
+                # Unary factors have no co-variable: identity element.
+                m = jnp.full(vals.shape[:1], -jnp.inf, vals.dtype)
+                for q in range(arity):
+                    if q == p:
+                        continue
+                    m = jnp.maximum(m, vals[:, q])
+                cols.append(m)
+            per_bucket.append(jnp.stack(cols, axis=1))
+        return _ell_reduce(
+            graph, _edge_flat(per_bucket), -jnp.inf, jnp.max)
     out = jnp.full((n_segments,), -jnp.inf, dtype=per_var.dtype)
     for bucket in graph.buckets:
         arity = bucket.var_ids.shape[1]
@@ -150,6 +219,27 @@ def neighbor_min_rank_where(graph: CompiledFactorGraph,
     `ranks` is float (lexical index or per-cycle random draws)."""
     n_segments = graph.var_costs.shape[0]
     ranks = jnp.asarray(ranks, dtype=jnp.float32)
+    if graph.agg_ell is not None:
+        per_bucket = []
+        for bucket in graph.buckets:
+            arity = bucket.var_ids.shape[1]
+            pv = per_var[bucket.var_ids]            # [F, arity]
+            rk = ranks[bucket.var_ids]
+            tgt = target[bucket.var_ids]
+            cols = []
+            for p in range(arity):
+                # Unary factors have no co-variable: identity element.
+                m = jnp.full(pv.shape[:1], jnp.inf, jnp.float32)
+                for q in range(arity):
+                    if q == p:
+                        continue
+                    cand = jnp.where(
+                        pv[:, q] == tgt[:, p], rk[:, q], jnp.inf)
+                    m = jnp.minimum(m, cand)
+                cols.append(m)
+            per_bucket.append(jnp.stack(cols, axis=1))
+        return _ell_reduce(
+            graph, _edge_flat(per_bucket), jnp.inf, jnp.min)
     out = jnp.full((n_segments,), jnp.inf, dtype=jnp.float32)
     for bucket in graph.buckets:
         arity = bucket.var_ids.shape[1]

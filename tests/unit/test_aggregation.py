@@ -72,6 +72,23 @@ def test_full_solve_same_assignment(strategy):
     assert alt["assignment"] == base["assignment"]
 
 
+@pytest.mark.parametrize(
+    "algo", ["dsa", "mgm", "dba", "gdba", "mgm2", "mixeddsa"])
+def test_local_search_ell_bit_parity(algo):
+    """With integer constraint costs, the ell sums are exact, so the
+    local-search trajectory (and final assignment) must be
+    bit-identical to the scatter path for every algorithm exposing
+    the param."""
+    from pydcop_tpu.api import solve
+
+    dcop = _coloring(n_vars=120, seed=7)
+    base = solve(dcop, algo, max_cycles=40, algo_params={"seed": 3})
+    alt = solve(dcop, algo, max_cycles=40,
+                algo_params={"seed": 3, "aggregation": "ell"})
+    assert alt["cost"] == base["cost"]
+    assert alt["assignment"] == base["assignment"]
+
+
 @pytest.mark.parametrize("strategy", ["sorted", "ell"])
 def test_non_scatter_aggregation_rejected_on_mesh(strategy):
     """shard_graph drops the agg_* arrays, so a non-scatter strategy

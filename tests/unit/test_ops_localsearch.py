@@ -59,14 +59,12 @@ def test_candidate_costs_are_one_flip_costs():
     assert cand[2, 0] == 5.0 and cand[2, 1] == 0.0
 
 
-def test_candidate_costs_ell_matches_scatter():
-    """The dense-gather (ell) branch must reproduce the scatter branch
-    exactly up to float reassociation — including across MIXED-arity
-    buckets, whose flattened edge orders must line up with the
-    compile-time ell lists."""
+def _mixed_arity_pair(seed=12):
+    """(scatter graph, same graph with ell lists) over a random mixed
+    binary + ternary problem."""
     from pydcop_tpu.engine.compile import build_aggregation_arrays
 
-    rng = np.random.default_rng(12)
+    rng = np.random.default_rng(seed)
     d = Domain("d", "", [0, 1, 2])
     vs = [Variable(f"v{i}", d) for i in range(40)]
     cs = []
@@ -82,12 +80,45 @@ def test_candidate_costs_ell_matches_scatter():
     graph, _ = compile_factor_graph(vs, cs, noise_level=0.0)
     _, _, _, _, ell = build_aggregation_arrays(
         graph.buckets, graph.var_costs.shape[0], "ell")
-    g_ell = graph._replace(agg_ell=ell)
+    return graph, graph._replace(agg_ell=ell), rng
+
+
+def test_candidate_costs_ell_matches_scatter():
+    """The dense-gather (ell) branch must reproduce the scatter branch
+    exactly up to float reassociation — including across MIXED-arity
+    buckets, whose flattened edge orders must line up with the
+    compile-time ell lists."""
+    graph, g_ell, rng = _mixed_arity_pair()
     values = jnp.asarray(
         np.append(rng.integers(0, 3, size=40), 0).astype(np.int32))
     base = np.asarray(ls.candidate_costs(graph, values))
     got = np.asarray(ls.candidate_costs(g_ell, values))
     np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-4)
+
+
+def test_neighbor_max_ell_matches_scatter():
+    graph, g_ell, rng = _mixed_arity_pair(seed=21)
+    per_var = jnp.asarray(
+        rng.normal(size=graph.var_costs.shape[0]).astype(np.float32))
+    base = np.asarray(ls.neighbor_max(graph, per_var))
+    got = np.asarray(ls.neighbor_max(g_ell, per_var))
+    np.testing.assert_array_equal(got[:-1], base[:-1])  # maxima: exact
+
+
+def test_neighbor_min_rank_where_ell_matches_scatter():
+    graph, g_ell, rng = _mixed_arity_pair(seed=22)
+    n = graph.var_costs.shape[0]
+    # Coarse-grained values so eligibility ties actually occur.
+    per_var = jnp.asarray(
+        rng.integers(0, 3, size=n).astype(np.float32))
+    target = jnp.asarray(
+        rng.integers(0, 3, size=n).astype(np.float32))
+    ranks = jnp.asarray(rng.permutation(n).astype(np.float32))
+    base = np.asarray(
+        ls.neighbor_min_rank_where(graph, per_var, target, ranks))
+    got = np.asarray(
+        ls.neighbor_min_rank_where(g_ell, per_var, target, ranks))
+    np.testing.assert_array_equal(got[:-1], base[:-1])
 
 
 def test_candidate_costs_consistent_with_assignment_cost():
