@@ -89,14 +89,25 @@ def maxsum_superstep_bytes(graph: CompiledFactorGraph) -> int:
     """HBM traffic (bytes) one fused superstep must move at minimum:
     read every factor cost table once, read old + write new messages on
     both sides (4 × [F, a, D]), read/write the [V, D] belief/sum
-    tables a handful of times."""
+    tables a handful of times.
+
+    With the ell aggregation the variable-side sum reads messages
+    through the padded [V+1, K] edge lists instead of one scatter
+    pass: V·K message rows (padding waste included — the kernel's
+    clipped dummy reads are real traffic) plus the index array
+    itself, replacing one of the six message passes."""
     itemsize = graph.var_costs.dtype.itemsize
+    d = graph.var_costs.shape[1]
     total = 4 * graph.var_costs.size * itemsize
+    msg_passes = 6
+    if graph.agg_ell is not None:
+        total += graph.agg_ell.size * 4           # edge-list reads
+        total += graph.agg_ell.size * d * itemsize  # padded gather
+        msg_passes = 5                            # replaces one pass
     for b in graph.buckets:
         f, a = b.var_ids.shape
-        d = graph.var_costs.shape[1]
         total += b.costs.size * itemsize          # cost tables (read)
-        total += 6 * f * a * d * itemsize         # v2f/f2v old+new
+        total += msg_passes * f * a * d * itemsize  # v2f/f2v old+new
         total += b.var_ids.size * 4               # gather indices
     return int(total)
 
@@ -106,6 +117,8 @@ def working_set_bytes(graph: CompiledFactorGraph) -> int:
     their suppression counters (ops/maxsum.MaxSumState)."""
     total = graph.var_costs.size * graph.var_costs.dtype.itemsize
     total += graph.var_valid.size  # bool
+    if graph.agg_ell is not None:
+        total += graph.agg_ell.size * 4
     d = graph.var_costs.shape[1]
     for b in graph.buckets:
         f, a = b.var_ids.shape

@@ -130,3 +130,26 @@ def test_rejects_lane_graph():
     lane = to_lane_graph(_graph(n_vars=4, arity2=3))
     with pytest.raises(TypeError, match="edge-major"):
         roofline_report(lane, cycles_per_s=1000.0, platform="cpu")
+
+
+def test_ell_graph_counts_list_traffic():
+    """An ell graph's byte model must charge the edge-list reads and
+    the padded gather (V*K rows, padding waste included) in place of
+    one scatter message pass, and carry the lists in the working
+    set."""
+    from pydcop_tpu.engine.compile import build_aggregation_arrays
+    from pydcop_tpu.engine.roofline import working_set_bytes
+
+    graph = _graph(n_vars=6, arity2=5)
+    _, _, _, _, ell = build_aggregation_arrays(
+        graph.buckets, graph.var_costs.shape[0], "ell")
+    g_ell = graph._replace(agg_ell=ell)
+    d = graph.var_costs.shape[1]
+    itemsize = graph.var_costs.dtype.itemsize
+    delta = maxsum_superstep_bytes(g_ell) - maxsum_superstep_bytes(graph)
+    f, a = graph.buckets[0].var_ids.shape
+    expected = (ell.size * 4 + ell.size * d * itemsize
+                - f * a * d * itemsize)
+    assert delta == expected
+    assert (working_set_bytes(g_ell) - working_set_bytes(graph)
+            == ell.size * 4)
