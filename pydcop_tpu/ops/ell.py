@@ -1,0 +1,34 @@
+"""The shared ELL (padded edge-list) gather-reduce primitive.
+
+One definition of the clip+mask dense gather that both kernel families
+aggregate through when a graph carries ``agg_ell`` (compile_dcop
+(aggregation='ell'), engine/compile.build_aggregation_arrays):
+MaxSum's belief aggregation (ops/maxsum.aggregate_beliefs) and the
+local-search positional sums/reductions (ops/localsearch).
+
+Dummy slots in the [V+1, K] lists hold E (one past the last edge);
+the gather clips the index (a real, counted read — see
+engine/roofline.maxsum_superstep_bytes) and the mask replaces the
+value with the reduction's identity.  A zero-row append would be
+simpler but copies the whole edge array every cycle.
+"""
+
+import jax.numpy as jnp
+
+
+def gather_reduce(ell: jnp.ndarray, edge_vals: jnp.ndarray, fill,
+                  reduce_fn) -> jnp.ndarray:
+    """Reduce per-edge values into per-variable values through the
+    ell lists.
+
+    ``edge_vals`` is [E] or [E, D] in the flattened (bucket, factor,
+    position) edge order the lists index; returns [V+1] or [V+1, D].
+    ``fill`` is the identity of ``reduce_fn`` (0 for sum, -inf for
+    max, +inf for min).
+    """
+    n_edges = edge_vals.shape[0]
+    safe = jnp.minimum(ell, n_edges - 1)
+    mask = ell < n_edges
+    if edge_vals.ndim == 2:
+        mask = mask[..., None]
+    return reduce_fn(jnp.where(mask, edge_vals[safe], fill), axis=1)

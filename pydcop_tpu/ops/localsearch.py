@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from pydcop_tpu.engine.compile import BIG, CompiledFactorGraph
+from pydcop_tpu.ops.ell import gather_reduce
 
 INT_MAX = jnp.iinfo(jnp.int32).max
 
@@ -62,16 +63,14 @@ def positional_sum(graph: CompiledFactorGraph, per_bucket,
     sums are a dense gather + K-way masked sum (no scatter); otherwise
     one segment_sum per position (identical addition order, so the two
     backends of every caller stay float-comparable)."""
+    if not per_bucket:
+        return init
     if graph.agg_ell is not None:
         d = init.shape[1]
         flats = [v.reshape(-1, d) for v in per_bucket]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(
             flats, axis=0)
-        n_edges = flat.shape[0]
-        safe = jnp.minimum(graph.agg_ell, n_edges - 1)
-        mask = (graph.agg_ell < n_edges)[..., None]
-        return init + jnp.sum(
-            jnp.where(mask, flat[safe], 0.0), axis=1)
+        return init + gather_reduce(graph.agg_ell, flat, 0.0, jnp.sum)
     out = init
     n_segments = init.shape[0]
     for bucket, vals in zip(graph.buckets, per_bucket):
@@ -90,8 +89,11 @@ def positional_max(graph: CompiledFactorGraph, per_bucket,
     [F, arity] array per bucket); ``fill`` for variables with no
     incident slots."""
     n_segments = graph.var_costs.shape[0]
+    if not per_bucket:
+        return jnp.full((n_segments,), fill)
     if graph.agg_ell is not None:
-        return _ell_reduce(graph, _edge_flat(per_bucket), fill, jnp.max)
+        return gather_reduce(
+            graph.agg_ell, _edge_flat(per_bucket), fill, jnp.max)
     out = jnp.full((n_segments,), fill, dtype=per_bucket[0].dtype)
     for bucket, vals in zip(graph.buckets, per_bucket):
         for p in range(bucket.var_ids.shape[1]):
@@ -149,19 +151,6 @@ def assignment_cost(graph: CompiledFactorGraph,
     return total
 
 
-def _ell_reduce(graph: CompiledFactorGraph, edge_vals: jnp.ndarray,
-                fill, reduce_fn) -> jnp.ndarray:
-    """Aggregate per-edge values into per-variable reductions via the
-    compile-time ell lists ([V+1]).  ``edge_vals`` is [E] in the same
-    flattened (bucket, factor, position) order the lists index; dummy
-    slots read ``fill`` (the reduction's identity)."""
-    n_edges = edge_vals.shape[0]
-    safe = jnp.minimum(graph.agg_ell, n_edges - 1)
-    mask = graph.agg_ell < n_edges
-    gathered = jnp.where(mask, edge_vals[safe], fill)
-    return reduce_fn(gathered, axis=1)
-
-
 def _edge_flat(per_bucket) -> jnp.ndarray:
     """Concatenate per-bucket [F, arity] edge values into the flat [E]
     order build_aggregation_arrays indexes."""
@@ -193,8 +182,8 @@ def neighbor_max(graph: CompiledFactorGraph,
                     m = jnp.maximum(m, vals[:, q])
                 cols.append(m)
             per_bucket.append(jnp.stack(cols, axis=1))
-        return _ell_reduce(
-            graph, _edge_flat(per_bucket), -jnp.inf, jnp.max)
+        return gather_reduce(
+            graph.agg_ell, _edge_flat(per_bucket), -jnp.inf, jnp.max)
     out = jnp.full((n_segments,), -jnp.inf, dtype=per_var.dtype)
     for bucket in graph.buckets:
         arity = bucket.var_ids.shape[1]
@@ -238,8 +227,8 @@ def neighbor_min_rank_where(graph: CompiledFactorGraph,
                     m = jnp.minimum(m, cand)
                 cols.append(m)
             per_bucket.append(jnp.stack(cols, axis=1))
-        return _ell_reduce(
-            graph, _edge_flat(per_bucket), jnp.inf, jnp.min)
+        return gather_reduce(
+            graph.agg_ell, _edge_flat(per_bucket), jnp.inf, jnp.min)
     out = jnp.full((n_segments,), jnp.inf, dtype=jnp.float32)
     for bucket in graph.buckets:
         arity = bucket.var_ids.shape[1]

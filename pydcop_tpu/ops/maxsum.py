@@ -209,17 +209,12 @@ def aggregate_beliefs(graph: CompiledFactorGraph, f2v: Msgs
     n_segments = graph.var_costs.shape[0]
     d = graph.var_costs.shape[1]
     if graph.agg_ell is not None:
+        from pydcop_tpu.ops.ell import gather_reduce
+
         flats = [msgs.reshape(-1, d) for msgs in f2v]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(
             flats, axis=0)
-        # Dummy slots hold E (one past the last edge): clip + mask
-        # instead of appending a zero row — appending would copy the
-        # whole message array every cycle.
-        n_edges = flat.shape[0]
-        safe = jnp.minimum(graph.agg_ell, n_edges - 1)
-        mask = (graph.agg_ell < n_edges)[..., None]
-        sums = jnp.sum(
-            jnp.where(mask, flat[safe], 0.0), axis=1)
+        sums = gather_reduce(graph.agg_ell, flat, 0.0, jnp.sum)
         return graph.var_costs + sums, sums
     if graph.agg_perm is not None:
         flats = [msgs.reshape(-1, d) for msgs in f2v]
