@@ -210,6 +210,18 @@ def build_aggregation_arrays(buckets: Sequence[FactorBucket],
     deg = ends - starts
     k_max = int(deg[:-1].max()) if n_segments > 1 and n_edges else 1
     k_max = max(k_max, 1)
+    # Hub guard: K is the MAX degree, so one power-law hub inflates
+    # every variable's padded list ([V+1, K] int32 — a 1M-var graph
+    # with a degree-10k hub would allocate 40 GB).  Refuse with
+    # guidance instead of OOMing the device.
+    ell_bytes = n_segments * k_max * 4
+    if ell_bytes > 2 << 30:
+        raise ValueError(
+            f"aggregation='ell' would allocate a {n_segments} x "
+            f"{k_max} edge-list array ({ell_bytes / (1 << 30):.1f} "
+            "GiB): the max variable degree is far above the mean "
+            f"({n_edges / max(n_segments - 1, 1):.1f}) — use "
+            "aggregation='scatter' for hub-dominated graphs")
     ell = np.full((n_segments, k_max), n_edges, np.int32)
     # Position of each sorted edge within its variable's list.
     k_pos = np.arange(n_edges) - starts[sorted_seg]

@@ -158,6 +158,26 @@ def test_sharded_graph_drops_sort_arrays():
     assert sharded.agg_perm is None  # scatter path on meshes
 
 
+def test_ell_hub_guard():
+    """A power-law hub makes K = max degree explode the [V+1, K]
+    lists; the builder must refuse with guidance instead of OOMing
+    (exercised via a synthetic bucket so no giant graph is built)."""
+    import numpy as np
+
+    from pydcop_tpu.engine.compile import (
+        FactorBucket,
+        build_aggregation_arrays,
+    )
+
+    n_vars = 2_000_000
+    # 600k binary factors all touching variable 0 (the hub).
+    ids = np.zeros((600_000, 2), np.int32)
+    ids[:, 1] = np.arange(600_000) % (n_vars - 1) + 1
+    bucket = FactorBucket(np.zeros((600_000, 2, 2), np.float32), ids)
+    with pytest.raises(ValueError, match="hub"):
+        build_aggregation_arrays((bucket,), n_vars + 1, "ell")
+
+
 def test_unknown_aggregation_rejected():
     dcop = _coloring(n_vars=10, seed=1)
     with pytest.raises(ValueError):
