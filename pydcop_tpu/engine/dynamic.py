@@ -285,9 +285,9 @@ class DynamicMaxSumEngine:
                for b in self.graph.buckets]
         f2v = [np.zeros(b.var_ids.shape + (d,), np.float32)
                for b in self.graph.buckets]
-        v2f_c = [np.zeros(b.var_ids.shape, np.int32)
+        v2f_c = [np.zeros(b.var_ids.shape, np.int8)
                  for b in self.graph.buckets]
-        f2v_c = [np.zeros(b.var_ids.shape, np.int32)
+        f2v_c = [np.zeros(b.var_ids.shape, np.int8)
                  for b in self.graph.buckets]
         old_v2f = [np.asarray(a) for a in old_state.v2f]
         old_f2v = [np.asarray(a) for a in old_state.f2v]
@@ -424,9 +424,9 @@ class DynamicMaxSumEngine:
                for b in self.graph.buckets]
         f2v = [np.zeros(b.var_ids.shape + (d,), np.float32)
                for b in self.graph.buckets]
-        v2f_c = [np.zeros(b.var_ids.shape, np.int32)
+        v2f_c = [np.zeros(b.var_ids.shape, np.int8)
                  for b in self.graph.buckets]
-        f2v_c = [np.zeros(b.var_ids.shape, np.int32)
+        f2v_c = [np.zeros(b.var_ids.shape, np.int8)
                  for b in self.graph.buckets]
         for name, (bi, row) in self.slots.items():
             sbi, srow = saved_pos[name]

@@ -126,7 +126,7 @@ def working_set_bytes(graph: CompiledFactorGraph) -> int:
         total += b.var_ids.size * 4
         # v2f + f2v messages carry the var_costs dtype (ops init_state)
         total += 2 * f * a * d * graph.var_costs.dtype.itemsize
-        total += 2 * f * a * 4       # send-suppression counters
+        total += 2 * f * a * 1       # send-suppression counters (int8)
     return int(total)
 
 

@@ -107,7 +107,7 @@ def to_lane_graph(graph: CompiledFactorGraph) -> LaneGraph:
 class LaneState(NamedTuple):
     v2f: Msgs            # last SENT variable -> factor messages
     f2v: Msgs            # last SENT factor -> variable messages
-    v2f_count: Msgs      # [arity, F] int32 consecutive-same counts
+    v2f_count: Msgs      # [arity, F] int8 consecutive-same counts
     f2v_count: Msgs
     stable: jnp.ndarray  # scalar bool
     cycle: jnp.ndarray   # scalar int32
@@ -121,7 +121,7 @@ def init_state(graph: LaneGraph) -> LaneState:
         for b in graph.buckets
     )
     counts = tuple(
-        jnp.zeros(b.var_ids.shape, dtype=jnp.int32)
+        jnp.zeros(b.var_ids.shape, dtype=jnp.int8)
         for b in graph.buckets
     )
     return LaneState(

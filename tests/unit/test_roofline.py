@@ -88,8 +88,8 @@ def test_working_set_accounts_state_and_graph():
     graph = _graph()
     # var tables: costs 5*3*4 + valid 5*3*1 = 75
     # bucket: costs 3*9*4=108, ids 3*2*4=24, msgs 2*3*2*3*4=144,
-    # counters 2*3*2*4=48
-    assert working_set_bytes(graph) == 75 + 108 + 24 + 144 + 48
+    # counters 2*3*2*1=12 (int8 — ops/maxsum.init_state)
+    assert working_set_bytes(graph) == 75 + 108 + 24 + 144 + 12
 
 
 def test_report_no_utilization_claim_for_unknown_tpu_kind():
