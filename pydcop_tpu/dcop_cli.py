@@ -88,6 +88,31 @@ def cli(args=None):
     sys.exit(rc)
 
 
+# CLI commands that execute on the device backend: a wedged
+# accelerator tunnel hangs jax backend init FOREVER (C++-level, not
+# interruptible), which would turn `pydcop solve` into a silent hang.
+_DEVICE_COMMANDS = ("solve", "run", "batch")
+
+
+def _guard_backend(command: str) -> None:
+    """Probe the accelerator backend before a device-running command
+    and fall back to a scrubbed CPU env when it is unresponsive (same
+    recipe the benchmarks use — utils/cleanenv).  Skipped entirely
+    when no accelerator plugin is configured (plain CPU installs pay
+    nothing) or inside an already-scrubbed fallback child."""
+    import os
+
+    if command not in _DEVICE_COMMANDS:
+        return
+    if "PALLAS_AXON_POOL_IPS" not in os.environ:
+        return
+    from pydcop_tpu.utils.cleanenv import ensure_live_backend
+
+    ensure_live_backend(tag=f"cli_{command}", retries=1,
+                        probe_timeout=float(os.environ.get(
+                            "PYDCOP_CLI_PROBE_TIMEOUT", "60")))
+
+
 def main(args=None) -> int:
     parser = make_parser()
     parsed = parser.parse_args(args)
@@ -100,6 +125,7 @@ def main(args=None) -> int:
     if not getattr(parsed, "func", None):
         parser.print_help()
         return 2
+    _guard_backend(parsed.command)
     try:
         return parsed.func(parsed) or 0
     except ModuleNotFoundError as e:

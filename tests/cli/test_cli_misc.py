@@ -1,0 +1,43 @@
+
+
+class TestBackendGuard:
+    """dcop_cli._guard_backend: probe-and-fallback only when a device
+    command meets a configured accelerator plugin (a wedged tunnel
+    hangs jax backend init forever — the guard is what keeps
+    `pydcop solve` from hanging silently)."""
+
+    def test_skips_without_plugin_env(self, monkeypatch):
+        from pydcop_tpu import dcop_cli
+
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        called = []
+        monkeypatch.setattr(
+            "pydcop_tpu.utils.cleanenv.ensure_live_backend",
+            lambda **kw: called.append(kw))
+        dcop_cli._guard_backend("solve")
+        assert called == []
+
+    def test_skips_non_device_commands(self, monkeypatch):
+        from pydcop_tpu import dcop_cli
+
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+        called = []
+        monkeypatch.setattr(
+            "pydcop_tpu.utils.cleanenv.ensure_live_backend",
+            lambda **kw: called.append(kw))
+        dcop_cli._guard_backend("graph")
+        assert called == []
+
+    def test_probes_device_commands_with_plugin(self, monkeypatch):
+        from pydcop_tpu import dcop_cli
+        from pydcop_tpu.utils import cleanenv
+
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+        monkeypatch.setenv("PYDCOP_CLI_PROBE_TIMEOUT", "7")
+        called = []
+        monkeypatch.setattr(
+            cleanenv, "ensure_live_backend",
+            lambda **kw: called.append(kw))
+        dcop_cli._guard_backend("solve")
+        assert called and called[0]["probe_timeout"] == 7.0
+        assert called[0]["tag"] == "cli_solve"

@@ -73,7 +73,7 @@ def test_full_solve_same_assignment(strategy):
 
 
 @pytest.mark.parametrize(
-    "algo", ["dsa", "mgm", "dba", "gdba", "mgm2", "mixeddsa"])
+    "algo", ["dsa", "adsa", "mgm", "dba", "gdba", "mgm2", "mixeddsa"])
 def test_local_search_ell_bit_parity(algo):
     """With integer constraint costs, the ell sums are exact, so the
     local-search trajectory (and final assignment) must be
