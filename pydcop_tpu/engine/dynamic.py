@@ -49,7 +49,14 @@ from pydcop_tpu.ops import maxsum as ops
 
 
 class DynamicMaxSumEngine:
-    """MaxSum engine whose factor graph can be edited between runs."""
+    """MaxSum engine whose factor graph can be edited between runs.
+
+    Always uses the scatter aggregation: the compile-time edge
+    structures behind the other strategies (sorted permutations, ell
+    lists) would need a rebuild on every graph edit, defeating the
+    array-surgery design.  Static solves through
+    ``algorithms/maxsum_dynamic.solve_on_device`` delegate to the
+    plain engine and do honor ``aggregation``."""
 
     def __init__(self, variables: List[Variable],
                  constraints: List[Constraint], mode: str = "min",
