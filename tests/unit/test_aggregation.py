@@ -158,6 +158,21 @@ def test_sharded_graph_drops_sort_arrays():
     assert sharded.agg_perm is None  # scatter path on meshes
 
 
+def test_decimation_composes_with_ell():
+    """run_decimated clamps var_costs rows via graph._replace, which
+    must preserve the ell lists — the decimated rounds aggregate
+    through them."""
+    from pydcop_tpu.api import solve
+
+    dcop = _coloring(n_vars=60, seed=5)
+    base = solve(dcop, "maxsum", max_cycles=120,
+                 algo_params={"decimation": 10})
+    alt = solve(dcop, "maxsum", max_cycles=120,
+                algo_params={"decimation": 10, "aggregation": "ell"})
+    assert alt["cost"] == base["cost"]
+    assert alt["assignment"] == base["assignment"]
+
+
 def test_ell_hub_guard():
     """A power-law hub makes K = max degree explode the [V+1, K]
     lists; the builder must refuse with guidance instead of OOMing
