@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test unit api cli check doctest bench dryrun onchip
+.PHONY: all test chaos unit api cli check doctest bench dryrun onchip
 
 all: check test
 
@@ -13,6 +13,16 @@ all: check test
 # forces the CPU backend for the examples.
 doctest:
 	$(PY) -m pytest --doctest-modules pydcop_tpu -q
+
+# Chaos gate: the resilience battery under a FIXED fault seed (the
+# fault pattern is a pure function of seed + edge + message index, so
+# a red run reproduces with the same command).  The battery lives in
+# tests/, so the default `make test` below already runs it — chaos is
+# a gate inside the default suite, and this target is the fast,
+# seed-pinned way to run it alone.
+chaos:
+	PYDCOP_CHAOS_SEED=42 $(PY) -m pytest \
+		tests/unit/test_resilience_battery.py -q
 
 test:
 	$(PY) -m pytest tests/ -q

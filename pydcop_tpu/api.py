@@ -38,12 +38,24 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           collect_moment: str = "value_change",
           collect_period: float = 1.0,
           delay: Optional[float] = None,
+          checkpoint_dir: Optional[str] = None,
+          checkpoint_every: Optional[int] = None,
+          resume: bool = False,
+          fault_plan=None,
           ) -> SolveResult:
     """Solve a DCOP and return assignment + quality metrics.
 
     backend="device": batched engine on TPU/CPU devices (default).
     backend="thread": agent-mode runtime (threads + in-process messages),
     reference-equivalent semantics.
+
+    Resilience knobs (docs/resilience.md): ``checkpoint_dir`` chunks a
+    device-mode solve into ``checkpoint_every``-cycle segments with an
+    NPZ state snapshot between segments; ``resume=True`` continues
+    from the newest snapshot in that directory instead of cycle 0
+    (identical final result — the battery asserts it).  ``fault_plan``
+    (a resilience.faults.FaultPlan) runs the thread backend under
+    seeded message faults and crash injection.
     warmup=True runs the compiled program once untimed before the timed
     call, so one-shot solves report steady-state rates instead of
     compile-dominated ones (device backend only).  The warm-up run is a
@@ -74,6 +86,24 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         )
     module = load_algorithm_module(algo_def.algo)
 
+    # Resilience knobs are backend-specific: reject silently-ignored
+    # combinations instead of letting a chaos test believe faults were
+    # injected (or a preemptible run believe it checkpointed).
+    if fault_plan is not None and backend == "device":
+        raise ValueError(
+            "fault_plan wraps agent transports: use backend='thread'"
+        )
+    if (checkpoint_dir is not None or resume) and backend != "device":
+        raise ValueError(
+            "checkpointing segments the device engine's solve loop: "
+            "use backend='device'"
+        )
+    if resume and checkpoint_dir is None:
+        raise ValueError(
+            "resume=True needs checkpoint_dir: there is no snapshot "
+            "location to resume from"
+        )
+
     if backend == "device":
         if not hasattr(module, "solve_on_device"):
             raise NotImplementedError(
@@ -86,10 +116,37 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
 
         initialize_multihost()
         t0 = time.perf_counter()
-        res = module.solve_on_device(
-            dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
-            n_devices=n_devices, warmup=warmup,
-        )
+        if checkpoint_dir is not None:
+            if not hasattr(module, "build_engine"):
+                raise NotImplementedError(
+                    f"Algorithm {algo_def.algo} has no segmentable "
+                    "engine: checkpointing supports maxsum-family "
+                    "solves"
+                )
+            from pydcop_tpu.resilience.checkpoint import (
+                CheckpointManager,
+                resume_from_checkpoint,
+            )
+
+            engine = module.build_engine(
+                dcop, algo_def.params, mesh=mesh, n_devices=n_devices
+            )
+            manager = CheckpointManager(
+                checkpoint_dir, every=checkpoint_every or 100
+            )
+            if resume:
+                res = resume_from_checkpoint(
+                    engine, manager, max_cycles=max_cycles
+                )
+            else:
+                res = engine.run_checkpointed(
+                    max_cycles=max_cycles, manager=manager
+                )
+        else:
+            res = module.solve_on_device(
+                dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
+                n_devices=n_devices, warmup=warmup,
+            )
         cost, violations = dcop.solution_cost(res.assignment)
         return SolveResult(
             status="FINISHED" if res.converged else "TIMEOUT",
@@ -128,6 +185,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             ui_port=ui_port, collector=collector,
             collect_moment=collect_moment,
             collect_period=collect_period, delay=delay,
+            fault_plan=fault_plan,
         )
 
     raise ValueError(f"Unknown backend {backend!r}")

@@ -55,6 +55,32 @@ def set_parser(subparsers):
                              "for observing algorithms live, e.g. with "
                              "--uiport (thread/process modes; "
                              "reference solve --delay)")
+    # Resilience knobs (docs/resilience.md).
+    parser.add_argument("--checkpoint_dir", default=None,
+                        help="device mode: snapshot solver state to "
+                             "this directory between segments")
+    parser.add_argument("--checkpoint_every", type=int, default=100,
+                        help="cycles per checkpoint segment")
+    parser.add_argument("--resume", action="store_true",
+                        help="device mode: continue from the newest "
+                             "checkpoint in --checkpoint_dir")
+    parser.add_argument("--fault_seed", type=int, default=0,
+                        help="seed for deterministic fault injection "
+                             "(thread mode)")
+    parser.add_argument("--fault_drop", type=float, default=0.0,
+                        help="per-message drop probability")
+    parser.add_argument("--fault_dup", type=float, default=0.0,
+                        help="per-message duplication probability")
+    parser.add_argument("--fault_delay", type=float, default=0.0,
+                        help="per-message delay probability")
+    parser.add_argument("--fault_delay_time", type=float, default=0.05,
+                        help="delay (s) applied to delayed messages")
+    parser.add_argument("--fault_kill", action="append", default=None,
+                        metavar="AGENT:CYCLE",
+                        help="kill AGENT when the run reaches CYCLE "
+                             "(repeatable; enables replication+repair)")
+    parser.add_argument("--fault_replicas", type=int, default=2,
+                        help="replicas placed before --fault_kill fires")
     parser.set_defaults(func=run_cmd)
 
 
@@ -69,6 +95,33 @@ def run_cmd(args) -> int:
 
     dcop = load_dcop_from_file(args.dcop_files)
     algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
+
+    if (args.checkpoint_dir or args.resume) and args.mode != "device":
+        raise ValueError(
+            "--checkpoint_dir/--resume segment the device engine's "
+            "solve loop: use --mode device"
+        )
+    fault_plan = None
+    if (args.fault_drop or args.fault_dup or args.fault_delay
+            or args.fault_kill):
+        from pydcop_tpu.resilience.faults import CrashEvent, FaultPlan
+
+        if args.mode != "thread":
+            raise ValueError(
+                "--fault_* knobs need --mode thread (fault injection "
+                "wraps in-process transports)"
+            )
+        fault_plan = FaultPlan(
+            seed=args.fault_seed,
+            drop=args.fault_drop,
+            duplicate=args.fault_dup,
+            delay=args.fault_delay,
+            delay_time=args.fault_delay_time,
+            crashes=tuple(
+                CrashEvent.parse(s) for s in (args.fault_kill or [])
+            ),
+            replicas=args.fault_replicas,
+        )
 
     t0 = time.perf_counter()
     if args.delay and args.mode == "device":
@@ -88,6 +141,9 @@ def run_cmd(args) -> int:
             res = solve(
                 dcop, algo_def, backend="device",
                 max_cycles=args.cycles, n_devices=args.n_devices,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                resume=args.resume,
             )
         result = {
             "status": res["status"],
@@ -144,6 +200,7 @@ def run_cmd(args) -> int:
             max_cycles=args.cycles, ui_port=args.uiport,
             collector=collector, collect_moment=args.collect_on,
             collect_period=args.period, delay=args.delay,
+            fault_plan=fault_plan,
         )
         result = {
             "status": res["status"],
@@ -157,6 +214,9 @@ def run_cmd(args) -> int:
             "agt_metrics": res.get("agt_metrics", {}),
             "backend": res.get("backend", args.mode),
         }
+        if "fault_stats" in res:
+            result["fault_stats"] = res["fault_stats"]
+            result["killed_agents"] = res.get("killed_agents", [])
 
     if args.run_metrics or args.end_metrics:
         from pydcop_tpu.commands.metrics_io import add_csvline

@@ -32,9 +32,63 @@ logger = logging.getLogger("pydcop.multihost")
 _initialized = False
 
 
+def multihost_initialized() -> bool:
+    """True once a join (or single-host no-op) completed successfully."""
+    return _initialized
+
+
+def _reset_initialized():
+    """Test hook: forget the latched join state so a fresh
+    initialize_multihost attempt runs (the production path never needs
+    this — a FAILED join already leaves the latch unset)."""
+    global _initialized
+    _initialized = False
+
+
+def _join_with_retry(join, retry_policy, what: str):
+    """Run the coordinator join under the retry policy, keeping the
+    module un-latched on failure so the caller can try again.
+
+    The coordinator not being up yet surfaces as a raw gRPC
+    unavailable error from ``jax.distributed.initialize``; under a
+    staggered pod bring-up that is the EXPECTED first-attempt outcome,
+    not a fatal one.  On exhaustion the partial distributed client is
+    torn down (best effort) and the last error raised.
+    """
+    from pydcop_tpu.resilience.retry import RetryPolicy
+
+    if retry_policy is None:
+        retry_policy = RetryPolicy.from_env(
+            "PYDCOP_MULTIHOST_RETRY_",
+            max_attempts=5, base_delay=1.0, max_delay=15.0,
+            jitter=0.0,
+        )
+
+    def _log_retry(attempt, error, delay):
+        logger.warning(
+            "%s failed (attempt %d: %s); retrying in %.1fs",
+            what, attempt, error, delay,
+        )
+
+    try:
+        retry_policy.call(join, on_retry=_log_retry)
+    except Exception:
+        import jax
+
+        # A half-joined client would make every later attempt fail
+        # with "already initialized"; tear it down so retry is
+        # possible.  _initialized stays False (never latched here).
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        raise
+
+
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
-                         process_id: Optional[int] = None) -> bool:
+                         process_id: Optional[int] = None,
+                         retry_policy=None) -> bool:
     """Join the JAX distributed runtime (idempotent).
 
     Arguments default to the ``PYDCOP_*`` environment variables; set
@@ -42,6 +96,12 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     jax.distributed's no-argument topology auto-detection.  Returns
     True when running distributed (more than one process), False for
     plain single-host runs (nothing configured — a silent no-op).
+
+    The coordinator join runs under ``retry_policy`` (default: built
+    from ``PYDCOP_MULTIHOST_RETRY_*`` env vars — exponential backoff,
+    5 attempts) because process 0 may simply not be up yet.  On
+    failure the module state is NOT latched: a later call retries the
+    join instead of silently reporting single-host.
     """
     global _initialized
     if _initialized:
@@ -62,17 +122,26 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     if coordinator_address is None and num_processes is None:
         if os.environ.get("PYDCOP_MULTIHOST") == "auto":
             # TPU pod: no-arg initialize auto-detects the topology.
-            jax.distributed.initialize()
+            _join_with_retry(
+                jax.distributed.initialize, retry_policy,
+                "multihost auto-join",
+            )
             _initialized = True
             return jax.process_count() > 1
         # Single-host: nothing to join.
         _initialized = True
         return False
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
+    def _join():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    _join_with_retry(
+        _join, retry_policy,
+        f"multihost join via {coordinator_address}",
     )
     _initialized = True
     logger.info(

@@ -417,7 +417,19 @@ AdsaValueMessage = message_type("adsa_value", ["value"])
 class ADsaComputation(HypergraphComputation):
     """Asynchronous DSA: a periodic action on the agent clock
     re-evaluates the variable against whatever neighbor values have
-    been seen so far; value messages carry no cycle bookkeeping."""
+    been seen so far; value messages carry no cycle bookkeeping.
+
+    Anti-entropy: the current value is re-broadcast every
+    ``REFRESH_TICKS`` ticks even when unchanged.  Value messages are
+    only posted on change otherwise, so on a lossy link one dropped
+    change can strand two neighbors in mutually-stale views where
+    NEITHER side sees the real conflict and the solve silently freezes
+    at a violated assignment; the periodic refresh guarantees views
+    eventually heal (chaos battery, docs/resilience.md).  Receiving a
+    value triggers no send, so the refresh adds bounded idempotent
+    traffic, never a storm."""
+
+    REFRESH_TICKS = 5
 
     def __init__(self, comp_def):
         super().__init__(comp_def)
@@ -426,6 +438,7 @@ class ADsaComputation(HypergraphComputation):
         self.variant = params.get("variant", "B")
         self.period = params.get("period", 0.5)
         self.stop_cycle = params.get("stop_cycle", 0)
+        self._ticks_since_broadcast = 0
         self._neighbor_values: Dict[str, Any] = {}
         if self.variant == "B":
             self._best_constraint_costs = {
@@ -493,7 +506,9 @@ class ADsaComputation(HypergraphComputation):
                     best_cost, best_values
                 )
         self.new_cycle()
-        if changed:
+        self._ticks_since_broadcast += 1
+        if changed or self._ticks_since_broadcast >= self.REFRESH_TICKS:
+            self._ticks_since_broadcast = 0
             self.post_to_all_neighbors(
                 AdsaValueMessage(self.current_value)
             )

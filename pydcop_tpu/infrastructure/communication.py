@@ -10,7 +10,9 @@ value (15) < algo (20) — lower value pops first.
 
 import json
 import logging
+import os
 import queue
+import random
 import threading
 import time
 from collections import namedtuple
@@ -19,12 +21,22 @@ from typing import Callable, Dict, Optional, Tuple
 from urllib import request as urlrequest
 
 from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.resilience.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryExhaustedError,
+    RetryPolicy,
+)
 from pydcop_tpu.utils.simple_repr import from_repr, simple_repr
 
 MSG_DISCOVERY = 5
 MSG_MGT = 10
 MSG_VALUE = 15
 MSG_ALGO = 20
+
+# Wakes a next_msg() blocked on an empty queue the moment shutdown is
+# called: lower than every real priority, so it pops first.
+_SHUTDOWN_PRIO = -1
 
 ComputationMessage = namedtuple(
     "ComputationMessage", ["src_comp", "dest_comp", "msg", "msg_type"]
@@ -39,6 +51,44 @@ class UnknownComputation(Exception):
 
 class UnreachableAgent(Exception):
     pass
+
+
+def mark_agent_dead(discovery, dest_agent: str, reason: str) -> bool:
+    """Publish ``dest_agent``'s removal through discovery — the signal
+    the orchestrator's reparation path repairs from.  Shared by every
+    transport-level failure detector so their guards cannot drift:
+
+    - the DIRECTORY agent is never marked: an agent cannot repair its
+      own control plane, and nothing ever re-publishes the directory's
+      arrival, so the mark would permanently blacklist it over one
+      slow bootstrap;
+    - an agent the local cache never learned is never marked: delivery
+      failed for want of an address, not because the agent is dead,
+      and publishing its removal could evict a live agent whose
+      registration simply has not propagated here yet.
+
+    Returns True when the removal was actually published."""
+    if discovery is None or not hasattr(discovery, "unregister_agent"):
+        return False
+    if dest_agent == getattr(discovery, "directory_agent", None):
+        logger.warning(
+            "Directory agent %s unreachable (%s); NOT marking the "
+            "control plane dead", dest_agent, reason,
+        )
+        return False
+    if hasattr(discovery, "agents") and \
+            dest_agent not in discovery.agents():
+        logger.warning(
+            "Agent %s undeliverable but never locally discovered "
+            "(%s); not publishing a removal for it", dest_agent, reason,
+        )
+        return False
+    try:
+        discovery.unregister_agent(dest_agent)
+        return True
+    except Exception:
+        logger.exception("Dead-agent mark of %s failed", dest_agent)
+        return False
 
 
 class CommunicationLayer:
@@ -96,10 +146,23 @@ class Messaging:
     communication.py:636-726).
     """
 
+    # Remote sends retry briefly on the agent thread before the message
+    # is dropped (an agent thread must NEVER die on a peer's failure);
+    # env-tunable via PYDCOP_MSG_RETRY_*.  Cheap by design: the HTTP
+    # layer has its own background retry queue, so this policy only
+    # really fires for in-process sends to departed agents.
+    DEFAULT_SEND_POLICY = dict(
+        max_attempts=3, base_delay=0.02, max_delay=0.1, jitter=0.0,
+    )
+
     def __init__(self, agent_name: str, comm: CommunicationLayer,
-                 delay: float = 0):
+                 delay: float = 0,
+                 retry_policy: Optional[RetryPolicy] = None):
         self._agent_name = agent_name
         self._comm = comm
+        self._retry_policy = retry_policy or RetryPolicy.from_env(
+            "PYDCOP_MSG_RETRY_", **self.DEFAULT_SEND_POLICY
+        )
         comm.messaging = self
         self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
         self._local_computations: Dict[str, bool] = {}
@@ -155,7 +218,20 @@ class Messaging:
         self.size_ext_msg[cmsg.src_comp] = (
             self.size_ext_msg.get(cmsg.src_comp, 0) + cmsg.msg.size
         )
-        self._comm.send_msg(self._agent_name, dest_agent, cmsg)
+        try:
+            self._retry_policy.call(
+                self._comm.send_msg, self._agent_name, dest_agent, cmsg,
+            )
+        except (RetryExhaustedError, CircuitOpenError) as e:
+            # Repeated delivery failure: mark the destination dead in
+            # discovery (triggering transport purges and — on the
+            # orchestrator — the reparation path) and drop the message
+            # instead of raising through the agent thread.
+            logger.warning(
+                "Dropping %s -> %s after retries, marking %s dead: %s",
+                cmsg.src_comp, cmsg.dest_comp, dest_agent, e,
+            )
+            mark_agent_dead(self.discovery, dest_agent, str(e))
 
     def _on_computation_discovered(self, event: str, computation: str,
                                    agent: str):
@@ -179,14 +255,39 @@ class Messaging:
 
     def next_msg(self, timeout: float = 0.05
                  ) -> Optional[ComputationMessage]:
-        try:
-            _, _, cmsg = self._queue.get(timeout=timeout)
+        """Pop the next message by priority.
+
+        Clean-termination contract (with :meth:`shutdown`): no message
+        is silently dropped and no caller waits past shutdown.  A
+        blocked ``next_msg`` wakes immediately when ``shutdown()`` runs
+        (the sentinel below — without it the old code slept out its
+        full timeout, the race this contract fixes); after shutdown,
+        already-queued messages keep draining in priority order and
+        only an EMPTY queue answers None, without blocking.
+        """
+        block = not self._shutdown
+        while True:
+            try:
+                _, _, cmsg = self._queue.get(
+                    block=block, timeout=timeout if block else None
+                )
+            except queue.Empty:
+                return None
+            if cmsg is None:
+                # Shutdown sentinel: stop waiting, drain what's left.
+                block = False
+                continue
             return cmsg
-        except queue.Empty:
-            return None
 
     def shutdown(self):
-        self._shutdown = True
+        """Stop the transport; queued messages stay poppable (drain
+        semantics, see :meth:`next_msg`)."""
+        with self._lock:
+            already = self._shutdown
+            self._shutdown = True
+            if not already:
+                self._seq += 1
+                self._queue.put((_SHUTDOWN_PRIO, self._seq, None))
         self._comm.shutdown()
 
 
@@ -196,20 +297,60 @@ class Messaging:
 
 class HttpCommunicationLayer(CommunicationLayer):
     """JSON-over-HTTP transport: one HTTP server thread per agent,
-    messages POSTed with simple_repr bodies (reference :313-492)."""
+    messages POSTed with simple_repr bodies (reference :313-492).
+
+    Delivery hardening: failed sends park in a retry queue swept by a
+    background thread with per-message exponential backoff
+    (``retry_policy``, env-tunable via ``PYDCOP_HTTP_RETRY_*``), a
+    per-destination :class:`CircuitBreaker` skips the connect timeout
+    to destinations that just failed repeatedly
+    (``PYDCOP_HTTP_BREAKER_*``), and a message still undeliverable
+    after ``RETRY_WINDOW`` seconds is dropped AND its destination
+    marked dead through discovery — the signal the orchestrator's
+    reparation path repairs from — instead of raising anywhere near
+    the agent thread.
+    """
 
     # Undeliverable messages are retried for this long before being
     # dropped (covers agents starting before their orchestrator —
     # reference communication.py:66-78 on_error retry semantics).
     RETRY_WINDOW = 30.0
+    # Messages to the DIRECTORY agent get a longer window: they are
+    # the bootstrap (agent_ready, register_agent) — dropping one
+    # strands the agent outside the run forever, and under heavy load
+    # an orchestrator's interpreter+jax start alone can eat the
+    # standard window.
+    DIRECTORY_RETRY_WINDOW = 120.0
+    # Sweep cadence of the retry thread (per-message backoff decides
+    # whether a due sweep actually re-attempts a given message).
     RETRY_INTERVAL = 0.5
 
-    def __init__(self, address_port: Tuple[str, int]):
+    def __init__(self, address_port: Tuple[str, int],
+                 retry_policy: Optional[RetryPolicy] = None):
         super().__init__()
         self._host, self._port = address_port
         self._server: Optional[ThreadingHTTPServer] = None
+        # Backoff cap stays SMALL relative to RETRY_WINDOW: the prime
+        # retry scenario is an agent booting before its orchestrator,
+        # where delivery must land within a couple of seconds of the
+        # peer's socket opening — a long cap would idle past a
+        # just-opened endpoint and fall off the window cliff.
+        self.retry_policy = retry_policy or RetryPolicy.from_env(
+            "PYDCOP_HTTP_RETRY_",
+            max_attempts=None, base_delay=0.25,
+            max_delay=2.0, jitter=0.1,
+        )
+        self._breaker_threshold = int(os.environ.get(
+            "PYDCOP_HTTP_BREAKER_THRESHOLD", "5"))
+        self._breaker_reset = float(os.environ.get(
+            "PYDCOP_HTTP_BREAKER_RESET", "1.0"))
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._rng = random.Random(0x5EED)
         self._retry_lock = threading.Lock()
-        self._retry_queue = []  # (expire_time, src, dest, cmsg)
+        # Entries: [expire_time, src, dest, cmsg, attempt, next_due,
+        # enqueued_at] (dest stays at index 2: the purge path keys on
+        # it; enqueued_at feeds the stale-namesake check).
+        self._retry_queue = []
         self._retry_thread: Optional[threading.Thread] = None
         # Agents known to have departed: their traffic is dropped
         # instead of lingering in the retry queue for RETRY_WINDOW
@@ -241,6 +382,9 @@ class HttpCommunicationLayer(CommunicationLayer):
         elif event == "agent_added":
             with self._retry_lock:
                 self._removed_agents.discard(agent_name)
+                # A re-added namesake is a fresh endpoint: forget the
+                # old one's failure history.
+                self._breakers.pop(agent_name, None)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -299,13 +443,32 @@ class HttpCommunicationLayer(CommunicationLayer):
                 raise UnreachableAgent(dest_agent)
             self._schedule_retry(src_agent, dest_agent, msg, error)
 
+    def _breaker_for(self, dest_agent: str) -> CircuitBreaker:
+        with self._retry_lock:
+            breaker = self._breakers.get(dest_agent)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    self._breaker_threshold, self._breaker_reset
+                )
+                self._breakers[dest_agent] = breaker
+            return breaker
+
     def _try_send(self, src_agent: str, dest_agent: str,
                   msg: ComputationMessage) -> Optional[str]:
-        """Attempt one delivery; returns an error string on failure."""
+        """Attempt one delivery; returns an error string on failure.
+
+        An unknown address is a discovery race, not a transport
+        failure, so it never trips the breaker; repeated CONNECTION
+        failures open the destination's breaker and later attempts
+        return immediately instead of eating the 2 s connect timeout
+        per queued message."""
         try:
             dest_address = self.discovery.agent_address(dest_agent)
         except Exception as e:
             return f"unknown agent: {e}"
+        breaker = self._breaker_for(dest_agent)
+        if not breaker.allow():
+            return f"circuit open for {dest_agent}"
         host, port = dest_address
         body = json.dumps({
             "src_comp": msg.src_comp,
@@ -324,21 +487,20 @@ class HttpCommunicationLayer(CommunicationLayer):
         )
         try:
             urlrequest.urlopen(req, timeout=2.0)
+            breaker.record_success()
             return None
         except Exception as e:
+            breaker.record_failure()
             return f"{host}:{port} unreachable: {e}"
 
-    def _is_stale(self, expire: float, dest: str) -> bool:
+    def _is_stale(self, enqueued: float, dest: str) -> bool:
         """True when the entry targets a currently-removed agent, or
         was enqueued before the agent's last removal (delivery would
         reach a re-added namesake).  Call with _retry_lock held."""
         if dest in self._removed_agents:
             return True
         removed_at = self._removed_at.get(dest)
-        return (
-            removed_at is not None
-            and expire - self.RETRY_WINDOW <= removed_at
-        )
+        return removed_at is not None and enqueued <= removed_at
 
     def _schedule_retry(self, src_agent: str, dest_agent: str,
                         msg: ComputationMessage, error: str):
@@ -346,12 +508,19 @@ class HttpCommunicationLayer(CommunicationLayer):
             "Send to %s failed (%s); will retry for up to %.0fs",
             dest_agent, error, self.RETRY_WINDOW,
         )
+        now = time.monotonic()
+        window = self.RETRY_WINDOW
+        disco = self.discovery
+        if disco is not None and \
+                dest_agent == getattr(disco, "directory_agent", None):
+            window = max(window, self.DIRECTORY_RETRY_WINDOW)
         with self._retry_lock:
             if dest_agent in self._removed_agents:
                 return
             self._retry_queue.append(
-                (time.monotonic() + self.RETRY_WINDOW,
-                 src_agent, dest_agent, msg)
+                (now + window, src_agent, dest_agent, msg,
+                 1, now + self.retry_policy.delay_for(1, self._rng),
+                 now)
             )
             if self._retry_thread is None or \
                     not self._retry_thread.is_alive():
@@ -360,6 +529,19 @@ class HttpCommunicationLayer(CommunicationLayer):
                     name=f"http_retry_{self._port}", daemon=True,
                 )
                 self._retry_thread.start()
+
+    def _mark_agent_dead(self, dest: str, error: str):
+        """The retry window is exhausted: the destination is dead.
+        Publishing the removal (module-level :func:`mark_agent_dead`,
+        with its directory and never-discovered exemptions) fires the
+        agent-change hooks — purging its queued traffic here — and
+        lets the orchestrator's reparation path migrate its
+        computations."""
+        if mark_agent_dead(self.discovery, dest, error):
+            logger.warning(
+                "Marked agent %s dead after failed delivery: %s",
+                dest, error,
+            )
 
     def _retry_loop(self):
         while not self._shutdown:
@@ -373,30 +555,49 @@ class HttpCommunicationLayer(CommunicationLayer):
                     self._retry_thread = None
                     return
             still_failing = []
-            for expire, src, dest, cmsg in pending:
+            dead: Dict[str, str] = {}
+            for (expire, src, dest, cmsg, attempt, next_due,
+                 enqueued) in pending:
                 with self._retry_lock:
-                    if self._is_stale(expire, dest):
+                    if self._is_stale(enqueued, dest):
                         # The agent departed after this entry was
                         # enqueued (and possibly re-registered since);
                         # a purge cannot see swapped-out entries, so
                         # drop them here.
                         continue
+                now = time.monotonic()
+                if now < next_due and now < expire:
+                    # Backoff not elapsed: keep without re-attempting.
+                    still_failing.append(
+                        (expire, src, dest, cmsg, attempt, next_due,
+                         enqueued))
+                    continue
                 error = self._try_send(src, dest, cmsg)
                 if error is None:
                     continue
                 if time.monotonic() >= expire:
                     logger.warning(
                         "Dropping message to %s after %.0fs of "
-                        "retries: %s", dest, self.RETRY_WINDOW, error,
+                        "retries: %s", dest,
+                        time.monotonic() - enqueued, error,
                     )
+                    dead[dest] = error
                 else:
-                    still_failing.append((expire, src, dest, cmsg))
+                    attempt += 1
+                    still_failing.append(
+                        (expire, src, dest, cmsg, attempt,
+                         time.monotonic() + self.retry_policy.delay_for(
+                             attempt, self._rng),
+                         enqueued)
+                    )
             if still_failing:
                 with self._retry_lock:
                     self._retry_queue.extend(
                         entry for entry in still_failing
-                        if not self._is_stale(entry[0], entry[2])
+                        if not self._is_stale(entry[6], entry[2])
                     )
+            for dest, error in dead.items():
+                self._mark_agent_dead(dest, error)
 
     def shutdown(self):
         self._shutdown = True

@@ -224,6 +224,21 @@ class Orchestrator:
 
         self._agent = Agent(ORCHESTRATOR_AGENT, comm)
         self.directory = Directory(self._agent.discovery)
+        # Failure detection: transports (and the fault monitor) mark a
+        # dead agent by unregistering it from discovery; the directory
+        # mirrors the removal here and this hook routes it into the
+        # reparation path.  Scenario-driven removals and kill
+        # injections also land in _handle_agent_failure — the
+        # _failure_lock + _removed_agents latch make the two paths
+        # race-safe and idempotent.
+        self._failure_lock = threading.Lock()
+        self._agent.discovery.agent_change_hooks.append(
+            self._on_discovery_agent_change
+        )
+        # Thread-mode runners register their in-process Agent objects
+        # here (name -> Agent) so crash injection can hard-stop them;
+        # empty for process/multi-machine runs.
+        self.local_agents: Dict[str, Agent] = {}
         self._agent.add_computation(self.directory.directory_computation)
         self._agent.discovery.use_directory(
             ORCHESTRATOR_AGENT, comm.address
@@ -463,21 +478,69 @@ class Orchestrator:
         """Scenario-driven agent removal: stop the agent, then migrate
         its orphaned computations onto agents holding their replicas by
         solving the repair DCOP (reference orchestrator.py:955-1178)."""
-        orphaned = self.distribution.computations_hosted(agent)
-        logger.warning(
-            "Agent %s removed; orphaned computations: %s", agent, orphaned
-        )
         self.mgt.post_msg(f"_mgt_{agent}", StopAgentMessage(), MSG_MGT)
-        self._removed_agents.add(agent)
-        mapping = self.distribution.mapping
-        mapping.pop(agent, None)
-        self.distribution = Distribution(mapping)
-        # Replicas hosted on the departed agent are gone with it.
-        for hosts in self.mgt.replica_hosts.values():
-            if agent in hosts:
-                hosts.remove(agent)
-        if orphaned:
-            self.repair(orphaned, departed=[agent])
+        self._handle_agent_failure(agent)
+
+    def report_agent_failure(self, agent: str):
+        """External failure report (fault monitor, health checks): the
+        agent is already dead — no stop message — so unregister it from
+        the directory (stopping messaging toward it and purging
+        transport retry queues) and run the reparation path."""
+        try:
+            self._agent.discovery.unregister_agent(agent)
+        except Exception:
+            logger.exception("Unregistering failed agent %s", agent)
+        self._handle_agent_failure(agent)
+
+    def _on_discovery_agent_change(self, event: str, agent: str):
+        """Discovery hook: an agent_removed publication during a run is
+        a detected death (transports mark dead agents by unregistering
+        them, communication.py _mark_agent_dead).  Repair runs on its
+        own thread — this hook fires on the orchestrator agent thread,
+        which must stay free to process the repair round's own
+        messages."""
+        if event != "agent_removed" or agent == ORCHESTRATOR_AGENT:
+            return
+        if self.status != "RUNNING" or agent in self._removed_agents:
+            return
+        threading.Thread(
+            target=self._handle_agent_failure, args=(agent,),
+            name=f"repair_{agent}", daemon=True,
+        ).start()
+
+    def _handle_agent_failure(self, agent: str):
+        """Shared failure path: forget the agent, then repair.  Safe
+        under concurrent detection (scenario removal + transport mark +
+        fault monitor can all fire for the same death): the first
+        caller wins the latch, the rest return.
+
+        The lock spans the REPAIR too, not just the bookkeeping: two
+        nearby deaths handled concurrently would otherwise interleave
+        — failure B rebuilds ``self.distribution`` from a snapshot
+        taken before failure A's repair committed its re-hosted
+        placements (``host_on_agent`` mutates the OLD object), erasing
+        them.  Repair waits on acks delivered by the orchestrator
+        agent thread, which never takes this lock, so serializing here
+        cannot deadlock — the second failure simply repairs after the
+        first."""
+        with self._failure_lock:
+            if agent in self._removed_agents:
+                return
+            self._removed_agents.add(agent)
+            orphaned = self.distribution.computations_hosted(agent)
+            mapping = self.distribution.mapping
+            mapping.pop(agent, None)
+            self.distribution = Distribution(mapping)
+            # Replicas hosted on the departed agent are gone with it.
+            for hosts in self.mgt.replica_hosts.values():
+                if agent in hosts:
+                    hosts.remove(agent)
+            logger.warning(
+                "Agent %s removed; orphaned computations: %s",
+                agent, orphaned,
+            )
+            if orphaned:
+                self.repair(orphaned, departed=[agent])
 
     def repair(self, orphaned: List[str], departed: List[str],
                timeout: float = 10):

@@ -54,11 +54,20 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                           collect_moment: str = "value_change",
                           collect_period: float = 1.0,
                           repair_mode: str = "device",
+                          comm_wrapper=None,
                           ) -> Orchestrator:
     """One OrchestratedAgent thread per AgentDef + an orchestrator, all
     with in-process transports (reference run.py:145).  With
     ``replication=True`` agents are resilient: they host a
-    replica-placement computation for dynamic-DCOP repair."""
+    replica-placement computation for dynamic-DCOP repair.
+
+    ``comm_wrapper(layer, agent_name)`` decorates each AGENT transport
+    before the agent is built — the fault-injection seam
+    (resilience.faults.FaultPlan.wrapper); the orchestrator's own
+    transport is never wrapped, so control-plane bootstrap stays
+    reliable.  Started agents are registered in
+    ``orchestrator.local_agents`` so crash injection (and tests) can
+    reach their threads."""
     comm = InProcessCommunicationLayer()
     orchestrator = Orchestrator(
         algo, cg, distribution, comm, dcop, infinity,
@@ -72,11 +81,14 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
     }
     def _start_agent(agent_def, ui=None):
         agent_comm = InProcessCommunicationLayer()
+        if comm_wrapper is not None:
+            agent_comm = comm_wrapper(agent_comm, agent_def.name)
         agent = OrchestratedAgent(
             agent_def, agent_comm, orchestrator.address, delay=delay,
             replication=replication, ui_port=ui,
         )
         agent.start()
+        orchestrator.local_agents[agent_def.name] = agent
         return agent
 
     for agent_def in dcop.agents.values():
@@ -200,8 +212,17 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
                       collector=None,
                       collect_moment: str = "value_change",
                       collect_period: float = 1.0,
-                      delay: Optional[float] = None) -> Dict:
-    """Full-metrics variant used by the api/CLI thread backend."""
+                      delay: Optional[float] = None,
+                      fault_plan=None) -> Dict:
+    """Full-metrics variant used by the api/CLI thread backend.
+
+    ``fault_plan`` (a resilience.faults.FaultPlan) turns the run into
+    a chaos run: agent transports are wrapped with seeded message
+    faults, and a crash schedule in the plan enables replication,
+    places ``fault_plan.replicas`` replicas before the run and fires
+    the kills from a FaultMonitor — the murdered agents' computations
+    migrate through the reparation path.  Thread mode only (process
+    agents own their transports in other processes)."""
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
             algo_def, mode=dcop.objective
@@ -232,6 +253,18 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
     if isinstance(distribution, str):
         distribution = _build_distribution(
             dcop, cg, algo_module, distribution)
+    if fault_plan is not None and mode != "thread":
+        raise ValueError(
+            "fault injection needs in-process transports: "
+            f"mode must be 'thread', got {mode!r}"
+        )
+    comm_wrapper = None
+    fault_stats = None
+    if fault_plan is not None:
+        from pydcop_tpu.resilience.faults import FaultStats
+
+        fault_stats = FaultStats()
+        comm_wrapper = fault_plan.wrapper(fault_stats)
     if mode == "process":
         orchestrator = run_local_process_dcop(
             algo_def, cg, distribution, dcop, delay=delay,
@@ -244,20 +277,43 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             delay=delay,
             collector=collector, collect_moment=collect_moment,
             collect_period=collect_period,
+            replication=bool(
+                fault_plan is not None and fault_plan.crashes),
+            comm_wrapper=comm_wrapper,
         )
     stopped = False
+    monitor = None
     try:
         if not orchestrator.wait_ready(
                 PROCESS_READY_TIMEOUT if mode == "process"
                 else THREAD_READY_TIMEOUT):
             raise RuntimeError("Agents did not become ready in time")
         orchestrator.deploy_computations()
+        if fault_plan is not None and fault_plan.crashes:
+            from pydcop_tpu.resilience.faults import (
+                CrashSchedule,
+                FaultMonitor,
+            )
+
+            # Replicas must exist before the first kill, or the
+            # murdered computations are lost instead of migrated.
+            orchestrator.start_replication(fault_plan.replicas)
+            monitor = FaultMonitor(
+                orchestrator, CrashSchedule(list(fault_plan.crashes))
+            ).start()
         orchestrator.run(timeout=timeout)
         # Stop agents first: final metrics arrive with AgentStopped.
         orchestrator.stop_agents(5)
         stopped = True
         metrics = orchestrator.end_metrics()
+        extra = {}
+        if fault_stats is not None:
+            extra["fault_stats"] = fault_stats.as_dict()
+            extra["killed_agents"] = (
+                list(monitor.killed) if monitor is not None else []
+            )
         return {
+            **extra,
             "status": orchestrator.status,
             "assignment": {
                 k: v for k, v in metrics["assignment"].items()
@@ -273,6 +329,8 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             "backend": mode,
         }
     finally:
+        if monitor is not None:
+            monitor.stop()
         if not stopped:
             orchestrator.stop_agents(5)
         orchestrator.stop()

@@ -1,0 +1,25 @@
+"""Resilience subsystem: fault injection, checkpoint/resume, retry.
+
+The reference ships resilience as a first-class capability
+(ResilientAgent, computation replication, distribution reparation);
+this package adds the pieces that *exercise* and *harden* that stack:
+
+- :mod:`pydcop_tpu.resilience.faults` — deterministic, seed-driven
+  fault injection (message drop / duplicate / delay / partition, agent
+  crash schedules) over any ``CommunicationLayer``;
+- :mod:`pydcop_tpu.resilience.checkpoint` — NPZ snapshots of
+  device-resident solver state plus ``resume_from_checkpoint`` so an
+  interrupted (or preempted multi-host) solve restarts mid-run;
+- :mod:`pydcop_tpu.resilience.retry` — ``RetryPolicy`` (exponential
+  backoff + jitter + deadline) and ``CircuitBreaker``, applied to the
+  HTTP transport, remote messaging and the multihost coordinator join.
+
+See docs/resilience.md for knobs and the agent-repair flow.
+"""
+
+from pydcop_tpu.resilience.retry import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryExhaustedError,
+    RetryPolicy,
+)

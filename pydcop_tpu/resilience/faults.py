@@ -1,0 +1,351 @@
+"""Deterministic, seed-driven fault injection for the agent runtime.
+
+Two independent instruments:
+
+- :class:`FaultyCommunicationLayer` decorates any
+  ``CommunicationLayer`` with per-message drop / duplicate / delay
+  faults and network partitions.  Decisions are a pure function of
+  ``(seed, src_agent, dest_agent, per-edge message index)`` — the same
+  seed replays the same fault pattern regardless of thread
+  interleaving, which is what makes chaos tests assertable.
+- :class:`CrashSchedule` + :class:`FaultMonitor` murder agents
+  mid-solve ("kill agent X at cycle N"): the monitor watches the
+  orchestrator's cycle reports, hard-stops the victim's thread (no
+  clean shutdown, no stop report — a crash, not a stop) and reports
+  the failure so the reparation path migrates the orphaned
+  computations (see Orchestrator.report_agent_failure).
+
+Management and discovery traffic is protected by default
+(``protect_management=True``): dropping a deploy or a directory
+publication does not test the *algorithms'* fault tolerance, it only
+wedges the harness.  Set it False to chaos-test the control plane too.
+"""
+
+import hashlib
+import logging
+import random
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from pydcop_tpu.infrastructure.communication import (
+    CommunicationLayer,
+    MSG_VALUE,
+)
+
+logger = logging.getLogger("pydcop.resilience.faults")
+
+
+@dataclass(frozen=True)
+class CrashEvent:
+    """Kill ``agent`` once the global cycle count reaches ``cycle``."""
+
+    agent: str
+    cycle: int
+
+    @classmethod
+    def parse(cls, spec: str) -> "CrashEvent":
+        """Parse an ``agent:cycle`` CLI spec (e.g. ``a1:30``)."""
+        agent, _, cycle = spec.rpartition(":")
+        if not agent:
+            raise ValueError(
+                f"crash spec must be agent:cycle, got {spec!r}")
+        return cls(agent, int(cycle))
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Everything a chaos run injects, in one seedable value.
+
+    Probabilities are per message: ``drop`` (never delivered),
+    ``duplicate`` (delivered twice), ``delay`` (delivered after
+    ``delay_time`` seconds, off the sender thread).  ``partitions`` is
+    a set of agent groups; messages crossing group boundaries are
+    dropped (agents absent from every group communicate freely).
+    ``crashes`` is the kill schedule; ``replicas`` the replication
+    factor a harness should place before letting the crashes fire.
+    """
+
+    seed: int = 0
+    drop: float = 0.0
+    duplicate: float = 0.0
+    delay: float = 0.0
+    delay_time: float = 0.05
+    partitions: Tuple[frozenset, ...] = ()
+    crashes: Tuple[CrashEvent, ...] = ()
+    replicas: int = 2
+    protect_management: bool = True
+
+    def is_partitioned(self, src: str, dest: str) -> bool:
+        if not self.partitions:
+            return False
+        src_groups = {
+            i for i, g in enumerate(self.partitions) if src in g
+        }
+        dest_groups = {
+            i for i, g in enumerate(self.partitions) if dest in g
+        }
+        if not src_groups or not dest_groups:
+            return False
+        return not (src_groups & dest_groups)
+
+    def wrapper(self, stats: Optional["FaultStats"] = None
+                ) -> Callable[[CommunicationLayer, str],
+                              "FaultyCommunicationLayer"]:
+        """A ``comm_wrapper(layer, agent_name)`` factory for
+        ``run_local_thread_dcop`` — all wrapped layers share ``stats``."""
+        shared = stats if stats is not None else FaultStats()
+
+        def wrap(inner: CommunicationLayer, agent_name: str
+                 ) -> "FaultyCommunicationLayer":
+            return FaultyCommunicationLayer(inner, self, stats=shared)
+
+        return wrap
+
+
+class FaultStats:
+    """Thread-safe counters shared by every wrapped layer of a run."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sent = 0
+        self.dropped = 0
+        self.duplicated = 0
+        self.delayed = 0
+        self.partitioned = 0
+
+    def bump(self, name: str, n: int = 1):
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def as_dict(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "sent": self.sent,
+                "dropped": self.dropped,
+                "duplicated": self.duplicated,
+                "delayed": self.delayed,
+                "partitioned": self.partitioned,
+            }
+
+    def __repr__(self):
+        return f"FaultStats({self.as_dict()})"
+
+
+def _edge_rng(seed: int, src: str, dest: str, index: int
+              ) -> random.Random:
+    """A Random seeded purely by (plan seed, edge, message index) —
+    stable across processes and thread schedules (``hash()`` is salted
+    per process, so blake2 instead)."""
+    key = f"{seed}:{src}>{dest}:{index}".encode()
+    digest = hashlib.blake2b(key, digest_size=8).digest()
+    return random.Random(int.from_bytes(digest, "big"))
+
+
+class FaultyCommunicationLayer(CommunicationLayer):
+    """Decorator over any transport, injecting the plan's faults on the
+    SEND side (the receive path is untouched: for the in-process layer
+    other agents deliver straight into the inner layer's address).
+
+    ``messaging`` / ``discovery`` are forwarded to the inner layer so
+    agent wiring (``Messaging.__init__``, ``Agent.__init__``) works
+    unchanged on the wrapped object.
+    """
+
+    def __init__(self, inner: CommunicationLayer, plan: FaultPlan,
+                 stats: Optional[FaultStats] = None):
+        self._inner = inner
+        self._plan = plan
+        self.stats = stats if stats is not None else FaultStats()
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._lock = threading.Lock()
+        # Intentionally no super().__init__(): messaging/discovery are
+        # forwarding properties over the inner layer's attributes.
+
+    # -- forwarded wiring ---------------------------------------------- #
+
+    @property
+    def messaging(self):
+        return self._inner.messaging
+
+    @messaging.setter
+    def messaging(self, value):
+        self._inner.messaging = value
+
+    @property
+    def discovery(self):
+        return self._inner.discovery
+
+    @discovery.setter
+    def discovery(self, value):
+        self._inner.discovery = value
+
+    @property
+    def address(self):
+        return self._inner.address
+
+    def on_agent_change(self, event: str, agent_name: str):
+        self._inner.on_agent_change(event, agent_name)
+
+    def receive_msg(self, src_agent: str, dest_agent: str, msg):
+        self._inner.receive_msg(src_agent, dest_agent, msg)
+
+    def shutdown(self):
+        self._inner.shutdown()
+
+    # -- fault injection ------------------------------------------------ #
+
+    def _next_index(self, src: str, dest: str) -> int:
+        with self._lock:
+            n = self._counts.get((src, dest), 0)
+            self._counts[(src, dest)] = n + 1
+            return n
+
+    def send_msg(self, src_agent: str, dest_agent: str, msg,
+                 on_error=None):
+        plan = self._plan
+        if plan.protect_management and msg.msg_type < MSG_VALUE:
+            self._inner.send_msg(src_agent, dest_agent, msg,
+                                 on_error=on_error)
+            return
+        if plan.is_partitioned(src_agent, dest_agent):
+            self.stats.bump("partitioned")
+            logger.debug(
+                "PARTITION %s -> %s: %s dropped",
+                src_agent, dest_agent, msg.msg.type,
+            )
+            return
+        rng = _edge_rng(plan.seed, src_agent, dest_agent,
+                        self._next_index(src_agent, dest_agent))
+        if rng.random() < plan.drop:
+            self.stats.bump("dropped")
+            logger.debug(
+                "DROP %s -> %s: %s", src_agent, dest_agent, msg.msg.type
+            )
+            return
+        copies = 1
+        if plan.duplicate and rng.random() < plan.duplicate:
+            copies = 2
+            self.stats.bump("duplicated")
+        if plan.delay and rng.random() < plan.delay:
+            self.stats.bump("delayed")
+            timer = threading.Timer(
+                plan.delay_time,
+                self._deliver, (src_agent, dest_agent, msg, copies,
+                                on_error),
+            )
+            timer.daemon = True
+            timer.start()
+            return
+        self._deliver(src_agent, dest_agent, msg, copies, on_error)
+
+    def _deliver(self, src_agent: str, dest_agent: str, msg,
+                 copies: int, on_error):
+        for _ in range(copies):
+            self.stats.bump("sent")
+            try:
+                self._inner.send_msg(src_agent, dest_agent, msg,
+                                     on_error=on_error)
+            except Exception:
+                # Delayed deliveries run on a timer thread: an
+                # unreachable destination must not kill the timer with
+                # an unhandled exception (the inner layer's own retry /
+                # dead-marking already handled or logged it).
+                logger.debug(
+                    "Fault-delayed delivery to %s failed", dest_agent,
+                    exc_info=True,
+                )
+
+    def __repr__(self):
+        return f"FaultyCommunicationLayer({self._inner!r})"
+
+
+class CrashSchedule:
+    """An ordered kill list; parses the CLI's ``agent:cycle`` specs."""
+
+    def __init__(self, events: Sequence[CrashEvent]):
+        self.events: List[CrashEvent] = sorted(
+            events, key=lambda e: e.cycle
+        )
+
+    @classmethod
+    def from_specs(cls, specs: Sequence[str]) -> "CrashSchedule":
+        return cls([CrashEvent.parse(s) for s in specs])
+
+    def __bool__(self):
+        return bool(self.events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+
+def kill_agent(orchestrator, agent_name: str) -> None:
+    """Crash ``agent_name``: hard-stop its thread when it is reachable
+    in this process (thread-mode runs expose ``local_agents``), then
+    report the failure so the orchestrator's reparation path migrates
+    the orphaned computations.  Process/remote agents cannot be stopped
+    from here — for them this is purely the failure report (the real
+    process keeps running until its transport is cut externally)."""
+    agents = getattr(orchestrator, "local_agents", {}) or {}
+    agent = agents.get(agent_name)
+    if agent is not None:
+        agent.stop()
+        logger.warning("CRASH injected: agent %s thread stopped",
+                       agent_name)
+    orchestrator.report_agent_failure(agent_name)
+
+
+class FaultMonitor:
+    """Daemon thread firing a :class:`CrashSchedule` against a running
+    orchestrator.  Triggers on the orchestrator's *global* cycle view
+    (max over all computations' reported cycles) so a kill lands
+    mid-solve regardless of which agent reports first."""
+
+    def __init__(self, orchestrator, schedule: CrashSchedule,
+                 poll: float = 0.02,
+                 kill: Callable = kill_agent):
+        self.orchestrator = orchestrator
+        self.schedule = schedule
+        self.poll = poll
+        self.kill = kill
+        self.killed: List[str] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="fault_monitor", daemon=True
+        )
+
+    def start(self) -> "FaultMonitor":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(2.0)
+
+    def _global_cycle(self) -> int:
+        try:
+            return max(self.orchestrator.mgt.cycles.values(), default=0)
+        except RuntimeError:
+            # The mgt thread mutated the dict mid-iteration; this poll
+            # is best-effort — read again next tick.
+            return 0
+
+    def _run(self):
+        pending = list(self.schedule)
+        fired: Set[str] = set()
+        while pending and not self._stop.is_set():
+            cycle = self._global_cycle()
+            due = [e for e in pending if cycle >= e.cycle]
+            for event in due:
+                pending.remove(event)
+                if event.agent in fired:
+                    continue
+                fired.add(event.agent)
+                try:
+                    self.kill(self.orchestrator, event.agent)
+                    self.killed.append(event.agent)
+                except Exception:
+                    logger.exception(
+                        "Crash injection of %s failed", event.agent
+                    )
+            self._stop.wait(self.poll)

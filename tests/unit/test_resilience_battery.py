@@ -1,0 +1,695 @@
+"""Resilience battery: chaos, checkpoint/resume and retry hardening.
+
+Covers the resilience subsystem end to end (docs/resilience.md):
+
+- RetryPolicy / CircuitBreaker semantics (resilience/retry.py);
+- deterministic fault injection (resilience/faults.py);
+- checkpoint determinism: a solve interrupted at a segment boundary
+  and resumed yields the SAME assignment, cost and cycle count as the
+  uninterrupted run (CPU backend, tier-1);
+- chaos convergence: MaxSum (async) and DSA under seeded message
+  drop / duplicate / delay still reach the fault-free cost;
+- kill-and-repair: an agent murdered mid-solve under 10% drop has its
+  computation migrated through the replication/reparation path and the
+  orchestrated solve completes at the fault-free cost;
+- transport hardening: HTTP delivery failure degrades to a Discovery
+  dead-agent mark (never an exception on the agent thread), the
+  multihost coordinator join retries and never latches on failure, and
+  Messaging's shutdown contract (no silent drop, no wait past
+  shutdown).
+
+``make chaos`` runs this file with a fixed PYDCOP_CHAOS_SEED; the
+fault pattern is a pure function of (seed, edge, message index), so a
+failure reproduces under the same seed.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.infrastructure.communication import (
+    MSG_ALGO,
+    MSG_MGT,
+    CommunicationLayer,
+    ComputationMessage,
+    InProcessCommunicationLayer,
+    Messaging,
+)
+from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.resilience.checkpoint import (
+    CheckpointManager,
+    load_state,
+    resume_from_checkpoint,
+    save_state,
+)
+from pydcop_tpu.resilience.faults import (
+    CrashEvent,
+    FaultPlan,
+    FaultyCommunicationLayer,
+)
+from pydcop_tpu.resilience.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryExhaustedError,
+    RetryPolicy,
+)
+
+CHAOS_SEED = int(os.environ.get("PYDCOP_CHAOS_SEED", "42"))
+
+# Distinct from test_http_transport.py's 19410-19470 range.
+PORTS = iter(range(19700, 19760))
+
+
+# ------------------------------------------------------------------ #
+# fixtures
+
+
+def _coloring_dcop(n_agents=5, n_vars=4):
+    """3-colorable chain: fault-free optimum cost is 0."""
+    d = Domain("colors", "", ["R", "G", "B"])
+    dcop = DCOP("chaos", objective="min")
+    variables = [Variable(f"v{i}", d) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    for i in range(n_vars - 1):
+        dcop.add_constraint(constraint_from_str(
+            f"diff_{i}_{i + 1}",
+            f"10 if v{i} == v{i + 1} else 0",
+            [variables[i], variables[i + 1]],
+        ))
+    dcop.add_agents([
+        AgentDef(f"a{i}", capacity=100, default_hosting_cost=i)
+        for i in range(n_agents)
+    ])
+    return dcop
+
+
+def _variable_distribution():
+    return Distribution({
+        "a0": ["v0"], "a1": ["v1"], "a2": ["v2"], "a3": ["v3"],
+        "a4": [],
+    })
+
+
+def _ring_dcop(n_vars=6):
+    """Loopy ring + one chord, for the device engine (not a tree, so
+    the solve needs a couple dozen cycles — room to interrupt)."""
+    d = Domain("c", "", list(range(3)))
+    dcop = DCOP("ckpt", objective="min")
+    variables = [Variable(f"v{i}", d) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    edges = [(i, (i + 1) % n_vars) for i in range(n_vars)] + [(0, 3)]
+    for i, j in edges:
+        dcop.add_constraint(constraint_from_str(
+            f"c{i}_{j}", f"10 if v{i} == v{j} else 0",
+            [variables[i], variables[j]],
+        ))
+    return dcop
+
+
+def _msg(prio=MSG_ALGO, content="x"):
+    return ComputationMessage(
+        "c_src", "c_dst", Message("test", content), prio)
+
+
+class RecordingLayer(CommunicationLayer):
+    """Inner transport stub: records sends, delivers nothing."""
+
+    def __init__(self):
+        super().__init__()
+        self.sent = []
+
+    @property
+    def address(self):
+        return self
+
+    def send_msg(self, src_agent, dest_agent, msg, on_error=None):
+        self.sent.append((src_agent, dest_agent, msg))
+
+
+# ------------------------------------------------------------------ #
+# RetryPolicy / CircuitBreaker
+
+
+class TestRetryPolicy:
+    def test_backoff_is_exponential_and_capped(self):
+        policy = RetryPolicy(base_delay=0.1, multiplier=2.0,
+                             max_delay=0.5, jitter=0.0)
+        delays = [policy.delay_for(a) for a in range(1, 6)]
+        assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+    def test_jitter_is_bounded_and_seeded(self):
+        import random
+
+        policy = RetryPolicy(base_delay=1.0, jitter=0.5)
+        d1 = policy.delay_for(1, random.Random(7))
+        d2 = policy.delay_for(1, random.Random(7))
+        assert d1 == d2
+        assert 1.0 <= d1 <= 1.5
+
+    def test_call_retries_until_success(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("down")
+            return "up"
+
+        policy = RetryPolicy(max_attempts=5, base_delay=0.001,
+                             jitter=0.0)
+        assert policy.call(flaky) == "up"
+        assert len(calls) == 3
+
+    def test_call_exhausts_attempts(self):
+        policy = RetryPolicy(max_attempts=3, base_delay=0.001,
+                             jitter=0.0)
+        with pytest.raises(RetryExhaustedError) as exc:
+            policy.call(lambda: (_ for _ in ()).throw(OSError("x")))
+        assert isinstance(exc.value.last_error, OSError)
+
+    def test_deadline_stops_before_max_attempts(self):
+        policy = RetryPolicy(max_attempts=1000, base_delay=0.2,
+                             jitter=0.0, deadline=0.1)
+        calls = []
+
+        def failing():
+            calls.append(1)
+            raise OSError("x")
+
+        with pytest.raises(RetryExhaustedError):
+            policy.call(failing)
+        assert len(calls) == 1  # next backoff would cross the deadline
+
+    def test_call_requires_a_bound(self):
+        policy = RetryPolicy(max_attempts=None, deadline=None)
+        with pytest.raises(ValueError):
+            policy.call(lambda: None)
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("PYDCOP_RETRY_MAX_ATTEMPTS", "9")
+        monkeypatch.setenv("PYDCOP_RETRY_BASE_DELAY", "0.25")
+        monkeypatch.setenv("PYDCOP_RETRY_DEADLINE", "12")
+        policy = RetryPolicy.from_env("PYDCOP_RETRY_")
+        assert policy.max_attempts == 9
+        assert policy.base_delay == 0.25
+        assert policy.deadline == 12
+        # Unset vars keep the passed defaults.
+        policy = RetryPolicy.from_env("PYDCOP_OTHER_", max_attempts=2)
+        assert policy.max_attempts == 2
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_then_half_opens(self):
+        breaker = CircuitBreaker(failure_threshold=3,
+                                 reset_timeout=0.1)
+        for _ in range(3):
+            assert breaker.allow()
+            breaker.record_failure()
+        assert breaker.state == "open"
+        assert not breaker.allow()
+        time.sleep(0.12)
+        assert breaker.state == "half_open"
+        # Exactly one probe allowed, and a success closes the circuit.
+        assert breaker.allow()
+        assert not breaker.allow()
+        breaker.record_success()
+        assert breaker.state == "closed"
+        assert breaker.allow()
+
+    def test_failed_probe_rearms_timeout(self):
+        breaker = CircuitBreaker(failure_threshold=1,
+                                 reset_timeout=0.1)
+        breaker.record_failure()
+        time.sleep(0.12)
+        assert breaker.allow()  # probe
+        breaker.record_failure()
+        assert breaker.state == "open"
+        assert not breaker.allow()
+
+    def test_policy_call_respects_breaker(self):
+        breaker = CircuitBreaker(failure_threshold=1,
+                                 reset_timeout=60.0)
+        policy = RetryPolicy(max_attempts=1)
+        with pytest.raises(RetryExhaustedError):
+            policy.call(
+                lambda: (_ for _ in ()).throw(OSError("x")),
+                breaker=breaker,
+            )
+        with pytest.raises(CircuitOpenError):
+            policy.call(lambda: "never runs", breaker=breaker)
+
+
+# ------------------------------------------------------------------ #
+# Fault injection
+
+
+class TestFaultyLayer:
+    def _layer(self, plan):
+        inner = RecordingLayer()
+        return FaultyCommunicationLayer(inner, plan), inner
+
+    def test_same_seed_same_fault_pattern(self):
+        outcomes = []
+        for _ in range(2):
+            layer, inner = self._layer(
+                FaultPlan(seed=CHAOS_SEED, drop=0.3))
+            for i in range(50):
+                layer.send_msg("a", "b", _msg(content=i))
+            outcomes.append(
+                [m.msg.content for _, _, m in inner.sent])
+        assert outcomes[0] == outcomes[1]
+        assert 0 < len(outcomes[0]) < 50  # some dropped, some not
+
+    def test_different_seed_different_pattern(self):
+        patterns = []
+        for seed in (1, 2):
+            layer, inner = self._layer(FaultPlan(seed=seed, drop=0.5))
+            for i in range(60):
+                layer.send_msg("a", "b", _msg(content=i))
+            patterns.append([m.msg.content for _, _, m in inner.sent])
+        assert patterns[0] != patterns[1]
+
+    def test_drop_one_drops_everything(self):
+        layer, inner = self._layer(FaultPlan(drop=1.0))
+        for _ in range(10):
+            layer.send_msg("a", "b", _msg())
+        assert inner.sent == []
+        assert layer.stats.dropped == 10
+
+    def test_duplicate_one_delivers_twice(self):
+        layer, inner = self._layer(FaultPlan(duplicate=1.0))
+        layer.send_msg("a", "b", _msg())
+        assert len(inner.sent) == 2
+        assert layer.stats.duplicated == 1
+
+    def test_delay_delivers_later(self):
+        layer, inner = self._layer(
+            FaultPlan(delay=1.0, delay_time=0.05))
+        layer.send_msg("a", "b", _msg())
+        assert inner.sent == []  # not yet
+        deadline = time.monotonic() + 2
+        while not inner.sent and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(inner.sent) == 1
+        assert layer.stats.delayed == 1
+
+    def test_partition_blocks_cross_group_only(self):
+        plan = FaultPlan(partitions=(
+            frozenset({"a", "b"}), frozenset({"c"})))
+        layer, inner = self._layer(plan)
+        layer.send_msg("a", "b", _msg())   # same group
+        layer.send_msg("a", "c", _msg())   # cross group
+        layer.send_msg("a", "x", _msg())   # x in no group: free
+        assert len(inner.sent) == 2
+        assert layer.stats.partitioned == 1
+
+    def test_management_traffic_protected(self):
+        layer, inner = self._layer(FaultPlan(drop=1.0))
+        layer.send_msg("a", "b", _msg(prio=MSG_MGT))
+        assert len(inner.sent) == 1
+        layer.send_msg("a", "b", _msg(prio=MSG_ALGO))
+        assert len(inner.sent) == 1  # algo message dropped
+
+    def test_crash_event_parse(self):
+        event = CrashEvent.parse("a1:30")
+        assert event == CrashEvent("a1", 30)
+        with pytest.raises(ValueError):
+            CrashEvent.parse("30")
+
+
+# ------------------------------------------------------------------ #
+# Checkpoint / resume
+
+
+class TestCheckpoint:
+    def _engine(self):
+        from pydcop_tpu.algorithms.maxsum import build_engine
+
+        return build_engine(_ring_dcop(), {})
+
+    def test_state_roundtrip(self, tmp_path):
+        import numpy as np
+
+        engine = self._engine()
+        state = engine.init_state()
+        path = str(tmp_path / "s.npz")
+        save_state(path, state, cycle=0, extra={"tag": "t"})
+        loaded, meta = load_state(path, engine.init_state())
+        assert meta["cycle"] == 0
+        assert meta["extra"] == {"tag": "t"}
+        import jax
+
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(loaded)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_manager_prunes_and_finds_latest(self, tmp_path):
+        engine = self._engine()
+        manager = CheckpointManager(str(tmp_path), every=5, keep=2)
+        state = engine.init_state()
+        for cycle in (5, 10, 15):
+            manager.save(state, cycle)
+        cycles = [c for c, _ in manager.checkpoints()]
+        assert cycles == [10, 15]  # keep=2 pruned cycle 5
+        assert manager.latest().endswith("ckpt_15.npz")
+
+    def test_latest_skips_corrupt_snapshot(self, tmp_path):
+        engine = self._engine()
+        manager = CheckpointManager(str(tmp_path), every=5, keep=3)
+        manager.save(engine.init_state(), 5)
+        with open(manager.path_for(99), "wb") as f:
+            f.write(b"not an npz")
+        assert manager.latest().endswith("ckpt_5.npz")
+
+    def test_interrupt_resume_matches_uninterrupted(self, tmp_path):
+        """THE determinism criterion: interrupted at an arbitrary
+        segment boundary + resumed == uninterrupted, in assignment,
+        cost and cycle count."""
+        dcop = _ring_dcop()
+        reference = self._engine().run(max_cycles=100)
+        assert reference.cycles > 5  # interrupt lands mid-run
+
+        engine = self._engine()
+        manager = CheckpointManager(str(tmp_path), every=5, keep=2)
+        partial = engine.run_checkpointed(
+            max_cycles=100, manager=manager, max_segments=1
+        )
+        assert partial.metrics["interrupted"]
+        assert partial.cycles == 5
+        assert manager.latest().endswith("ckpt_5.npz")
+
+        # "New process": a fresh engine restores the snapshot.
+        from pydcop_tpu.algorithms.maxsum import build_engine
+
+        engine2 = build_engine(dcop, {})
+        resumed = resume_from_checkpoint(
+            engine2, manager, max_cycles=100)
+        assert resumed.metrics["resumed_from_cycle"] == 5
+        assert resumed.cycles == reference.cycles
+        assert resumed.converged == reference.converged
+        assert resumed.assignment == reference.assignment
+        ref_cost, _ = dcop.solution_cost(reference.assignment)
+        res_cost, _ = dcop.solution_cost(resumed.assignment)
+        assert res_cost == ref_cost
+
+    def test_segmented_run_matches_single_program(self):
+        reference = self._engine().run(max_cycles=100)
+        segmented = self._engine().run_checkpointed(
+            max_cycles=100, segment_cycles=7)
+        assert segmented.cycles == reference.cycles
+        assert segmented.assignment == reference.assignment
+
+    def test_resume_without_snapshot_starts_fresh(self, tmp_path):
+        engine = self._engine()
+        result = resume_from_checkpoint(
+            engine, str(tmp_path), max_cycles=100)
+        assert result.metrics["resumed_from_cycle"] == 0
+        assert result.cycles == self._engine().run(max_cycles=100).cycles
+
+    def test_api_solve_checkpointed(self, tmp_path):
+        from pydcop_tpu.api import solve
+
+        dcop = _ring_dcop()
+        ref = solve(dcop, "maxsum", backend="device", max_cycles=100)
+        res = solve(
+            dcop, "maxsum", backend="device", max_cycles=100,
+            checkpoint_dir=str(tmp_path), checkpoint_every=10,
+        )
+        assert res["cost"] == ref["cost"]
+        assert res["cycles"] == ref["cycles"]
+        assert (tmp_path / f"ckpt_{res['cycles']}.npz").exists()
+        # And resume from the finished state reproduces the result.
+        res2 = solve(
+            dcop, "maxsum", backend="device", max_cycles=100,
+            checkpoint_dir=str(tmp_path), checkpoint_every=10,
+            resume=True,
+        )
+        assert res2["assignment"] == res["assignment"]
+
+
+# ------------------------------------------------------------------ #
+# Chaos battery: solves under injected faults
+
+
+class TestChaosConvergence:
+    def test_amaxsum_under_drop_dup_delay(self):
+        """Async MaxSum under seeded 10% drop + dup + delay reaches
+        the fault-free cost (0 on the 3-colorable chain)."""
+        from pydcop_tpu.infrastructure.run import solve_with_agents
+
+        dist = Distribution({
+            "a0": ["v0", "diff_0_1"], "a1": ["v1"],
+            "a2": ["v2", "diff_1_2"], "a3": ["v3", "diff_2_3"],
+            "a4": [],
+        })
+        plan = FaultPlan(seed=CHAOS_SEED, drop=0.10, duplicate=0.05,
+                         delay=0.05, delay_time=0.02)
+        res = solve_with_agents(
+            _coloring_dcop(), "amaxsum", distribution=dist,
+            timeout=6, fault_plan=plan,
+        )
+        assert res["cost"] == 0
+        stats = res["fault_stats"]
+        assert stats["dropped"] > 0, (
+            "chaos run injected no faults — not a chaos run")
+
+    def test_dsa_under_dup_delay(self):
+        """Synchronous DSA tolerates duplication and delay (cycle
+        alignment shifts but progresses) and reaches cost 0.  Drop is
+        excluded by design: cycle-synchronous algorithms deadlock on
+        loss — that is what the async variants are for."""
+        from pydcop_tpu.infrastructure.run import solve_with_agents
+
+        algo = AlgorithmDef.build_with_default_param(
+            "dsa", {"stop_cycle": 100}, mode="min")
+        plan = FaultPlan(seed=CHAOS_SEED, duplicate=0.10, delay=0.10,
+                         delay_time=0.02)
+        res = solve_with_agents(
+            _coloring_dcop(), algo,
+            distribution=_variable_distribution(),
+            timeout=6, fault_plan=plan,
+        )
+        assert res["cost"] == 0
+        assert res["fault_stats"]["duplicated"] > 0
+
+    def test_kill_and_repair_mid_solve(self):
+        """Murder one agent mid-solve under 10% drop: the replication
+        + reparation path migrates its computation and the orchestrated
+        solve COMPLETES at the fault-free cost."""
+        from pydcop_tpu.infrastructure.run import solve_with_agents
+
+        algo = AlgorithmDef.build_with_default_param(
+            "adsa", {"stop_cycle": 40, "period": 0.05}, mode="min")
+        plan = FaultPlan(
+            seed=CHAOS_SEED, drop=0.10,
+            crashes=(CrashEvent("a1", 5),), replicas=2,
+        )
+        res = solve_with_agents(
+            _coloring_dcop(), algo,
+            distribution=_variable_distribution(),
+            timeout=45, fault_plan=plan,
+        )
+        assert res["killed_agents"] == ["a1"]
+        assert res["status"] == "FINISHED"
+        assert res["cost"] == 0
+        # Every variable still has a value: v1 was re-hosted, not lost.
+        assert set(res["assignment"]) == {"v0", "v1", "v2", "v3"}
+
+
+# ------------------------------------------------------------------ #
+# Transport hardening
+
+
+class TestHttpDeadAgentMark:
+    def test_refused_connection_marks_agent_dead(self):
+        """Acceptance: send_msg to a refused connection retries per
+        RetryPolicy, never raises through the caller, and ends in a
+        Discovery dead-agent mark."""
+        from pydcop_tpu.infrastructure.communication import (
+            HttpCommunicationLayer,
+        )
+
+        class Disco:
+            def __init__(self):
+                self.addresses = {}
+                self.unregistered = []
+
+            def agent_address(self, name):
+                return self.addresses[name]
+
+            def unregister_agent(self, name):
+                self.unregistered.append(name)
+
+        disco = Disco()
+        layer = HttpCommunicationLayer(
+            ("127.0.0.1", next(PORTS)),
+            retry_policy=RetryPolicy(
+                max_attempts=None, base_delay=0.05, max_delay=0.2,
+                jitter=0.0,
+            ),
+        )
+        try:
+            layer.discovery = disco
+            layer.RETRY_WINDOW = 0.6
+            layer.RETRY_INTERVAL = 0.05
+            disco.addresses["dead"] = ("127.0.0.1", 1)  # refused
+            layer.send_msg("me", "dead", _msg())  # must not raise
+            deadline = time.monotonic() + 10
+            while not disco.unregistered and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert disco.unregistered == ["dead"]
+            assert not layer._retry_queue
+            # The mark fed back through on_agent_change: new sends to
+            # the dead agent are dropped immediately, without retries.
+            layer.on_agent_change("agent_removed", "dead")
+            layer.send_msg("me", "dead", _msg())
+            assert not layer._retry_queue
+        finally:
+            layer.shutdown()
+
+    def test_breaker_skips_attempts_to_failing_destination(self):
+        from pydcop_tpu.infrastructure.communication import (
+            HttpCommunicationLayer,
+        )
+
+        class Disco:
+            def agent_address(self, name):
+                return ("127.0.0.1", 1)
+
+        layer = HttpCommunicationLayer(("127.0.0.1", next(PORTS)))
+        try:
+            layer.discovery = Disco()
+            layer._breaker_threshold = 2
+            for _ in range(3):
+                error = layer._try_send("me", "dead", _msg())
+                assert error is not None
+            assert "circuit open" in layer._try_send(
+                "me", "dead", _msg())
+        finally:
+            layer.shutdown()
+
+
+class TestMessagingShutdownContract:
+    def test_shutdown_wakes_blocked_next_msg(self):
+        comm = InProcessCommunicationLayer()
+        messaging = Messaging("a", comm)
+        result = {}
+
+        def blocked_pop():
+            t0 = time.monotonic()
+            result["msg"] = messaging.next_msg(timeout=10)
+            result["elapsed"] = time.monotonic() - t0
+
+        thread = threading.Thread(target=blocked_pop, daemon=True)
+        thread.start()
+        time.sleep(0.2)
+        messaging.shutdown()
+        thread.join(3)
+        assert not thread.is_alive(), "next_msg waited past shutdown"
+        assert result["msg"] is None
+        assert result["elapsed"] < 5, "woke by timeout, not shutdown"
+
+    def test_queued_messages_drain_after_shutdown(self):
+        comm = InProcessCommunicationLayer()
+        messaging = Messaging("a", comm)
+        messaging.post_local(_msg(prio=MSG_ALGO, content="algo"))
+        messaging.post_local(_msg(prio=MSG_MGT, content="mgt"))
+        messaging.shutdown()
+        # No message silently dropped: both drain, priority order
+        # preserved, and the empty queue answers None WITHOUT waiting.
+        assert messaging.next_msg(timeout=10).msg.content == "mgt"
+        assert messaging.next_msg(timeout=10).msg.content == "algo"
+        t0 = time.monotonic()
+        assert messaging.next_msg(timeout=10) is None
+        assert time.monotonic() - t0 < 1
+
+    def test_send_to_dead_inprocess_agent_never_raises(self):
+        """_send_remote retries then drops + logs — an unreachable
+        peer must not kill the calling agent thread."""
+        comm = InProcessCommunicationLayer()
+        messaging = Messaging(
+            "a", comm,
+            retry_policy=RetryPolicy(max_attempts=2, base_delay=0.001,
+                                     jitter=0.0),
+        )
+        from pydcop_tpu.infrastructure.discovery import Discovery
+
+        disco = Discovery("a", comm)
+        comm.discovery = disco
+        # Destination agent registered but its address is bogus (the
+        # in-process address protocol needs a layer object).
+        disco.register_agent("ghost", object(), publish=False)
+        messaging._send_remote("ghost", _msg())  # must not raise
+        # The known-but-unreachable agent was marked dead locally.
+        assert "ghost" not in disco.agents()
+
+
+class TestMultihostJoinRetry:
+    @pytest.fixture()
+    def multihost(self):
+        from pydcop_tpu.engine import multihost as mh
+
+        was_initialized = mh._initialized
+        mh._reset_initialized()
+        yield mh
+        mh._initialized = was_initialized
+
+    def test_join_retries_until_coordinator_up(self, multihost,
+                                               monkeypatch):
+        import jax
+
+        calls = []
+
+        def flaky_initialize(**kwargs):
+            calls.append(kwargs)
+            if len(calls) < 3:
+                raise RuntimeError("UNAVAILABLE: connection refused")
+
+        monkeypatch.setattr(
+            jax.distributed, "initialize", flaky_initialize)
+        multihost.initialize_multihost(
+            coordinator_address="127.0.0.1:65500",
+            num_processes=1, process_id=0,
+            retry_policy=RetryPolicy(max_attempts=5, base_delay=0.01,
+                                     jitter=0.0),
+        )
+        assert len(calls) == 3
+        assert multihost.multihost_initialized()
+
+    def test_failed_join_keeps_state_unlatched(self, multihost,
+                                               monkeypatch):
+        import jax
+
+        def dead_initialize(**kwargs):
+            raise RuntimeError("UNAVAILABLE: connection refused")
+
+        monkeypatch.setattr(
+            jax.distributed, "initialize", dead_initialize)
+        with pytest.raises(RetryExhaustedError):
+            multihost.initialize_multihost(
+                coordinator_address="127.0.0.1:65500",
+                num_processes=1, process_id=0,
+                retry_policy=RetryPolicy(max_attempts=2,
+                                         base_delay=0.01, jitter=0.0),
+            )
+        assert not multihost.multihost_initialized()
+        # A later attempt (coordinator now up) succeeds: the failure
+        # did not latch module state.
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lambda **kw: None)
+        multihost.initialize_multihost(
+            coordinator_address="127.0.0.1:65500",
+            num_processes=1, process_id=0,
+            retry_policy=RetryPolicy(max_attempts=1),
+        )
+        assert multihost.multihost_initialized()
