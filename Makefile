@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test chaos unit api cli check doctest bench dryrun onchip
+.PHONY: all test chaos trace-demo unit api cli check doctest bench dryrun onchip
 
 all: check test
 
@@ -24,7 +24,15 @@ chaos:
 	PYDCOP_CHAOS_SEED=42 $(PY) -m pytest \
 		tests/unit/test_resilience_battery.py -q
 
-test:
+# Observability gate: solve a small graph coloring through the real
+# CLI with --trace + --metrics and assert the Chrome trace validates
+# (json loads, spans well-nested, expected span kinds), the metrics
+# JSONL has a monotone cycle counter, the Prometheus dump parses, and
+# `pydcop trace summary` aggregates it.  See tools/trace_demo.py.
+trace-demo:
+	$(PY) tools/trace_demo.py
+
+test: trace-demo
 	$(PY) -m pytest tests/ -q
 
 unit:

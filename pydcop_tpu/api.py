@@ -42,6 +42,10 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           checkpoint_every: Optional[int] = None,
           resume: bool = False,
           fault_plan=None,
+          trace: Optional[str] = None,
+          trace_format: str = "chrome",
+          metrics_file: Optional[str] = None,
+          metrics_every: Optional[int] = None,
           ) -> SolveResult:
     """Solve a DCOP and return assignment + quality metrics.
 
@@ -56,6 +60,24 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     (identical final result — the battery asserts it).  ``fault_plan``
     (a resilience.faults.FaultPlan) runs the thread backend under
     seeded message faults and crash injection.
+
+    Observability knobs (docs/observability.md): ``trace`` records
+    the whole solve on the process tracer and writes a Chrome
+    ``trace_event`` JSON (``trace_format="chrome"``, open in
+    chrome://tracing / Perfetto) or line-delimited JSON
+    (``"jsonl"``) to that path.  ``metrics_file`` activates the
+    metrics registry, appends JSONL snapshots — in device mode one per
+    ``metrics_every``-cycle engine chunk (honest per-chunk timings +
+    a cost-vs-cycle curve, returned in ``metrics['cost_curve']``),
+    in thread mode one each time the global cycle advances by
+    ``metrics_every`` — and writes a Prometheus text dump to
+    ``<metrics_file>.prom`` when the solve ends.  Both default off and
+    cost nothing while off.  Interactions: with ``checkpoint_dir`` the
+    chunking follows ``checkpoint_every``, so snapshots land every
+    ``max(checkpoint_every, metrics_every)`` cycles; ``warmup=True``
+    keeps the plain (unsegmented) device path — the solve is still
+    traced, but without per-chunk points or a cost curve.
+
     warmup=True runs the compiled program once untimed before the timed
     call, so one-shot solves report steady-state rates instead of
     compile-dominated ones (device backend only).  The warm-up run is a
@@ -104,6 +126,40 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             "location to resume from"
         )
 
+    session = None
+    if trace is not None or metrics_file is not None:
+        from pydcop_tpu.observability import ObservabilitySession
+
+        session = ObservabilitySession(
+            trace, trace_format, metrics_file
+        ).start()
+    try:
+        from pydcop_tpu.observability.trace import tracer
+
+        with tracer.span("solve", "api", algo=algo_def.algo,
+                         backend=backend, max_cycles=max_cycles):
+            return _solve(
+                dcop, algo_def, module, distribution=distribution,
+                backend=backend, timeout=timeout,
+                max_cycles=max_cycles, mesh=mesh, n_devices=n_devices,
+                warmup=warmup, ui_port=ui_port, collector=collector,
+                collect_moment=collect_moment,
+                collect_period=collect_period, delay=delay,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every, resume=resume,
+                fault_plan=fault_plan, observing=session is not None,
+                metrics_file=metrics_file, metrics_every=metrics_every,
+            )
+    finally:
+        if session is not None:
+            session.finish()
+
+
+def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
+           max_cycles, mesh, n_devices, warmup, ui_port, collector,
+           collect_moment, collect_period, delay, checkpoint_dir,
+           checkpoint_every, resume, fault_plan, observing,
+           metrics_file, metrics_every) -> SolveResult:
     if backend == "device":
         if not hasattr(module, "solve_on_device"):
             raise NotImplementedError(
@@ -116,7 +172,21 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
 
         initialize_multihost()
         t0 = time.perf_counter()
-        if checkpoint_dir is not None:
+        # The engine probe needs chunk boundaries, so an observed solve
+        # routes through the same segmented loop checkpointing uses.
+        # Excluded: decimation (its host-driven clamping rounds are a
+        # different loop) and warmup=True (the segmented loop has no
+        # discarded warm-up call, and silently dropping a requested
+        # steady-state measurement would be worse than losing the
+        # cost curve) — both fall back to the plain path, which still
+        # traces the overall device_solve span.
+        probed = (
+            observing
+            and not warmup
+            and hasattr(module, "build_engine")
+            and not algo_def.params.get("decimation")
+        )
+        if checkpoint_dir is not None or probed:
             if not hasattr(module, "build_engine"):
                 raise NotImplementedError(
                     f"Algorithm {algo_def.algo} has no segmentable "
@@ -131,17 +201,43 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             engine = module.build_engine(
                 dcop, algo_def.params, mesh=mesh, n_devices=n_devices
             )
-            manager = CheckpointManager(
-                checkpoint_dir, every=checkpoint_every or 100
-            )
+            probe = None
+            if probed:
+                from pydcop_tpu.observability.engine_probe import (
+                    EngineProbe,
+                )
+
+                # Snapshots fire at chunk boundaries; with
+                # checkpointing the chunk size is the checkpoint
+                # cadence, so the effective snapshot period is
+                # max(checkpoint_every, metrics_every).
+                probe = EngineProbe(
+                    engine, metrics_path=metrics_file,
+                    metrics_every=metrics_every or 1,
+                )
+            manager = None
+            segment_cycles = None
+            if checkpoint_dir is not None:
+                manager = CheckpointManager(
+                    checkpoint_dir, every=checkpoint_every or 100
+                )
+            else:
+                segment_cycles = metrics_every or 100
             if resume:
                 res = resume_from_checkpoint(
-                    engine, manager, max_cycles=max_cycles
+                    engine, manager, max_cycles=max_cycles, probe=probe
                 )
             else:
                 res = engine.run_checkpointed(
-                    max_cycles=max_cycles, manager=manager
+                    max_cycles=max_cycles, manager=manager,
+                    segment_cycles=segment_cycles, probe=probe,
                 )
+            if probe is not None:
+                from pydcop_tpu.observability.engine_probe import (
+                    attach_result_metrics,
+                )
+
+                attach_result_metrics(res, probe)
         else:
             res = module.solve_on_device(
                 dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
@@ -186,6 +282,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             collect_moment=collect_moment,
             collect_period=collect_period, delay=delay,
             fault_plan=fault_plan,
+            metrics_file=metrics_file, metrics_every=metrics_every,
         )
 
     raise ValueError(f"Unknown backend {backend!r}")

@@ -44,8 +44,25 @@ def set_parser(subparsers):
                         help="first websocket UI port (one per agent, "
                              "thread mode)")
     parser.add_argument("--trace", default=None,
-                        help="per-step trace CSV file (thread mode, "
-                             "infrastructure/stats.py)")
+                        help="trace file for the run; format chosen "
+                             "by --trace_format (docs/observability"
+                             ".md)")
+    parser.add_argument("--trace_format", "--trace-format",
+                        default="chrome",
+                        choices=["chrome", "jsonl", "csv"],
+                        help="chrome: trace_event JSON for "
+                             "chrome://tracing / Perfetto; jsonl: one "
+                             "event per line; csv: legacy per-step "
+                             "rows (thread mode, infrastructure/"
+                             "stats.py)")
+    parser.add_argument("--metrics", default=None,
+                        help="JSONL metrics-snapshot file; a "
+                             "Prometheus text dump is written next to "
+                             "it (<file>.prom)")
+    parser.add_argument("--metrics_every", "--metrics-every",
+                        type=int, default=100,
+                        help="cycles between metrics snapshots (device "
+                             "mode: also the engine chunk size)")
     parser.add_argument("--profile", default=None,
                         help="device mode: write a JAX profiler trace "
                              "of the solve to this directory (inspect "
@@ -88,10 +105,16 @@ def run_cmd(args) -> int:
     from pydcop_tpu.api import solve
     from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
 
-    if args.trace:
+    # csv is the legacy per-step CSV (infrastructure/stats.py, thread
+    # mode); chrome/jsonl route through the observability tracer via
+    # api.solve's trace knob.
+    trace_file = trace_format = None
+    if args.trace and args.trace_format == "csv":
         from pydcop_tpu.infrastructure import stats
 
         stats.set_stats_file(args.trace)
+    elif args.trace:
+        trace_file, trace_format = args.trace, args.trace_format
 
     dcop = load_dcop_from_file(args.dcop_files)
     algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
@@ -144,6 +167,9 @@ def run_cmd(args) -> int:
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
                 resume=args.resume,
+                trace=trace_file, trace_format=trace_format or "chrome",
+                metrics_file=args.metrics,
+                metrics_every=args.metrics_every,
             )
         result = {
             "status": res["status"],
@@ -201,6 +227,9 @@ def run_cmd(args) -> int:
             collector=collector, collect_moment=args.collect_on,
             collect_period=args.period, delay=args.delay,
             fault_plan=fault_plan,
+            trace=trace_file, trace_format=trace_format or "chrome",
+            metrics_file=args.metrics,
+            metrics_every=args.metrics_every,
         )
         result = {
             "status": res["status"],

@@ -1,0 +1,65 @@
+"""``pydcop trace``: inspect trace files produced by ``--trace``.
+
+``pydcop trace summary FILE`` prints top-k span aggregates (count,
+total/mean/max duration) from a Chrome ``trace_event`` JSON or a JSONL
+trace — the quick "where did the time go" answer that does not need a
+browser.  Instant events (fault injections, breaker trips, message
+sends) aggregate with zero duration; their counts are the point.
+"""
+
+import sys
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "trace", help="inspect trace files produced by --trace")
+    trace_sub = parser.add_subparsers(
+        title="trace commands", dest="trace_command")
+    summary = trace_sub.add_parser(
+        "summary", help="top-k span aggregates of a trace file")
+    summary.add_argument("trace_file", help="chrome-JSON or JSONL "
+                                            "trace file")
+    summary.add_argument("--top", type=int, default=15,
+                         help="rows to print (default 15)")
+    summary.add_argument("--by", default="name",
+                         choices=["name", "cat"],
+                         help="aggregate by span name or category")
+    summary.set_defaults(func=run_summary)
+    parser.set_defaults(func=_no_subcommand(parser))
+
+
+def _no_subcommand(parser):
+    def run(_args) -> int:
+        parser.print_help(sys.stderr)
+        return 2
+
+    return run
+
+
+def run_summary(args) -> int:
+    from pydcop_tpu.observability.trace import (
+        load_trace_file,
+        summarize_spans,
+    )
+
+    events = load_trace_file(args.trace_file)
+    rows = summarize_spans(events, by=args.by, top=args.top)
+    spans = sum(1 for e in events if e.get("ph") == "X")
+    instants = sum(1 for e in events if e.get("ph") == "i")
+    threads = len({e.get("tid") for e in events})
+    print(f"{args.trace_file}: {spans} spans, {instants} instants, "
+          f"{threads} threads")
+    if not rows:
+        print("no span events")
+        return 0
+    key_width = max(len(str(r[args.by])) for r in rows)
+    key_width = max(key_width, len(args.by))
+    header = (f"{args.by:<{key_width}}  {'count':>8}  "
+              f"{'total_ms':>12}  {'mean_ms':>10}  {'max_ms':>10}")
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print(f"{str(r[args.by]):<{key_width}}  {r['count']:>8}  "
+              f"{r['total_ms']:>12.3f}  {r['mean_ms']:>10.3f}  "
+              f"{r['max_ms']:>10.3f}")
+    return 0

@@ -38,6 +38,7 @@ def make_parser() -> argparse.ArgumentParser:
         replica_dist,
         run,
         solve,
+        trace,
     )
 
     parser = argparse.ArgumentParser(
@@ -60,7 +61,7 @@ def make_parser() -> argparse.ArgumentParser:
     )
     subparsers = parser.add_subparsers(title="commands", dest="command")
     for cmd in (solve, run, distribute, graph, agent, orchestrator,
-                generate, replica_dist, batch, consolidate):
+                generate, replica_dist, batch, consolidate, trace):
         cmd.set_parser(subparsers)
     return parser
 

@@ -23,6 +23,7 @@ from pydcop_tpu.engine.compile import (
 )
 from pydcop_tpu.engine.sharding import make_mesh, shard_graph
 from pydcop_tpu.engine.timing import sync
+from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.ops import maxsum as maxsum_ops
 from pydcop_tpu.ops import maxsum_lane as lane_ops
 
@@ -70,7 +71,12 @@ def timed_jit_call(warm: set, key, fn, *args):
     """
     first = key not in warm
     t0 = time.perf_counter()
-    out = sync(fn(*args))
+    if tracer.enabled:
+        with tracer.span("jit_compile" if first else "engine_call",
+                         "engine", key=str(key)):
+            out = sync(fn(*args))
+    else:
+        out = sync(fn(*args))
     elapsed = time.perf_counter() - t0
     if first:
         warm.add(key)
@@ -122,7 +128,12 @@ def run_device_fn(graph: CompiledFactorGraph, meta: FactorGraphMeta,
         sync(jitted(graph))
         compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    out = sync(jitted(graph))
+    if tracer.enabled:
+        with tracer.span("device_solve", "engine",
+                         warmed=warmup):
+            out = sync(jitted(graph))
+    else:
+        out = sync(jitted(graph))
     t1 = time.perf_counter()
     values, cost, cycles = jax.device_get(out)
     values = np.asarray(values)
@@ -228,7 +239,8 @@ class MaxSumEngine:
                          segment_cycles: Optional[int] = None,
                          stop_on_convergence: bool = True,
                          initial_state=None,
-                         max_segments: Optional[int] = None
+                         max_segments: Optional[int] = None,
+                         probe=None,
                          ) -> "DeviceRunResult":
         """The solve loop chunked into K-cycle segments with a state
         snapshot between segments — the preemption-survival entry point
@@ -248,6 +260,12 @@ class MaxSumEngine:
         ``initial_state`` resumes from a restored snapshot;
         ``max_segments`` stops early after that many segments — the
         test harness's deterministic stand-in for a preemption.
+
+        ``probe`` (an observability.engine_probe.EngineProbe) receives
+        ``on_segment(state, values, run_s, compile_s)`` after every
+        segment — the chunk boundary is the only place a host already
+        waits, so the probe's cost/convergence points cost no extra
+        syncs inside the jitted loop.
         """
         from pydcop_tpu.resilience.checkpoint import CheckpointManager
 
@@ -280,12 +298,23 @@ class MaxSumEngine:
             # stepping.
             extra = min(every, max(max_cycles - cycle, 0))
             fn = self._segment_fn(extra, stop_on_convergence)
-            (state, values), c_s, _ = self._call(
-                ("segment", extra, stop_on_convergence), fn,
-                self.graph, state,
-            )
+            if tracer.enabled:
+                with tracer.span("engine_segment", "engine",
+                                 segment=segments, from_cycle=cycle,
+                                 extra_cycles=extra):
+                    (state, values), c_s, run_s = self._call(
+                        ("segment", extra, stop_on_convergence), fn,
+                        self.graph, state,
+                    )
+            else:
+                (state, values), c_s, run_s = self._call(
+                    ("segment", extra, stop_on_convergence), fn,
+                    self.graph, state,
+                )
             compile_s += c_s
             segments += 1
+            if probe is not None:
+                probe.on_segment(state, values, run_s, c_s)
             if manager is not None:
                 manager.save(state, int(state.cycle))
                 checkpoints += 1

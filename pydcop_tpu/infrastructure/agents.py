@@ -20,6 +20,8 @@ from pydcop_tpu.infrastructure.computations import (
     MessagePassingComputation,
 )
 from pydcop_tpu.infrastructure.discovery import Discovery
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.trace import tracer
 
 
 class AgentException(Exception):
@@ -55,6 +57,29 @@ class Agent:
         self._periodic: List[List] = []  # [period, action, next_due]
         self.t_active = 0.0
         self._start_time: Optional[float] = None
+        # Activity accounting: the hot message loop bumps plain
+        # instance attributes (no shared locks — the disabled-cost
+        # contract), and :meth:`_publish_metrics` folds the deltas
+        # into the process-wide registry counters whenever metrics are
+        # read — the registry stays the canonical, monotone export
+        # (a re-created agent name keeps accumulating the same
+        # series) while per-instance figures come from the local
+        # attributes.
+        self._n_handled = 0
+        self._bytes_in = 0
+        self._m_handled = metrics_registry.counter(
+            "pydcop_agent_messages_handled_total",
+            "Messages handled by the agent thread").bind(agent=name)
+        self._m_in_bytes = metrics_registry.counter(
+            "pydcop_agent_message_bytes_handled_total",
+            "Total size of messages handled by the agent thread"
+        ).bind(agent=name)
+        self._m_active = metrics_registry.counter(
+            "pydcop_agent_active_seconds_total",
+            "Seconds the agent thread spent handling messages"
+        ).bind(agent=name)
+        # Already-published portion of the local attributes.
+        self._m_published = [0, 0, 0.0]
         # Orchestration hooks, set by OrchestratedAgent:
         self.on_value_change: Optional[Callable] = None
         self.on_cycle_change: Optional[Callable] = None
@@ -191,9 +216,23 @@ class Agent:
             cmsg = self._messaging.next_msg(0.05)
             if cmsg is not None:
                 t0 = time.monotonic()
-                self._handle_message(cmsg)
+                if tracer.enabled:
+                    tracer.instant(
+                        "message_recv", "comm", agent=self._name,
+                        computation=cmsg.dest_comp, src=cmsg.src_comp,
+                        type=cmsg.msg.type, size=cmsg.msg.size,
+                    )
+                    with tracer.span(
+                            "agent_step", "agent", agent=self._name,
+                            computation=cmsg.dest_comp,
+                            msg_type=cmsg.msg.type):
+                        self._handle_message(cmsg)
+                else:
+                    self._handle_message(cmsg)
                 duration = time.monotonic() - t0
                 self.t_active += duration
+                self._n_handled += 1
+                self._bytes_in += cmsg.msg.size
                 if stats.tracing_enabled():
                     comp = self._computations.get(cmsg.dest_comp)
                     stats.trace_computation(
@@ -253,19 +292,54 @@ class Agent:
 
     # -- metrics ------------------------------------------------------- #
 
+    def _publish_metrics(self):
+        """Fold the hot-loop attribute deltas into the registry
+        counters; returns this instance's (handled, bytes_in,
+        active_s) totals."""
+        handled, in_size, active = (
+            self._n_handled, self._bytes_in, self.t_active)
+        delta = (handled - self._m_published[0],
+                 in_size - self._m_published[1],
+                 active - self._m_published[2])
+        self._m_published = [handled, in_size, active]
+        if delta[0]:
+            self._m_handled.inc(delta[0])
+        if delta[1]:
+            self._m_in_bytes.inc(delta[1])
+        if delta[2] > 0:
+            self._m_active.inc(delta[2])
+        return handled, in_size, active
+
     def metrics(self) -> Dict:
+        """Reference-parity agent metrics (agents.py:717), extended
+        with message-size totals and the activity-time split — all
+        sourced from the observability metrics registry, so
+        ``pydcop run --run_metrics`` and the orchestrator's
+        end-metrics aggregate the exact same counters."""
         cycles = {}
         for name, comp in self._computations.items():
             if hasattr(comp, "cycle_count"):
                 cycles[name] = comp.cycle_count
+        handled, in_size, active = self._publish_metrics()
+        total = (
+            time.monotonic() - self._start_time
+            if self._start_time else 0.0
+        )
+        out_count, out_size = self._messaging.ext_msg_totals()
         return {
             "count_ext_msg": dict(self._messaging.count_ext_msg),
             "size_ext_msg": dict(self._messaging.size_ext_msg),
             "cycles": cycles,
-            "activity_ratio": (
-                self.t_active / (time.monotonic() - self._start_time)
-                if self._start_time else 0
-            ),
+            "activity_ratio": active / total if total else 0,
+            "msg_count": out_count,
+            "msg_size": out_size,
+            "msg_in_count": handled,
+            "msg_in_size": in_size,
+            "activity": {
+                "active_s": active,
+                "idle_s": max(total - active, 0.0),
+                "total_s": total,
+            },
         }
 
     def __repr__(self):

@@ -21,6 +21,8 @@ from typing import Callable, Dict, Optional, Tuple
 from urllib import request as urlrequest
 
 from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.resilience.retry import (
     CircuitBreaker,
     CircuitOpenError,
@@ -176,6 +178,25 @@ class Messaging:
         self.msg_queue_count = 0
         # Parked messages waiting for discovery: comp -> list of msgs.
         self._parked: Dict[str, list] = {}
+        # Registry-backed outbound totals (observability.metrics):
+        # the send path bumps plain attributes (no shared locks on the
+        # hot path — the disabled-cost contract); ext_msg_totals()
+        # folds the deltas into the registry counters on read, same
+        # pattern as Agent._publish_metrics.
+        self._out_count = 0
+        self._out_bytes = 0
+        self._m_out_published = [0, 0]
+        self._m_out = metrics_registry.counter(
+            "pydcop_agent_messages_sent_total",
+            "Remote messages sent by the agent").bind(agent=agent_name)
+        self._m_out_bytes = metrics_registry.counter(
+            "pydcop_agent_message_bytes_sent_total",
+            "Total size of remote messages sent by the agent"
+        ).bind(agent=agent_name)
+        self._m_q_depth = metrics_registry.gauge(
+            "pydcop_queue_depth",
+            "Pending messages in the agent's priority queue"
+        ).bind(agent=agent_name)
 
     @property
     def communication(self) -> CommunicationLayer:
@@ -211,6 +232,20 @@ class Messaging:
             return
         self._send_remote(dest_agent, cmsg)
 
+    def ext_msg_totals(self):
+        """(count, size) of remote sends by THIS messaging instance;
+        folds the deltas into the registry counters so the canonical
+        export is current at every read."""
+        count, size = self._out_count, self._out_bytes
+        delta = (count - self._m_out_published[0],
+                 size - self._m_out_published[1])
+        self._m_out_published = [count, size]
+        if delta[0]:
+            self._m_out.inc(delta[0])
+        if delta[1]:
+            self._m_out_bytes.inc(delta[1])
+        return count, size
+
     def _send_remote(self, dest_agent: str, cmsg: ComputationMessage):
         self.count_ext_msg[cmsg.src_comp] = (
             self.count_ext_msg.get(cmsg.src_comp, 0) + 1
@@ -218,6 +253,22 @@ class Messaging:
         self.size_ext_msg[cmsg.src_comp] = (
             self.size_ext_msg.get(cmsg.src_comp, 0) + cmsg.msg.size
         )
+        self._out_count += 1
+        self._out_bytes += cmsg.msg.size
+        if metrics_registry.active:
+            # Per-type detail is opt-in: the label-key build per
+            # message is only paid when metrics were requested.
+            metrics_registry.counter(
+                "pydcop_messages_by_type_total",
+                "Remote messages by message type",
+            ).inc(type=cmsg.msg.type, direction="out")
+        if tracer.enabled:
+            tracer.instant(
+                "message_send", "comm", agent=self._agent_name,
+                src=cmsg.src_comp, dest_comp=cmsg.dest_comp,
+                dest_agent=dest_agent, type=cmsg.msg.type,
+                size=cmsg.msg.size,
+            )
         try:
             self._retry_policy.call(
                 self._comm.send_msg, self._agent_name, dest_agent, cmsg,
@@ -252,6 +303,8 @@ class Messaging:
             self._seq += 1
             self.msg_queue_count += 1
             self._queue.put((cmsg.msg_type, self._seq, cmsg))
+        if metrics_registry.active:
+            self._m_q_depth.set(self._queue.qsize())
 
     def next_msg(self, timeout: float = 0.05
                  ) -> Optional[ComputationMessage]:
@@ -277,6 +330,8 @@ class Messaging:
                 # Shutdown sentinel: stop waiting, drain what's left.
                 block = False
                 continue
+            if metrics_registry.active:
+                self._m_q_depth.set(self._queue.qsize())
             return cmsg
 
     def shutdown(self):
@@ -448,7 +503,8 @@ class HttpCommunicationLayer(CommunicationLayer):
             breaker = self._breakers.get(dest_agent)
             if breaker is None:
                 breaker = CircuitBreaker(
-                    self._breaker_threshold, self._breaker_reset
+                    self._breaker_threshold, self._breaker_reset,
+                    name=dest_agent,
                 )
                 self._breakers[dest_agent] = breaker
             return breaker
@@ -486,7 +542,13 @@ class HttpCommunicationLayer(CommunicationLayer):
             },
         )
         try:
-            urlrequest.urlopen(req, timeout=2.0)
+            if tracer.enabled:
+                with tracer.span("http_send", "comm",
+                                 src=src_agent, dest=dest_agent,
+                                 type=msg.msg.type):
+                    urlrequest.urlopen(req, timeout=2.0)
+            else:
+                urlrequest.urlopen(req, timeout=2.0)
             breaker.record_success()
             return None
         except Exception as e:

@@ -12,6 +12,7 @@ import random
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from pydcop_tpu.infrastructure.events import event_bus
+from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.utils.simple_repr import SimpleRepr
 
 MSG_ALGO = 20
@@ -566,6 +567,10 @@ class DcopComputation(MessagePassingComputation):
             event_bus.emit(
                 f"computations.cycle.{self.name}", self._cycle_count
             )
+        if tracer.enabled:
+            tracer.instant("cycle", "computation",
+                           computation=self.name,
+                           cycle=self._cycle_count)
 
     def footprint(self) -> float:
         from pydcop_tpu.algorithms import load_algorithm_module
@@ -614,6 +619,10 @@ class VariableComputation(DcopComputation):
             event_bus.emit(
                 f"computations.value.{self.name}", (val, cost)
             )
+        if tracer.enabled:
+            tracer.instant("value_selection", "computation",
+                           computation=self.name, value=str(val),
+                           cost=cost)
 
     def random_value_selection(self):
         self.value_selection(random.choice(list(self._variable.domain)))

@@ -25,6 +25,7 @@ from pydcop_tpu.infrastructure.computations import (
     register,
 )
 from pydcop_tpu.infrastructure.discovery import Directory
+from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.infrastructure.orchestratedagents import (
     AgentReadyMessage,
     AgentStoppedMessage,
@@ -100,6 +101,7 @@ class AgentsMgt(MessagePassingComputation):
             return  # repair-internal: keep out of the metrics stream
         self.orchestrator._on_progress()
         self.orchestrator._collect("value_change")
+        self.orchestrator._note_cycle()
 
     @register("cycle_change")
     def _on_cycle_change(self, sender, msg, t):
@@ -111,6 +113,7 @@ class AgentsMgt(MessagePassingComputation):
         if msg.computation in self.active_transients:
             return
         self.orchestrator._collect("cycle_change")
+        self.orchestrator._note_cycle()
 
     @register("computation_finished")
     def _on_comp_finished(self, sender, msg, t):
@@ -164,8 +167,21 @@ class AgentsMgt(MessagePassingComputation):
             cost, violation = None, None
         msg_count, msg_size = 0, 0
         for metrics in self.agent_metrics.values():
-            msg_count += sum(metrics.get("count_ext_msg", {}).values())
-            msg_size += sum(metrics.get("size_ext_msg", {}).values())
+            # Registry-sourced totals (Agent.metrics msg_count /
+            # msg_size) are bumped at the same call site as the
+            # per-computation count_ext_msg dicts, so the two views
+            # agree; the dict sum stays as fallback for pre-upgrade
+            # metrics payloads (process agents on an older build).
+            count = metrics.get("msg_count")
+            size = metrics.get("msg_size")
+            msg_count += int(
+                count if count is not None
+                else sum(metrics.get("count_ext_msg", {}).values())
+            )
+            msg_size += int(
+                size if size is not None
+                else sum(metrics.get("size_ext_msg", {}).values())
+            )
         total_time = (
             time.monotonic() - self.start_time
             if self.start_time else 0
@@ -221,6 +237,11 @@ class Orchestrator:
         self.collect_period = collect_period
         self._collect_timer: Optional[threading.Timer] = None
         self._collecting = False
+        # Optional observability.metrics.CycleSnapshotter (set by the
+        # runner when the caller asked for --metrics): invoked on
+        # every cycle/value report with the global cycle count; its
+        # own cadence check rate-limits the snapshot writes.
+        self.metrics_snapshotter = None
 
         self._agent = Agent(ORCHESTRATOR_AGENT, comm)
         self.directory = Directory(self._agent.discovery)
@@ -315,6 +336,18 @@ class Orchestrator:
         except Exception:
             logger.exception("Metrics collector failed")
 
+    def _note_cycle(self):
+        """Feed the global cycle view into the metrics snapshotter
+        (no-op without one; cost is only evaluated when a snapshot
+        actually fires — see CycleSnapshotter)."""
+        snapshotter = self.metrics_snapshotter
+        if snapshotter is None:
+            return
+        try:
+            snapshotter(max(self.mgt.cycles.values(), default=0))
+        except Exception:
+            logger.exception("Metrics snapshotter failed")
+
     def _schedule_periodic_collect(self):
         if not self._collecting or self.status != "RUNNING":
             return
@@ -351,13 +384,16 @@ class Orchestrator:
     def deploy_computations(self):
         """Send each computation's definition to its hosting agent
         (reference :203 → DeployMessage per computation :1197-1209)."""
-        for comp_name in self._expected_computations:
-            agent = self.distribution.agent_for(comp_name)
-            node = self.cg.computation(comp_name)
-            comp_def = ComputationDef(node, self.algo)
-            self.mgt.post_msg(
-                f"_mgt_{agent}", DeployMessage(comp_def), MSG_MGT
-            )
+        # Once-per-run path: tracer.span is its own no-op when off.
+        with tracer.span("deploy_computations", "orchestrator",
+                         computations=len(self._expected_computations)):
+            for comp_name in self._expected_computations:
+                agent = self.distribution.agent_for(comp_name)
+                node = self.cg.computation(comp_name)
+                comp_def = ComputationDef(node, self.algo)
+                self.mgt.post_msg(
+                    f"_mgt_{agent}", DeployMessage(comp_def), MSG_MGT
+                )
 
     def run(self, scenario=None, timeout: Optional[float] = None):
         """Start all computations; block until finished or timeout."""
@@ -527,6 +563,7 @@ class Orchestrator:
             if agent in self._removed_agents:
                 return
             self._removed_agents.add(agent)
+            tracer.instant("agent_failure", "orchestrator", agent=agent)
             orphaned = self.distribution.computations_hosted(agent)
             mapping = self.distribution.mapping
             mapping.pop(agent, None)
@@ -540,7 +577,10 @@ class Orchestrator:
                 agent, orphaned,
             )
             if orphaned:
-                self.repair(orphaned, departed=[agent])
+                with tracer.span("repair", "orchestrator",
+                                 departed=agent,
+                                 orphaned=len(orphaned)):
+                    self.repair(orphaned, departed=[agent])
 
     def repair(self, orphaned: List[str], departed: List[str],
                timeout: float = 10):

@@ -213,7 +213,9 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
                       collect_moment: str = "value_change",
                       collect_period: float = 1.0,
                       delay: Optional[float] = None,
-                      fault_plan=None) -> Dict:
+                      fault_plan=None,
+                      metrics_file: Optional[str] = None,
+                      metrics_every: Optional[int] = None) -> Dict:
     """Full-metrics variant used by the api/CLI thread backend.
 
     ``fault_plan`` (a resilience.faults.FaultPlan) turns the run into
@@ -222,7 +224,12 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
     places ``fault_plan.replicas`` replicas before the run and fires
     the kills from a FaultMonitor — the murdered agents' computations
     migrate through the reparation path.  Thread mode only (process
-    agents own their transports in other processes)."""
+    agents own their transports in other processes).
+
+    ``metrics_file`` appends a JSONL metrics snapshot (observability
+    registry) each time the orchestrator's global cycle view advances
+    by ``metrics_every`` cycles, including the cost of the then-current
+    assignment."""
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
             algo_def, mode=dcop.objective
@@ -280,6 +287,13 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             replication=bool(
                 fault_plan is not None and fault_plan.crashes),
             comm_wrapper=comm_wrapper,
+        )
+    if metrics_file is not None:
+        from pydcop_tpu.observability.metrics import CycleSnapshotter
+
+        orchestrator.metrics_snapshotter = CycleSnapshotter(
+            metrics_file, every=metrics_every or 1,
+            cost_fn=lambda: orchestrator.current_global_cost()[0],
         )
     stopped = False
     monitor = None

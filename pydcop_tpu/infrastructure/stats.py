@@ -5,12 +5,22 @@ Reference parity: pydcop/infrastructure/stats.py (column schema
 
 Columns: timestamp, computation, step duration, messages in/out,
 message sizes in/out, current value.
+
+This module is now a thin shim over the observability subsystem: every
+row is also forwarded to :data:`pydcop_tpu.observability.trace.tracer`
+as a ``computation_step`` instant (when tracing is enabled), so the
+legacy CSV and a Chrome/JSONL trace of the same run tell one story.
+An ``atexit`` close is registered the first time a file is opened, so
+an interrupted run still flushes its rows.
 """
 
+import atexit
 import csv
 import threading
 import time
 from typing import Optional
+
+from pydcop_tpu.observability.trace import tracer
 
 COLUMNS = [
     "time",
@@ -26,21 +36,47 @@ COLUMNS = [
 _lock = threading.Lock()
 _stats_file = None
 _writer = None
+_atexit_registered = False
 
 
 def set_stats_file(path: Optional[str]):
-    """Enable (or disable with None) step tracing to a CSV file."""
+    """Enable (or disable with None) step tracing to a CSV file.
+
+    The swap is atomic: the new file is opened (and its header
+    written) BEFORE the old writer is touched, so a failing ``open``
+    — bad directory, permissions — raises while the previous tracing
+    state keeps working.  (The old implementation closed the previous
+    file first; an open() error then left the globals half-cleared
+    with the caller believing tracing was still on.)
+    """
+    global _stats_file, _writer, _atexit_registered
+    with _lock:
+        new_file = new_writer = None
+        if path is not None:
+            new_file = open(path, "w", newline="", encoding="utf-8")
+            new_writer = csv.writer(new_file)
+            new_writer.writerow(COLUMNS)
+        old_file = _stats_file
+        _stats_file, _writer = new_file, new_writer
+        if old_file is not None:
+            old_file.close()
+        if new_file is not None and not _atexit_registered:
+            atexit.register(close)
+            _atexit_registered = True
+
+
+def close():
+    """Flush + close the CSV; idempotent (registered atexit so an
+    interrupted run keeps the rows written so far)."""
     global _stats_file, _writer
     with _lock:
         if _stats_file is not None:
-            _stats_file.close()
+            try:
+                _stats_file.close()
+            except Exception:
+                pass
             _stats_file = None
             _writer = None
-        if path is not None:
-            _stats_file = open(path, "w", newline="",
-                               encoding="utf-8")
-            _writer = csv.writer(_stats_file)
-            _writer.writerow(COLUMNS)
 
 
 def tracing_enabled() -> bool:
@@ -51,7 +87,20 @@ def trace_computation(computation: str, duration: float,
                       msg_in_count: int = 0, msg_in_size: int = 0,
                       msg_out_count: int = 0, msg_out_size: int = 0,
                       value=None):
-    """Append one step row (no-op unless set_stats_file was called)."""
+    """Append one step row (no-op unless set_stats_file was called).
+
+    Independently of the CSV state, the same event lands on the
+    observability tracer when it is enabled — one instrumentation
+    call site, two sinks.
+    """
+    if tracer.enabled:
+        tracer.instant(
+            "computation_step", "agent", computation=computation,
+            duration=duration, msg_in_count=msg_in_count,
+            msg_in_size=msg_in_size, msg_out_count=msg_out_count,
+            msg_out_size=msg_out_size,
+            value=None if value is None else str(value),
+        )
     with _lock:
         if _writer is None:
             return

@@ -1,0 +1,79 @@
+"""Unified observability: tracing, metrics registry, engine telemetry.
+
+Four parts (docs/observability.md):
+
+- :mod:`.trace` — process-wide :data:`~pydcop_tpu.observability.trace.
+  tracer` producing timestamped, parent-correlated spans with Chrome
+  ``trace_event`` and JSONL exporters;
+- :mod:`.metrics` — :data:`~pydcop_tpu.observability.metrics.registry`
+  of counters/gauges/histograms with Prometheus text export and JSONL
+  snapshots;
+- :mod:`.engine_probe` — per-chunk honest device timings + cost
+  convergence for the jitted solvers;
+- the instrumentation wired through infrastructure, engine and
+  resilience (all guarded on one flag check, zero overhead when off).
+
+:class:`ObservabilitySession` is the run-scoped front door used by
+``api.solve``: it enables the tracer/registry for one solve and
+exports trace + Prometheus files on the way out.
+"""
+
+from typing import Optional
+
+from pydcop_tpu.observability.metrics import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    registry,
+)
+from pydcop_tpu.observability.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    tracer,
+)
+
+
+class ObservabilitySession:
+    """Enable tracing/metrics for one solve; export on finish.
+
+    ``trace_path`` + ``trace_format`` ('chrome'|'jsonl') control the
+    trace export; ``metrics_path`` activates the registry's optional
+    instrumentation and, on finish, writes a Prometheus text dump next
+    to the JSONL snapshots (``<metrics_path>.prom``).
+    """
+
+    def __init__(self, trace_path: Optional[str] = None,
+                 trace_format: str = "chrome",
+                 metrics_path: Optional[str] = None):
+        if trace_format not in ("chrome", "jsonl"):
+            raise ValueError(
+                f"trace_format must be 'chrome' or 'jsonl', got "
+                f"{trace_format!r}"
+            )
+        self.trace_path = trace_path
+        self.trace_format = trace_format
+        self.metrics_path = metrics_path
+        self._was_active = registry.active
+
+    def start(self) -> "ObservabilitySession":
+        if self.trace_path:
+            tracer.enable()
+        if self.metrics_path:
+            registry.active = True
+        return self
+
+    def finish(self):
+        if self.trace_path:
+            tracer.disable()
+            tracer.export(self.trace_path, self.trace_format)
+        if self.metrics_path:
+            registry.active = self._was_active
+            with open(f"{self.metrics_path}.prom", "w",
+                      encoding="utf-8") as f:
+                f.write(registry.to_prometheus())
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False

@@ -1,0 +1,139 @@
+"""Engine-level telemetry for the jitted solvers.
+
+The device engine's solve is one XLA program per segment — a host
+callback per cycle would serialize the loop through the tunnel and
+destroy the very rate being measured (engine/timing.py documents how
+that tunnel also lies to ``block_until_ready``).  The probe therefore
+piggybacks on ``MaxSumEngine.run_checkpointed``'s existing K-cycle
+segmentation: each segment already ends with one honest ``sync`` (the
+forced host fetch in ``timed_jit_call``), so the per-chunk wall time
+handed to :meth:`EngineProbe.on_segment` is end-to-end honest, and the
+probe adds NO host syncs inside the jitted loop — its only extra work
+is one tiny jitted cost evaluation per chunk, on the chunk boundary
+the engine already pays for.
+
+Per chunk the probe emits: a ``chunk`` trace instant (cycle, cost,
+converged, honest seconds), the monotone cycle counter + cost gauge
+through a :class:`~pydcop_tpu.observability.metrics.CycleSnapshotter`
+(JSONL snapshot per chunk when a metrics path is set), and a point on
+the in-memory cost-vs-cycle curve that ``api.solve`` returns in
+``metrics['cost_curve']``.  The cost computation mirrors
+``run_maxsum_trace``'s exactly (constraint cost + noise-free variable
+base costs, mode sign, constant term), so the curve's final point
+equals the solver's reported cost — asserted in the battery.
+"""
+
+import logging
+from typing import Any, List, Optional, Tuple
+
+logger = logging.getLogger("pydcop.observability.engine_probe")
+
+
+class EngineProbe:
+    """Per-chunk cost/convergence/timing recorder for a
+    ``MaxSumEngine`` (edge layout; the lane layout's graph has no
+    host-side cost tables, so its chunks record timing only)."""
+
+    def __init__(self, engine, metrics_path: Optional[str] = None,
+                 metrics_every: int = 1, registry=None):
+        from pydcop_tpu.observability.metrics import CycleSnapshotter
+
+        self.engine = engine
+        self.snapshotter = CycleSnapshotter(
+            metrics_path, every=metrics_every, reg=registry
+        )
+        reg = self.snapshotter.registry
+        self._seg_seconds = reg.histogram(
+            "pydcop_engine_segment_seconds",
+            "Honest (sync-forced) wall seconds per engine chunk")
+        self._compile_seconds = reg.counter(
+            "pydcop_engine_compile_seconds_total",
+            "Seconds spent jit-compiling engine programs")
+        # (cycle, cost, converged, seconds) per chunk.
+        self.chunks: List[Tuple[int, Optional[float], bool, float]] = []
+        self._cost_fn = None
+
+    def _build_cost_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pydcop_tpu.ops.maxsum import assignment_constraint_cost
+
+        meta = self.engine.meta
+        base = meta.var_base_costs
+        base_arr = None if base is None else jnp.asarray(base)
+
+        def cost_of(values):
+            cost = assignment_constraint_cost(self.engine.graph, values)
+            if base_arr is not None:
+                cost = cost + jnp.sum(jnp.take_along_axis(
+                    base_arr, values[:, None], axis=1))
+            return cost
+
+        return jax.jit(cost_of)
+
+    def _chunk_cost(self, values) -> Optional[float]:
+        if getattr(self.engine, "layout", "edge") != "edge":
+            return None
+        try:
+            if self._cost_fn is None:
+                self._cost_fn = self._build_cost_fn()
+            raw = float(self._cost_fn(values))
+        except Exception:
+            logger.exception("Chunk cost evaluation failed")
+            return None
+        meta = self.engine.meta
+        sign = 1.0 if meta.mode == "min" else -1.0
+        return sign * raw + meta.constant_cost
+
+    def on_segment(self, state, values, seconds: float,
+                   compile_s: float):
+        """Record one completed chunk (called by ``run_checkpointed``
+        on the chunk boundary, after its honest sync).
+
+        A first call per program reports its whole elapsed time as
+        BOTH compile and run (timed_jit_call's overlapping-fields
+        convention — never sum them), so the run-only portion here is
+        ``seconds - compile_s``: compile time goes to its own counter,
+        not into the segment-seconds series.
+        """
+        from pydcop_tpu.observability.trace import tracer
+
+        cycle = int(state.cycle)
+        converged = bool(state.stable)
+        cost = self._chunk_cost(values)
+        run_s = max(float(seconds) - float(compile_s), 0.0)
+        self.chunks.append((cycle, cost, converged, run_s))
+        self._seg_seconds.observe(run_s)
+        if compile_s:
+            self._compile_seconds.inc(float(compile_s))
+        self.snapshotter(cycle, cost)
+        if tracer.enabled:
+            tracer.instant(
+                "chunk", "engine", cycle=cycle, cost=cost,
+                converged=converged, seconds=run_s,
+                compile_s=float(compile_s),
+            )
+
+    def cost_curve(self) -> List[Tuple[int, float]]:
+        """(cycle, cost) points for chunks where cost was computable."""
+        return [(cycle, cost) for cycle, cost, _, _ in self.chunks
+                if cost is not None]
+
+    def summary(self) -> dict:
+        run_s = sum(s for _, _, _, s in self.chunks)
+        return {
+            "chunks": len(self.chunks),
+            "chunk_seconds": run_s,
+            "cost_curve": self.cost_curve(),
+        }
+
+
+def attach_result_metrics(result: Any, probe: "EngineProbe"):
+    """Fold the probe's curve into a ``DeviceRunResult``/dict metrics
+    mapping (shared by api.solve's probed paths)."""
+    metrics = (result.metrics if hasattr(result, "metrics")
+               else result.setdefault("metrics", {}))
+    metrics["cost_curve"] = probe.cost_curve()
+    metrics["probe_chunks"] = len(probe.chunks)
+    return result

@@ -1,0 +1,352 @@
+"""Process-wide tracer: timestamped spans with parent/child
+correlation, exported as Chrome ``trace_event`` JSON or JSONL.
+
+The runtime is threaded (one thread per agent, HTTP server threads,
+retry sweepers, fault timers); a single locked event list would
+serialize every instrumented site on one mutex.  Instead each thread
+appends to its own buffer (``threading.local``) — the only lock is
+taken once per thread per session, when the buffer is registered for
+export — so recording is a list append plus a dict build.
+
+Disabled (the default) costs ONE attribute check: every instrumented
+site guards on ``tracer.enabled``, :meth:`Tracer.span` returns a
+shared no-op context manager singleton (no allocation), and
+:meth:`Tracer.instant` returns before touching its arguments.  The
+zero-overhead contract is asserted in the observability battery.
+
+Span events carry ``id``/``parent`` correlation ids (a per-thread span
+stack): a message-handling span opened inside an agent-step span
+records the step as its parent, so one trace file reconstructs the
+whole causal tree of a chaos run.  Chrome ``trace_event`` output loads
+directly in ``chrome://tracing`` / Perfetto (spans are ``ph:"X"``
+complete events, instants ``ph:"i"``); JSONL output is one event per
+line for ad-hoc ``jq``/pandas processing.
+"""
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Optional
+
+_US = 1e6  # trace_event timestamps are microseconds
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager returned while disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """An open span; records a complete (``ph:"X"``) event on exit."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "span_id",
+                 "parent_id", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.span_id = next(tracer._ids)
+        self.parent_id = 0
+        self._t0 = 0.0
+
+    def __enter__(self):
+        stack = self._tracer._stack()
+        self.parent_id = stack[-1] if stack else 0
+        stack.append(self.span_id)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        stack = self._tracer._stack()
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        self._tracer._record({
+            "name": self.name,
+            "cat": self.cat,
+            "ph": "X",
+            "ts": self._t0 * _US,
+            "dur": (t1 - self._t0) * _US,
+            "id": self.span_id,
+            "parent": self.parent_id,
+            "args": self.args,
+        })
+        return False
+
+
+class Tracer:
+    """Per-thread-buffered span/instant recorder.
+
+    Lifecycle: :meth:`enable` clears previous events and starts a
+    session; :meth:`disable` stops recording (events stay readable for
+    export); :meth:`events` / :meth:`export_chrome` /
+    :meth:`export_jsonl` read them back.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        # (tid, thread name, buffer) per registered thread.
+        self._buffers: List[tuple] = []
+        # Bumping the generation invalidates every thread's cached
+        # buffer, so enable() drops stale events without touching
+        # other threads' locals.
+        self._generation = 0
+        self._ids = itertools.count(1)
+
+    # -- recording ----------------------------------------------------- #
+
+    def _buf(self) -> list:
+        if getattr(self._local, "gen", None) != self._generation:
+            buf: list = []
+            thread = threading.current_thread()
+            self._local.buf = buf
+            self._local.stack = []
+            self._local.gen = self._generation
+            with self._lock:
+                # Synthetic tid, not thread.ident: the OS reuses
+                # idents once a thread exits (killed agents, repair
+                # threads), which would merge two threads' lanes and
+                # break span nesting within one exported lane.
+                tid = len(self._buffers) + 1
+                self._local.tid = tid
+                self._buffers.append((tid, thread.name, buf))
+        return self._local.buf
+
+    def _stack(self) -> list:
+        self._buf()
+        return self._local.stack
+
+    def _record(self, event: Dict[str, Any]):
+        if not self.enabled:
+            return
+        buf = self._buf()
+        event["tid"] = self._local.tid
+        buf.append(event)
+
+    def span(self, name: str, cat: str = "default", **args) -> Any:
+        """Context manager recording a complete span on exit.
+
+        Hot call sites should still guard on ``tracer.enabled`` so the
+        kwargs dict is never built while disabled."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return _Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "default", **args):
+        """Record a point-in-time event."""
+        if not self.enabled:
+            return
+        parent = self._stack()
+        self._record({
+            "name": name,
+            "cat": cat,
+            "ph": "i",
+            "ts": time.perf_counter() * _US,
+            "id": next(self._ids),
+            "parent": parent[-1] if parent else 0,
+            "args": args,
+        })
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def enable(self):
+        """Start a fresh tracing session (previous events dropped)."""
+        with self._lock:
+            self._generation += 1
+            self._buffers = []
+            self.enabled = True
+
+    def disable(self):
+        """Stop recording; buffered events stay readable for export."""
+        self.enabled = False
+
+    def clear(self):
+        """Drop all events; recording state unchanged."""
+        with self._lock:
+            self._generation += 1
+            self._buffers = []
+
+    # -- readback / export --------------------------------------------- #
+
+    def events(self) -> List[Dict[str, Any]]:
+        """All recorded events, globally sorted by timestamp."""
+        with self._lock:
+            buffers = [(tid, name, list(buf))
+                       for tid, name, buf in self._buffers]
+        merged = [ev for _, _, buf in buffers for ev in buf]
+        merged.sort(key=lambda e: e["ts"])
+        return merged
+
+    def thread_names(self) -> Dict[int, str]:
+        with self._lock:
+            return {tid: name for tid, name, _ in self._buffers}
+
+    def export_chrome(self, path: str):
+        """Write Chrome ``trace_event`` JSON (open in chrome://tracing
+        or https://ui.perfetto.dev)."""
+        pid = os.getpid()
+        trace_events = [
+            {
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": tid, "args": {"name": name},
+            }
+            for tid, name in sorted(self.thread_names().items())
+        ]
+        for ev in self.events():
+            out = {
+                "name": ev["name"],
+                "cat": ev["cat"],
+                "ph": ev["ph"],
+                "ts": ev["ts"],
+                "pid": pid,
+                "tid": ev["tid"],
+                "args": dict(ev.get("args") or {}),
+            }
+            if ev["ph"] == "X":
+                out["dur"] = ev["dur"]
+            else:
+                out["s"] = "t"  # thread-scoped instant
+            # Correlation ids ride in args: the Chrome schema has no
+            # parent field for X events, and viewers ignore extras.
+            out["args"]["span_id"] = ev.get("id", 0)
+            out["args"]["parent_id"] = ev.get("parent", 0)
+            trace_events.append(out)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {"traceEvents": trace_events, "displayTimeUnit": "ms"},
+                f, default=str,
+            )
+        os.replace(tmp, path)
+
+    def export_jsonl(self, path: str):
+        """One JSON event per line (jq/pandas-friendly)."""
+        names = self.thread_names()
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for ev in self.events():
+                row = dict(ev)
+                row["thread"] = names.get(ev["tid"], str(ev["tid"]))
+                f.write(json.dumps(row, default=str) + "\n")
+        os.replace(tmp, path)
+
+    def export(self, path: str, fmt: str = "chrome"):
+        if fmt == "chrome":
+            self.export_chrome(path)
+        elif fmt == "jsonl":
+            self.export_jsonl(path)
+        else:
+            raise ValueError(
+                f"unknown trace format {fmt!r}: use 'chrome' or 'jsonl'"
+            )
+
+
+tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return tracer
+
+
+# --------------------------------------------------------------------- #
+# trace-file readback + analysis (pydcop trace summary, make trace-demo)
+
+
+def load_trace_file(path: str) -> List[Dict[str, Any]]:
+    """Load events from a Chrome-trace JSON or a JSONL trace file.
+
+    Returns the normalized internal event shape (name/cat/ph/ts/dur/
+    tid/args); Chrome metadata events (``ph:"M"``) are dropped.
+    """
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        # One JSON document: the Chrome container, a bare list, or a
+        # single-line JSONL file (one event object).
+        data = json.loads(text)
+        if isinstance(data, dict):
+            events = data.get("traceEvents")
+            if events is None:
+                events = [data]
+        else:
+            events = data
+    except json.JSONDecodeError:
+        # Multiple documents: JSONL, one event per line.
+        events = [json.loads(line) for line in text.splitlines()
+                  if line.strip()]
+    return [ev for ev in events if ev.get("ph") != "M"]
+
+
+def summarize_spans(events: Iterable[Dict[str, Any]],
+                    by: str = "name", top: Optional[int] = None
+                    ) -> List[Dict[str, Any]]:
+    """Aggregate complete spans by ``name`` (or ``cat``): count, total
+    / mean / max duration in ms, sorted by total descending.  Instant
+    events aggregate with zero duration (their counts still matter —
+    fault drops and breaker trips are instants)."""
+    agg: Dict[str, List[float]] = defaultdict(lambda: [0, 0.0, 0.0])
+    for ev in events:
+        if ev.get("ph") not in ("X", "i"):
+            continue
+        key = ev.get(by) or "?"
+        dur_ms = float(ev.get("dur", 0.0)) / 1000.0
+        entry = agg[key]
+        entry[0] += 1
+        entry[1] += dur_ms
+        entry[2] = max(entry[2], dur_ms)
+    rows = [
+        {
+            by: key, "count": count, "total_ms": total,
+            "mean_ms": total / count if count else 0.0, "max_ms": mx,
+        }
+        for key, (count, total, mx) in agg.items()
+    ]
+    rows.sort(key=lambda r: (-r["total_ms"], -r["count"], r[by]))
+    return rows[:top] if top else rows
+
+
+def check_well_nested(events: Iterable[Dict[str, Any]]) -> None:
+    """Raise ``ValueError`` unless, per thread, complete spans form a
+    proper nesting (every pair either disjoint or contained).  Spans
+    are recorded via a per-thread stack, so a violation means a
+    corrupted trace file — ``make trace-demo`` gates on this."""
+    by_tid: Dict[Any, List[tuple]] = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        ts = float(ev["ts"])
+        by_tid[ev.get("tid")].append((ts, ts + float(ev["dur"]), ev))
+    eps = 1.0  # µs of timer slack between adjacent spans
+    for tid, spans in by_tid.items():
+        spans.sort(key=lambda s: (s[0], -s[1]))
+        stack: List[tuple] = []
+        for start, end, ev in spans:
+            while stack and start >= stack[-1][1] - eps:
+                stack.pop()
+            if stack and end > stack[-1][1] + eps:
+                raise ValueError(
+                    f"span {ev.get('name')!r} [{start:.0f}, {end:.0f}] "
+                    f"on tid {tid} overlaps enclosing span "
+                    f"{stack[-1][2].get('name')!r} "
+                    f"[{stack[-1][0]:.0f}, {stack[-1][1]:.0f}] "
+                    "without nesting"
+                )
+            stack.append((start, end, ev))

@@ -25,6 +25,7 @@ import logging
 import os
 import re
 import tempfile
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,8 +39,22 @@ _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
 def save_state(path: str, state: Any, *, cycle: int,
                extra: Optional[Dict[str, Any]] = None) -> str:
     """Atomically write a state pytree to ``path`` (.npz)."""
+    from pydcop_tpu.observability.trace import tracer
+
+    if tracer.enabled:
+        with tracer.span("checkpoint_write", "resilience",
+                         path=path, cycle=int(cycle)):
+            return _save_state(path, state, cycle=cycle, extra=extra)
+    return _save_state(path, state, cycle=cycle, extra=extra)
+
+
+def _save_state(path: str, state: Any, *, cycle: int,
+                extra: Optional[Dict[str, Any]] = None) -> str:
     import jax
 
+    from pydcop_tpu.observability.metrics import registry
+
+    t0 = time.perf_counter()
     leaves = jax.tree_util.tree_leaves(state)
     arrays = {
         f"leaf_{i}": np.asarray(jax.device_get(leaf))
@@ -66,6 +81,14 @@ def save_state(path: str, state: Any, *, cycle: int,
         except OSError:
             pass
         raise
+    registry.counter(
+        "pydcop_checkpoints_total", "Checkpoint snapshots written"
+    ).inc()
+    if registry.active:
+        registry.histogram(
+            "pydcop_checkpoint_write_seconds",
+            "Wall seconds per checkpoint write",
+        ).observe(time.perf_counter() - t0)
     return path
 
 

@@ -32,8 +32,22 @@ from pydcop_tpu.infrastructure.communication import (
     CommunicationLayer,
     MSG_VALUE,
 )
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.trace import tracer
 
 logger = logging.getLogger("pydcop.resilience.faults")
+
+
+def _note_fault(kind: str, src: str, dest: str, msg_type: str):
+    """One injected fault -> one trace instant + one counter bump, so a
+    chaos run is reconstructable from its trace file alone."""
+    metrics_registry.counter(
+        "pydcop_fault_injections_total",
+        "Faults injected by the chaos layer",
+    ).inc(kind=kind)
+    if tracer.enabled:
+        tracer.instant(f"fault_{kind}", "fault", src=src, dest=dest,
+                       type=msg_type)
 
 
 @dataclass(frozen=True)
@@ -210,6 +224,8 @@ class FaultyCommunicationLayer(CommunicationLayer):
             return
         if plan.is_partitioned(src_agent, dest_agent):
             self.stats.bump("partitioned")
+            _note_fault("partition", src_agent, dest_agent,
+                        msg.msg.type)
             logger.debug(
                 "PARTITION %s -> %s: %s dropped",
                 src_agent, dest_agent, msg.msg.type,
@@ -219,6 +235,7 @@ class FaultyCommunicationLayer(CommunicationLayer):
                         self._next_index(src_agent, dest_agent))
         if rng.random() < plan.drop:
             self.stats.bump("dropped")
+            _note_fault("drop", src_agent, dest_agent, msg.msg.type)
             logger.debug(
                 "DROP %s -> %s: %s", src_agent, dest_agent, msg.msg.type
             )
@@ -227,8 +244,11 @@ class FaultyCommunicationLayer(CommunicationLayer):
         if plan.duplicate and rng.random() < plan.duplicate:
             copies = 2
             self.stats.bump("duplicated")
+            _note_fault("duplicate", src_agent, dest_agent,
+                        msg.msg.type)
         if plan.delay and rng.random() < plan.delay:
             self.stats.bump("delayed")
+            _note_fault("delay", src_agent, dest_agent, msg.msg.type)
             timer = threading.Timer(
                 plan.delay_time,
                 self._deliver, (src_agent, dest_agent, msg, copies,
@@ -292,6 +312,12 @@ def kill_agent(orchestrator, agent_name: str) -> None:
         agent.stop()
         logger.warning("CRASH injected: agent %s thread stopped",
                        agent_name)
+    metrics_registry.counter(
+        "pydcop_fault_injections_total",
+        "Faults injected by the chaos layer",
+    ).inc(kind="kill")
+    if tracer.enabled:
+        tracer.instant("fault_kill", "fault", agent=agent_name)
     orchestrator.report_agent_failure(agent_name)
 
 

@@ -46,9 +46,11 @@ class CircuitBreaker:
     """
 
     def __init__(self, failure_threshold: int = 5,
-                 reset_timeout: float = 2.0):
+                 reset_timeout: float = 2.0,
+                 name: Optional[str] = None):
         self.failure_threshold = failure_threshold
         self.reset_timeout = reset_timeout
+        self.name = name  # destination label for trace/metrics events
         self._failures = 0
         self._opened_at: Optional[float] = None
         self._probing = False
@@ -79,21 +81,45 @@ class CircuitBreaker:
 
     def record_success(self):
         with self._lock:
+            reclosed = self._opened_at is not None
             self._failures = 0
             self._opened_at = None
             self._probing = False
+        if reclosed:
+            from pydcop_tpu.observability.trace import tracer
+
+            if tracer.enabled:
+                tracer.instant("breaker_close", "resilience",
+                               dest=self.name or "?")
 
     def record_failure(self):
         with self._lock:
             self._failures += 1
             self._probing = False
+            tripped = False
             if self._failures >= self.failure_threshold:
                 if self._opened_at is None:
+                    tripped = True
                     logger.debug(
                         "Circuit opened after %d failures", self._failures
                     )
                 # A failed half-open probe re-arms the full timeout.
                 self._opened_at = time.monotonic()
+        if tripped:
+            # A trip is a rare, operationally-significant event: it is
+            # counted unconditionally (breaker state belongs in every
+            # metrics dump) and traced when a trace is being taken.
+            from pydcop_tpu.observability.metrics import registry
+            from pydcop_tpu.observability.trace import tracer
+
+            registry.counter(
+                "pydcop_breaker_trips_total",
+                "Circuit breakers opened after repeated failures",
+            ).inc(dest=self.name or "?")
+            if tracer.enabled:
+                tracer.instant("breaker_trip", "resilience",
+                               dest=self.name or "?",
+                               failures=self._failures)
 
     def reset(self):
         self.record_success()
@@ -213,4 +239,11 @@ class RetryPolicy:
                     on_retry(attempt, last_error, delay)
                 except Exception:
                     logger.exception("on_retry callback failed")
+            from pydcop_tpu.observability.trace import tracer
+
+            if tracer.enabled:
+                tracer.instant(
+                    "retry", "resilience", attempt=attempt,
+                    delay=delay, error=str(last_error)[:200],
+                )
             sleep(delay)

@@ -18,6 +18,37 @@ from pydcop_tpu.engine.timing import (
 
 
 class TestSync:
+    def test_fetches_smallest_leaf_to_host(self, monkeypatch):
+        """sync must force a REAL host fetch (device_get), and of the
+        cheapest leaf: the scalar, not the big array — the fetch is
+        the barrier, its size is the overhead."""
+        import pydcop_tpu.engine.timing as timing_mod
+
+        fetched = []
+        real_device_get = jax.device_get
+
+        def spy(x):
+            fetched.append(getattr(x, "size", None))
+            return real_device_get(x)
+
+        monkeypatch.setattr(timing_mod.jax, "device_get", spy)
+        big = jnp.ones((64, 64))
+        small = jnp.int32(7)
+        out = sync((big, small))
+        assert out == (big, small)
+        assert fetched == [1], (
+            "sync must fetch exactly one leaf, the smallest")
+
+    def test_no_fetch_without_array_leaves(self, monkeypatch):
+        import pydcop_tpu.engine.timing as timing_mod
+
+        fetched = []
+        monkeypatch.setattr(
+            timing_mod.jax, "device_get",
+            lambda x: fetched.append(x))
+        assert sync((1, "x", None)) == (1, "x", None)
+        assert fetched == []
+
     def test_returns_pytree_unchanged(self):
         out = {"a": jnp.arange(4), "b": (jnp.float32(1.5),)}
         got = sync(out)
@@ -60,6 +91,19 @@ class TestMarginalSecondsPerCycle:
         got_per, got_fixed = marginal_seconds_per_cycle(
             run_cycles, 10, 40, reps=3)
         assert got_per == pytest.approx(per, rel=0.5)
+        assert got_fixed == pytest.approx(fixed, abs=0.02)
+
+    @pytest.mark.parametrize("fixed", [0.0, 0.004, 0.02])
+    def test_slope_invariant_to_injected_constant_offset(self, fixed):
+        """The whole point of the two-point differencing: a constant
+        per-call offset (tunnel round-trip, enqueue) of ANY size must
+        not move the recovered per-cycle rate."""
+        per = 0.001
+
+        got_per, got_fixed = marginal_seconds_per_cycle(
+            lambda n: time.sleep(fixed + per * n), 5, 45, reps=3)
+        assert got_per == pytest.approx(per, rel=0.5)
+        # And the offset itself lands in the fixed term, not the rate.
         assert got_fixed == pytest.approx(fixed, abs=0.02)
 
     def test_noise_floored_at_zero(self):
