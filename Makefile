@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test chaos trace-demo unit api cli check doctest bench dryrun onchip
+.PHONY: all test chaos trace-demo perf-smoke unit api cli check doctest bench dryrun onchip
 
 all: check test
 
@@ -32,7 +32,16 @@ chaos:
 trace-demo:
 	$(PY) tools/trace_demo.py
 
-test: trace-demo
+# Perf-smoke gate: the hot-path claims measured on CPU — vectorized
+# compile >= 3x over the per-factor loop on a 10k-factor expression
+# instance, a structure-cache hit skipping layout construction
+# (counter-asserted) and compiling faster, and the aggregation
+# autotuner picking a valid strategy + replaying from its JSON cache.
+# See tools/perf_smoke.py.
+perf-smoke:
+	$(PY) tools/perf_smoke.py
+
+test: trace-demo perf-smoke
 	$(PY) -m pytest tests/ -q
 
 unit:

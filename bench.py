@@ -409,11 +409,16 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
         fixed = 0.0
         cps = int(state.cycle) / elapsed
     info = {"sec_per_cycle": sec_per_cycle, "fixed_overhead_s": fixed}
+    # The flags COMPOSE (ADVICE r5: return_values used to shadow
+    # detail and silently drop the timing dict): values come before
+    # info, so every single-flag caller keeps its 3-tuple shape and
+    # both-flags callers get (cps, graph, values, info).
+    out = [cps, graph]
     if return_values:
-        return cps, graph, np.asarray(jax.device_get(values))
+        out.append(np.asarray(jax.device_get(values)))
     if detail:
-        return cps, graph, info
-    return cps, graph
+        out.append(info)
+    return tuple(out) if len(out) > 2 else (cps, graph)
 
 
 def run_bench():

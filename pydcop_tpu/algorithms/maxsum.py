@@ -73,9 +73,13 @@ algo_params = [
     # catastrophically at exactly the scale it targets, and TPUs have
     # no f64 to accumulate in — so it is not offered for solves.
     # Sharded runs always use scatter (shard_graph drops the sort
-    # arrays).
+    # arrays).  "auto" micro-times the strategies on the compiled
+    # graph and picks the measured winner (engine/autotune.py;
+    # decision + timings land in result metrics, and a JSON shape
+    # cache skips the measurement on re-solves).
     AlgoParameterDef(
-        "aggregation", "str", ["scatter", "sorted", "ell"], "scatter"
+        "aggregation", "str",
+        ["scatter", "sorted", "ell", "auto"], "scatter"
     ),
     # Message-array layout (device path).  "edge" keeps messages as
     # [F, arity, D] (domain minor); "lane" transposes to [D, arity, F]
@@ -104,21 +108,111 @@ def build_computation(comp_def):
     return build_algo_computation("maxsum", comp_def)
 
 
+def _replay_auto_choice(dcop: DCOP):
+    """Pre-compile lookup of a persisted autotune decision.
+
+    The shape key is computed from the DCOP directly (variable/domain
+    counts, per-arity factor counts, max scope degree — identical to
+    the compiled graph's key at pad_to=1, the only case 'auto'
+    measures).  On a hit the winner is returned as the aggregation to
+    COMPILE WITH, so the layout comes from engine/compile's structure
+    cache; on a miss the caller compiles scatter and measures.
+
+    Returns ``(aggregation, agg_info_or_None)``.
+    """
+    import jax
+
+    from pydcop_tpu.engine.autotune import cached_choice, shape_key
+
+    variables = list(dcop.variables.values())
+    counts: dict = {}
+    degree: dict = {}
+    for c in dcop.constraints.values():
+        if c.arity == 0:
+            continue
+        counts[c.arity] = counts.get(c.arity, 0) + 1
+        for v in c.dimensions:
+            degree[v.name] = degree.get(v.name, 0) + 1
+    key = shape_key(
+        jax.default_backend(),
+        len(variables),
+        max((len(v.domain) for v in variables), default=1),
+        sorted(counts.items()),
+        max(degree.values(), default=0),
+    )
+    choice = cached_choice(key)
+    if choice is None:
+        return "scatter", None
+    return choice, {
+        "aggregation": choice,
+        "aggregation_source": "cache",
+        "aggregation_key": key,
+    }
+
+
 def build_engine(dcop: DCOP, params: dict, mesh=None,
                  n_devices: Optional[int] = None) -> MaxSumEngine:
     """Compile + construct the engine from validated algo params — the
     single place the parameter->engine wiring lives (solve_on_device
-    and the CLI's device-mode trace reconstruction both use it)."""
+    and the CLI's device-mode trace reconstruction both use it).
+
+    ``aggregation='auto'`` compiles with scatter (the universally
+    valid baseline), measures the candidate strategies on the actual
+    compiled graph (engine/autotune.py — mesh and hub-guard
+    constraints respected there), swaps in the winner's agg arrays,
+    and annotates the engine so every result reports the decision."""
     pad_to = 1
     if mesh is not None:
         pad_to = mesh.size
     elif n_devices:
         pad_to = n_devices
+    aggregation = validated_aggregation(params, pad_to)
+    agg_info = None
+    if aggregation == "auto":
+        # Compile with scatter (the universally valid baseline) and
+        # tune on the compiled structure below — unless a persisted
+        # decision replays pre-compile (see _replay_auto_choice).
+        aggregation = "scatter"
+    elif params.get("aggregation") == "auto":
+        # validated_aggregation already resolved auto -> scatter for
+        # the mesh case; record why nothing was measured.
+        agg_info = {"aggregation": "scatter",
+                    "aggregation_source": "mesh"}
+    if params.get("aggregation") == "auto" and agg_info is None \
+            and params.get("layout", "edge") == "lane":
+        # The lane layout carries its own scatter aggregation;
+        # nothing to tune.
+        agg_info = {"aggregation": "scatter",
+                    "aggregation_source": "lane"}
+    if params.get("aggregation") == "auto" and agg_info is None:
+        # Replay a persisted decision BEFORE compiling: the winner
+        # then lands in compile_dcop's aggregation argument and its
+        # layout arrays come out of the structure cache — a warm
+        # auto-solve rebuilds nothing.
+        aggregation, agg_info = _replay_auto_choice(dcop)
     graph, meta = compile_dcop(
         dcop, noise_level=params.get("noise", 0.01), pad_to=pad_to,
-        aggregation=validated_aggregation(params, pad_to),
+        aggregation=aggregation,
     )
-    return MaxSumEngine(
+    if params.get("aggregation") == "auto" and agg_info is None:
+        from pydcop_tpu.engine.autotune import (
+            apply_aggregation,
+            autotune_aggregation,
+        )
+
+        agg_info = autotune_aggregation(graph, pad_to=pad_to)
+        if agg_info["aggregation"] != "scatter":
+            try:
+                graph = apply_aggregation(
+                    graph, agg_info["aggregation"])
+            except ValueError:
+                # Builder refusal (e.g. hub guard) on a strategy that
+                # nonetheless timed: never fail an 'auto' solve —
+                # scatter is always valid.
+                agg_info = dict(
+                    agg_info, aggregation="scatter",
+                    aggregation_source="fallback")
+    engine = MaxSumEngine(
         graph, meta,
         damping=params.get("damping", 0.5),
         damping_nodes=params.get("damping_nodes", "both"),
@@ -126,6 +220,9 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
         mesh=mesh, n_devices=n_devices,
         layout=params.get("layout", "edge"),
     )
+    if agg_info is not None:
+        engine.extra_metrics.update(agg_info)
+    return engine
 
 
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,

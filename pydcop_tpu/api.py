@@ -40,6 +40,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           delay: Optional[float] = None,
           checkpoint_dir: Optional[str] = None,
           checkpoint_every: Optional[int] = None,
+          checkpoint_async: bool = True,
           resume: bool = False,
           fault_plan=None,
           trace: Optional[str] = None,
@@ -57,8 +58,14 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     device-mode solve into ``checkpoint_every``-cycle segments with an
     NPZ state snapshot between segments; ``resume=True`` continues
     from the newest snapshot in that directory instead of cycle 0
-    (identical final result — the battery asserts it).  ``fault_plan``
-    (a resilience.faults.FaultPlan) runs the thread backend under
+    (identical final result — the battery asserts it).
+    ``checkpoint_async`` (default True) moves each snapshot's
+    device→host copy + file write onto a background writer thread so
+    it overlaps the next segment's device compute instead of
+    serializing with it (all snapshots are flushed before the solve
+    returns); ``checkpoint_async=False`` restores the synchronous
+    write between segments.  ``fault_plan`` (a
+    resilience.faults.FaultPlan) runs the thread backend under
     seeded message faults and crash injection.
 
     Observability knobs (docs/observability.md): ``trace`` records
@@ -146,7 +153,8 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                 collect_moment=collect_moment,
                 collect_period=collect_period, delay=delay,
                 checkpoint_dir=checkpoint_dir,
-                checkpoint_every=checkpoint_every, resume=resume,
+                checkpoint_every=checkpoint_every,
+                checkpoint_async=checkpoint_async, resume=resume,
                 fault_plan=fault_plan, observing=session is not None,
                 metrics_file=metrics_file, metrics_every=metrics_every,
             )
@@ -158,8 +166,8 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
 def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
            max_cycles, mesh, n_devices, warmup, ui_port, collector,
            collect_moment, collect_period, delay, checkpoint_dir,
-           checkpoint_every, resume, fault_plan, observing,
-           metrics_file, metrics_every) -> SolveResult:
+           checkpoint_every, checkpoint_async, resume, fault_plan,
+           observing, metrics_file, metrics_every) -> SolveResult:
     if backend == "device":
         if not hasattr(module, "solve_on_device"):
             raise NotImplementedError(
@@ -225,12 +233,14 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
                 segment_cycles = metrics_every or 100
             if resume:
                 res = resume_from_checkpoint(
-                    engine, manager, max_cycles=max_cycles, probe=probe
+                    engine, manager, max_cycles=max_cycles,
+                    probe=probe, checkpoint_async=checkpoint_async,
                 )
             else:
                 res = engine.run_checkpointed(
                     max_cycles=max_cycles, manager=manager,
                     segment_cycles=segment_cycles, probe=probe,
+                    checkpoint_async=checkpoint_async,
                 )
             if probe is not None:
                 from pydcop_tpu.observability.engine_probe import (

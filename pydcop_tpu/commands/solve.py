@@ -7,6 +7,7 @@ on TPU/CPU), ``--mode thread`` / ``--mode process`` (agent runtime,
 reference semantics).
 """
 
+import argparse
 import logging
 import time
 
@@ -78,6 +79,13 @@ def set_parser(subparsers):
                              "this directory between segments")
     parser.add_argument("--checkpoint_every", type=int, default=100,
                         help="cycles per checkpoint segment")
+    parser.add_argument("--checkpoint_async",
+                        action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="write snapshots on a background thread "
+                             "overlapping device compute (default; "
+                             "--no-checkpoint_async restores the "
+                             "synchronous write between segments)")
     parser.add_argument("--resume", action="store_true",
                         help="device mode: continue from the newest "
                              "checkpoint in --checkpoint_dir")
@@ -166,6 +174,7 @@ def run_cmd(args) -> int:
                 max_cycles=args.cycles, n_devices=args.n_devices,
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
+                checkpoint_async=args.checkpoint_async,
                 resume=args.resume,
                 trace=trace_file, trace_format=trace_format or "chrome",
                 metrics_file=args.metrics,

@@ -21,8 +21,12 @@ Materialization is capped (`MAX_MATERIALIZED_ELEMENTS`) because ``d^arity``
 explodes; algorithms that can work factored (SyncBB) never call it.
 """
 
+import ast
 import itertools
-from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+import re
+from typing import (
+    Any, Callable, Dict, Hashable, Iterable, List, Optional, Union,
+)
 
 import numpy as np
 
@@ -79,13 +83,8 @@ class Constraint(SimpleRepr):
 
     def to_array(self) -> np.ndarray:
         """Dense cost hypercube: one axis per dimension, C-order."""
+        self._check_materializable()
         shape = self.shape
-        n = int(np.prod(shape)) if shape else 1
-        if n > MAX_MATERIALIZED_ELEMENTS:
-            raise MemoryError(
-                f"Refusing to materialize constraint {self.name}: "
-                f"{n} elements (> {MAX_MATERIALIZED_ELEMENTS})"
-            )
         dims = self.dimensions
         out = np.empty(shape, dtype=DEFAULT_TYPE)
         for idx in np.ndindex(*shape) if shape else [()]:
@@ -94,6 +93,25 @@ class Constraint(SimpleRepr):
             }
             out[idx] = self(**assignment)
         return out
+
+    def _check_materializable(self) -> None:
+        shape = self.shape
+        n = int(np.prod(shape)) if shape else 1
+        if n > MAX_MATERIALIZED_ELEMENTS:
+            raise MemoryError(
+                f"Refusing to materialize constraint {self.name}: "
+                f"{n} elements (> {MAX_MATERIALIZED_ELEMENTS})"
+            )
+
+    def table_signature(self) -> Optional[Hashable]:
+        """A hashable key equal for constraints whose ``to_array()``
+        tables are provably identical, or None when no cheap proof
+        exists.  The engine compiler (engine/compile.py) memoizes
+        bucket-table evaluation on this key, so 10k structurally
+        identical expression factors (e.g. generated graph-coloring
+        edges, whose expressions differ only in variable *names*) cost
+        ONE table evaluation instead of 10k."""
+        return None
 
     def slice(self, partial: Dict[str, Any]) -> "Constraint":
         """Constraint over the remaining dims with `partial` frozen."""
@@ -250,6 +268,92 @@ class NAryFunctionRelation(Constraint):
                 name=f"{self.name}_sliced",
             )
         return super().slice(partial)
+
+    def to_array(self) -> np.ndarray:
+        """Dense cost hypercube, evaluated vectorized when possible.
+
+        Expression constraints are evaluated in ONE numpy call over an
+        open meshgrid of the domain product instead of ``d^arity``
+        python calls (the engine-compile hot path; see
+        engine/compile.compile_factor_graph).  The numpy-elementwise
+        rewrite (utils/expressionfunction._VectorizeTransform) is
+        spot-checked against scalar evaluation at a few grid points;
+        any failure or mismatch falls back to the reference
+        per-assignment loop, so the vectorized path can only be
+        faster, never different.
+        """
+        arr = self._vectorized_array()
+        if arr is not None:
+            return arr
+        return super().to_array()
+
+    def _vectorized_array(self) -> Optional[np.ndarray]:
+        f = self._f
+        if not isinstance(f, ExpressionFunction):
+            return None
+        if not f.supports_vectorized:
+            return None
+        self._check_materializable()
+        dims = self.dimensions
+        shape = self.shape
+        if not shape:
+            return None
+        needed = set(f.variable_names)
+        grids = {}
+        for axis, v in enumerate(dims):
+            if v.name not in needed:
+                continue
+            g_shape = [1] * len(dims)
+            g_shape[axis] = len(v.domain)
+            grids[v.name] = np.asarray(list(v.domain)).reshape(g_shape)
+        try:
+            out = f.vectorized(**grids)
+            out = np.array(
+                np.broadcast_to(np.asarray(out, dtype=DEFAULT_TYPE),
+                                shape),
+                dtype=DEFAULT_TYPE,
+            )
+        except Exception:
+            f.mark_not_vectorizable()
+            return None
+        # Spot-check a few deterministic grid points against the
+        # scalar path: the AST rewrite is semantics-preserving by
+        # construction, but an expression can still mean something
+        # different elementwise (e.g. a user callable smuggled into
+        # scope) — a mismatch demotes this expression to the scalar
+        # loop for the rest of the process.
+        n = out.size
+        for flat in {0, n - 1, n // 2, (n // 3) * 2}:
+            idx = np.unravel_index(flat, shape)
+            assignment = {
+                v.name: v.domain[i] for v, i in zip(dims, idx)
+            }
+            try:
+                ref = float(self(**assignment))
+            except Exception:
+                f.mark_not_vectorizable()
+                return None
+            if not np.isclose(out[idx], ref, rtol=1e-9, atol=1e-12,
+                              equal_nan=True):
+                f.mark_not_vectorizable()
+                return None
+        return out
+
+    def table_signature(self) -> Optional[Hashable]:
+        f = self._f
+        if not isinstance(f, ExpressionFunction) or f.source_file:
+            return None
+        sig = getattr(self, "_table_sig", False)
+        if sig is False:
+            sig = _normalized_expression_key(
+                f, [v.name for v in self._variables])
+            if sig is not None:
+                sig = (
+                    sig,
+                    tuple(tuple(v.domain) for v in self._variables),
+                )
+            self._table_sig = sig
+        return sig
 
     def _simple_repr(self):
         return {
@@ -451,6 +555,69 @@ class ConditionalRelation(Constraint):
             }
             return self._relation(**rel_args)
         return self._default
+
+
+# Standalone identifiers (not attribute accesses): the shared scan
+# behind the _normalized_expression_key fast path.
+_IDENT_RE = re.compile(r"(?<![\w.])[A-Za-z_]\w*")
+
+
+class _RenameVars(ast.NodeTransformer):
+    def __init__(self, mapping: Dict[str, str]):
+        self._mapping = mapping
+
+    def visit_Name(self, node: ast.Name) -> ast.AST:
+        new = self._mapping.get(node.id)
+        if new is not None:
+            return ast.Name(id=new, ctx=node.ctx)
+        return node
+
+
+def _normalized_expression_key(f: ExpressionFunction,
+                               scope_names: List[str],
+                               ) -> Optional[Hashable]:
+    """Expression text with scope variable names replaced by their
+    POSITION in the constraint's dimensions — e.g. both
+    ``10 if v12 == v37 else 0`` and ``10 if v3 == v8 else 0``
+    normalize to ``10 if __v0__ == __v1__ else 0``, proving the two
+    cost tables are identical whenever the (positional) domains also
+    match.  None when the expression is not a pure function of its
+    scope (random/source/function bodies) or the fixed vars are not
+    hashable."""
+    expr = f.expression
+    if "random" in expr or "source" in expr:
+        # Conservative substring test (also rejects e.g. a variable
+        # named "randomize"): a missed memo costs one extra eval, a
+        # wrong hit would corrupt a cost table.
+        return None
+    try:
+        fixed = tuple(sorted(f.fixed_vars.items()))
+        hash(fixed)
+    except TypeError:
+        return None
+    mapping = {n: f"__v{i}__" for i, n in enumerate(scope_names)}
+    if '"' not in expr and "'" not in expr:
+        # Fast path (a few µs/constraint — this runs once per factor
+        # on the compile hot path): one precompiled identifier scan,
+        # renaming scope names and leaving everything else (including
+        # attribute positions like ``x.v1``, excluded by the
+        # lookbehind).  Exact because without string literals every
+        # standalone occurrence of an identifier is a Name node.
+        normalized = _IDENT_RE.sub(
+            lambda m: mapping.get(m.group(0), m.group(0)), expr)
+        return (normalized, fixed)
+    # String literals present: only the AST rename can distinguish a
+    # quoted occurrence of a variable name from a real Name node.
+    try:
+        tree = ast.parse(expr.strip(), mode="eval")
+    except SyntaxError:
+        return None  # function-body form: not normalizable cheaply
+    tree = _RenameVars(mapping).visit(tree)
+    try:
+        normalized = ast.unparse(tree)
+    except AttributeError:
+        return None
+    return (normalized, fixed)
 
 
 def constraint_from_str(name: str, expression: str,

@@ -1,0 +1,306 @@
+"""Aggregation autotuner: measure, don't guess.
+
+The variable-side aggregation is the op that dominates the superstep
+past the ~100k-var scale cliff (BENCH_TPU.md), and the best strategy
+is backend- and shape-dependent: scatter wins everywhere on CPU,
+while on TPU the scatter-add serializes row updates and the dense
+ell gather is the candidate (docs/performance.md, round-5 on-chip
+A/B).  A manual ``aggregation=`` flag nobody tunes leaves that
+performance on the table; ``aggregation='auto'`` replaces it with a
+per-graph measurement: micro-time the candidate strategies on the
+*actual* compiled graph (same bucket shapes, same edge distribution,
+random message payloads), pick the winner, and record the decision
+in ``DeviceRunResult.metrics``.
+
+Constraints the measurement respects (never violated, never silently
+worked around):
+
+- **mesh**: sharded graphs always use scatter (shard_graph drops the
+  agg arrays) — callers resolve that before ever reaching here
+  (engine/compile.validated_aggregation), and :func:`autotune_aggregation`
+  re-checks ``pad_to``;
+- **hub guard**: the ell builder refuses degree-skewed graphs whose
+  padded lists would explode ([V+1, K] with K = max degree); the
+  autotuner catches that refusal and drops ell from the candidate
+  set instead of OOMing;
+- **numerics**: "boundary" is timed for the record but NEVER
+  selected — its f32 prefix sum cancels catastrophically at exactly
+  the scale it targets (measured, docs/performance.md), which is why
+  the maxsum param validation does not offer it either.
+
+Decisions persist in a JSON cache keyed by (backend, graph shape):
+re-serving a same-shaped problem skips the micro-benchmark entirely.
+Default location ``~/.cache/pydcop_tpu/agg_autotune.json``
+(``PYDCOP_AGG_AUTOTUNE_CACHE`` overrides; an unwritable path degrades
+to measuring every time, never to failing the solve).
+"""
+
+import json
+import logging
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from pydcop_tpu.engine.compile import (
+    AGGREGATIONS,
+    CompiledFactorGraph,
+    build_aggregation_arrays,
+)
+
+logger = logging.getLogger("pydcop.engine.autotune")
+
+# Strategies a solve may actually run with.  "boundary" is excluded
+# on numerics (see module docstring), matching the algo-param policy.
+SELECTABLE = ("scatter", "sorted", "ell")
+
+_CACHE_VERSION = 1
+
+
+def cache_path() -> str:
+    env = os.environ.get("PYDCOP_AGG_AUTOTUNE_CACHE")
+    if env:
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "pydcop_tpu",
+        "agg_autotune.json",
+    )
+
+
+def shape_key(backend: str, n_vars: int, dmax: int,
+              bucket_shapes, max_degree: int) -> str:
+    """Stable string key for "same-shaped problem": backend + var/
+    domain counts + per-bucket (arity, rows) + the max variable
+    degree.  Cost values are deliberately absent — the aggregation op
+    never reads them.  The degree term matters: the ell hub guard
+    trips on max degree, so two graphs with identical bucket shapes
+    but different degree skew must NOT share a cached 'ell' decision
+    (a replay onto the hub-skewed twin would refuse to build).
+    ``bucket_shapes`` is an iterable of (arity, rows), arity-sorted.
+    """
+    buckets = ";".join(f"{a}x{r}" for a, r in bucket_shapes)
+    return (
+        f"v{_CACHE_VERSION}|{backend}|V{n_vars}|D{dmax}"
+        f"|{buckets}|K{max_degree}"
+    )
+
+
+def graph_max_degree(graph: CompiledFactorGraph) -> int:
+    """Max real-variable degree over the flattened edge slots (the
+    quantity the ell hub guard trips on; sentinel edges excluded)."""
+    counts = np.zeros(graph.n_vars + 1, dtype=np.int64)
+    for b in graph.buckets:
+        counts += np.bincount(
+            b.var_ids.reshape(-1), minlength=graph.n_vars + 1)
+    return int(counts[:-1].max()) if graph.n_vars else 0
+
+
+def graph_shape_key(graph: CompiledFactorGraph,
+                    backend: Optional[str] = None) -> str:
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return shape_key(
+        backend, graph.n_vars, graph.dmax,
+        [(b.var_ids.shape[1], b.var_ids.shape[0])
+         for b in graph.buckets],
+        graph_max_degree(graph),
+    )
+
+
+def cached_choice(key: str,
+                  cache_file: Optional[str] = None) -> Optional[str]:
+    """Replay a persisted decision for ``key`` (None on miss/invalid)
+    — lets callers resolve the strategy BEFORE compiling, so the
+    winner's layout arrays come out of the compile-time structure
+    cache instead of being rebuilt per solve."""
+    cached = _load_cache(cache_file or cache_path()).get(key)
+    if isinstance(cached, dict) \
+            and cached.get("aggregation") in SELECTABLE:
+        return cached["aggregation"]
+    return None
+
+
+def _load_cache(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if isinstance(data, dict):
+            return data
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def _store_cache(path: str, data: Dict[str, Any]) -> None:
+    """Atomic merge-and-write; failure logs and moves on (the cache
+    is an optimization, not a dependency)."""
+    try:
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        merged = _load_cache(path)
+        merged.update(data)
+        fd, tmp = tempfile.mkstemp(
+            dir=directory, prefix=".autotune_", suffix=".json")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(merged, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError as e:
+        logger.warning("autotune cache not persisted to %s: %s",
+                       path, e)
+
+
+def apply_aggregation(graph: CompiledFactorGraph,
+                      aggregation: str) -> CompiledFactorGraph:
+    """Rebuild a compiled graph's agg_* arrays for ``aggregation``
+    (structure-only: costs and var_ids are shared, not copied)."""
+    perm, sorted_seg, starts, ends, ell = build_aggregation_arrays(
+        graph.buckets, graph.n_vars + 1, aggregation
+    )
+    return graph._replace(
+        agg_perm=perm, agg_sorted_seg=sorted_seg,
+        agg_starts=starts, agg_ends=ends, agg_ell=ell,
+    )
+
+
+def _time_strategy(graph: CompiledFactorGraph, f2v, reps: int,
+                   ) -> float:
+    """Median seconds for one aggregation pass, warmed (compile
+    excluded), honest completion via engine.timing.sync."""
+    import jax
+
+    from pydcop_tpu.engine.timing import sync, timed_call
+    from pydcop_tpu.ops.maxsum import aggregate_beliefs
+
+    fn = jax.jit(lambda g, m: aggregate_beliefs(g, m)[1])
+    placed = jax.device_put(graph)
+    sync(fn(placed, f2v))  # compile + warm
+    times = [timed_call(fn, placed, f2v)[1] for _ in range(reps)]
+    return float(np.median(times))
+
+
+def autotune_aggregation(graph: CompiledFactorGraph, *,
+                         pad_to: int = 1,
+                         reps: int = 3,
+                         use_cache: bool = True,
+                         cache_file: Optional[str] = None,
+                         ) -> Dict[str, Any]:
+    """Pick the aggregation strategy for ``graph`` by measurement.
+
+    Returns ``{"aggregation", "aggregation_source",
+    "aggregation_timings_ms", "aggregation_key"}`` — the dict engines
+    merge into ``DeviceRunResult.metrics``.  ``aggregation_source``
+    is one of:
+
+    - ``"mesh"``: sharded run, scatter is the only valid strategy
+      (nothing measured);
+    - ``"empty"``: no factor edges, nothing to aggregate;
+    - ``"cache"``: decision replayed from the JSON shape cache;
+    - ``"measured"``: micro-benchmarked on this process's backend.
+
+    Timings are reported for all four named strategies where
+    measurable (``None`` where not: hub-guard refusals, mesh runs);
+    selection only ever happens among :data:`SELECTABLE`.
+    """
+    import jax
+
+    backend = jax.default_backend()
+    key = graph_shape_key(graph, backend)
+    timings: Dict[str, Optional[float]] = {
+        s: None for s in AGGREGATIONS}
+    if pad_to > 1:
+        return {
+            "aggregation": "scatter",
+            "aggregation_source": "mesh",
+            "aggregation_timings_ms": timings,
+            "aggregation_key": key,
+        }
+    n_edges = sum(
+        int(np.prod(b.var_ids.shape)) for b in graph.buckets)
+    if n_edges == 0:
+        return {
+            "aggregation": "scatter",
+            "aggregation_source": "empty",
+            "aggregation_timings_ms": timings,
+            "aggregation_key": key,
+        }
+
+    path = cache_file or cache_path()
+    if use_cache:
+        cached = _load_cache(path).get(key)
+        if (isinstance(cached, dict)
+                and cached.get("aggregation") in SELECTABLE):
+            return {
+                "aggregation": cached["aggregation"],
+                "aggregation_source": "cache",
+                "aggregation_timings_ms": cached.get(
+                    "aggregation_timings_ms", timings),
+                "aggregation_key": key,
+            }
+
+    # Random message payloads: the aggregation's cost is layout- and
+    # index-driven, value-independent — any dense payload measures it.
+    # Placed on device ONCE: host-resident payloads would add the
+    # same multi-MB host→device transfer to every rep of every
+    # strategy, drowning the kernel-time differences being measured.
+    rng = np.random.default_rng(0)
+    d = graph.dmax
+    f2v = jax.device_put(tuple(
+        rng.standard_normal(
+            b.var_ids.shape + (d,)).astype(np.float32)
+        for b in graph.buckets
+    ))
+    notes: Dict[str, str] = {}
+    for strategy in AGGREGATIONS:
+        try:
+            variant = apply_aggregation(graph, strategy)
+        except ValueError as e:
+            # The hub guard refusing ell (or any builder refusal):
+            # record why, drop the candidate.
+            notes[strategy] = str(e).split(":")[0]
+            continue
+        try:
+            timings[strategy] = _time_strategy(variant, f2v, reps)
+        except Exception as e:  # pragma: no cover - backend-specific
+            notes[strategy] = f"{type(e).__name__}"
+            logger.warning("autotune: %s failed to run: %s",
+                           strategy, e)
+
+    candidates = {
+        s: t for s, t in timings.items()
+        if s in SELECTABLE and t is not None
+    }
+    # Deterministic tie-break: strategy order in SELECTABLE (scatter
+    # first — the parity default) wins exact ties.
+    choice = min(
+        candidates,
+        key=lambda s: (candidates[s], SELECTABLE.index(s)),
+    ) if candidates else "scatter"
+    timings_ms = {
+        s: (None if t is None else round(t * 1e3, 4))
+        for s, t in timings.items()
+    }
+    result = {
+        "aggregation": choice,
+        "aggregation_source": "measured",
+        "aggregation_timings_ms": timings_ms,
+        "aggregation_key": key,
+    }
+    if notes:
+        result["aggregation_notes"] = notes
+    if use_cache:
+        _store_cache(path, {key: {
+            "aggregation": choice,
+            "aggregation_timings_ms": timings_ms,
+            "backend": backend,
+        }})
+    return result

@@ -89,6 +89,12 @@ def solve_maxsum_batch(
     """
     if not dcops:
         return []
+    # Same-structured instances (same graph, different cost tables —
+    # the repeated-traffic serving pattern) are exactly what the
+    # structure-keyed compile cache serves: instance 1 builds the
+    # layout/agg arrays, instances 2..N reuse them
+    # (engine/compile.CompileCache), matching the device side where
+    # vmap already made N solves cost barely more than one.
     compiled: List[Tuple[CompiledFactorGraph, FactorGraphMeta]] = [
         compile_dcop(d, noise_level=noise_level) for d in dcops
     ]

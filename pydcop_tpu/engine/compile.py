@@ -52,15 +52,82 @@ Example (compile a 2-variable problem and inspect the device layout)::
     ('x', 'y')
 """
 
+import os
+from collections import OrderedDict
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.objects import Variable, _stable_noise
-from pydcop_tpu.dcop.relations import Constraint
+from pydcop_tpu.dcop.relations import Constraint, NAryFunctionRelation
 
 BIG = np.float32(1e9)
+
+
+class CompileCache:
+    """Process-wide structure-keyed layout cache.
+
+    Re-solving a same-*shaped* problem (new cost tables, same
+    variables/scopes — the repeated-traffic serving pattern the
+    ROADMAP targets) should not pay layout construction again: the
+    padded ``var_ids`` arrays and the aggregation indexing
+    (``agg_perm``/``agg_sorted_seg``/``agg_starts``/``agg_ends``/
+    ``agg_ell`` — an argsort + searchsorted + list fill over all E
+    edges) are pure functions of the graph *structure* (variable
+    count, per-factor scope indices, pad_to, aggregation), never of
+    the costs.  ``compile_factor_graph`` keys them here; a hit skips
+    layout and agg-array construction entirely (``layout_builds``
+    counts the builds, so tests can assert the skip).  Cached arrays
+    are frozen (``writeable=False``) — every consumer treats compiled
+    graphs as immutable (the engines ``device_put`` them; decimation
+    copies before clamping).
+
+    Bounded LRU; ``PYDCOP_COMPILE_CACHE=0`` disables globally.
+    """
+
+    def __init__(self, maxsize: int = 8):
+        self.maxsize = maxsize
+        self._entries: "OrderedDict" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.layout_builds = 0
+
+    def get(self, key):
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry
+        self.misses += 1
+        return None
+
+    def put(self, key, entry):
+        self._entries[key] = entry
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.maxsize:
+            self._entries.popitem(last=False)
+
+    def clear(self):
+        self._entries.clear()
+        self.hits = self.misses = self.layout_builds = 0
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "layout_builds": self.layout_builds,
+            "entries": len(self._entries),
+        }
+
+
+compile_cache = CompileCache()
+
+
+def _freeze(arr: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    if arr is not None:
+        arr.flags.writeable = False
+    return arr
 
 
 class FactorBucket(NamedTuple):
@@ -154,14 +221,27 @@ def _round_up(n: int, multiple: int) -> int:
 
 
 AGGREGATIONS = ("scatter", "sorted", "boundary", "ell")
+AUTO_AGGREGATION = "auto"
+
+# Placeholder costs array for layout-only FactorBucket shims — the
+# aggregation builder reads only var_ids.
+_EMPTY_COSTS = np.zeros((0,), np.float32)
 
 
 def validated_aggregation(params: dict, pad_to: int) -> str:
     """Resolve an algorithm's ``aggregation`` param against the mesh
     size.  shard_graph rebuilds graphs WITHOUT the agg_* arrays, so a
     non-scatter strategy on a mesh would silently measure scatter —
-    refuse loudly instead (one policy for every algorithm family)."""
+    refuse loudly instead (one policy for every algorithm family).
+
+    ``"auto"`` resolves to ``"scatter"`` on a mesh (the only valid
+    sharded strategy — not an error, auto means "pick a valid one for
+    me") and passes through otherwise; the caller is expected to run
+    the measured selection (engine/autotune.autotune_aggregation) on
+    the compiled graph."""
     aggregation = params.get("aggregation", "scatter")
+    if aggregation == AUTO_AGGREGATION:
+        return "scatter" if pad_to > 1 else AUTO_AGGREGATION
     if pad_to > 1 and aggregation != "scatter":
         raise ValueError(
             f"aggregation={aggregation!r} is single-device; sharded "
@@ -230,6 +310,34 @@ def build_aggregation_arrays(buckets: Sequence[FactorBucket],
     return None, None, None, None, ell
 
 
+def _factor_table(c: Constraint, sign: float, dtype,
+                  memo: Dict, vectorize: bool) -> np.ndarray:
+    """Sign-adjusted dense table for one factor, memoized on the
+    structural table signature: factors whose expressions differ only
+    in variable names (every generated-edge family) evaluate ONCE per
+    bucket instead of once per factor, and each evaluation is the
+    vectorized numpy path (relations.NAryFunctionRelation.to_array)
+    instead of a d^arity python loop.  ``vectorize=False`` restores
+    the per-factor per-assignment reference path — the A/B baseline
+    ``make perf-smoke`` measures against."""
+    if not vectorize:
+        if isinstance(c, NAryFunctionRelation):
+            # The pre-vectorization behavior: the base per-assignment
+            # enumeration loop.
+            return sign * np.asarray(
+                Constraint.to_array(c), dtype=dtype)
+        return sign * np.asarray(c.to_array(), dtype=dtype)
+    sig = c.table_signature()
+    if sig is not None:
+        table = memo.get(sig)
+        if table is not None:
+            return table
+    table = sign * np.asarray(c.to_array(), dtype=dtype)
+    if sig is not None:
+        memo[sig] = table
+    return table
+
+
 def compile_factor_graph(
     variables: Sequence[Variable],
     constraints: Sequence[Constraint],
@@ -239,10 +347,20 @@ def compile_factor_graph(
     pad_to: int = 1,
     dtype=np.float32,
     aggregation: str = "scatter",
+    vectorize: bool = True,
+    use_cache: Optional[bool] = None,
 ) -> Tuple[CompiledFactorGraph, FactorGraphMeta]:
     """Build the dense arrays.  `noise_level` adds deterministic
     per-variable-value noise (maxsum's tie-breaking noise, reference
-    maxsum.py:477-487, seeded here for reproducibility)."""
+    maxsum.py:477-487, seeded here for reproducibility).
+
+    ``vectorize`` enables the batched numpy cost-table evaluation
+    plus the per-bucket table memo (see :func:`_factor_table`);
+    ``use_cache`` controls the structure-keyed layout cache
+    (:class:`CompileCache`; default on, ``PYDCOP_COMPILE_CACHE=0``
+    disables process-wide)."""
+    if use_cache is None:
+        use_cache = os.environ.get("PYDCOP_COMPILE_CACHE") != "0"
     variables = list(variables)
     constraints = list(constraints)
     var_index = {v.name: i for i, v in enumerate(variables)}
@@ -280,30 +398,64 @@ def compile_factor_graph(
             continue
         by_arity.setdefault(c.arity, []).append(c)
 
+    # Per-factor scope indices, one [n_facs, arity] array per arity.
+    # Needed both for the bucket layout and as the structure-cache
+    # key: the layout (padded var_ids + agg_* arrays) is a pure
+    # function of these indices + (v_count, pad_to, aggregation).
+    arities = sorted(by_arity)
+    scope_ids: Dict[int, np.ndarray] = {}
+    for arity in arities:
+        facs = by_arity[arity]
+        scope_ids[arity] = np.array(
+            [[var_index[v.name] for v in c.dimensions] for c in facs],
+            dtype=np.int32,
+        ).reshape(len(facs), arity)
+
+    layout = None
+    cache_key = None
+    if use_cache:
+        cache_key = (
+            v_count, pad_to, aggregation,
+            tuple((a, scope_ids[a].tobytes()) for a in arities),
+        )
+        layout = compile_cache.get(cache_key)
+    if layout is None:
+        compile_cache.layout_builds += 1
+        var_ids_by_arity = {}
+        for arity in arities:
+            n_facs = scope_ids[arity].shape[0]
+            n_rows = _round_up(n_facs, pad_to)
+            ids = np.full((n_rows, arity), v_count, dtype=np.int32)
+            ids[:n_facs] = scope_ids[arity]
+            var_ids_by_arity[arity] = _freeze(ids)
+        agg = build_aggregation_arrays(
+            [FactorBucket(_EMPTY_COSTS, ids)
+             for ids in var_ids_by_arity.values()],
+            v_count + 1, aggregation,
+        )
+        layout = (var_ids_by_arity, tuple(_freeze(a) for a in agg))
+        if use_cache:
+            compile_cache.put(cache_key, layout)
+    var_ids_by_arity, (perm, sorted_seg, starts, ends, ell) = layout
+
     buckets = []
     factor_names: List[str] = []
     bucket_sizes: List[int] = []
-    for arity in sorted(by_arity):
+    for arity in arities:
         facs = by_arity[arity]
-        n_rows = _round_up(len(facs), pad_to)
+        n_rows = var_ids_by_arity[arity].shape[0]
         shape = (n_rows,) + (dmax,) * arity
         costs = np.full(shape, BIG, dtype=dtype)
-        var_ids = np.full((n_rows, arity), v_count, dtype=np.int32)
+        memo: Dict = {}
         for fi, c in enumerate(facs):
             factor_names.append(c.name)
-            table = sign * np.asarray(c.to_array(), dtype=dtype)
+            table = _factor_table(c, sign, dtype, memo, vectorize)
             idx = tuple(slice(0, s) for s in table.shape)
             costs[(fi,) + idx] = table
-            for p, v in enumerate(c.dimensions):
-                var_ids[fi, p] = var_index[v.name]
         # Padding rows keep cost 0 and the sentinel variable.
         costs[len(facs):] = 0.0
-        buckets.append(FactorBucket(costs, var_ids))
+        buckets.append(FactorBucket(costs, var_ids_by_arity[arity]))
         bucket_sizes.append(len(facs))
-
-    perm, sorted_seg, starts, ends, ell = build_aggregation_arrays(
-        buckets, v_count + 1, aggregation
-    )
     compiled = CompiledFactorGraph(
         var_costs=var_costs,
         var_valid=var_valid,
@@ -329,6 +481,8 @@ def compile_factor_graph(
 def compile_dcop(dcop: DCOP, noise_level: float = 0.0,
                  noise_seed: Optional[int] = None, pad_to: int = 1,
                  aggregation: str = "scatter",
+                 vectorize: bool = True,
+                 use_cache: Optional[bool] = None,
                  ) -> Tuple[CompiledFactorGraph, FactorGraphMeta]:
     return compile_factor_graph(
         list(dcop.variables.values()),
@@ -338,4 +492,6 @@ def compile_dcop(dcop: DCOP, noise_level: float = 0.0,
         noise_seed=noise_seed,
         pad_to=pad_to,
         aggregation=aggregation,
+        vectorize=vectorize,
+        use_cache=use_cache,
     )

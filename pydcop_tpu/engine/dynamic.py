@@ -64,8 +64,9 @@ class DynamicMaxSumEngine:
                  noise_seed: Optional[int] = None,
                  slack: float = 0.25,
                  damping: float = 0.5, damping_nodes: str = "both",
-                 stability: float = 0.1):
+                 stability: float = 0.1, donate: bool = True):
         self.mode = mode
+        self.donate = donate
         self.sign = 1.0 if mode == "min" else -1.0
         self.noise_level = noise_level
         self.noise_seed = noise_seed
@@ -323,7 +324,15 @@ class DynamicMaxSumEngine:
 
     def run(self, max_cycles: int = 1000,
             stop_on_convergence: bool = True) -> DeviceRunResult:
-        """Continue the trajectory for up to max_cycles more cycles."""
+        """Continue the trajectory for up to max_cycles more cycles.
+
+        The state argument is donated (``self.donate``, default True):
+        across repeated run/edit rounds the superstep program reuses
+        the previous round's state buffers in place instead of
+        allocating fresh ones.  Host-side array surgery is unaffected
+        — the edits rebuild numpy copies, and a donated (device)
+        input is only consumed at the next dispatch, after
+        ``self._state`` already points at the returned state."""
         key = (max_cycles, stop_on_convergence,
                tuple(b.costs.shape for b in self.graph.buckets),
                self.graph.var_costs.shape)
@@ -338,7 +347,7 @@ class DynamicMaxSumEngine:
                 damp_factors=self.damp_factors,
                 stability=self.stability,
                 stop_on_convergence=stop_on_convergence,
-            ))
+            ), donate_argnums=(1,) if self.donate else ())
         if self._state is None:
             self._state = ops.init_state(self.graph)
         fn = self._jitted[key]

@@ -7,6 +7,7 @@ value selection — is one XLA program; the host only launches it and reads
 back the result.
 """
 
+import sys
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -170,7 +171,7 @@ class MaxSumEngine:
                  damping: float = 0.5, damping_nodes: str = "both",
                  stability: float = 0.1,
                  mesh=None, n_devices: Optional[int] = None,
-                 layout: str = "edge"):
+                 layout: str = "edge", donate: bool = True):
         if layout not in ("edge", "lane"):
             raise ValueError(
                 f"layout must be 'edge' or 'lane', got {layout!r}")
@@ -199,6 +200,18 @@ class MaxSumEngine:
         self.damp_vars = damping_nodes in ("vars", "both")
         self.damp_factors = damping_nodes in ("factors", "both")
         self.stability = stability
+        # Donate the state argument of the segment program: XLA then
+        # writes each segment's output state into the input buffers
+        # instead of allocating fresh ones — zero steady-state
+        # allocations across a checkpointed/dynamic run.  Donation
+        # only changes WHERE outputs land, never their values (the
+        # tier-1 battery pins the bit-identical trajectory);
+        # ``donate=False`` keeps input states alive for callers that
+        # re-run from one (the A/B tests do).
+        self.donate = donate
+        # Per-engine annotations (e.g. the aggregation autotuner's
+        # decision) merged into every DeviceRunResult.metrics.
+        self.extra_metrics: Dict[str, Any] = {}
         self._jitted: Dict[Any, Any] = {}
         self._warm: set = set()
 
@@ -217,7 +230,11 @@ class MaxSumEngine:
     def _segment_fn(self, extra_cycles: int, stop_on_convergence: bool):
         """Cached-jit ``run_maxsum_from`` for one K-cycle segment (the
         checkpointed loop re-enters the solve with device state, the
-        warm-start primitive dynamic DCOPs already use)."""
+        warm-start primitive dynamic DCOPs already use).  With
+        ``donate=True`` (default) the state argument is donated, so
+        every segment reuses the previous segment's buffers in place
+        — the donated input is dead after the call; the loop only
+        ever touches the returned state."""
         key = ("segment", extra_cycles, stop_on_convergence)
         if key not in self._jitted:
             self._jitted[key] = jax.jit(
@@ -229,7 +246,8 @@ class MaxSumEngine:
                     damp_factors=self.damp_factors,
                     stability=self.stability,
                     stop_on_convergence=stop_on_convergence,
-                )
+                ),
+                donate_argnums=(1,) if self.donate else (),
             )
         return self._jitted[key]
 
@@ -241,6 +259,7 @@ class MaxSumEngine:
                          initial_state=None,
                          max_segments: Optional[int] = None,
                          probe=None,
+                         checkpoint_async: bool = True,
                          ) -> "DeviceRunResult":
         """The solve loop chunked into K-cycle segments with a state
         snapshot between segments — the preemption-survival entry point
@@ -250,16 +269,30 @@ class MaxSumEngine:
         exact device state the previous one produced, the segmented
         trajectory is the same superstep sequence as :meth:`run`'s
         single XLA program: same assignment, cost and cycle count
-        (asserted in the tier-1 resilience battery).  The price is one
-        host sync + NPZ write per segment, so pick ``segment_cycles``
-        against preemption risk, not small.
+        (asserted in the tier-1 resilience battery).
+
+        Steady-state host cost per segment is one scalar fetch (the
+        data-dependent cycle counter): with ``checkpoint_async=True``
+        (default) the snapshot's device→host copy and atomic NPZ
+        write run on a background writer thread
+        (resilience.checkpoint.AsyncCheckpointWriter) and overlap the
+        NEXT segment's device compute, and with the engine's
+        ``donate=True`` each segment reuses the previous state's
+        buffers in place (the writer gets a device-side copy so
+        donation can never invalidate an in-flight snapshot).
+        ``checkpoint_async=False`` restores the synchronous
+        fetch-then-write between segments.
 
         ``manager`` (a resilience.checkpoint.CheckpointManager) or
         ``checkpoint_dir`` enables snapshots; with neither this is just
         a segmented run (still useful to bound time-to-interrupt).
-        ``initial_state`` resumes from a restored snapshot;
+        ``initial_state`` resumes from a restored snapshot (with
+        ``donate=True`` the passed state is consumed by the first
+        segment — reload it for any later reuse);
         ``max_segments`` stops early after that many segments — the
         test harness's deterministic stand-in for a preemption.
+        All snapshots are flushed to disk before this returns,
+        whichever mode wrote them.
 
         ``probe`` (an observability.engine_probe.EngineProbe) receives
         ``on_segment(state, values, run_s, compile_s)`` after every
@@ -267,7 +300,10 @@ class MaxSumEngine:
         waits, so the probe's cost/convergence points cost no extra
         syncs inside the jitted loop.
         """
-        from pydcop_tpu.resilience.checkpoint import CheckpointManager
+        from pydcop_tpu.resilience.checkpoint import (
+            AsyncCheckpointWriter,
+            CheckpointManager,
+        )
 
         if manager is None and checkpoint_dir is not None:
             manager = CheckpointManager(
@@ -280,47 +316,78 @@ class MaxSumEngine:
             initial_state if initial_state is not None
             else self.init_state()
         )
+        writer = None
+        if manager is not None and checkpoint_async:
+            writer = AsyncCheckpointWriter(manager)
         t0 = time.perf_counter()
         compile_s = 0.0
         segments = 0
         checkpoints = 0
         interrupted = False
         values = None
-        while True:
-            cycle = int(state.cycle)
-            if values is not None and (
-                cycle >= max_cycles
-                or (stop_on_convergence and bool(state.stable))
-            ):
-                break
-            # A resume at/past the cycle budget still needs the value
-            # selection: a zero-extra segment computes it without
-            # stepping.
-            extra = min(every, max(max_cycles - cycle, 0))
-            fn = self._segment_fn(extra, stop_on_convergence)
-            if tracer.enabled:
-                with tracer.span("engine_segment", "engine",
-                                 segment=segments, from_cycle=cycle,
-                                 extra_cycles=extra):
+        try:
+            while True:
+                cycle = int(state.cycle)
+                if values is not None and (
+                    cycle >= max_cycles
+                    or (stop_on_convergence and bool(state.stable))
+                ):
+                    break
+                # A resume at/past the cycle budget still needs the
+                # value selection: a zero-extra segment computes it
+                # without stepping.
+                extra = min(every, max(max_cycles - cycle, 0))
+                fn = self._segment_fn(extra, stop_on_convergence)
+                if tracer.enabled:
+                    with tracer.span("engine_segment", "engine",
+                                     segment=segments,
+                                     from_cycle=cycle,
+                                     extra_cycles=extra):
+                        (state, values), c_s, run_s = self._call(
+                            ("segment", extra, stop_on_convergence),
+                            fn, self.graph, state,
+                        )
+                else:
                     (state, values), c_s, run_s = self._call(
                         ("segment", extra, stop_on_convergence), fn,
                         self.graph, state,
                     )
-            else:
-                (state, values), c_s, run_s = self._call(
-                    ("segment", extra, stop_on_convergence), fn,
-                    self.graph, state,
-                )
-            compile_s += c_s
-            segments += 1
-            if probe is not None:
-                probe.on_segment(state, values, run_s, c_s)
-            if manager is not None:
-                manager.save(state, int(state.cycle))
-                checkpoints += 1
-            if max_segments is not None and segments >= max_segments:
-                interrupted = True
-                break
+                compile_s += c_s
+                segments += 1
+                if probe is not None:
+                    probe.on_segment(state, values, run_s, c_s)
+                if manager is not None:
+                    if writer is not None:
+                        snap = state
+                        if self.donate:
+                            # The next segment donates ``state``'s
+                            # buffers; the writer must fetch from a
+                            # copy that outlives the donation.  The
+                            # copy is a device-side program — it
+                            # overlaps, no host sync.
+                            snap = jax.tree_util.tree_map(
+                                jnp.copy, state)
+                        # snap.cycle, not state.cycle: the original
+                        # scalar is donated along with the rest of
+                        # the state on the next dispatch.
+                        writer.submit(snap, snap.cycle)
+                    else:
+                        manager.save(state, int(state.cycle))
+                    checkpoints += 1
+                if max_segments is not None \
+                        and segments >= max_segments:
+                    interrupted = True
+                    break
+        finally:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    # Don't mask an in-flight engine error with a
+                    # checkpoint-write error; with a clean loop exit
+                    # the write failure IS the error.
+                    if sys.exc_info()[0] is None:
+                        raise
         total = time.perf_counter() - t0
         values_host, cycle, stable = jax.device_get(
             (values, state.cycle, state.stable)
@@ -335,9 +402,11 @@ class MaxSumEngine:
             time_s=total,
             compile_time_s=compile_s,
             metrics={
+                **self.extra_metrics,
                 "segments": segments,
                 "segment_cycles": every,
                 "checkpoints_written": checkpoints,
+                "checkpoint_async": writer is not None,
                 "interrupted": interrupted,
                 "cycles_per_s": cycle / steady if steady > 0 else 0.0,
                 "cold_start": compile_s > 0,
@@ -396,6 +465,7 @@ class MaxSumEngine:
             time_s=run_s,
             compile_time_s=compile_s,
             metrics={
+                **self.extra_metrics,
                 "cost_trace": sign * np.asarray(costs)
                 + self.meta.constant_cost,
                 "cold_start": compile_s > 0,
@@ -518,6 +588,7 @@ class MaxSumEngine:
             time_s=total,
             compile_time_s=compile_s,
             metrics={
+                **self.extra_metrics,
                 "decimated_vars": int(fixed.sum()),
                 "cycles_per_s": cycle / steady if steady > 0 else 0.0,
                 "cold_start": compile_s > 0,
@@ -551,6 +622,7 @@ class MaxSumEngine:
             time_s=run_s,
             compile_time_s=compile_s,
             metrics={
+                **self.extra_metrics,
                 "msg_count": 2 * n_msgs * cycle,
                 "cycles_per_s": cycle / run_s if run_s > 0 else 0.0,
                 "cold_start": compile_s > 0,

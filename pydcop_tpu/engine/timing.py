@@ -32,6 +32,7 @@ a TPU-deployment reality, so the timing discipline lives here in the
 engine, not in bench scripts.
 """
 
+import os
 import time
 from typing import Any, Callable, Tuple
 
@@ -50,12 +51,32 @@ def sync(out: Any) -> Any:
     Cost: one round-trip plus the smallest leaf's transfer (pick your
     outputs so a scalar — cycle counter, convergence flag — is among
     them, which every ops.run_* in this package does).
+
+    PRECONDITION (API contract): every array leaf of ``out`` must be
+    an output of the SAME dispatched program (or of its dependency
+    chain).  Fetching one leaf proves only *that* program finished; a
+    pytree assembled from independent dispatches would leave the
+    other programs in flight and silently turn the caller's timing
+    back into an enqueue time — exactly the artifact this module
+    exists to prevent.  Every call site in this package passes a
+    single program's output pytree; keep it that way.
+
+    Debug assertion path: ``PYDCOP_SYNC_DEBUG=1`` fetches EVERY leaf
+    (one barrier per distinct buffer source, a true sync regardless
+    of the precondition).  Run a suspicious measurement under this
+    flag: if the number changes materially, a call site is violating
+    the single-program contract.
     """
     leaves = [x for x in jax.tree_util.tree_leaves(out)
               if hasattr(x, "dtype")]
-    if leaves:
-        smallest = min(leaves, key=lambda a: getattr(a, "size", 1))
-        np.asarray(jax.device_get(smallest))
+    if not leaves:
+        return out
+    if os.environ.get("PYDCOP_SYNC_DEBUG") == "1":
+        for leaf in leaves:
+            np.asarray(jax.device_get(leaf))
+        return out
+    smallest = min(leaves, key=lambda a: getattr(a, "size", 1))
+    np.asarray(jax.device_get(smallest))
     return out
 
 

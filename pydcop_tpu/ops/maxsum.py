@@ -63,22 +63,30 @@ class MaxSumState(NamedTuple):
 def init_state(graph: CompiledFactorGraph) -> MaxSumState:
     d = graph.var_costs.shape[1]
     dtype = graph.var_costs.dtype
-    zeros = tuple(
-        jnp.zeros(b.var_ids.shape + (d,), dtype=dtype)
-        for b in graph.buckets
-    )
-    # int8: counts saturate at SAME_COUNT + 1 = 5, and the two
+
+    # int8 counts: they saturate at SAME_COUNT + 1 = 5, and the two
     # counter arrays are read+written every cycle — int32 would
     # spend 4x the HBM traffic on values that never exceed 5.
-    counts = tuple(
-        jnp.zeros(b.var_ids.shape, dtype=jnp.int8)
-        for b in graph.buckets
-    )
+    # Each field gets its OWN arrays (no tuple reuse across v2f/f2v):
+    # the segment jits donate the state pytree (engine/runner.py), and
+    # donation rejects the same buffer appearing in two donated slots.
+    def zeros():
+        return tuple(
+            jnp.zeros(b.var_ids.shape + (d,), dtype=dtype)
+            for b in graph.buckets
+        )
+
+    def counts():
+        return tuple(
+            jnp.zeros(b.var_ids.shape, dtype=jnp.int8)
+            for b in graph.buckets
+        )
+
     return MaxSumState(
-        v2f=zeros,
-        f2v=zeros,
-        v2f_count=counts,
-        f2v_count=counts,
+        v2f=zeros(),
+        f2v=zeros(),
+        v2f_count=counts(),
+        f2v_count=counts(),
         stable=jnp.asarray(False),
         cycle=jnp.asarray(0, dtype=jnp.int32),
     )
@@ -211,6 +219,13 @@ def aggregate_beliefs(graph: CompiledFactorGraph, f2v: Msgs
     """
     n_segments = graph.var_costs.shape[0]
     d = graph.var_costs.shape[1]
+    if not graph.buckets:
+        # Constraint-free DCOP: zero factor buckets means zero
+        # incoming messages — the ell/sorted fast paths below would
+        # hit jnp.concatenate([]) (ADVICE r5).  Beliefs are just the
+        # unary costs.
+        zeros = jnp.zeros_like(graph.var_costs)
+        return graph.var_costs, zeros
     if graph.agg_ell is not None:
         from pydcop_tpu.ops.ell import gather_reduce
 

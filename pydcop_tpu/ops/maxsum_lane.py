@@ -116,16 +116,25 @@ class LaneState(NamedTuple):
 def init_state(graph: LaneGraph) -> LaneState:
     d = graph.var_costs.shape[0]
     dtype = graph.var_costs.dtype
-    zeros = tuple(
-        jnp.zeros((d,) + b.var_ids.shape, dtype=dtype)
-        for b in graph.buckets
-    )
-    counts = tuple(
-        jnp.zeros(b.var_ids.shape, dtype=jnp.int8)
-        for b in graph.buckets
-    )
+
+    # Independent arrays per field (no tuple reuse): the segment jits
+    # donate the state pytree (engine/runner.py), and donation rejects
+    # the same buffer appearing in two donated slots.
+    def zeros():
+        return tuple(
+            jnp.zeros((d,) + b.var_ids.shape, dtype=dtype)
+            for b in graph.buckets
+        )
+
+    def counts():
+        return tuple(
+            jnp.zeros(b.var_ids.shape, dtype=jnp.int8)
+            for b in graph.buckets
+        )
+
     return LaneState(
-        v2f=zeros, f2v=zeros, v2f_count=counts, f2v_count=counts,
+        v2f=zeros(), f2v=zeros(),
+        v2f_count=counts(), f2v_count=counts(),
         stable=jnp.asarray(False),
         cycle=jnp.asarray(0, dtype=jnp.int32),
     )

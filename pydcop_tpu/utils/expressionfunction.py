@@ -25,7 +25,115 @@ import random
 import textwrap
 from typing import Iterable, Optional
 
+import numpy as np
+
 _SCOPE_MODULES = {"math": math, "random": random}
+
+
+class _NotVectorizable(Exception):
+    """Raised by the AST transform when an expression cannot be
+    rewritten into numpy elementwise form."""
+
+
+class _VectorizeTransform(ast.NodeTransformer):
+    """Rewrite a scalar python expression into a numpy-elementwise one.
+
+    The scalar and vectorized forms must agree at every grid point
+    (spot-checked by the caller); constructs whose array semantics
+    differ from their scalar semantics are rewritten, and constructs
+    with no elementwise equivalent abort the transform:
+
+    - ``a if c else b``      -> ``np.where(c, a, b)``
+    - ``a and b`` / ``or``   -> ``np.logical_and/or(a, b)``
+    - ``not a``              -> ``np.logical_not(a)``
+    - ``a < b < c``          -> ``np.logical_and(a < b, b < c)``
+    - ``math.<fn>``          -> ``np.<fn>`` (math functions reject
+      arrays; numpy carries elementwise versions of the common ones —
+      a missing attribute surfaces at eval time and falls back)
+    - ``min(a, b)``/``max``  -> ``np.minimum/np.maximum`` (two-arg
+      only: the scalar builtins reduce, which is not elementwise)
+    - ``random.*`` / ``source.*`` / ``in`` -> not vectorizable
+      (per-call randomness and external python have per-assignment
+      semantics a single array eval cannot reproduce).
+    """
+
+    _NP = "__np__"
+
+    def visit_IfExp(self, node: ast.IfExp) -> ast.AST:
+        node = self.generic_visit(node)
+        return ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id=self._NP, ctx=ast.Load()),
+                attr="where", ctx=ast.Load()),
+            args=[node.test, node.body, node.orelse],
+            keywords=[],
+        )
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> ast.AST:
+        node = self.generic_visit(node)
+        fn = "logical_and" if isinstance(node.op, ast.And) \
+            else "logical_or"
+        out = node.values[0]
+        for v in node.values[1:]:
+            out = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=self._NP, ctx=ast.Load()),
+                    attr=fn, ctx=ast.Load()),
+                args=[out, v], keywords=[],
+            )
+        return out
+
+    def visit_UnaryOp(self, node: ast.UnaryOp) -> ast.AST:
+        node = self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=self._NP, ctx=ast.Load()),
+                    attr="logical_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[],
+            )
+        return node
+
+    def visit_Compare(self, node: ast.Compare) -> ast.AST:
+        node = self.generic_visit(node)
+        for op in node.ops:
+            if isinstance(op, (ast.In, ast.NotIn)):
+                raise _NotVectorizable("membership test")
+        if len(node.ops) == 1:
+            return node
+        # Chained comparison: python evaluates it as an AND of pairs,
+        # which is ambiguous on arrays — expand explicitly.
+        operands = [node.left] + list(node.comparators)
+        out = None
+        for left, op, right in zip(operands, node.ops, operands[1:]):
+            pair = ast.Compare(left=left, ops=[op], comparators=[right])
+            out = pair if out is None else ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=self._NP, ctx=ast.Load()),
+                    attr="logical_and", ctx=ast.Load()),
+                args=[out, pair], keywords=[],
+            )
+        return out
+
+    def visit_Name(self, node: ast.Name) -> ast.AST:
+        if node.id in ("random", "source"):
+            raise _NotVectorizable(node.id)
+        if node.id == "math":
+            return ast.Name(id=self._NP, ctx=node.ctx)
+        return node
+
+    def visit_Call(self, node: ast.Call) -> ast.AST:
+        node = self.generic_visit(node)
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("min", "max"):
+            if len(node.args) != 2 or node.keywords:
+                raise _NotVectorizable("min/max with != 2 args")
+            node.func = ast.Attribute(
+                value=ast.Name(id=self._NP, ctx=ast.Load()),
+                attr=("minimum" if node.func.id == "min"
+                      else "maximum"),
+                ctx=ast.Load())
+        return node
 
 
 def _free_names(tree: ast.AST) -> list:
@@ -119,6 +227,58 @@ class ExpressionFunction:
         else:
             self._func = None
             self._code = compile(stripped, "<dcop_expression>", "eval")
+        # Vectorized variant compiled lazily on first use; False once
+        # the transform (or a later eval) proved unsupported.
+        self._vec_code = None
+
+    @property
+    def supports_vectorized(self) -> bool:
+        """Whether a numpy-elementwise variant of the expression could
+        be compiled (function bodies, ``random``/``source`` uses and
+        membership tests cannot).  Compiling succeeding does NOT
+        guarantee semantic equivalence on every input — callers
+        spot-check :meth:`vectorized` results against scalar calls
+        (see relations.NAryFunctionRelation.to_array)."""
+        return self._compile_vectorized() is not None
+
+    def _compile_vectorized(self):
+        if self._vec_code is None:
+            if self._is_body or self._source_file:
+                self._vec_code = False
+            else:
+                try:
+                    tree = ast.parse(
+                        textwrap.dedent(self._expression).strip(),
+                        mode="eval")
+                    tree = _VectorizeTransform().visit(tree)
+                    ast.fix_missing_locations(tree)
+                    self._vec_code = compile(
+                        tree, "<dcop_expression_vec>", "eval")
+                except (_NotVectorizable, SyntaxError, ValueError):
+                    self._vec_code = False
+        return self._vec_code or None
+
+    def mark_not_vectorizable(self) -> None:
+        """Record that a vectorized eval produced wrong/failed results
+        so later calls skip straight to the scalar path."""
+        self._vec_code = False
+
+    def vectorized(self, **arrays):
+        """Evaluate the expression elementwise over numpy arrays.
+
+        ``arrays`` maps variable names to broadcastable numpy arrays;
+        fixed vars stay scalar.  Raises :class:`_NotVectorizable` when
+        no elementwise variant exists; other exceptions propagate (the
+        caller treats any failure as "use the scalar path").
+        """
+        code = self._compile_vectorized()
+        if code is None:
+            raise _NotVectorizable(self._expression)
+        g = {"__builtins__": builtins,
+             _VectorizeTransform._NP: np}
+        scope = dict(self._fixed_vars)
+        scope.update(arrays)
+        return eval(code, g, scope)
 
     @property
     def expression(self) -> str:

@@ -415,6 +415,42 @@ class TestCheckpoint:
         assert result.metrics["resumed_from_cycle"] == 0
         assert result.cycles == self._engine().run(max_cycles=100).cycles
 
+    def test_async_interrupt_resume_matches_uninterrupted(
+            self, tmp_path):
+        """The determinism criterion under the ASYNC writer + donated
+        buffers (both defaults): interrupt at a segment boundary,
+        resume from the background-written snapshot, equal the
+        uninterrupted run exactly."""
+        reference = self._engine().run(max_cycles=100)
+        manager = CheckpointManager(str(tmp_path), every=5, keep=2)
+        partial = self._engine().run_checkpointed(
+            max_cycles=100, manager=manager, max_segments=1,
+            checkpoint_async=True,
+        )
+        assert partial.metrics["checkpoint_async"]
+        # Flushed before return: the snapshot is already readable.
+        assert manager.latest().endswith("ckpt_5.npz")
+        resumed = resume_from_checkpoint(
+            self._engine(), manager, max_cycles=100,
+            checkpoint_async=True,
+        )
+        assert resumed.metrics["resumed_from_cycle"] == 5
+        assert resumed.cycles == reference.cycles
+        assert resumed.assignment == reference.assignment
+
+    def test_donation_off_matches_default(self):
+        """donate=False (state buffers kept) and donate=True (buffers
+        reused in place) must walk the same trajectory."""
+        ref = self._engine().run_checkpointed(
+            max_cycles=100, segment_cycles=7)
+        engine = self._engine()
+        engine.donate = False
+        undonated = engine.run_checkpointed(
+            max_cycles=100, segment_cycles=7)
+        assert undonated.assignment == ref.assignment
+        assert undonated.cycles == ref.cycles
+        assert undonated.converged == ref.converged
+
     def test_api_solve_checkpointed(self, tmp_path):
         from pydcop_tpu.api import solve
 
