@@ -4,7 +4,11 @@
 
 PY ?= python
 
-.PHONY: all test chaos trace-demo perf-smoke unit api cli check doctest bench dryrun onchip
+.PHONY: all test chaos chaos-soak trace-demo perf-smoke unit api cli check doctest bench dryrun onchip
+
+# 0 = the full scenario matrix; `make test` runs the quick 6-scenario
+# gate (the first 6 cover every failure class; fixed seed, < 60 s).
+SOAK_SCENARIOS ?= 0
 
 all: check test
 
@@ -24,6 +28,18 @@ chaos:
 	PYDCOP_CHAOS_SEED=42 $(PY) -m pytest \
 		tests/unit/test_resilience_battery.py -q
 
+# Self-healing gate: the seeded chaos-soak scenario matrix
+# (drop+dup+delay / partition-with-heal / silent kill / guard trip /
+# checkpoint corruption), each asserting the global invariants: valid
+# assignment, monotone cycle counter, no orphaned computations, and
+# health verdicts consistent with the injected kill schedule.  A red
+# scenario prints its seed + trace file for replay
+# (tools/chaos_soak.py --only NAME).  Default = full matrix;
+# `make test` runs the quick gate via SOAK_SCENARIOS=6.
+chaos-soak:
+	PYDCOP_CHAOS_SEED=42 $(PY) tools/chaos_soak.py \
+		--scenarios $(SOAK_SCENARIOS)
+
 # Observability gate: solve a small graph coloring through the real
 # CLI with --trace + --metrics and assert the Chrome trace validates
 # (json loads, spans well-nested, expected span kinds), the metrics
@@ -42,6 +58,7 @@ perf-smoke:
 	$(PY) tools/perf_smoke.py
 
 test: trace-demo perf-smoke
+	$(MAKE) chaos-soak SOAK_SCENARIOS=6
 	$(PY) -m pytest tests/ -q
 
 unit:

@@ -41,8 +41,11 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           checkpoint_dir: Optional[str] = None,
           checkpoint_every: Optional[int] = None,
           checkpoint_async: bool = True,
+          checkpoint_keep: int = 2,
           resume: bool = False,
           fault_plan=None,
+          recovery=None,
+          health=None,
           trace: Optional[str] = None,
           trace_format: str = "chrome",
           metrics_file: Optional[str] = None,
@@ -64,9 +67,22 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     it overlaps the next segment's device compute instead of
     serializing with it (all snapshots are flushed before the solve
     returns); ``checkpoint_async=False`` restores the synchronous
-    write between segments.  ``fault_plan`` (a
-    resilience.faults.FaultPlan) runs the thread backend under
-    seeded message faults and crash injection.
+    write between segments.  ``checkpoint_keep`` bounds the retention
+    (keep-last-N snapshots, default 2; the newest valid one is never
+    pruned).  ``fault_plan`` (a resilience.faults.FaultPlan) runs the
+    thread backend under seeded message faults and crash injection.
+
+    Self-healing knobs (docs/resilience.md "Failure detection &
+    recovery"): ``recovery`` (a resilience.recovery.RecoveryPolicy)
+    arms segment-boundary guards on a device solve — NaN/Inf scan +
+    optional cost-divergence window, rollback to the last valid
+    snapshot with escalating intervention, ``RecoveryExhausted``
+    carrying the partial trajectory once the restart budget is spent;
+    guard trip/attempt counts come back in ``metrics``.  ``health``
+    (a resilience.health.HealthConfig) runs the thread backend under
+    active heartbeat failure detection — phi-accrual suspicion,
+    bounded ``agent_dead`` verdicts feeding the repair path — and
+    returns the verdict history under the result's ``health`` key.
 
     Observability knobs (docs/observability.md): ``trace`` records
     the whole solve on the process tracer and writes a Chrome
@@ -132,6 +148,16 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             "resume=True needs checkpoint_dir: there is no snapshot "
             "location to resume from"
         )
+    if recovery is not None and backend != "device":
+        raise ValueError(
+            "recovery guards the device engine's segmented loop: "
+            "use backend='device'"
+        )
+    if health is not None and backend != "thread":
+        raise ValueError(
+            "health monitoring instruments agent threads: use "
+            "backend='thread'"
+        )
 
     session = None
     if trace is not None or metrics_file is not None:
@@ -154,8 +180,10 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                 collect_period=collect_period, delay=delay,
                 checkpoint_dir=checkpoint_dir,
                 checkpoint_every=checkpoint_every,
-                checkpoint_async=checkpoint_async, resume=resume,
-                fault_plan=fault_plan, observing=session is not None,
+                checkpoint_async=checkpoint_async,
+                checkpoint_keep=checkpoint_keep, resume=resume,
+                fault_plan=fault_plan, recovery=recovery,
+                health=health, observing=session is not None,
                 metrics_file=metrics_file, metrics_every=metrics_every,
             )
     finally:
@@ -166,8 +194,9 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
 def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
            max_cycles, mesh, n_devices, warmup, ui_port, collector,
            collect_moment, collect_period, delay, checkpoint_dir,
-           checkpoint_every, checkpoint_async, resume, fault_plan,
-           observing, metrics_file, metrics_every) -> SolveResult:
+           checkpoint_every, checkpoint_async, checkpoint_keep,
+           resume, fault_plan, recovery, health, observing,
+           metrics_file, metrics_every) -> SolveResult:
     if backend == "device":
         if not hasattr(module, "solve_on_device"):
             raise NotImplementedError(
@@ -194,12 +223,13 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
             and hasattr(module, "build_engine")
             and not algo_def.params.get("decimation")
         )
-        if checkpoint_dir is not None or probed:
+        if checkpoint_dir is not None or probed \
+                or recovery is not None:
             if not hasattr(module, "build_engine"):
                 raise NotImplementedError(
                     f"Algorithm {algo_def.algo} has no segmentable "
-                    "engine: checkpointing supports maxsum-family "
-                    "solves"
+                    "engine: checkpointing/recovery supports "
+                    "maxsum-family solves"
                 )
             from pydcop_tpu.resilience.checkpoint import (
                 CheckpointManager,
@@ -227,7 +257,8 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
             segment_cycles = None
             if checkpoint_dir is not None:
                 manager = CheckpointManager(
-                    checkpoint_dir, every=checkpoint_every or 100
+                    checkpoint_dir, every=checkpoint_every or 100,
+                    keep=checkpoint_keep,
                 )
             else:
                 segment_cycles = metrics_every or 100
@@ -235,12 +266,14 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
                 res = resume_from_checkpoint(
                     engine, manager, max_cycles=max_cycles,
                     probe=probe, checkpoint_async=checkpoint_async,
+                    recovery=recovery,
                 )
             else:
                 res = engine.run_checkpointed(
                     max_cycles=max_cycles, manager=manager,
                     segment_cycles=segment_cycles, probe=probe,
                     checkpoint_async=checkpoint_async,
+                    recovery=recovery,
                 )
             if probe is not None:
                 from pydcop_tpu.observability.engine_probe import (
@@ -291,7 +324,7 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
             ui_port=ui_port, collector=collector,
             collect_moment=collect_moment,
             collect_period=collect_period, delay=delay,
-            fault_plan=fault_plan,
+            fault_plan=fault_plan, health_config=health,
             metrics_file=metrics_file, metrics_every=metrics_every,
         )
 

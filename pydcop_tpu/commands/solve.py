@@ -86,9 +86,42 @@ def set_parser(subparsers):
                              "overlapping device compute (default; "
                              "--no-checkpoint_async restores the "
                              "synchronous write between segments)")
+    parser.add_argument("--checkpoint_keep", type=int, default=2,
+                        help="keep-last-N checkpoint retention (the "
+                             "newest valid snapshot is never pruned)")
     parser.add_argument("--resume", action="store_true",
                         help="device mode: continue from the newest "
-                             "checkpoint in --checkpoint_dir")
+                             "VALID checkpoint in --checkpoint_dir "
+                             "(corrupt/truncated snapshots are "
+                             "skipped with a warning)")
+    # Self-healing knobs (docs/resilience.md).
+    parser.add_argument("--recovery", action="store_true",
+                        help="device mode: arm segment-boundary "
+                             "guards (NaN/Inf scan) with rollback-"
+                             "and-recover on a trip")
+    parser.add_argument("--recovery_max_restarts", type=int, default=3,
+                        help="restart budget before RecoveryExhausted")
+    parser.add_argument("--recovery_noise", type=float, default=1e-3,
+                        help="tie-break noise scale of the first "
+                             "recovery escalation")
+    parser.add_argument("--recovery_damping_bump", type=float,
+                        default=0.2,
+                        help="damping increase of the second recovery "
+                             "escalation")
+    parser.add_argument("--health", action="store_true",
+                        help="thread mode: heartbeat failure "
+                             "detection (phi-accrual suspicion, "
+                             "bounded death verdicts feeding repair)")
+    parser.add_argument("--health_interval", type=float, default=0.05,
+                        help="seconds between agent heartbeats")
+    parser.add_argument("--health_suspect_misses", type=float,
+                        default=3.0,
+                        help="missed intervals before an agent is "
+                             "suspect")
+    parser.add_argument("--health_dead_misses", type=float,
+                        default=8.0,
+                        help="missed intervals before an agent is "
+                             "declared dead (the detection bound)")
     parser.add_argument("--fault_seed", type=int, default=0,
                         help="seed for deterministic fault injection "
                              "(thread mode)")
@@ -153,6 +186,34 @@ def run_cmd(args) -> int:
             ),
             replicas=args.fault_replicas,
         )
+    health_config = None
+    if args.health:
+        from pydcop_tpu.resilience.health import HealthConfig
+
+        if args.mode != "thread":
+            raise ValueError(
+                "--health needs --mode thread (heartbeats instrument "
+                "in-process agents)"
+            )
+        health_config = HealthConfig(
+            interval=args.health_interval,
+            suspect_misses=args.health_suspect_misses,
+            dead_misses=args.health_dead_misses,
+        )
+    recovery_policy = None
+    if args.recovery:
+        from pydcop_tpu.resilience.recovery import RecoveryPolicy
+
+        if args.mode != "device":
+            raise ValueError(
+                "--recovery guards the device engine's segmented "
+                "loop: use --mode device"
+            )
+        recovery_policy = RecoveryPolicy(
+            max_restarts=args.recovery_max_restarts,
+            noise_scale=args.recovery_noise,
+            damping_bump=args.recovery_damping_bump,
+        )
 
     t0 = time.perf_counter()
     if args.delay and args.mode == "device":
@@ -175,7 +236,9 @@ def run_cmd(args) -> int:
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
                 checkpoint_async=args.checkpoint_async,
+                checkpoint_keep=args.checkpoint_keep,
                 resume=args.resume,
+                recovery=recovery_policy,
                 trace=trace_file, trace_format=trace_format or "chrome",
                 metrics_file=args.metrics,
                 metrics_every=args.metrics_every,
@@ -235,7 +298,7 @@ def run_cmd(args) -> int:
             max_cycles=args.cycles, ui_port=args.uiport,
             collector=collector, collect_moment=args.collect_on,
             collect_period=args.period, delay=args.delay,
-            fault_plan=fault_plan,
+            fault_plan=fault_plan, health=health_config,
             trace=trace_file, trace_format=trace_format or "chrome",
             metrics_file=args.metrics,
             metrics_every=args.metrics_every,
@@ -255,6 +318,8 @@ def run_cmd(args) -> int:
         if "fault_stats" in res:
             result["fault_stats"] = res["fault_stats"]
             result["killed_agents"] = res.get("killed_agents", [])
+        if "health" in res:
+            result["health"] = res["health"]
 
     if args.run_metrics or args.end_metrics:
         from pydcop_tpu.commands.metrics_io import add_csvline

@@ -152,9 +152,39 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     return jax.process_count() > 1
 
 
+def multihost_configured() -> bool:
+    """True when the environment asks for a distributed runtime (the
+    ``PYDCOP_*`` conventions above), regardless of whether the join
+    has happened yet."""
+    return (
+        "PYDCOP_COORDINATOR" in os.environ
+        or "PYDCOP_NUM_PROCESSES" in os.environ
+        or os.environ.get("PYDCOP_MULTIHOST") == "auto"
+    )
+
+
 def global_mesh(n_devices: Optional[int] = None):
     """A mesh over the global (cross-host) device list; call
-    :func:`initialize_multihost` first on every host."""
+    :func:`initialize_multihost` first on every host.
+
+    When the environment is CONFIGURED for multihost but the join has
+    not completed (never attempted, or the coordinator was lost and
+    the retries exhausted), this raises a clean error instead of
+    silently building a single-host mesh: a participant sharding over
+    its local devices while the rest of the pod shards globally would
+    produce a wrong answer, not a crash — the worst failure mode.  The
+    un-latched join state (``initialize_multihost`` never latches on
+    failure) means the caller can retry the join and come back here.
+    """
     from pydcop_tpu.engine.sharding import make_mesh
 
+    if multihost_configured() and not multihost_initialized():
+        raise RuntimeError(
+            "multihost runtime configured (PYDCOP_COORDINATOR / "
+            "PYDCOP_NUM_PROCESSES / PYDCOP_MULTIHOST=auto) but not "
+            "initialized: the coordinator join failed or was never "
+            "attempted — call initialize_multihost() (it retries and "
+            "never latches a failed join) before building a global "
+            "mesh"
+        )
     return make_mesh(n_devices)

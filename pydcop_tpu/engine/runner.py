@@ -227,6 +227,15 @@ class MaxSumEngine:
         device placement)."""
         return self._ops.init_state(self.graph)
 
+    def _segment_key(self, extra_cycles: int,
+                     stop_on_convergence: bool):
+        """Cache key of one segment program.  Damping parameters are
+        part of the key: a recovery damping bump
+        (resilience/recovery.py) mid-run must compile a fresh program,
+        not silently reuse the one that baked in the old damping."""
+        return ("segment", extra_cycles, stop_on_convergence,
+                self.damping, self.damp_vars, self.damp_factors)
+
     def _segment_fn(self, extra_cycles: int, stop_on_convergence: bool):
         """Cached-jit ``run_maxsum_from`` for one K-cycle segment (the
         checkpointed loop re-enters the solve with device state, the
@@ -235,7 +244,7 @@ class MaxSumEngine:
         every segment reuses the previous segment's buffers in place
         — the donated input is dead after the call; the loop only
         ever touches the returned state."""
-        key = ("segment", extra_cycles, stop_on_convergence)
+        key = self._segment_key(extra_cycles, stop_on_convergence)
         if key not in self._jitted:
             self._jitted[key] = jax.jit(
                 partial(
@@ -251,6 +260,34 @@ class MaxSumEngine:
             )
         return self._jitted[key]
 
+    def _guard_fn(self, with_cost: bool = True):
+        """Cached-jit segment-boundary guard: NaN/Inf scan over every
+        floating-point state leaf, plus (``with_cost``) the constraint
+        cost of the selected assignment — computed ON DEVICE so the
+        verdict rides the segment boundary's existing host fetch (no
+        syncs enter the jitted loop).  ``with_cost=False`` (the
+        default-policy case: divergence guard disabled) skips the cost
+        evaluation entirely instead of computing a value nobody reads.
+        Pure reads either way: running the guard can never change the
+        trajectory (the no-trip bit-identity the battery pins)."""
+        key = ("guard", with_cost)
+        if key not in self._jitted:
+            ops = self._ops
+
+            def guard(graph, state, values):
+                finite = jnp.asarray(True)
+                for leaf in jax.tree_util.tree_leaves(state):
+                    if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                        finite = finite & jnp.all(jnp.isfinite(leaf))
+                cost = (
+                    ops.assignment_constraint_cost(graph, values)
+                    if with_cost else jnp.asarray(0.0)
+                )
+                return finite, cost
+
+            self._jitted[key] = jax.jit(guard)
+        return self._jitted[key]
+
     def run_checkpointed(self, max_cycles: int = 1000, *,
                          manager=None,
                          checkpoint_dir: Optional[str] = None,
@@ -260,6 +297,7 @@ class MaxSumEngine:
                          max_segments: Optional[int] = None,
                          probe=None,
                          checkpoint_async: bool = True,
+                         recovery=None,
                          ) -> "DeviceRunResult":
         """The solve loop chunked into K-cycle segments with a state
         snapshot between segments — the preemption-survival entry point
@@ -299,6 +337,18 @@ class MaxSumEngine:
         segment — the chunk boundary is the only place a host already
         waits, so the probe's cost/convergence points cost no extra
         syncs inside the jitted loop.
+
+        ``recovery`` (a resilience.recovery.RecoveryPolicy) arms the
+        segment-boundary GUARD: each segment's end state is validated
+        on device (NaN/Inf scan + optional cost-divergence window) and
+        a tripped guard rolls back to the last valid in-memory
+        snapshot and re-runs under the policy's escalation ladder
+        (reseeded tie-break noise -> damping bump -> RecoveryExhausted
+        carrying the partial trajectory), bounded by its restart
+        budget.  Only VALIDATED states are checkpointed or fed to the
+        probe; with no trips the guarded trajectory is bit-identical
+        to the unguarded one (guards are pure reads — tier-1
+        asserted).
         """
         from pydcop_tpu.resilience.checkpoint import (
             AsyncCheckpointWriter,
@@ -316,6 +366,14 @@ class MaxSumEngine:
             initial_state if initial_state is not None
             else self.init_state()
         )
+        rec = None
+        if recovery is not None:
+            from pydcop_tpu.resilience.recovery import RecoveryRun
+
+            rec = RecoveryRun(recovery, self)
+            # The starting state is the first rollback target: a trip
+            # on the very first segment restarts from here.
+            rec.retain(state, None)
         writer = None
         if manager is not None and checkpoint_async:
             writer = AsyncCheckpointWriter(manager)
@@ -338,22 +396,39 @@ class MaxSumEngine:
                 # without stepping.
                 extra = min(every, max(max_cycles - cycle, 0))
                 fn = self._segment_fn(extra, stop_on_convergence)
+                seg_key = self._segment_key(extra, stop_on_convergence)
                 if tracer.enabled:
                     with tracer.span("engine_segment", "engine",
                                      segment=segments,
                                      from_cycle=cycle,
                                      extra_cycles=extra):
                         (state, values), c_s, run_s = self._call(
-                            ("segment", extra, stop_on_convergence),
-                            fn, self.graph, state,
+                            seg_key, fn, self.graph, state,
                         )
                 else:
                     (state, values), c_s, run_s = self._call(
-                        ("segment", extra, stop_on_convergence), fn,
-                        self.graph, state,
+                        seg_key, fn, self.graph, state,
                     )
                 compile_s += c_s
                 segments += 1
+                if rec is not None:
+                    finite, g_cost = jax.device_get(
+                        self._guard_fn(
+                            recovery.divergence_window > 0
+                        )(self.graph, state, values))
+                    violation = rec.check(
+                        int(state.cycle), bool(finite), float(g_cost))
+                    if violation is not None:
+                        # Tripped: the segment's output never reaches
+                        # the probe or a checkpoint.  rollback raises
+                        # RecoveryExhausted past the restart budget.
+                        state, values = rec.rollback(violation)
+                        if max_segments is not None \
+                                and segments >= max_segments:
+                            interrupted = True
+                            break
+                        continue
+                    rec.retain(state, values)
                 if probe is not None:
                     probe.on_segment(state, values, run_s, c_s)
                 if manager is not None:
@@ -364,9 +439,16 @@ class MaxSumEngine:
                             # buffers; the writer must fetch from a
                             # copy that outlives the donation.  The
                             # copy is a device-side program — it
-                            # overlaps, no host sync.
-                            snap = jax.tree_util.tree_map(
-                                jnp.copy, state)
+                            # overlaps, no host sync.  The recovery
+                            # run already retained exactly that copy
+                            # (both sides only read it), so reuse it
+                            # rather than paying a second one.
+                            snap = (
+                                rec.snapshot_state
+                                if rec is not None
+                                else jax.tree_util.tree_map(
+                                    jnp.copy, state)
+                            )
                         # snap.cycle, not state.cycle: the original
                         # scalar is donated along with the rest of
                         # the state on the next dispatch.
@@ -388,6 +470,19 @@ class MaxSumEngine:
                     # the write failure IS the error.
                     if sys.exc_info()[0] is None:
                         raise
+        if values is None:
+            # Reachable when a guard trip on the very first segment
+            # meets a max_segments break: the rollback restored the
+            # initial snapshot, which carries no selected values yet.
+            # A zero-extra segment computes the selection without
+            # stepping (the same trick the resume-at-budget path
+            # uses).
+            fn = self._segment_fn(0, stop_on_convergence)
+            (state, values), c_s, _ = self._call(
+                self._segment_key(0, stop_on_convergence), fn,
+                self.graph, state,
+            )
+            compile_s += c_s
         total = time.perf_counter() - t0
         values_host, cycle, stable = jax.device_get(
             (values, state.cycle, state.stable)
@@ -410,6 +505,7 @@ class MaxSumEngine:
                 "interrupted": interrupted,
                 "cycles_per_s": cycle / steady if steady > 0 else 0.0,
                 "cold_start": compile_s > 0,
+                **(rec.metrics() if rec is not None else {}),
             },
         )
 

@@ -242,6 +242,10 @@ class Orchestrator:
         # every cycle/value report with the global cycle count; its
         # own cadence check rate-limits the snapshot writes.
         self.metrics_snapshotter = None
+        # Optional resilience.health.HealthMonitor (set by
+        # attach_health when the runner enabled heartbeat failure
+        # detection); its death verdicts call report_agent_failure.
+        self.health_monitor = None
 
         self._agent = Agent(ORCHESTRATOR_AGENT, comm)
         self.directory = Directory(self._agent.discovery)
@@ -563,6 +567,13 @@ class Orchestrator:
             if agent in self._removed_agents:
                 return
             self._removed_agents.add(agent)
+            if self.health_monitor is not None:
+                # Removed through another detector (scenario event,
+                # transport mark): stop scoring it so the silence that
+                # FOLLOWS the removal cannot yield a second, spurious
+                # death verdict.  A monitor-declared death keeps its
+                # record.
+                self.health_monitor.forget_removed(agent)
             tracer.instant("agent_failure", "orchestrator", agent=agent)
             orphaned = self.distribution.computations_hosted(agent)
             mapping = self.distribution.mapping

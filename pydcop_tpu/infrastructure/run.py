@@ -15,7 +15,10 @@ from pydcop_tpu.distribution.objects import Distribution
 from pydcop_tpu.infrastructure.communication import (
     InProcessCommunicationLayer,
 )
-from pydcop_tpu.infrastructure.orchestratedagents import OrchestratedAgent
+from pydcop_tpu.infrastructure.orchestratedagents import (
+    ORCHESTRATOR_AGENT,
+    OrchestratedAgent,
+)
 from pydcop_tpu.infrastructure.orchestrator import Orchestrator
 
 logger = logging.getLogger("pydcop.run")
@@ -55,6 +58,7 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
                           collect_period: float = 1.0,
                           repair_mode: str = "device",
                           comm_wrapper=None,
+                          health=None,
                           ) -> Orchestrator:
     """One OrchestratedAgent thread per AgentDef + an orchestrator, all
     with in-process transports (reference run.py:145).  With
@@ -67,7 +71,16 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
     transport is never wrapped, so control-plane bootstrap stays
     reliable.  Started agents are registered in
     ``orchestrator.local_agents`` so crash injection (and tests) can
-    reach their threads."""
+    reach their threads.
+
+    ``health`` (a resilience.health.HealthConfig) enables active
+    failure detection: every started agent gets a HeartbeatEmitter
+    (beats ride the agent's — possibly fault-wrapped — transport) and
+    the orchestrator a HealthMonitor whose death verdicts feed
+    ``report_agent_failure``, i.e. the replication/reparation path.
+    The monitor is created here but NOT started; the caller starts it
+    once the run begins and stops it before tearing agents down
+    (solve_with_agents does both)."""
     comm = InProcessCommunicationLayer()
     orchestrator = Orchestrator(
         algo, cg, distribution, comm, dcop, infinity,
@@ -75,6 +88,11 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
         collect_period=collect_period, repair_mode=repair_mode,
     )
     orchestrator.start()
+    monitor = None
+    if health is not None:
+        from pydcop_tpu.resilience.health import attach_health
+
+        monitor = attach_health(orchestrator, health)
     hosting = {
         a for a in distribution.agents
         if distribution.computations_hosted(a)
@@ -87,6 +105,25 @@ def run_local_thread_dcop(algo: AlgorithmDef, cg, distribution, dcop,
             agent_def, agent_comm, orchestrator.address, delay=delay,
             replication=replication, ui_port=ui,
         )
+        if monitor is not None:
+            from pydcop_tpu.resilience.health import (
+                HEALTH_COMP,
+                HeartbeatEmitter,
+            )
+
+            # Route heartbeats: the health computation lives on the
+            # orchestrator agent but is never published through
+            # discovery (service name), so seed the mapping like
+            # OrchestratedAgent does for ORCHESTRATOR_MGT.
+            agent.discovery.register_computation(
+                HEALTH_COMP, ORCHESTRATOR_AGENT, orchestrator.address,
+                publish=False,
+            )
+            emitter = HeartbeatEmitter(
+                agent_def.name, monitor.config.interval)
+            agent.add_computation(emitter)
+            emitter.start()
+            monitor.watch(agent_def.name)
         agent.start()
         orchestrator.local_agents[agent_def.name] = agent
         return agent
@@ -214,6 +251,7 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
                       collect_period: float = 1.0,
                       delay: Optional[float] = None,
                       fault_plan=None,
+                      health_config=None,
                       metrics_file: Optional[str] = None,
                       metrics_every: Optional[int] = None) -> Dict:
     """Full-metrics variant used by the api/CLI thread backend.
@@ -225,6 +263,16 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
     the kills from a FaultMonitor — the murdered agents' computations
     migrate through the reparation path.  Thread mode only (process
     agents own their transports in other processes).
+
+    ``health_config`` (a resilience.health.HealthConfig) adds active
+    failure detection: heartbeat emitters on every agent, a
+    HealthMonitor on the orchestrator, and a ``health`` summary
+    (statuses + verdict history) in the result.  With BOTH a health
+    config and a crash schedule, the kills are SILENT (the fault
+    monitor stops the thread but does not report the failure) — the
+    heartbeat detector must notice the death and trigger the repair,
+    which is the self-healing property the chaos soak asserts.  Thread
+    mode only.
 
     ``metrics_file`` appends a JSONL metrics snapshot (observability
     registry) each time the orchestrator's global cycle view advances
@@ -265,6 +313,11 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             "fault injection needs in-process transports: "
             f"mode must be 'thread', got {mode!r}"
         )
+    if health_config is not None and mode != "thread":
+        raise ValueError(
+            "heartbeat health monitoring instruments in-process "
+            f"agents: mode must be 'thread', got {mode!r}"
+        )
     comm_wrapper = None
     fault_stats = None
     if fault_plan is not None:
@@ -287,6 +340,7 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             replication=bool(
                 fault_plan is not None and fault_plan.crashes),
             comm_wrapper=comm_wrapper,
+            health=health_config,
         )
     if metrics_file is not None:
         from pydcop_tpu.observability.metrics import CycleSnapshotter
@@ -297,25 +351,40 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
         )
     stopped = False
     monitor = None
+    health_monitor = getattr(orchestrator, "health_monitor", None)
     try:
         if not orchestrator.wait_ready(
                 PROCESS_READY_TIMEOUT if mode == "process"
                 else THREAD_READY_TIMEOUT):
             raise RuntimeError("Agents did not become ready in time")
         orchestrator.deploy_computations()
+        if health_monitor is not None:
+            health_monitor.start()
         if fault_plan is not None and fault_plan.crashes:
             from pydcop_tpu.resilience.faults import (
                 CrashSchedule,
                 FaultMonitor,
+                kill_agent,
             )
 
             # Replicas must exist before the first kill, or the
             # murdered computations are lost instead of migrated.
             orchestrator.start_replication(fault_plan.replicas)
+            kill = kill_agent
+            if health_monitor is not None:
+                # Silent crash: the thread dies but nobody files the
+                # report — detection is the heartbeat monitor's job.
+                def kill(orch, agent):
+                    kill_agent(orch, agent, report=False)
             monitor = FaultMonitor(
-                orchestrator, CrashSchedule(list(fault_plan.crashes))
+                orchestrator, CrashSchedule(list(fault_plan.crashes)),
+                kill=kill,
             ).start()
         orchestrator.run(timeout=timeout)
+        # Verdicts must not fire on the clean shutdown below (stopped
+        # agents stop beating); detection is over once the run is.
+        if health_monitor is not None:
+            health_monitor.stop()
         # Stop agents first: final metrics arrive with AgentStopped.
         orchestrator.stop_agents(5)
         stopped = True
@@ -326,6 +395,8 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             extra["killed_agents"] = (
                 list(monitor.killed) if monitor is not None else []
             )
+        if health_monitor is not None:
+            extra["health"] = health_monitor.summary()
         return {
             **extra,
             "status": orchestrator.status,
@@ -345,6 +416,8 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
     finally:
         if monitor is not None:
             monitor.stop()
+        if health_monitor is not None:
+            health_monitor.stop()
         if not stopped:
             orchestrator.stop_agents(5)
         orchestrator.stop()

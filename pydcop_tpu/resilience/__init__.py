@@ -1,20 +1,30 @@
-"""Resilience subsystem: fault injection, checkpoint/resume, retry.
+"""Resilience subsystem: faults, checkpoints, retry, self-healing.
 
 The reference ships resilience as a first-class capability
 (ResilientAgent, computation replication, distribution reparation);
 this package adds the pieces that *exercise* and *harden* that stack:
 
 - :mod:`pydcop_tpu.resilience.faults` — deterministic, seed-driven
-  fault injection (message drop / duplicate / delay / partition, agent
-  crash schedules) over any ``CommunicationLayer``;
-- :mod:`pydcop_tpu.resilience.checkpoint` — NPZ snapshots of
-  device-resident solver state plus ``resume_from_checkpoint`` so an
-  interrupted (or preempted multi-host) solve restarts mid-run;
+  fault injection (message drop / duplicate / delay / partition with
+  optional healing, agent crash schedules) over any
+  ``CommunicationLayer``;
+- :mod:`pydcop_tpu.resilience.checkpoint` — checksummed NPZ snapshots
+  of device-resident solver state plus ``resume_from_checkpoint``
+  that falls back to the newest *valid* snapshot on corruption;
 - :mod:`pydcop_tpu.resilience.retry` — ``RetryPolicy`` (exponential
   backoff + jitter + deadline) and ``CircuitBreaker``, applied to the
-  HTTP transport, remote messaging and the multihost coordinator join.
+  HTTP transport, remote messaging and the multihost coordinator join;
+- :mod:`pydcop_tpu.resilience.health` — active failure detection:
+  per-agent heartbeat emitters and a phi-accrual ``HealthMonitor``
+  whose bounded death verdicts feed the replication/reparation path;
+- :mod:`pydcop_tpu.resilience.recovery` — guarded engine segments:
+  ``RecoveryPolicy`` rolls a tripped solve (NaN/Inf, cost divergence)
+  back to the last valid snapshot and re-runs with escalating
+  intervention, bounded by a restart budget.
 
-See docs/resilience.md for knobs and the agent-repair flow.
+See docs/resilience.md for knobs and the agent-repair flow;
+``tools/chaos_soak.py`` (``make chaos-soak``) is the invariant-
+asserting scenario matrix over all of it.
 """
 
 from pydcop_tpu.resilience.retry import (  # noqa: F401

@@ -12,18 +12,26 @@ tests/unit/test_resilience_battery.py).
 
 Format: one ``ckpt_<cycle>.npz`` per snapshot — flattened state leaves
 (``leaf_<i>``) + a JSON metadata blob (version, cycle, leaf count,
-engine tag).  Writes are atomic (tmp + ``os.replace``) so a crash
-mid-write never corrupts the latest good snapshot, and ``latest()``
-skips unreadable files.  :class:`AsyncCheckpointWriter` moves the
-device→host fetch and the write onto a background thread (bounded
-queue, flush-on-exit, same atomic format) so snapshotting overlaps
-device compute — the engine's default checkpoint path.  The state's pytree *structure* is not stored:
+content checksum, engine tag).  Writes are atomic (tmp +
+``os.replace``) so a crash mid-write never corrupts the latest good
+snapshot.  Integrity is verified on READ, not trusted from the write
+path: the meta blob carries a sha256 over every leaf's bytes (+ shape
+and dtype), so a torn async write, a truncated file or silent disk
+corruption is detected by :func:`verify_checkpoint` /
+:func:`load_state` (:class:`CheckpointCorruptError`) and ``latest()``
+falls back to the newest snapshot that fully verifies —
+``resume_from_checkpoint`` can therefore NEVER resume from garbage.
+:class:`AsyncCheckpointWriter` moves the device→host fetch and the
+write onto a background thread (bounded queue, flush-on-exit, same
+atomic format) so snapshotting overlaps device compute — the engine's
+default checkpoint path.  The state's pytree *structure* is not stored:
 restore goes through a template state built from the same compiled
 graph, which also re-applies the template's device/sharding placement
 (checkpoints taken on a mesh restore onto a mesh).
 """
 
 import atexit
+import hashlib
 import json
 import logging
 import os
@@ -40,6 +48,25 @@ logger = logging.getLogger("pydcop.resilience.checkpoint")
 
 CHECKPOINT_VERSION = 1
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A snapshot failed integrity verification (unreadable container,
+    missing leaves, or checksum mismatch)."""
+
+
+def _content_checksum(arrays: Dict[str, np.ndarray]) -> str:
+    """sha256 over every leaf's bytes, shape and dtype, in leaf order.
+    Shape/dtype are hashed too: a corruption that re-interprets bytes
+    under a different dtype must not collide."""
+    h = hashlib.sha256()
+    for name in sorted(arrays, key=lambda n: int(n.split("_")[1])):
+        arr = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def save_state(path: str, state: Any, *, cycle: int,
@@ -70,6 +97,7 @@ def _save_state(path: str, state: Any, *, cycle: int,
         "version": CHECKPOINT_VERSION,
         "cycle": int(cycle),
         "n_leaves": len(leaves),
+        "checksum": _content_checksum(arrays),
         "extra": extra or {},
     }
     directory = os.path.dirname(os.path.abspath(path))
@@ -103,14 +131,67 @@ def read_meta(path: str) -> Dict[str, Any]:
         return json.loads(str(data["__meta__"]))
 
 
+def _verify_arrays(path: str, meta: Dict[str, Any],
+                   arrays: Dict[str, np.ndarray]):
+    """Checksum the loaded leaves against the meta blob.  Pre-checksum
+    snapshots (no ``checksum`` key) pass — their atomic rename is the
+    only integrity story they have."""
+    expected = meta.get("checksum")
+    if expected is None:
+        return
+    actual = _content_checksum(arrays)
+    if actual != expected:
+        raise CheckpointCorruptError(
+            f"Checkpoint {path} failed content verification: "
+            f"checksum {actual[:12]}… != recorded {expected[:12]}… "
+            "(torn write or disk corruption)"
+        )
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Fully verify one snapshot: readable container, every declared
+    leaf present, content checksum matching.  Returns the meta blob;
+    raises :class:`CheckpointCorruptError` on any failure (including
+    an unreadable/truncated NPZ)."""
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            meta = json.loads(str(data["__meta__"]))
+            arrays = {
+                f"leaf_{i}": data[f"leaf_{i}"]
+                for i in range(meta["n_leaves"])
+            }
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"Checkpoint {path} unreadable: {e}") from e
+    _verify_arrays(path, meta, arrays)
+    return meta
+
+
 def load_state(path: str, template: Any) -> Tuple[Any, Dict[str, Any]]:
     """Load a snapshot back into ``template``'s pytree structure and
-    device placement.  Returns ``(state, meta)``."""
+    device placement, verifying its content checksum.  Returns
+    ``(state, meta)``; raises :class:`CheckpointCorruptError` when the
+    snapshot fails verification."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(template)
-    with np.load(path, allow_pickle=False) as data:
-        meta = json.loads(str(data["__meta__"]))
+    # An unreadable container / missing leaf is CORRUPTION
+    # (CheckpointCorruptError — resume falls back past it); a version
+    # or structure mismatch is a CALLER error (ValueError — never
+    # silently skipped).
+    try:
+        data = np.load(path, allow_pickle=False)
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"Checkpoint {path} unreadable: {e}") from e
+    with data:
+        try:
+            meta = json.loads(str(data["__meta__"]))
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"Checkpoint {path} unreadable: {e}") from e
         if meta.get("version") != CHECKPOINT_VERSION:
             raise ValueError(
                 f"Checkpoint {path} has version {meta.get('version')}; "
@@ -122,7 +203,15 @@ def load_state(path: str, template: Any) -> Tuple[Any, Dict[str, Any]]:
                 f"the engine state has {len(leaves)}: wrong problem or "
                 "engine configuration"
             )
-        loaded = [data[f"leaf_{i}"] for i in range(len(leaves))]
+        try:
+            loaded = [data[f"leaf_{i}"] for i in range(len(leaves))]
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"Checkpoint {path} unreadable: {e}") from e
+    _verify_arrays(
+        path, meta,
+        {f"leaf_{i}": arr for i, arr in enumerate(loaded)},
+    )
     placed = []
     for arr, ref in zip(loaded, leaves):
         if arr.shape != ref.shape:
@@ -176,16 +265,20 @@ class CheckpointManager:
         return sorted(found)
 
     def latest(self) -> Optional[str]:
-        """Path of the newest READABLE checkpoint (corrupt/partial
-        files — e.g. from a crash predating the atomic rename — are
-        skipped with a warning)."""
+        """Path of the newest VALID checkpoint.  Candidates are fully
+        verified (container readable, every leaf present, content
+        checksum matching — :func:`verify_checkpoint`), newest first;
+        a corrupt or truncated snapshot (torn async write, disk rot)
+        is skipped with a warning and the next older one is tried, so
+        a resume can never start from garbage."""
         for cycle, path in reversed(self.checkpoints()):
             try:
-                read_meta(path)
+                verify_checkpoint(path)
                 return path
             except Exception as e:
                 logger.warning(
-                    "Skipping unreadable checkpoint %s: %s", path, e
+                    "Skipping corrupt checkpoint %s, falling back to "
+                    "an older snapshot: %s", path, e
                 )
         return None
 
@@ -230,7 +323,11 @@ class AsyncCheckpointWriter:
       engine loop blocks on ``submit`` rather than buying unbounded
       host memory — backpressure, not a crash;
     - ``close`` drains the queue and joins the thread (also registered
-      ``atexit`` so an abandoned writer still flushes);
+      ``atexit`` so an abandoned writer still flushes — but the atexit
+      drain LOGS a failure instead of raising it: an exception thrown
+      into interpreter shutdown cannot be handled by anyone and only
+      garbles the exit.  Explicit ``flush``/``close`` calls keep
+      raising);
     - a write failure is re-raised on the NEXT ``submit``/``flush``/
       ``close`` — never swallowed, never crashing the writer thread.
     """
@@ -244,7 +341,7 @@ class AsyncCheckpointWriter:
             target=self._run, name="pydcop-ckpt-writer", daemon=True
         )
         self._thread.start()
-        atexit.register(self.close)
+        atexit.register(self._close_at_exit)
 
     def _run(self):
         import jax
@@ -299,10 +396,24 @@ class AsyncCheckpointWriter:
             self._thread.join()
         finally:
             try:
-                atexit.unregister(self.close)
+                atexit.unregister(self._close_at_exit)
             except Exception:  # pragma: no cover - interpreter exit
                 pass
         self._raise_pending()
+
+    def _close_at_exit(self) -> None:
+        """Atexit drain: flush like :meth:`close`, but log-and-swallow
+        a failure — re-raising into interpreter shutdown turns one
+        failed background write into an unhandleable error splat at
+        exit.  Every explicit ``submit``/``flush``/``close`` still
+        raises."""
+        try:
+            self.close()
+        except Exception:
+            logger.exception(
+                "Async checkpoint flush failed during interpreter "
+                "shutdown; the last snapshot may be missing"
+            )
 
 
 def resume_from_checkpoint(engine, manager, max_cycles: int = 1000,
@@ -319,15 +430,30 @@ def resume_from_checkpoint(engine, manager, max_cycles: int = 1000,
     """
     if isinstance(manager, str):
         manager = CheckpointManager(manager)
-    path = manager.latest()
     initial_state = None
     resumed_cycle = 0
-    if path is not None:
-        initial_state, meta = load_state(path, engine.init_state())
-        resumed_cycle = meta["cycle"]
-        logger.info(
-            "Resuming from %s (cycle %d)", path, resumed_cycle
-        )
+    template = engine.init_state()
+    # Newest-first over every snapshot on disk: load_state re-verifies
+    # the checksum, so a snapshot that rots between listing and load
+    # falls back to the next older one instead of resuming from
+    # garbage.  ONLY corruption falls back: a structural mismatch
+    # (wrong problem / engine configuration — ValueError) is a caller
+    # error and still aborts loudly, as it always has; silently
+    # restarting such a run from cycle 0 would also let retention GC
+    # the other problem's snapshots.
+    for cycle, path in reversed(manager.checkpoints()):
+        try:
+            initial_state, meta = load_state(path, template)
+            resumed_cycle = meta["cycle"]
+            logger.info(
+                "Resuming from %s (cycle %d)", path, resumed_cycle
+            )
+            break
+        except (CheckpointCorruptError, OSError) as e:
+            logger.warning(
+                "Checkpoint %s failed verification (%s); falling back "
+                "to an older snapshot", path, e,
+            )
     result = engine.run_checkpointed(
         max_cycles=max_cycles, manager=manager,
         initial_state=initial_state, **run_kwargs,

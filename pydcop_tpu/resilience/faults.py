@@ -76,8 +76,15 @@ class FaultPlan:
     ``delay_time`` seconds, off the sender thread).  ``partitions`` is
     a set of agent groups; messages crossing group boundaries are
     dropped (agents absent from every group communicate freely).
-    ``crashes`` is the kill schedule; ``replicas`` the replication
-    factor a harness should place before letting the crashes fire.
+    ``partition_heal_index`` HEALS the partition deterministically:
+    once a cross-group edge's per-edge message index reaches it,
+    traffic flows again — the transport-level analogue of an end-cycle
+    (for cycle-synchronous algorithms the per-edge index advances one
+    per cycle), chosen over wall-clock so decisions stay a pure
+    function of (seed, edge, index) and soak scenarios can assert
+    post-heal reconvergence under replay.  ``crashes`` is the kill
+    schedule; ``replicas`` the replication factor a harness should
+    place before letting the crashes fire.
     """
 
     seed: int = 0
@@ -86,13 +93,21 @@ class FaultPlan:
     delay: float = 0.0
     delay_time: float = 0.05
     partitions: Tuple[frozenset, ...] = ()
+    partition_heal_index: Optional[int] = None
     crashes: Tuple[CrashEvent, ...] = ()
     replicas: int = 2
     protect_management: bool = True
 
-    def is_partitioned(self, src: str, dest: str) -> bool:
+    def is_partitioned(self, src: str, dest: str,
+                       index: int = 0) -> bool:
+        """True when the partition blocks ``src -> dest``'s
+        ``index``-th message — a pure function of the plan and the
+        per-edge message index (no clocks, no shared state)."""
         if not self.partitions:
             return False
+        if self.partition_heal_index is not None \
+                and index >= self.partition_heal_index:
+            return False  # healed: cross-group traffic flows again
         src_groups = {
             i for i, g in enumerate(self.partitions) if src in g
         }
@@ -222,7 +237,12 @@ class FaultyCommunicationLayer(CommunicationLayer):
             self._inner.send_msg(src_agent, dest_agent, msg,
                                  on_error=on_error)
             return
-        if plan.is_partitioned(src_agent, dest_agent):
+        # One index per faultable message, consumed BEFORE the
+        # partition verdict: partition healing is keyed on this index
+        # (a pure function of the edge's send count), so partitioned
+        # messages must advance it too.
+        index = self._next_index(src_agent, dest_agent)
+        if plan.is_partitioned(src_agent, dest_agent, index):
             self.stats.bump("partitioned")
             _note_fault("partition", src_agent, dest_agent,
                         msg.msg.type)
@@ -231,8 +251,7 @@ class FaultyCommunicationLayer(CommunicationLayer):
                 src_agent, dest_agent, msg.msg.type,
             )
             return
-        rng = _edge_rng(plan.seed, src_agent, dest_agent,
-                        self._next_index(src_agent, dest_agent))
+        rng = _edge_rng(plan.seed, src_agent, dest_agent, index)
         if rng.random() < plan.drop:
             self.stats.bump("dropped")
             _note_fault("drop", src_agent, dest_agent, msg.msg.type)
@@ -299,13 +318,19 @@ class CrashSchedule:
         return iter(self.events)
 
 
-def kill_agent(orchestrator, agent_name: str) -> None:
+def kill_agent(orchestrator, agent_name: str,
+               report: bool = True) -> None:
     """Crash ``agent_name``: hard-stop its thread when it is reachable
     in this process (thread-mode runs expose ``local_agents``), then
     report the failure so the orchestrator's reparation path migrates
     the orphaned computations.  Process/remote agents cannot be stopped
     from here — for them this is purely the failure report (the real
-    process keeps running until its transport is cut externally)."""
+    process keeps running until its transport is cut externally).
+
+    ``report=False`` makes the crash SILENT: the thread dies but no
+    failure report is filed — the mode chaos runs use to prove that a
+    death is *detected* (heartbeat monitor, transport retry window)
+    rather than merely announced by its own injector."""
     agents = getattr(orchestrator, "local_agents", {}) or {}
     agent = agents.get(agent_name)
     if agent is not None:
@@ -318,7 +343,8 @@ def kill_agent(orchestrator, agent_name: str) -> None:
     ).inc(kind="kill")
     if tracer.enabled:
         tracer.instant("fault_kill", "fault", agent=agent_name)
-    orchestrator.report_agent_failure(agent_name)
+    if report:
+        orchestrator.report_agent_failure(agent_name)
 
 
 class FaultMonitor:

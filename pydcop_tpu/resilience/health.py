@@ -1,0 +1,437 @@
+"""Heartbeat failure detection: phi-accrual suspicion, bounded death.
+
+PR-1's failure detection is *passive*: an agent death is only noticed
+when a send to it fails (transport retry window) or when the fault
+monitor injected the kill and reported it itself.  A silently-wedged
+agent — thread crashed, process frozen, partitioned away — keeps its
+computations orphaned until some neighbor happens to message it.  This
+module makes detection *active*:
+
+- every agent hosts a tiny :class:`HeartbeatEmitter` service
+  computation that posts a :data:`HeartbeatMessage` to the
+  orchestrator every ``interval`` seconds **over the normal
+  CommunicationLayer** — heartbeats ride at value priority
+  (:data:`MSG_HEARTBEAT`), so injected drop/delay faults apply to them
+  exactly like algorithm traffic (a detector that only works on a
+  perfect network detects nothing);
+- the orchestrator's :class:`HealthMonitor` scores each agent's
+  heartbeat inter-arrival history with a phi-accrual-style estimator
+  (Hayashibara et al., "The phi accrual failure detector"): instead of
+  a binary alive/dead timeout it computes a *suspicion level* from the
+  observed arrival distribution, so a link that is lossy-but-alive
+  raises suspicion without triggering migration;
+- verdicts escalate ``alive -> suspect -> dead`` and de-escalate back
+  to ``alive`` on the next heartbeat.  ``suspect`` is advisory (trace
+  instant + counter + ``agent_suspect`` verdict).  ``dead`` is the
+  hard, *bounded* verdict — declared only after
+  ``dead_misses x expected-interval`` of silence — and feeds
+  ``orchestrator.report_agent_failure``, i.e. the exact same
+  replication/reparation path PR-1 wired for transport-detected
+  deaths.  Detection latency is therefore bounded by
+  ``dead_misses * interval + poll`` regardless of message traffic.
+
+Determinism note: verdict *timing* depends on wall-clock scheduling,
+but the guarantees the chaos soak asserts are schedule-free — a killed
+agent IS reported dead within the miss bound, and pure message-level
+faults (drop/dup/delay without a kill) are NEVER escalated past
+suspicion, because a live emitter keeps producing heartbeats and the
+drop probability of ``dead_misses`` consecutive beats vanishes.
+"""
+
+import logging
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from pydcop_tpu.infrastructure.communication import MSG_VALUE
+from pydcop_tpu.infrastructure.computations import (
+    MessagePassingComputation,
+    message_type,
+    register,
+)
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.trace import tracer
+
+logger = logging.getLogger("pydcop.resilience.health")
+
+# The orchestrator-side computation heartbeats are addressed to.
+HEALTH_COMP = "_health_orchestrator"
+
+# Heartbeats ride at VALUE priority on purpose: anything below
+# MSG_VALUE is protected management traffic the fault layer never
+# touches (FaultyCommunicationLayer.protect_management), and a failure
+# detector whose probes bypass the faulty network cannot distinguish a
+# lossy link from a healthy one.
+MSG_HEARTBEAT = MSG_VALUE
+
+HeartbeatMessage = message_type("heartbeat", ["agent", "seq"])
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Knobs of the heartbeat failure detector (docs/resilience.md).
+
+    ``interval`` — seconds between heartbeats (per agent);
+    ``suspect_misses`` — silence longer than this many expected
+    intervals (or phi above ``phi_suspect``) marks the agent suspect;
+    ``dead_misses`` — silence longer than this many expected intervals
+    is the death verdict: the HARD detection bound;
+    ``phi_suspect`` — phi-accrual suspicion threshold (phi = k means
+    the observed arrival history puts the no-heartbeat probability at
+    10^-k);
+    ``poll`` — monitor scan period;
+    ``window`` — inter-arrival samples kept per agent.
+    """
+
+    interval: float = 0.05
+    suspect_misses: float = 3.0
+    dead_misses: float = 8.0
+    phi_suspect: float = 2.0
+    poll: float = 0.02
+    window: int = 20
+
+    def __post_init__(self):
+        if self.interval <= 0:
+            raise ValueError(f"heartbeat interval must be > 0: "
+                             f"{self.interval}")
+        if not 0 < self.suspect_misses < self.dead_misses:
+            raise ValueError(
+                "need 0 < suspect_misses < dead_misses, got "
+                f"{self.suspect_misses} / {self.dead_misses}")
+
+
+class PhiAccrualEstimator:
+    """Suspicion level from one agent's heartbeat arrival history.
+
+    Keeps the last ``window`` inter-arrival intervals; :meth:`phi`
+    scores the current silence against their normal fit:
+    ``phi(t) = -log10(P[interval > t])``.  With too few samples the
+    configured ``expected`` interval stands in for the mean.  The
+    standard deviation is floored at 25% of the mean so a perfectly
+    regular history cannot make the detector hair-triggered: a gap
+    must be several expected intervals long before phi alone crosses
+    the suspicion threshold.
+    """
+
+    def __init__(self, expected: float, window: int = 20):
+        self.expected = expected
+        self._intervals: Deque[float] = deque(maxlen=window)
+        self.last_beat: Optional[float] = None
+        self.beats = 0
+
+    def beat(self, now: float):
+        if self.last_beat is not None:
+            # Clock hiccups (now <= last) contribute a zero interval.
+            self._intervals.append(max(now - self.last_beat, 0.0))
+        self.last_beat = now
+        self.beats += 1
+
+    def mean_interval(self) -> float:
+        if not self._intervals:
+            return self.expected
+        # Never trust an estimate below the configured cadence: a
+        # burst of queued heartbeats (delay fault released) would
+        # otherwise shrink the mean toward 0 and make phi
+        # hair-triggered on the next ordinary gap.
+        return max(sum(self._intervals) / len(self._intervals),
+                   self.expected)
+
+    def missed(self, now: float, anchor: float) -> float:
+        """Silence so far, in units of the CONFIGURED interval — not
+        the adaptive mean: the miss count backs the death verdict,
+        whose detection-latency bound (``dead_misses x interval``)
+        must hold regardless of what a faulty link did to the observed
+        arrival history (only phi, the advisory suspicion score,
+        adapts to it)."""
+        last = self.last_beat if self.last_beat is not None else anchor
+        return max(now - last, 0.0) / self.expected
+
+    def phi(self, now: float, anchor: float) -> float:
+        """-log10 of the probability that a live agent stays silent
+        this long, under a normal fit of the interval history."""
+        last = self.last_beat if self.last_beat is not None else anchor
+        elapsed = max(now - last, 0.0)
+        mean = self.mean_interval()
+        if len(self._intervals) >= 2:
+            var = sum((x - mean) ** 2 for x in self._intervals) \
+                / len(self._intervals)
+            std = math.sqrt(var)
+        else:
+            std = 0.0
+        std = max(std, 0.25 * mean, 1e-6)
+        # P[interval > elapsed] under N(mean, std).
+        z = (elapsed - mean) / std
+        p_longer = 0.5 * math.erfc(z / math.sqrt(2.0))
+        if p_longer <= 0.0:
+            return float("inf")
+        return -math.log10(p_longer)
+
+
+class HealthComputation(MessagePassingComputation):
+    """Orchestrator-side sink for heartbeat messages (``HEALTH_COMP``)."""
+
+    def __init__(self, monitor: "HealthMonitor"):
+        super().__init__(HEALTH_COMP)
+        self._monitor = monitor
+
+    @register("heartbeat")
+    def _on_heartbeat(self, sender, msg, t):
+        self._monitor.record(msg.agent, msg.seq)
+
+
+class HeartbeatEmitter(MessagePassingComputation):
+    """Agent-side service computation: one heartbeat every ``interval``
+    seconds, posted from the agent's own thread (its periodic-action
+    loop) — so a hard-stopped thread stops beating, which is exactly
+    the signal the monitor scores."""
+
+    def __init__(self, agent_name: str, interval: float):
+        super().__init__(f"_heartbeat_{agent_name}")
+        self._agent_name = agent_name
+        self._seq = 0
+        self.add_periodic_action(interval, self._beat)
+
+    def _beat(self):
+        self._seq += 1
+        try:
+            self.post_msg(
+                HEALTH_COMP,
+                HeartbeatMessage(self._agent_name, self._seq),
+                MSG_HEARTBEAT,
+            )
+        except Exception:
+            # A beat must never kill the agent thread; a missing beat
+            # is precisely what the monitor is designed to score.
+            self.logger.debug("heartbeat send failed", exc_info=True)
+
+
+class HealthMonitor:
+    """Scores heartbeat arrivals into alive/suspect/dead verdicts.
+
+    ``on_dead(agent)`` fires exactly once per agent on the death
+    verdict (default: nothing — the orchestrator wiring passes
+    ``report_agent_failure``, routing the death into the PR-1
+    replication/reparation path).  ``on_suspect(agent)`` is advisory.
+
+    Verdict changes are published as trace instants
+    (``agent_suspect`` / ``agent_dead`` / ``agent_recovered``) and
+    counted in ``pydcop_health_verdicts_total{verdict=...}``, so a
+    chaos run's detection story is reconstructable from its trace
+    alone.  :attr:`verdicts` keeps the in-process history for
+    harnesses (chaos soak) to assert against.
+    """
+
+    def __init__(self, config: Optional[HealthConfig] = None,
+                 on_dead: Optional[Callable[[str], None]] = None,
+                 on_suspect: Optional[Callable[[str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config or HealthConfig()
+        self.on_dead = on_dead
+        self.on_suspect = on_suspect
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._estimators: Dict[str, PhiAccrualEstimator] = {}
+        self._anchors: Dict[str, float] = {}
+        self._status: Dict[str, str] = {}
+        # Agents removed through the failure path: their in-flight
+        # (e.g. delay-faulted) heartbeats must not auto-watch them
+        # back into scoring — that silence would later surface as a
+        # spurious death verdict.
+        self._forgotten: set = set()
+        self.verdicts: List[Tuple[float, str, str]] = []
+        self.computation = HealthComputation(self)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m_verdicts = metrics_registry.counter(
+            "pydcop_health_verdicts_total",
+            "Health verdict transitions by the heartbeat monitor")
+        self._m_beats = metrics_registry.counter(
+            "pydcop_heartbeats_total",
+            "Heartbeats received by the health monitor")
+
+    # -- registration / input ------------------------------------------ #
+
+    def watch(self, agent: str):
+        """Start scoring ``agent``; the watch time anchors the silence
+        window until its first heartbeat arrives.  An explicit watch
+        clears a previous removal (an agent can come back under the
+        same name through a scenario event)."""
+        with self._lock:
+            self._forgotten.discard(agent)
+            if agent in self._estimators:
+                return
+            self._estimators[agent] = PhiAccrualEstimator(
+                self.config.interval, self.config.window)
+            self._anchors[agent] = self._clock()
+            self._status[agent] = ALIVE
+
+    def unwatch(self, agent: str):
+        """Forget ``agent`` without a verdict (clean shutdown path:
+        a stopped agent is not a dead agent)."""
+        with self._lock:
+            self._estimators.pop(agent, None)
+            self._anchors.pop(agent, None)
+            self._status.pop(agent, None)
+
+    def forget_removed(self, agent: str):
+        """An agent left through the failure path (scenario removal,
+        transport mark, injected kill).  Stop scoring it — a cleanly
+        removed agent must not later produce a spurious death verdict
+        — but keep the record when THIS monitor already declared it
+        dead (the verdict history is the detection evidence)."""
+        with self._lock:
+            self._forgotten.add(agent)
+            if self._status.get(agent) == DEAD:
+                return
+        self.unwatch(agent)
+
+    def record(self, agent: str, seq: int):
+        """One heartbeat arrived (any thread)."""
+        now = self._clock()
+        recovered = False
+        with self._lock:
+            if agent in self._forgotten:
+                # A straggler beat (delay fault) from an agent already
+                # removed through the failure path: scoring it again
+                # would end in a spurious death verdict.
+                return
+            est = self._estimators.get(agent)
+            if est is None:
+                # Auto-watch: an agent can beat before the runner's
+                # explicit watch() (scenario-added agents).
+                est = PhiAccrualEstimator(
+                    self.config.interval, self.config.window)
+                self._estimators[agent] = est
+                self._anchors[agent] = now
+                self._status[agent] = ALIVE
+            est.beat(now)
+            # A heartbeat clears suspicion; death is final (the
+            # reparation path already migrated the computations — a
+            # zombie beat must not resurrect the agent here).
+            if self._status.get(agent) == SUSPECT:
+                self._status[agent] = ALIVE
+                recovered = True
+        self._m_beats.inc()
+        if recovered:
+            self._note_verdict(now, agent, ALIVE, "agent_recovered")
+
+    # -- verdicts ------------------------------------------------------- #
+
+    def _note_verdict(self, now: float, agent: str, status: str,
+                      instant: str):
+        with self._lock:
+            self.verdicts.append((now, agent, status))
+        self._m_verdicts.inc(verdict=status)
+        if tracer.enabled:
+            tracer.instant(instant, "health", agent=agent)
+        logger.log(
+            logging.WARNING if status == DEAD else logging.INFO,
+            "Health verdict: agent %s is %s", agent, status,
+        )
+
+    def scan(self) -> Dict[str, str]:
+        """One scoring pass over every watched agent; returns the
+        post-scan status map.  Called by the monitor thread each
+        ``poll``; exposed for deterministic fake-clock tests."""
+        now = self._clock()
+        cfg = self.config
+        suspects: List[str] = []
+        deaths: List[str] = []
+        with self._lock:
+            for agent, est in self._estimators.items():
+                status = self._status[agent]
+                if status == DEAD:
+                    continue
+                anchor = self._anchors[agent]
+                missed = est.missed(now, anchor)
+                if missed >= cfg.dead_misses:
+                    self._status[agent] = DEAD
+                    deaths.append(agent)
+                elif status == ALIVE and (
+                        missed >= cfg.suspect_misses
+                        or est.phi(now, anchor) >= cfg.phi_suspect):
+                    self._status[agent] = SUSPECT
+                    suspects.append(agent)
+            statuses = dict(self._status)
+        for agent in suspects:
+            self._note_verdict(now, agent, SUSPECT, "agent_suspect")
+            if self.on_suspect is not None:
+                try:
+                    self.on_suspect(agent)
+                except Exception:
+                    logger.exception("on_suspect(%s) failed", agent)
+        for agent in deaths:
+            self._note_verdict(now, agent, DEAD, "agent_dead")
+            if self.on_dead is not None:
+                try:
+                    self.on_dead(agent)
+                except Exception:
+                    logger.exception("on_dead(%s) failed", agent)
+        return statuses
+
+    def statuses(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._status)
+
+    def dead_agents(self) -> List[str]:
+        return sorted(
+            a for a, s in self.statuses().items() if s == DEAD)
+
+    def summary(self) -> Dict[str, object]:
+        """Result-dict payload: final statuses + verdict history."""
+        statuses = self.statuses()
+        return {
+            "statuses": statuses,
+            "dead": sorted(a for a, s in statuses.items()
+                           if s == DEAD),
+            "verdicts": [
+                {"t": t, "agent": a, "status": s}
+                for t, a, s in list(self.verdicts)
+            ],
+        }
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def start(self) -> "HealthMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="health_monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.scan()
+            except Exception:
+                logger.exception("Health scan failed")
+            self._stop.wait(self.config.poll)
+
+
+def attach_health(orchestrator, config: HealthConfig) -> HealthMonitor:
+    """Build a monitor wired to ``orchestrator``: heartbeats land on
+    its agent, a death verdict runs ``report_agent_failure`` (the same
+    entry every other detector uses, so verdict handling is latched
+    and race-safe there).  The runner is responsible for watching
+    agents and installing emitters (infrastructure/run.py)."""
+    monitor = HealthMonitor(
+        config, on_dead=orchestrator.report_agent_failure)
+    orchestrator._agent.add_computation(monitor.computation)
+    monitor.computation.start()
+    orchestrator.health_monitor = monitor
+    return monitor

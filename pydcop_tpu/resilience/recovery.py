@@ -1,0 +1,356 @@
+"""Rollback-and-recover for guarded engine segments.
+
+A NaN/Inf inside the jitted solve loop — a poisoned cost table, an
+overflow in a long bfloat16 run, a flipped bit on flaky hardware —
+silently corrupts every later cycle: the solve "finishes" with a
+garbage assignment and nothing ever noticed.  ``run_checkpointed``
+already pauses at every K-cycle segment boundary (the host is syncing
+the cycle counter there anyway), so that boundary becomes a *guard*:
+a device-side validation (NaN/Inf scan over the state pytree + an
+optional cost-divergence window) whose verdict travels back in the
+same host fetch — zero extra syncs inside the jitted loop.
+
+On a tripped guard the :class:`RecoveryPolicy` rolls the solve back to
+the last *validated* in-memory snapshot (bit-identical restore,
+assertable) and re-runs the segment with **escalating intervention**:
+
+1. reseeded tie-break noise on the message arrays — the same lever
+   decimation-style MaxSum interventions use to leave a bad basin
+   (Improving Max-Sum through Decimation, arXiv:1706.02209): a tiny
+   deterministic perturbation re-orders argmin ties and the re-run
+   walks a different trajectory;
+2. a damping bump — heavier smoothing suppresses the oscillation that
+   diverged (the engine's segment jit re-keys on damping, so the bump
+   compiles a fresh program rather than silently reusing the old one);
+3. both, with a fresh noise seed, until the restart budget
+   (``max_restarts``) is spent — then :class:`RecoveryExhausted`
+   aborts the solve *carrying the partial trajectory* (last valid
+   assignment + cycle), so the caller still gets the best known state
+   instead of garbage.
+
+Every trip and every attempt is a trace instant/span and a registry
+counter, so a recovered run is reconstructable from its trace file
+(PR-2 observability).  With no guard trips the guarded path is
+bit-identical to the unguarded one — guards only *read* state (tier-1
+asserted).
+"""
+
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.trace import tracer
+
+logger = logging.getLogger("pydcop.resilience.recovery")
+
+
+class GuardViolation(NamedTuple):
+    """One tripped segment guard."""
+
+    kind: str      # "nonfinite" | "divergence" | "injected"
+    cycle: int     # end cycle of the segment that tripped
+    detail: str
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "cycle": int(self.cycle),
+                "detail": self.detail}
+
+
+class RecoveryExhausted(RuntimeError):
+    """The restart budget is spent: the solve cannot self-heal.
+
+    Carries the partial trajectory — ``partial`` holds the last
+    VALIDATED assignment/cycle (``assignment`` may be None when the
+    guard tripped before any segment validated) — plus the full
+    violation history and attempt count, so callers can surface a
+    best-effort answer and a diagnosis instead of a bare stack trace.
+    """
+
+    def __init__(self, message: str, *,
+                 violations: List[GuardViolation],
+                 attempts: int,
+                 partial: Dict[str, Any]):
+        super().__init__(message)
+        self.violations = list(violations)
+        self.attempts = attempts
+        self.partial = dict(partial)
+
+
+@dataclass
+class RecoveryPolicy:
+    """Guard thresholds + the escalation ladder of ``run_checkpointed``
+    (docs/resilience.md "Failure detection & recovery").
+
+    The NaN/Inf guard is always on.  The cost-divergence guard is
+    opt-in (``divergence_window > 0``): it trips when every cost in
+    the last ``divergence_window`` segment boundaries exceeds
+    ``divergence_factor * |best cost seen| + divergence_slack`` — set
+    ``divergence_slack`` for problems whose optimum cost is 0.
+
+    ``trip_cycles`` injects guard trips (chaos soak / tests): the
+    first segment ending at-or-past each listed cycle trips once with
+    kind ``"injected"``.
+
+    ``verify_restore`` (default True) asserts every rollback restored
+    the snapshot bit-identically before intervening — a host fetch of
+    the state, paid only on the (rare) rollback path.
+    """
+
+    max_restarts: int = 3
+    noise_scale: float = 1e-3
+    noise_seed: int = 0
+    damping_bump: float = 0.2
+    damping_cap: float = 0.95
+    divergence_window: int = 0
+    divergence_factor: float = 3.0
+    divergence_slack: float = 0.0
+    trip_cycles: Tuple[int, ...] = field(default_factory=tuple)
+    verify_restore: bool = True
+
+    def __post_init__(self):
+        if self.max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0: {self.max_restarts}")
+        if self.noise_scale < 0:
+            raise ValueError(
+                f"noise_scale must be >= 0: {self.noise_scale}")
+
+    def action_for(self, attempt: int) -> str:
+        """The escalation ladder: attempt 1 reseeds tie-break noise,
+        attempt 2 bumps damping, later attempts do both with a fresh
+        seed."""
+        if attempt <= 1:
+            return "reseed_noise"
+        if attempt == 2:
+            return "damping_bump"
+        return "reseed_noise+damping_bump"
+
+
+def perturb_state(state, scale: float, seed: int):
+    """Deterministic tie-break noise: add uniform(-scale, +scale)
+    noise (seeded jax PRNG, folded per leaf) to every floating-point
+    leaf of the state pytree, and clear a ``stable`` flag when the
+    state carries one (the perturbed messages must re-converge, not
+    inherit the snapshot's convergence verdict).  Same (seed, scale,
+    structure) -> same perturbation — recovery stays replayable."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(seed)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if jnp.issubdtype(leaf.dtype, jnp.inexact) and leaf.ndim >= 1:
+            noise = jax.random.uniform(
+                jax.random.fold_in(key, i), leaf.shape,
+                dtype=leaf.dtype, minval=-scale, maxval=scale,
+            )
+            out.append(leaf + noise)
+        else:
+            out.append(leaf)
+    perturbed = jax.tree_util.tree_unflatten(treedef, out)
+    if hasattr(perturbed, "_replace") and hasattr(perturbed, "stable"):
+        perturbed = perturbed._replace(stable=jnp.asarray(False))
+    return perturbed
+
+
+def _assert_bit_identical(restored, snapshot):
+    """The rollback contract: the restored state IS the snapshot, byte
+    for byte.  A mismatch means donation aliasing or a buggy copy —
+    corrupting the recovery path itself — so fail loudly."""
+    import jax
+
+    r_leaves = jax.tree_util.tree_leaves(restored)
+    s_leaves = jax.tree_util.tree_leaves(snapshot)
+    assert len(r_leaves) == len(s_leaves)
+    for i, (r, s) in enumerate(zip(
+            jax.device_get(r_leaves), jax.device_get(s_leaves))):
+        r, s = np.asarray(r), np.asarray(s)
+        if r.tobytes() != s.tobytes():
+            raise AssertionError(
+                f"rollback restore not bit-identical at leaf {i} "
+                f"(dtype {r.dtype}, shape {r.shape})"
+            )
+
+
+class RecoveryRun:
+    """Mutable guard/recovery state for ONE ``run_checkpointed`` call.
+
+    The engine owns the loop; this object owns the verdicts: `check`
+    scores each segment's guard outputs, `retain` snapshots a
+    validated state (a device-side copy when the engine donates
+    buffers), `rollback` restores + intervenes or raises
+    :class:`RecoveryExhausted` once the budget is spent.
+    """
+
+    def __init__(self, policy: RecoveryPolicy, engine):
+        self.policy = policy
+        self.engine = engine
+        self.attempts = 0
+        self.trips: List[GuardViolation] = []
+        self.actions: List[str] = []
+        self.best_cost: Optional[float] = None
+        self._window = deque(
+            maxlen=max(policy.divergence_window, 1))
+        # Kept sorted, duplicates preserved: (c, c, c) arms three
+        # consecutive trips at cycle c — how tests force a run through
+        # the whole escalation ladder into RecoveryExhausted.
+        self._pending_injections = sorted(policy.trip_cycles)
+        self._snap_state = None
+        self._snap_values = None
+        self._m_trips = metrics_registry.counter(
+            "pydcop_guard_trips_total",
+            "Engine segment guard trips")
+        self._m_attempts = metrics_registry.counter(
+            "pydcop_recovery_attempts_total",
+            "Recovery rollback attempts by escalation action")
+
+    # -- snapshots ------------------------------------------------------ #
+
+    def retain(self, state, values) -> None:
+        """Snapshot a VALIDATED state as the rollback target.  With
+        buffer donation the next segment consumes ``state``'s buffers,
+        so the snapshot is a device-side copy (an on-device program —
+        it overlaps, no host sync); without donation the reference
+        stays valid as-is."""
+        import jax
+        import jax.numpy as jnp
+
+        self._snap_state = (
+            jax.tree_util.tree_map(jnp.copy, state)
+            if self.engine.donate else state
+        )
+        self._snap_values = values
+
+    @property
+    def snapshot_state(self):
+        """The retained (donation-safe) copy of the last validated
+        state.  Read-only sharing is safe: rollback copies OUT of it,
+        so a checkpoint writer fetching from the same buffers never
+        races a mutation — run_checkpointed reuses it instead of
+        making a second per-segment device copy."""
+        return self._snap_state
+
+    @property
+    def snapshot_cycle(self) -> Optional[int]:
+        if self._snap_state is None:
+            return None
+        return int(self._snap_state.cycle)
+
+    # -- guard verdicts ------------------------------------------------- #
+
+    def check(self, end_cycle: int, finite: bool,
+              cost: float) -> Optional[GuardViolation]:
+        """Score one segment's guard outputs; None means valid."""
+        if self._pending_injections \
+                and end_cycle >= self._pending_injections[0]:
+            at = self._pending_injections.pop(0)
+            return GuardViolation(
+                "injected", end_cycle, f"injected trip armed at "
+                f"cycle {at}")
+        if not finite:
+            return GuardViolation(
+                "nonfinite", end_cycle, "NaN/Inf in solver state")
+        if self.policy.divergence_window > 0:
+            if self.best_cost is None or cost < self.best_cost:
+                self.best_cost = cost
+            self._window.append(cost)
+            threshold = (
+                self.policy.divergence_factor * abs(self.best_cost)
+                + self.policy.divergence_slack
+            )
+            if len(self._window) == self._window.maxlen \
+                    and min(self._window) > threshold:
+                return GuardViolation(
+                    "divergence", end_cycle,
+                    f"cost window min {min(self._window):.6g} > "
+                    f"threshold {threshold:.6g} "
+                    f"(best {self.best_cost:.6g})")
+        return None
+
+    # -- rollback + escalation ----------------------------------------- #
+
+    def rollback(self, violation: GuardViolation):
+        """Restore the last valid snapshot and intervene; returns the
+        (state, values) to continue from.  Raises RecoveryExhausted
+        past the restart budget."""
+        import jax
+        import jax.numpy as jnp
+
+        self.trips.append(violation)
+        self._m_trips.inc(kind=violation.kind)
+        if tracer.enabled:
+            tracer.instant("guard_trip", "resilience",
+                           kind=violation.kind,
+                           cycle=int(violation.cycle),
+                           detail=violation.detail)
+        self.attempts += 1
+        if self.attempts > self.policy.max_restarts:
+            partial: Dict[str, Any] = {
+                "assignment": None,
+                "cycle": self.snapshot_cycle,
+                "converged": False,
+            }
+            if self._snap_values is not None:
+                partial["assignment"] = (
+                    self.engine.meta.assignment_from_indices(
+                        np.asarray(jax.device_get(self._snap_values)))
+                )
+            raise RecoveryExhausted(
+                f"recovery budget exhausted after "
+                f"{self.policy.max_restarts} restarts; last trip: "
+                f"{violation.kind} at cycle {violation.cycle}",
+                violations=self.trips, attempts=self.attempts,
+                partial=partial,
+            )
+        action = self.policy.action_for(self.attempts)
+        self.actions.append(action)
+        self._m_attempts.inc(action=action)
+        logger.warning(
+            "Guard trip (%s at cycle %d): rollback to cycle %s, "
+            "attempt %d/%d, action=%s",
+            violation.kind, violation.cycle, self.snapshot_cycle,
+            self.attempts, self.policy.max_restarts, action,
+        )
+        with tracer.span("recovery_rollback", "resilience",
+                         attempt=self.attempts, action=action,
+                         kind=violation.kind,
+                         to_cycle=self.snapshot_cycle):
+            # Copy out of the snapshot — the continuing loop will
+            # donate (or perturb) what we return, and a LATER trip
+            # must be able to roll back to this same snapshot again.
+            restored = jax.tree_util.tree_map(
+                jnp.copy, self._snap_state)
+            if self.policy.verify_restore:
+                _assert_bit_identical(restored, self._snap_state)
+            if "reseed_noise" in action and self.policy.noise_scale:
+                restored = perturb_state(
+                    restored, self.policy.noise_scale,
+                    self.policy.noise_seed + self.attempts,
+                )
+            if "damping_bump" in action:
+                engine = self.engine
+                bumped = min(
+                    engine.damping + self.policy.damping_bump,
+                    self.policy.damping_cap,
+                )
+                logger.warning(
+                    "Recovery damping bump: %.3f -> %.3f",
+                    engine.damping, bumped)
+                engine.damping = bumped
+        # The diverged branch's costs must not poison the next
+        # window's verdict.
+        self._window.clear()
+        return restored, self._snap_values
+
+    def metrics(self) -> Dict[str, Any]:
+        return {
+            "guard_trips": len(self.trips),
+            "recovery_attempts": self.attempts,
+            "recovery_actions": list(self.actions),
+            "guard_violations": [v.as_dict() for v in self.trips],
+        }

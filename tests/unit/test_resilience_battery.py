@@ -325,6 +325,35 @@ class TestFaultyLayer:
         with pytest.raises(ValueError):
             CrashEvent.parse("30")
 
+    def test_silent_kill_stops_thread_without_report(self):
+        """kill_agent(report=False): the victim's thread is stopped
+        but NO failure report is filed — the mode health-monitored
+        chaos runs use, so a death must be *detected*, not announced
+        by its own injector (see test_selfheal_battery)."""
+        from pydcop_tpu.resilience.faults import kill_agent
+
+        class FakeAgent:
+            stopped = False
+
+            def stop(self):
+                self.stopped = True
+
+        class FakeOrchestrator:
+            def __init__(self):
+                self.local_agents = {"a1": FakeAgent()}
+                self.reports = []
+
+            def report_agent_failure(self, agent):
+                self.reports.append(agent)
+
+        orch = FakeOrchestrator()
+        kill_agent(orch, "a1", report=False)
+        assert orch.local_agents["a1"].stopped
+        assert orch.reports == []
+        orch2 = FakeOrchestrator()
+        kill_agent(orch2, "a1")  # default: report as before
+        assert orch2.reports == ["a1"]
+
 
 # ------------------------------------------------------------------ #
 # Checkpoint / resume

@@ -1,0 +1,871 @@
+"""Self-healing battery: failure detection, guarded recovery,
+checkpoint integrity, partition healing (ISSUE 4 / docs/resilience.md
+"Failure detection & recovery").
+
+- Heartbeat health subsystem: phi-accrual estimator + HealthMonitor
+  verdict transitions under a FAKE clock (deterministic bounds: dead
+  exactly within ``dead_misses`` intervals, suspicion before that,
+  recovery on the next beat), plus end-to-end thread runs (a silent
+  kill detected by heartbeats and repaired; pure delay never escalates
+  past suspicion);
+- guarded engine segments: no-trip runs byte-identical to unguarded
+  (checkpoint checksums compared), injected trips rolled back
+  bit-identically with the escalation ladder (noise -> damping bump ->
+  RecoveryExhausted carrying the partial trajectory), all of it
+  visible in the exported trace;
+- checkpoint integrity: content checksums catch silent corruption,
+  truncation falls back to the newest VALID snapshot, retention keeps
+  exactly N;
+- AsyncCheckpointWriter atexit regression: a failed flush at
+  interpreter shutdown is logged, not raised (explicit flush still
+  raises);
+- partition healing: cross-group traffic resumes at the heal index, a
+  pure function of (seed, edge, index);
+- multihost coordinator loss: a failed global-mesh participant
+  surfaces a clean error, latches nothing, and global_mesh refuses to
+  build a wrong single-host mesh.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import constraint_from_str
+from pydcop_tpu.distribution.objects import Distribution
+from pydcop_tpu.infrastructure.communication import (
+    MSG_ALGO,
+    CommunicationLayer,
+    ComputationMessage,
+)
+from pydcop_tpu.infrastructure.computations import Message
+from pydcop_tpu.resilience.checkpoint import (
+    AsyncCheckpointWriter,
+    CheckpointCorruptError,
+    CheckpointManager,
+    load_state,
+    read_meta,
+    resume_from_checkpoint,
+    verify_checkpoint,
+)
+from pydcop_tpu.resilience.faults import FaultPlan, FaultyCommunicationLayer
+from pydcop_tpu.resilience.health import (
+    ALIVE,
+    DEAD,
+    SUSPECT,
+    HealthConfig,
+    HealthMonitor,
+    PhiAccrualEstimator,
+)
+from pydcop_tpu.resilience.recovery import (
+    GuardViolation,
+    RecoveryExhausted,
+    RecoveryPolicy,
+    RecoveryRun,
+    perturb_state,
+)
+
+CHAOS_SEED = int(os.environ.get("PYDCOP_CHAOS_SEED", "42"))
+
+
+# ------------------------------------------------------------------ #
+# fixtures
+
+
+def _ring_dcop(n_vars=6):
+    d = Domain("c", "", list(range(3)))
+    dcop = DCOP("selfheal", objective="min")
+    variables = [Variable(f"v{i}", d) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    edges = [(i, (i + 1) % n_vars) for i in range(n_vars)] + [(0, 3)]
+    for i, j in edges:
+        dcop.add_constraint(constraint_from_str(
+            f"c{i}_{j}", f"10 if v{i} == v{j} else 0",
+            [variables[i], variables[j]],
+        ))
+    return dcop
+
+
+def _coloring_dcop(n_agents=5, n_vars=4):
+    d = Domain("colors", "", ["R", "G", "B"])
+    dcop = DCOP("chaos", objective="min")
+    variables = [Variable(f"v{i}", d) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    for i in range(n_vars - 1):
+        dcop.add_constraint(constraint_from_str(
+            f"diff_{i}_{i + 1}",
+            f"10 if v{i} == v{i + 1} else 0",
+            [variables[i], variables[i + 1]],
+        ))
+    dcop.add_agents([
+        AgentDef(f"a{i}", capacity=100, default_hosting_cost=i)
+        for i in range(n_agents)
+    ])
+    return dcop
+
+
+def _engine():
+    from pydcop_tpu.algorithms.maxsum import build_engine
+
+    return build_engine(_ring_dcop(), {})
+
+
+def _msg(prio=MSG_ALGO, content="x"):
+    return ComputationMessage(
+        "c_src", "c_dst", Message("test", content), prio)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------------ #
+# phi-accrual estimator
+
+
+class TestPhiAccrual:
+    def test_regular_beats_keep_phi_low(self):
+        est = PhiAccrualEstimator(expected=0.1)
+        t = 0.0
+        for _ in range(10):
+            est.beat(t)
+            t += 0.1
+        # Right on schedule: low suspicion.
+        assert est.phi(t, anchor=0.0) < 1.0
+        assert est.missed(t, anchor=0.0) == pytest.approx(1.0)
+
+    def test_phi_grows_with_silence(self):
+        est = PhiAccrualEstimator(expected=0.1)
+        t = 0.0
+        for _ in range(10):
+            est.beat(t)
+            t += 0.1
+        last = t - 0.1
+        phis = [est.phi(last + dt, anchor=0.0)
+                for dt in (0.1, 0.3, 0.6, 1.0)]
+        assert phis == sorted(phis)
+        assert phis[-1] > 5.0
+
+    def test_no_samples_uses_expected_interval(self):
+        est = PhiAccrualEstimator(expected=0.5)
+        # Never beat: missed counts from the anchor.
+        assert est.missed(101.0, anchor=100.0) == pytest.approx(2.0)
+
+    def test_mean_never_shrinks_below_expected(self):
+        est = PhiAccrualEstimator(expected=0.1)
+        # A burst of queued beats (delay fault released) lands at
+        # near-zero intervals — the estimator must not hair-trigger.
+        for t in (0.0, 0.001, 0.002, 0.003):
+            est.beat(t)
+        assert est.mean_interval() >= 0.1
+
+    def test_missed_uses_configured_interval_not_adaptive_mean(self):
+        """The death bound is HARD: a faulty link stretching the
+        observed arrival mean must not stretch the miss count with it
+        (only phi, the advisory score, adapts)."""
+        est = PhiAccrualEstimator(expected=0.1)
+        t = 0.0
+        for _ in range(10):  # arrivals at 5x the cadence
+            est.beat(t)
+            t += 0.5
+        assert est.mean_interval() == pytest.approx(0.5)
+        last = t - 0.5
+        # 0.8 s of silence = 8 configured intervals, NOT 1.6 observed.
+        assert est.missed(last + 0.8, anchor=0.0) == pytest.approx(8.0)
+
+
+# ------------------------------------------------------------------ #
+# health monitor verdicts (deterministic fake clock)
+
+
+class TestHealthMonitor:
+    def _monitor(self, **kwargs):
+        clock = FakeClock()
+        config = HealthConfig(interval=0.1, suspect_misses=3,
+                              dead_misses=8, **kwargs)
+        deaths, suspects = [], []
+        monitor = HealthMonitor(
+            config, on_dead=deaths.append, on_suspect=suspects.append,
+            clock=clock,
+        )
+        return monitor, clock, deaths, suspects
+
+    def _beat_regularly(self, monitor, clock, agent, n=10, dt=0.1):
+        for _ in range(n):
+            clock.advance(dt)
+            monitor.record(agent, 0)
+
+    def test_alive_while_beating(self):
+        monitor, clock, deaths, _ = self._monitor()
+        monitor.watch("a1")
+        self._beat_regularly(monitor, clock, "a1")
+        assert monitor.scan()["a1"] == ALIVE
+        assert deaths == []
+
+    def test_silence_escalates_suspect_then_dead_within_bound(self):
+        """THE detection bound: suspect after suspect_misses expected
+        intervals, dead after dead_misses — never before, always by
+        then."""
+        monitor, clock, deaths, suspects = self._monitor()
+        monitor.watch("a1")
+        self._beat_regularly(monitor, clock, "a1")
+        clock.advance(0.15)  # 1.5 intervals: still alive
+        assert monitor.scan()["a1"] == ALIVE
+        clock.advance(0.2)   # 3.5 intervals: suspect, not dead
+        assert monitor.scan()["a1"] == SUSPECT
+        assert suspects == ["a1"] and deaths == []
+        clock.advance(0.4)   # 7.5 intervals: still only suspect
+        assert monitor.scan()["a1"] == SUSPECT
+        clock.advance(0.1)   # 8.5 intervals: past the dead bound
+        assert monitor.scan()["a1"] == DEAD
+        assert deaths == ["a1"]
+        # Death fires once, even across further scans.
+        monitor.scan()
+        assert deaths == ["a1"]
+
+    def test_heartbeat_recovers_suspect(self):
+        monitor, clock, deaths, _ = self._monitor()
+        monitor.watch("a1")
+        self._beat_regularly(monitor, clock, "a1")
+        clock.advance(0.35)
+        assert monitor.scan()["a1"] == SUSPECT
+        monitor.record("a1", 99)  # the link was lossy, not dead
+        assert monitor.statuses()["a1"] == ALIVE
+        statuses = [s for _, a, s in monitor.verdicts if a == "a1"]
+        assert statuses == [SUSPECT, ALIVE]
+        assert deaths == []
+
+    def test_dead_is_final_despite_zombie_beat(self):
+        monitor, clock, deaths, _ = self._monitor()
+        monitor.watch("a1")
+        clock.advance(10.0)
+        assert monitor.scan()["a1"] == DEAD
+        monitor.record("a1", 1)  # a delayed beat from the corpse
+        assert monitor.statuses()["a1"] == DEAD
+        assert deaths == ["a1"]
+
+    def test_never_beaten_agent_dies_from_watch_anchor(self):
+        monitor, clock, deaths, _ = self._monitor()
+        monitor.watch("a1")
+        clock.advance(0.79)  # 7.9 intervals from the watch anchor
+        assert monitor.scan()["a1"] == SUSPECT
+        clock.advance(0.02)
+        assert monitor.scan()["a1"] == DEAD
+        assert deaths == ["a1"]
+
+    def test_forget_removed_keeps_dead_record_drops_live(self):
+        monitor, clock, _, _ = self._monitor()
+        monitor.watch("a1")
+        monitor.watch("a2")
+        clock.advance(10.0)
+        monitor.scan()  # both dead
+        monitor.forget_removed("a1")  # dead: record kept
+        assert monitor.statuses()["a1"] == DEAD
+        monitor.watch("a3")
+        monitor.forget_removed("a3")  # live: dropped, no verdict
+        assert "a3" not in monitor.statuses()
+
+    def test_straggler_beat_cannot_resurrect_forgotten_agent(self):
+        """A delay-faulted heartbeat arriving AFTER the agent was
+        removed through the failure path must not auto-watch it back
+        into scoring — the ensuing silence would read as a spurious
+        death verdict, breaking the verdicts==kills soak invariant."""
+        monitor, clock, deaths, _ = self._monitor()
+        monitor.watch("a1")
+        monitor.forget_removed("a1")  # transport marked it dead first
+        monitor.record("a1", 7)       # straggler from the corpse
+        clock.advance(10.0)
+        assert "a1" not in monitor.scan()
+        assert deaths == []
+        # An explicit re-watch (scenario re-adds the name) clears the
+        # removal and scoring resumes.
+        monitor.watch("a1")
+        clock.advance(10.0)
+        assert monitor.scan()["a1"] == DEAD
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            HealthConfig(interval=0)
+        with pytest.raises(ValueError):
+            HealthConfig(suspect_misses=8, dead_misses=3)
+
+    def test_summary_shape(self):
+        monitor, clock, _, _ = self._monitor()
+        monitor.watch("a1")
+        clock.advance(10.0)
+        monitor.scan()
+        summary = monitor.summary()
+        assert summary["dead"] == ["a1"]
+        assert summary["statuses"]["a1"] == DEAD
+        assert summary["verdicts"][0]["agent"] == "a1"
+
+
+# ------------------------------------------------------------------ #
+# health end-to-end (thread runtime)
+
+
+class TestHealthEndToEnd:
+    DIST = Distribution({
+        "a0": ["v0"], "a1": ["v1"], "a2": ["v2"], "a3": ["v3"],
+        "a4": [],
+    })
+
+    def test_silent_kill_detected_and_repaired(self):
+        """A silently-murdered agent (no failure report from the
+        injector) is detected by heartbeats alone; its computation
+        migrates and the solve completes at the fault-free cost."""
+        from pydcop_tpu.infrastructure.run import solve_with_agents
+        from pydcop_tpu.resilience.faults import CrashEvent
+
+        algo = AlgorithmDef.build_with_default_param(
+            "adsa", {"stop_cycle": 40, "period": 0.05}, mode="min")
+        plan = FaultPlan(seed=CHAOS_SEED,
+                         crashes=(CrashEvent("a1", 5),), replicas=2)
+        res = solve_with_agents(
+            _coloring_dcop(), algo, distribution=self.DIST,
+            timeout=45, fault_plan=plan,
+            health_config=HealthConfig(),
+        )
+        assert res["killed_agents"] == ["a1"]
+        assert res["health"]["dead"] == ["a1"]
+        assert res["status"] == "FINISHED"
+        assert res["cost"] == 0
+        assert set(res["assignment"]) == {"v0", "v1", "v2", "v3"}
+
+    def test_lossy_link_never_escalates_past_suspicion(self):
+        """Drop + delay with NO kill: zero agent_dead verdicts —
+        suspicion is allowed (that is the phi detector working)."""
+        from pydcop_tpu.infrastructure.run import solve_with_agents
+
+        algo = AlgorithmDef.build_with_default_param(
+            "adsa", {"stop_cycle": 20, "period": 0.05}, mode="min")
+        plan = FaultPlan(seed=CHAOS_SEED, drop=0.10, delay=0.10,
+                         delay_time=0.03)
+        res = solve_with_agents(
+            _coloring_dcop(), algo, distribution=self.DIST,
+            timeout=20, fault_plan=plan,
+            health_config=HealthConfig(),
+        )
+        assert res["health"]["dead"] == []
+        assert res["cost"] == 0
+
+    def test_health_rejects_process_mode(self):
+        from pydcop_tpu.infrastructure.run import solve_with_agents
+
+        with pytest.raises(ValueError, match="thread"):
+            solve_with_agents(
+                _coloring_dcop(), "dsa", distribution=self.DIST,
+                mode="process", health_config=HealthConfig(),
+            )
+
+
+# ------------------------------------------------------------------ #
+# guarded engine segments
+
+
+class TestGuardedSegments:
+    def test_no_trip_bit_identical_to_unguarded(self, tmp_path):
+        """Guards are pure reads: with nothing injected, the guarded
+        run's final snapshot is BYTE-identical to the unguarded one
+        (content checksums compared), and assignment/cycles match."""
+        ref_mgr = CheckpointManager(str(tmp_path / "ref"), every=7)
+        ref = _engine().run_checkpointed(
+            max_cycles=100, manager=ref_mgr, checkpoint_async=False)
+        guard_mgr = CheckpointManager(str(tmp_path / "g"), every=7)
+        res = _engine().run_checkpointed(
+            max_cycles=100, manager=guard_mgr, checkpoint_async=False,
+            recovery=RecoveryPolicy())
+        assert res.metrics["guard_trips"] == 0
+        assert res.assignment == ref.assignment
+        assert res.cycles == ref.cycles
+        assert res.converged == ref.converged
+        ref_meta = read_meta(ref_mgr.latest())
+        g_meta = read_meta(guard_mgr.latest())
+        assert ref_meta["cycle"] == g_meta["cycle"]
+        assert ref_meta["checksum"] == g_meta["checksum"]
+
+    def test_injected_trip_recovers_and_traces(self, tmp_path):
+        """Guard-trip injection at cycle c: rollback restores the last
+        snapshot bit-identically (verify_restore asserts in-line), the
+        attempt counter lands in result metrics, and guard_trip +
+        recovery_rollback events appear in the exported trace."""
+        from pydcop_tpu.observability.trace import (
+            load_trace_file,
+            tracer,
+        )
+
+        trace_path = str(tmp_path / "trip.trace.json")
+        tracer.enable()
+        try:
+            res = _engine().run_checkpointed(
+                max_cycles=120, segment_cycles=7,
+                recovery=RecoveryPolicy(trip_cycles=(14,),
+                                        verify_restore=True),
+            )
+        finally:
+            tracer.disable()
+            tracer.export(trace_path, "chrome")
+        assert res.metrics["guard_trips"] == 1
+        assert res.metrics["recovery_attempts"] == 1
+        assert res.metrics["recovery_actions"] == ["reseed_noise"]
+        assert res.metrics["guard_violations"][0]["kind"] == "injected"
+        assert res.converged
+        names = [e["name"] for e in load_trace_file(trace_path)]
+        assert "guard_trip" in names
+        assert "recovery_rollback" in names
+
+    def test_escalation_ladder_order_and_damping_bump(self):
+        """Attempt 1 reseeds noise, attempt 2 bumps damping (and the
+        bumped segment program is a fresh compile, not a stale
+        cache hit — the run would diverge from the damping change
+        otherwise)."""
+        engine = _engine()
+        base_damping = engine.damping
+        res = engine.run_checkpointed(
+            max_cycles=200, segment_cycles=7,
+            recovery=RecoveryPolicy(trip_cycles=(7, 7),
+                                    max_restarts=3),
+        )
+        assert res.metrics["recovery_actions"] == [
+            "reseed_noise", "damping_bump"]
+        assert engine.damping == pytest.approx(base_damping + 0.2)
+        assert res.converged
+
+    def test_budget_exhaustion_carries_partial(self):
+        engine = _engine()
+        with pytest.raises(RecoveryExhausted) as exc:
+            # stop_on_convergence=False pins segment ends to 7, 14,
+            # 21... so the repeated cycle-14 injection re-fires on
+            # every re-run until the budget is spent (a converging
+            # segment could otherwise stop short of the trip cycle).
+            engine.run_checkpointed(
+                max_cycles=200, segment_cycles=7,
+                stop_on_convergence=False,
+                recovery=RecoveryPolicy(trip_cycles=(14,) * 6,
+                                        max_restarts=2),
+            )
+        err = exc.value
+        assert err.attempts == 3
+        assert len(err.violations) == 3
+        # Trips hit at cycle 14, after segment 7 validated: the
+        # partial trajectory carries the last VALID state.
+        assert err.partial["cycle"] == 7
+        assert err.partial["assignment"] is not None
+        assert set(err.partial["assignment"]) == {
+            f"v{i}" for i in range(6)}
+
+    def test_nan_guard_detects_poisoned_state(self):
+        """The device-side guard flags a NaN in any float leaf."""
+        import jax
+        import jax.numpy as jnp
+
+        engine = _engine()
+        state = engine.init_state()
+        values = jnp.zeros(
+            (len(engine.meta.var_names),), dtype=jnp.int32)
+        finite, _ = jax.device_get(
+            engine._guard_fn()(engine.graph, state, values))
+        assert bool(finite)
+        poisoned = state._replace(
+            v2f=tuple(m.at[0].set(jnp.nan) for m in state.v2f))
+        finite, _ = jax.device_get(
+            engine._guard_fn()(engine.graph, poisoned, values))
+        assert not bool(finite)
+
+    def test_nan_trip_rolls_back_to_valid_state(self):
+        """End to end: a NaN planted in the state AFTER a validated
+        segment trips the nonfinite guard and recovery restarts from
+        the clean snapshot — the solve still converges."""
+        engine = _engine()
+        rec_holder = {}
+        original_retain = RecoveryRun.retain
+
+        def poisoning_retain(self, state, values):
+            original_retain(self, state, values)
+            rec_holder.setdefault("rec", self)
+
+        # Inject the NaN through the guard's own check path: plant it
+        # by flipping the first validated snapshot's successor. The
+        # simplest honest injection: monkeypatch check() to report
+        # nonfinite exactly once.
+        original_check = RecoveryRun.check
+        fired = []
+
+        def nan_once_check(self, end_cycle, finite, cost):
+            if not fired and end_cycle >= 14:
+                fired.append(end_cycle)
+                return GuardViolation(
+                    "nonfinite", end_cycle, "injected NaN")
+            return original_check(self, end_cycle, finite, cost)
+
+        RecoveryRun.retain = poisoning_retain
+        RecoveryRun.check = nan_once_check
+        try:
+            res = engine.run_checkpointed(
+                max_cycles=120, segment_cycles=7,
+                recovery=RecoveryPolicy())
+        finally:
+            RecoveryRun.retain = original_retain
+            RecoveryRun.check = original_check
+        assert res.metrics["guard_trips"] == 1
+        assert res.metrics["guard_violations"][0]["kind"] == \
+            "nonfinite"
+        assert res.converged
+
+    def test_divergence_window_trips(self):
+        """RecoveryRun.check verdicts: a window of costs all above
+        factor * best trips the divergence guard; recovering costs do
+        not."""
+        policy = RecoveryPolicy(divergence_window=3,
+                                divergence_factor=2.0)
+        rec = RecoveryRun(policy, _engine())
+        assert rec.check(10, True, 10.0) is None   # establishes best
+        assert rec.check(20, True, 12.0) is None
+        assert rec.check(30, True, 15.0) is None   # window below 20
+        violation = rec.check(40, True, 50.0)
+        assert violation is None  # window = [12, 15, 50]: min 12 < 20
+        for cycle, cost in ((50, 30.0), (60, 40.0)):
+            violation = rec.check(cycle, True, cost)
+        assert violation is not None
+        assert violation.kind == "divergence"
+
+    def test_perturb_state_is_seeded_and_clears_stable(self):
+        import jax
+        import jax.numpy as jnp
+
+        engine = _engine()
+        state = engine.init_state()
+        state = state._replace(stable=jnp.asarray(True))
+        p1 = perturb_state(state, 1e-3, seed=7)
+        p2 = perturb_state(state, 1e-3, seed=7)
+        p3 = perturb_state(state, 1e-3, seed=8)
+        assert not bool(p1.stable)
+        l1 = jax.device_get(jax.tree_util.tree_leaves(p1))
+        l2 = jax.device_get(jax.tree_util.tree_leaves(p2))
+        l3 = jax.device_get(jax.tree_util.tree_leaves(p3))
+        for a, b in zip(l1, l2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(l1, l3)
+        )
+
+    def test_api_solve_with_recovery(self, tmp_path):
+        from pydcop_tpu.api import solve
+
+        dcop = _ring_dcop()
+        ref = solve(dcop, "maxsum", backend="device", max_cycles=100)
+        res = solve(
+            dcop, "maxsum", backend="device", max_cycles=100,
+            recovery=RecoveryPolicy(),
+        )
+        assert res["assignment"] == ref["assignment"]
+        assert res["metrics"]["guard_trips"] == 0
+        with pytest.raises(ValueError, match="device"):
+            solve(dcop, "maxsum", backend="thread",
+                  recovery=RecoveryPolicy())
+
+
+# ------------------------------------------------------------------ #
+# checkpoint integrity
+
+
+class TestCheckpointIntegrity:
+    def test_checksum_written_and_verified(self, tmp_path):
+        engine = _engine()
+        manager = CheckpointManager(str(tmp_path), every=5)
+        manager.save(engine.init_state(), 5)
+        meta = verify_checkpoint(manager.path_for(5))
+        assert len(meta["checksum"]) == 64
+
+    def test_flipped_byte_detected(self, tmp_path):
+        import json
+
+        engine = _engine()
+        manager = CheckpointManager(str(tmp_path), every=5)
+        path = manager.save(engine.init_state(), 5)
+        with np.load(path, allow_pickle=False) as data:
+            meta = json.loads(str(data["__meta__"]))
+            arrays = {k: data[k].copy() for k in data.files
+                      if k != "__meta__"}
+        flat = arrays["leaf_0"].reshape(-1).view(np.uint8)
+        flat[0] ^= 0xFF
+        with open(path, "wb") as f:
+            np.savez(f, __meta__=json.dumps(meta), **arrays)
+        with pytest.raises(CheckpointCorruptError):
+            verify_checkpoint(path)
+        with pytest.raises(CheckpointCorruptError):
+            load_state(path, engine.init_state())
+        # latest() must skip it entirely.
+        assert manager.latest() is None
+
+    def test_truncated_newest_falls_back_on_resume(self, tmp_path,
+                                                   caplog):
+        """THE corruption-safety criterion: truncate the newest
+        snapshot mid-file (a torn async write); resume comes from the
+        previous valid snapshot, with a warning, and reproduces the
+        uninterrupted run."""
+        import logging
+
+        dcop = _ring_dcop()
+        from pydcop_tpu.algorithms.maxsum import build_engine
+
+        ref = build_engine(dcop, {}).run(max_cycles=100)
+        manager = CheckpointManager(str(tmp_path), every=5, keep=3)
+        build_engine(dcop, {}).run_checkpointed(
+            max_cycles=100, manager=manager, max_segments=2)
+        cycles = [c for c, _ in manager.checkpoints()]
+        assert cycles == [5, 10]
+        newest = manager.path_for(10)
+        with open(newest, "r+b") as f:
+            f.truncate(os.path.getsize(newest) // 2)
+        with caplog.at_level(logging.WARNING,
+                             logger="pydcop.resilience.checkpoint"):
+            res = resume_from_checkpoint(
+                build_engine(dcop, {}), manager, max_cycles=100)
+        assert res.metrics["resumed_from_cycle"] == 5
+        assert res.assignment == ref.assignment
+        assert res.cycles == ref.cycles
+        assert any("falling back" in r.message for r in caplog.records)
+
+    def test_retention_keeps_exactly_n(self, tmp_path):
+        engine = _engine()
+        manager = CheckpointManager(str(tmp_path), every=5, keep=3)
+        state = engine.init_state()
+        for cycle in (5, 10, 15, 20, 25):
+            manager.save(state, cycle)
+        assert [c for c, _ in manager.checkpoints()] == [15, 20, 25]
+
+    def test_structural_mismatch_still_aborts_resume(self, tmp_path):
+        """Only CORRUPTION falls back; resuming the wrong problem is a
+        caller error and must abort loudly, never silently restart
+        from cycle 0 (which would also let retention GC the other
+        problem's snapshots)."""
+        from pydcop_tpu.algorithms.maxsum import build_engine
+
+        manager = CheckpointManager(str(tmp_path), every=5)
+        build_engine(_ring_dcop(6), {}).run_checkpointed(
+            max_cycles=100, manager=manager, max_segments=1)
+        other_engine = build_engine(_ring_dcop(4), {})
+        with pytest.raises(ValueError, match="wrong problem"):
+            resume_from_checkpoint(other_engine, manager,
+                                   max_cycles=100)
+
+    def test_first_segment_trip_with_max_segments_returns(self):
+        """A guard trip on the very first segment + a max_segments
+        interrupt: no validated values exist yet — the result must
+        still come back (value selection computed without stepping),
+        not crash on a None fetch."""
+        res = _engine().run_checkpointed(
+            max_cycles=100, segment_cycles=7, max_segments=1,
+            recovery=RecoveryPolicy(trip_cycles=(1,)),
+        )
+        assert res.metrics["interrupted"]
+        assert res.metrics["guard_trips"] == 1
+        assert res.cycles == 0  # rolled back to the initial snapshot
+        assert set(res.assignment) == {f"v{i}" for i in range(6)}
+
+    def test_api_checkpoint_keep_knob(self, tmp_path):
+        from pydcop_tpu.api import solve
+
+        solve(_ring_dcop(), "maxsum", backend="device",
+              max_cycles=100, checkpoint_dir=str(tmp_path),
+              checkpoint_every=5, checkpoint_keep=1)
+        snapshots = [f for f in os.listdir(tmp_path)
+                     if f.startswith("ckpt_")]
+        assert len(snapshots) == 1
+
+
+# ------------------------------------------------------------------ #
+# AsyncCheckpointWriter atexit regression
+
+
+class TestAsyncWriterAtexit:
+    def _failing_writer(self, tmp_path, monkeypatch):
+        from pydcop_tpu.resilience import checkpoint as ckpt_mod
+
+        manager = CheckpointManager(str(tmp_path), every=5)
+
+        def boom(*args, **kwargs):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(ckpt_mod, "save_state", boom)
+        return AsyncCheckpointWriter(manager)
+
+    def test_atexit_drain_swallows_and_logs(self, tmp_path,
+                                            monkeypatch, caplog):
+        """An exception during the atexit flush must be logged, never
+        re-raised into interpreter shutdown."""
+        import logging
+
+        writer = self._failing_writer(tmp_path, monkeypatch)
+        writer.submit({"x": np.zeros(3)}, 5)
+        with caplog.at_level(logging.ERROR,
+                             logger="pydcop.resilience.checkpoint"):
+            writer._close_at_exit()  # must NOT raise
+        assert any("interpreter shutdown" in r.message
+                   for r in caplog.records)
+
+    def test_explicit_flush_still_raises(self, tmp_path, monkeypatch):
+        writer = self._failing_writer(tmp_path, monkeypatch)
+        writer.submit({"x": np.zeros(3)}, 5)
+        with pytest.raises(RuntimeError, match="checkpoint write"):
+            writer.flush()
+
+    def test_explicit_close_still_raises(self, tmp_path, monkeypatch):
+        writer = self._failing_writer(tmp_path, monkeypatch)
+        writer.submit({"x": np.zeros(3)}, 5)
+        with pytest.raises(RuntimeError, match="checkpoint write"):
+            writer.close()
+
+
+# ------------------------------------------------------------------ #
+# partition healing
+
+
+class RecordingLayer(CommunicationLayer):
+    def __init__(self):
+        super().__init__()
+        self.sent = []
+
+    @property
+    def address(self):
+        return self
+
+    def send_msg(self, src_agent, dest_agent, msg, on_error=None):
+        self.sent.append((src_agent, dest_agent, msg))
+
+
+class TestPartitionHealing:
+    def test_cross_traffic_resumes_at_heal_index(self):
+        plan = FaultPlan(
+            partitions=(frozenset({"a"}), frozenset({"b"})),
+            partition_heal_index=5,
+        )
+        inner = RecordingLayer()
+        layer = FaultyCommunicationLayer(inner, plan)
+        for i in range(10):
+            layer.send_msg("a", "b", _msg(content=i))
+        # Messages 0-4 blocked, 5-9 delivered.
+        assert [m.msg.content for _, _, m in inner.sent] == \
+            [5, 6, 7, 8, 9]
+        assert layer.stats.partitioned == 5
+
+    def test_heal_is_per_edge(self):
+        plan = FaultPlan(
+            partitions=(frozenset({"a"}), frozenset({"b", "c"})),
+            partition_heal_index=2,
+        )
+        inner = RecordingLayer()
+        layer = FaultyCommunicationLayer(inner, plan)
+        layer.send_msg("a", "b", _msg(content="b0"))  # blocked
+        layer.send_msg("a", "c", _msg(content="c0"))  # blocked
+        layer.send_msg("a", "b", _msg(content="b1"))  # blocked
+        layer.send_msg("a", "b", _msg(content="b2"))  # healed (idx 2)
+        layer.send_msg("a", "c", _msg(content="c1"))  # still blocked
+        assert [m.msg.content for _, _, m in inner.sent] == ["b2"]
+
+    def test_unhealed_partition_blocks_forever(self):
+        plan = FaultPlan(partitions=(frozenset({"a"}),
+                                     frozenset({"b"})))
+        assert plan.is_partitioned("a", "b", index=10 ** 6)
+
+    def test_decision_is_pure_function_of_index(self):
+        plan = FaultPlan(
+            partitions=(frozenset({"a"}), frozenset({"b"})),
+            partition_heal_index=3,
+        )
+        assert plan.is_partitioned("a", "b", 2)
+        assert not plan.is_partitioned("a", "b", 3)
+        # Same answers on re-query: no hidden state.
+        assert plan.is_partitioned("a", "b", 2)
+
+
+# ------------------------------------------------------------------ #
+# multihost coordinator loss
+
+
+class TestMultihostCoordinatorLoss:
+    @pytest.fixture()
+    def multihost(self):
+        from pydcop_tpu.engine import multihost as mh
+
+        was_initialized = mh._initialized
+        mh._reset_initialized()
+        yield mh
+        mh._initialized = was_initialized
+
+    def test_coordinator_loss_surfaces_clean_error_no_latch(
+            self, multihost, monkeypatch):
+        """A participant losing the coordinator mid-join gets a
+        bounded, clean RetryExhaustedError (no hang: attempts are
+        capped), the partial client is torn down, and the module never
+        latches — a later successful join works."""
+        import jax
+
+        from pydcop_tpu.resilience.retry import (
+            RetryExhaustedError,
+            RetryPolicy,
+        )
+
+        shutdowns = []
+
+        def lost_coordinator(**kwargs):
+            raise RuntimeError(
+                "DEADLINE_EXCEEDED: coordinator heartbeat lost")
+
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lost_coordinator)
+        monkeypatch.setattr(
+            jax.distributed, "shutdown",
+            lambda: shutdowns.append(1))
+        with pytest.raises(RetryExhaustedError):
+            multihost.initialize_multihost(
+                coordinator_address="127.0.0.1:65501",
+                num_processes=2, process_id=1,
+                retry_policy=RetryPolicy(max_attempts=2,
+                                         base_delay=0.01, jitter=0.0),
+            )
+        assert not multihost.multihost_initialized()
+        assert shutdowns, "partial distributed client not torn down"
+        # The loss did not latch: a later join succeeds.
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lambda **kw: None)
+        multihost.initialize_multihost(
+            coordinator_address="127.0.0.1:65501",
+            num_processes=1, process_id=0,
+            retry_policy=RetryPolicy(max_attempts=1),
+        )
+        assert multihost.multihost_initialized()
+
+    def test_global_mesh_refuses_unjoined_configured_env(
+            self, multihost, monkeypatch):
+        """With the environment configured for multihost but the join
+        failed, global_mesh must raise a clean error — NOT silently
+        build a single-host mesh that computes a wrong answer."""
+        monkeypatch.setenv("PYDCOP_NUM_PROCESSES", "2")
+        assert multihost.multihost_configured()
+        with pytest.raises(RuntimeError, match="not.*initialized"):
+            multihost.global_mesh()
+
+    def test_global_mesh_works_single_host(self, multihost,
+                                           monkeypatch):
+        for var in ("PYDCOP_COORDINATOR", "PYDCOP_NUM_PROCESSES",
+                    "PYDCOP_MULTIHOST"):
+            monkeypatch.delenv(var, raising=False)
+        assert not multihost.multihost_configured()
+        mesh = multihost.global_mesh()
+        assert mesh is not None

@@ -1,0 +1,410 @@
+"""Chaos soak: a seeded scenario matrix asserting global invariants.
+
+The robustness analogue of ``make perf-smoke``: where the perf gate
+proves the hot path is *fast*, this gate proves the runtime *heals* —
+every scenario injects a distinct failure combination (message drop +
+duplicate + delay, network partition with healing, silent agent kill,
+engine guard trips, checkpoint corruption) and asserts the system-wide
+invariants that define "self-healing":
+
+- **valid assignment** — every variable ends with a value from its
+  domain (a migrated computation kept working; nothing was lost);
+- **monotone cycle counter** — progress never runs backwards in the
+  observable record (trace ``engine_segment`` spans may rewind ONLY
+  across an explicit ``recovery_rollback``);
+- **no orphaned computations** — a killed agent's computations are
+  re-hosted, not dropped (their variables still carry values);
+- **health verdicts consistent with the kill schedule** — every
+  injected kill is reported ``agent_dead`` within the configured miss
+  bound, and scenarios with message faults but NO kill produce zero
+  death verdicts (suspicion is allowed: that is the phi-accrual
+  detector doing its job on a lossy link).
+
+Every scenario is a pure function of the seed (fault decisions are
+seeded per edge+index, heartbeat bounds are schedule-free, guard trips
+are cycle-keyed), so a red run REPLAYS: the failure report prints the
+scenario name, the seed and the trace file to hand to
+``pydcop trace summary``.
+
+Usage::
+
+    python tools/chaos_soak.py                 # full matrix
+    python tools/chaos_soak.py --scenarios 6   # quick gate (make test)
+    python tools/chaos_soak.py --seed 7 --only kill_detected
+
+``make chaos-soak`` runs the full matrix; ``make test`` wires the
+quick 6-scenario gate (fixed seed, < 60 s).
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from pydcop_tpu.algorithms import AlgorithmDef  # noqa: E402
+from pydcop_tpu.dcop.dcop import DCOP  # noqa: E402
+from pydcop_tpu.dcop.objects import (  # noqa: E402
+    AgentDef,
+    Domain,
+    Variable,
+)
+from pydcop_tpu.dcop.relations import constraint_from_str  # noqa: E402
+from pydcop_tpu.distribution.objects import Distribution  # noqa: E402
+
+DEFAULT_SEED = int(os.environ.get("PYDCOP_CHAOS_SEED", "42"))
+
+
+# ------------------------------------------------------------------ #
+# fixtures
+
+
+def coloring_dcop(n_agents=5, n_vars=4):
+    """3-colorable chain: fault-free optimum cost is 0."""
+    d = Domain("colors", "", ["R", "G", "B"])
+    dcop = DCOP("soak", objective="min")
+    variables = [Variable(f"v{i}", d) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    for i in range(n_vars - 1):
+        dcop.add_constraint(constraint_from_str(
+            f"diff_{i}_{i + 1}",
+            f"10 if v{i} == v{i + 1} else 0",
+            [variables[i], variables[i + 1]],
+        ))
+    dcop.add_agents([
+        AgentDef(f"a{i}", capacity=100, default_hosting_cost=i)
+        for i in range(n_agents)
+    ])
+    return dcop
+
+
+def variable_distribution():
+    return Distribution({
+        "a0": ["v0"], "a1": ["v1"], "a2": ["v2"], "a3": ["v3"],
+        "a4": [],
+    })
+
+
+def ring_dcop(n_vars=6):
+    d = Domain("c", "", list(range(3)))
+    dcop = DCOP("soak_ring", objective="min")
+    variables = [Variable(f"v{i}", d) for i in range(n_vars)]
+    for v in variables:
+        dcop.add_variable(v)
+    edges = [(i, (i + 1) % n_vars) for i in range(n_vars)] + [(0, 3)]
+    for i, j in edges:
+        dcop.add_constraint(constraint_from_str(
+            f"c{i}_{j}", f"10 if v{i} == v{j} else 0",
+            [variables[i], variables[j]],
+        ))
+    return dcop
+
+
+# ------------------------------------------------------------------ #
+# invariants
+
+
+def assert_valid_assignment(dcop, assignment):
+    """Every variable valued, every value in its domain."""
+    for name, variable in dcop.variables.items():
+        assert name in assignment, f"variable {name} has NO value " \
+            "(orphaned computation?)"
+        value = assignment[name]
+        assert value in list(variable.domain), \
+            f"variable {name} = {value!r} outside its domain"
+
+
+def assert_health_consistent(health, killed):
+    """Dead verdicts == the injected kill schedule, exactly."""
+    dead = set(health["dead"])
+    assert dead == set(killed), (
+        f"health verdicts inconsistent with kill schedule: "
+        f"dead={sorted(dead)} killed={sorted(killed)}"
+    )
+
+
+def assert_monotone_segments(trace_path):
+    """Engine segment cycles never rewind except across an explicit
+    recovery rollback — the monotone-progress invariant."""
+    from pydcop_tpu.observability.trace import load_trace_file
+
+    events = sorted(
+        (e for e in load_trace_file(trace_path)
+         if e.get("name") in ("engine_segment", "recovery_rollback")),
+        key=lambda e: e["ts"],
+    )
+    last_cycle = -1
+    for ev in events:
+        if ev["name"] == "recovery_rollback":
+            last_cycle = -1  # an announced rewind resets the floor
+            continue
+        start = int(ev.get("args", {}).get("from_cycle", 0))
+        assert start >= last_cycle, (
+            f"cycle counter rewound without a rollback: segment from "
+            f"cycle {start} after cycle {last_cycle}"
+        )
+        last_cycle = start
+    return events
+
+
+# ------------------------------------------------------------------ #
+# scenarios — each returns a dict of observations, raises on failure
+
+
+def _thread_chaos(seed, trace, *, plan, health=True, algo=None,
+                  timeout=20):
+    from pydcop_tpu.infrastructure.run import solve_with_agents
+    from pydcop_tpu.observability import ObservabilitySession
+    from pydcop_tpu.resilience.health import HealthConfig
+
+    dcop = coloring_dcop()
+    algo = algo or AlgorithmDef.build_with_default_param(
+        "adsa", {"stop_cycle": 40, "period": 0.05}, mode="min")
+    config = HealthConfig() if health else None
+    with ObservabilitySession(trace, "chrome"):
+        res = solve_with_agents(
+            dcop, algo, distribution=variable_distribution(),
+            timeout=timeout, fault_plan=plan, health_config=config,
+        )
+    assert_valid_assignment(dcop, res["assignment"])
+    assert res.get("cycles", 0) > 0, "no cycle ever completed"
+    return res
+
+
+def scenario_kill_detected(seed, trace):
+    """Silent kill mid-run: the heartbeat monitor (not the injector)
+    must detect the death and the repair path must migrate the
+    victim's computation."""
+    from pydcop_tpu.resilience.faults import CrashEvent, FaultPlan
+
+    res = _thread_chaos(seed, trace, plan=FaultPlan(
+        seed=seed, crashes=(CrashEvent("a1", 5),), replicas=2,
+    ), timeout=45)
+    assert res["killed_agents"] == ["a1"]
+    assert_health_consistent(res["health"], ["a1"])
+    assert res["status"] == "FINISHED", f"run ended {res['status']}"
+    assert res["cost"] == 0, f"non-optimal cost {res['cost']}"
+    return {"dead": res["health"]["dead"], "cost": res["cost"]}
+
+
+def scenario_drop_dup_delay(seed, trace):
+    """Lossy-but-alive links: drop+dup+delay with NO kill must
+    converge to the fault-free cost with ZERO death verdicts
+    (suspicion allowed — that is the detector's designed response)."""
+    from pydcop_tpu.resilience.faults import FaultPlan
+
+    res = _thread_chaos(seed, trace, plan=FaultPlan(
+        seed=seed, drop=0.10, duplicate=0.05, delay=0.05,
+        delay_time=0.02,
+    ))
+    stats = res["fault_stats"]
+    assert stats["dropped"] > 0, "no fault injected — not a chaos run"
+    assert_health_consistent(res["health"], [])
+    assert res["cost"] == 0, f"non-optimal cost {res['cost']}"
+    return {"fault_stats": stats,
+            "suspects": [v for v in res["health"]["verdicts"]
+                         if v["status"] == "suspect"]}
+
+
+def scenario_delay_only_no_death(seed, trace):
+    """Pure delay (30%): heartbeats arrive late, never never-again —
+    zero death verdicts."""
+    from pydcop_tpu.resilience.faults import FaultPlan
+
+    res = _thread_chaos(seed, trace, plan=FaultPlan(
+        seed=seed, delay=0.30, delay_time=0.05,
+    ))
+    assert_health_consistent(res["health"], [])
+    assert res["cost"] == 0, f"non-optimal cost {res['cost']}"
+    return {"verdicts": len(res["health"]["verdicts"])}
+
+
+def scenario_partition_heal(seed, trace):
+    """A partition splits the chain mid-problem, then HEALS (per-edge
+    index bound): the run must reconverge to the fault-free cost after
+    the heal — the assertion PR-1's permanent partitions could never
+    make."""
+    from pydcop_tpu.resilience.faults import FaultPlan
+
+    res = _thread_chaos(seed, trace, plan=FaultPlan(
+        seed=seed,
+        partitions=(frozenset({"a0", "a1"}),
+                    frozenset({"a2", "a3", "a4"})),
+        partition_heal_index=8,
+    ), timeout=30)
+    assert res["fault_stats"]["partitioned"] > 0, \
+        "partition never blocked a message"
+    assert_health_consistent(res["health"], [])
+    assert res["cost"] == 0, (
+        f"no reconvergence after partition heal: cost {res['cost']}")
+    return {"partitioned": res["fault_stats"]["partitioned"]}
+
+
+def scenario_drop_plus_kill(seed, trace):
+    """Combined loss + silent kill: detection and repair under a lossy
+    network."""
+    from pydcop_tpu.resilience.faults import CrashEvent, FaultPlan
+
+    res = _thread_chaos(seed, trace, plan=FaultPlan(
+        seed=seed, drop=0.10, crashes=(CrashEvent("a2", 5),),
+        replicas=2,
+    ), timeout=45)
+    assert res["killed_agents"] == ["a2"]
+    assert_health_consistent(res["health"], ["a2"])
+    assert res["status"] == "FINISHED", f"run ended {res['status']}"
+    assert res["cost"] == 0, f"non-optimal cost {res['cost']}"
+    return {"dead": res["health"]["dead"]}
+
+
+def scenario_guard_trip_device(seed, trace):
+    """Injected guard trip on a device solve: rollback + recovery must
+    appear in the exported trace, the cycle counter may only rewind
+    across the rollback, and the healed run still converges to a valid
+    assignment."""
+    from pydcop_tpu.algorithms.maxsum import build_engine
+    from pydcop_tpu.observability import ObservabilitySession
+    from pydcop_tpu.resilience.recovery import RecoveryPolicy
+
+    dcop = ring_dcop()
+    with ObservabilitySession(trace, "chrome"):
+        res = build_engine(dcop, {}).run_checkpointed(
+            max_cycles=120, segment_cycles=7,
+            recovery=RecoveryPolicy(trip_cycles=(14,),
+                                    noise_seed=seed),
+        )
+    assert res.metrics["guard_trips"] == 1
+    assert res.metrics["recovery_attempts"] == 1
+    assert res.converged, "recovered run failed to converge"
+    assert_valid_assignment(dcop, res.assignment)
+    events = assert_monotone_segments(trace)
+    names = {e["name"] for e in events}
+    assert "recovery_rollback" in names, \
+        "recovery span missing from exported trace"
+    return {"trace_events": len(events),
+            "actions": res.metrics["recovery_actions"]}
+
+
+def scenario_guard_noop_device(seed, trace):
+    """Guard armed, nothing injected: the guarded trajectory must be
+    bit-identical to the unguarded one (guards are pure reads)."""
+    from pydcop_tpu.algorithms.maxsum import build_engine
+    from pydcop_tpu.resilience.recovery import RecoveryPolicy
+
+    dcop = ring_dcop()
+    ref = build_engine(dcop, {}).run_checkpointed(
+        max_cycles=120, segment_cycles=7)
+    res = build_engine(dcop, {}).run_checkpointed(
+        max_cycles=120, segment_cycles=7, recovery=RecoveryPolicy())
+    assert res.metrics["guard_trips"] == 0
+    assert res.assignment == ref.assignment, \
+        "guarded run diverged from unguarded with no faults"
+    assert res.cycles == ref.cycles
+    assert_valid_assignment(dcop, res.assignment)
+    return {"cycles": res.cycles}
+
+
+def scenario_checkpoint_corruption(seed, trace):
+    """Torn-write simulation: truncate the newest snapshot mid-file;
+    resume must fall back to the previous VALID snapshot and still
+    reproduce the uninterrupted run; retention keeps exactly N."""
+    from pydcop_tpu.algorithms.maxsum import build_engine
+    from pydcop_tpu.resilience.checkpoint import (
+        CheckpointManager,
+        resume_from_checkpoint,
+    )
+
+    dcop = ring_dcop()
+    ref = build_engine(dcop, {}).run(max_cycles=120)
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        manager = CheckpointManager(ckpt_dir, every=5, keep=2)
+        build_engine(dcop, {}).run_checkpointed(
+            max_cycles=120, manager=manager, max_segments=3)
+        on_disk = manager.checkpoints()
+        assert len(on_disk) == 2, (
+            f"retention kept {len(on_disk)} snapshots, wanted "
+            f"exactly 2")
+        newest = on_disk[-1][1]
+        with open(newest, "r+b") as f:
+            f.truncate(os.path.getsize(newest) // 2)
+        res = resume_from_checkpoint(
+            build_engine(dcop, {}), manager, max_cycles=120)
+        assert res.metrics["resumed_from_cycle"] == on_disk[-2][0], \
+            "resume did not fall back to the previous valid snapshot"
+        assert res.assignment == ref.assignment
+        assert res.cycles == ref.cycles
+        assert_valid_assignment(dcop, res.assignment)
+        return {"resumed_from": res.metrics["resumed_from_cycle"]}
+
+
+# Quick-gate ordering: the first 6 cover every failure class (kill
+# detection, engine recovery, partition healing, lossy links,
+# checkpoint corruption, guard purity).
+SCENARIOS = [
+    ("kill_detected", scenario_kill_detected),
+    ("guard_trip_device", scenario_guard_trip_device),
+    ("partition_heal", scenario_partition_heal),
+    ("drop_dup_delay", scenario_drop_dup_delay),
+    ("checkpoint_corruption", scenario_checkpoint_corruption),
+    ("guard_noop_device", scenario_guard_noop_device),
+    ("delay_only_no_death", scenario_delay_only_no_death),
+    ("drop_plus_kill", scenario_drop_plus_kill),
+]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--scenarios", type=int, default=0,
+                        help="run only the first N scenarios "
+                             "(0 = full matrix)")
+    parser.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    parser.add_argument("--only", default=None,
+                        help="run a single scenario by name (replay)")
+    parser.add_argument("--out", default=None,
+                        help="directory for per-scenario trace files "
+                             "(default: a temp dir)")
+    args = parser.parse_args(argv)
+
+    selected = SCENARIOS
+    if args.only:
+        selected = [s for s in SCENARIOS if s[0] == args.only]
+        if not selected:
+            names = ", ".join(name for name, _ in SCENARIOS)
+            print(f"unknown scenario {args.only!r}; have: {names}")
+            return 2
+    elif args.scenarios:
+        selected = SCENARIOS[:args.scenarios]
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="chaos_soak_")
+    os.makedirs(out_dir, exist_ok=True)
+    print(f"chaos soak: {len(selected)} scenario(s), "
+          f"seed={args.seed}, traces in {out_dir}")
+    failures = 0
+    t_total = time.perf_counter()
+    for name, fn in selected:
+        trace = os.path.join(out_dir, f"{name}.trace.json")
+        t0 = time.perf_counter()
+        try:
+            obs = fn(args.seed, trace)
+        except Exception as e:
+            failures += 1
+            print(f"FAIL  {name} ({time.perf_counter() - t0:.1f}s): "
+                  f"{e}")
+            print(f"      replay: python tools/chaos_soak.py "
+                  f"--seed {args.seed} --only {name} "
+                  f"--out {out_dir}")
+            print(f"      trace:  {trace}  "
+                  f"(pydcop trace summary {trace})")
+            continue
+        print(f"ok    {name} ({time.perf_counter() - t0:.1f}s) {obs}")
+    status = "FAIL" if failures else "PASS"
+    print(f"chaos soak {status}: {len(selected) - failures}/"
+          f"{len(selected)} scenarios in "
+          f"{time.perf_counter() - t_total:.1f}s")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
