@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test chaos chaos-soak trace-demo perf-smoke unit api cli check doctest bench dryrun onchip
+.PHONY: all test chaos chaos-soak trace-demo perf-smoke bench-check unit api cli check doctest bench dryrun onchip
 
 # 0 = the full scenario matrix; `make test` runs the quick 6-scenario
 # gate (the first 6 cover every failure class; fixed seed, < 60 s).
@@ -57,7 +57,16 @@ trace-demo:
 perf-smoke:
 	$(PY) tools/perf_smoke.py
 
+# Bench regression sentinel: noise-aware (median ± MAD per backend)
+# run-over-run check of the BENCH_r*.json trajectory, with a
+# sparkline trajectory line per backend.  Hard gate standalone; `make
+# test` runs it ADVISORY (`-` prefix: a slow shared host must not
+# block an unrelated PR).  See tools/bench_sentinel.py.
+bench-check:
+	$(PY) tools/bench_sentinel.py
+
 test: trace-demo perf-smoke
+	-$(PY) tools/bench_sentinel.py
 	$(MAKE) chaos-soak SOAK_SCENARIOS=6
 	$(PY) -m pytest tests/ -q
 

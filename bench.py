@@ -248,6 +248,7 @@ def _try_revive_tpu():
     bench invocation (PYDCOP_BENCH_TPU_RETRIED)."""
     from pydcop_tpu.utils.cleanenv import (
         DIAG_ENV,
+        default_probe_timeout,
         probe_backend,
         record_diag,
         tpu_env,
@@ -256,7 +257,9 @@ def _try_revive_tpu():
     env = tpu_env()
     if env is None or os.environ.get("PYDCOP_BENCH_TPU_RETRIED"):
         return
-    ok, error, dt = probe_backend(60, env=env)
+    # Revival probe budget: 60 s default, PYDCOP_BENCH_PROBE_TIMEOUT
+    # overrides (a tunnel that answers in 90 s is revived, not lost).
+    ok, error, dt = probe_backend(default_probe_timeout(60), env=env)
     record_diag("revival_probe", ok=ok, error=error,
                 seconds=round(dt, 1))
     if not ok:
@@ -424,9 +427,15 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
 def run_bench():
     import jax
 
+    from pydcop_tpu.observability.profiler import profiler
     from pydcop_tpu.utils.cleanenv import diag_events
     from pydcop_tpu.engine.roofline import roofline_report
 
+    # XLA cost attribution for the roofline: the engine's cold
+    # dispatch captures measured flops/bytes per compiled program
+    # (PYDCOP_XLA_PROFILE=0 vetoes — the capture adds one AOT compile
+    # per program, which a wedge-prone tunnel may not tolerate).
+    profiler.enabled = True
     dev = jax.devices()[0]
     platform = dev.platform
     device_kind = getattr(dev, "device_kind", None)
@@ -532,10 +541,29 @@ def run_bench():
                   "with end-to-end timing only", file=sys.stderr)
             fixed_latency = None
 
+    # Measured (XLA-reported) per-cycle cost when the backend offered
+    # one: the headline program is one while-loop whose body is a
+    # superstep, and XLA's cost analysis counts a loop body ONCE
+    # (trip-count-independent — verified in the perf-intel battery),
+    # so the reported flops/bytes ARE per-cycle numbers.
+    # bench_device's engine compiles exactly one program, so take the
+    # sole entry rather than reverse-engineering the jit cache key
+    # (whose format belongs to the engine).
+    xla_entries = list((res.metrics.get("xla_cost") or {}).values())
+    xla_entry = xla_entries[0] if len(xla_entries) == 1 else {}
+    measured = None
+    if xla_entry.get("available"):
+        measured = {
+            "flops_per_cycle": xla_entry.get("flops"),
+            "bytes_per_cycle": xla_entry.get("bytes_accessed"),
+        }
     roofline = roofline_report(
-        engine.graph, marginal_cps or device_cps, platform, device_kind)
+        engine.graph, marginal_cps or device_cps, platform, device_kind,
+        measured=measured)
     roofline["roofline_rate_basis"] = (
         "marginal" if marginal_cps else "end_to_end")
+    if xla_entry.get("peak_bytes"):
+        roofline["xla_peak_bytes"] = xla_entry["peak_bytes"]
     # HBM-bound scale leg: TPU only — on the CPU-fallback path it
     # would add minutes and say nothing about HBM streaming.
     if platform == "tpu":

@@ -50,6 +50,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           trace_format: str = "chrome",
           metrics_file: Optional[str] = None,
           metrics_every: Optional[int] = None,
+          serve_metrics: Optional[int] = None,
           ) -> SolveResult:
     """Solve a DCOP and return assignment + quality metrics.
 
@@ -94,8 +95,17 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     a cost-vs-cycle curve, returned in ``metrics['cost_curve']``),
     in thread mode one each time the global cycle advances by
     ``metrics_every`` — and writes a Prometheus text dump to
-    ``<metrics_file>.prom`` when the solve ends.  Both default off and
-    cost nothing while off.  Interactions: with ``checkpoint_dir`` the
+    ``<metrics_file>.prom`` when the solve ends.  ``serve_metrics``
+    (a port; 0 = OS-assigned) serves live telemetry over HTTP for the
+    duration of the solve — ``/metrics`` (Prometheus text),
+    ``/healthz`` (health verdicts) and ``/events`` (SSE cycle/cost
+    stream) — so a long run is scrapeable while it runs
+    (observability/server.py).  An observed device solve also records
+    XLA cost attribution: measured flops/bytes/peak memory per
+    compiled segment land in ``metrics['xla_cost']`` keyed by jit
+    cache key (explicit ``available: False`` markers on backends that
+    return nothing).  All default off and cost nothing while off.
+    Interactions: with ``checkpoint_dir`` the
     chunking follows ``checkpoint_every``, so snapshots land every
     ``max(checkpoint_every, metrics_every)`` cycles; ``warmup=True``
     keeps the plain (unsegmented) device path — the solve is still
@@ -160,11 +170,13 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         )
 
     session = None
-    if trace is not None or metrics_file is not None:
+    if (trace is not None or metrics_file is not None
+            or serve_metrics is not None):
         from pydcop_tpu.observability import ObservabilitySession
 
         session = ObservabilitySession(
-            trace, trace_format, metrics_file
+            trace, trace_format, metrics_file,
+            serve_port=serve_metrics,
         ).start()
     try:
         from pydcop_tpu.observability.trace import tracer
@@ -185,6 +197,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                 fault_plan=fault_plan, recovery=recovery,
                 health=health, observing=session is not None,
                 metrics_file=metrics_file, metrics_every=metrics_every,
+                serving=serve_metrics is not None,
             )
     finally:
         if session is not None:
@@ -196,7 +209,7 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
            collect_moment, collect_period, delay, checkpoint_dir,
            checkpoint_every, checkpoint_async, checkpoint_keep,
            resume, fault_plan, recovery, health, observing,
-           metrics_file, metrics_every) -> SolveResult:
+           metrics_file, metrics_every, serving=False) -> SolveResult:
     if backend == "device":
         if not hasattr(module, "solve_on_device"):
             raise NotImplementedError(
@@ -326,6 +339,7 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
             collect_period=collect_period, delay=delay,
             fault_plan=fault_plan, health_config=health,
             metrics_file=metrics_file, metrics_every=metrics_every,
+            metrics_live=serving,
         )
 
     raise ValueError(f"Unknown backend {backend!r}")

@@ -64,6 +64,14 @@ def set_parser(subparsers):
                         type=int, default=100,
                         help="cycles between metrics snapshots (device "
                              "mode: also the engine chunk size)")
+    parser.add_argument("--serve_metrics", "--serve-metrics",
+                        type=int, default=None, metavar="PORT",
+                        help="serve live telemetry over HTTP while "
+                             "the solve runs: /metrics (Prometheus "
+                             "text), /healthz, /events (SSE cycle/"
+                             "cost stream); PORT 0 = OS-assigned, "
+                             "printed on stderr "
+                             "(docs/observability.md)")
     parser.add_argument("--profile", default=None,
                         help="device mode: write a JAX profiler trace "
                              "of the solve to this directory (inspect "
@@ -242,6 +250,7 @@ def run_cmd(args) -> int:
                 trace=trace_file, trace_format=trace_format or "chrome",
                 metrics_file=args.metrics,
                 metrics_every=args.metrics_every,
+                serve_metrics=args.serve_metrics,
             )
         result = {
             "status": res["status"],
@@ -302,6 +311,7 @@ def run_cmd(args) -> int:
             trace=trace_file, trace_format=trace_format or "chrome",
             metrics_file=args.metrics,
             metrics_every=args.metrics_every,
+            serve_metrics=args.serve_metrics,
         )
         result = {
             "status": res["status"],

@@ -1,20 +1,36 @@
-"""``pydcop trace``: inspect trace files produced by ``--trace``.
+"""``pydcop trace``: inspect, merge and compare trace files.
 
 ``pydcop trace summary FILE`` prints top-k span aggregates (count,
 total/mean/max duration) from a Chrome ``trace_event`` JSON or a JSONL
 trace — the quick "where did the time go" answer that does not need a
-browser.  Instant events (fault injections, breaker trips, message
-sends) aggregate with zero duration; their counts are the point.
+browser (``--json`` emits the same rows machine-readably, the input
+side of ``trace diff`` and CI assertions).  Instant events (fault
+injections, breaker trips, message sends) aggregate with zero
+duration; their counts are the point.
+
+``pydcop trace merge OUT IN1 IN2 ...`` aligns N per-process traces on
+one wall-clock axis (each exported trace carries a monotonic-to-wall
+anchor in its header; offsets are corrected per file) and namespaces
+their thread lanes, producing one Chrome trace for the whole
+multi-process run.  ``pydcop trace diff A B`` compares two traces
+span-name by span-name (count/total/p50 deltas) and exits 1 when a
+span regressed beyond ``--threshold`` — the trace-level counterpart
+of the bench sentinel.
+
+All subcommands print a one-line error (exit 2) instead of a
+traceback on empty/truncated/non-trace files.
 """
 
+import json
 import sys
 
 
 def set_parser(subparsers):
     parser = subparsers.add_parser(
-        "trace", help="inspect trace files produced by --trace")
+        "trace", help="inspect, merge and compare trace files")
     trace_sub = parser.add_subparsers(
         title="trace commands", dest="trace_command")
+
     summary = trace_sub.add_parser(
         "summary", help="top-k span aggregates of a trace file")
     summary.add_argument("trace_file", help="chrome-JSON or JSONL "
@@ -24,7 +40,37 @@ def set_parser(subparsers):
     summary.add_argument("--by", default="name",
                          choices=["name", "cat"],
                          help="aggregate by span name or category")
+    summary.add_argument("--json", action="store_true",
+                         dest="as_json",
+                         help="emit the summary as one JSON document "
+                              "(machine-readable; used by trace diff "
+                              "pipelines and CI)")
     summary.set_defaults(func=run_summary)
+
+    merge = trace_sub.add_parser(
+        "merge", help="merge N per-process traces into one aligned "
+                      "Chrome trace")
+    merge.add_argument("out_file", help="merged Chrome-trace output")
+    merge.add_argument("trace_files", nargs="+",
+                       help="two or more input traces (chrome or "
+                            "jsonl; clock-anchor headers align them)")
+    merge.set_defaults(func=run_merge)
+
+    diff = trace_sub.add_parser(
+        "diff", help="per-span-name count/total/p50 deltas between "
+                     "two traces")
+    diff.add_argument("trace_a", help="baseline trace")
+    diff.add_argument("trace_b", help="candidate trace")
+    diff.add_argument("--threshold", type=float, default=0.25,
+                      help="relative total-duration growth that "
+                           "flags a regression (default 0.25)")
+    diff.add_argument("--min_delta_ms", type=float, default=1.0,
+                      help="absolute growth floor below which a span "
+                           "never flags (default 1 ms)")
+    diff.add_argument("--json", action="store_true", dest="as_json",
+                      help="emit the full diff rows as JSON")
+    diff.set_defaults(func=run_diff)
+
     parser.set_defaults(func=_no_subcommand(parser))
 
 
@@ -36,17 +82,40 @@ def _no_subcommand(parser):
     return run
 
 
-def run_summary(args) -> int:
+def _load(path):
+    """load_trace_file with the command-level error contract."""
     from pydcop_tpu.observability.trace import (
+        TraceFileError,
         load_trace_file,
-        summarize_spans,
     )
 
-    events = load_trace_file(args.trace_file)
+    try:
+        return load_trace_file(path)
+    except TraceFileError as exc:
+        print(f"pydcop trace: {exc}", file=sys.stderr)
+        return None
+
+
+def run_summary(args) -> int:
+    from pydcop_tpu.observability.trace import summarize_spans
+
+    events = _load(args.trace_file)
+    if events is None:
+        return 2
     rows = summarize_spans(events, by=args.by, top=args.top)
     spans = sum(1 for e in events if e.get("ph") == "X")
     instants = sum(1 for e in events if e.get("ph") == "i")
     threads = len({e.get("tid") for e in events})
+    if args.as_json:
+        print(json.dumps({
+            "file": args.trace_file,
+            "spans": spans,
+            "instants": instants,
+            "threads": threads,
+            "by": args.by,
+            "rows": rows,
+        }))
+        return 0
     print(f"{args.trace_file}: {spans} spans, {instants} instants, "
           f"{threads} threads")
     if not rows:
@@ -62,4 +131,74 @@ def run_summary(args) -> int:
         print(f"{str(r[args.by]):<{key_width}}  {r['count']:>8}  "
               f"{r['total_ms']:>12.3f}  {r['mean_ms']:>10.3f}  "
               f"{r['max_ms']:>10.3f}")
+    return 0
+
+
+def run_merge(args) -> int:
+    from pydcop_tpu.observability.trace import (
+        TraceFileError,
+        merge_traces,
+    )
+
+    try:
+        info = merge_traces(args.trace_files, args.out_file)
+    except TraceFileError as exc:
+        print(f"pydcop trace: {exc}", file=sys.stderr)
+        return 2
+    align_note = (
+        "wall-clock aligned" if info["aligned"]
+        else f"{info['anchored']}/{info['files']} anchored — "
+             "NOT aligned, each file rebased to its own start"
+    )
+    print(
+        f"{args.out_file}: merged {info['files']} traces "
+        f"({align_note}) -> {info['events']} events on "
+        f"{info['lanes']} lanes, {info['span_us'] / 1000.0:.1f} ms "
+        "span"
+    )
+    return 0
+
+
+def run_diff(args) -> int:
+    from pydcop_tpu.observability.trace import diff_trace_summaries
+
+    events_a = _load(args.trace_a)
+    if events_a is None:
+        return 2
+    events_b = _load(args.trace_b)
+    if events_b is None:
+        return 2
+    rows = diff_trace_summaries(
+        events_a, events_b, threshold=args.threshold,
+        min_delta_ms=args.min_delta_ms,
+    )
+    regressions = [r for r in rows if r["regressed"]]
+    if args.as_json:
+        print(json.dumps({
+            "a": args.trace_a, "b": args.trace_b,
+            "threshold": args.threshold,
+            "regressions": len(regressions),
+            "rows": rows,
+        }))
+        return 1 if regressions else 0
+    name_w = max([len(r["name"]) for r in rows] + [4])
+    header = (f"{'name':<{name_w}}  {'count a>b':>11}  "
+              f"{'total_ms a':>11}  {'total_ms b':>11}  "
+              f"{'p50 a':>8}  {'p50 b':>8}  {'delta':>9}")
+    print(f"{args.trace_a} -> {args.trace_b}")
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        flag = "  << REGRESSED" if r["regressed"] else ""
+        print(
+            f"{r['name']:<{name_w}}  "
+            f"{r['count_a']:>5}>{r['count_b']:<5}  "
+            f"{r['total_ms_a']:>11.3f}  {r['total_ms_b']:>11.3f}  "
+            f"{r['p50_ms_a']:>8.3f}  {r['p50_ms_b']:>8.3f}  "
+            f"{r['delta_total_ms']:>+9.3f}{flag}"
+        )
+    if regressions:
+        print(f"{len(regressions)} span(s) regressed beyond "
+              f"{args.threshold:.0%} (+{args.min_delta_ms} ms)")
+        return 1
     return 0

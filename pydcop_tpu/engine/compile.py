@@ -61,6 +61,8 @@ import numpy as np
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.dcop.objects import Variable, _stable_noise
 from pydcop_tpu.dcop.relations import Constraint, NAryFunctionRelation
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.trace import tracer
 
 BIG = np.float32(1e9)
 
@@ -361,6 +363,24 @@ def compile_factor_graph(
     disables process-wide)."""
     if use_cache is None:
         use_cache = os.environ.get("PYDCOP_COMPILE_CACHE") != "0"
+    # Materialize before measuring: callers may pass iterators, which
+    # have no len() (the body always listified them).
+    variables = list(variables)
+    constraints = list(constraints)
+    # tracer.span is its own no-op when disabled; compile is a cold
+    # path, so the kwargs build costs nothing worth guarding.
+    with tracer.span("compile_graph", "engine",
+                     n_vars=len(variables),
+                     n_constraints=len(constraints)):
+        return _compile_factor_graph(
+            variables, constraints, mode, noise_level, noise_seed,
+            pad_to, dtype, aggregation, vectorize, use_cache,
+        )
+
+
+def _compile_factor_graph(variables, constraints, mode, noise_level,
+                          noise_seed, pad_to, dtype, aggregation,
+                          vectorize, use_cache):
     variables = list(variables)
     constraints = list(constraints)
     var_index = {v.name: i for i, v in enumerate(variables)}
@@ -419,8 +439,22 @@ def compile_factor_graph(
             tuple((a, scope_ids[a].tobytes()) for a in arities),
         )
         layout = compile_cache.get(cache_key)
+        # registry.active gate, like every optional series this PR
+        # adds: an unobserved solve must not accumulate samples that
+        # a later observed solve's .prom dump would misattribute.
+        if metrics_registry.active:
+            metrics_registry.counter(
+                "pydcop_compile_cache_total",
+                "Structure-cache lookups by outcome",
+            ).inc(outcome="hit" if layout is not None else "miss")
     if layout is None:
         compile_cache.layout_builds += 1
+        if metrics_registry.active:
+            metrics_registry.counter(
+                "pydcop_layout_builds_total",
+                "Factor-graph layout constructions (cache misses + "
+                "uncached compiles)",
+            ).inc()
         var_ids_by_arity = {}
         for arity in arities:
             n_facs = scope_ids[arity].shape[0]

@@ -45,6 +45,7 @@ from pydcop_tpu.engine.compile import (
     FactorBucket,
 )
 from pydcop_tpu.engine.runner import DeviceRunResult, timed_jit_call
+from pydcop_tpu.observability.profiler import key_str, profiler
 from pydcop_tpu.ops import maxsum as ops
 
 
@@ -359,14 +360,22 @@ class DynamicMaxSumEngine:
             v.name: v.domain[int(values[i])]
             for i, v in enumerate(self.variables)
         }
+        metrics = {"recompiles": self.recompile_count - 1,
+                   "cold_start": compile_s > 0}
+        if profiler.enabled:
+            entry = profiler.get(key)
+            if entry is not None:
+                # Superstep programs re-key on bucket shapes, so after
+                # a recompile the new program's measured cost appears
+                # under its own key.
+                metrics["xla_cost"] = {key_str(key): entry}
         return DeviceRunResult(
             assignment=assignment,
             cycles=int(state.cycle),
             converged=bool(state.stable),
             time_s=run_s,
             compile_time_s=compile_s,
-            metrics={"recompiles": self.recompile_count - 1,
-                     "cold_start": compile_s > 0},
+            metrics=metrics,
         )
 
     def cost(self, assignment: Dict) -> float:

@@ -133,8 +133,19 @@ def working_set_bytes(graph: CompiledFactorGraph) -> int:
 def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
                     platform: str,
                     device_kind: Optional[str] = None,
+                    measured: Optional[Dict[str, float]] = None,
                     ) -> Dict[str, Optional[float]]:
     """Achieved FLOP/s + utilizations for a measured superstep rate.
+
+    ``measured`` replaces the analytical per-cycle counts with
+    XLA-reported ones (observability/profiler.py): a dict with
+    ``flops_per_cycle`` and/or ``bytes_per_cycle`` — each present key
+    overrides its model value and the report carries
+    ``cost_source='xla'``; with ``measured=None`` (or an empty dict —
+    the backend-returned-nothing case) the hand model stands and
+    ``cost_source='model'``.  Utilization/residency logic is identical
+    either way, so a measured report stays comparable run-over-run
+    with modeled ones.
 
     Utilization claims (mfu/hbm_util) are made only when the concrete
     chip is recognized in TPU_PEAKS; `platform == "tpu"` with an
@@ -158,8 +169,17 @@ def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
             "roofline_report requires the edge-major "
             "CompiledFactorGraph; convert before accounting "
             "(ops/maxsum_lane.LaneGraph shapes are transposed)")
-    flops = maxsum_superstep_flops(graph)
-    bytes_moved = maxsum_superstep_bytes(graph)
+    model_flops = maxsum_superstep_flops(graph)
+    model_bytes = maxsum_superstep_bytes(graph)
+    flops, bytes_moved = model_flops, model_bytes
+    cost_source = "model"
+    if measured:
+        if measured.get("flops_per_cycle"):
+            flops = float(measured["flops_per_cycle"])
+            cost_source = "xla"
+        if measured.get("bytes_per_cycle"):
+            bytes_moved = float(measured["bytes_per_cycle"])
+            cost_source = "xla"
     ws = working_set_bytes(graph)
     achieved_flops = flops * cycles_per_s
     achieved_bw = bytes_moved * cycles_per_s
@@ -174,7 +194,8 @@ def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
         vmem_resident = ws < TPU_VMEM_BYTES // 2
         if device_kind in TPU_PEAKS:
             peak_flops, peak_bw = TPU_PEAKS[device_kind]
-    return {
+    out = {
+        "cost_source": cost_source,
         "flops_per_cycle": float(flops),
         "bytes_per_cycle": float(bytes_moved),
         "working_set_bytes": float(ws),
@@ -202,3 +223,11 @@ def roofline_report(graph: CompiledFactorGraph, cycles_per_s: float,
             if peak_bw and vmem_resident is False else None
         ),
     }
+    if cost_source == "xla":
+        # Keep the hand model alongside the measurement: the delta
+        # between them is itself a finding (a fused chain the model
+        # double-counts, or traffic XLA materializes that the model
+        # assumed fused away).
+        out["model_flops_per_cycle"] = float(model_flops)
+        out["model_bytes_per_cycle"] = float(model_bytes)
+    return out

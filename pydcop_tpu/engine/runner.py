@@ -24,6 +24,8 @@ from pydcop_tpu.engine.compile import (
 )
 from pydcop_tpu.engine.sharding import make_mesh, shard_graph
 from pydcop_tpu.engine.timing import sync
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.profiler import key_str, profiler
 from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.ops import maxsum as maxsum_ops
 from pydcop_tpu.ops import maxsum_lane as lane_ops
@@ -71,18 +73,67 @@ def timed_jit_call(warm: set, key, fn, *args):
     Returns (out, compile_s, run_s).
     """
     first = key not in warm
+    # Cost attribution happens BEFORE the timer: the profiler's
+    # throwaway AOT compile must never pollute the measured interval,
+    # and it must run before the dispatch below donates ``args``'
+    # buffers (the profiler only reads avals, but they come from the
+    # live arrays).
+    entry = None
+    if first and profiler.enabled:
+        entry = profiler.capture(key, fn, args)
     t0 = time.perf_counter()
+    span = None
     if tracer.enabled:
-        with tracer.span("jit_compile" if first else "engine_call",
-                         "engine", key=str(key)):
+        span = tracer.span("jit_compile" if first else "engine_call",
+                           "engine", key=str(key))
+        with span:
             out = sync(fn(*args))
     else:
         out = sync(fn(*args))
     elapsed = time.perf_counter() - t0
+    if entry is not None and span is not None:
+        # The recorded event holds this args dict BY REFERENCE until
+        # export, so measured cost lands in the jit_compile span
+        # without widening the timed window.
+        span.args["xla_cost"] = {
+            k: v for k, v in entry.items() if k != "capture_s"
+        }
+    if metrics_registry.active:
+        _account_jit_call(str(key), first, elapsed)
     if first:
         warm.add(key)
         return out, elapsed, elapsed
     return out, 0.0, elapsed
+
+
+def _account_jit_call(skey: str, first: bool, elapsed: float):
+    """Per-cache-key compile/dispatch accounting (registry.active
+    only — the key label is unbounded across engines, so this is
+    opt-in detail): warm-vs-cold call counts plus cold wall seconds,
+    the queryable form of "did this run recompile, and what did it
+    cost"."""
+    metrics_registry.counter(
+        "pydcop_jit_calls_total",
+        "Engine jit dispatches by cache key and warmth",
+    ).inc(key=skey, warmth="cold" if first else "warm")
+    if first:
+        metrics_registry.counter(
+            "pydcop_jit_compile_seconds_total",
+            "Wall seconds of cold engine dispatches (trace+compile+"
+            "first run) by cache key",
+        ).inc(elapsed, key=skey)
+
+
+def _fn_label(fn) -> str:
+    """Stable, low-cardinality name for a solve fn: partials (every
+    one-shot algorithm wraps its runner in one) resolve to the
+    wrapped function's name — never repr(), whose embedded addresses
+    and array dumps would mint a fresh metric label per solve."""
+    name = getattr(fn, "__name__", None)
+    if name:
+        return name
+    inner = getattr(fn, "func", None)  # functools.partial
+    return getattr(inner, "__name__", None) or type(fn).__name__
 
 
 def _place_graph(graph: CompiledFactorGraph, mesh,
@@ -123,6 +174,11 @@ def run_device_fn(graph: CompiledFactorGraph, meta: FactorGraphMeta,
     one-shot algorithms)."""
     graph, mesh = _place_graph(graph, mesh, n_devices)
     jitted = jax.jit(fn)
+    xla_entry = None
+    xla_key = None
+    if profiler.enabled:
+        xla_key = ("device_fn", _fn_label(fn))
+        xla_entry = profiler.capture(xla_key, jitted, (graph,))
     compile_s = 0.0
     if warmup:
         t0 = time.perf_counter()
@@ -149,6 +205,8 @@ def run_device_fn(graph: CompiledFactorGraph, meta: FactorGraphMeta,
     }
     if warmup:
         metrics["warmup_time_s"] = compile_s
+    if xla_entry is not None:
+        metrics["xla_cost"] = {key_str(xla_key): xla_entry}
     return DeviceRunResult(
         assignment=assignment,
         cycles=int(cycles),
@@ -217,8 +275,20 @@ class MaxSumEngine:
 
     def _call(self, key, fn, *args):
         """See timed_jit_call (module level, shared with the dynamic
-        engine)."""
-        return timed_jit_call(self._warm, key, fn, *args)
+        engine).  While the profiler is enabled, every compiled
+        program's measured cost/memory analysis (or its explicit
+        unavailable marker) is folded into ``extra_metrics`` so each
+        DeviceRunResult carries ``metrics['xla_cost']`` keyed by cache
+        key.  The fold happens only on the COLD dispatch (the one the
+        capture rode in on) — warm dispatches skip the profiler
+        lock entirely."""
+        out = timed_jit_call(self._warm, key, fn, *args)
+        if profiler.enabled and out[1] > 0:
+            entry = profiler.get(key)
+            if entry is not None:
+                self.extra_metrics.setdefault(
+                    "xla_cost", {})[key_str(key)] = entry
+        return out
 
     def init_state(self):
         """Fresh solver state for this engine's placed graph — also the

@@ -253,7 +253,8 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
                       fault_plan=None,
                       health_config=None,
                       metrics_file: Optional[str] = None,
-                      metrics_every: Optional[int] = None) -> Dict:
+                      metrics_every: Optional[int] = None,
+                      metrics_live: bool = False) -> Dict:
     """Full-metrics variant used by the api/CLI thread backend.
 
     ``fault_plan`` (a resilience.faults.FaultPlan) turns the run into
@@ -342,9 +343,12 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             comm_wrapper=comm_wrapper,
             health=health_config,
         )
-    if metrics_file is not None:
+    if metrics_file is not None or metrics_live:
         from pydcop_tpu.observability.metrics import CycleSnapshotter
 
+        # metrics_live (no file): a serve-only run still needs the
+        # snapshotter — it is what feeds the live endpoint's cycle/
+        # cost metrics and /events stream (path=None writes nothing).
         orchestrator.metrics_snapshotter = CycleSnapshotter(
             metrics_file, every=metrics_every or 1,
             cost_fn=lambda: orchestrator.current_global_cost()[0],
@@ -360,6 +364,14 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
         orchestrator.deploy_computations()
         if health_monitor is not None:
             health_monitor.start()
+            # The live telemetry endpoint's /healthz reads whichever
+            # monitor is currently registered (cleared in the finally
+            # below, so verdicts never outlive their run).
+            from pydcop_tpu.observability.server import (
+                set_health_provider,
+            )
+
+            set_health_provider(health_monitor.summary)
         if fault_plan is not None and fault_plan.crashes:
             from pydcop_tpu.resilience.faults import (
                 CrashSchedule,
@@ -418,6 +430,11 @@ def solve_with_agents(dcop: DCOP, algo_def, distribution="oneagent",
             monitor.stop()
         if health_monitor is not None:
             health_monitor.stop()
+            from pydcop_tpu.observability.server import (
+                set_health_provider,
+            )
+
+            set_health_provider(None)
         if not stopped:
             orchestrator.stop_agents(5)
         orchestrator.stop()

@@ -1,21 +1,27 @@
 """Unified observability: tracing, metrics registry, engine telemetry.
 
-Four parts (docs/observability.md):
+Six parts (docs/observability.md):
 
 - :mod:`.trace` — process-wide :data:`~pydcop_tpu.observability.trace.
   tracer` producing timestamped, parent-correlated spans with Chrome
-  ``trace_event`` and JSONL exporters;
+  ``trace_event`` and JSONL exporters, plus multi-process trace
+  merge/diff tooling;
 - :mod:`.metrics` — :data:`~pydcop_tpu.observability.metrics.registry`
   of counters/gauges/histograms with Prometheus text export and JSONL
   snapshots;
 - :mod:`.engine_probe` — per-chunk honest device timings + cost
   convergence for the jitted solvers;
+- :mod:`.profiler` — XLA cost attribution: measured flops/bytes/peak
+  memory per compiled engine program;
+- :mod:`.server` — live HTTP telemetry endpoint (``/metrics``,
+  ``/healthz``, ``/events``) for scraping a running solve;
 - the instrumentation wired through infrastructure, engine and
   resilience (all guarded on one flag check, zero overhead when off).
 
 :class:`ObservabilitySession` is the run-scoped front door used by
-``api.solve``: it enables the tracer/registry for one solve and
-exports trace + Prometheus files on the way out.
+``api.solve``: it enables the tracer/registry/profiler for one solve,
+optionally serves live telemetry while it runs, and exports trace +
+Prometheus files on the way out.
 """
 
 from typing import Optional
@@ -24,6 +30,11 @@ from pydcop_tpu.observability.metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     registry,
+)
+from pydcop_tpu.observability.profiler import (  # noqa: F401
+    XlaCostProfiler,
+    get_profiler,
+    profiler,
 )
 from pydcop_tpu.observability.trace import (  # noqa: F401
     Tracer,
@@ -37,13 +48,19 @@ class ObservabilitySession:
 
     ``trace_path`` + ``trace_format`` ('chrome'|'jsonl') control the
     trace export; ``metrics_path`` activates the registry's optional
-    instrumentation and, on finish, writes a Prometheus text dump next
-    to the JSONL snapshots (``<metrics_path>.prom``).
+    instrumentation — and the XLA cost profiler, unless
+    ``PYDCOP_XLA_PROFILE=0`` vetoes it — and, on finish, writes a
+    Prometheus text dump next to the JSONL snapshots
+    (``<metrics_path>.prom``).  ``serve_port`` (0 = OS-assigned, see
+    :attr:`server`) additionally serves ``/metrics`` + ``/healthz`` +
+    ``/events`` over HTTP for the duration of the session, so a long
+    run is scrapeable WHILE it runs (observability/server.py).
     """
 
     def __init__(self, trace_path: Optional[str] = None,
                  trace_format: str = "chrome",
-                 metrics_path: Optional[str] = None):
+                 metrics_path: Optional[str] = None,
+                 serve_port: Optional[int] = None):
         if trace_format not in ("chrome", "jsonl"):
             raise ValueError(
                 f"trace_format must be 'chrome' or 'jsonl', got "
@@ -52,21 +69,46 @@ class ObservabilitySession:
         self.trace_path = trace_path
         self.trace_format = trace_format
         self.metrics_path = metrics_path
+        self.serve_port = serve_port
+        self.server = None
         self._was_active = registry.active
+        self._was_profiling = profiler.enabled
 
     def start(self) -> "ObservabilitySession":
+        # Bind the server FIRST: it is the only step that can fail
+        # (port in use), and failing after enabling would leak
+        # tracer/registry/profiler enabled process-wide with no
+        # finish() ever running (the caller never got a session).
+        if self.serve_port is not None:
+            import sys
+
+            from pydcop_tpu.observability.server import TelemetryServer
+
+            self.server = TelemetryServer(port=self.serve_port).start()
+            # The OS picks the port when serve_port=0: announce it, or
+            # nothing can scrape the run it was requested for.
+            print(
+                "telemetry: serving /metrics /healthz /events on "
+                f"{self.server.url}", file=sys.stderr,
+            )
         if self.trace_path:
             tracer.enable()
-        if self.metrics_path:
+        if self.metrics_path or self.serve_port is not None:
             registry.active = True
+            profiler.enabled = True
         return self
 
     def finish(self):
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
         if self.trace_path:
             tracer.disable()
             tracer.export(self.trace_path, self.trace_format)
-        if self.metrics_path:
+        if self.metrics_path or self.serve_port is not None:
             registry.active = self._was_active
+            profiler.enabled = self._was_profiling
+        if self.metrics_path:
             with open(f"{self.metrics_path}.prom", "w",
                       encoding="utf-8") as f:
                 f.write(registry.to_prometheus())

@@ -45,6 +45,12 @@ def _escape(value: str) -> str:
             .replace("\n", "\\n"))
 
 
+def _escape_help(text: str) -> str:
+    # HELP lines terminate at the newline: per the exposition format
+    # only backslash and newline are escaped here (quotes stay raw).
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _format_sample(name: str, key: LabelKey, value: float) -> str:
     if key:
         labels = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
@@ -116,7 +122,7 @@ class Metric:
             return sorted(self._values.items())
 
     def to_prometheus(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}",
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
         lines.extend(
             _format_sample(self.name, key, value)
@@ -204,7 +210,7 @@ class Histogram(Metric):
             return entry[-1] if entry else 0.0
 
     def to_prometheus(self) -> List[str]:
-        lines = [f"# HELP {self.name} {self.help}",
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             items = sorted(self._hist.items())
@@ -338,7 +344,30 @@ class CycleSnapshotter:
     cycle-change report and the cadence check here rate-limits the
     writes.  ``cost_fn`` is only invoked when a snapshot actually
     fires, so per-cycle reports never pay a cost evaluation.
+
+    Fired snapshots are also pushed to listeners — per-instance ones
+    (:meth:`add_listener`) and the class-wide set
+    (:meth:`add_global_listener`), which is how the live telemetry
+    endpoint's ``/events`` SSE stream observes whichever snapshotter
+    the current run happens to drive without holding a reference to
+    it.  Listener errors are swallowed: a slow or dead subscriber
+    must never stall the solve.
     """
+
+    # Class-wide listeners: every instance notifies these.
+    _global_listeners: List = []
+    _global_lock = threading.Lock()
+
+    @classmethod
+    def add_global_listener(cls, fn):
+        with cls._global_lock:
+            cls._global_listeners.append(fn)
+
+    @classmethod
+    def remove_global_listener(cls, fn):
+        with cls._global_lock:
+            if fn in cls._global_listeners:
+                cls._global_listeners.remove(fn)
 
     def __init__(self, path: Optional[str] = None, every: int = 1,
                  reg: Optional[MetricsRegistry] = None,
@@ -357,6 +386,10 @@ class CycleSnapshotter:
         self._cost_g = self.registry.gauge(
             "pydcop_cost", "Cost of the current best-known assignment")
         self.points: List[Tuple[int, Optional[float]]] = []
+        self._listeners: List = []
+
+    def add_listener(self, fn):
+        self._listeners.append(fn)
 
     def __call__(self, cycle: int, cost: Optional[float] = None):
         cycle = int(cycle)
@@ -382,3 +415,11 @@ class CycleSnapshotter:
         if self.path:
             self.registry.write_snapshot(self.path, cycle=cycle,
                                          cost=cost)
+        event = {"ts": time.time(), "cycle": cycle, "cost": cost}
+        with self._global_lock:
+            listeners = self._listeners + self._global_listeners
+        for fn in listeners:
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 — never stall the solve
+                pass

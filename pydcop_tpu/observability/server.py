@@ -1,0 +1,257 @@
+"""Live telemetry endpoint: scrape a RUNNING solve.
+
+Until now metrics only materialized as end-of-run files (JSONL
+snapshots + a ``.prom`` dump) — useless for watching a long
+``pydcop solve`` or orchestrator run while it runs.
+:class:`TelemetryServer` is a stdlib-only (``http.server``) HTTP
+endpoint over the process-wide observability state:
+
+- ``GET /metrics`` — the metrics registry in Prometheus text
+  exposition format (scrape it directly, no pushgateway);
+- ``GET /healthz`` — a JSON health verdict sourced from the active
+  :class:`~pydcop_tpu.resilience.health.HealthMonitor` when one is
+  registered (``alive``/``suspect``/``dead`` statuses per agent;
+  any dead agent turns the endpoint 503) and a plain ``ok`` when
+  none is — orchestration probes work in both modes;
+- ``GET /events`` — a Server-Sent-Events stream of cycle/cost
+  snapshots pushed by whichever
+  :class:`~pydcop_tpu.observability.metrics.CycleSnapshotter` the
+  current run drives (the class-wide listener hook), with keepalive
+  comments while the solve is between chunks.
+
+Lifecycle is owned by
+:class:`~pydcop_tpu.observability.ObservabilitySession` (``api.solve
+(serve_metrics=PORT)`` / ``pydcop solve --serve_metrics PORT``), but
+the server is freestanding — tests and tools start one directly.
+``port=0`` asks the OS for a free port (:attr:`port` reports the
+assignment), which is what keeps parallel test runs collision-free.
+
+The server thread and every connection handler are daemons: a wedged
+scraper can never keep the solve process alive.
+"""
+
+import json
+import logging
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("pydcop.observability.server")
+
+# Process-wide health source: the thread-backend run loop registers its
+# HealthMonitor summary here for the duration of the run (see
+# infrastructure/run.solve_with_agents); /healthz falls back to a plain
+# "ok" when nothing is registered.
+_health_provider: Optional[Callable[[], Dict[str, Any]]] = None
+_health_lock = threading.Lock()
+
+
+def set_health_provider(fn: Optional[Callable[[], Dict[str, Any]]]):
+    """Register (or clear, with ``None``) the process-wide health
+    source consumed by ``/healthz``."""
+    global _health_provider
+    with _health_lock:
+        _health_provider = fn
+
+
+def get_health_provider() -> Optional[Callable[[], Dict[str, Any]]]:
+    with _health_lock:
+        return _health_provider
+
+
+def health_verdict() -> Dict[str, Any]:
+    """The /healthz body: provider data + an overall ``status`` rolled
+    up from per-agent statuses (any dead -> ``failing``, any suspect
+    -> ``degraded``, else ``ok``).  Provider failures report
+    ``unknown`` rather than crashing the probe."""
+    provider = get_health_provider()
+    if provider is None:
+        return {"status": "ok", "detail": "no health monitor active"}
+    try:
+        data = dict(provider())
+    except Exception as exc:  # noqa: BLE001 — probe must answer
+        return {"status": "unknown",
+                "detail": f"health provider failed: {exc}"}
+    statuses = data.get("statuses", {})
+    if any(s == "dead" for s in statuses.values()):
+        status = "failing"
+    elif any(s == "suspect" for s in statuses.values()):
+        status = "degraded"
+    else:
+        status = "ok"
+    data.setdefault("status", status)
+    return data
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set per-server via the factory in TelemetryServer.start().
+    telemetry: "TelemetryServer"
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: N802 — stdlib name
+        logger.debug("telemetry %s", fmt % args)
+
+    def _reply(self, code: int, body: bytes, content_type: str):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — stdlib name
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.telemetry.registry.to_prometheus().encode()
+            self._reply(
+                200, body,
+                "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            verdict = health_verdict()
+            code = 503 if verdict.get("status") == "failing" else 200
+            self._reply(code, json.dumps(verdict).encode(),
+                        "application/json")
+        elif path == "/events":
+            self._stream_events()
+        else:
+            self._reply(404, b'{"error": "unknown path"}',
+                        "application/json")
+
+    def _stream_events(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        # SSE is an unbounded body: no Content-Length, close delimits.
+        self.send_header("Connection", "close")
+        self.end_headers()
+        q = self.telemetry._subscribe()
+        try:
+            # Replay the latest snapshot so a client connecting between
+            # chunks sees state immediately, not on the next boundary.
+            last = self.telemetry.last_event
+            if last is not None:
+                self._write_event(last)
+            while not self.telemetry._stopping.is_set():
+                try:
+                    event = q.get(timeout=1.0)
+                except queue.Empty:
+                    self.wfile.write(b": keepalive\n\n")
+                    self.wfile.flush()
+                    continue
+                self._write_event(event)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away — normal SSE termination
+        finally:
+            self.telemetry._unsubscribe(q)
+
+    def _write_event(self, event: Dict[str, Any]):
+        payload = json.dumps(event, default=str).encode()
+        self.wfile.write(b"data: " + payload + b"\n\n")
+        self.wfile.flush()
+
+
+class TelemetryServer:
+    """Serve /metrics, /healthz and /events for the process-wide
+    observability state.  ``start()`` binds (``port=0`` = OS-assigned,
+    see :attr:`port`) and serves from a daemon thread; ``stop()``
+    shuts down and unhooks the snapshot listener."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry=None):
+        from pydcop_tpu.observability.metrics import (
+            registry as default_registry,
+        )
+
+        self.host = host
+        self._requested_port = port
+        self.registry = (registry if registry is not None
+                         else default_registry)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        self._subscribers: List[queue.Queue] = []
+        self._sub_lock = threading.Lock()
+        self.last_event: Optional[Dict[str, Any]] = None
+
+    # -- snapshot fan-out ---------------------------------------------- #
+
+    def _subscribe(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue(maxsize=256)
+        with self._sub_lock:
+            self._subscribers.append(q)
+        return q
+
+    def _unsubscribe(self, q: queue.Queue):
+        with self._sub_lock:
+            if q in self._subscribers:
+                self._subscribers.remove(q)
+
+    def _on_snapshot(self, event: Dict[str, Any]):
+        self.last_event = event
+        with self._sub_lock:
+            subscribers = list(self._subscribers)
+        for q in subscribers:
+            try:
+                q.put_nowait(event)
+            except queue.Full:
+                # Slow consumer: drop the oldest so the stream stays
+                # current instead of stalling the producer.
+                try:
+                    q.get_nowait()
+                    q.put_nowait(event)
+                except (queue.Empty, queue.Full):
+                    pass
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    @property
+    def port(self) -> Optional[int]:
+        if self._httpd is None:
+            return None
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> Optional[str]:
+        port = self.port
+        return None if port is None else f"http://{self.host}:{port}"
+
+    def start(self) -> "TelemetryServer":
+        from pydcop_tpu.observability.metrics import CycleSnapshotter
+
+        if self._httpd is not None:
+            return self
+        handler = type("BoundHandler", (_Handler,),
+                       {"telemetry": self})
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), handler)
+        self._httpd.daemon_threads = True
+        self._stopping.clear()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="pydcop-telemetry", daemon=True)
+        self._thread.start()
+        CycleSnapshotter.add_global_listener(self._on_snapshot)
+        logger.info("telemetry server listening on %s", self.url)
+        return self
+
+    def stop(self):
+        from pydcop_tpu.observability.metrics import CycleSnapshotter
+
+        if self._httpd is None:
+            return
+        CycleSnapshotter.remove_global_listener(self._on_snapshot)
+        self._stopping.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self) -> "TelemetryServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -21,17 +21,62 @@ whole causal tree of a chaos run.  Chrome ``trace_event`` output loads
 directly in ``chrome://tracing`` / Perfetto (spans are ``ph:"X"``
 complete events, instants ``ph:"i"``); JSONL output is one event per
 line for ad-hoc ``jq``/pandas processing.
+
+Multi-process runs: every exported file carries a HEADER with the
+process/host identity and a monotonic-to-wall clock anchor
+(``perf_counter`` timestamps are only comparable within one process).
+:func:`merge_traces` uses the anchors to align N per-process traces
+onto one wall-clock axis and namespaces their thread lanes, so a
+distributed run collapses into a single well-nested Perfetto tab;
+:func:`diff_trace_summaries` compares two traces span-name by
+span-name (count/total/p50 deltas, regression flags) — the ``pydcop
+trace merge`` / ``trace diff`` commands drive both.
 """
 
 import itertools
 import json
 import os
+import socket
 import threading
 import time
 from collections import defaultdict
-from typing import Any, Dict, Iterable, List, Optional
+from typing import (
+    Any,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 _US = 1e6  # trace_event timestamps are microseconds
+
+HEADER_KEY = "pydcop_trace_header"
+_HEADER_VERSION = 1
+
+
+class TraceFileError(ValueError):
+    """A trace file that cannot be read as events: missing, empty,
+    truncated mid-write, or not Chrome-JSON/JSONL at all.  Commands
+    catch this and print the message instead of a traceback."""
+
+
+def trace_header() -> Dict[str, Any]:
+    """Identity + clock anchor stamped into every exported trace.
+
+    ``anchor_perf_us`` and ``anchor_unix_us`` are sampled
+    back-to-back: their difference maps this process's
+    ``perf_counter`` timeline onto the wall clock, which is what lets
+    :func:`merge_traces` align traces from different processes (each
+    process's perf_counter has an arbitrary epoch)."""
+    return {
+        "version": _HEADER_VERSION,
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "anchor_perf_us": time.perf_counter() * _US,
+        "anchor_unix_us": time.time() * _US,
+    }
 
 
 class _NoopSpan:
@@ -232,16 +277,24 @@ class Tracer:
         tmp = f"{path}.tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(
-                {"traceEvents": trace_events, "displayTimeUnit": "ms"},
+                {
+                    "traceEvents": trace_events,
+                    "displayTimeUnit": "ms",
+                    # Viewers ignore unknown top-level keys; trace
+                    # merge reads the identity + clock anchor here.
+                    HEADER_KEY: trace_header(),
+                },
                 f, default=str,
             )
         os.replace(tmp, path)
 
     def export_jsonl(self, path: str):
-        """One JSON event per line (jq/pandas-friendly)."""
+        """One JSON event per line (jq/pandas-friendly); the first
+        line is the process-identity/clock-anchor header."""
         names = self.thread_names()
         tmp = f"{path}.tmp"
         with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({HEADER_KEY: trace_header()}) + "\n")
             for ev in self.events():
                 row = dict(ev)
                 row["thread"] = names.get(ev["tid"], str(ev["tid"]))
@@ -270,29 +323,305 @@ def get_tracer() -> Tracer:
 # trace-file readback + analysis (pydcop trace summary, make trace-demo)
 
 
-def load_trace_file(path: str) -> List[Dict[str, Any]]:
-    """Load events from a Chrome-trace JSON or a JSONL trace file.
+def _parse_trace(path: str) -> Tuple[Optional[Dict[str, Any]],
+                                     List[Dict[str, Any]],
+                                     Dict[Any, str]]:
+    """Internal loader: ``(header, events, thread_names)``.
 
-    Returns the normalized internal event shape (name/cat/ph/ts/dur/
-    tid/args); Chrome metadata events (``ph:"M"``) are dropped.
+    ``thread_names`` maps tid -> label, recovered from Chrome
+    ``thread_name`` metadata events or per-event ``thread`` fields
+    (JSONL) — :func:`merge_traces` labels merged lanes with these.
     """
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as exc:
+        raise TraceFileError(f"cannot read trace file {path}: {exc}")
+    if not text.strip():
+        raise TraceFileError(f"trace file {path} is empty")
+    header: Optional[Dict[str, Any]] = None
     try:
         # One JSON document: the Chrome container, a bare list, or a
         # single-line JSONL file (one event object).
         data = json.loads(text)
         if isinstance(data, dict):
+            header = data.get(HEADER_KEY)
             events = data.get("traceEvents")
             if events is None:
+                if "ph" not in data:
+                    raise TraceFileError(
+                        f"{path} parsed as JSON but is not a trace "
+                        "(no traceEvents list, not an event object)")
                 events = [data]
         else:
             events = data
-    except json.JSONDecodeError:
-        # Multiple documents: JSONL, one event per line.
-        events = [json.loads(line) for line in text.splitlines()
-                  if line.strip()]
-    return [ev for ev in events if ev.get("ph") != "M"]
+    except json.JSONDecodeError as exc:
+        # Multiple documents: JSONL, one event per line.  A line that
+        # does not parse means a truncated/corrupt file — say so.
+        events = []
+        for n, line in enumerate(text.splitlines(), start=1):
+            if not line.strip():
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                if n == 1:
+                    raise TraceFileError(
+                        f"{path} is neither Chrome-trace JSON "
+                        f"({exc}) nor JSONL (line 1 unparsable)"
+                    )
+                raise TraceFileError(
+                    f"trace file {path} is truncated or corrupt: "
+                    f"line {n} is not valid JSON"
+                )
+            if isinstance(row, dict) and HEADER_KEY in row:
+                header = row[HEADER_KEY]
+                continue
+            events.append(row)
+    if not isinstance(events, list):
+        raise TraceFileError(
+            f"{path} parsed as JSON but holds no event list")
+    names: Dict[Any, str] = {}
+    kept = []
+    for ev in events:
+        if not isinstance(ev, dict) or "ph" not in ev:
+            continue
+        if ev.get("ph") == "M":
+            if ev.get("name") == "thread_name":
+                label = (ev.get("args") or {}).get("name")
+                if label:
+                    names[ev.get("tid")] = str(label)
+            continue
+        if ev.get("thread"):
+            names.setdefault(ev.get("tid"), str(ev["thread"]))
+        kept.append(ev)
+    if events and not kept:
+        raise TraceFileError(
+            f"{path} parsed as JSON but holds no trace events")
+    return header, kept, names
+
+
+def load_trace(path: str
+               ) -> Tuple[Optional[Dict[str, Any]],
+                          List[Dict[str, Any]]]:
+    """Load ``(header, events)`` from a Chrome-trace JSON or JSONL
+    trace file.
+
+    ``header`` is the process-identity/clock-anchor record written by
+    the exporters (None for traces from before headers existed).
+    Events come back in the normalized internal shape (name/cat/ph/
+    ts/dur/tid/args); Chrome metadata events (``ph:"M"``) and the
+    header row are dropped from the event list.
+
+    Raises :class:`TraceFileError` — never a bare decode traceback —
+    on a missing, empty, truncated or non-trace file.
+    """
+    header, events, _ = _parse_trace(path)
+    return header, events
+
+
+def load_trace_file(path: str) -> List[Dict[str, Any]]:
+    """Events only — see :func:`load_trace` for the (header, events)
+    form and the error contract."""
+    return load_trace(path)[1]
+
+
+def merge_traces(paths: Sequence[str], out_path: str
+                 ) -> Dict[str, Any]:
+    """Align and merge N per-process trace files into one Chrome
+    trace; returns a summary dict (files, events, lanes, offsets).
+
+    Alignment: each file's header anchors its process-local
+    ``perf_counter`` timeline to the wall clock, so events are
+    rebased as ``ts + (anchor_unix_us - anchor_perf_us)`` — after
+    which all files share one axis — then shifted so the earliest
+    merged event sits at 0.  When ANY input lacks an anchor
+    (headerless legacy trace), wall-clock alignment is impossible, so
+    EVERY file degrades to starting at 0 on the merged axis —
+    mixing a wall-rebased file with a raw-``perf_counter`` one would
+    otherwise scatter the lanes decades apart.  The summary's
+    ``aligned`` flag says which mode applied.
+
+    Lanes: every (file, tid) pair maps to a FRESH merged tid, so two
+    processes' thread-1 lanes can never collide, and each lane is
+    labeled ``host:pid thread-name`` (thread names recovered from
+    Chrome ``thread_name`` metadata or JSONL ``thread`` fields).
+    Span correlation ids are namespaced per file for the same reason.
+    Per-lane nesting is preserved (a uniform per-file shift cannot
+    reorder spans within a lane), so ``check_well_nested`` holds on
+    the merged trace iff it held on the inputs.
+    """
+    if len(paths) < 2:
+        raise TraceFileError("trace merge needs at least two files")
+    loaded = []
+    for path in paths:
+        header, events, names = _parse_trace(path)
+        loaded.append((path, header, events, names))
+    anchored = [
+        bool(header and "anchor_unix_us" in header
+             and "anchor_perf_us" in header)
+        for _, header, _, _ in loaded
+    ]
+    aligned = all(anchored)
+    offsets = []
+    for (path, header, events, _), has_anchor in zip(loaded, anchored):
+        if aligned:
+            offsets.append(float(header["anchor_unix_us"])
+                           - float(header["anchor_perf_us"]))
+        else:
+            # Degraded mode: rebase each file to its own first event.
+            offsets.append(-min(
+                (float(ev["ts"]) for ev in events if "ts" in ev),
+                default=0.0,
+            ))
+    base = min(
+        (float(ev["ts"]) + off
+         for (_, _, events, _), off in zip(loaded, offsets)
+         for ev in events if "ts" in ev),
+        default=0.0,
+    )
+    lane_map: Dict[Tuple[int, Any], int] = {}
+    lane_names: Dict[int, str] = {}
+    merged: List[Dict[str, Any]] = []
+    _ID_STRIDE = 10 ** 9  # far above any single-process span count
+
+    def _lane(fi: int, tid, label: str) -> int:
+        key = (fi, tid)
+        if key not in lane_map:
+            lane_map[key] = len(lane_map) + 1
+            lane_names[lane_map[key]] = label
+        return lane_map[key]
+
+    for fi, ((path, header, events, names), off) in enumerate(
+            zip(loaded, offsets)):
+        who = (f"{header.get('host', '?')}:{header.get('pid', '?')}"
+               if header else f"file{fi}")
+        for ev in events:
+            out = dict(ev)
+            out["ts"] = float(ev.get("ts", 0.0)) + off - base
+            thread = (names.get(ev.get("tid"))
+                      or str(ev.get("tid", "?")))
+            out["tid"] = _lane(fi, ev.get("tid"), f"{who} {thread}")
+            out.pop("thread", None)
+            # Correlation ids (top-level in JSONL events, inside args
+            # for re-loaded Chrome exports): namespace per file so
+            # cross-process id reuse cannot fake a parent link.
+            # Integer ids only — foreign Chrome traces (JAX profiler,
+            # chrome://tracing async events) carry string ids like
+            # "0x42", which pass through untouched rather than crash.
+            for holder, id_key, parent_key in (
+                    (out, "id", "parent"),
+                    (out.get("args") or {}, "span_id", "parent_id")):
+                for k in (id_key, parent_key):
+                    value = holder.get(k)
+                    if isinstance(value, int) and value:
+                        holder[k] = value + fi * _ID_STRIDE
+            merged.append(out)
+    merged.sort(key=lambda e: e["ts"])
+    trace_events = [
+        {"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+         "args": {"name": name}}
+        for tid, name in sorted(lane_names.items())
+    ]
+    for ev in merged:
+        out = {
+            "name": ev.get("name"), "cat": ev.get("cat", "default"),
+            "ph": ev.get("ph"), "ts": ev["ts"], "pid": 0,
+            "tid": ev["tid"], "args": dict(ev.get("args") or {}),
+        }
+        if ev.get("ph") == "X":
+            out["dur"] = ev.get("dur", 0.0)
+        else:
+            out["s"] = "t"
+        if ev.get("id"):
+            out["args"].setdefault("span_id", ev["id"])
+        if ev.get("parent"):
+            out["args"].setdefault("parent_id", ev["parent"])
+        trace_events.append(out)
+    tmp = f"{out_path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({
+            "traceEvents": trace_events,
+            "displayTimeUnit": "ms",
+            HEADER_KEY: {
+                "version": _HEADER_VERSION,
+                "merged_from": [
+                    {"path": p, "header": h, "clock_anchor": anch}
+                    for (p, h, _, _), anch in zip(loaded, anchored)
+                ],
+                "aligned": aligned,
+            },
+        }, f, default=str)
+    os.replace(tmp, out_path)
+    return {
+        "files": len(paths),
+        "events": len(merged),
+        "lanes": len(lane_names),
+        "anchored": sum(anchored),
+        "aligned": aligned,
+        "span_us": (merged[-1]["ts"] - merged[0]["ts"]
+                    if merged else 0.0),
+    }
+
+
+def _per_name_stats(events: Iterable[Dict[str, Any]]
+                    ) -> Dict[str, Dict[str, float]]:
+    durs: Dict[str, List[float]] = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") == "X":
+            durs[ev.get("name") or "?"].append(
+                float(ev.get("dur", 0.0)) / 1000.0)
+        elif ev.get("ph") == "i":
+            durs[ev.get("name") or "?"].append(0.0)
+    out = {}
+    for name, values in durs.items():
+        values.sort()
+        out[name] = {
+            "count": len(values),
+            "total_ms": sum(values),
+            "p50_ms": values[len(values) // 2] if values else 0.0,
+        }
+    return out
+
+
+def diff_trace_summaries(events_a: Iterable[Dict[str, Any]],
+                         events_b: Iterable[Dict[str, Any]],
+                         threshold: float = 0.25,
+                         min_delta_ms: float = 1.0,
+                         ) -> List[Dict[str, Any]]:
+    """Per-span-name deltas between two traces (A = baseline, B =
+    candidate): count, total and p50 duration on each side, and a
+    ``regressed`` flag when B's total grew beyond ``threshold``
+    (relative) AND ``min_delta_ms`` (absolute — spans in the noise
+    floor never flag).  Span names present on only one side are
+    reported with zeros on the other; a name absent from A has no
+    defined relative growth, so ``delta_rel`` is None there (NOT
+    float('inf'), which json.dumps would emit as the non-JSON token
+    ``Infinity``) and only the absolute floor gates its flag.
+    Sorted by absolute total delta, largest first."""
+    stats_a = _per_name_stats(events_a)
+    stats_b = _per_name_stats(events_b)
+    rows = []
+    for name in sorted(set(stats_a) | set(stats_b)):
+        a = stats_a.get(name, {"count": 0, "total_ms": 0.0,
+                               "p50_ms": 0.0})
+        b = stats_b.get(name, {"count": 0, "total_ms": 0.0,
+                               "p50_ms": 0.0})
+        delta = b["total_ms"] - a["total_ms"]
+        rel = (delta / a["total_ms"] if a["total_ms"] > 0
+               else (None if delta > 0 else 0.0))
+        rows.append({
+            "name": name,
+            "count_a": a["count"], "count_b": b["count"],
+            "total_ms_a": a["total_ms"], "total_ms_b": b["total_ms"],
+            "p50_ms_a": a["p50_ms"], "p50_ms_b": b["p50_ms"],
+            "delta_total_ms": delta,
+            "delta_rel": rel,
+            "regressed": (delta >= min_delta_ms
+                          and (rel is None or rel >= threshold)),
+        })
+    rows.sort(key=lambda r: -abs(r["delta_total_ms"]))
+    return rows
 
 
 def summarize_spans(events: Iterable[Dict[str, Any]],

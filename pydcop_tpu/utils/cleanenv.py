@@ -25,6 +25,65 @@ DIAG_ENV = "PYDCOP_BENCH_DIAG"
 # Original accelerator plugin setting, saved before scrubbing so a CPU
 # fallback child can still probe (and revive into) the TPU backend.
 SAVED_AXON_ENV = "PYDCOP_SAVED_AXON"
+# Probe timeout override (seconds): one env var governs every probe —
+# startup retries AND the revival probe — so a slow-but-alive tunnel
+# can be given more rope without editing two call sites.
+PROBE_TIMEOUT_ENV = "PYDCOP_BENCH_PROBE_TIMEOUT"
+
+
+def default_probe_timeout(default=120.0):
+    """The probe timeout in seconds: ``PYDCOP_BENCH_PROBE_TIMEOUT``
+    when set (and parseable, and positive), else ``default``."""
+    raw = os.environ.get(PROBE_TIMEOUT_ENV)
+    if raw is None:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        return default
+    return value if value > 0 else default
+
+
+def _probe_failure_reason(error):
+    """Short label for the failure-counter: 'timeout' vs 'init_error'
+    (non-zero exit / import crash)."""
+    if error and str(error).startswith("timeout"):
+        return "timeout"
+    return "init_error"
+
+
+def _observe_probe_event(kind, details):
+    """Mirror a diagnostic event into the observability plane: failed
+    probes and fallbacks count in
+    ``pydcop_bench_probe_failures_total{reason}`` and every event is a
+    ``bench_probe`` trace instant while tracing is on.  Import is
+    deferred and failure-swallowed — diagnostics must work in the
+    most broken environments (that is their job).
+
+    Deliberately NOT gated on ``registry.active``: CLI probes fire
+    BEFORE api.solve opens its ObservabilitySession, so gating would
+    hide exactly the failures a later live scrape exists to surface.
+    The label set is bounded (timeout / init_error / fallback kinds),
+    and probe trouble is process-level state, not per-solve detail —
+    a .prom dump that includes it is attributing correctly."""
+    try:
+        from pydcop_tpu.observability.metrics import registry
+        from pydcop_tpu.observability.trace import tracer
+    except Exception:  # noqa: BLE001
+        return
+    failed = (
+        kind in ("cpu_fallback", "child_timeout", "child_failed")
+        or (kind.endswith("probe") and details.get("ok") is False)
+    )
+    if failed:
+        reason = (kind if not kind.endswith("probe")
+                  else _probe_failure_reason(details.get("error")))
+        registry.counter(
+            "pydcop_bench_probe_failures_total",
+            "Accelerator probe / bench supervision failures by reason",
+        ).inc(reason=reason)
+    if tracer.enabled:
+        tracer.instant("bench_probe", "bench", kind=kind, **details)
 
 
 def diag_events():
@@ -38,11 +97,16 @@ def diag_events():
 
 def record_diag(kind, **details):
     """Append an event to the in-env diagnostic log and return the
-    full log.  Timestamps are unix seconds."""
+    full log.  Timestamps are unix seconds.  Each event is also
+    mirrored into the metrics registry / tracer
+    (``pydcop_bench_probe_failures_total{reason}`` + ``bench_probe``
+    instants) so probe trouble is visible to a live scrape, not only
+    in the post-hoc JSON line."""
     events = diag_events()
     events.append({"unix": round(time.time(), 1), "event": kind,
                    **details})
     os.environ[DIAG_ENV] = json.dumps(events)
+    _observe_probe_event(kind, details)
     return events
 
 
@@ -94,7 +158,7 @@ def scrubbed_cpu_env(n_devices=None, base=None):
     return env
 
 
-def ensure_live_backend(tag="bench", retries=1, probe_timeout=120,
+def ensure_live_backend(tag="bench", retries=1, probe_timeout=None,
                         backoff=10.0):
     """Guard a benchmark entry point against a wedged TPU tunnel.
 
@@ -116,13 +180,16 @@ def ensure_live_backend(tag="bench", retries=1, probe_timeout=120,
         cpu_fallback_exec(tag)
 
 
-def probe_with_retries(tag, retries, probe_timeout=120, backoff=10.0):
+def probe_with_retries(tag, retries, probe_timeout=None, backoff=10.0):
     """Probe the backend up to ``retries`` times with ``backoff``
     seconds between failures (none after the last), recording every
     attempt in the diagnostic log.  Returns True when a probe
-    succeeds."""
+    succeeds.  ``probe_timeout=None`` resolves through
+    ``PYDCOP_BENCH_PROBE_TIMEOUT`` (default 120 s)."""
     import sys
 
+    if probe_timeout is None:
+        probe_timeout = default_probe_timeout()
     for attempt in range(retries):
         ok, error, dt = probe_backend(probe_timeout)
         record_diag(

@@ -144,9 +144,13 @@ class TestTracer:
         path = str(tmp_path / "trace.jsonl")
         t.export_jsonl(path)
         rows = [json.loads(line) for line in open(path)]
-        assert rows[0]["name"] == "x"
-        assert rows[0]["args"] == {"k": "v"}
-        assert "thread" in rows[0]
+        # Line 1 is the process-identity/clock-anchor header (ISSUE 5
+        # multi-process merge); events follow.
+        assert "pydcop_trace_header" in rows[0]
+        assert rows[1]["name"] == "x"
+        assert rows[1]["args"] == {"k": "v"}
+        assert "thread" in rows[1]
+        # load_trace_file returns events only (header excluded).
         assert load_trace_file(path)[0]["name"] == "x"
 
     def test_multithreaded_buffers(self):
@@ -427,8 +431,14 @@ class TestEngineProbe:
     def test_probed_solve_curve_matches_reported_cost(self, tmp_path):
         from pydcop_tpu.api import solve
 
+        from pydcop_tpu.observability.metrics import registry
+
         metrics_file = str(tmp_path / "m.jsonl")
         trace_file = str(tmp_path / "t.json")
+        # The cycle counter is process-global and monotone across
+        # solves: assert this solve's DELTA, not an absolute value
+        # that depends on what ran before in the process.
+        cycles_before = registry.value("pydcop_cycles_total")
         res = solve(
             _ring_dcop(), "maxsum", backend="device", max_cycles=80,
             trace=trace_file, metrics_file=metrics_file,
@@ -447,7 +457,8 @@ class TestEngineProbe:
         snap_cycles = [r["cycle"] for r in rows]
         assert snap_cycles == sorted(snap_cycles)
         total = rows[-1]["metrics"]["pydcop_cycles_total"]
-        assert total["samples"][0]["value"] == snap_cycles[-1]
+        assert total["samples"][0]["value"] - cycles_before \
+            == snap_cycles[-1]
         # Prometheus dump parses.
         prom = open(metrics_file + ".prom").read()
         assert "# HELP pydcop_cycles_total" in prom

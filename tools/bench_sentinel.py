@@ -1,0 +1,268 @@
+"""Bench regression sentinel: run-over-run guard on the BENCH_r*.json
+trajectory.
+
+Every PR's driver appends a ``BENCH_r<N>.json`` (the supervised
+``bench.py`` line, wrapped with attempt metadata) and TPU runs persist
+``BENCH_TPU_LAST.json`` — but until now nothing ever COMPARED them, so
+a perf regression only surfaced when a human eyeballed the numbers.
+This tool parses the whole history, builds a noise-aware baseline per
+backend (CPU-fallback and TPU rates differ by orders of magnitude and
+must never share a baseline), and fails when the newest run regresses
+beyond threshold.
+
+Noise model: the baseline is the MEDIAN of the trailing window with a
+MAD (median absolute deviation) spread — both robust to the single
+wild outlier a wedged-tunnel run produces.  The newest value regresses
+when it falls below ``median - max(rel_tol * median, mad_mult * MAD)``:
+the relative term guards stable series (MAD ~ 0 would otherwise flag
+every wiggle), the MAD term widens tolerance on genuinely noisy
+series (shared-CPU benchmark hosts jitter ±15% run to run).
+
+Usage::
+
+    python tools/bench_sentinel.py             # report + exit 1 on
+                                               # regression (make
+                                               # bench-check)
+    python tools/bench_sentinel.py --json      # machine-readable
+    python tools/bench_sentinel.py --root DIR  # history elsewhere
+
+``make test`` runs it ADVISORY (report printed, failures don't gate:
+a slow shared host must not block an unrelated PR); ``make
+bench-check`` is the hard gate for perf-focused work.  Each series
+also prints a one-line sparkline trajectory suitable for pasting into
+CHANGES.md.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional
+
+DEFAULT_REL_TOL = 0.15
+DEFAULT_MAD_MULT = 3.0
+DEFAULT_WINDOW = 5
+MIN_POINTS = 3  # newest + at least 2 history points to call anything
+
+_SPARKS = "▁▂▃▄▅▆▇█"
+
+
+def load_history(root: str) -> List[Dict[str, Any]]:
+    """All bench runs in chronological order: ``BENCH_r*.json`` (by
+    round number), plus ``BENCH_TPU_LAST.json`` ONLY when no round
+    ever ran on TPU — the artifact has no position in the round
+    chronology, so once real TPU rounds exist it must not masquerade
+    as "the newest run" (a stale artifact would be judged instead of
+    the actual latest round); with zero TPU rounds it is the only
+    TPU evidence and seeds the series instead.
+
+    Unreadable or value-less files are skipped with a note in the
+    returned rows (``"skipped"`` entries), never a crash — the history
+    predates this tool and its earliest rows are ragged.
+    """
+    runs: List[Dict[str, Any]] = []
+    # Round files strictly: the glob also matches names like
+    # BENCH_rerun.json, which have no round number to sort by.
+    numbered = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        match = re.fullmatch(r"BENCH_r(\d+)\.json",
+                             os.path.basename(path))
+        if match:
+            numbered.append((int(match.group(1)), path))
+    paths = [p for _, p in sorted(numbered)]
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            runs.append({"source": name, "skipped": str(exc)})
+            continue
+        parsed = doc.get("parsed") or {}
+        value = parsed.get("value")
+        if value is None:
+            runs.append({"source": name,
+                         "skipped": "no parsed.value"})
+            continue
+        runs.append({
+            "source": name,
+            "n": doc.get("n"),
+            "value": float(value),
+            # Rounds 1-5 all fell back to CPU; the earliest line
+            # predates the backend key, so absent means cpu.
+            "backend": parsed.get("backend") or "cpu",
+        })
+    last_path = os.path.join(root, "BENCH_TPU_LAST.json")
+    have_tpu_round = any(r.get("backend") == "tpu" for r in runs)
+    if os.path.exists(last_path) and not have_tpu_round:
+        try:
+            with open(last_path, encoding="utf-8") as f:
+                doc = json.load(f)
+            value = doc.get("value")
+            if value is not None:
+                runs.append({
+                    "source": "BENCH_TPU_LAST.json",
+                    "n": None,
+                    "value": float(value),
+                    "backend": doc.get("backend") or "tpu",
+                })
+        except (OSError, ValueError) as exc:
+            runs.append({"source": "BENCH_TPU_LAST.json",
+                         "skipped": str(exc)})
+    return runs
+
+
+def check_series(values: List[float],
+                 rel_tol: float = DEFAULT_REL_TOL,
+                 mad_mult: float = DEFAULT_MAD_MULT,
+                 window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
+    """Verdict for one backend's chronological cycles/s series.
+
+    The newest value is judged against the median ± MAD of the
+    ``window`` runs before it.  Returns a dict with the verdict
+    (``ok`` / ``regressed`` / ``insufficient``), the baseline stats
+    and the tolerance actually applied."""
+    if len(values) < MIN_POINTS:
+        return {
+            "verdict": "insufficient",
+            "points": len(values),
+            "detail": f"need >= {MIN_POINTS} runs to judge",
+        }
+    newest = values[-1]
+    trail = values[-(window + 1):-1]
+    med = statistics.median(trail)
+    mad = statistics.median([abs(v - med) for v in trail])
+    tolerance = max(rel_tol * med, mad_mult * mad)
+    floor = med - tolerance
+    regressed = newest < floor
+    return {
+        "verdict": "regressed" if regressed else "ok",
+        "points": len(values),
+        "newest": newest,
+        "median": med,
+        "mad": mad,
+        "tolerance": tolerance,
+        "floor": floor,
+        "delta_rel": (newest - med) / med if med else 0.0,
+    }
+
+
+def sparkline(values: List[float]) -> str:
+    """One block-character per run, scaled to the series range — the
+    pasteable trajectory line."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARKS[3] * len(values)
+    return "".join(
+        _SPARKS[min(int((v - lo) / span * (len(_SPARKS) - 1)),
+                    len(_SPARKS) - 1)]
+        for v in values
+    )
+
+
+def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
+              mad_mult: float = DEFAULT_MAD_MULT,
+              window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
+    """Full sentinel pass over a history directory: per-backend
+    verdicts + summary lines.  ``failed`` is True iff any backend
+    with enough history regressed."""
+    runs = load_history(root)
+    skipped = [r for r in runs if "skipped" in r]
+    by_backend: Dict[str, List[Dict[str, Any]]] = {}
+    for r in runs:
+        if "skipped" in r:
+            continue
+        by_backend.setdefault(r["backend"], []).append(r)
+    series = {}
+    lines = []
+    failed = False
+    for backend in sorted(by_backend):
+        rows = by_backend[backend]
+        values = [r["value"] for r in rows]
+        result = check_series(values, rel_tol=rel_tol,
+                              mad_mult=mad_mult, window=window)
+        result["values"] = values
+        result["sources"] = [r["source"] for r in rows]
+        series[backend] = result
+        spark = sparkline(values)
+        if result["verdict"] == "insufficient":
+            lines.append(
+                f"bench[{backend}] {spark} "
+                f"{values[0]:.0f}→{values[-1]:.0f} cycles/s — "
+                f"{result['detail']} ({result['points']} run(s))"
+            )
+            continue
+        direction = f"{result['delta_rel']:+.1%}"
+        lines.append(
+            f"bench[{backend}] {spark} "
+            f"{values[0]:.0f}→{values[-1]:.0f} cycles/s, newest "
+            f"{direction} vs median {result['median']:.0f} "
+            f"(floor {result['floor']:.0f}) "
+            f"{'REGRESSED' if result['verdict'] == 'regressed' else 'OK'}"
+        )
+        if result["verdict"] == "regressed":
+            failed = True
+    return {
+        "root": root,
+        "runs": len(runs),
+        "skipped": [r["source"] for r in skipped],
+        "series": series,
+        "lines": lines,
+        "failed": failed,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="bench regression sentinel over BENCH_r*.json")
+    parser.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    parser.add_argument("--threshold", type=float,
+                        default=DEFAULT_REL_TOL,
+                        help="relative regression tolerance "
+                             f"(default {DEFAULT_REL_TOL})")
+    parser.add_argument("--mad-mult", type=float,
+                        default=DEFAULT_MAD_MULT,
+                        help="MAD multiples added to the tolerance "
+                             f"(default {DEFAULT_MAD_MULT})")
+    parser.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                        help="trailing runs in the baseline "
+                             f"(default {DEFAULT_WINDOW})")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the full verdict as JSON")
+    args = parser.parse_args(argv)
+
+    report = run_check(args.root, rel_tol=args.threshold,
+                       mad_mult=args.mad_mult, window=args.window)
+    if args.as_json:
+        print(json.dumps(report))
+        return 1 if report["failed"] else 0
+    if not report["series"]:
+        print(f"bench_sentinel: no usable bench history under "
+              f"{args.root}")
+        return 0
+    for line in report["lines"]:
+        print(line)
+    if report["skipped"]:
+        print(f"bench_sentinel: skipped unreadable: "
+              f"{', '.join(report['skipped'])}")
+    if report["failed"]:
+        print("bench_sentinel: FAIL — newest run regressed beyond "
+              "the noise-aware floor (median - max(rel_tol*median, "
+              "mad_mult*MAD) of the trailing window)")
+        return 1
+    print("bench_sentinel: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

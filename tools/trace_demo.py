@@ -3,7 +3,11 @@
 artifacts validate — the Chrome trace loads as JSON with well-nested
 spans and the expected span kinds, the metrics JSONL parses with a
 monotone cycle counter, the Prometheus dump is well-formed, and
-``pydcop trace summary`` aggregates the file without error.
+``pydcop trace summary`` aggregates the file without error.  A live
+telemetry leg then starts the HTTP endpoint on port 0, scrapes
+``/metrics`` twice MID-RUN around an advancing segmented solve, and
+asserts both scrapes parse with a strictly increasing
+``pydcop_cycles_total`` (plus ``/healthz`` answering 200).
 
 Run: ``make trace-demo`` (part of ``make test``).  Exit 0 = clean.
 """
@@ -13,6 +17,9 @@ import os
 import re
 import sys
 import tempfile
+import threading
+import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -116,13 +123,110 @@ def main() -> int:
             if not line.startswith("#") and not _PROM_SAMPLE.match(line):
                 return fail(f"unparsable prometheus sample: {line!r}")
 
-        # 4. The summary command aggregates the trace without error.
+        # 4. The summary command aggregates the trace without error —
+        # in both human and machine form.
         rc = cli_main(["trace", "summary", trace_file])
         if rc != 0:
             return fail(f"pydcop trace summary exited {rc}")
+        rc = cli_main(["trace", "summary", "--json", trace_file])
+        if rc != 0:
+            return fail(f"pydcop trace summary --json exited {rc}")
 
-    print("trace_demo: OK (trace + metrics + summary all validate)")
+        # 5. Live telemetry endpoint, scraped MID-RUN.
+        err = check_live_endpoint(dcop_file)
+        if err:
+            return fail(err)
+
+    print("trace_demo: OK (trace + metrics + summary + live "
+          "endpoint all validate)")
     return 0
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _parse_prom(text: str, what: str):
+    """Validate Prometheus text; return the parsed samples dict or an
+    error string."""
+    samples = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        if not _PROM_SAMPLE.match(line):
+            return None, f"{what}: unparsable sample: {line!r}"
+        name_part, value = line.rsplit(" ", 1)
+        samples[name_part] = float(value)
+    return samples, None
+
+
+def check_live_endpoint(dcop_file: str):
+    """Start the telemetry server on port 0, advance a segmented
+    engine solve on a background thread, scrape /metrics twice while
+    it runs and assert the cycle counter moved.  Returns an error
+    string or None."""
+    from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+    from pydcop_tpu.engine.compile import compile_dcop
+    from pydcop_tpu.engine.runner import MaxSumEngine
+    from pydcop_tpu.observability.engine_probe import EngineProbe
+    from pydcop_tpu.observability.metrics import registry
+    from pydcop_tpu.observability.server import TelemetryServer
+
+    dcop = load_dcop_from_file([dcop_file])
+    graph, meta = compile_dcop(dcop, noise_level=0.01)
+    engine = MaxSumEngine(graph, meta)
+    probe = EngineProbe(engine)
+    server = TelemetryServer(port=0).start()
+    url = server.url
+    done = threading.Event()
+
+    def run():
+        try:
+            # Tiny segments keep the host boundary (where the
+            # snapshotter fires) hot; no convergence stop so the run
+            # outlives both scrapes.  2500 cycles ≈ a second or two:
+            # long enough that the scrapes land mid-run, short enough
+            # that the success path's drain wait below stays cheap.
+            engine.run_checkpointed(
+                max_cycles=2_500, segment_cycles=5,
+                stop_on_convergence=False, probe=probe)
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=run, daemon=True)
+    try:
+        before = registry.value("pydcop_cycles_total")
+        thread.start()
+        first, err = _parse_prom(_scrape(f"{url}/metrics"),
+                                 "live /metrics scrape 1")
+        if err:
+            return err
+        # Wait (bounded) for the counter to advance, then rescrape:
+        # the increase must be visible THROUGH the endpoint.
+        deadline = time.time() + 30
+        second = None
+        while time.time() < deadline and not done.is_set():
+            text = _scrape(f"{url}/metrics")
+            second, err = _parse_prom(text, "live /metrics scrape 2")
+            if err:
+                return err
+            if second.get("pydcop_cycles_total", 0) > max(
+                    first.get("pydcop_cycles_total", 0), before):
+                break
+            time.sleep(0.05)
+        c1 = first.get("pydcop_cycles_total", 0)
+        c2 = (second or {}).get("pydcop_cycles_total", 0)
+        if not (second and c2 > c1):
+            return (f"cycle counter did not increase between live "
+                    f"scrapes ({c1} -> {c2})")
+        health = json.loads(_scrape(f"{url}/healthz"))
+        if health.get("status") != "ok":
+            return f"unexpected /healthz verdict: {health}"
+    finally:
+        done.wait(60)
+        server.stop()
+    return None
 
 
 if __name__ == "__main__":
