@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test chaos chaos-soak trace-demo perf-smoke bench-check unit api cli check doctest bench dryrun onchip
+.PHONY: all test chaos chaos-soak trace-demo perf-smoke serve-smoke bench-check unit api cli check doctest bench dryrun onchip
 
 # 0 = the full scenario matrix; `make test` runs the quick 6-scenario
 # gate (the first 6 cover every failure class; fixed seed, < 60 s).
@@ -57,6 +57,16 @@ trace-demo:
 perf-smoke:
 	$(PY) tools/perf_smoke.py
 
+# Serve-smoke gate: the solve service end-to-end over real HTTP —
+# a mixed-structure burst of N requests must complete in fewer than
+# N device dispatches (batch coalescing counter-asserted), every
+# response must equal the solo api.solve assignment, and an overload
+# burst past the high-water mark must yield clean 429s (never a hang
+# or a dropped request) with pydcop_requests_total accounting for
+# every request.  See tools/serve_smoke.py + docs/serving.md.
+serve-smoke:
+	$(PY) tools/serve_smoke.py
+
 # Bench regression sentinel: noise-aware (median ± MAD per backend)
 # run-over-run check of the BENCH_r*.json trajectory, with a
 # sparkline trajectory line per backend.  Hard gate standalone; `make
@@ -65,7 +75,7 @@ perf-smoke:
 bench-check:
 	$(PY) tools/bench_sentinel.py
 
-test: trace-demo perf-smoke
+test: trace-demo perf-smoke serve-smoke
 	-$(PY) tools/bench_sentinel.py
 	$(MAKE) chaos-soak SOAK_SCENARIOS=6
 	$(PY) -m pytest tests/ -q

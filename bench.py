@@ -424,6 +424,112 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     return tuple(out) if len(out) > 2 else (cps, graph)
 
 
+# Serving-throughput leg: closed-loop clients firing small random
+# coloring DCOPs at the solve service (pydcop_tpu/serving).  Small
+# problems + several structures is the multi-tenant traffic shape the
+# service exists for; the number that matters is sustained
+# problems/sec with per-request latency percentiles.
+SERVE_N_VARS = (24, 30)         # two structure bins
+SERVE_POOL_PER_STRUCT = 6       # distinct instances per structure
+SERVE_CLIENTS = 4
+SERVE_DURATION_S = 4.0
+SERVE_MAX_CYCLES = 60
+
+
+def bench_serving():
+    """Sustained service throughput: SERVE_CLIENTS closed-loop client
+    threads submit-and-wait random coloring DCOPs for
+    SERVE_DURATION_S.  Returns {serve_problems_per_sec, serve_p50_ms,
+    serve_p99_ms, serve_batched_fraction} (None values when the
+    service completed nothing — never crashes the bench)."""
+    import threading
+
+    from pydcop_tpu.serving.service import SolveService
+
+    pool = {
+        n: [build_dcop_small(n, seed) for seed in
+            range(SERVE_POOL_PER_STRUCT)]
+        for n in SERVE_N_VARS
+    }
+    service = SolveService(max_queue=512, batch_window_s=0.005,
+                           max_batch=16).start()
+    try:
+        params = {"max_cycles": SERVE_MAX_CYCLES}
+        # Warm: one dispatch per structure compiles the batched
+        # programs so the timed window measures steady state.
+        for dcops in pool.values():
+            rid = service.submit(dcops[0], params=params)
+            service.result(rid, wait=60)
+        latencies = []
+        completed = [0]
+        lock = threading.Lock()
+        t_end = time.perf_counter() + SERVE_DURATION_S
+
+        def client(idx):
+            n = SERVE_N_VARS[idx % len(SERVE_N_VARS)]
+            i = 0
+            while time.perf_counter() < t_end:
+                dcop = pool[n][i % SERVE_POOL_PER_STRUCT]
+                i += 1
+                t0 = time.perf_counter()
+                rid = service.submit(dcop, params=params)
+                res = service.result(rid, wait=60)
+                t1 = time.perf_counter()
+                if res is not None and res["status"] == "FINISHED":
+                    with lock:
+                        latencies.append(t1 - t0)
+                        completed[0] += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(SERVE_CLIENTS)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=SERVE_DURATION_S + 120)
+        elapsed = time.perf_counter() - t_start
+        stats = service.stats()
+    finally:
+        service.stop(drain=False)
+    if not latencies or elapsed <= 0:
+        return {"serve_problems_per_sec": None}
+    lat_ms = np.asarray(latencies) * 1e3
+    return {
+        "serve_problems_per_sec": round(completed[0] / elapsed, 2),
+        "serve_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "serve_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "serve_requests": completed[0],
+        "serve_batched_fraction": round(
+            stats["batched_dispatches"] / stats["dispatches"], 3)
+            if stats["dispatches"] else None,
+    }
+
+
+def build_dcop_small(n_vars: int, seed: int):
+    """Ring + chord coloring with random cost tables — the serving
+    bench's per-request problem (same topology per n_vars, so same
+    structure bin; different tables per seed)."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(seed)
+    dom = Domain("colors", "color", list(range(N_COLORS)))
+    dcop = DCOP(f"serve_{n_vars}_{seed}", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in vs:
+        dcop.add_variable(v)
+    edges = [(i, (i + 1) % n_vars) for i in range(n_vars)]
+    edges += [(i, (i + n_vars // 2) % n_vars)
+              for i in range(0, n_vars, 3)]
+    for k, (i, j) in enumerate(edges):
+        table = rng.integers(0, 10, size=(N_COLORS, N_COLORS))
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[j]], table.astype(float), f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
 def run_bench():
     import jax
 
@@ -597,6 +703,16 @@ def run_bench():
         del scale_graph
     else:
         scale_keys = {}
+    # Serving-throughput leg (both backends: the request plane exists
+    # on the CPU fallback too, and its trajectory is what the
+    # sentinel tracks per backend).  Never kills the headline line.
+    try:
+        serve_keys = bench_serving()
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: serving leg failed ({exc}); continuing",
+              file=sys.stderr)
+        serve_keys = {"serve_problems_per_sec": None,
+                      "serve_error": f"{type(exc).__name__}: {exc}"[:200]}
     out = {
         "metric": "maxsum_cycles_per_sec_10kvar_graphcoloring",
         "value": round(device_cps, 2),
@@ -626,6 +742,7 @@ def run_bench():
         ),
         **roofline,
         **scale_keys,
+        **serve_keys,
     }
     out.update(_artifact_keys(platform, out))
     out["probe_diagnostics"] = diag_events()

@@ -204,6 +204,106 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             session.finish()
 
 
+class ServeHandle:
+    """A running solve service + HTTP front end.
+
+    ``url``/``port`` locate the front end; ``service`` is the
+    underlying :class:`~pydcop_tpu.serving.service.SolveService`
+    (submit/result work in-process too); ``stop()`` drains the queue
+    and shuts both down.  Context-manager friendly."""
+
+    def __init__(self, service, front_end):
+        self.service = service
+        self.front_end = front_end
+
+    @property
+    def url(self):
+        return self.front_end.url
+
+    @property
+    def port(self):
+        return self.front_end.port
+
+    def stop(self, drain: bool = True):
+        self.front_end.stop()
+        self.service.stop(drain=drain)
+
+    def __enter__(self) -> "ServeHandle":
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def serve(port: int = 8080, host: str = "127.0.0.1",
+          max_queue: int = 256, batch_window_s: float = 0.02,
+          max_batch: int = 16, high_water: Optional[int] = None,
+          default_params: Optional[Dict[str, Any]] = None,
+          breaker_failures: int = 3, breaker_reset_s: float = 5.0,
+          result_keep: int = 4096,
+          block: bool = False) -> Optional[ServeHandle]:
+    """Start the multi-tenant solve service (docs/serving.md).
+
+    Incoming problems are binned by structure signature and
+    same-structure requests are stacked into ONE vmapped device
+    dispatch (the batched-BP throughput lever); results stream back
+    per request with latency accounting.  The front end serves
+    ``POST /solve`` / ``GET /result/<id>`` / ``GET /stats`` plus the
+    live telemetry routes (``/metrics``, ``/healthz``, ``/events``).
+
+    Admission control: a submit past the queue's ``high_water``
+    (default ``max_queue``) is rejected with 429; repeated dispatch
+    failure opens a circuit breaker (``breaker_failures`` failures,
+    ``breaker_reset_s`` probe delay) that turns submits 503 and
+    ``/healthz`` failing.
+
+    ``port=0`` asks the OS for a free port.  ``block=True`` (the
+    ``pydcop serve`` CLI) serves until interrupted and returns None;
+    ``block=False`` returns a :class:`ServeHandle` (also a context
+    manager) for embedding and tests.
+    """
+    from pydcop_tpu.serving.admission import AdmissionPolicy
+    from pydcop_tpu.serving.http import ServeFrontEnd
+    from pydcop_tpu.serving.service import SolveService
+
+    service = SolveService(
+        max_queue=max_queue,
+        batch_window_s=batch_window_s,
+        max_batch=max_batch,
+        default_params=default_params,
+        admission=AdmissionPolicy(
+            high_water=(high_water if high_water is not None
+                        else max_queue),
+            breaker_failures=breaker_failures,
+            breaker_reset_s=breaker_reset_s,
+        ),
+        result_keep=result_keep,
+    ).start()
+    try:
+        front_end = ServeFrontEnd(service, port=port, host=host).start()
+    except Exception:
+        service.stop(drain=False)
+        raise
+    handle = ServeHandle(service, front_end)
+    import sys
+
+    print(f"pydcop serve: listening on {handle.url} "
+          "(POST /solve, GET /result/<id>, /metrics, /healthz)",
+          file=sys.stderr)
+    if not block:
+        return handle
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        print("pydcop serve: shutting down", file=sys.stderr)
+    finally:
+        handle.stop()
+    return None
+
+
 def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
            max_cycles, mesh, n_devices, warmup, ui_port, collector,
            collect_moment, collect_period, delay, checkpoint_dir,

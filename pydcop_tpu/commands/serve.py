@@ -1,0 +1,75 @@
+"""``pydcop serve``: run the multi-tenant solve service.
+
+No reference analogue — the reference runs one problem per process
+(``pydcop solve``) or per subprocess (``pydcop batch``); this serves
+a *stream* of problems over HTTP, stacking same-structure requests
+into single device dispatches (docs/serving.md).
+"""
+
+import logging
+
+logger = logging.getLogger("pydcop.cli.serve")
+
+
+def set_parser(subparsers):
+    parser = subparsers.add_parser(
+        "serve",
+        help="serve solve requests over HTTP with structure-binned "
+             "device batching")
+    parser.add_argument("--port", type=int, default=8080,
+                        help="HTTP port (0 = OS-assigned, printed on "
+                             "stderr)")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind address")
+    parser.add_argument("--max_queue", "--max-queue", type=int,
+                        default=256,
+                        help="request queue bound; also the default "
+                             "admission high-water mark")
+    parser.add_argument("--high_water", "--high-water", type=int,
+                        default=None,
+                        help="queue depth past which submits get 429 "
+                             "(default: --max_queue)")
+    parser.add_argument("--batch_window", "--batch-window",
+                        type=float, default=0.02, metavar="SECONDS",
+                        help="how long the scheduler lingers after "
+                             "the first request collecting "
+                             "same-structure batch-mates")
+    parser.add_argument("--max_batch", "--max-batch", type=int,
+                        default=16,
+                        help="largest number of instances stacked "
+                             "into one device dispatch")
+    parser.add_argument("--breaker_failures", type=int, default=3,
+                        help="consecutive dispatch failures before "
+                             "the admission breaker opens (503s)")
+    parser.add_argument("--breaker_reset", type=float, default=5.0,
+                        metavar="SECONDS",
+                        help="seconds the breaker stays open before "
+                             "a half-open probe dispatch")
+    parser.add_argument("--cycles", type=int, default=200,
+                        help="default max_cycles for requests that "
+                             "don't set params.max_cycles")
+    parser.add_argument("--damping", type=float, default=0.5,
+                        help="default MaxSum damping for requests")
+    parser.add_argument("--result_keep", type=int, default=4096,
+                        help="completed results retained for "
+                             "GET /result/<id> (oldest evicted)")
+    parser.set_defaults(func=run_cmd)
+
+
+def run_cmd(args) -> int:
+    from pydcop_tpu.api import serve
+
+    serve(
+        port=args.port, host=args.host,
+        max_queue=args.max_queue, high_water=args.high_water,
+        batch_window_s=args.batch_window, max_batch=args.max_batch,
+        breaker_failures=args.breaker_failures,
+        breaker_reset_s=args.breaker_reset,
+        default_params={
+            "max_cycles": args.cycles,
+            "damping": args.damping,
+        },
+        result_keep=args.result_keep,
+        block=True,
+    )
+    return 0

@@ -37,6 +37,7 @@ def make_parser() -> argparse.ArgumentParser:
         orchestrator,
         replica_dist,
         run,
+        serve,
         solve,
         trace,
     )
@@ -61,7 +62,8 @@ def make_parser() -> argparse.ArgumentParser:
     )
     subparsers = parser.add_subparsers(title="commands", dest="command")
     for cmd in (solve, run, distribute, graph, agent, orchestrator,
-                generate, replica_dist, batch, consolidate, trace):
+                generate, replica_dist, batch, consolidate, trace,
+                serve):
         cmd.set_parser(subparsers)
     return parser
 
@@ -92,7 +94,7 @@ def cli(args=None):
 # CLI commands that execute on the device backend: a wedged
 # accelerator tunnel hangs jax backend init FOREVER (C++-level, not
 # interruptible), which would turn `pydcop solve` into a silent hang.
-_DEVICE_COMMANDS = ("solve", "run", "batch")
+_DEVICE_COMMANDS = ("solve", "run", "batch", "serve")
 
 
 def _guard_backend(command: str) -> None:

@@ -13,10 +13,27 @@ Shape contract: every instance must compile to identical array shapes
 seeded generator sweeps produce (same config, different seeds or cost
 tables).  A shape mismatch raises instead of silently padding, so the
 caller controls the batching granularity.
+
+This module is ALSO the serving hot path (pydcop_tpu/serving/): the
+request scheduler stacks same-structure-bin requests and dispatches
+them through :func:`run_stacked`.  Two serving-driven extensions:
+
+- **Padding to bin sizes.** A jitted batched program re-traces per
+  batch size, so a scheduler dispatching raw batch sizes 3, 5, 7, 6 …
+  would compile a fresh program per straggler count.  ``pad_to_bins``
+  rounds the stack up to a fixed ladder of sizes (duplicating the
+  last instance; padded lanes are computed and discarded), bounding
+  the number of compiled programs per structure to ``len(bins)``.
+
+- **Honest padding accounting.** Padded lanes are wasted device work,
+  so every padded dispatch reports ``pad_fraction`` (padded lanes /
+  batch size) in ``DeviceRunResult.metrics`` — the serving
+  batch-occupancy telemetry reads it instead of guessing.
 """
 
 import functools
-from typing import Dict, List, Sequence, Tuple
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,12 +45,24 @@ from pydcop_tpu.engine.compile import (
     FactorGraphMeta,
     compile_dcop,
 )
+from pydcop_tpu.engine.runner import DeviceRunResult, timed_jit_call
 from pydcop_tpu.ops import maxsum as maxsum_ops
 
+# Batch-size ladder used when a caller asks for bin padding without
+# giving one: powers of two keep the compiled-program count per
+# structure logarithmic in the largest batch.
+DEFAULT_BIN_SIZES = (1, 2, 4, 8, 16, 32, 64)
 
-def _stack_graphs(
+# jit-cache warmth per (shape-signature, solver statics) — feeds the
+# cold/warm split in timed_jit_call so serving dispatch latencies can
+# separate compile stalls from steady-state batches.
+_warm: set = set()
+
+
+def stack_graphs(
     graphs: Sequence[CompiledFactorGraph],
 ) -> CompiledFactorGraph:
+    """Stack same-shaped compiled graphs along a new leading axis."""
     shapes = [
         (g.var_costs.shape,) + tuple(b.costs.shape for b in g.buckets)
         for g in graphs
@@ -44,6 +73,36 @@ def _stack_graphs(
             f"{sorted(set(shapes))}"
         )
     return jax.tree.map(lambda *xs: jnp.stack(xs), *graphs)
+
+
+# Pre-promotion private name, kept for external callers.
+_stack_graphs = stack_graphs
+
+
+def bin_size_for(n: int, bin_sizes: Sequence[int]) -> int:
+    """Smallest ladder size >= n; n itself when the ladder tops out
+    below it (an oversized dispatch compiles once for its exact size
+    rather than failing)."""
+    for b in sorted(bin_sizes):
+        if b >= n:
+            return b
+    return n
+
+
+def pad_to_bin(
+    graphs: Sequence[CompiledFactorGraph],
+    bin_sizes: Sequence[int] = DEFAULT_BIN_SIZES,
+) -> Tuple[List[CompiledFactorGraph], int, float]:
+    """Pad a graph list up to the next bin size by repeating the last
+    instance.  Returns (padded_graphs, n_real, pad_fraction) — padded
+    lanes solve a duplicate problem whose results the caller drops.
+    """
+    n_real = len(graphs)
+    if n_real == 0:
+        return [], 0, 0.0
+    target = bin_size_for(n_real, bin_sizes)
+    padded = list(graphs) + [graphs[-1]] * (target - n_real)
+    return padded, n_real, (target - n_real) / target
 
 
 @functools.partial(
@@ -73,6 +132,81 @@ def _batched_solve(stacked, *, max_cycles, damping, damp_vars,
     return jax.vmap(solve_one)(stacked)
 
 
+def _shape_signature(stacked: CompiledFactorGraph) -> tuple:
+    return (
+        (stacked.var_costs.shape,)
+        + tuple(b.costs.shape for b in stacked.buckets)
+    )
+
+
+def run_stacked(
+    graphs: Sequence[CompiledFactorGraph],
+    max_cycles: int = 200,
+    damping: float = 0.5,
+    damping_nodes: str = "both",
+    stability: float = 0.1,
+    pad_to_bins: Optional[Sequence[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, DeviceRunResult]:
+    """One device dispatch over a stack of same-shaped compiled graphs.
+
+    The serving hot path: all instances run ``max_cycles`` cycles (no
+    convergence stop — a data-dependent loop bound would serialize the
+    batch; converged instances freeze via send suppression, so extra
+    cycles don't change their assignment).  With ``pad_to_bins`` the
+    stack is padded up the bin ladder first (see module docstring).
+
+    Returns ``(values, cycles, batch_result)``: per-instance selected
+    value indices / cycle counts for the first ``n_real`` lanes
+    (padding lanes already dropped), plus a batch-level
+    :class:`DeviceRunResult` whose ``metrics`` carry the dispatch
+    accounting — ``batch_size``, ``n_real``, ``pad_fraction``,
+    ``cold_start`` — and whose ``assignment`` is empty (a batch has no
+    single assignment; decode per instance via each meta).
+    """
+    if not graphs:
+        raise ValueError("run_stacked needs at least one graph")
+    n_real = len(graphs)
+    pad_fraction = 0.0
+    if pad_to_bins is not None:
+        graphs, n_real, pad_fraction = pad_to_bin(graphs, pad_to_bins)
+    stacked = stack_graphs(graphs)
+    statics = dict(
+        max_cycles=max_cycles,
+        damping=damping,
+        damp_vars=damping_nodes in ("vars", "both"),
+        damp_factors=damping_nodes in ("factors", "both"),
+        stability=stability,
+    )
+    key = (
+        "maxsum_batch", len(graphs), _shape_signature(stacked),
+        tuple(sorted(statics.items())),
+    )
+    t0 = time.perf_counter()
+    (values, cycles), compile_s, run_s = timed_jit_call(
+        _warm, key,
+        functools.partial(_batched_solve, **statics),
+        stacked,
+    )
+    elapsed = time.perf_counter() - t0
+    values = np.asarray(jax.device_get(values))[:n_real]
+    cycles = np.asarray(jax.device_get(cycles))[:n_real]
+    batch_result = DeviceRunResult(
+        assignment={},
+        cycles=int(cycles.max()) if cycles.size else 0,
+        converged=False,
+        time_s=elapsed,
+        compile_time_s=compile_s,
+        metrics={
+            "batch_size": len(graphs),
+            "n_real": n_real,
+            "pad_fraction": pad_fraction,
+            "cold_start": compile_s > 0.0,
+            "run_time_s": run_s,
+        },
+    )
+    return values, cycles, batch_result
+
+
 def solve_maxsum_batch(
     dcops: Sequence[DCOP],
     max_cycles: int = 200,
@@ -80,12 +214,17 @@ def solve_maxsum_batch(
     damping: float = 0.5,
     damping_nodes: str = "both",
     stability: float = 0.1,
+    pad_to_bins: Optional[Sequence[int]] = None,
 ) -> List[Dict]:
     """Solve a batch of same-shaped DCOPs in one vmapped program.
 
     Returns one dict per instance: assignment, cost (host-evaluated),
     cycles.  All instances run ``max_cycles`` cycles (no convergence
     stop: a data-dependent loop bound would serialize the batch).
+    ``pad_to_bins`` pads the stack up a bin-size ladder so a sweep of
+    ragged batch sizes reuses a bounded set of compiled programs; the
+    shared dispatch accounting (incl. ``pad_fraction``) rides along in
+    each result's ``batch`` key.
     """
     if not dcops:
         return []
@@ -100,18 +239,15 @@ def solve_maxsum_batch(
     ]
     graphs = [c[0] for c in compiled]
     metas = [c[1] for c in compiled]
-    stacked = _stack_graphs(graphs)
 
-    values, cycles = _batched_solve(
-        stacked,
+    values, cycles, batch_result = run_stacked(
+        graphs,
         max_cycles=max_cycles,
         damping=damping,
-        damp_vars=damping_nodes in ("vars", "both"),
-        damp_factors=damping_nodes in ("factors", "both"),
+        damping_nodes=damping_nodes,
         stability=stability,
+        pad_to_bins=pad_to_bins,
     )
-    values = np.asarray(jax.device_get(values))
-    cycles = np.asarray(jax.device_get(cycles))
 
     results = []
     for i, (dcop, meta) in enumerate(zip(dcops, metas)):
@@ -122,5 +258,6 @@ def solve_maxsum_batch(
             "cost": cost,
             "violations": violations,
             "cycles": int(cycles[i]),
+            "batch": dict(batch_result.metrics),
         })
     return results

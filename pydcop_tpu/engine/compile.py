@@ -53,6 +53,7 @@ Example (compile a 2-variable problem and inspect the device layout)::
 """
 
 import os
+import threading
 from collections import OrderedDict
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -86,41 +87,50 @@ class CompileCache:
     copies before clamping).
 
     Bounded LRU; ``PYDCOP_COMPILE_CACHE=0`` disables globally.
+    Thread-safe: the solve service compiles on concurrent submitter
+    threads (serving/service.py), so get/put must not race the LRU
+    bookkeeping (an unlocked ``move_to_end`` can KeyError against a
+    concurrent eviction).
     """
 
     def __init__(self, maxsize: int = 8):
         self.maxsize = maxsize
         self._entries: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.layout_builds = 0
 
     def get(self, key):
-        entry = self._entries.get(key)
-        if entry is not None:
-            self._entries.move_to_end(key)
-            self.hits += 1
-            return entry
-        self.misses += 1
-        return None
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry
+            self.misses += 1
+            return None
 
     def put(self, key, entry):
-        self._entries[key] = entry
-        self._entries.move_to_end(key)
-        while len(self._entries) > self.maxsize:
-            self._entries.popitem(last=False)
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
 
     def clear(self):
-        self._entries.clear()
-        self.hits = self.misses = self.layout_builds = 0
+        with self._lock:
+            self._entries.clear()
+            self.hits = self.misses = self.layout_builds = 0
 
     def stats(self) -> Dict[str, int]:
-        return {
-            "hits": self.hits,
-            "misses": self.misses,
-            "layout_builds": self.layout_builds,
-            "entries": len(self._entries),
-        }
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "layout_builds": self.layout_builds,
+                "entries": len(self._entries),
+            }
 
 
 compile_cache = CompileCache()

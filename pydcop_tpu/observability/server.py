@@ -60,27 +60,65 @@ def get_health_provider() -> Optional[Callable[[], Dict[str, Any]]]:
         return _health_provider
 
 
+def _probe_diagnostics() -> Optional[Dict[str, Any]]:
+    """Accelerator-probe failure root cause for the /healthz body.
+
+    The bench/CLI backend guards record every probe outcome via
+    ``utils.cleanenv.record_diag`` — until now that evidence was
+    bench-log-only, so an operator watching a CPU-fallback service
+    had no way to see WHY the accelerator was skipped.  Returns None
+    when no probe ever failed (the common healthy case keeps the
+    body small); failures never flip the health status — a CPU
+    fallback still serves correctly, the body just says what
+    happened."""
+    try:
+        from pydcop_tpu.utils.cleanenv import (
+            diag_events,
+            is_probe_failure,
+        )
+    except Exception:  # noqa: BLE001 — probe must answer
+        return None
+    failures = [e for e in diag_events() if is_probe_failure(e)]
+    if not failures:
+        return None
+    last = failures[-1]
+    return {
+        "failures": len(failures),
+        "last_event": last.get("event"),
+        "last_error": last.get("error"),
+        "last_unix": last.get("unix"),
+        "recent": failures[-5:],
+    }
+
+
 def health_verdict() -> Dict[str, Any]:
     """The /healthz body: provider data + an overall ``status`` rolled
     up from per-agent statuses (any dead -> ``failing``, any suspect
-    -> ``degraded``, else ``ok``).  Provider failures report
-    ``unknown`` rather than crashing the probe."""
+    -> ``degraded``, else ``ok``), plus the accelerator-probe failure
+    root cause when any probe failed (``accelerator_probe`` key —
+    informational, never changes the status).  Provider failures
+    report ``unknown`` rather than crashing the probe."""
     provider = get_health_provider()
     if provider is None:
-        return {"status": "ok", "detail": "no health monitor active"}
-    try:
-        data = dict(provider())
-    except Exception as exc:  # noqa: BLE001 — probe must answer
-        return {"status": "unknown",
-                "detail": f"health provider failed: {exc}"}
-    statuses = data.get("statuses", {})
-    if any(s == "dead" for s in statuses.values()):
-        status = "failing"
-    elif any(s == "suspect" for s in statuses.values()):
-        status = "degraded"
+        data = {"status": "ok", "detail": "no health monitor active"}
     else:
-        status = "ok"
-    data.setdefault("status", status)
+        try:
+            data = dict(provider())
+        except Exception as exc:  # noqa: BLE001 — probe must answer
+            data = {"status": "unknown",
+                    "detail": f"health provider failed: {exc}"}
+        else:
+            statuses = data.get("statuses", {})
+            if any(s == "dead" for s in statuses.values()):
+                status = "failing"
+            elif any(s == "suspect" for s in statuses.values()):
+                status = "degraded"
+            else:
+                status = "ok"
+            data.setdefault("status", status)
+    probe = _probe_diagnostics()
+    if probe is not None:
+        data.setdefault("accelerator_probe", probe)
     return data
 
 
@@ -93,10 +131,17 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: N802 — stdlib name
         logger.debug("telemetry %s", fmt % args)
 
-    def _reply(self, code: int, body: bytes, content_type: str):
+    def _reply(self, code: int, body: bytes, content_type: str,
+               close: bool = False):
+        """``close=True`` advertises Connection: close (and makes the
+        server honor it) — required on error replies sent WITHOUT
+        reading a request body, or the unread bytes corrupt the next
+        keep-alive request on the socket."""
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if close:
+            self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
 
@@ -155,7 +200,15 @@ class TelemetryServer:
     """Serve /metrics, /healthz and /events for the process-wide
     observability state.  ``start()`` binds (``port=0`` = OS-assigned,
     see :attr:`port`) and serves from a daemon thread; ``stop()``
-    shuts down and unhooks the snapshot listener."""
+    shuts down and unhooks the snapshot listener.
+
+    Subclasses mount extra routes by overriding :attr:`handler_class`
+    with a ``_Handler`` subclass (the serving front end,
+    serving/http.py, adds ``POST /solve`` / ``GET /result`` this
+    way and keeps /metrics, /healthz and /events mounted alongside).
+    """
+
+    handler_class = _Handler
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry=None):
@@ -221,7 +274,7 @@ class TelemetryServer:
 
         if self._httpd is not None:
             return self
-        handler = type("BoundHandler", (_Handler,),
+        handler = type("BoundHandler", (self.handler_class,),
                        {"telemetry": self})
         self._httpd = ThreadingHTTPServer(
             (self.host, self._requested_port), handler)

@@ -1,0 +1,38 @@
+"""Multi-tenant solve service: the request plane over the device engine.
+
+One ``api.solve`` call solves one DCOP; production traffic is
+millions of small problems.  This package turns the engine into a
+throughput service (docs/serving.md):
+
+- :mod:`.service` — :class:`SolveService`: bounded request queue,
+  per-request compile (hitting the PR-3 structure cache), result
+  store with latency accounting, request-plane telemetry
+  (``pydcop_requests_total{status}``,
+  ``pydcop_request_latency_seconds``, batch-occupancy gauge);
+- :mod:`.scheduler` — the batching scheduler: drains the queue,
+  coalesces a batch window, dispatches each structure bin as ONE
+  vmapped device program (engine/batch.run_stacked, padded up the
+  bin-size ladder so ragged batches reuse compiled programs);
+- :mod:`.binning` — structure-signature bin keys (two structures
+  never share a dispatch; same-structure requests coalesce);
+- :mod:`.admission` — backpressure (queue high-water → 429) and the
+  dispatch circuit breaker (repeated engine failure → 503);
+- :mod:`.http` — stdlib HTTP front end (``POST /solve``,
+  ``GET /result/<id>``, ``GET /stats``) mounting the PR-5 telemetry
+  routes (``/metrics``, ``/healthz``, ``/events``) alongside.
+
+Entry points: ``pydcop serve`` (commands/serve.py) and
+:func:`pydcop_tpu.api.serve`.
+"""
+
+from pydcop_tpu.serving.admission import (  # noqa: F401
+    AdmissionController,
+    AdmissionPolicy,
+    AdmissionRejected,
+    QueueFull,
+    ServiceUnavailable,
+)
+from pydcop_tpu.serving.service import (  # noqa: F401
+    SolveRequest,
+    SolveService,
+)

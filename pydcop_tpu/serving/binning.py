@@ -1,0 +1,111 @@
+"""Structure-signature binning for the solve service.
+
+A batched dispatch (engine/batch.run_stacked) requires every instance
+in the stack to compile to identical array shapes, and the service
+additionally promises that two *different* problem structures never
+share a dispatch (same shapes with different scopes would vmap fine
+mathematically, but one misrouted meta would decode the wrong
+variables — the bin key keeps the invariant structural, not just
+dimensional).  The key is the serving-side analogue of the PR-3
+structure cache key (engine/compile.CompileCache): variable count,
+domain padding, per-bucket shapes and the exact scope-index bytes.
+
+Solver parameters ride in the key too: ``max_cycles``/``damping``/
+``stability`` are static arguments of the jitted batched program, so
+requests with different parameters can never share one dispatch.
+"""
+
+from typing import Any, Dict, Tuple
+
+from pydcop_tpu.engine.compile import CompiledFactorGraph
+
+# Solver parameters that are static in the batched program — the
+# params half of the bin key, in canonical order.
+PARAM_KEYS = ("max_cycles", "damping", "damping_nodes", "stability",
+              "noise")
+
+DEFAULT_PARAMS: Dict[str, Any] = {
+    "max_cycles": 200,
+    "damping": 0.5,
+    "damping_nodes": "both",
+    "stability": 0.1,
+    "noise": 0.01,
+}
+
+
+DAMPING_NODES = ("vars", "factors", "both", "none")
+
+
+def normalize_params(overrides: Dict[str, Any] = None) -> Dict[str, Any]:
+    """Fill a request's solver-parameter dict from the service
+    defaults, rejecting unknown keys (a typo'd parameter silently
+    falling back to a default would be a debugging trap) and
+    canonicalizing every value's type — the values land in a hashable
+    bin key AND in the jitted program's static arguments, so an
+    unhashable or wrong-typed value must fail the submit (a 400), not
+    the scheduler thread."""
+    params = dict(DEFAULT_PARAMS)
+    for key, value in (overrides or {}).items():
+        if key not in DEFAULT_PARAMS:
+            raise ValueError(
+                f"unknown solver parameter {key!r}; valid: "
+                f"{', '.join(PARAM_KEYS)}"
+            )
+        params[key] = value
+    try:
+        params["max_cycles"] = int(params["max_cycles"])
+        for key in ("damping", "stability", "noise"):
+            params[key] = float(params[key])
+    except (TypeError, ValueError) as exc:
+        raise ValueError(f"bad solver parameter value: {exc}")
+    if params["damping_nodes"] not in DAMPING_NODES:
+        raise ValueError(
+            f"damping_nodes must be one of {DAMPING_NODES}, got "
+            f"{params['damping_nodes']!r}")
+    return params
+
+
+def structure_signature(graph: CompiledFactorGraph) -> Tuple:
+    """Hashable structural identity of a compiled graph.
+
+    Shapes alone define *stackability*; the scope-index bytes make the
+    signature injective over topologies, which is what "two structures
+    never share a dispatch" needs.  Cost tables are deliberately NOT
+    in the signature — same-structure requests with different costs
+    are exactly the traffic that should coalesce.
+    """
+    return (
+        graph.var_costs.shape,
+        tuple(
+            (b.costs.shape, b.var_ids.tobytes()) for b in graph.buckets
+        ),
+        # Aggregation layout arrays change the compiled program shape.
+        tuple(
+            None if a is None else a.shape
+            for a in (graph.agg_perm, graph.agg_sorted_seg,
+                      graph.agg_starts, graph.agg_ends, graph.agg_ell)
+        ),
+    )
+
+
+def bin_key(graph: CompiledFactorGraph,
+            params: Dict[str, Any]) -> Tuple:
+    """The scheduler's bin key: structure signature + solver params."""
+    return (
+        structure_signature(graph),
+        tuple((k, params[k]) for k in PARAM_KEYS),
+    )
+
+
+def bin_label(key: Tuple) -> str:
+    """Short low-cardinality label for a bin key (metrics/trace): the
+    variable-count/domain part of the shape plus a process-stable
+    digest of the rest — full keys embed scope bytes and would
+    explode label cardinality, and the built-in ``hash`` is
+    per-process randomized (labels must survive restarts so merged
+    traces from two serving processes correlate by bin)."""
+    import hashlib
+
+    (var_shape, _buckets, _agg), _params = key
+    digest = hashlib.sha1(repr(key).encode()).hexdigest()[:6]
+    return f"v{var_shape[0] - 1}d{var_shape[1]}h{digest}"

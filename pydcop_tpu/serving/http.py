@@ -1,0 +1,167 @@
+"""HTTP front end for the solve service (stdlib-only).
+
+Extends the PR-5 telemetry endpoint
+(:class:`~pydcop_tpu.observability.server.TelemetryServer`) with the
+request plane, so one port serves the solve API *and* its own
+telemetry:
+
+- ``POST /solve`` — body ``{"dcop": "<dcop yaml>", "params": {...},
+  "wait": bool, "timeout": s}``.  Returns 202 + a request id (poll
+  ``/result/<id>``), or the finished result directly with
+  ``"wait": true`` (200; 202 + id if the wait timed out).  Errors:
+  400 malformed body/problem/params, 429 queue past high-water
+  (back off and retry), 503 dispatch breaker open.
+- ``GET /result/<id>`` — 200 + result when done, 202 while pending,
+  404 unknown id.
+- ``GET /stats`` — the service's dispatch/queue/breaker ledger.
+- ``GET /metrics`` / ``/healthz`` / ``/events`` — mounted unchanged
+  from the telemetry server; ``/healthz`` additionally reflects the
+  serving state (open dispatch breaker → ``failing`` → 503).
+
+curl examples live in docs/serving.md.
+"""
+
+import json
+import logging
+from typing import Any, Dict
+
+from pydcop_tpu.observability.server import (
+    TelemetryServer,
+    _Handler,
+    get_health_provider,
+    set_health_provider,
+)
+from pydcop_tpu.serving.admission import AdmissionRejected
+from pydcop_tpu.serving.service import SolveService
+
+logger = logging.getLogger("pydcop.serving.http")
+
+# Request bodies are small YAML problems; refuse anything huge before
+# reading it (a misbehaving client must not balloon the process).
+MAX_BODY_BYTES = 8 << 20
+
+
+class _ServeHandler(_Handler):
+    """Telemetry routes + the solve request plane."""
+
+    def _json(self, code: int, payload: Dict[str, Any],
+              close: bool = False):
+        self._reply(code, json.dumps(payload, default=str).encode(),
+                    "application/json", close=close)
+
+    def do_GET(self):  # noqa: N802 — stdlib name
+        path = self.path.split("?", 1)[0]
+        service = self.telemetry.service
+        if path.startswith("/result/"):
+            rid = path[len("/result/"):]
+            # Both lookups can KeyError: the id may be unknown, or
+            # the entry may be evicted between the two calls
+            # (result() pending -> completion -> a concurrent
+            # submit's retention prune).  Either way: 404.
+            try:
+                result = service.result(rid)
+                if result is None:
+                    self._json(202, {"id": rid,
+                                     "status": service.status(rid)})
+                    return
+            except KeyError:
+                self._json(404, {"error": f"unknown request {rid!r}"})
+                return
+            self._json(200, result)
+        elif path == "/stats":
+            self._json(200, service.stats())
+        else:
+            super().do_GET()
+
+    def do_POST(self):  # noqa: N802 — stdlib name
+        path = self.path.split("?", 1)[0]
+        if path != "/solve":
+            # Replying without reading the body would leave it on the
+            # socket and corrupt the next keep-alive request (the
+            # handler speaks HTTP/1.1): advertise-and-close on every
+            # error path that skips the read.
+            self._json(404, {"error": "unknown path"}, close=True)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = 0
+        if length <= 0 or length > MAX_BODY_BYTES:
+            self._json(400, {"error": "body required (JSON, "
+                                      f"<= {MAX_BODY_BYTES} bytes)"},
+                       close=True)
+            return
+        raw = self.rfile.read(length)
+        try:
+            body = json.loads(raw)
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            yaml_src = body.get("dcop")
+            if not isinstance(yaml_src, str) or not yaml_src.strip():
+                raise ValueError(
+                    "body needs a 'dcop' key holding the problem "
+                    "as a dcop yaml string")
+        except ValueError as exc:
+            self._json(400, {"error": f"bad request body: {exc}"})
+            return
+        service = self.telemetry.service
+        try:
+            from pydcop_tpu.dcop.yamldcop import load_dcop
+
+            dcop = load_dcop(yaml_src)
+            rid = service.submit(dcop, params=body.get("params"))
+        except AdmissionRejected as exc:
+            self._json(exc.http_status, {
+                "error": str(exc),
+                "status": "rejected",
+                "retry": exc.http_status == 429,
+            })
+            return
+        except Exception as exc:  # noqa: BLE001 — malformed problem
+            self._json(400, {"error": f"bad problem: {exc}"})
+            return
+        if body.get("wait"):
+            try:
+                timeout = float(body.get("timeout", 30.0))
+            except (TypeError, ValueError):
+                timeout = 30.0
+            result = service.result(rid, wait=timeout)
+            if result is not None:
+                self._json(200, result)
+                return
+            # Fell through the wait window: hand back the id.
+        self._json(202, {"id": rid, "status": "queued",
+                         "result_url": f"/result/{rid}"})
+
+
+class ServeFrontEnd(TelemetryServer):
+    """One HTTP server binding the solve API + telemetry routes.
+
+    Owns neither the service's lifecycle nor the registry — start the
+    :class:`SolveService` first (or use :func:`pydcop_tpu.api.serve`,
+    which wires both).  While running, the service's health summary
+    feeds the process-wide ``/healthz`` provider so an open dispatch
+    breaker turns the probe 503.
+    """
+
+    handler_class = _ServeHandler
+
+    def __init__(self, service: SolveService, port: int = 0,
+                 host: str = "127.0.0.1", registry=None):
+        super().__init__(port=port, host=host, registry=registry)
+        self.service = service
+        self._prior_provider = None
+
+    def start(self) -> "ServeFrontEnd":
+        super().start()
+        # Save/restore, don't clobber: a process embedding the front
+        # end next to a health-monitored thread run must get its
+        # provider back when the front end stops.
+        self._prior_provider = get_health_provider()
+        set_health_provider(self.service.health_summary)
+        return self
+
+    def stop(self):
+        set_health_provider(self._prior_provider)
+        self._prior_provider = None
+        super().stop()

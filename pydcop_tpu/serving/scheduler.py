@@ -1,0 +1,116 @@
+"""The batching scheduler: drain, bin, dispatch.
+
+One daemon thread owns every device dispatch (JAX work stays on a
+single thread; concurrency lives in the batch axis, not in racing
+dispatches).  The loop:
+
+1. Block on the service queue for the next request.
+2. Linger ``batch_window_s`` draining more requests into per-bin
+   lists — this is the coalescing window that turns a burst of N
+   same-structure requests into one vmapped dispatch.  The window is
+   latency the *first* request pays to buy batch-mates; under
+   sustained load the queue is never empty and the window barely
+   waits.
+3. Dispatch each bin (largest first — most amortization per compile)
+   in ``max_batch``-sized chunks through
+   :meth:`~pydcop_tpu.serving.service.SolveService.dispatch`.
+
+Different bins collected in one window still dispatch separately —
+the two-structures-never-share-a-dispatch invariant lives in the bin
+key (serving/binning.py), not in scheduler timing.
+"""
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List
+
+logger = logging.getLogger("pydcop.serving.scheduler")
+
+# Queue sentinel: wakes the loop for shutdown.
+_STOP = object()
+
+
+class BinScheduler:
+    """Daemon scheduler thread for one SolveService."""
+
+    def __init__(self, service, batch_window_s: float = 0.02,
+                 max_batch: int = 16):
+        self.service = service
+        self.batch_window_s = batch_window_s
+        self.max_batch = max(int(max_batch), 1)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="pydcop-serve-scheduler",
+            daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def shutdown(self, timeout: float = 30.0):
+        self._stop.set()
+        # Unblock a waiting get() immediately.
+        try:
+            self.service._queue.put_nowait(_STOP)
+        except queue.Full:
+            pass
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            logger.warning("scheduler thread did not stop in %.1fs",
+                           timeout)
+
+    # -- loop ---------------------------------------------------------- #
+
+    def _run(self):
+        q = self.service._queue
+        while not self._stop.is_set():
+            try:
+                first = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is _STOP:
+                continue
+            bins: Dict = {}
+            bins.setdefault(first.bin, []).append(first)
+            self._collect(q, bins)
+            self._dispatch_bins(bins)
+        # Shutdown: the service fails anything still queued.
+
+    def _collect(self, q, bins: Dict) -> None:
+        """Linger up to the batch window, draining arrivals into
+        per-bin lists.  Stops early once the largest bin can fill a
+        whole dispatch — waiting longer would only add latency to a
+        batch that is already full."""
+        deadline = time.monotonic() + self.batch_window_s
+        while not self._stop.is_set():
+            if max(len(v) for v in bins.values()) >= self.max_batch:
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            try:
+                req = q.get(timeout=remaining)
+            except queue.Empty:
+                return
+            if req is _STOP:
+                return
+            bins.setdefault(req.bin, []).append(req)
+
+    def _dispatch_bins(self, bins: Dict) -> None:
+        for key in sorted(bins, key=lambda k: -len(bins[k])):
+            reqs: List = bins[key]
+            for i in range(0, len(reqs), self.max_batch):
+                chunk = reqs[i:i + self.max_batch]
+                # Last line of defense: dispatch() fails batches
+                # cleanly on engine errors, but NOTHING may kill this
+                # thread — a dead scheduler turns the service into a
+                # black hole that accepts work it will never do.
+                try:
+                    self.service.dispatch(chunk)
+                except Exception as exc:  # noqa: BLE001
+                    logger.exception("dispatch crashed")
+                    for req in chunk:
+                        if not req.done.is_set():
+                            self.service._finish_error(
+                                req, f"internal dispatch error: {exc}")

@@ -1,0 +1,449 @@
+"""The multi-tenant solve service: queue, binning dispatch, results.
+
+``SolveService`` turns the device engine into a throughput service:
+callers :meth:`~SolveService.submit` DCOPs (each compiled on the
+submitting thread — malformed problems fail synchronously, and
+same-structure requests hit the PR-3 layout cache), a scheduler
+thread (serving/scheduler.py) drains the bounded queue, bins requests
+by structure signature (serving/binning.py) and dispatches each bin
+as ONE vmapped device program (engine/batch.run_stacked, padded up
+the bin-size ladder so ragged batch sizes reuse compiled programs).
+Results stream back per request with latency accounting; admission
+control (serving/admission.py) sheds load at the high-water mark and
+opens a circuit breaker on repeated dispatch failure.
+
+Request-plane telemetry (all registered on the process registry, so
+the serving front end's ``/metrics`` exposes them):
+
+- ``pydcop_requests_total{status}`` — every submit accounted:
+  ``ok`` / ``error`` / ``rejected_queue_full`` /
+  ``rejected_unavailable`` / ``rejected_bad_request``;
+- ``pydcop_request_latency_seconds`` — submit→result histogram
+  (p50/p99 straight off the buckets);
+- ``pydcop_serve_queue_depth`` / ``pydcop_serve_batch_occupancy`` —
+  live gauges;
+- ``pydcop_serve_dispatches_total{kind}`` (``batched``/``solo``) and
+  ``pydcop_serve_batched_requests_total`` — the batch-coalescing
+  evidence (N same-structure requests in << N dispatches);
+- per-batch ``serve_dispatch`` trace spans when tracing is on.
+"""
+
+import contextlib
+import itertools
+import logging
+import queue
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.engine import batch as engine_batch
+from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.trace import tracer
+from pydcop_tpu.serving import binning
+from pydcop_tpu.serving.admission import (
+    AdmissionController,
+    AdmissionPolicy,
+    AdmissionRejected,
+)
+
+logger = logging.getLogger("pydcop.serving.service")
+
+# Request terminal states.
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+ERROR = "ERROR"
+
+
+@dataclass
+class SolveRequest:
+    """One in-flight problem: compiled form + bookkeeping."""
+
+    id: str
+    dcop: DCOP
+    graph: Any
+    meta: Any
+    params: Dict[str, Any]
+    bin: Any
+    t_submit: float
+    status: str = QUEUED
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[Dict[str, Any]] = None
+
+
+class SolveService:
+    """Bounded-queue, structure-binned batching solve service.
+
+    Knobs: ``max_queue`` bounds the request queue (also the default
+    admission high-water mark), ``batch_window_s`` is how long the
+    scheduler lingers after the first request collecting batch-mates,
+    ``max_batch`` caps one dispatch, ``bin_sizes`` is the
+    padding ladder (engine/batch.DEFAULT_BIN_SIZES when None),
+    ``default_params`` overrides the solver defaults
+    (serving/binning.DEFAULT_PARAMS) service-wide, ``admission`` the
+    backpressure/breaker policy, ``result_keep`` bounds completed-
+    result retention (oldest evicted first — a long-lived service must
+    not leak every response it ever produced).
+    """
+
+    def __init__(self, max_queue: int = 256,
+                 batch_window_s: float = 0.02,
+                 max_batch: int = 16,
+                 bin_sizes: Optional[List[int]] = None,
+                 default_params: Optional[Dict[str, Any]] = None,
+                 admission: Optional[AdmissionPolicy] = None,
+                 result_keep: int = 4096):
+        if admission is None:
+            admission = AdmissionPolicy(high_water=max_queue)
+        self.admission = AdmissionController(admission)
+        self.batch_window_s = batch_window_s
+        self.max_batch = max(int(max_batch), 1)
+        self.bin_sizes = tuple(
+            bin_sizes or engine_batch.DEFAULT_BIN_SIZES)
+        self.default_params = binning.normalize_params(default_params)
+        self.result_keep = result_keep
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._requests: "OrderedDict[str, SolveRequest]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._scheduler = None
+        self._started = False
+        # Dispatch ledger (also mirrored into the registry).
+        self.dispatches = 0
+        self.batched_dispatches = 0
+        self.completed = 0
+        self.failed = 0
+        reg = metrics_registry
+        self._req_total = reg.counter(
+            "pydcop_requests_total",
+            "Solve-service requests by terminal status")
+        self._latency = reg.histogram(
+            "pydcop_request_latency_seconds",
+            "Submit-to-result latency of solve-service requests")
+        self._queue_depth = reg.gauge(
+            "pydcop_serve_queue_depth",
+            "Solve-service requests waiting in the queue")
+        self._occupancy = reg.gauge(
+            "pydcop_serve_batch_occupancy",
+            "Real-instance fraction of the last dispatched batch")
+        self._dispatch_total = reg.counter(
+            "pydcop_serve_dispatches_total",
+            "Device dispatches by kind (batched = >1 real instance)")
+        self._batched_reqs = reg.counter(
+            "pydcop_serve_batched_requests_total",
+            "Requests that shared their device dispatch with others")
+        self._pad_waste = reg.counter(
+            "pydcop_serve_padded_lanes_total",
+            "Padded (wasted) batch lanes dispatched to the device")
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self) -> "SolveService":
+        from pydcop_tpu.serving.scheduler import BinScheduler
+
+        if self._started:
+            return self
+        # Activated like an ObservabilitySession: request-plane detail
+        # counters should record while the service runs; the prior
+        # state is restored on stop so an embedding process (tests,
+        # bench) is left the way it was found.
+        self._was_active = metrics_registry.active
+        metrics_registry.active = True
+        self._scheduler = BinScheduler(
+            self, batch_window_s=self.batch_window_s,
+            max_batch=self.max_batch)
+        self._scheduler.start()
+        self._started = True
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: float = 30.0) -> None:
+        """Stop the scheduler.  ``drain=True`` (default) lets queued
+        requests finish first — a service shutdown must not silently
+        drop accepted work; ``drain=False`` fails queued requests with
+        a shutdown error instead."""
+        if not self._started:
+            return
+        if drain:
+            deadline = time.monotonic() + timeout
+            while (not self._queue.empty()
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        self._scheduler.shutdown(timeout=timeout)
+        self._scheduler = None
+        self._started = False
+        metrics_registry.active = self._was_active
+        # Fail anything still queued (drain=False or drain timeout).
+        # The queue may also hold the scheduler's unconsumed shutdown
+        # sentinel — skip anything that isn't a request.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(req, SolveRequest):
+                self._finish_error(req,
+                                   "service stopped before dispatch")
+
+    def __enter__(self) -> "SolveService":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request plane ------------------------------------------------- #
+
+    def submit(self, dcop: DCOP,
+               params: Optional[Dict[str, Any]] = None,
+               request_id: Optional[str] = None) -> str:
+        """Admit, compile and enqueue one problem; returns the request
+        id.  Raises :class:`~pydcop_tpu.serving.admission.
+        AdmissionRejected` (429/503 at the front end) on backpressure
+        and ``ValueError`` (400) on malformed problems/parameters.
+
+        Compilation happens HERE, on the submitting thread: structure
+        errors surface synchronously, concurrent clients compile in
+        parallel, and the scheduler thread stays dedicated to device
+        dispatch.  Same-structure submissions hit the PR-3 layout
+        cache, so the steady-state compile cost is the cost-table
+        fill."""
+        if not self._started:
+            raise RuntimeError("SolveService is not started")
+        t_submit = time.perf_counter()
+        try:
+            self.admission.admit(self._queue.qsize())
+        except AdmissionRejected as rejection:
+            status = ("rejected_queue_full"
+                      if rejection.http_status == 429
+                      else "rejected_unavailable")
+            self._req_total.inc(status=status)
+            raise
+        # Everything below is the caller's fault when it raises
+        # (unknown/bad-typed params, malformed problem, duplicate id
+        # -> 400 at the front end): still a ledger entry, so
+        # pydcop_requests_total reconciles against client-side counts
+        # even when clients misbehave.
+        try:
+            merged = dict(self.default_params)
+            if params:
+                merged.update(params)
+            merged = binning.normalize_params(merged)
+            graph, meta = compile_dcop(
+                dcop, noise_level=merged["noise"])
+            req = SolveRequest(
+                id=request_id or f"r{next(self._ids)}",
+                dcop=dcop, graph=graph, meta=meta, params=merged,
+                bin=binning.bin_key(graph, merged),
+                t_submit=t_submit,
+            )
+            with self._lock:
+                if req.id in self._requests:
+                    raise ValueError(
+                        f"duplicate request id {req.id!r}")
+                self._requests[req.id] = req
+                self._prune_locked()
+        except Exception:
+            self._req_total.inc(status="rejected_bad_request")
+            raise
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            # qsize raced past the high-water check: same contract as
+            # an admission rejection, never a blocking put.
+            with self._lock:
+                self._requests.pop(req.id, None)
+            self._req_total.inc(status="rejected_queue_full")
+            raise QueueFullRace(
+                f"request queue full ({self._queue.maxsize})")
+        self._queue_depth.set(self._queue.qsize())
+        return req.id
+
+    def result(self, request_id: str,
+               wait: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """The request's result dict, or None while pending.  With
+        ``wait`` (seconds), block up to that long for completion.
+        Raises ``KeyError`` for unknown ids."""
+        with self._lock:
+            req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(request_id)
+        if wait:
+            req.done.wait(wait)
+        return req.result if req.done.is_set() else None
+
+    def status(self, request_id: str) -> str:
+        with self._lock:
+            req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(request_id)
+        return req.status
+
+    def _prune_locked(self):
+        """Evict oldest COMPLETED results past result_keep (pending
+        requests are never evicted — their clients still hold the
+        id).  Amortized O(excess), not a full-table scan: the table
+        is insertion-ordered, so eviction pops completed entries off
+        the front, rotating still-pending heads to the back (each
+        entry rotates at most once per call, bounding the loop even
+        when everything old is still pending)."""
+        excess = len(self._requests) - self.result_keep
+        if excess <= 0:
+            return
+        rotations = 0
+        while excess > 0 and rotations < len(self._requests):
+            rid = next(iter(self._requests))
+            if self._requests[rid].done.is_set():
+                del self._requests[rid]
+                excess -= 1
+            else:
+                self._requests.move_to_end(rid)
+                rotations += 1
+
+    # -- dispatch plane (called by the scheduler thread) --------------- #
+
+    def dispatch(self, reqs: List[SolveRequest]) -> None:
+        """Solve one same-bin batch in a single device dispatch and
+        complete every request in it.  Any engine failure fails the
+        whole batch (each request gets the error) and feeds the
+        breaker; success closes a half-open circuit."""
+        for req in reqs:
+            req.status = RUNNING
+        self._queue_depth.set(self._queue.qsize())
+        params = reqs[0].params
+        span = (tracer.span(
+            "serve_dispatch", "serving",
+            bin=binning.bin_label(reqs[0].bin),
+            n_real=len(reqs)) if tracer.enabled else None)
+        try:
+            with (span if span is not None
+                  else contextlib.nullcontext()):
+                values, cycles, batch_result = self._run_batch(
+                    reqs, params)
+                if span is not None:
+                    span.args["batch_size"] = \
+                        batch_result.metrics["batch_size"]
+                    span.args["pad_fraction"] = \
+                        batch_result.metrics["pad_fraction"]
+        except Exception as exc:  # noqa: BLE001 — fail the batch, not
+            # the scheduler thread: the service must keep serving.
+            logger.warning("serve dispatch failed (%d requests): %s",
+                           len(reqs), exc)
+            self.admission.record_dispatch(ok=False)
+            self._dispatch_total.inc(kind="failed")
+            for req in reqs:
+                self._finish_error(req, f"dispatch failed: {exc}")
+            return
+        self.admission.record_dispatch(ok=True)
+        metrics = batch_result.metrics
+        self.dispatches += 1
+        kind = "batched" if len(reqs) > 1 else "solo"
+        self._dispatch_total.inc(kind=kind)
+        if len(reqs) > 1:
+            self.batched_dispatches += 1
+            self._batched_reqs.inc(len(reqs))
+        self._occupancy.set(
+            metrics["n_real"] / metrics["batch_size"])
+        pad_lanes = metrics["batch_size"] - metrics["n_real"]
+        if pad_lanes:
+            self._pad_waste.inc(pad_lanes)
+        t_done = time.perf_counter()
+        for i, req in enumerate(reqs):
+            # Per-request decode guard: one cost function that raises
+            # on its own selected assignment must fail THAT request,
+            # not the batch-mates (already solved) or the scheduler
+            # thread (which serves everyone after them).
+            try:
+                assignment = req.meta.assignment_from_indices(
+                    values[i])
+                cost, violations = req.dcop.solution_cost(assignment)
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("result decode failed for %s: %s",
+                               req.id, exc)
+                self._finish_error(req, f"result decode failed: {exc}")
+                continue
+            req.result = {
+                "id": req.id,
+                "status": FINISHED,
+                "assignment": assignment,
+                "cost": cost,
+                "violations": violations,
+                "cycles": int(cycles[i]),
+                "latency": {
+                    "total_s": t_done - req.t_submit,
+                    "dispatch_s": batch_result.time_s,
+                    "queued_s": (t_done - req.t_submit
+                                 - batch_result.time_s),
+                },
+                "batch": {
+                    "size": metrics["batch_size"],
+                    "n_real": metrics["n_real"],
+                    "pad_fraction": metrics["pad_fraction"],
+                    "cold_start": metrics["cold_start"],
+                },
+            }
+            req.status = FINISHED
+            self.completed += 1
+            self._req_total.inc(status="ok")
+            self._latency.observe(t_done - req.t_submit)
+            req.done.set()
+
+    def _run_batch(self, reqs, params):
+        """The device call, isolated for tests to stub failures."""
+        return engine_batch.run_stacked(
+            [r.graph for r in reqs],
+            max_cycles=params["max_cycles"],
+            damping=params["damping"],
+            damping_nodes=params["damping_nodes"],
+            stability=params["stability"],
+            pad_to_bins=self.bin_sizes,
+        )
+
+    def _finish_error(self, req: SolveRequest, message: str):
+        req.result = {
+            "id": req.id, "status": ERROR, "error": message,
+            "latency": {
+                "total_s": time.perf_counter() - req.t_submit,
+            },
+        }
+        req.status = ERROR
+        self.failed += 1
+        self._req_total.inc(status="error")
+        req.done.set()
+
+    # -- introspection ------------------------------------------------- #
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            tracked = len(self._requests)
+        return {
+            "queue_depth": self._queue.qsize(),
+            "high_water": self.admission.policy.high_water,
+            "breaker_state": self.admission.breaker_state,
+            "dispatches": self.dispatches,
+            "batched_dispatches": self.batched_dispatches,
+            "completed": self.completed,
+            "failed": self.failed,
+            "tracked_requests": tracked,
+            "max_batch": self.max_batch,
+            "batch_window_s": self.batch_window_s,
+            "bin_sizes": list(self.bin_sizes),
+        }
+
+    def health_summary(self) -> Dict[str, Any]:
+        """The /healthz contribution: breaker open → failing (503)."""
+        stats = self.stats()
+        status = ("failing" if stats["breaker_state"] == "open"
+                  else "ok")
+        return {"status": status, "serving": stats}
+
+
+class QueueFullRace(AdmissionRejected):
+    """put_nowait lost the depth race: treated exactly like a
+    high-water rejection (429)."""
+
+    http_status = 429

@@ -52,6 +52,20 @@ def _probe_failure_reason(error):
     return "init_error"
 
 
+def is_probe_failure(event):
+    """Whether a diagnostic event (a ``diag_events()`` row, or a
+    (kind, details) pair flattened into one dict) records a probe /
+    supervision FAILURE.  The single classification shared by the
+    metrics mirror below and the ``/healthz`` accelerator_probe body
+    (observability/server.py) — one predicate, so a new failure kind
+    can never be counted in one place and missing from the other."""
+    kind = str(event.get("event", ""))
+    return (
+        kind in ("cpu_fallback", "child_timeout", "child_failed")
+        or (kind.endswith("probe") and event.get("ok") is False)
+    )
+
+
 def _observe_probe_event(kind, details):
     """Mirror a diagnostic event into the observability plane: failed
     probes and fallbacks count in
@@ -71,10 +85,7 @@ def _observe_probe_event(kind, details):
         from pydcop_tpu.observability.trace import tracer
     except Exception:  # noqa: BLE001
         return
-    failed = (
-        kind in ("cpu_fallback", "child_timeout", "child_failed")
-        or (kind.endswith("probe") and details.get("ok") is False)
-    )
+    failed = is_probe_failure({"event": kind, **details})
     if failed:
         reason = (kind if not kind.endswith("probe")
                   else _probe_failure_reason(details.get("error")))

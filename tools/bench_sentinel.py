@@ -87,6 +87,7 @@ def load_history(root: str) -> List[Dict[str, Any]]:
             runs.append({"source": name,
                          "skipped": "no parsed.value"})
             continue
+        serve_value = parsed.get("serve_problems_per_sec")
         runs.append({
             "source": name,
             "n": doc.get("n"),
@@ -94,6 +95,10 @@ def load_history(root: str) -> List[Dict[str, Any]]:
             # Rounds 1-5 all fell back to CPU; the earliest line
             # predates the backend key, so absent means cpu.
             "backend": parsed.get("backend") or "cpu",
+            # Serving-throughput leg (PR-6 bench_serving); absent in
+            # earlier rounds, None when the leg failed that round.
+            "serve_value": (float(serve_value)
+                            if serve_value is not None else None),
         })
     last_path = os.path.join(root, "BENCH_TPU_LAST.json")
     have_tpu_round = any(r.get("backend") == "tpu" for r in runs)
@@ -174,40 +179,53 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
     with enough history regressed."""
     runs = load_history(root)
     skipped = [r for r in runs if "skipped" in r]
-    by_backend: Dict[str, List[Dict[str, Any]]] = {}
-    for r in runs:
-        if "skipped" in r:
-            continue
-        by_backend.setdefault(r["backend"], []).append(r)
+    # Two metric families judged with the same noise model: the
+    # headline engine rate ("value", cycles/s) and the serving
+    # throughput ("serve_value", problems/s — absent before PR 6, so
+    # its series only starts when the history carries it).  Backends
+    # never share a baseline in either family.
+    metrics = (
+        ("bench", "value", "cycles/s"),
+        ("serve", "serve_value", "problems/s"),
+    )
     series = {}
     lines = []
     failed = False
-    for backend in sorted(by_backend):
-        rows = by_backend[backend]
-        values = [r["value"] for r in rows]
-        result = check_series(values, rel_tol=rel_tol,
-                              mad_mult=mad_mult, window=window)
-        result["values"] = values
-        result["sources"] = [r["source"] for r in rows]
-        series[backend] = result
-        spark = sparkline(values)
-        if result["verdict"] == "insufficient":
+    for family, field, unit in metrics:
+        by_backend: Dict[str, List[Dict[str, Any]]] = {}
+        for r in runs:
+            if "skipped" in r or r.get(field) is None:
+                continue
+            by_backend.setdefault(r["backend"], []).append(r)
+        for backend in sorted(by_backend):
+            rows = by_backend[backend]
+            values = [r[field] for r in rows]
+            result = check_series(values, rel_tol=rel_tol,
+                                  mad_mult=mad_mult, window=window)
+            result["values"] = values
+            result["sources"] = [r["source"] for r in rows]
+            label = (backend if family == "bench"
+                     else f"{family}:{backend}")
+            series[label] = result
+            spark = sparkline(values)
+            if result["verdict"] == "insufficient":
+                lines.append(
+                    f"{family}[{backend}] {spark} "
+                    f"{values[0]:.0f}→{values[-1]:.0f} {unit} — "
+                    f"{result['detail']} ({result['points']} run(s))"
+                )
+                continue
+            direction = f"{result['delta_rel']:+.1%}"
+            verdict = ("REGRESSED" if result["verdict"] == "regressed"
+                       else "OK")
             lines.append(
-                f"bench[{backend}] {spark} "
-                f"{values[0]:.0f}→{values[-1]:.0f} cycles/s — "
-                f"{result['detail']} ({result['points']} run(s))"
+                f"{family}[{backend}] {spark} "
+                f"{values[0]:.0f}→{values[-1]:.0f} {unit}, newest "
+                f"{direction} vs median {result['median']:.0f} "
+                f"(floor {result['floor']:.0f}) {verdict}"
             )
-            continue
-        direction = f"{result['delta_rel']:+.1%}"
-        lines.append(
-            f"bench[{backend}] {spark} "
-            f"{values[0]:.0f}→{values[-1]:.0f} cycles/s, newest "
-            f"{direction} vs median {result['median']:.0f} "
-            f"(floor {result['floor']:.0f}) "
-            f"{'REGRESSED' if result['verdict'] == 'regressed' else 'OK'}"
-        )
-        if result["verdict"] == "regressed":
-            failed = True
+            if result["verdict"] == "regressed":
+                failed = True
     return {
         "root": root,
         "runs": len(runs),
