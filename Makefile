@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all test chaos chaos-soak trace-demo perf-smoke serve-smoke bench-check unit api cli check doctest bench dryrun onchip
+.PHONY: all test chaos chaos-soak trace-demo perf-smoke serve-smoke shard-smoke bench-check unit api cli check doctest bench dryrun onchip
 
 # 0 = the full scenario matrix; `make test` runs the quick 6-scenario
 # gate (the first 6 cover every failure class; fixed seed, < 60 s).
@@ -67,6 +67,15 @@ perf-smoke:
 serve-smoke:
 	$(PY) tools/serve_smoke.py
 
+# Shard-smoke gate: the partitioned engine on 8 forced host devices —
+# a 2k-var loopy grid partitioned with edge_cut_fraction < 0.3,
+# per-superstep halo-exchange volume asserted strictly below the
+# replicated all-reduce volume, and bit-parity with the unsharded
+# solve; plus the shard_graph auto-padding regression.  See
+# tools/shard_smoke.py + docs/sharding.md.
+shard-smoke:
+	$(PY) tools/shard_smoke.py
+
 # Bench regression sentinel: noise-aware (median ± MAD per backend)
 # run-over-run check of the BENCH_r*.json trajectory, with a
 # sparkline trajectory line per backend.  Hard gate standalone; `make
@@ -75,7 +84,7 @@ serve-smoke:
 bench-check:
 	$(PY) tools/bench_sentinel.py
 
-test: trace-demo perf-smoke serve-smoke
+test: trace-demo perf-smoke serve-smoke shard-smoke
 	-$(PY) tools/bench_sentinel.py
 	$(MAKE) chaos-soak SOAK_SCENARIOS=6
 	$(PY) -m pytest tests/ -q

@@ -73,6 +73,42 @@ def build_dcop(n_vars: int, seed: int = 0):
     return dcop
 
 
+def build_grid_dcop(side: int, seed: int = 0):
+    """``side x side`` 4-neighbor grid coloring with random integer
+    tables — the locally-connected instance the SHARDED leg measures.
+    Random graphs are expanders (no partitioner cuts them well); real
+    DCOP deployments (sensor nets, smart grids, meeting graphs) are
+    spatially local, and a grid is the canonical local topology:
+    a BFS-grown min-edge-cut partition lands a single-digit-percent
+    cut, which is the regime where halo exchange beats the
+    replicated all-reduce."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(seed)
+    dom = Domain("colors", "color", list(range(N_COLORS)))
+    dcop = DCOP(f"grid_{side}", objective="min")
+    variables = [Variable(f"v{i}", dom) for i in range(side * side)]
+    for v in variables:
+        dcop.add_variable(v)
+    k = 0
+    for r in range(side):
+        for c in range(side):
+            i = r * side + c
+            for rr, cc in ((r + 1, c), (r, c + 1)):
+                if rr < side and cc < side:
+                    j = rr * side + cc
+                    table = rng.integers(
+                        0, 10, size=(N_COLORS, N_COLORS))
+                    dcop.add_constraint(NAryMatrixRelation(
+                        [variables[i], variables[j]],
+                        table.astype(np.float64), f"c{k}"))
+                    k += 1
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
 def bench_device(dcop, max_cycles: int, timed: bool = True):
     """Compile + run the device engine; returns (cycles/s, result,
     engine).  With timed=True a warmup run precedes the timed run so
@@ -424,6 +460,89 @@ def bench_scale(n_vars: int = SCALE_N_VARS, edge_factor: float = 1.5,
     return tuple(out) if len(out) > 2 else (cps, graph)
 
 
+# Sharded-superstep leg: the partitioned engine (min-edge-cut
+# partition + shard_map halo exchange, engine/sharding.py) on a
+# locally-connected grid.  On TPU the mesh is the real device list;
+# on the CPU fallback the leg runs in a CHILD process with
+# XLA_FLAGS=--xla_force_host_platform_device_count=8 (jax reads the
+# flag at import, so the forced mesh cannot be conjured in-process)
+# — the same recipe CI parity tests use, so the 1M-var code path is
+# exercised before a TPU ever runs it.
+SHARDED_SIDE = 64            # 64x64 grid = 4096 vars, 8064 factors
+SHARDED_SHARDS = 8
+SHARDED_CYCLES = 100
+SHARDED_CHILD_TIMEOUT_S = 600
+SCALE_SMOKE_N_VARS = 50_000  # CPU smoke of the 1M-var scale leg
+SCALE_SMOKE_CYCLES = 12
+
+
+def bench_sharded(n_shards: int = SHARDED_SHARDS):
+    """Steady-state cycles/s of the partitioned engine on the grid
+    instance, plus the partition/communication evidence: cut
+    fraction, halo-vs-replicated exchange volume.  Caller guarantees
+    >= n_shards devices exist (real or forced-host)."""
+    from pydcop_tpu.algorithms.maxsum import build_engine
+
+    dcop = build_grid_dcop(SHARDED_SIDE)
+    engine = build_engine(dcop, {"noise": 0.01}, shards=n_shards)
+    engine.run(max_cycles=SHARDED_CYCLES, stop_on_convergence=False)
+    res = engine.run(
+        max_cycles=SHARDED_CYCLES, stop_on_convergence=False)
+    cps = res.cycles / res.time_s if res.time_s > 0 else 0.0
+    m = res.metrics
+    return {
+        "maxsum_cycles_per_sec_sharded": round(cps, 2),
+        "sharded_n_vars": SHARDED_SIDE * SHARDED_SIDE,
+        "sharded_n_shards": n_shards,
+        "sharded_edge_cut_fraction": round(
+            m["edge_cut_fraction"], 4),
+        "sharded_halo_elems": m[
+            "halo_exchange_elems_per_superstep"],
+        "sharded_replicated_elems": m[
+            "replicated_allreduce_elems_per_superstep"],
+        "sharded_balance": round(m["balance"], 3),
+    }
+
+
+def _bench_sharded_forced():
+    """CPU path: run bench_sharded in a child with 8 forced host
+    devices (the flag must be set before jax imports).  Returns the
+    sharded keys, or a None-valued entry with the error — the
+    sharded leg never kills the headline line."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{SHARDED_SHARDS}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYDCOP_BENCH_SHARDED_CHILD"] = "1"
+    env.pop("PYDCOP_BENCH_CHILD", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            timeout=SHARDED_CHILD_TIMEOUT_S, stdout=subprocess.PIPE,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return {"maxsum_cycles_per_sec_sharded": None,
+                "sharded_error": "child timeout"}
+    for line in (proc.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if "maxsum_cycles_per_sec_sharded" in parsed:
+                parsed["sharded_backend"] = "cpu"
+                parsed["sharded_forced_host_devices"] = SHARDED_SHARDS
+                return parsed
+    return {"maxsum_cycles_per_sec_sharded": None,
+            "sharded_error": f"child rc={proc.returncode}, "
+                             "no result line"}
+
+
 # Serving-throughput leg: closed-loop clients firing small random
 # coloring DCOPs at the solve service (pydcop_tpu/serving).  Small
 # problems + several structures is the multi-tenant traffic shape the
@@ -702,7 +821,30 @@ def run_bench():
             })
         del scale_graph
     else:
-        scale_keys = {}
+        # CPU smoke of the same scale-leg code path (shrunk from 1M
+        # vars so the fallback adds seconds, not minutes): the array
+        # builder, aggregation layout and marginal-timing ladder all
+        # execute before a TPU ever runs them at full scale.  No HBM
+        # claim is made — the keys are namespaced "smoke".
+        try:
+            smoke_cps, _smoke_graph, smoke_info = bench_scale(
+                n_vars=SCALE_SMOKE_N_VARS, cycles=SCALE_SMOKE_CYCLES,
+                detail=True)
+            scale_keys = {
+                "scale_smoke_n_vars": SCALE_SMOKE_N_VARS,
+                "scale_smoke_cycles_per_s": round(smoke_cps, 2),
+                "scale_smoke_ms_per_cycle": round(
+                    smoke_info["sec_per_cycle"] * 1e3, 4),
+            }
+            del _smoke_graph
+        except Exception as exc:  # noqa: BLE001 — auxiliary leg
+            print(f"bench: scale smoke failed ({exc}); continuing",
+                  file=sys.stderr)
+            scale_keys = {
+                "scale_smoke_cycles_per_s": None,
+                "scale_smoke_error":
+                    f"{type(exc).__name__}: {exc}"[:200],
+            }
     # Serving-throughput leg (both backends: the request plane exists
     # on the CPU fallback too, and its trajectory is what the
     # sentinel tracks per backend).  Never kills the headline line.
@@ -713,6 +855,22 @@ def run_bench():
               file=sys.stderr)
         serve_keys = {"serve_problems_per_sec": None,
                       "serve_error": f"{type(exc).__name__}: {exc}"[:200]}
+    # Sharded-superstep leg: real mesh on TPU (when the tunnel gave
+    # us more than one chip), forced-host-device child on CPU.
+    try:
+        if platform == "tpu" and len(jax.devices()) >= 2:
+            shard_keys = bench_sharded(
+                min(SHARDED_SHARDS, len(jax.devices())))
+            shard_keys["sharded_backend"] = "tpu"
+        else:
+            shard_keys = _bench_sharded_forced()
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: sharded leg failed ({exc}); continuing",
+              file=sys.stderr)
+        shard_keys = {
+            "maxsum_cycles_per_sec_sharded": None,
+            "sharded_error": f"{type(exc).__name__}: {exc}"[:200],
+        }
     out = {
         "metric": "maxsum_cycles_per_sec_10kvar_graphcoloring",
         "value": round(device_cps, 2),
@@ -743,6 +901,7 @@ def run_bench():
         **roofline,
         **scale_keys,
         **serve_keys,
+        **shard_keys,
     }
     out.update(_artifact_keys(platform, out))
     out["probe_diagnostics"] = diag_events()
@@ -750,6 +909,11 @@ def run_bench():
 
 
 def main():
+    if os.environ.get("PYDCOP_BENCH_SHARDED_CHILD"):
+        # Forced-host-device child of the sharded leg: one JSON line
+        # with the sharded keys, nothing else on stdout.
+        print(json.dumps(bench_sharded()))
+        return
     if (os.environ.get("PYDCOP_BENCH_CHILD")
             or os.environ.get("PYDCOP_BENCH_NO_PROBE")):
         run_bench()

@@ -49,13 +49,21 @@ def build_computation(comp_def):
     return build_algo_computation("amaxsum", comp_def)
 
 
+# Same engine as maxsum on the device path (asynchrony is an
+# agent-mode schedule, not a kernel), so partitioned sharding
+# (shards=) comes for free through the shared engine builder.
+SUPPORTS_SHARDS = True
+
+
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     max_cycles: int = 1000, mesh=None,
                     n_devices: Optional[int] = None,
+                    shards: Optional[int] = None,
                     stop_on_convergence: bool = True,
                     warmup: bool = False, **_) -> DeviceRunResult:
     return _maxsum.solve_on_device(
         dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
-        n_devices=n_devices, stop_on_convergence=stop_on_convergence,
+        n_devices=n_devices, shards=shards,
+        stop_on_convergence=stop_on_convergence,
         warmup=warmup,
     )

@@ -41,6 +41,11 @@ from pydcop_tpu.engine.runner import DeviceRunResult, MaxSumEngine
 
 GRAPH_TYPE = "factor_graph"
 
+# Partitioned sharding (api.solve(shards=N)): this module builds the
+# ShardedMaxSumEngine; amaxsum and maxsum_dynamic delegate their
+# device path here and re-declare the flag.
+SUPPORTS_SHARDS = True
+
 HEADER_SIZE = 0
 UNIT_SIZE = 1
 # Messages considered identical after this many resends (agent mode).
@@ -151,7 +156,8 @@ def _replay_auto_choice(dcop: DCOP):
 
 
 def build_engine(dcop: DCOP, params: dict, mesh=None,
-                 n_devices: Optional[int] = None) -> MaxSumEngine:
+                 n_devices: Optional[int] = None,
+                 shards: Optional[int] = None) -> MaxSumEngine:
     """Compile + construct the engine from validated algo params — the
     single place the parameter->engine wiring lives (solve_on_device
     and the CLI's device-mode trace reconstruction both use it).
@@ -160,7 +166,48 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
     valid baseline), measures the candidate strategies on the actual
     compiled graph (engine/autotune.py — mesh and hub-guard
     constraints respected there), swaps in the winner's agg arrays,
-    and annotates the engine so every result reports the decision."""
+    and annotates the engine so every result reports the decision.
+
+    ``shards=N`` (N >= 2) selects the PARTITIONED engine instead of
+    the replicated-variable mesh: a min-edge-cut partition
+    (engine/partition.py) assigns variables and factors to shards,
+    each shard owns its local slice of the variable tables, and only
+    cut-edge (halo) state crosses devices per superstep — O(cut·D)
+    communication instead of the replicated path's O(V·D)
+    (engine/sharding.py; docs/sharding.md).  Mutually exclusive with
+    ``mesh``/``n_devices``; partition statistics and communication
+    accounting land in every result's ``metrics``."""
+    if shards is not None and shards > 1:
+        if mesh is not None or n_devices:
+            raise ValueError(
+                "shards= (partitioned engine) and mesh=/n_devices= "
+                "(replicated sharding) are mutually exclusive")
+        if params.get("layout", "edge") == "lane":
+            raise ValueError(
+                "layout='lane' is single-device; the partitioned "
+                "engine uses the edge layout")
+        if int(params.get("decimation", 0) or 0) > 0:
+            raise ValueError(
+                "decimation clamps the single-device var_costs "
+                "table; run without shards=")
+        # The partitioned superstep aggregates locally with scatter;
+        # reuse the mesh aggregation policy (auto -> scatter,
+        # anything else refused loudly).
+        aggregation = validated_aggregation(params, max(shards, 2))
+        from pydcop_tpu.engine.multihost import partitioned_mesh
+        from pydcop_tpu.engine.runner import ShardedMaxSumEngine
+
+        graph, meta = compile_dcop(
+            dcop, noise_level=params.get("noise", 0.01),
+            aggregation=aggregation,
+        )
+        return ShardedMaxSumEngine(
+            graph, meta,
+            mesh=partitioned_mesh(shards),
+            damping=params.get("damping", 0.5),
+            damping_nodes=params.get("damping_nodes", "both"),
+            stability=params.get("stability", STABILITY_COEFF),
+        )
     pad_to = 1
     if mesh is not None:
         pad_to = mesh.size
@@ -228,11 +275,13 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
 def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
                     max_cycles: int = 1000, mesh=None,
                     n_devices: Optional[int] = None,
+                    shards: Optional[int] = None,
                     stop_on_convergence: bool = True,
                     warmup: bool = False, **_) -> DeviceRunResult:
     """Batched BSP MaxSum on TPU/CPU devices."""
     params = algo_def.params
-    engine = build_engine(dcop, params, mesh=mesh, n_devices=n_devices)
+    engine = build_engine(dcop, params, mesh=mesh,
+                          n_devices=n_devices, shards=shards)
     decimation = int(params.get("decimation", 0) or 0)
     if decimation > 0:
         # warmup is a no-op here: run_decimated is a multi-round

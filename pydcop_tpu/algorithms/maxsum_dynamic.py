@@ -76,6 +76,11 @@ def _slice_externals(dcop):
     return sliced
 
 
+# Delegates to the maxsum engine after slicing externals, so the
+# partitioned-sharding knob (shards=) flows through **kwargs.
+SUPPORTS_SHARDS = True
+
+
 def solve_on_device(dcop, algo_def, **kwargs):
     """Freeze external variables at their current values, then run the
     batched MaxSum engine on the writable problem."""

@@ -32,6 +32,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
           max_cycles: int = 1000,
           algo_params: Optional[Dict[str, Any]] = None,
           mesh=None, n_devices: Optional[int] = None,
+          shards: Optional[int] = None,
           warmup: bool = False,
           ui_port: Optional[int] = None,
           collector=None,
@@ -57,6 +58,17 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     backend="device": batched engine on TPU/CPU devices (default).
     backend="thread": agent-mode runtime (threads + in-process messages),
     reference-equivalent semantics.
+
+    Scaling knobs (docs/sharding.md): ``n_devices`` row-shards factor
+    buckets over a mesh with replicated variable tables (any device
+    algorithm; per-superstep all-reduce is O(V·D)); ``shards=N``
+    runs the PARTITIONED engine instead (maxsum family) — a
+    min-edge-cut partition assigns variables and factors to shards
+    and only cut-edge halo state is exchanged per superstep
+    (O(cut·D)).  Partition statistics (``edge_cut_fraction``,
+    ``halo_vars_per_shard``, ``balance``) and communication
+    accounting come back in ``metrics``.  The two knobs are mutually
+    exclusive.
 
     Resilience knobs (docs/resilience.md): ``checkpoint_dir`` chunks a
     device-mode solve into ``checkpoint_every``-cycle segments with an
@@ -168,6 +180,18 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
             "health monitoring instruments agent threads: use "
             "backend='thread'"
         )
+    if shards is not None and shards > 1:
+        if backend != "device":
+            raise ValueError(
+                "shards= partitions the device engine's factor "
+                "graph: use backend='device'"
+            )
+        if not getattr(module, "SUPPORTS_SHARDS", False):
+            raise NotImplementedError(
+                f"Algorithm {algo_def.algo} has no partitioned "
+                "engine (maxsum family only); use n_devices= for "
+                "replicated-variable sharding"
+            )
 
     session = None
     if (trace is not None or metrics_file is not None
@@ -187,6 +211,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                 dcop, algo_def, module, distribution=distribution,
                 backend=backend, timeout=timeout,
                 max_cycles=max_cycles, mesh=mesh, n_devices=n_devices,
+                shards=shards,
                 warmup=warmup, ui_port=ui_port, collector=collector,
                 collect_moment=collect_moment,
                 collect_period=collect_period, delay=delay,
@@ -305,7 +330,8 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
 
 
 def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
-           max_cycles, mesh, n_devices, warmup, ui_port, collector,
+           max_cycles, mesh, n_devices, shards, warmup, ui_port,
+           collector,
            collect_moment, collect_period, delay, checkpoint_dir,
            checkpoint_every, checkpoint_async, checkpoint_keep,
            resume, fault_plan, recovery, health, observing,
@@ -350,7 +376,8 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
             )
 
             engine = module.build_engine(
-                dcop, algo_def.params, mesh=mesh, n_devices=n_devices
+                dcop, algo_def.params, mesh=mesh, n_devices=n_devices,
+                shards=shards,
             )
             probe = None
             if probed:
@@ -395,9 +422,14 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
 
                 attach_result_metrics(res, probe)
         else:
+            extra = {}
+            if shards is not None and shards > 1:
+                # Only the maxsum family accepts shards (gated
+                # above); other modules never see the kwarg.
+                extra["shards"] = shards
             res = module.solve_on_device(
                 dcop, algo_def, max_cycles=max_cycles, mesh=mesh,
-                n_devices=n_devices, warmup=warmup,
+                n_devices=n_devices, warmup=warmup, **extra,
             )
         cost, violations = dcop.solution_cost(res.assignment)
         return SolveResult(

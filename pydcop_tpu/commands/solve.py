@@ -32,7 +32,17 @@ def set_parser(subparsers):
     parser.add_argument("-c", "--cycles", type=int, default=1000,
                         help="max cycles (device/synchronous modes)")
     parser.add_argument("--n_devices", type=int, default=None,
-                        help="shard over this many devices (device mode)")
+                        help="replicated-variable sharding: row-shard "
+                             "factor buckets over this many devices "
+                             "(device mode, any algorithm)")
+    parser.add_argument("--shards", type=int, default=None,
+                        help="partitioned sharding (device mode, "
+                             "maxsum family): min-edge-cut partition "
+                             "of the factor graph, per-shard variable "
+                             "slices, halo-only exchange — O(cut*D) "
+                             "per-superstep communication instead of "
+                             "O(V*D) (docs/sharding.md); mutually "
+                             "exclusive with --n_devices")
     parser.add_argument("--collect_on", default="value_change",
                         choices=["value_change", "cycle_change", "period"])
     parser.add_argument("--period", type=float, default=1.0)
@@ -241,6 +251,7 @@ def run_cmd(args) -> int:
             res = solve(
                 dcop, algo_def, backend="device",
                 max_cycles=args.cycles, n_devices=args.n_devices,
+                shards=args.shards,
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
                 checkpoint_async=args.checkpoint_async,
@@ -278,6 +289,7 @@ def run_cmd(args) -> int:
 
             trace_res = build_engine(
                 dcop, algo_def.params, n_devices=args.n_devices,
+                shards=args.shards,
             ).run_trace(max_cycles=max(res["cycles"], 1))
             for i, cost in enumerate(
                     trace_res.metrics["cost_trace"]):

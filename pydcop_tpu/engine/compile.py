@@ -242,9 +242,11 @@ _EMPTY_COSTS = np.zeros((0,), np.float32)
 
 def validated_aggregation(params: dict, pad_to: int) -> str:
     """Resolve an algorithm's ``aggregation`` param against the mesh
-    size.  shard_graph rebuilds graphs WITHOUT the agg_* arrays, so a
-    non-scatter strategy on a mesh would silently measure scatter —
-    refuse loudly instead (one policy for every algorithm family).
+    size.  shard_graph rebuilds graphs WITHOUT the agg_* arrays (and
+    the partitioned engine aggregates per shard with local scatter),
+    so a non-scatter strategy on a mesh would silently measure
+    scatter — refuse loudly instead (one policy for every algorithm
+    family).
 
     ``"auto"`` resolves to ``"scatter"`` on a mesh (the only valid
     sharded strategy — not an error, auto means "pick a valid one for

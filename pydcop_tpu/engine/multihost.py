@@ -188,3 +188,30 @@ def global_mesh(n_devices: Optional[int] = None):
             "mesh"
         )
     return make_mesh(n_devices)
+
+
+def partitioned_mesh(shards: int):
+    """Mesh for the PARTITIONED engine (``api.solve(shards=N)`` /
+    ``pydcop solve --shards N``): the same unjoined-multihost guard as
+    :func:`global_mesh` (a participant partitioning over its local
+    devices while the pod partitions globally would compute a wrong
+    halo exchange — the silent-wrong-answer failure mode), plus a
+    device-count check with the CPU-testing recipe in the message.
+
+    Under multihost the mesh spans the GLOBAL device list, so cut
+    edges between shards on different hosts ride DCN and the rest ICI
+    — same code path, bigger mesh."""
+    import jax
+
+    if shards < 2:
+        raise ValueError(
+            f"partitioned sharding needs shards >= 2, got {shards}")
+    available = len(jax.devices()) if not multihost_configured() \
+        else None
+    if available is not None and shards > available:
+        raise ValueError(
+            f"shards={shards} but only {available} device(s) "
+            "available; for CPU testing force host devices with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{shards}")
+    return global_mesh(shards)

@@ -1,0 +1,402 @@
+"""Host-side min-edge-cut partitioning of a compiled factor graph.
+
+The replicated-variable sharding story (engine/sharding.shard_graph)
+all-reduces dense ``[V+1, D]`` message totals every superstep, so
+per-device communication is O(V·D) regardless of how local the graph
+is.  The fine-grained factor-graph parallelism analysis (PAPERS.md,
+arXiv 1603.02526) and the GPU loopy-BP partition/halo recipe
+(arXiv 2509.22337) both say the same thing: partition the graph so
+interior message updates stay local and only CUT-EDGE state crosses
+devices.  This module is the host side of that recipe:
+
+- :func:`partition_factor_graph` — greedy BFS-growth partitioning with
+  boundary refinement (a KL-style gain sweep), no external deps.  BFS
+  growth from peripheral (low-degree) seeds produces connected,
+  balanced regions; the refinement passes move boundary variables to
+  the neighboring shard they are most connected to, under a balance
+  cap.  On locally-connected graphs (grids, rings, meshes — the
+  sensor-net shapes DCOPs model) this lands single-digit-percent edge
+  cuts; on expander-like random graphs no partitioner can do well and
+  the reported ``edge_cut_fraction`` says so honestly.
+
+- :class:`Partition` — variable→shard and factor→shard assignments
+  plus the cut statistics (``edge_cut_fraction``,
+  ``halo_vars_per_shard``, ``balance``) that
+  ``DeviceRunResult.metrics`` reports.
+
+- a structure-keyed cache (:data:`partition_cache`), same key material
+  as the PR-3 compile layout cache (variable count + per-arity
+  scope-index bytes + shard count): re-solving a same-shaped problem
+  never re-partitions.
+
+Everything here is pure numpy + stdlib; the device side lives in
+engine/sharding.py (:func:`~pydcop_tpu.engine.sharding.
+build_partitioned_graph` consumes the Partition).
+"""
+
+import os
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Partition:
+    """A variable/factor → shard assignment with cut statistics.
+
+    ``var_shard`` is ``[V] int32``; ``factor_shard`` holds one
+    ``[F_real] int32`` array per bucket (real factors only, padding
+    rows excluded, in bucket row order).  ``stats`` carries the
+    numbers the engine folds into ``DeviceRunResult.metrics``:
+
+    - ``edge_cut_fraction``: fraction of (factor, variable)
+      incidences whose endpoints live on different shards — the
+      communication-volume driver;
+    - ``halo_vars_per_shard``: per-shard count of variables referenced
+      by local factors but owned elsewhere;
+    - ``boundary_vars``: size of the global halo-exchange buffer
+      (variables that are halo for at least one shard);
+    - ``balance``: max owned-variable count over the ideal ``V/S``.
+    """
+
+    n_shards: int
+    var_shard: np.ndarray
+    factor_shard: Tuple[np.ndarray, ...]
+    stats: Dict[str, Any] = field(default_factory=dict)
+
+
+class PartitionCache:
+    """Structure-keyed partition memo (same shape as the PR-3
+    CompileCache): a partition is a pure function of (variable count,
+    per-arity scope indices, shard count), never of costs, so
+    same-structure re-solves — the serving traffic pattern — skip the
+    BFS + refinement entirely.  Bounded LRU, thread-safe,
+    ``PYDCOP_COMPILE_CACHE=0`` disables it together with the layout
+    cache (one switch for all structure caching)."""
+
+    def __init__(self, maxsize: int = 8):
+        self.maxsize = maxsize
+        self._entries: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.builds = 0
+
+    def get(self, key):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry
+            self.misses += 1
+            return None
+
+    def put(self, key, entry):
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+
+    def record_build(self):
+        """Count a real partition construction (cache miss OR caching
+        disabled — same convention as the compile cache's
+        layout_builds).  Under the lock: serving compiles on
+        concurrent submitter threads."""
+        with self._lock:
+            self.builds += 1
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self.hits = self.misses = self.builds = 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "builds": self.builds,
+                "entries": len(self._entries),
+            }
+
+
+partition_cache = PartitionCache()
+
+
+def build_adjacency(scopes: Sequence[np.ndarray], n_vars: int
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """CSR variable adjacency from per-bucket scope-index arrays
+    (``[F, arity] int``): an edge per co-occurring scope pair (factors
+    of arity > 2 contribute their scope clique).  Returns
+    ``(neighbors, starts, ends)`` — the neighbor list of variable v is
+    ``neighbors[starts[v]:ends[v]]`` (duplicates kept: parallel
+    factors weigh their pair accordingly in the refinement gains)."""
+    pair_blocks: List[np.ndarray] = []
+    for sc in scopes:
+        if sc.size == 0:
+            continue
+        arity = sc.shape[1]
+        for i in range(arity):
+            for j in range(i + 1, arity):
+                pair_blocks.append(sc[:, (i, j)])
+    if not pair_blocks:
+        empty = np.zeros((0,), np.int32)
+        zeros = np.zeros((n_vars,), np.int64)
+        return empty, zeros, zeros
+    pairs = np.concatenate(pair_blocks, axis=0)
+    src = np.concatenate([pairs[:, 0], pairs[:, 1]]).astype(np.int64)
+    dst = np.concatenate([pairs[:, 1], pairs[:, 0]]).astype(np.int32)
+    order = np.argsort(src, kind="stable")
+    src, dst = src[order], dst[order]
+    idx = np.arange(n_vars)
+    starts = np.searchsorted(src, idx, side="left")
+    ends = np.searchsorted(src, idx, side="right")
+    return dst, starts, ends
+
+
+def _bfs_grow(n_vars: int, n_shards: int, neighbors: np.ndarray,
+              starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    """Initial assignment: grow each shard as a BFS region from a
+    peripheral (lowest-degree unassigned) seed until it reaches its
+    quota; disconnected leftovers seed fresh BFS frontiers inside the
+    same shard.  Quotas are recomputed per shard from the remaining
+    pool so the last shard is never starved or flooded."""
+    var_shard = np.full(n_vars, -1, np.int32)
+    degree = ends - starts
+    seed_order = np.argsort(degree, kind="stable")
+    seed_ptr = 0
+    remaining = n_vars
+    for s in range(n_shards):
+        if remaining <= 0:
+            break
+        quota = -(-remaining // (n_shards - s))  # ceil
+        size = 0
+        frontier: deque = deque()
+        while size < quota:
+            if not frontier:
+                while (seed_ptr < n_vars
+                       and var_shard[seed_order[seed_ptr]] >= 0):
+                    seed_ptr += 1
+                if seed_ptr >= n_vars:
+                    break
+                frontier.append(int(seed_order[seed_ptr]))
+            v = frontier.popleft()
+            if var_shard[v] >= 0:
+                continue
+            var_shard[v] = s
+            size += 1
+            for u in neighbors[starts[v]:ends[v]]:
+                if var_shard[u] < 0:
+                    frontier.append(int(u))
+        remaining -= size
+    # Any stragglers (can only happen on degenerate inputs) land on
+    # the last shard so every variable is owned exactly once.
+    var_shard[var_shard < 0] = n_shards - 1
+    return var_shard
+
+
+def _refine(var_shard: np.ndarray, n_shards: int,
+            neighbors: np.ndarray, starts: np.ndarray,
+            ends: np.ndarray, passes: int, imbalance: float
+            ) -> np.ndarray:
+    """Boundary refinement: deterministic sweeps moving boundary
+    variables to the neighboring shard they have the most edges into,
+    when that strictly reduces the cut and respects the balance cap.
+
+    Each pass computes every vertex's per-shard connectivity in one
+    vectorized scatter-add over the edge list ([V, S] counts — the
+    O(V·loop-body) Python sweep would cost minutes at the 1M-variable
+    scale this engine targets), selects the positive-gain CANDIDATES
+    (an O(cut)-sized set), and applies them in deterministic vertex
+    order, re-checking each candidate's gain against the live
+    assignment at application time — so every applied move strictly
+    reduces the cut (monotone per pass; a candidate stale-ified by an
+    earlier move this pass is simply skipped and reconsidered next
+    pass), and the loop stops at the first pass that moves nothing."""
+    n_vars = var_shard.shape[0]
+    if neighbors.size == 0 or n_vars == 0:
+        return var_shard
+    ideal = n_vars / n_shards
+    cap = int(np.ceil(ideal * (1.0 + imbalance)))
+    floor = max(1, int(np.floor(ideal * (1.0 - imbalance))))
+    sizes = np.bincount(var_shard, minlength=n_shards)
+    src = np.repeat(np.arange(n_vars), ends - starts)
+    vidx = np.arange(n_vars)
+    for _ in range(passes):
+        counts = np.zeros((n_vars, n_shards), np.int32)
+        np.add.at(counts, (src, var_shard[neighbors]), 1)
+        internal = counts[vidx, var_shard]
+        counts[vidx, var_shard] = -1
+        best = counts.argmax(axis=1)
+        gain = counts[vidx, best] - internal
+        movers = np.nonzero(gain > 0)[0]
+        moved = 0
+        for v in movers:
+            nb = var_shard[neighbors[starts[v]:ends[v]]]
+            cur = int(var_shard[v])
+            live = np.bincount(nb, minlength=n_shards)
+            live_internal = live[cur]
+            live[cur] = -1
+            dest = int(np.argmax(live))
+            if (live[dest] - live_internal > 0
+                    and sizes[dest] < cap and sizes[cur] > floor):
+                var_shard[v] = dest
+                sizes[cur] -= 1
+                sizes[dest] += 1
+                moved += 1
+        if moved == 0:
+            break
+    return var_shard
+
+
+def _assign_factors(scopes: Sequence[np.ndarray],
+                    var_shard: np.ndarray
+                    ) -> Tuple[np.ndarray, ...]:
+    """Each factor goes to the shard owning the majority of its scope
+    (its messages then stay local for those endpoints).  Binary
+    factors with split endpoints have no majority; alternating the
+    tie-break by factor index keeps the cut-factor load balanced
+    while staying deterministic."""
+    out = []
+    for sc in scopes:
+        if sc.shape[0] == 0:
+            out.append(np.zeros((0,), np.int32))
+            continue
+        sh = var_shard[sc]  # [F, arity]
+        if sc.shape[1] == 1:
+            out.append(sh[:, 0].astype(np.int32))
+            continue
+        if sc.shape[1] == 2:
+            idx = np.arange(sh.shape[0])
+            pick = np.where(idx % 2 == 0, sh[:, 0], sh[:, 1])
+            fac = np.where(sh[:, 0] == sh[:, 1], sh[:, 0], pick)
+            out.append(fac.astype(np.int32))
+            continue
+        counts = np.zeros((sh.shape[0], int(sh.max()) + 1), np.int32)
+        rows = np.arange(sh.shape[0])
+        for p in range(sh.shape[1]):
+            np.add.at(counts, (rows, sh[:, p]), 1)
+        out.append(counts.argmax(axis=1).astype(np.int32))
+    return tuple(out)
+
+
+def cut_statistics(scopes: Sequence[np.ndarray],
+                   var_shard: np.ndarray,
+                   factor_shard: Sequence[np.ndarray],
+                   n_shards: int) -> Dict[str, Any]:
+    """Cut/halo/balance numbers for a (var, factor) assignment — the
+    dict that lands in ``DeviceRunResult.metrics``."""
+    n_vars = var_shard.shape[0]
+    total = 0
+    cut = 0
+    halo_sets: List[set] = [set() for _ in range(n_shards)]
+    for sc, fs in zip(scopes, factor_shard):
+        if sc.shape[0] == 0:
+            continue
+        vs = var_shard[sc]                      # [F, arity]
+        off = vs != fs[:, None]
+        total += vs.size
+        cut += int(off.sum())
+        f_idx, p_idx = np.nonzero(off)
+        for f, p in zip(f_idx, p_idx):
+            halo_sets[int(fs[f])].add(int(sc[f, p]))
+    halo_sizes = [len(h) for h in halo_sets]
+    boundary = set().union(*halo_sets) if halo_sets else set()
+    sizes = np.bincount(var_shard, minlength=n_shards)
+    ideal = n_vars / n_shards if n_shards else 1.0
+    return {
+        "n_shards": n_shards,
+        "edge_cut_fraction": (cut / total) if total else 0.0,
+        "cut_incidences": cut,
+        "total_incidences": total,
+        "halo_vars_per_shard": halo_sizes,
+        "boundary_vars": len(boundary),
+        "owned_vars_per_shard": sizes.tolist(),
+        "balance": float(sizes.max() / ideal) if n_vars else 1.0,
+    }
+
+
+def partition_factor_graph(scopes: Sequence[np.ndarray], n_vars: int,
+                           n_shards: int, *, refine_passes: int = 4,
+                           imbalance: float = 0.1) -> Partition:
+    """Partition a factor graph given per-bucket scope-index arrays.
+
+    Greedy BFS growth (balanced quotas, peripheral seeds) followed by
+    ``refine_passes`` boundary-refinement sweeps under a
+    ``(1 + imbalance)`` balance cap.  Fully deterministic: no RNG
+    anywhere, so the same structure always produces the same
+    partition — which is what lets the partition ride the structure
+    cache and keeps sharded solves replayable."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    scopes = [np.asarray(sc, np.int64).reshape(-1, sc.shape[-1])
+              for sc in scopes]
+    if n_shards == 1 or n_vars == 0:
+        var_shard = np.zeros(n_vars, np.int32)
+        factor_shard = tuple(
+            np.zeros(sc.shape[0], np.int32) for sc in scopes)
+        return Partition(
+            n_shards=n_shards, var_shard=var_shard,
+            factor_shard=factor_shard,
+            stats=cut_statistics(scopes, var_shard, factor_shard,
+                                 n_shards),
+        )
+    neighbors, starts, ends = build_adjacency(scopes, n_vars)
+    var_shard = _bfs_grow(n_vars, n_shards, neighbors, starts, ends)
+    var_shard = _refine(var_shard, n_shards, neighbors, starts, ends,
+                        refine_passes, imbalance)
+    factor_shard = _assign_factors(scopes, var_shard)
+    return Partition(
+        n_shards=n_shards,
+        var_shard=var_shard,
+        factor_shard=factor_shard,
+        stats=cut_statistics(scopes, var_shard, factor_shard,
+                             n_shards),
+    )
+
+
+def real_factor_rows(var_ids: np.ndarray, n_vars: int) -> np.ndarray:
+    """Row indices of REAL factors in a (possibly padded) bucket:
+    padding rows point every scope slot at the sentinel variable."""
+    return np.nonzero(
+        ~np.all(np.asarray(var_ids) == n_vars, axis=1))[0]
+
+
+def partition_compiled(graph, n_shards: int, *,
+                       refine_passes: int = 4,
+                       imbalance: float = 0.1,
+                       use_cache: Optional[bool] = None) -> Partition:
+    """Partition a :class:`~pydcop_tpu.engine.compile.
+    CompiledFactorGraph` (padding rows excluded), memoized on the
+    layout signature — the same (v_count, scope-index bytes) key
+    material the PR-3 compile cache uses, extended with the shard
+    count."""
+    if use_cache is None:
+        use_cache = os.environ.get("PYDCOP_COMPILE_CACHE") != "0"
+    n_vars = graph.n_vars
+    scopes = []
+    for b in graph.buckets:
+        ids = np.asarray(b.var_ids)
+        scopes.append(ids[real_factor_rows(ids, n_vars)])
+    key = None
+    if use_cache:
+        key = (
+            n_vars, n_shards, refine_passes, imbalance,
+            tuple((sc.shape[1], sc.tobytes()) for sc in scopes),
+        )
+        hit = partition_cache.get(key)
+        if hit is not None:
+            return hit
+    partition_cache.record_build()
+    part = partition_factor_graph(
+        scopes, n_vars, n_shards,
+        refine_passes=refine_passes, imbalance=imbalance,
+    )
+    if use_cache:
+        partition_cache.put(key, part)
+    return part

@@ -57,14 +57,24 @@ class EngineProbe:
         import jax
         import jax.numpy as jnp
 
-        from pydcop_tpu.ops.maxsum import assignment_constraint_cost
+        from pydcop_tpu.ops import maxsum as maxsum_ops
+
+        # The engine's own kernel namespace when it has one: the
+        # partitioned engine's graph is a ShardedGraph whose cost
+        # evaluation needs the halo-value exchange, and its ShardOps
+        # exposes the same assignment_constraint_cost surface over a
+        # GLOBAL [V] assignment.
+        ops = getattr(self.engine, "_ops", maxsum_ops)
+        constraint_cost = getattr(
+            ops, "assignment_constraint_cost",
+            maxsum_ops.assignment_constraint_cost)
 
         meta = self.engine.meta
         base = meta.var_base_costs
         base_arr = None if base is None else jnp.asarray(base)
 
         def cost_of(values):
-            cost = assignment_constraint_cost(self.engine.graph, values)
+            cost = constraint_cost(self.engine.graph, values)
             if base_arr is not None:
                 cost = cost + jnp.sum(jnp.take_along_axis(
                     base_arr, values[:, None], axis=1))

@@ -450,6 +450,13 @@ def merge_traces(paths: Sequence[str], out_path: str
     Per-lane nesting is preserved (a uniform per-file shift cannot
     reorder spans within a lane), so ``check_well_nested`` holds on
     the merged trace iff it held on the inputs.
+
+    Shard lanes: events tagged with a scalar ``shard`` arg (the
+    partitioned engine's per-shard ``shard_segment`` instants —
+    engine/runner.py) are demuxed onto their own
+    ``(file, tid, shard)`` lane labeled ``... [shard N]``, so a
+    sharded solve reads as one lane per shard in Perfetto instead of
+    an interleaved pile on the dispatching host thread.
     """
     if len(paths) < 2:
         raise TraceFileError("trace merge needs at least two files")
@@ -501,7 +508,15 @@ def merge_traces(paths: Sequence[str], out_path: str
             out["ts"] = float(ev.get("ts", 0.0)) + off - base
             thread = (names.get(ev.get("tid"))
                       or str(ev.get("tid", "?")))
-            out["tid"] = _lane(fi, ev.get("tid"), f"{who} {thread}")
+            shard = (ev.get("args") or {}).get("shard")
+            if isinstance(shard, (int, str)) and not isinstance(
+                    shard, bool):
+                out["tid"] = _lane(
+                    fi, (ev.get("tid"), "shard", shard),
+                    f"{who} {thread} [shard {shard}]")
+            else:
+                out["tid"] = _lane(fi, ev.get("tid"),
+                                   f"{who} {thread}")
             out.pop("thread", None)
             # Correlation ids (top-level in JSONL events, inside args
             # for re-loaded Chrome exports): namespace per file so

@@ -91,11 +91,30 @@ def test_sharded_matches_unsharded():
 @pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
 )
-def test_sharded_bucket_padding_guard():
+def test_sharded_bucket_autopad():
+    """Regression (ISSUE 7 satellite): bucket rows not divisible by
+    the mesh size used to raise a hard ValueError demanding
+    ``pad_to=mesh.size`` at compile time; shard_graph now auto-pads
+    with masked sentinel rows and the padded sharded run matches the
+    unsharded one exactly."""
     variables, constraints = _big_problem()
+    constraints = constraints[:1001]  # 1001 rows: not divisible by 8
     mesh = make_mesh(8)
-    graph, _ = compile_factor_graph(variables, constraints[:1001])
-    if graph.buckets[0].costs.shape[0] % mesh.size == 0:
-        pytest.skip("padding accidentally aligned")
-    with pytest.raises(ValueError, match="not divisible"):
-        shard_graph(graph, mesh)
+    graph, _ = compile_factor_graph(
+        variables, constraints, noise_level=0.01, noise_seed=1)
+    assert graph.buckets[0].costs.shape[0] % mesh.size != 0
+    placed = shard_graph(graph, mesh)
+    assert placed.buckets[0].costs.shape[0] % mesh.size == 0
+    # Padding rows carry zero cost and sentinel var ids.
+    pad_rows = np.asarray(placed.buckets[0].var_ids)[1001:]
+    assert (pad_rows == len(variables)).all()
+    assert np.asarray(placed.buckets[0].costs)[1001:].sum() == 0.0
+
+    state1, values1 = jax.jit(
+        lambda g: run_maxsum(g, 40, stop_on_convergence=False)
+    )(jax.device_put(graph))
+    state8, values8 = jax.jit(
+        lambda g: run_maxsum(g, 40, stop_on_convergence=False)
+    )(placed)
+    assert np.array_equal(np.asarray(values1), np.asarray(values8))
+    assert int(state1.cycle) == int(state8.cycle)

@@ -136,6 +136,117 @@ def test_exact_solvers_cost_parity(algo):
     assert sharded.assignment == single.assignment
 
 
+# ------------------------------------------------------------------ #
+# Partitioned engine (ISSUE 7): shards= runs the min-edge-cut /
+# halo-exchange path, a different kernel from the replicated
+# n_devices= mesh — parity is asserted separately, across the full
+# 1/2/8 forced-host-device ladder, including a mid-solve
+# checkpointed resume.
+
+
+def _grid_dcop(side=10, seed=4):
+    """4-neighbor grid coloring: the locally-connected loopy shape
+    the partitioner is built for (single-digit-percent cuts).  One
+    shared builder across the bench, the shard-smoke gate and both
+    test batteries — see bench.build_grid_dcop."""
+    from bench import build_grid_dcop
+
+    return build_grid_dcop(side, seed=seed)
+
+
+@pytest.mark.parametrize("shards", [2, 8])
+@pytest.mark.parametrize("topo", ["grid", "loopy", "tree"])
+def test_partitioned_assignment_parity(topo, shards):
+    """Partitioned maxsum across the device ladder: identical
+    assignment and cost to the single-device engine on grids (the
+    partitioner's home turf), expander-like loopy graphs (worst-case
+    cuts) and trees (quiescent fixpoint)."""
+    dcop = {"grid": _grid_dcop, "loopy": _loopy_int_dcop,
+            "tree": _tree_dcop}[topo]()
+    single = solve(dcop, "maxsum", backend="device", max_cycles=60)
+    sharded = solve(dcop, "maxsum", backend="device", max_cycles=60,
+                    shards=shards)
+    assert sharded.assignment == single.assignment, (
+        f"partitioned maxsum diverged on {topo} at {shards} shards")
+    assert sharded.cost == single.cost
+    m = sharded["metrics"]
+    assert m["n_shards"] == shards
+    assert 0.0 <= m["edge_cut_fraction"] <= 1.0
+    assert len(m["halo_vars_per_shard"]) == shards
+    # O(cut*D) < O(V*D): the whole point of the partitioned path.
+    assert (m["halo_exchange_elems_per_superstep"]
+            < m["replicated_allreduce_elems_per_superstep"])
+
+
+def test_partitioned_cost_trajectory_parity():
+    """Per-cycle cost traces agree across 1/2/8 devices: the
+    partitioned per-shard cost psum is a partition of the global sum
+    (each factor and variable owned exactly once)."""
+    from pydcop_tpu.algorithms.maxsum import build_engine
+
+    dcop = _grid_dcop()
+    params = {"noise": 0.01}
+    ref = build_engine(dcop, params).run_trace(max_cycles=40)
+    for shards in (2, 8):
+        trace = build_engine(
+            dcop, params, shards=shards).run_trace(max_cycles=40)
+        np.testing.assert_allclose(
+            trace.metrics["cost_trace"], ref.metrics["cost_trace"],
+            rtol=1e-5,
+            err_msg=f"cost trajectory diverged at {shards} shards")
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("maxsum", {}),
+    ("dsa", {"seed": 3}),
+    ("mgm", {"seed": 3}),
+])
+@pytest.mark.parametrize("n", [2, 8])
+def test_device_ladder_parity(algo, params, n):
+    """The ISSUE-7 ladder: maxsum rides the partitioned engine
+    (shards=), the local-search kernels ride the replicated mesh
+    (n_devices=) — each across 1/2/8 forced host devices with
+    identical assignments and costs."""
+    dcop = _grid_dcop()
+    single = solve(dcop, algo, backend="device", max_cycles=30,
+                   algo_params=params)
+    kwargs = ({"shards": n} if algo == "maxsum"
+              else {"n_devices": n})
+    sharded = solve(dcop, algo, backend="device", max_cycles=30,
+                    algo_params=params, **kwargs)
+    assert sharded.assignment == single.assignment
+    assert sharded.cost == single.cost
+
+
+def test_partitioned_checkpoint_resume_mid_solve(tmp_path):
+    """run_checkpointed on a sharded graph, interrupted mid-solve and
+    resumed: the resumed trajectory equals the uninterrupted one
+    (assignment, cost, cycle count) — the halo double-buffer is part
+    of the snapshot, so a resume re-enters the exchange exactly where
+    it left off."""
+    from pydcop_tpu.algorithms.maxsum import build_engine
+    from pydcop_tpu.resilience.checkpoint import resume_from_checkpoint
+
+    dcop = _grid_dcop()
+    params = {"noise": 0.01}
+    ref = build_engine(dcop, params, shards=8).run_checkpointed(
+        max_cycles=60, segment_cycles=20, stop_on_convergence=False)
+
+    interrupted = build_engine(
+        dcop, params, shards=8).run_checkpointed(
+        max_cycles=60, segment_cycles=20, stop_on_convergence=False,
+        checkpoint_dir=str(tmp_path), max_segments=2)
+    assert interrupted.metrics["interrupted"]
+    assert interrupted.cycles == 40
+
+    resumed = resume_from_checkpoint(
+        build_engine(dcop, params, shards=8), str(tmp_path),
+        max_cycles=60, stop_on_convergence=False)
+    assert resumed.metrics["resumed_from_cycle"] == 40
+    assert resumed.cycles == ref.cycles
+    assert resumed.assignment == ref.assignment
+
+
 def test_all_fourteen_covered():
     """The battery must cover every algorithm exposing a device path
     (pkgutil discovery — a 15th algorithm without a parity row fails
