@@ -4,10 +4,10 @@
 
 PY ?= python
 
-.PHONY: all test chaos chaos-soak trace-demo perf-smoke serve-smoke shard-smoke bench-check unit api cli check doctest bench dryrun onchip
+.PHONY: all test chaos chaos-soak chaos-soak-quick trace-demo perf-smoke serve-smoke shard-smoke bench-check unit api cli check doctest bench dryrun onchip
 
-# 0 = the full scenario matrix; `make test` runs the quick 6-scenario
-# gate (the first 6 cover every failure class; fixed seed, < 60 s).
+# 0 = the full scenario matrix; `make test` runs the --quick
+# device-side gate (chaos_soak.QUICK_GATE; fixed seed, ~20 s).
 SOAK_SCENARIOS ?= 0
 
 all: check test
@@ -30,15 +30,19 @@ chaos:
 
 # Self-healing gate: the seeded chaos-soak scenario matrix
 # (drop+dup+delay / partition-with-heal / silent kill / guard trip /
-# checkpoint corruption), each asserting the global invariants: valid
-# assignment, monotone cycle counter, no orphaned computations, and
-# health verdicts consistent with the injected kill schedule.  A red
-# scenario prints its seed + trace file for replay
+# checkpoint corruption / serve crash + journal replay / poison bin /
+# shard trip + repartition), each asserting the global invariants:
+# valid assignment, monotone cycle counter, no orphaned computations,
+# and health verdicts consistent with the injected kill schedule.  A
+# red scenario prints its seed + trace file for replay
 # (tools/chaos_soak.py --only NAME).  Default = full matrix;
-# `make test` runs the quick gate via SOAK_SCENARIOS=6.
+# `make test` runs the --quick device-side gate (~20 s).
 chaos-soak:
 	PYDCOP_CHAOS_SEED=42 $(PY) tools/chaos_soak.py \
 		--scenarios $(SOAK_SCENARIOS)
+
+chaos-soak-quick:
+	PYDCOP_CHAOS_SEED=42 $(PY) tools/chaos_soak.py --quick
 
 # Observability gate: solve a small graph coloring through the real
 # CLI with --trace + --metrics and assert the Chrome trace validates
@@ -86,7 +90,7 @@ bench-check:
 
 test: trace-demo perf-smoke serve-smoke shard-smoke
 	-$(PY) tools/bench_sentinel.py
-	$(MAKE) chaos-soak SOAK_SCENARIOS=6
+	$(MAKE) chaos-soak-quick
 	$(PY) -m pytest tests/ -q
 
 unit:

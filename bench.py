@@ -490,7 +490,7 @@ def bench_sharded(n_shards: int = SHARDED_SHARDS):
         max_cycles=SHARDED_CYCLES, stop_on_convergence=False)
     cps = res.cycles / res.time_s if res.time_s > 0 else 0.0
     m = res.metrics
-    return {
+    out = {
         "maxsum_cycles_per_sec_sharded": round(cps, 2),
         "sharded_n_vars": SHARDED_SIDE * SHARDED_SIDE,
         "sharded_n_shards": n_shards,
@@ -502,6 +502,28 @@ def bench_sharded(n_shards: int = SHARDED_SHARDS):
             "replicated_allreduce_elems_per_superstep"],
         "sharded_balance": round(m["balance"], 3),
     }
+    # Shard-loss recovery latency (ISSUE 8): inject a device loss on
+    # a FRESH engine for the same instance and report the engine's
+    # repartition + state-remap wall time — the time a mid-solve
+    # device failure costs on this backend before compute resumes.
+    try:
+        from pydcop_tpu.resilience.recovery import RecoveryPolicy
+
+        rec_res = build_engine(
+            dcop, {"noise": 0.01}, shards=n_shards,
+        ).run_checkpointed(
+            max_cycles=30, segment_cycles=10,
+            stop_on_convergence=False,
+            recovery=RecoveryPolicy(trip_shard=((10, 1),)))
+        out["shard_recovery_s"] = \
+            rec_res.metrics["shard_recovery_s"]
+    except Exception as exc:  # noqa: BLE001 — auxiliary sub-leg
+        print(f"bench: shard-recovery leg failed ({exc}); "
+              "continuing", file=sys.stderr)
+        out["shard_recovery_s"] = None
+        out["shard_recovery_error"] = \
+            f"{type(exc).__name__}: {exc}"[:200]
+    return out
 
 
 def _bench_sharded_forced():
@@ -622,6 +644,58 @@ def bench_serving():
             stats["batched_dispatches"] / stats["dispatches"], 3)
             if stats["dispatches"] else None,
     }
+
+
+# Crash-recovery replay leg (ISSUE 8): how long a --recover start
+# takes to scan + compact the journal and push REPLAY_N acknowledged
+# requests back through the queue — the downtime a serve-process
+# crash adds before the service answers again.
+REPLAY_N = 8
+REPLAY_N_VARS = 24
+REPLAY_MAX_CYCLES = 60
+
+
+def bench_recovery_replay():
+    """Time a journal crash-recovery start: REPLAY_N accepted-but-
+    unfinished records on disk, ``SolveService(recover=True).start()``
+    timed (scan, torn-tail handling, compaction, re-compile, enqueue
+    — everything between process start and the queue being live
+    again).  Returns {serve_recovery_replay_s, serve_recovery_replayed}
+    (None-valued on failure — never kills the headline line)."""
+    import shutil
+    import tempfile
+
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving.journal import (
+        RequestJournal,
+        accepted_record,
+    )
+    from pydcop_tpu.serving.service import SolveService
+
+    journal_dir = tempfile.mkdtemp(prefix="bench_replay_")
+    try:
+        jnl = RequestJournal(journal_dir)
+        for i in range(REPLAY_N):
+            jnl.append(accepted_record(
+                f"r{i}", dcop_yaml(build_dcop_small(REPLAY_N_VARS, i)),
+                {"max_cycles": REPLAY_MAX_CYCLES}))
+        jnl.close()
+        service = SolveService(journal_dir=journal_dir, recover=True,
+                               batch_window_s=0.005, max_batch=16)
+        t0 = time.perf_counter()
+        service.start()
+        replay_s = time.perf_counter() - t0
+        try:
+            for i in range(REPLAY_N):
+                service.result(f"r{i}", wait=120)
+        finally:
+            service.stop(drain=False)
+        return {
+            "serve_recovery_replay_s": round(replay_s, 4),
+            "serve_recovery_replayed": REPLAY_N,
+        }
+    finally:
+        shutil.rmtree(journal_dir, ignore_errors=True)
 
 
 def build_dcop_small(n_vars: int, seed: int):
@@ -855,6 +929,19 @@ def run_bench():
               file=sys.stderr)
         serve_keys = {"serve_problems_per_sec": None,
                       "serve_error": f"{type(exc).__name__}: {exc}"[:200]}
+    # Crash-recovery replay leg: journal scan + replay downtime —
+    # the sentinel tracks it per backend like any other metric, so a
+    # change that slows recovery is a tracked regression.
+    try:
+        serve_keys.update(bench_recovery_replay())
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: recovery-replay leg failed ({exc}); "
+              "continuing", file=sys.stderr)
+        serve_keys.update({
+            "serve_recovery_replay_s": None,
+            "serve_recovery_error":
+                f"{type(exc).__name__}: {exc}"[:200],
+        })
     # Sharded-superstep leg: real mesh on TPU (when the tunnel gave
     # us more than one chip), forced-host-device child on CPU.
     try:

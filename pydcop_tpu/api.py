@@ -249,9 +249,11 @@ class ServeHandle:
     def port(self):
         return self.front_end.port
 
-    def stop(self, drain: bool = True):
+    def stop(self, drain: bool = True) -> Dict[str, Any]:
+        """Stop front end + service; returns the service's drain
+        summary (``drained`` / ``replayable`` / ``failed_pending``)."""
         self.front_end.stop()
-        self.service.stop(drain=drain)
+        return self.service.stop(drain=drain)
 
     def __enter__(self) -> "ServeHandle":
         return self
@@ -267,6 +269,9 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           default_params: Optional[Dict[str, Any]] = None,
           breaker_failures: int = 3, breaker_reset_s: float = 5.0,
           result_keep: int = 4096,
+          journal_dir: Optional[str] = None,
+          journal_sync: bool = False,
+          recover: bool = False,
           block: bool = False) -> Optional[ServeHandle]:
     """Start the multi-tenant solve service (docs/serving.md).
 
@@ -283,10 +288,19 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     ``breaker_reset_s`` probe delay) that turns submits 503 and
     ``/healthz`` failing.
 
+    ``journal_dir`` enables the durable request journal (every 202 is
+    crash-durable); ``recover=True`` replays accepted-but-unfinished
+    journal entries through the queue on startup (``pydcop serve
+    --journal_dir D --recover``); ``journal_sync`` fsyncs per record.
+
     ``port=0`` asks the OS for a free port.  ``block=True`` (the
-    ``pydcop serve`` CLI) serves until interrupted and returns None;
-    ``block=False`` returns a :class:`ServeHandle` (also a context
-    manager) for embedding and tests.
+    ``pydcop serve`` CLI) serves until SIGTERM/SIGINT, then STOPS
+    WITH DRAIN — an orchestrated restart (k8s-style) never drops
+    accepted work: queued requests either finish in the drain window
+    or stay journaled-replayable, and the drained count is logged on
+    exit.  Returns None.  ``block=False`` returns a
+    :class:`ServeHandle` (also a context manager) for embedding and
+    tests.
     """
     from pydcop_tpu.serving.admission import AdmissionPolicy
     from pydcop_tpu.serving.http import ServeFrontEnd
@@ -304,6 +318,9 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
             breaker_reset_s=breaker_reset_s,
         ),
         result_keep=result_keep,
+        journal_dir=journal_dir,
+        journal_sync=journal_sync,
+        recover=recover,
     ).start()
     try:
         front_end = ServeFrontEnd(service, port=port, host=host).start()
@@ -318,14 +335,40 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           file=sys.stderr)
     if not block:
         return handle
-    try:
-        import threading
+    import signal
+    import threading
 
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        print("pydcop serve: shutting down", file=sys.stderr)
+    stop_event = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — signal signature
+        stop_event.set()
+
+    # SIGTERM is what an orchestrator sends before the SIGKILL
+    # grace deadline; both it and Ctrl-C route through the same
+    # drain-first shutdown.  Original handlers restored on exit so an
+    # embedding process is left the way it was found.  Handlers can
+    # only be installed from the main thread — a background-thread
+    # caller just blocks on the event (signals never reach it).
+    previous = {}
+    if threading.current_thread() is threading.main_thread():
+        previous = {
+            sig: signal.signal(sig, _on_signal)
+            for sig in (signal.SIGTERM, signal.SIGINT)
+        }
+    summary = None
+    try:
+        stop_event.wait()
+        print("pydcop serve: signal received, draining…",
+              file=sys.stderr)
     finally:
-        handle.stop()
+        summary = handle.stop(drain=True)
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
+        print("pydcop serve: shut down — "
+              f"{summary['drained']} request(s) drained, "
+              f"{summary['replayable']} journaled replayable, "
+              f"{summary['failed_pending']} failed pending",
+              file=sys.stderr)
     return None
 
 

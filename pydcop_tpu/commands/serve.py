@@ -53,12 +53,32 @@ def set_parser(subparsers):
     parser.add_argument("--result_keep", type=int, default=4096,
                         help="completed results retained for "
                              "GET /result/<id> (oldest evicted)")
+    parser.add_argument("--journal_dir", "--journal-dir",
+                        default=None, metavar="DIR",
+                        help="durable request journal directory: "
+                             "every 202 is journaled before it is "
+                             "returned, so a crash loses zero "
+                             "acknowledged requests")
+    parser.add_argument("--recover", action="store_true",
+                        help="replay accepted-but-unfinished journal "
+                             "entries through the queue on startup "
+                             "(requires --journal_dir; torn journal "
+                             "tails are truncated past the last "
+                             "valid record)")
+    parser.add_argument("--journal_sync", "--journal-sync",
+                        action="store_true",
+                        help="fsync the journal per record "
+                             "(machine-crash durability; the default "
+                             "flush already survives a process kill)")
     parser.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
     from pydcop_tpu.api import serve
 
+    if args.recover and not args.journal_dir:
+        logger.error("--recover requires --journal_dir")
+        return 2
     serve(
         port=args.port, host=args.host,
         max_queue=args.max_queue, high_water=args.high_water,
@@ -70,6 +90,9 @@ def run_cmd(args) -> int:
             "damping": args.damping,
         },
         result_keep=args.result_keep,
+        journal_dir=args.journal_dir,
+        journal_sync=args.journal_sync,
+        recover=args.recover,
         block=True,
     )
     return 0

@@ -860,6 +860,10 @@ class ShardedMaxSumEngine(MaxSumEngine):
         self.layout = "edge"
         self.mesh = mesh
         self.partition = partition
+        # Kept for shard-loss recovery: re-partitioning onto a
+        # surviving mesh rebuilds the per-shard layout from the
+        # ORIGINAL compiled graph (repartition_after_loss).
+        self._source_graph = graph
         self.graph, part_metrics = build_partitioned_graph(
             graph, partition, mesh)
         self._ops = ShardOps(mesh, len(meta.var_names))
@@ -888,6 +892,78 @@ class ShardedMaxSumEngine(MaxSumEngine):
                     key=str(key),
                 )
         return out
+
+    def repartition_after_loss(self, lost_shard: int,
+                               snapshot_state):
+        """Shard-loss recovery: rebuild this engine on the surviving
+        mesh and remap a validated snapshot onto the new layout.
+
+        Called by the recovery run (resilience/recovery.py) when a
+        ``shard_loss`` guard trips.  The sequence: (1) a fresh 1-D
+        mesh over the surviving devices, (2) a re-partition of the
+        ORIGINAL compiled graph onto it — memoized by structure key +
+        shard count (engine/partition.partition_cache), so a repeated
+        loss pattern re-partitions from cache, (3) the per-shard
+        layout rebuilt, (4) the snapshot's messages remapped onto the
+        new factor→shard packing with the halo buffer recomputed
+        on-device (engine/sharding.remap_partitioned_state), and
+        (5) every cached jit/warm entry dropped — the old programs
+        baked in the dead mesh.  Returns the remapped state to resume
+        from; raises :class:`~pydcop_tpu.resilience.recovery.
+        NoSurvivingDevices` when the mesh would be empty.
+
+        The repartition + remap wall time lands in
+        ``extra_metrics['shard_recovery_s']`` (the bench's
+        per-backend recovery-time series).
+        """
+        from jax.sharding import Mesh
+
+        from pydcop_tpu.engine.partition import partition_compiled
+        from pydcop_tpu.engine.sharding import (
+            SHARD_AXIS,
+            ShardOps,
+            build_partitioned_graph,
+            remap_partitioned_state,
+        )
+        from pydcop_tpu.resilience.recovery import NoSurvivingDevices
+
+        t0 = time.perf_counter()
+        devices = list(self.mesh.devices.flat)
+        if not 0 <= lost_shard < len(devices):
+            raise ValueError(
+                f"lost shard {lost_shard} out of range for a mesh "
+                f"of {len(devices)}")
+        survivors = [d for i, d in enumerate(devices)
+                     if i != lost_shard]
+        if not survivors:
+            raise NoSurvivingDevices(
+                f"shard {lost_shard} was the last device")
+        new_mesh = Mesh(np.array(survivors), (SHARD_AXIS,))
+        new_part = partition_compiled(self._source_graph,
+                                      new_mesh.size)
+        new_graph, part_metrics = build_partitioned_graph(
+            self._source_graph, new_part, new_mesh)
+        new_ops = ShardOps(new_mesh, len(self.meta.var_names))
+        state = remap_partitioned_state(
+            self._source_graph, self.partition, new_part,
+            snapshot_state, new_graph, new_ops)
+        self.mesh = new_mesh
+        self.partition = new_part
+        self.graph = new_graph
+        self._ops = new_ops
+        # Stale compiled programs reference the dead mesh; the next
+        # segment call recompiles against the survivors.
+        self._jitted.clear()
+        self._warm.clear()
+        self.extra_metrics.update(part_metrics)
+        self.extra_metrics["repartitions"] = (
+            self.extra_metrics.get("repartitions", 0) + 1)
+        self.extra_metrics.setdefault(
+            "lost_shards", []).append(int(lost_shard))
+        self.extra_metrics["shard_recovery_s"] = round(
+            time.perf_counter() - t0, 4)
+        self._segment_span_args["shards"] = new_mesh.size
+        return state
 
     def run_decimated(self, *args, **kwargs):
         raise ValueError(

@@ -759,6 +759,37 @@ class ShardOps:
             cycle=jnp.asarray(0, dtype=jnp.int32),
         )
 
+    def recompute_halo(self, graph: ShardedGraph, f2v) -> jnp.ndarray:
+        """The ``[B, D]`` boundary buffer for an EXISTING set of f2v
+        messages: the same per-shard boundary partial sums + psum the
+        superstep tail issues (``_exchange_halo``), run once outside
+        the loop.  Shard-loss recovery uses this to rebuild the halo
+        slot after remapping a snapshot onto a new partition — the
+        double buffer must hold exactly the boundary totals of the
+        snapshot's f2v messages, computed with the NEW layout's
+        reduction order, or the first post-recovery superstep would
+        read garbage."""
+        n_bnd = graph.n_boundary
+        d = graph.dmax
+        if n_bnd == 0:
+            return jax.device_put(
+                jnp.zeros((0, d), graph.var_costs.dtype),
+                NamedSharding(self.mesh, P()))
+        nb = len(graph.buckets)
+
+        def local(g, msgs):
+            _, aux = _unblock_graph(g)
+            return _exchange_halo(
+                tuple(m[0] for m in msgs), aux, n_bnd)
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(self._graph_specs(graph),
+                      (P(SHARD_AXIS),) * nb),
+            out_specs=P(),
+            check_rep=False,
+        )(graph, tuple(f2v))
+
     def assignment_constraint_cost(self, graph: ShardedGraph,
                                    values: jnp.ndarray) -> jnp.ndarray:
         """Global constraint cost of a GLOBAL [V] assignment on the
@@ -793,3 +824,96 @@ class ShardOps:
         ext = jnp.zeros((self.n_vars + 1,), jnp.int32)
         return ext.at[owned_global.reshape(-1)].set(
             values_sh.reshape(-1))[: self.n_vars]
+
+
+# ----------------------- shard-loss state remap ---------------------- #
+
+
+def _factor_row_maps(source_graph: CompiledFactorGraph, part):
+    """Per bucket: the positions (in real-factor row order) owned by
+    each shard — the inverse of build_partitioned_graph's per-shard
+    row packing (``rows[fs == s]`` in order)."""
+    n_vars = source_graph.n_vars
+    maps = []
+    for b, fs in zip(source_graph.buckets, part.factor_shard):
+        ids = np.asarray(b.var_ids)
+        rows = real_factor_rows(ids, n_vars)
+        maps.append((rows,
+                     [np.nonzero(fs == s)[0]
+                      for s in range(part.n_shards)]))
+    return maps
+
+
+def remap_partitioned_state(source_graph: CompiledFactorGraph,
+                            old_part, new_part,
+                            state: ShardedMaxSumState,
+                            new_graph: ShardedGraph,
+                            new_ops: "ShardOps"
+                            ) -> ShardedMaxSumState:
+    """Map a checkpointed/validated :class:`ShardedMaxSumState` from
+    one partition's blocked layout onto another's — the shard-loss
+    recovery step ("remap the global state onto the new layout").
+
+    Messages and SAME_COUNT counters live per (factor, scope slot):
+    the remap gathers each bucket's per-shard blocks back to global
+    real-factor row order (host numpy — the recovery path runs once
+    per device loss, not per superstep) and re-packs them under the
+    new factor→shard assignment; padding rows in the new layout start
+    zeroed, exactly like a fresh ``init_state`` (they scatter only
+    into the sentinel slot, which nothing reads).  The halo double
+    buffer is NOT remapped — the new partition has a different
+    boundary set — but recomputed on device from the remapped f2v
+    messages (:meth:`ShardOps.recompute_halo`), so the first
+    post-recovery superstep consumes exactly what the tail exchange
+    of the snapshot cycle would have produced under the new layout.
+    ``stable``/``cycle`` carry over (replicated scalars are
+    layout-free)."""
+    state_host = jax.device_get(state)
+    old_maps = _factor_row_maps(source_graph, old_part)
+    new_maps = _factor_row_maps(source_graph, new_part)
+    new_S = new_part.n_shards
+
+    def regather(blocked, bucket_i):
+        """[S_old, Fmax_old, ...] blocked → [F_real, ...] global."""
+        blocked = np.asarray(blocked)
+        rows, per_shard = old_maps[bucket_i]
+        out = np.zeros((rows.shape[0],) + blocked.shape[2:],
+                       blocked.dtype)
+        for s, sel in enumerate(per_shard):
+            out[sel] = blocked[s, :sel.shape[0]]
+        return out
+
+    def reblock(global_arr, bucket_i, f_max):
+        """[F_real, ...] global → [S_new, f_max, ...] blocked."""
+        _, per_shard = new_maps[bucket_i]
+        out = np.zeros((new_S, f_max) + global_arr.shape[1:],
+                       global_arr.dtype)
+        for s, sel in enumerate(per_shard):
+            out[s, :sel.shape[0]] = global_arr[sel]
+        return out
+
+    def remap_field(msgs):
+        remapped = []
+        for i, blocked in enumerate(msgs):
+            f_max = new_graph.buckets[i].var_ids.shape[1]
+            remapped.append(
+                reblock(regather(blocked, i), i, f_max))
+        return tuple(remapped)
+
+    shard = NamedSharding(new_ops.mesh, P(SHARD_AXIS))
+    rep = NamedSharding(new_ops.mesh, P())
+    put = lambda t: tuple(  # noqa: E731
+        jax.device_put(m, shard) for m in t)
+    placed = ShardedMaxSumState(
+        v2f=put(remap_field(state_host.v2f)),
+        f2v=put(remap_field(state_host.f2v)),
+        v2f_count=put(remap_field(state_host.v2f_count)),
+        f2v_count=put(remap_field(state_host.f2v_count)),
+        halo=jax.device_put(
+            np.zeros((new_graph.n_boundary, new_graph.dmax),
+                     np.asarray(state_host.halo).dtype), rep),
+        stable=jax.device_put(np.asarray(state_host.stable), rep),
+        cycle=jax.device_put(np.asarray(state_host.cycle), rep),
+    )
+    halo = new_ops.recompute_halo(new_graph, placed.f2v)
+    return placed._replace(halo=halo)

@@ -49,15 +49,28 @@ logger = logging.getLogger("pydcop.resilience.recovery")
 
 
 class GuardViolation(NamedTuple):
-    """One tripped segment guard."""
+    """One tripped segment guard.  ``shard`` is set only for
+    ``shard_loss`` trips (the lost device's mesh position)."""
 
-    kind: str      # "nonfinite" | "divergence" | "injected"
+    kind: str      # "nonfinite" | "divergence" | "injected" |
+    #                "shard_loss"
     cycle: int     # end cycle of the segment that tripped
     detail: str
+    shard: Optional[int] = None
 
     def as_dict(self) -> Dict[str, Any]:
-        return {"kind": self.kind, "cycle": int(self.cycle),
-                "detail": self.detail}
+        out = {"kind": self.kind, "cycle": int(self.cycle),
+               "detail": self.detail}
+        if self.shard is not None:
+            out["shard"] = int(self.shard)
+        return out
+
+
+class NoSurvivingDevices(RuntimeError):
+    """A shard loss left the mesh empty: there is nothing left to
+    re-partition onto.  Raised by the engine's shard-loss hook and
+    converted to :class:`RecoveryExhausted` (with the partial
+    trajectory) by the recovery run."""
 
 
 class RecoveryExhausted(RuntimeError):
@@ -95,6 +108,19 @@ class RecoveryPolicy:
     first segment ending at-or-past each listed cycle trips once with
     kind ``"injected"``.
 
+    ``trip_shard`` injects DEVICE LOSSES on a partitioned sharded
+    engine (``(cycle, shard)`` pairs — the first segment ending
+    at-or-past ``cycle`` loses mesh position ``shard``).  A shard
+    loss does not walk the escalation ladder and does not consume the
+    restart budget: the engine rolls back to the last validated
+    snapshot, RE-PARTITIONS the factor graph onto the surviving mesh
+    (``ShardedMaxSumEngine.repartition_after_loss`` — the partitioner
+    memoizes by structure key + shard count, so a repeated loss
+    pattern repartitions from cache), remaps the snapshot onto the
+    new layout and resumes; only when NO devices remain does the run
+    abort with :class:`RecoveryExhausted` carrying the partial
+    trajectory.
+
     ``verify_restore`` (default True) asserts every rollback restored
     the snapshot bit-identically before intervening — a host fetch of
     the state, paid only on the (rare) rollback path.
@@ -109,6 +135,8 @@ class RecoveryPolicy:
     divergence_factor: float = 3.0
     divergence_slack: float = 0.0
     trip_cycles: Tuple[int, ...] = field(default_factory=tuple)
+    trip_shard: Tuple[Tuple[int, int], ...] = field(
+        default_factory=tuple)
     verify_restore: bool = True
 
     def __post_init__(self):
@@ -118,6 +146,11 @@ class RecoveryPolicy:
         if self.noise_scale < 0:
             raise ValueError(
                 f"noise_scale must be >= 0: {self.noise_scale}")
+        for entry in self.trip_shard:
+            if len(tuple(entry)) != 2:
+                raise ValueError(
+                    "trip_shard entries are (cycle, shard) pairs: "
+                    f"{entry!r}")
 
     def action_for(self, attempt: int) -> str:
         """The escalation ladder: attempt 1 reseeds tie-break noise,
@@ -200,6 +233,12 @@ class RecoveryRun:
         # consecutive trips at cycle c — how tests force a run through
         # the whole escalation ladder into RecoveryExhausted.
         self._pending_injections = sorted(policy.trip_cycles)
+        # (cycle, shard) device-loss injections, sorted by cycle —
+        # ((10, 1), (20, 0)) loses shard 1 at ~cycle 10 and then
+        # shard 0 of the ALREADY-SHRUNK mesh at ~cycle 20.
+        self._pending_shard_trips = sorted(
+            tuple(t) for t in policy.trip_shard)
+        self.shard_losses = 0
         self._snap_state = None
         self._snap_values = None
         self._m_trips = metrics_registry.counter(
@@ -246,6 +285,13 @@ class RecoveryRun:
     def check(self, end_cycle: int, finite: bool,
               cost: float) -> Optional[GuardViolation]:
         """Score one segment's guard outputs; None means valid."""
+        if self._pending_shard_trips \
+                and end_cycle >= self._pending_shard_trips[0][0]:
+            at, shard = self._pending_shard_trips.pop(0)
+            return GuardViolation(
+                "shard_loss", end_cycle,
+                f"injected loss of shard {shard} armed at cycle "
+                f"{at}", shard=int(shard))
         if self._pending_injections \
                 and end_cycle >= self._pending_injections[0]:
             at = self._pending_injections.pop(0)
@@ -274,13 +320,32 @@ class RecoveryRun:
 
     # -- rollback + escalation ----------------------------------------- #
 
+    def _partial(self) -> Dict[str, Any]:
+        """The best-known state for a RecoveryExhausted carrier."""
+        import jax
+
+        partial: Dict[str, Any] = {
+            "assignment": None,
+            "cycle": self.snapshot_cycle,
+            "converged": False,
+        }
+        if self._snap_values is not None:
+            partial["assignment"] = (
+                self.engine.meta.assignment_from_indices(
+                    np.asarray(jax.device_get(self._snap_values)))
+            )
+        return partial
+
     def rollback(self, violation: GuardViolation):
         """Restore the last valid snapshot and intervene; returns the
         (state, values) to continue from.  Raises RecoveryExhausted
-        past the restart budget."""
+        past the restart budget.  ``shard_loss`` violations take the
+        repartition path instead of the escalation ladder."""
         import jax
         import jax.numpy as jnp
 
+        if violation.kind == "shard_loss":
+            return self._rollback_shard_loss(violation)
         self.trips.append(violation)
         self._m_trips.inc(kind=violation.kind)
         if tracer.enabled:
@@ -290,16 +355,7 @@ class RecoveryRun:
                            detail=violation.detail)
         self.attempts += 1
         if self.attempts > self.policy.max_restarts:
-            partial: Dict[str, Any] = {
-                "assignment": None,
-                "cycle": self.snapshot_cycle,
-                "converged": False,
-            }
-            if self._snap_values is not None:
-                partial["assignment"] = (
-                    self.engine.meta.assignment_from_indices(
-                        np.asarray(jax.device_get(self._snap_values)))
-                )
+            partial = self._partial()
             raise RecoveryExhausted(
                 f"recovery budget exhausted after "
                 f"{self.policy.max_restarts} restarts; last trip: "
@@ -347,10 +403,68 @@ class RecoveryRun:
         self._window.clear()
         return restored, self._snap_values
 
+    def _rollback_shard_loss(self, violation: GuardViolation):
+        """Shard-loss recovery: roll back to the last validated
+        snapshot AND re-partition onto the surviving mesh.
+
+        Distinct from the escalation ladder on purpose — losing a
+        device says nothing about the numerics, so no noise/damping
+        intervention is applied and the restart budget is not
+        consumed (a solve can survive as many device losses as it has
+        devices).  The engine hook does the heavy lifting: new mesh
+        from the survivors, memoized re-partition, snapshot remapped
+        onto the new layout.  :class:`NoSurvivingDevices` becomes
+        :class:`RecoveryExhausted` carrying the partial trajectory.
+        """
+        self.trips.append(violation)
+        self._m_trips.inc(kind="shard_loss")
+        if tracer.enabled:
+            tracer.instant("guard_trip", "resilience",
+                           kind="shard_loss",
+                           cycle=int(violation.cycle),
+                           shard=violation.shard,
+                           detail=violation.detail)
+        hook = getattr(self.engine, "repartition_after_loss", None)
+        if hook is None:
+            raise ValueError(
+                "trip_shard requires a partitioned sharded engine "
+                "(solve with shards=N); this engine has no "
+                "repartition_after_loss hook")
+        self.shard_losses += 1
+        self.actions.append("repartition")
+        self._m_attempts.inc(action="repartition")
+        logger.warning(
+            "Shard loss (shard %s at cycle %d): rollback to cycle "
+            "%s and re-partition onto the surviving mesh",
+            violation.shard, violation.cycle, self.snapshot_cycle,
+        )
+        with tracer.span("recovery_rollback", "resilience",
+                         attempt=self.attempts, action="repartition",
+                         kind="shard_loss",
+                         to_cycle=self.snapshot_cycle,
+                         lost_shard=violation.shard):
+            try:
+                state = hook(violation.shard, self._snap_state)
+            except NoSurvivingDevices as exc:
+                raise RecoveryExhausted(
+                    f"no surviving devices after loss of shard "
+                    f"{violation.shard} at cycle {violation.cycle}",
+                    violations=self.trips, attempts=self.attempts,
+                    partial=self._partial(),
+                ) from exc
+        # The old snapshot's layout died with the lost shard: the
+        # remapped state IS the new rollback target (retain copies it
+        # when the engine donates, so the continuing loop cannot
+        # invalidate it).
+        self.retain(state, self._snap_values)
+        self._window.clear()
+        return state, self._snap_values
+
     def metrics(self) -> Dict[str, Any]:
         return {
             "guard_trips": len(self.trips),
             "recovery_attempts": self.attempts,
             "recovery_actions": list(self.actions),
+            "shard_losses": self.shard_losses,
             "guard_violations": [v.as_dict() for v in self.trips],
         }

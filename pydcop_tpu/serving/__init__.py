@@ -17,6 +17,10 @@ throughput service (docs/serving.md):
   never share a dispatch; same-structure requests coalesce);
 - :mod:`.admission` — backpressure (queue high-water → 429) and the
   dispatch circuit breaker (repeated engine failure → 503);
+- :mod:`.journal` — the durable request journal: length-prefixed,
+  crc-checksummed on-disk records appended before every 202, torn
+  tails truncated and unfinished requests replayed on a
+  ``--recover`` start (kill -9 loses zero acknowledged requests);
 - :mod:`.http` — stdlib HTTP front end (``POST /solve``,
   ``GET /result/<id>``, ``GET /stats``) mounting the PR-5 telemetry
   routes (``/metrics``, ``/healthz``, ``/events``) alongside.
@@ -31,6 +35,9 @@ from pydcop_tpu.serving.admission import (  # noqa: F401
     AdmissionRejected,
     QueueFull,
     ServiceUnavailable,
+)
+from pydcop_tpu.serving.journal import (  # noqa: F401
+    RequestJournal,
 )
 from pydcop_tpu.serving.service import (  # noqa: F401
     SolveRequest,

@@ -6,13 +6,16 @@ request plane, so one port serves the solve API *and* its own
 telemetry:
 
 - ``POST /solve`` — body ``{"dcop": "<dcop yaml>", "params": {...},
-  "wait": bool, "timeout": s}``.  Returns 202 + a request id (poll
-  ``/result/<id>``), or the finished result directly with
-  ``"wait": true`` (200; 202 + id if the wait timed out).  Errors:
-  400 malformed body/problem/params, 429 queue past high-water
-  (back off and retry), 503 dispatch breaker open.
+  "wait": bool, "timeout": s, "deadline_s": s}``.  Returns 202 + a
+  request id (poll ``/result/<id>``), or the finished result directly
+  with ``"wait": true`` (200; 202 + id if the wait timed out).
+  ``deadline_s`` is a freshness budget: work still queued past it is
+  dropped by the scheduler (504, ``rejected_deadline``).  Errors:
+  400 malformed body/problem/params (a malformed ``timeout`` or
+  ``deadline_s`` is a 400, never silently coerced), 429 queue past
+  high-water (back off and retry), 503 dispatch breaker open.
 - ``GET /result/<id>`` — 200 + result when done, 202 while pending,
-  404 unknown id.
+  504 + result when the deadline expired it, 404 unknown id.
 - ``GET /stats`` — the service's dispatch/queue/breaker ledger.
 - ``GET /metrics`` / ``/healthz`` / ``/events`` — mounted unchanged
   from the telemetry server; ``/healthz`` additionally reflects the
@@ -23,6 +26,7 @@ curl examples live in docs/serving.md.
 
 import json
 import logging
+import math
 from typing import Any, Dict
 
 from pydcop_tpu.observability.server import (
@@ -39,6 +43,28 @@ logger = logging.getLogger("pydcop.serving.http")
 # Request bodies are small YAML problems; refuse anything huge before
 # reading it (a misbehaving client must not balloon the process).
 MAX_BODY_BYTES = 8 << 20
+
+
+def _positive_float(value: Any, name: str) -> float:
+    """Strict wire-field validation: a finite number > 0, or
+    ValueError.  Non-finite values are rejected — ``timeout: inf``
+    would pin one of the server's handler threads forever."""
+    try:
+        out = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{name} must be a number of seconds, got {value!r}")
+    if not math.isfinite(out) or not out > 0:
+        raise ValueError(
+            f"{name} must be a finite number > 0, got {out}")
+    return out
+
+
+def _result_code(result: Dict[str, Any]) -> int:
+    """HTTP status for a terminal result body: 504 for a
+    deadline-expired request, 200 otherwise (an ERROR result is a
+    well-formed 200 reply whose body says the solve failed)."""
+    return 504 if result.get("status") == "EXPIRED" else 200
 
 
 class _ServeHandler(_Handler):
@@ -67,7 +93,7 @@ class _ServeHandler(_Handler):
             except KeyError:
                 self._json(404, {"error": f"unknown request {rid!r}"})
                 return
-            self._json(200, result)
+            self._json(_result_code(result), result)
         elif path == "/stats":
             self._json(200, service.stats())
         else:
@@ -105,11 +131,28 @@ class _ServeHandler(_Handler):
             self._json(400, {"error": f"bad request body: {exc}"})
             return
         service = self.telemetry.service
+        # Wire-level fields validate BEFORE submit: a malformed
+        # ``timeout`` used to be silently coerced to 30.0 by a bare
+        # except — a typo'd client ran with a default it never chose.
+        # Now it is a 400 (``rejected_bad_request`` in the ledger),
+        # and because nothing was submitted yet there is no orphaned
+        # accepted request behind the rejection.
+        try:
+            timeout = _positive_float(
+                body.get("timeout", 30.0), "timeout")
+            deadline_s = body.get("deadline_s")
+            if deadline_s is not None:
+                deadline_s = _positive_float(deadline_s, "deadline_s")
+        except ValueError as exc:
+            service.record_bad_request()
+            self._json(400, {"error": f"bad request body: {exc}"})
+            return
         try:
             from pydcop_tpu.dcop.yamldcop import load_dcop
 
             dcop = load_dcop(yaml_src)
-            rid = service.submit(dcop, params=body.get("params"))
+            rid = service.submit(dcop, params=body.get("params"),
+                                 deadline_s=deadline_s)
         except AdmissionRejected as exc:
             self._json(exc.http_status, {
                 "error": str(exc),
@@ -117,17 +160,19 @@ class _ServeHandler(_Handler):
                 "retry": exc.http_status == 429,
             })
             return
+        except RuntimeError as exc:
+            # Server-side submit failure (journal append I/O): the
+            # request was valid and the fault is ours — a 400 would
+            # tell a well-behaved client to stop retrying.
+            self._json(500, {"error": f"internal error: {exc}"})
+            return
         except Exception as exc:  # noqa: BLE001 — malformed problem
             self._json(400, {"error": f"bad problem: {exc}"})
             return
         if body.get("wait"):
-            try:
-                timeout = float(body.get("timeout", 30.0))
-            except (TypeError, ValueError):
-                timeout = 30.0
             result = service.result(rid, wait=timeout)
             if result is not None:
-                self._json(200, result)
+                self._json(_result_code(result), result)
                 return
             # Fell through the wait window: hand back the id.
         self._json(202, {"id": rid, "status": "queued",

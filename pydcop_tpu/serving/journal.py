@@ -1,0 +1,208 @@
+"""Durable request journal: crash recovery for the solve service.
+
+A ``pydcop serve`` process crash loses every accepted request — the
+client got its 202, the queue was in memory, the memory is gone.
+This module makes the 202 a *durable* promise: every admitted request
+is appended to an on-disk journal BEFORE the ack is returned, every
+terminal outcome (finished / error / expired) is appended when it
+happens, and a restart with ``--recover`` replays exactly the
+accepted-but-unfinished entries through the normal queue.
+
+On-disk format (one file, ``requests.jnl``, append-only):
+
+- each record is ``[u32 length][u32 crc32][payload]`` (big-endian
+  header, JSON payload) — the same verify-on-read discipline as the
+  PR-4 checkpoint checksums: the write path is trusted for nothing;
+- a torn tail (the process died mid-append, or the disk lied) is
+  detected by the length/crc check and TRUNCATED past the last valid
+  record on recovery — every record before it is intact by
+  construction, so a crash can only ever cost the unacknowledged
+  suffix;
+- recovery then COMPACTS the journal: the surviving file holds only
+  the still-pending accepted records, so journals don't grow without
+  bound across restarts and a second crash replays the same pending
+  set again.
+
+Durability model: ``append`` flushes to the OS on every record, so a
+process kill (SIGKILL, OOM, crash) loses nothing acknowledged;
+``sync=True`` adds an fsync per record for machine-crash durability
+at a per-request latency cost.
+
+The service side lives in serving/service.py (``journal_dir=`` /
+``recover=``); the wire side in serving/http.py; ``pydcop serve
+--journal_dir D --recover`` is the operational entry point
+(docs/serving.md, docs/resilience.md "Serving & sharding fault
+tolerance").
+"""
+
+import binascii
+import json
+import logging
+import os
+import struct
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("pydcop.serving.journal")
+
+# Record header: payload byte length + crc32 of the payload.
+_HEADER = struct.Struct(">II")
+# Refuse absurd lengths on read: a corrupt header must not make the
+# scanner allocate gigabytes before the crc check can call it torn.
+MAX_RECORD_BYTES = 64 << 20
+JOURNAL_FILE = "requests.jnl"
+
+# Record kinds.
+ACCEPTED = "accepted"
+COMPLETED = "completed"
+
+
+def encode_record(record: Dict[str, Any]) -> bytes:
+    payload = json.dumps(
+        record, separators=(",", ":"), default=str).encode()
+    return _HEADER.pack(
+        len(payload), binascii.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def scan_journal(path: str) -> Tuple[List[Dict[str, Any]], int, bool]:
+    """Read every valid record off a journal file.
+
+    Returns ``(records, valid_bytes, torn)``: ``valid_bytes`` is the
+    offset just past the last record that verified (length plausible,
+    payload complete, crc matching, JSON decoding) — the truncation
+    point for a torn tail; ``torn`` says whether anything past it was
+    found.  A missing file is an empty journal, never an error."""
+    records: List[Dict[str, Any]] = []
+    try:
+        data = open(path, "rb").read()
+    except FileNotFoundError:
+        return records, 0, False
+    offset = 0
+    while offset + _HEADER.size <= len(data):
+        length, crc = _HEADER.unpack_from(data, offset)
+        start = offset + _HEADER.size
+        end = start + length
+        if length > MAX_RECORD_BYTES or end > len(data):
+            break
+        payload = data[start:end]
+        if binascii.crc32(payload) & 0xFFFFFFFF != crc:
+            break
+        try:
+            records.append(json.loads(payload))
+        except ValueError:
+            break
+        offset = end
+    return records, offset, offset < len(data)
+
+
+def pending_requests(records: List[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+    """Accepted records with no terminal record — the replay set, in
+    acceptance order.  A completion for an id the journal never
+    accepted is ignored (it can only be debris from a pre-compaction
+    file)."""
+    accepted: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        kind = rec.get("kind")
+        rid = rec.get("id")
+        if kind == ACCEPTED and rid is not None:
+            accepted[rid] = rec
+        elif kind == COMPLETED and rid in accepted:
+            del accepted[rid]
+    return list(accepted.values())
+
+
+class RequestJournal:
+    """Append-side handle on one journal directory.
+
+    Thread-safe (submitting threads and the scheduler thread both
+    append).  ``append`` returns only after the record reached the OS
+    (``flush``; plus ``fsync`` with ``sync=True``) — the caller may
+    then acknowledge the request."""
+
+    def __init__(self, journal_dir: str, sync: bool = False):
+        os.makedirs(journal_dir, exist_ok=True)
+        self.journal_dir = journal_dir
+        self.path = os.path.join(journal_dir, JOURNAL_FILE)
+        self.sync = sync
+        self._lock = threading.Lock()
+        self._f = open(self.path, "ab")
+        self.appended = 0
+
+    def append(self, record: Dict[str, Any]) -> None:
+        blob = encode_record(record)
+        with self._lock:
+            if self._f.closed:
+                raise RuntimeError("journal is closed")
+            self._f.write(blob)
+            self._f.flush()
+            if self.sync:
+                os.fsync(self._f.fileno())
+            self.appended += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+    @classmethod
+    def recover(cls, journal_dir: str, sync: bool = False
+                ) -> Tuple["RequestJournal", List[Dict[str, Any]]]:
+        """Open a journal directory for crash recovery.
+
+        Scans the journal, truncates a torn tail past the last valid
+        record, computes the pending (accepted-without-terminal) set,
+        and atomically compacts the file down to exactly those
+        records before reopening it for appends.  Returns the open
+        journal and the pending records, in acceptance order."""
+        path = os.path.join(journal_dir, JOURNAL_FILE)
+        records, valid_bytes, torn = scan_journal(path)
+        if torn:
+            logger.warning(
+                "journal %s has a torn tail: truncating to the last "
+                "valid record at byte %d", path, valid_bytes)
+        pending = pending_requests(records)
+        if os.path.exists(path):
+            # Compact: pending records only, written to a temp file
+            # and renamed over the old journal — a crash mid-compact
+            # leaves the (longer but equivalent) original.
+            fd, tmp = tempfile.mkstemp(
+                dir=journal_dir, prefix=".jnl_tmp_")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    for rec in pending:
+                        f.write(encode_record(rec))
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        journal = cls(journal_dir, sync=sync)
+        if records or torn:
+            logger.info(
+                "journal recovery: %d record(s) scanned, %d pending "
+                "request(s) to replay%s", len(records), len(pending),
+                " (torn tail truncated)" if torn else "")
+        return journal, pending
+
+
+def accepted_record(rid: str, dcop_yaml: str,
+                    params: Dict[str, Any],
+                    deadline_s: Optional[float] = None,
+                    t_submit: Optional[float] = None
+                    ) -> Dict[str, Any]:
+    rec = {"kind": ACCEPTED, "id": rid, "dcop": dcop_yaml,
+           "params": params}
+    if deadline_s is not None:
+        rec["deadline_s"] = deadline_s
+    if t_submit is not None:
+        rec["t"] = t_submit
+    return rec
+
+
+def completed_record(rid: str, status: str) -> Dict[str, Any]:
+    return {"kind": COMPLETED, "id": rid, "status": status}

@@ -71,11 +71,28 @@ class BinScheduler:
                 continue
             if first is _STOP:
                 continue
+            # Deadline enforcement happens HERE, before binning: work
+            # that expired while queued is dropped (terminal EXPIRED,
+            # 504) instead of burning a device dispatch — and never
+            # contaminates a batch whose other members are still
+            # fresh.
+            if self._expire(first):
+                continue
             bins: Dict = {}
             bins.setdefault(first.bin, []).append(first)
             self._collect(q, bins)
             self._dispatch_bins(bins)
         # Shutdown: the service fails anything still queued.
+
+    def _expire(self, req) -> bool:
+        """Drop overdue work before binning; guarded so a broken
+        deadline check can never kill the scheduler thread."""
+        try:
+            return self.service.expire_if_overdue(req)
+        except Exception:  # noqa: BLE001 — last line of defense
+            logger.exception("deadline check crashed; dispatching "
+                             "the request anyway")
+            return False
 
     def _collect(self, q, bins: Dict) -> None:
         """Linger up to the batch window, draining arrivals into
@@ -95,6 +112,8 @@ class BinScheduler:
                 return
             if req is _STOP:
                 return
+            if self._expire(req):
+                continue
             bins.setdefault(req.bin, []).append(req)
 
     def _dispatch_bins(self, bins: Dict) -> None:

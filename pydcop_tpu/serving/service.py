@@ -26,6 +26,29 @@ the serving front end's ``/metrics`` exposes them):
   ``pydcop_serve_batched_requests_total`` — the batch-coalescing
   evidence (N same-structure requests in << N dispatches);
 - per-batch ``serve_dispatch`` trace spans when tracing is on.
+
+Fault tolerance (docs/resilience.md "Serving & sharding fault
+tolerance"):
+
+- **Durable journal + crash recovery** (``journal_dir=``): every
+  admitted request is journaled BEFORE ``submit`` returns (the 202 is
+  a durable promise), terminal outcomes are journaled too, and
+  ``recover=True`` replays accepted-but-unfinished entries through
+  the normal queue on start (``serve_replay`` span,
+  ``pydcop_serve_replayed_total``) — a kill -9 mid-burst loses zero
+  acknowledged requests (tools/serve_smoke.py asserts it).
+- **Deadlines** (``submit(..., deadline_s=...)``): the scheduler
+  drops already-expired work before binning — terminal state
+  ``EXPIRED``, ledger status ``rejected_deadline``, 504 on the wire.
+- **Poison isolation**: a failed multi-request bin dispatch BISECTS
+  instead of failing wholesale — halves are retried
+  (``pydcop_serve_dispatch_retries_total``) until the poison request
+  fails alone and its bin-mates succeed; only the isolated singleton
+  failure feeds the admission breaker.
+- **Graceful drain**: ``stop(drain=True)`` returns a summary dict;
+  with a journal, requests still queued at shutdown stay journaled
+  as REPLAYABLE instead of being failed (``pydcop serve`` wires this
+  to SIGTERM/SIGINT).
 """
 
 import contextlib
@@ -43,7 +66,7 @@ from pydcop_tpu.engine import batch as engine_batch
 from pydcop_tpu.engine.compile import compile_dcop
 from pydcop_tpu.observability.metrics import registry as metrics_registry
 from pydcop_tpu.observability.trace import tracer
-from pydcop_tpu.serving import binning
+from pydcop_tpu.serving import binning, journal as journal_mod
 from pydcop_tpu.serving.admission import (
     AdmissionController,
     AdmissionPolicy,
@@ -52,16 +75,26 @@ from pydcop_tpu.serving.admission import (
 
 logger = logging.getLogger("pydcop.serving.service")
 
-# Request terminal states.
+# Request states (FINISHED / ERROR / EXPIRED are terminal;
+# REPLAYABLE is terminal for THIS process only — the journal still
+# holds the accepted record, so a --recover restart replays it).
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 ERROR = "ERROR"
+EXPIRED = "EXPIRED"
+REPLAYABLE = "REPLAYABLE"
 
 
 @dataclass
 class SolveRequest:
-    """One in-flight problem: compiled form + bookkeeping."""
+    """One in-flight problem: compiled form + bookkeeping.
+
+    ``deadline_s`` is a freshness budget relative to ``t_submit``:
+    the scheduler refuses to dispatch the request past it (terminal
+    state ``EXPIRED``).  ``replayed`` marks requests resurrected from
+    the journal by crash recovery (their clock restarts at replay —
+    the original submit clock died with the crashed process)."""
 
     id: str
     dcop: DCOP
@@ -70,6 +103,8 @@ class SolveRequest:
     params: Dict[str, Any]
     bin: Any
     t_submit: float
+    deadline_s: Optional[float] = None
+    replayed: bool = False
     status: str = QUEUED
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[Dict[str, Any]] = None
@@ -88,6 +123,13 @@ class SolveService:
     backpressure/breaker policy, ``result_keep`` bounds completed-
     result retention (oldest evicted first — a long-lived service must
     not leak every response it ever produced).
+
+    ``journal_dir`` enables the durable request journal
+    (serving/journal.py): acks become crash-durable, and
+    ``recover=True`` replays accepted-but-unfinished requests through
+    the normal queue on :meth:`start`.  ``journal_sync`` adds an
+    fsync per record (machine-crash durability) at a per-request
+    latency cost; the default flush already survives a process kill.
     """
 
     def __init__(self, max_queue: int = 256,
@@ -96,7 +138,10 @@ class SolveService:
                  bin_sizes: Optional[List[int]] = None,
                  default_params: Optional[Dict[str, Any]] = None,
                  admission: Optional[AdmissionPolicy] = None,
-                 result_keep: int = 4096):
+                 result_keep: int = 4096,
+                 journal_dir: Optional[str] = None,
+                 journal_sync: bool = False,
+                 recover: bool = False):
         if admission is None:
             admission = AdmissionPolicy(high_water=max_queue)
         self.admission = AdmissionController(admission)
@@ -106,6 +151,10 @@ class SolveService:
             bin_sizes or engine_batch.DEFAULT_BIN_SIZES)
         self.default_params = binning.normalize_params(default_params)
         self.result_keep = result_keep
+        self.journal_dir = journal_dir
+        self.journal_sync = journal_sync
+        self.recover_on_start = recover
+        self._journal = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._requests: "OrderedDict[str, SolveRequest]" = OrderedDict()
         self._lock = threading.Lock()
@@ -117,6 +166,10 @@ class SolveService:
         self.batched_dispatches = 0
         self.completed = 0
         self.failed = 0
+        self.expired = 0
+        self.replayed = 0
+        self.dispatch_retries = 0
+        self.last_stop: Optional[Dict[str, Any]] = None
         reg = metrics_registry
         self._req_total = reg.counter(
             "pydcop_requests_total",
@@ -139,6 +192,16 @@ class SolveService:
         self._pad_waste = reg.counter(
             "pydcop_serve_padded_lanes_total",
             "Padded (wasted) batch lanes dispatched to the device")
+        self._retries = reg.counter(
+            "pydcop_serve_dispatch_retries_total",
+            "Bisection retry dispatches after a failed bin dispatch")
+        self._replayed_total = reg.counter(
+            "pydcop_serve_replayed_total",
+            "Journaled requests replayed through the queue on "
+            "crash recovery")
+        self._journal_records = reg.counter(
+            "pydcop_serve_journal_records_total",
+            "Request-journal records appended, by kind")
 
     # -- lifecycle ----------------------------------------------------- #
 
@@ -153,21 +216,45 @@ class SolveService:
         # bench) is left the way it was found.
         self._was_active = metrics_registry.active
         metrics_registry.active = True
+        pending = []
+        if self.journal_dir and self._journal is None:
+            if self.recover_on_start:
+                self._journal, pending = journal_mod.\
+                    RequestJournal.recover(self.journal_dir,
+                                           sync=self.journal_sync)
+            else:
+                self._journal = journal_mod.RequestJournal(
+                    self.journal_dir, sync=self.journal_sync)
         self._scheduler = BinScheduler(
             self, batch_window_s=self.batch_window_s,
             max_batch=self.max_batch)
         self._scheduler.start()
         self._started = True
+        if pending:
+            self._replay(pending)
         return self
 
     def stop(self, drain: bool = True,
-             timeout: float = 30.0) -> None:
+             timeout: float = 30.0) -> Dict[str, Any]:
         """Stop the scheduler.  ``drain=True`` (default) lets queued
         requests finish first — a service shutdown must not silently
-        drop accepted work; ``drain=False`` fails queued requests with
-        a shutdown error instead."""
+        drop accepted work; ``drain=False`` skips the wait.  Requests
+        still queued after the drain window are journaled-REPLAYABLE
+        when a journal is active (a ``--recover`` restart picks them
+        up; in-process ``result(wait=...)`` waiters are woken with a
+        ``REPLAYABLE`` result instead of sleeping out their window),
+        and failed with a shutdown error otherwise.
+
+        Returns a drain summary: ``drained`` (requests completed
+        between the stop call and the scheduler halt), ``replayable``
+        (left in the journal for the next ``--recover`` start) and
+        ``failed_pending`` (dropped with an error — journal-less
+        services only)."""
         if not self._started:
-            return
+            return dict(self.last_stop or
+                        {"drained": 0, "replayable": 0,
+                         "failed_pending": 0})
+        completed_before = self.completed
         if drain:
             deadline = time.monotonic() + timeout
             while (not self._queue.empty()
@@ -177,17 +264,59 @@ class SolveService:
         self._scheduler = None
         self._started = False
         metrics_registry.active = self._was_active
-        # Fail anything still queued (drain=False or drain timeout).
-        # The queue may also hold the scheduler's unconsumed shutdown
+        # Anything still queued (drain=False, drain timeout, or a
+        # submit that raced the shutdown): journaled services leave it
+        # REPLAYABLE — the accepted record survives, a --recover
+        # restart replays it — journal-less services fail it.  The
+        # queue may also hold the scheduler's unconsumed shutdown
         # sentinel — skip anything that isn't a request.
+        failed_pending = 0
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if isinstance(req, SolveRequest):
+            if not isinstance(req, SolveRequest):
+                continue
+            if self._journal is not None:
+                logger.info("request %s left journaled-replayable "
+                            "at shutdown", req.id)
+            else:
+                failed_pending += 1
                 self._finish_error(req,
                                    "service stopped before dispatch")
+        replayable = 0
+        if self._journal is not None:
+            # Every accepted-but-not-terminal request — whether still
+            # queued or caught mid-collection in the scheduler — has
+            # its accepted record on disk and no completion: the next
+            # --recover start replays exactly this set.
+            with self._lock:
+                replayable_reqs = [
+                    r for r in self._requests.values()
+                    if not r.done.is_set()]
+            replayable = len(replayable_reqs)
+            self._journal.close()
+            self._journal = None
+            # Wake in-process waiters: a result(wait=...) caller must
+            # not sleep its full window for an answer this process can
+            # no longer produce.  The journal keeps only the accepted
+            # record — REPLAYABLE is terminal for this process, not
+            # for the request.
+            for req in replayable_reqs:
+                req.result = {
+                    "id": req.id, "status": REPLAYABLE,
+                    "error": "service stopped before dispatch; "
+                             "journaled for --recover replay",
+                }
+                req.status = REPLAYABLE
+                req.done.set()
+        self.last_stop = {
+            "drained": self.completed - completed_before,
+            "replayable": replayable,
+            "failed_pending": failed_pending,
+        }
+        return dict(self.last_stop)
 
     def __enter__(self) -> "SolveService":
         return self.start()
@@ -200,11 +329,22 @@ class SolveService:
 
     def submit(self, dcop: DCOP,
                params: Optional[Dict[str, Any]] = None,
-               request_id: Optional[str] = None) -> str:
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> str:
         """Admit, compile and enqueue one problem; returns the request
         id.  Raises :class:`~pydcop_tpu.serving.admission.
         AdmissionRejected` (429/503 at the front end) on backpressure
         and ``ValueError`` (400) on malformed problems/parameters.
+
+        ``deadline_s`` (optional, seconds from now): the scheduler
+        refuses to dispatch the request past its deadline — terminal
+        ``EXPIRED`` (504 on the wire, ``rejected_deadline`` in the
+        ledger) instead of burning device time on an answer nobody is
+        waiting for.
+
+        With a journal, the accepted record reaches the OS before
+        this returns — the id this hands back survives a process
+        kill.
 
         Compilation happens HERE, on the submitting thread: structure
         errors surface synchronously, concurrent clients compile in
@@ -224,11 +364,16 @@ class SolveService:
             self._req_total.inc(status=status)
             raise
         # Everything below is the caller's fault when it raises
-        # (unknown/bad-typed params, malformed problem, duplicate id
-        # -> 400 at the front end): still a ledger entry, so
-        # pydcop_requests_total reconciles against client-side counts
-        # even when clients misbehave.
+        # (unknown/bad-typed params, malformed problem, duplicate id,
+        # bad deadline -> 400 at the front end): still a ledger
+        # entry, so pydcop_requests_total reconciles against
+        # client-side counts even when clients misbehave.
         try:
+            if deadline_s is not None:
+                deadline_s = float(deadline_s)
+                if not deadline_s > 0:
+                    raise ValueError(
+                        f"deadline_s must be > 0, got {deadline_s}")
             merged = dict(self.default_params)
             if params:
                 merged.update(params)
@@ -239,7 +384,7 @@ class SolveService:
                 id=request_id or f"r{next(self._ids)}",
                 dcop=dcop, graph=graph, meta=meta, params=merged,
                 bin=binning.bin_key(graph, merged),
-                t_submit=t_submit,
+                t_submit=t_submit, deadline_s=deadline_s,
             )
             with self._lock:
                 if req.id in self._requests:
@@ -250,18 +395,123 @@ class SolveService:
         except Exception:
             self._req_total.inc(status="rejected_bad_request")
             raise
+        if self._journal is not None:
+            # BEFORE the queue and before the caller can ack: the 202
+            # must never outlive the journal record.  A failed append
+            # fails the submit — a durability promise the service
+            # cannot keep must not be made.
+            try:
+                from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+                self._journal.append(journal_mod.accepted_record(
+                    req.id, dcop_yaml(dcop), req.params,
+                    deadline_s=deadline_s, t_submit=t_submit))
+                self._journal_records.inc(kind="accepted")
+            except Exception as exc:
+                with self._lock:
+                    self._requests.pop(req.id, None)
+                self._req_total.inc(status="error")
+                raise RuntimeError(
+                    f"request journal append failed: {exc}") from exc
         try:
             self._queue.put_nowait(req)
         except queue.Full:
             # qsize raced past the high-water check: same contract as
-            # an admission rejection, never a blocking put.
+            # an admission rejection, never a blocking put.  The
+            # journal must agree the request is terminal — without
+            # the completion record a --recover restart would replay
+            # a request its client saw rejected.
             with self._lock:
                 self._requests.pop(req.id, None)
+            req.status = ERROR
+            self._journal_done(req)
             self._req_total.inc(status="rejected_queue_full")
             raise QueueFullRace(
                 f"request queue full ({self._queue.maxsize})")
         self._queue_depth.set(self._queue.qsize())
         return req.id
+
+    def _replay(self, records: List[Dict[str, Any]]) -> None:
+        """Re-enqueue journaled accepted-but-unfinished requests
+        through the normal queue (crash recovery).  Replayed requests
+        keep their original ids (clients poll the id they were acked
+        with) and skip admission — they were admitted by the previous
+        process; their accepted records already survive in the
+        compacted journal, so nothing is re-journaled here.  A record
+        that no longer compiles is failed (journaled terminal) rather
+        than dropped."""
+        from pydcop_tpu.dcop.yamldcop import load_dcop
+
+        span = (tracer.span("serve_replay", "serving",
+                            n_pending=len(records))
+                if tracer.enabled else None)
+        replayed = 0
+        with (span if span is not None else contextlib.nullcontext()):
+            for rec in records:
+                rid = rec.get("id")
+                try:
+                    dcop = load_dcop(rec["dcop"])
+                    merged = binning.normalize_params(
+                        rec.get("params") or {})
+                    graph, meta = compile_dcop(
+                        dcop, noise_level=merged["noise"])
+                    # The deadline clock restarts at replay: the
+                    # original submit clock died with the crashed
+                    # process, and expiring everything on principle
+                    # would turn recovery into a mass 504.
+                    req = SolveRequest(
+                        id=rid, dcop=dcop, graph=graph, meta=meta,
+                        params=merged,
+                        bin=binning.bin_key(graph, merged),
+                        t_submit=time.perf_counter(),
+                        deadline_s=rec.get("deadline_s"),
+                        replayed=True,
+                    )
+                    with self._lock:
+                        self._requests[req.id] = req
+                    self._queue.put(req, timeout=30.0)
+                except Exception as exc:  # noqa: BLE001 — one bad
+                    # record must not abort the rest of the replay.
+                    logger.warning("journal replay failed for %s: %s",
+                                   rid, exc)
+                    with self._lock:
+                        req = self._requests.get(rid)
+                    if req is not None:
+                        self._finish_error(
+                            req, f"journal replay failed: {exc}")
+                    elif self._journal is not None and rid:
+                        # No request object to fail (the yaml itself
+                        # would not load): journal the terminal
+                        # directly so the record cannot replay
+                        # forever.
+                        try:
+                            self._journal.append(
+                                journal_mod.completed_record(
+                                    rid, ERROR))
+                            self._journal_records.inc(kind="completed")
+                        except Exception:
+                            logger.warning(
+                                "could not journal replay failure "
+                                "for %s", rid)
+                        self._req_total.inc(status="error")
+                    continue
+                replayed += 1
+                if tracer.enabled:
+                    tracer.instant("serve_replay_request", "serving",
+                                   id=rid)
+        self.replayed += replayed
+        if replayed:
+            self._replayed_total.inc(replayed)
+            logger.info("journal recovery replayed %d request(s)",
+                        replayed)
+        self._queue_depth.set(self._queue.qsize())
+
+    def record_bad_request(self) -> None:
+        """Ledger a client error rejected before :meth:`submit` could
+        run (the front end validates wire-level fields like
+        ``timeout`` first) — the request ledger must reconcile against
+        client-side counts on every path."""
+        self._req_total.inc(status="rejected_bad_request")
 
     def result(self, request_id: str,
                wait: Optional[float] = None) -> Optional[Dict[str, Any]]:
@@ -308,17 +558,30 @@ class SolveService:
 
     def dispatch(self, reqs: List[SolveRequest]) -> None:
         """Solve one same-bin batch in a single device dispatch and
-        complete every request in it.  Any engine failure fails the
-        whole batch (each request gets the error) and feeds the
-        breaker; success closes a half-open circuit."""
+        complete every request in it.
+
+        An engine failure on a MULTI-request batch does not fail the
+        batch wholesale: the bin is BISECTED and each half retried
+        (``pydcop_serve_dispatch_retries_total``), recursively, until
+        the poison request fails ALONE and its bin-mates succeed —
+        log-bounded (at most ``2·n - 1`` dispatches for one poison
+        request in a bin of n).  Only the isolated singleton failure
+        feeds the admission breaker, so one poison client cannot open
+        the circuit for a healthy engine — while a genuinely down
+        engine still fails every singleton and trips it."""
         for req in reqs:
             req.status = RUNNING
         self._queue_depth.set(self._queue.qsize())
+        self._dispatch_attempt(reqs, retry_depth=0)
+
+    def _dispatch_attempt(self, reqs: List[SolveRequest],
+                          retry_depth: int) -> None:
         params = reqs[0].params
         span = (tracer.span(
             "serve_dispatch", "serving",
             bin=binning.bin_label(reqs[0].bin),
-            n_real=len(reqs)) if tracer.enabled else None)
+            n_real=len(reqs),
+            retry_depth=retry_depth) if tracer.enabled else None)
         try:
             with (span if span is not None
                   else contextlib.nullcontext()):
@@ -329,14 +592,25 @@ class SolveService:
                         batch_result.metrics["batch_size"]
                     span.args["pad_fraction"] = \
                         batch_result.metrics["pad_fraction"]
-        except Exception as exc:  # noqa: BLE001 — fail the batch, not
-            # the scheduler thread: the service must keep serving.
-            logger.warning("serve dispatch failed (%d requests): %s",
-                           len(reqs), exc)
-            self.admission.record_dispatch(ok=False)
+        except Exception as exc:  # noqa: BLE001 — fail/bisect the
+            # batch, not the scheduler thread: the service must keep
+            # serving.
             self._dispatch_total.inc(kind="failed")
-            for req in reqs:
-                self._finish_error(req, f"dispatch failed: {exc}")
+            if len(reqs) == 1:
+                logger.warning("serve dispatch failed (isolated "
+                               "request %s): %s", reqs[0].id, exc)
+                self.admission.record_dispatch(ok=False)
+                self._finish_error(reqs[0],
+                                   f"dispatch failed: {exc}")
+                return
+            logger.warning(
+                "serve dispatch failed (%d requests): bisecting to "
+                "isolate the poison request: %s", len(reqs), exc)
+            mid = len(reqs) // 2
+            for half in (reqs[:mid], reqs[mid:]):
+                self.dispatch_retries += 1
+                self._retries.inc()
+                self._dispatch_attempt(half, retry_depth + 1)
             return
         self.admission.record_dispatch(ok=True)
         metrics = batch_result.metrics
@@ -390,6 +664,7 @@ class SolveService:
             self.completed += 1
             self._req_total.inc(status="ok")
             self._latency.observe(t_done - req.t_submit)
+            self._journal_done(req)
             req.done.set()
 
     def _run_batch(self, reqs, params):
@@ -413,7 +688,52 @@ class SolveService:
         req.status = ERROR
         self.failed += 1
         self._req_total.inc(status="error")
+        self._journal_done(req)
         req.done.set()
+
+    def _finish_expired(self, req: SolveRequest):
+        """Terminal EXPIRED: the deadline passed before dispatch.  A
+        504 on the wire, ``rejected_deadline`` in the ledger, and a
+        journaled terminal — an expired request must not resurrect on
+        a --recover restart."""
+        req.result = {
+            "id": req.id, "status": EXPIRED,
+            "error": (f"deadline of {req.deadline_s}s exceeded "
+                      "before dispatch"),
+            "latency": {
+                "total_s": time.perf_counter() - req.t_submit,
+            },
+        }
+        req.status = EXPIRED
+        self.expired += 1
+        self._req_total.inc(status="rejected_deadline")
+        self._journal_done(req)
+        req.done.set()
+
+    def expire_if_overdue(self, req: SolveRequest) -> bool:
+        """Scheduler hook: drop already-expired work BEFORE binning.
+        True means the request was expired and must not be
+        dispatched."""
+        if req.deadline_s is None:
+            return False
+        if time.perf_counter() - req.t_submit <= req.deadline_s:
+            return False
+        self._finish_expired(req)
+        return True
+
+    def _journal_done(self, req: SolveRequest):
+        """Journal a terminal outcome.  Never raises into the
+        scheduler thread: a failed completion append costs at most
+        one duplicate solve after a crash, never the service."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(
+                journal_mod.completed_record(req.id, req.status))
+            self._journal_records.inc(kind="completed")
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("journal completion append failed for "
+                           "%s: %s", req.id, exc)
 
     # -- introspection ------------------------------------------------- #
 
@@ -428,6 +748,11 @@ class SolveService:
             "batched_dispatches": self.batched_dispatches,
             "completed": self.completed,
             "failed": self.failed,
+            "expired": self.expired,
+            "replayed": self.replayed,
+            "dispatch_retries": self.dispatch_retries,
+            "journal": (self.journal_dir
+                        if self._journal is not None else None),
             "tracked_requests": tracked,
             "max_batch": self.max_batch,
             "batch_window_s": self.batch_window_s,

@@ -50,6 +50,10 @@ MIN_POINTS = 3  # newest + at least 2 history points to call anything
 _SPARKS = "▁▂▃▄▅▆▇█"
 
 
+def _opt_float(value) -> Optional[float]:
+    return float(value) if value is not None else None
+
+
 def load_history(root: str) -> List[Dict[str, Any]]:
     """All bench runs in chronological order: ``BENCH_r*.json`` (by
     round number), plus ``BENCH_TPU_LAST.json`` ONLY when no round
@@ -108,6 +112,13 @@ def load_history(root: str) -> List[Dict[str, Any]]:
                               if sharded_value is not None else None),
             "sharded_backend": parsed.get("sharded_backend")
             or parsed.get("backend") or "cpu",
+            # Recovery-latency legs (ISSUE 8 bench_recovery_replay /
+            # bench_sharded): seconds, LOWER is better — absent
+            # before PR 8, None when the leg failed that round.
+            "serve_recovery_value": _opt_float(
+                parsed.get("serve_recovery_replay_s")),
+            "shard_recovery_value": _opt_float(
+                parsed.get("shard_recovery_s")),
         })
     last_path = os.path.join(root, "BENCH_TPU_LAST.json")
     have_tpu_round = any(r.get("backend") == "tpu" for r in runs)
@@ -132,13 +143,18 @@ def load_history(root: str) -> List[Dict[str, Any]]:
 def check_series(values: List[float],
                  rel_tol: float = DEFAULT_REL_TOL,
                  mad_mult: float = DEFAULT_MAD_MULT,
-                 window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
-    """Verdict for one backend's chronological cycles/s series.
+                 window: int = DEFAULT_WINDOW,
+                 higher_is_better: bool = True) -> Dict[str, Any]:
+    """Verdict for one backend's chronological metric series.
 
     The newest value is judged against the median ± MAD of the
-    ``window`` runs before it.  Returns a dict with the verdict
-    (``ok`` / ``regressed`` / ``insufficient``), the baseline stats
-    and the tolerance actually applied."""
+    ``window`` runs before it.  ``higher_is_better=True`` (rates:
+    cycles/s, problems/s) regresses when the newest value falls below
+    the floor; ``False`` (latencies: recovery seconds) regresses when
+    it rises above the ceiling.  Returns a dict with the verdict
+    (``ok`` / ``regressed`` / ``insufficient``), the baseline stats,
+    the tolerance actually applied, and ``bound`` (the floor or
+    ceiling crossed)."""
     if len(values) < MIN_POINTS:
         return {
             "verdict": "insufficient",
@@ -149,9 +165,13 @@ def check_series(values: List[float],
     trail = values[-(window + 1):-1]
     med = statistics.median(trail)
     mad = statistics.median([abs(v - med) for v in trail])
-    tolerance = max(rel_tol * med, mad_mult * mad)
-    floor = med - tolerance
-    regressed = newest < floor
+    tolerance = max(rel_tol * abs(med), mad_mult * mad)
+    if higher_is_better:
+        bound = med - tolerance
+        regressed = newest < bound
+    else:
+        bound = med + tolerance
+        regressed = newest > bound
     return {
         "verdict": "regressed" if regressed else "ok",
         "points": len(values),
@@ -159,7 +179,11 @@ def check_series(values: List[float],
         "median": med,
         "mad": mad,
         "tolerance": tolerance,
-        "floor": floor,
+        "bound": bound,
+        # Kept for history consumers that predate lower-is-better
+        # series: "floor" has always named the regression boundary.
+        "floor": bound,
+        "higher_is_better": higher_is_better,
         "delta_rel": (newest - med) / med if med else 0.0,
     }
 
@@ -188,24 +212,31 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
     with enough history regressed."""
     runs = load_history(root)
     skipped = [r for r in runs if "skipped" in r]
-    # Three metric families judged with the same noise model: the
+    # Five metric families judged with the same noise model: the
     # headline engine rate ("value", cycles/s), the serving
-    # throughput ("serve_value", problems/s — absent before PR 6) and
+    # throughput ("serve_value", problems/s — absent before PR 6),
     # the sharded-superstep rate ("sharded_value", cycles/s — absent
     # before PR 7; judged on its own backend key because the CPU leg
-    # runs on a forced-host-device mesh).  Backends never share a
-    # baseline in any family.
+    # runs on a forced-host-device mesh), and the two ISSUE-8
+    # recovery LATENCIES (journal crash replay, shard-loss
+    # repartition — seconds, LOWER is better, regression = newest
+    # above the ceiling).  Backends never share a baseline in any
+    # family.
     metrics = (
-        ("bench", "value", "cycles/s"),
-        ("serve", "serve_value", "problems/s"),
-        ("sharded", "sharded_value", "cycles/s"),
+        ("bench", "value", "cycles/s", "backend", True),
+        ("serve", "serve_value", "problems/s", "backend", True),
+        ("sharded", "sharded_value", "cycles/s",
+         "sharded_backend", True),
+        ("serve_recovery", "serve_recovery_value", "s",
+         "backend", False),
+        ("shard_recovery", "shard_recovery_value", "s",
+         "sharded_backend", False),
     )
     series = {}
     lines = []
     failed = False
-    for family, field, unit in metrics:
-        backend_key = ("sharded_backend" if family == "sharded"
-                       else "backend")
+    for family, field, unit, backend_key, higher_better in metrics:
+        fmt = ".0f" if higher_better else ".3f"
         by_backend: Dict[str, List[Dict[str, Any]]] = {}
         for r in runs:
             if "skipped" in r or r.get(field) is None:
@@ -217,7 +248,8 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
             rows = by_backend[backend]
             values = [r[field] for r in rows]
             result = check_series(values, rel_tol=rel_tol,
-                                  mad_mult=mad_mult, window=window)
+                                  mad_mult=mad_mult, window=window,
+                                  higher_is_better=higher_better)
             result["values"] = values
             result["sources"] = [r["source"] for r in rows]
             label = (backend if family == "bench"
@@ -227,18 +259,19 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
             if result["verdict"] == "insufficient":
                 lines.append(
                     f"{family}[{backend}] {spark} "
-                    f"{values[0]:.0f}→{values[-1]:.0f} {unit} — "
+                    f"{values[0]:{fmt}}→{values[-1]:{fmt}} {unit} — "
                     f"{result['detail']} ({result['points']} run(s))"
                 )
                 continue
             direction = f"{result['delta_rel']:+.1%}"
             verdict = ("REGRESSED" if result["verdict"] == "regressed"
                        else "OK")
+            bound_name = "floor" if higher_better else "ceiling"
             lines.append(
                 f"{family}[{backend}] {spark} "
-                f"{values[0]:.0f}→{values[-1]:.0f} {unit}, newest "
-                f"{direction} vs median {result['median']:.0f} "
-                f"(floor {result['floor']:.0f}) {verdict}"
+                f"{values[0]:{fmt}}→{values[-1]:{fmt}} {unit}, newest "
+                f"{direction} vs median {result['median']:{fmt}} "
+                f"({bound_name} {result['bound']:{fmt}}) {verdict}"
             )
             if result["verdict"] == "regressed":
                 failed = True

@@ -4,8 +4,10 @@ The robustness analogue of ``make perf-smoke``: where the perf gate
 proves the hot path is *fast*, this gate proves the runtime *heals* —
 every scenario injects a distinct failure combination (message drop +
 duplicate + delay, network partition with healing, silent agent kill,
-engine guard trips, checkpoint corruption) and asserts the system-wide
-invariants that define "self-healing":
+engine guard trips, checkpoint corruption, serve-process crash with
+journal replay, poison requests in a batched bin, device loss
+mid-sharded-solve) and asserts the system-wide invariants that define
+"self-healing":
 
 - **valid assignment** — every variable ends with a value from its
   domain (a migrated computation kept working; nothing was lost);
@@ -29,11 +31,14 @@ scenario name, the seed and the trace file to hand to
 Usage::
 
     python tools/chaos_soak.py                 # full matrix
-    python tools/chaos_soak.py --scenarios 6   # quick gate (make test)
+    python tools/chaos_soak.py --quick         # make-test gate (~20 s)
+    python tools/chaos_soak.py --scenarios 6   # first N scenarios
     python tools/chaos_soak.py --seed 7 --only kill_detected
 
 ``make chaos-soak`` runs the full matrix; ``make test`` wires the
-quick 6-scenario gate (fixed seed, < 60 s).
+``--quick`` device-side gate (fixed seed, ~20 s): engine guard
+recovery, checkpoint corruption, guard purity, journal crash replay,
+poison-bin bisection, shard-loss repartition.
 """
 
 import argparse
@@ -43,6 +48,13 @@ import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The shard-trip scenario needs a multi-device mesh: force the
+# 8-virtual-device CPU platform (same recipe as the root conftest)
+# unless the caller already chose a device count.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 from pydcop_tpu.algorithms import AlgorithmDef  # noqa: E402
 from pydcop_tpu.dcop.dcop import DCOP  # noqa: E402
@@ -339,6 +351,183 @@ def scenario_checkpoint_corruption(seed, trace):
         return {"resumed_from": res.metrics["resumed_from_cycle"]}
 
 
+def _serve_instance(n_vars, seed):
+    """Ring coloring with seeded random tables; carries an agent so
+    it survives the journal's dcop_yaml round-trip."""
+    import numpy as np
+
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(seed)
+    d = Domain("c", "", [0, 1, 2])
+    dcop = DCOP(f"soak_srv_{n_vars}_{seed}", objective="min")
+    vs = [Variable(f"v{i}", d) for i in range(n_vars)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(n_vars):
+        table = rng.integers(0, 10, size=(3, 3)).astype(float)
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[k], vs[(k + 1) % n_vars]], table, f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def scenario_serve_journal_replay(seed, trace):
+    """Crash-equivalent journal (accepted records, one pre-crash
+    completion, a torn tail) + a ``recover=True`` service start:
+    exactly the unfinished requests replay through the normal queue
+    and complete — zero acknowledged requests lost — and the replay
+    is announced in the trace (``serve_replay`` span)."""
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.observability import ObservabilitySession
+    from pydcop_tpu.serving.journal import (
+        RequestJournal,
+        accepted_record,
+        completed_record,
+    )
+    from pydcop_tpu.serving.service import SolveService
+
+    params = {"max_cycles": 40}
+    with tempfile.TemporaryDirectory() as journal_dir:
+        jnl = RequestJournal(journal_dir)
+        dcops = {}
+        for i in range(5):
+            rid = f"crash{i}"
+            dcops[rid] = _serve_instance(8, seed * 100 + i)
+            jnl.append(accepted_record(
+                rid, dcop_yaml(dcops[rid]), params))
+        jnl.append(completed_record("crash0", "FINISHED"))
+        jnl.close()
+        with open(jnl.path, "ab") as f:
+            f.write(b"\x00\x00\x00\x20torn-mid-append")  # kill -9
+        svc = SolveService(journal_dir=journal_dir, recover=True,
+                           batch_window_s=0.05, max_batch=8)
+        with ObservabilitySession(trace, "chrome"):
+            svc.start()
+            try:
+                for rid in ("crash1", "crash2", "crash3", "crash4"):
+                    result = svc.result(rid, wait=60.0)
+                    assert result is not None \
+                        and result["status"] == "FINISHED", \
+                        f"replayed request {rid} lost after crash"
+                    assert_valid_assignment(dcops[rid],
+                                            result["assignment"])
+                assert svc.replayed == 4, \
+                    f"replayed {svc.replayed}, wanted exactly 4 " \
+                    "(the pre-crash completion must not resurrect)"
+                try:
+                    svc.result("crash0")
+                    raise AssertionError(
+                        "completed-before-crash request resurrected")
+                except KeyError:
+                    pass
+            finally:
+                svc.stop(drain=False)
+        from pydcop_tpu.observability.trace import load_trace_file
+
+        names = {e["name"] for e in load_trace_file(trace)}
+        assert "serve_replay" in names, \
+            "serve_replay span missing from exported trace"
+        return {"replayed": 4, "torn_tail": "truncated"}
+
+
+def scenario_serve_poison_bin(seed, trace):
+    """One poison request in a bin of 6: the failed dispatch BISECTS
+    — the poison request fails alone, every bin-mate succeeds, the
+    retries are accounted, and the breaker never opens."""
+    from pydcop_tpu.serving.service import SolveService
+
+    svc = SolveService(batch_window_s=0.3, max_batch=8)
+    svc.start()
+    real = svc._run_batch
+    poison = set()
+
+    def poisoned(reqs, params):
+        if any(r.id in poison for r in reqs):
+            raise RuntimeError("poison request in batch")
+        return real(reqs, params)
+
+    svc._run_batch = poisoned
+    try:
+        rids = [svc.submit(_serve_instance(8, seed * 10 + i),
+                           params={"max_cycles": 40})
+                for i in range(6)]
+        poison.add(rids[seed % 6])
+        statuses = {}
+        for rid in rids:
+            result = svc.result(rid, wait=60.0)
+            assert result is not None, f"request {rid} hung"
+            statuses[rid] = result["status"]
+        assert statuses[rids[seed % 6]] == "ERROR", \
+            "poison request must fail"
+        mates = [r for r in rids if r != rids[seed % 6]]
+        assert all(statuses[r] == "FINISHED" for r in mates), (
+            "bin-mates of the poison request failed too: "
+            f"{statuses}")
+        assert svc.dispatch_retries > 0, \
+            "bisection never retried (wholesale failure?)"
+        assert svc.admission.breaker.state != "open", \
+            "isolated poison failure opened the breaker"
+        return {"retries": svc.dispatch_retries,
+                "isolated": rids[seed % 6]}
+    finally:
+        svc.stop(drain=False)
+
+
+def scenario_shard_trip_repartition(seed, trace):
+    """Injected device loss mid-sharded-solve: rollback +
+    re-partition onto the survivors, with the SAME final assignment
+    and cost as the untripped run, the repartition visible in the
+    trace, and the cycle counter monotone except across the
+    announced rollback."""
+    import numpy as np
+
+    from pydcop_tpu.algorithms.maxsum import build_engine
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.observability import ObservabilitySession
+    from pydcop_tpu.resilience.recovery import RecoveryPolicy
+
+    rng = np.random.default_rng(seed)
+    d = Domain("d", "", [0, 1, 2])
+    dcop = DCOP("soak_shard", objective="min")
+    vs = [Variable(f"v{i}", d) for i in range(20)]
+    for v in vs:
+        dcop.add_variable(v)
+    seen, k = set(), 0
+    while k < 30:
+        i, j = rng.choice(20, size=2, replace=False)
+        key = (min(i, j), max(i, j))
+        if key in seen:
+            continue
+        seen.add(key)
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[key[0]], vs[key[1]]],
+            rng.integers(0, 10, size=(3, 3)), name=f"c{k}"))
+        k += 1
+    ref = build_engine(dcop, {}, shards=2).run_checkpointed(
+        max_cycles=60, segment_cycles=10)
+    with ObservabilitySession(trace, "chrome"):
+        res = build_engine(dcop, {}, shards=2).run_checkpointed(
+            max_cycles=60, segment_cycles=10,
+            recovery=RecoveryPolicy(trip_shard=((20, seed % 2),)))
+    assert res.assignment == ref.assignment, \
+        "repartitioned recovery diverged from the untripped solve"
+    assert_valid_assignment(dcop, res.assignment)
+    m = res.metrics
+    assert m["shard_losses"] == 1 and m["repartitions"] == 1
+    assert m["recovery_attempts"] == 0, \
+        "a device loss must not consume the numerics restart budget"
+    assert m["shard_recovery_s"] > 0
+    events = assert_monotone_segments(trace)
+    rollbacks = [e for e in events
+                 if e["name"] == "recovery_rollback"]
+    assert any(e["args"].get("action") == "repartition"
+               for e in rollbacks), \
+        "repartition rollback missing from exported trace"
+    return {"lost_shard": seed % 2,
+            "shard_recovery_s": m["shard_recovery_s"]}
+
+
 # Quick-gate ordering: the first 6 cover every failure class (kill
 # detection, engine recovery, partition healing, lossy links,
 # checkpoint corruption, guard purity).
@@ -351,6 +540,26 @@ SCENARIOS = [
     ("guard_noop_device", scenario_guard_noop_device),
     ("delay_only_no_death", scenario_delay_only_no_death),
     ("drop_plus_kill", scenario_drop_plus_kill),
+    ("serve_journal_replay", scenario_serve_journal_replay),
+    ("serve_poison_bin", scenario_serve_poison_bin),
+    ("shard_trip_repartition", scenario_shard_trip_repartition),
+]
+
+# The `make test` gate (--quick): the DEVICE-SIDE failure classes —
+# engine guard recovery, checkpoint corruption, guard purity, plus
+# the three ISSUE-8 classes (journal crash replay, poison-bin
+# bisection, shard-loss repartition) — chosen to finish in ~20 s.
+# The thread-runtime scenarios (kills, partitions, lossy links) stay
+# in the full matrix (`make chaos-soak`); their invariants also run
+# in `make test` through tests/unit/test_resilience_battery.py and
+# test_selfheal_battery.py.
+QUICK_GATE = [
+    "guard_trip_device",
+    "checkpoint_corruption",
+    "guard_noop_device",
+    "serve_journal_replay",
+    "serve_poison_bin",
+    "shard_trip_repartition",
 ]
 
 
@@ -359,6 +568,9 @@ def main(argv=None) -> int:
     parser.add_argument("--scenarios", type=int, default=0,
                         help="run only the first N scenarios "
                              "(0 = full matrix)")
+    parser.add_argument("--quick", action="store_true",
+                        help="the `make test` gate: the device-side "
+                             "scenario subset (~20 s)")
     parser.add_argument("--seed", type=int, default=DEFAULT_SEED)
     parser.add_argument("--only", default=None,
                         help="run a single scenario by name (replay)")
@@ -374,6 +586,8 @@ def main(argv=None) -> int:
             names = ", ".join(name for name, _ in SCENARIOS)
             print(f"unknown scenario {args.only!r}; have: {names}")
             return 2
+    elif args.quick:
+        selected = [s for s in SCENARIOS if s[0] in QUICK_GATE]
     elif args.scenarios:
         selected = SCENARIOS[:args.scenarios]
 

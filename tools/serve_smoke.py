@@ -14,6 +14,16 @@ Starts the real service on port 0 and drives it over HTTP:
    a dropped request: every accepted request finishes, every rejected
    one is a clean 429, and ``pydcop_requests_total{status}`` accounts
    for every single request fired.
+3. **kill -9 + journal replay** (ISSUE 8 acceptance): a REAL
+   ``pydcop serve --journal_dir D`` subprocess is SIGKILLed mid-burst;
+   every acknowledged (202) request must have its accepted record on
+   disk, and a ``--recover`` start must replay every
+   accepted-but-unfinished one to completion — zero acknowledged
+   requests lost.
+4. **SIGTERM drain** (ISSUE 8 satellite): an orchestrated-restart
+   signal makes the serve process drain and exit 0, logging the
+   drained/replayable counts — accepted work is never silently
+   dropped.
 
 Run:  python tools/serve_smoke.py      (exit 0 = all claims hold)
 """
@@ -21,7 +31,11 @@ Run:  python tools/serve_smoke.py      (exit 0 = all claims hold)
 import json
 import os
 import re
+import signal
+import socket
+import subprocess
 import sys
+import tempfile
 import threading
 import time
 import urllib.error
@@ -225,10 +239,178 @@ def leg_overload():
         handle.stop()
 
 
+KILL9_BURST = 10
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_serve(port: int, journal_dir: str, *extra) -> subprocess.Popen:
+    """A REAL ``pydcop serve`` process (the kill target must be a
+    process, not a thread)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "serve",
+         "--port", str(port), "--journal_dir", journal_dir,
+         "--batch_window", "0.3", "--max_batch", "4",
+         "--cycles", "200", *extra],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def _wait_listening(proc, url: str, timeout: float = 90.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            _, err = proc.communicate()
+            check(False, "serve subprocess died on startup: "
+                  + err.decode(errors="replace")[-800:])
+        try:
+            with urllib.request.urlopen(url + "/healthz", timeout=2):
+                return
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.25)
+    check(False, f"serve subprocess never listened on {url}")
+
+
+def leg_kill9_replay():
+    """SIGKILL a serving process mid-burst; prove the 202 was a
+    durable promise: every acked request's accepted record is on
+    disk, and --recover replays every unfinished one to completion."""
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving.journal import (
+        pending_requests,
+        scan_journal,
+    )
+    from pydcop_tpu.serving.service import SolveService
+
+    journal_dir = tempfile.mkdtemp(prefix="serve_kill9_")
+    port = _free_port()
+    proc = _spawn_serve(port, journal_dir)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        _wait_listening(proc, url)
+        dcops = {}
+        acked = []
+        for i in range(KILL9_BURST):
+            dcop = build_instance(11, 400 + i)
+            status, body = post(url, {
+                "dcop": dcop_yaml(dcop),
+                "params": {"max_cycles": MAX_CYCLES},
+            })
+            check(status == 202,
+                  f"burst request {i} acked (got {status})")
+            acked.append(body["id"])
+            dcops[body["id"]] = dcop
+        # Mid-burst: the batch window is still open, nothing has
+        # finished.  No drain, no flush, no mercy.
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    records, _, _ = scan_journal(
+        os.path.join(journal_dir, "requests.jnl"))
+    on_disk = {r["id"] for r in records if r["kind"] == "accepted"}
+    check(set(acked) <= on_disk,
+          f"all {len(acked)} acked requests journaled before the 202 "
+          f"(SIGKILL lost {len(set(acked) - on_disk)})")
+    pending = {r["id"] for r in pending_requests(records)}
+    finished_before_kill = set(acked) - pending
+
+    # --recover: the same path `pydcop serve --journal_dir D
+    # --recover` takes on restart.
+    svc = SolveService(journal_dir=journal_dir, recover=True,
+                       batch_window_s=0.05, max_batch=4)
+    svc.start()
+    try:
+        check(svc.replayed == len(pending),
+              f"recovery replayed exactly the {len(pending)} "
+              f"unfinished request(s) ({svc.replayed} replayed, "
+              f"{len(finished_before_kill)} completed pre-kill)")
+        for rid in sorted(pending):
+            result = svc.result(rid, wait=120.0)
+            check(result is not None
+                  and result["status"] == "FINISHED",
+                  f"replayed request {rid} completed after kill -9")
+        # Parity: a replayed request's answer equals the solo solve.
+        from pydcop_tpu import api
+
+        probe = sorted(pending)[0] if pending else None
+        if probe is not None:
+            solo = api.solve(dcops[probe], "maxsum",
+                             backend="device", max_cycles=MAX_CYCLES)
+            check(svc.result(probe)["assignment"]
+                  == solo["assignment"],
+                  "replayed result identical to solo api.solve")
+    finally:
+        svc.stop(drain=False)
+    check(True, f"kill -9 mid-burst lost zero of {len(acked)} "
+          "acknowledged requests")
+
+
+def leg_sigterm_drain():
+    """SIGTERM (the orchestrated-restart signal): the process drains
+    accepted work and exits 0, logging the drained count."""
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving.journal import (
+        pending_requests,
+        scan_journal,
+    )
+
+    journal_dir = tempfile.mkdtemp(prefix="serve_sigterm_")
+    port = _free_port()
+    proc = _spawn_serve(port, journal_dir)
+    url = f"http://127.0.0.1:{port}"
+    acked = []
+    try:
+        _wait_listening(proc, url)
+        for i in range(4):
+            status, body = post(url, {
+                "dcop": dcop_yaml(build_instance(9, 500 + i)),
+                "params": {"max_cycles": 40},
+            })
+            check(status == 202, f"drain request {i} acked")
+            acked.append(body["id"])
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            check(False, "SIGTERM'd serve process failed to exit")
+        _, err = proc.communicate()
+        stderr = err.decode(errors="replace")
+        check(proc.returncode == 0,
+              f"SIGTERM exits 0 (got {proc.returncode}): "
+              f"{stderr[-400:]}")
+        check("drained" in stderr and "replayable" in stderr,
+              "shutdown banner logs the drained/replayable counts")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # Zero silently dropped: every acked id either completed inside
+    # the drain window (journaled terminal) or is still replayable.
+    records, _, _ = scan_journal(
+        os.path.join(journal_dir, "requests.jnl"))
+    on_disk = {r["id"] for r in records if r["kind"] == "accepted"}
+    pending = {r["id"] for r in pending_requests(records)}
+    terminal = on_disk - pending
+    check(set(acked) <= (terminal | pending),
+          f"every accepted request drained ({len(terminal)}) or "
+          f"left replayable ({len(pending)}) — zero dropped")
+
+
 def main() -> int:
     t0 = time.perf_counter()
     leg_coalescing()
     leg_overload()
+    leg_kill9_replay()
+    leg_sigterm_drain()
     print(f"serve_smoke: PASS ({time.perf_counter() - t0:.1f}s)")
     return 0
 
