@@ -55,9 +55,10 @@ trace-demo:
 # Perf-smoke gate: the hot-path claims measured on CPU — vectorized
 # compile >= 3x over the per-factor loop on a 10k-factor expression
 # instance, a structure-cache hit skipping layout construction
-# (counter-asserted) and compiling faster, and the aggregation
-# autotuner picking a valid strategy + replaying from its JSON cache.
-# See tools/perf_smoke.py.
+# (counter-asserted) and compiling faster, the aggregation autotuner
+# picking a valid strategy + replaying from its JSON cache, and the
+# always-on flight recorder costing <= 5% on the segmented-run
+# benchmark.  See tools/perf_smoke.py.
 perf-smoke:
 	$(PY) tools/perf_smoke.py
 

@@ -635,6 +635,7 @@ def bench_serving():
     if not latencies or elapsed <= 0:
         return {"serve_problems_per_sec": None}
     lat_ms = np.asarray(latencies) * 1e3
+    p99_exemplar = (stats.get("latency_exemplars") or {}).get("p99")
     return {
         "serve_problems_per_sec": round(completed[0] / elapsed, 2),
         "serve_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
@@ -643,6 +644,9 @@ def bench_serving():
         "serve_batched_fraction": round(
             stats["batched_dispatches"] / stats["dispatches"], 3)
             if stats["dispatches"] else None,
+        # The p99 bucket's exemplar: a flagged regression in the
+        # sentinel points at a concrete request trace to open.
+        "exemplar_trace_id": (p99_exemplar or {}).get("trace_id"),
     }
 
 

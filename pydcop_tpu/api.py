@@ -339,8 +339,10 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     import threading
 
     stop_event = threading.Event()
+    got_signal = []
 
     def _on_signal(signum, frame):  # noqa: ARG001 — signal signature
+        got_signal.append(signum)
         stop_event.set()
 
     # SIGTERM is what an orchestrator sends before the SIGKILL
@@ -360,6 +362,13 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
         stop_event.wait()
         print("pydcop serve: signal received, draining…",
               file=sys.stderr)
+        # Fatal-signal anomaly: cut the black-box bundle BEFORE the
+        # drain mutates the queue/journal — the bundle shows what the
+        # process was doing when the orchestrator pulled the plug.
+        from pydcop_tpu.observability import flight
+
+        flight.trigger("fatal_signal", force=True,
+                       signum=(got_signal[0] if got_signal else None))
     finally:
         summary = handle.stop(drain=True)
         for sig, handler in previous.items():

@@ -70,6 +70,14 @@ def set_parser(subparsers):
                         help="fsync the journal per record "
                              "(machine-crash durability; the default "
                              "flush already survives a process kill)")
+    parser.add_argument("--flight_recorder_events",
+                        "--flight-recorder-events",
+                        type=int, default=None, metavar="N",
+                        help="size of the always-on flight-recorder "
+                             "ring (trace events kept for anomaly "
+                             "postmortem bundles; 0 disables; "
+                             "default: PYDCOP_FLIGHT_RECORDER or "
+                             "2048 — docs/observability.md)")
     parser.set_defaults(func=run_cmd)
 
 
@@ -79,6 +87,10 @@ def run_cmd(args) -> int:
     if args.recover and not args.journal_dir:
         logger.error("--recover requires --journal_dir")
         return 2
+    if args.flight_recorder_events is not None:
+        from pydcop_tpu.observability import flight
+
+        flight.install(events=args.flight_recorder_events)
     serve(
         port=args.port, host=args.host,
         max_queue=args.max_queue, high_water=args.high_water,

@@ -82,6 +82,14 @@ def set_parser(subparsers):
                              "cost stream); PORT 0 = OS-assigned, "
                              "printed on stderr "
                              "(docs/observability.md)")
+    parser.add_argument("--flight_recorder_events",
+                        "--flight-recorder-events",
+                        type=int, default=None, metavar="N",
+                        help="size of the always-on flight-recorder "
+                             "ring (trace events kept for postmortem "
+                             "bundles; 0 disables; default: "
+                             "PYDCOP_FLIGHT_RECORDER or 2048 — "
+                             "docs/observability.md)")
     parser.add_argument("--profile", default=None,
                         help="device mode: write a JAX profiler trace "
                              "of the solve to this directory (inspect "
@@ -163,6 +171,11 @@ def set_parser(subparsers):
 def run_cmd(args) -> int:
     from pydcop_tpu.api import solve
     from pydcop_tpu.dcop.yamldcop import load_dcop_from_file
+
+    if args.flight_recorder_events is not None:
+        from pydcop_tpu.observability import flight
+
+        flight.install(events=args.flight_recorder_events)
 
     # csv is the legacy per-step CSV (infrastructure/stats.py, thread
     # mode); chrome/jsonl route through the observability tracer via

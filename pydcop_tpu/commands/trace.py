@@ -8,6 +8,17 @@ side of ``trace diff`` and CI assertions).  Instant events (fault
 injections, breaker trips, message sends) aggregate with zero
 duration; their counts are the point.
 
+``pydcop trace query --request ID FILE [FILE...]`` reconstructs ONE
+request's span tree out of a trace: every span/instant tagged with
+the request's ``trace_id`` (directly, or via a dispatch's
+``trace_ids`` batch tag) is filtered out and re-nested by time
+containment per lane, then stitched under one root ordered by time —
+the submit, queue wait, serve dispatch and engine segments of a
+single request, even when they crossed threads or processes
+(multiple files are clock-anchor aligned like ``merge``).  The
+trace_id comes from the submit ack (HTTP ``trace_id`` field), a
+latency-histogram exemplar, or ``/stats``.
+
 ``pydcop trace merge OUT IN1 IN2 ...`` aligns N per-process traces on
 one wall-clock axis (each exported trace carries a monotonic-to-wall
 anchor in its header; offsets are corrected per file) and namespaces
@@ -70,6 +81,19 @@ def set_parser(subparsers):
     diff.add_argument("--json", action="store_true", dest="as_json",
                       help="emit the full diff rows as JSON")
     diff.set_defaults(func=run_diff)
+
+    query = trace_sub.add_parser(
+        "query", help="one request's span tree out of a trace "
+                      "(filter by trace_id, re-nest, print)")
+    query.add_argument("trace_files", nargs="+",
+                       help="one or more trace files (several are "
+                            "clock-anchor aligned like merge)")
+    query.add_argument("--request", required=True, metavar="TRACE_ID",
+                       help="the request's trace_id (from the submit "
+                            "ack, a latency exemplar, or /stats)")
+    query.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the reconstructed tree as JSON")
+    query.set_defaults(func=run_query)
 
     parser.set_defaults(func=_no_subcommand(parser))
 
@@ -156,6 +180,54 @@ def run_merge(args) -> int:
         f"{info['lanes']} lanes, {info['span_us'] / 1000.0:.1f} ms "
         "span"
     )
+    return 0
+
+
+def run_query(args) -> int:
+    from pydcop_tpu.observability.trace import (
+        TraceFileError,
+        load_events_aligned,
+        query_request,
+    )
+
+    try:
+        events = load_events_aligned(args.trace_files)
+    except TraceFileError as exc:
+        print(f"pydcop trace: {exc}", file=sys.stderr)
+        return 2
+    tree = query_request(events, args.request)
+    if args.as_json:
+        print(json.dumps(tree))
+        return 0 if tree["events"] else 1
+    if not tree["events"]:
+        print(f"no events tagged trace_id={args.request!r} in "
+              f"{len(args.trace_files)} file(s)", file=sys.stderr)
+        return 1
+    nesting = ("well-nested" if tree["well_nested"]
+               else "NOT WELL-NESTED (corrupt or mis-merged trace?)")
+    print(f"request {args.request}: {tree['spans']} spans, "
+          f"{tree['instants']} instants on {tree['lanes']} lane(s), "
+          f"{nesting}")
+
+    def _print(node, depth):
+        indent = "  " * depth
+        if node["ph"] == "X":
+            head = f"{node['name']} {node['dur_ms']:.3f} ms"
+        else:
+            head = f"* {node['name']}"
+        extras = {k: v for k, v in node["args"].items()
+                  if k not in ("trace_id", "trace_ids")}
+        detail = (" " + " ".join(f"{k}={v}" for k, v
+                                 in sorted(extras.items()))
+                  if extras else "")
+        print(f"{indent}{head} [{node['cat']}] "
+              f"@{node['ts_ms']:.3f} ms (lane {node['tid']})"
+              f"{detail}")
+        for child in node["children"]:
+            _print(child, depth + 1)
+
+    for root in tree["tree"]:
+        _print(root, 0)
     return 0
 
 

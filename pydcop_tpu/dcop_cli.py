@@ -31,6 +31,7 @@ def make_parser() -> argparse.ArgumentParser:
         agent,
         batch,
         consolidate,
+        debug,
         distribute,
         generate,
         graph,
@@ -63,7 +64,7 @@ def make_parser() -> argparse.ArgumentParser:
     subparsers = parser.add_subparsers(title="commands", dest="command")
     for cmd in (solve, run, distribute, graph, agent, orchestrator,
                 generate, replica_dist, batch, consolidate, trace,
-                serve):
+                serve, debug):
         cmd.set_parser(subparsers)
     return parser
 

@@ -31,6 +31,7 @@ them through :func:`run_stacked`.  Two serving-driven extensions:
   batch-occupancy telemetry reads it instead of guessing.
 """
 
+import contextlib
 import functools
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -46,6 +47,7 @@ from pydcop_tpu.engine.compile import (
     compile_dcop,
 )
 from pydcop_tpu.engine.runner import DeviceRunResult, timed_jit_call
+from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.ops import maxsum as maxsum_ops
 
 # Batch-size ladder used when a caller asks for bin padding without
@@ -127,7 +129,7 @@ def _batched_solve(stacked, *, max_cycles, damping, damp_vars,
             stability=stability,
             stop_on_convergence=False,
         )
-        return values, state.cycle
+        return values, state.cycle, state.stable
 
     return jax.vmap(solve_one)(stacked)
 
@@ -182,18 +184,29 @@ def run_stacked(
         tuple(sorted(statics.items())),
     )
     t0 = time.perf_counter()
-    (values, cycles), compile_s, run_s = timed_jit_call(
-        _warm, key,
-        functools.partial(_batched_solve, **statics),
-        stacked,
-    )
+    # A batched dispatch IS one engine segment (the whole solve in
+    # one program): the span name matches the segmented loop's so
+    # request-scoped trace queries see a uniform engine layer —
+    # under a serve dispatch the thread-bound trace context stamps
+    # the batch's trace_ids onto it.
+    span = (tracer.span("engine_segment", "engine",
+                        batch_size=len(graphs), n_real=n_real,
+                        from_cycle=0, extra_cycles=max_cycles)
+            if tracer.active else None)
+    with (span if span is not None else contextlib.nullcontext()):
+        (values, cycles, stable), compile_s, run_s = timed_jit_call(
+            _warm, key,
+            functools.partial(_batched_solve, **statics),
+            stacked,
+        )
     elapsed = time.perf_counter() - t0
     values = np.asarray(jax.device_get(values))[:n_real]
     cycles = np.asarray(jax.device_get(cycles))[:n_real]
+    stable = np.asarray(jax.device_get(stable))[:n_real]
     batch_result = DeviceRunResult(
         assignment={},
         cycles=int(cycles.max()) if cycles.size else 0,
-        converged=False,
+        converged=bool(stable.all()) if stable.size else False,
         time_s=elapsed,
         compile_time_s=compile_s,
         metrics={
@@ -202,6 +215,10 @@ def run_stacked(
             "pad_fraction": pad_fraction,
             "cold_start": compile_s > 0.0,
             "run_time_s": run_s,
+            # Per-request convergence verdicts (real lanes, dispatch
+            # order): the serve plane folds lane i's flag into
+            # request i's result.
+            "converged_lanes": [bool(s) for s in stable],
         },
     )
     return values, cycles, batch_result

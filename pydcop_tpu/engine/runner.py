@@ -83,7 +83,13 @@ def timed_jit_call(warm: set, key, fn, *args):
         entry = profiler.capture(key, fn, args)
     t0 = time.perf_counter()
     span = None
-    if tracer.enabled:
+    # Cold dispatches record on ``tracer.active`` (a recompile storm
+    # is exactly the signal a flight-recorder postmortem needs); warm
+    # dispatches only under a file session — in flight-only mode the
+    # enclosing engine_segment span already marks every segment, and
+    # the redundant per-segment event would eat the ring AND the ≤5%
+    # overhead budget gated in make perf-smoke.
+    if tracer.enabled or (first and tracer.active):
         span = tracer.span("jit_compile" if first else "engine_call",
                            "engine", key=str(key))
         with span:
@@ -185,7 +191,7 @@ def run_device_fn(graph: CompiledFactorGraph, meta: FactorGraphMeta,
         sync(jitted(graph))
         compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    if tracer.enabled:
+    if tracer.active:
         with tracer.span("device_solve", "engine",
                          warmed=warmup):
             out = sync(jitted(graph))
@@ -480,7 +486,7 @@ class MaxSumEngine:
                 extra = min(every, max(max_cycles - cycle, 0))
                 fn = self._segment_fn(extra, stop_on_convergence)
                 seg_key = self._segment_key(extra, stop_on_convergence)
-                if tracer.enabled:
+                if tracer.active:
                     with tracer.span("engine_segment", "engine",
                                      segment=segments,
                                      from_cycle=cycle,
@@ -874,7 +880,7 @@ class ShardedMaxSumEngine(MaxSumEngine):
 
     def _call(self, key, fn, *args):
         out = super()._call(key, fn, *args)
-        if tracer.enabled:
+        if tracer.active:
             # One instant per shard with its static partition stats:
             # the honest per-shard facts a single-program dispatch
             # can report (per-shard wall time does not exist — the

@@ -1,6 +1,6 @@
 """Unified observability: tracing, metrics registry, engine telemetry.
 
-Six parts (docs/observability.md):
+Seven parts (docs/observability.md):
 
 - :mod:`.trace` — process-wide :data:`~pydcop_tpu.observability.trace.
   tracer` producing timestamped, parent-correlated spans with Chrome
@@ -14,7 +14,12 @@ Six parts (docs/observability.md):
 - :mod:`.profiler` — XLA cost attribution: measured flops/bytes/peak
   memory per compiled engine program;
 - :mod:`.server` — live HTTP telemetry endpoint (``/metrics``,
-  ``/healthz``, ``/events``) for scraping a running solve;
+  ``/healthz``, ``/events``, ``/debug/bundle``) for scraping a
+  running solve;
+- :mod:`.flight` — the always-on flight recorder: a bounded ring of
+  trace events (recording even while file tracing is off) that dumps
+  postmortem bundles on anomaly triggers (``PYDCOP_FLIGHT_RECORDER=0``
+  opts out);
 - the instrumentation wired through infrastructure, engine and
   resilience (all guarded on one flag check, zero overhead when off).
 
@@ -41,6 +46,17 @@ from pydcop_tpu.observability.trace import (  # noqa: F401
     get_tracer,
     tracer,
 )
+from pydcop_tpu.observability import flight as _flight
+from pydcop_tpu.observability.flight import (  # noqa: F401
+    FlightRecorder,
+    get_flight,
+)
+
+# The flight recorder is ALWAYS ON by default (PYDCOP_FLIGHT_RECORDER
+# =0 opts out): the black box only helps if it was recording before
+# the anomaly.  Ring-only until a trigger fires — nothing is written
+# to disk on the happy path.
+_flight.install()
 
 
 class ObservabilitySession:

@@ -66,6 +66,18 @@ def _format_value(value: float) -> str:
     return repr(float(value))
 
 
+def _exemplar_suffix(sample: str, cell: Optional[Tuple]) -> str:
+    """Append an OpenMetrics exemplar (``# {trace_id="..."} v ts``)
+    to a bucket sample line; plain Prometheus parsers that stop at
+    the value are unaffected, OpenMetrics-aware ones pick up the
+    trace link."""
+    if cell is None:
+        return sample
+    trace_id, value, unix = cell
+    return (f'{sample} # {{trace_id="{_escape(trace_id)}"}} '
+            f"{_format_value(value)} {unix:.3f}")
+
+
 class BoundMetric:
     """A metric handle with its label key pre-computed — the hot-path
     form of ``metric.inc(..., **labels)``."""
@@ -121,9 +133,20 @@ class Metric:
         with self._lock:
             return sorted(self._values.items())
 
-    def to_prometheus(self) -> List[str]:
-        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
-                 f"# TYPE {self.name} {self.kind}"]
+    def _family_name(self, openmetrics: bool) -> str:
+        """The HELP/TYPE family name.  OpenMetrics reserves ``_total``
+        as a counter SAMPLE suffix and forbids it in the family name
+        (family ``x`` exposes sample ``x_total``); the classic text
+        format keeps the full name in both places."""
+        if (openmetrics and self.kind == "counter"
+                and self.name.endswith("_total")):
+            return self.name[:-len("_total")]
+        return self.name
+
+    def to_prometheus(self, openmetrics: bool = False) -> List[str]:
+        family = self._family_name(openmetrics)
+        lines = [f"# HELP {family} {_escape_help(self.help)}",
+                 f"# TYPE {family} {self.kind}"]
         lines.extend(
             _format_sample(self.name, key, value)
             for key, value in self.samples()
@@ -172,7 +195,18 @@ class Gauge(Metric):
 
 
 class Histogram(Metric):
-    """Cumulative-bucket histogram (Prometheus semantics)."""
+    """Cumulative-bucket histogram (Prometheus semantics).
+
+    **Latency exemplars**: ``observe(value, exemplar=trace_id)``
+    remembers the last (trace_id, value, unix time) observed per
+    NATIVE bucket — the bucket the value lands in, not every
+    cumulative bucket above it — so a p99 spike in the exposition is
+    one hop from a concrete request trace (``pydcop trace query
+    --request <trace_id>``).  Exposed in the text exposition with the
+    OpenMetrics exemplar syntax (``... # {trace_id="..."} v ts``), in
+    :meth:`snapshot` (the ``/stats`` and JSONL form), and resolvable
+    by quantile via :meth:`quantile_exemplar`.
+    """
 
     kind = "histogram"
 
@@ -185,8 +219,18 @@ class Histogram(Metric):
         self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
         # key -> [per-bucket counts..., +Inf count, sum]
         self._hist: Dict[LabelKey, List[float]] = {}
+        # key -> [(trace_id, value, unix) or None] per native bucket
+        # (len(buckets) + 1: the last slot is the +Inf bucket).
+        self._exemplars: Dict[LabelKey, List[Optional[Tuple]]] = {}
 
-    def observe(self, value: float, **labels):
+    def _native_bucket(self, value: float) -> int:
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                return i
+        return len(self.buckets)
+
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels):
         key = _label_key(labels)
         with self._lock:
             entry = self._hist.get(key)
@@ -198,6 +242,13 @@ class Histogram(Metric):
                     entry[i] += 1
             entry[-2] += 1        # +Inf / total count
             entry[-1] += value    # sum
+            if exemplar is not None:
+                cells = self._exemplars.get(key)
+                if cells is None:
+                    cells = [None] * (len(self.buckets) + 1)
+                    self._exemplars[key] = cells
+                cells[self._native_bucket(value)] = (
+                    str(exemplar), float(value), time.time())
 
     def count(self, **labels) -> float:
         with self._lock:
@@ -209,29 +260,81 @@ class Histogram(Metric):
             entry = self._hist.get(_label_key(labels))
             return entry[-1] if entry else 0.0
 
-    def to_prometheus(self) -> List[str]:
+    def to_prometheus(self, openmetrics: bool = False) -> List[str]:
+        """Text exposition.  Exemplar suffixes are OPENMETRICS-ONLY
+        syntax: the classic Prometheus v0.0.4 text parser errors on
+        the ``#`` after a sample value (failing the whole scrape), so
+        they are appended only when the caller negotiated the
+        OpenMetrics content type (``Accept:
+        application/openmetrics-text`` on the /metrics endpoint)."""
         lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             items = sorted(self._hist.items())
+            exemplars = ({k: list(v)
+                          for k, v in self._exemplars.items()}
+                         if openmetrics else {})
         for key, entry in items:
+            cells = exemplars.get(key)
             for i, bound in enumerate(self.buckets):
                 bkey = key + (("le", _format_value(bound)),)
-                lines.append(_format_sample(
-                    f"{self.name}_bucket", tuple(sorted(bkey)), entry[i]
-                ))
+                sample = _format_sample(
+                    f"{self.name}_bucket", tuple(sorted(bkey)),
+                    entry[i])
+                lines.append(_exemplar_suffix(
+                    sample, cells[i] if cells else None))
             inf_key = tuple(sorted(key + (("le", "+Inf"),)))
-            lines.append(_format_sample(
-                f"{self.name}_bucket", inf_key, entry[-2]))
+            lines.append(_exemplar_suffix(
+                _format_sample(f"{self.name}_bucket", inf_key,
+                               entry[-2]),
+                cells[-1] if cells else None))
             lines.append(_format_sample(f"{self.name}_sum", key,
                                         entry[-1]))
             lines.append(_format_sample(f"{self.name}_count", key,
                                         entry[-2]))
         return lines
 
+    def quantile_exemplar(self, q: float, **labels
+                          ) -> Optional[Dict[str, Any]]:
+        """The exemplar of the bucket holding the q-quantile
+        observation (e.g. ``q=0.99`` → the p99 bucket), or the
+        nearest lower bucket holding one — None when nothing with an
+        exemplar was ever observed.  Returns ``{le, trace_id, value,
+        unix}``."""
+        key = _label_key(labels)
+        with self._lock:
+            entry = self._hist.get(key)
+            cells = self._exemplars.get(key)
+        if entry is None or cells is None or entry[-2] <= 0:
+            return None
+        rank = max(float(q), 0.0) * entry[-2]
+        target = len(self.buckets)  # +Inf slot by default
+        for i in range(len(self.buckets)):
+            if entry[i] >= rank:
+                target = i
+                break
+        les = ([_format_value(b) for b in self.buckets] + ["+Inf"])
+        # Prefer the quantile's own bucket; a cumulative count can
+        # cross the rank in a bucket whose native observations all
+        # lacked exemplars, so fall back to the nearest LOWER bucket
+        # that holds one (per the docstring contract — a p99 labeled
+        # with a slower-than-p99 exemplar would overstate the tail),
+        # and only then look above.
+        order = (list(range(target, -1, -1))
+                 + list(range(target + 1, len(cells))))
+        for i in order:
+            if cells[i] is not None:
+                trace_id, value, unix = cells[i]
+                return {"le": les[i], "trace_id": trace_id,
+                        "value": value, "unix": unix}
+        return None
+
     def snapshot(self) -> List[Dict[str, Any]]:
         with self._lock:
             items = sorted(self._hist.items())
+            exemplars = {k: list(v)
+                         for k, v in self._exemplars.items()}
+        les = [_format_value(b) for b in self.buckets] + ["+Inf"]
         return [
             {
                 "labels": dict(key),
@@ -240,6 +343,13 @@ class Histogram(Metric):
                 "buckets": {
                     _format_value(b): entry[i]
                     for i, b in enumerate(self.buckets)
+                },
+                "exemplars": {
+                    les[i]: {"trace_id": cell[0], "value": cell[1],
+                             "unix": cell[2]}
+                    for i, cell in enumerate(
+                        exemplars.get(key) or [])
+                    if cell is not None
                 },
             }
             for key, entry in items
@@ -298,10 +408,17 @@ class MetricsRegistry:
         with self._lock:
             return [self._metrics[n] for n in sorted(self._metrics)]
 
-    def to_prometheus(self) -> str:
+    def to_prometheus(self, openmetrics: bool = False) -> str:
+        """Text exposition; ``openmetrics=True`` switches to the
+        OpenMetrics dialect (histogram exemplar suffixes + the
+        mandatory ``# EOF`` terminator) — only for responses whose
+        content type was negotiated as
+        ``application/openmetrics-text``."""
         lines: List[str] = []
         for metric in self.metrics():
-            lines.extend(metric.to_prometheus())
+            lines.extend(metric.to_prometheus(openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n" if lines else ""
 
     def snapshot(self) -> Dict[str, Any]:
@@ -369,6 +486,22 @@ class CycleSnapshotter:
             if fn in cls._global_listeners:
                 cls._global_listeners.remove(fn)
 
+    @classmethod
+    def publish(cls, event: Dict[str, Any]):
+        """Push one event to every class-wide listener — the shared
+        fan-out behind the SSE ``/events`` stream.  Producers other
+        than the cycle snapshotters (the serve plane's
+        request-lifecycle events) publish here; listener errors are
+        swallowed like everywhere else (a dead subscriber must never
+        stall the producer)."""
+        with cls._global_lock:
+            listeners = list(cls._global_listeners)
+        for fn in listeners:
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 — never stall producers
+                pass
+
     def __init__(self, path: Optional[str] = None, every: int = 1,
                  reg: Optional[MetricsRegistry] = None,
                  cost_fn=None):
@@ -391,7 +524,12 @@ class CycleSnapshotter:
     def add_listener(self, fn):
         self._listeners.append(fn)
 
-    def __call__(self, cycle: int, cost: Optional[float] = None):
+    def __call__(self, cycle: int, cost: Optional[float] = None,
+                 **extra):
+        """Record one progress point.  ``extra`` (non-None values
+        only) rides into the snapshot event — the engine probe adds
+        its convergence-health signals (``residual``, ``flip_rate``)
+        here so the SSE stream carries them per chunk."""
         cycle = int(cycle)
         with self._lock:
             last = self._last
@@ -416,6 +554,9 @@ class CycleSnapshotter:
             self.registry.write_snapshot(self.path, cycle=cycle,
                                          cost=cost)
         event = {"ts": time.time(), "cycle": cycle, "cost": cost}
+        for k, v in extra.items():
+            if v is not None:
+                event[k] = v
         with self._global_lock:
             listeners = self._listeners + self._global_listeners
         for fn in listeners:

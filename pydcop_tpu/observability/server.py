@@ -148,10 +148,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — stdlib name
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
-            body = self.telemetry.registry.to_prometheus().encode()
+            # Content negotiation per the Prometheus convention: the
+            # classic v0.0.4 text parser errors on exemplar suffixes,
+            # so they only ride when the scraper explicitly Accepts
+            # the OpenMetrics dialect (Prometheus does exactly this
+            # when exemplar storage is enabled).
+            openmetrics = ("application/openmetrics-text"
+                           in self.headers.get("Accept", ""))
+            body = self.telemetry.registry.to_prometheus(
+                openmetrics=openmetrics).encode()
             self._reply(
                 200, body,
-                "text/plain; version=0.0.4; charset=utf-8")
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8" if openmetrics
+                else "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
             verdict = health_verdict()
             code = 503 if verdict.get("status") == "failing" else 200
@@ -159,9 +169,37 @@ class _Handler(BaseHTTPRequestHandler):
                         "application/json")
         elif path == "/events":
             self._stream_events()
+        elif path == "/debug/bundle":
+            self._debug_bundle()
         else:
             self._reply(404, b'{"error": "unknown path"}',
                         "application/json")
+
+    def _debug_bundle(self):
+        """Cut an on-demand postmortem bundle: written to the
+        recorder's bundle dir AND returned in the response (the
+        ``pydcop debug bundle`` client saves it locally) — the
+        operator gets the evidence even when the server host's disk
+        is not reachable."""
+        from pydcop_tpu.observability.flight import get_flight
+
+        recorder = get_flight()
+        if recorder is None:
+            self._reply(503,
+                        b'{"error": "flight recorder disabled '
+                        b'(PYDCOP_FLIGHT_RECORDER=0)"}',
+                        "application/json")
+            return
+        try:
+            doc = recorder.make_bundle("on_demand", {"via": "http"})
+            doc["path"] = recorder.write_bundle(doc)
+        except Exception as exc:  # noqa: BLE001 — probe must answer
+            self._reply(500, json.dumps(
+                {"error": f"bundle failed: {exc}"}).encode(),
+                "application/json")
+            return
+        self._reply(200, json.dumps(doc, default=str).encode(),
+                    "application/json")
 
     def _stream_events(self):
         self.send_response(200)
@@ -241,7 +279,12 @@ class TelemetryServer:
                 self._subscribers.remove(q)
 
     def _on_snapshot(self, event: Dict[str, Any]):
-        self.last_event = event
+        # One-off request-lifecycle events fan out live but must not
+        # occupy the replay slot: a client connecting mid-run is
+        # promised "the latest snapshot" (cycle/cost state), not the
+        # terminal phase of some unrelated already-finished request.
+        if event.get("event") != "request":
+            self.last_event = event
         with self._sub_lock:
             subscribers = list(self._subscribers)
         for q in subscribers:

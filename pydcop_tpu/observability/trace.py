@@ -31,10 +31,28 @@ distributed run collapses into a single well-nested Perfetto tab;
 :func:`diff_trace_summaries` compares two traces span-name by
 span-name (count/total/p50 deltas, regression flags) — the ``pydcop
 trace merge`` / ``trace diff`` commands drive both.
+
+Request-scoped causality (the serve plane): :meth:`Tracer.context`
+binds args (e.g. a request ``trace_id``, or a batch's ``trace_ids``)
+onto the CURRENT THREAD for the duration of a ``with`` block — every
+span and instant recorded inside carries them, so engine internals
+are tagged with the requests riding a dispatch without the engine
+knowing about requests.  :func:`query_request` filters a trace down
+to one request's events and rebuilds its span tree (``pydcop trace
+query --request ID``).
+
+Flight recorder: :meth:`Tracer.set_flight` attaches an always-on
+bounded ring (observability/flight.py) that receives events EVEN
+WHILE file tracing is off.  Sites whose events belong in a
+postmortem guard on ``tracer.active`` (true when either the session
+tracer or the flight ring wants events); per-message hot paths keep
+guarding on ``tracer.enabled`` so the ring holds signal, not message
+spam.
 """
 
 import itertools
 import json
+import math
 import os
 import socket
 import threading
@@ -135,6 +153,33 @@ class _Span:
         return False
 
 
+class _TraceContext:
+    """Pushes bound args for the current thread; see Tracer.context."""
+
+    __slots__ = ("_tracer", "args")
+
+    def __init__(self, tracer: "Tracer", args: Dict[str, Any]):
+        self._tracer = tracer
+        self.args = args
+
+    def __enter__(self):
+        local = self._tracer._ensure_local()
+        local.ctx_stack.append(self.args)
+        self._tracer._rebuild_ctx()
+        return self
+
+    def __exit__(self, *exc):
+        local = self._tracer._ensure_local()
+        # Remove by identity, not equality (two contexts may bind
+        # equal dicts), and survive an enable() that reset the stack
+        # mid-block.
+        local.ctx_stack[:] = [
+            a for a in local.ctx_stack if a is not self.args
+        ]
+        self._tracer._rebuild_ctx()
+        return False
+
+
 class Tracer:
     """Per-thread-buffered span/instant recorder.
 
@@ -142,10 +187,21 @@ class Tracer:
     session; :meth:`disable` stops recording (events stay readable for
     export); :meth:`events` / :meth:`export_chrome` /
     :meth:`export_jsonl` read them back.
+
+    ``active`` is the recording-wanted flag call sites guard on when
+    their events should also reach the flight recorder's always-on
+    ring: it is true while the session tracer is enabled OR a flight
+    ring is attached (:meth:`set_flight`).  ``enabled`` alone still
+    gates the per-message hot paths.
     """
 
     def __init__(self):
         self.enabled = False
+        # Attached flight ring (observability/flight.FlightRecorder)
+        # or None; ``active`` is kept in sync so hot sites pay one
+        # attribute check, not two.
+        self.flight = None
+        self.active = False
         self._lock = threading.Lock()
         self._local = threading.local()
         # (tid, thread name, buffer) per registered thread.
@@ -154,50 +210,108 @@ class Tracer:
         # buffer, so enable() drops stale events without touching
         # other threads' locals.
         self._generation = 0
+        # Monotone lane ids, independent of _buffers length: flight-
+        # only threads get a tid without a registration.
+        self._tid_counter = 0
         self._ids = itertools.count(1)
 
     # -- recording ----------------------------------------------------- #
 
-    def _buf(self) -> list:
+    def _ensure_local(self):
         if getattr(self._local, "gen", None) != self._generation:
             buf: list = []
             thread = threading.current_thread()
             self._local.buf = buf
             self._local.stack = []
+            # Context-binding state survives nothing across a
+            # generation bump: a fresh session starts unbound (open
+            # _TraceContext blocks re-register on exit harmlessly).
+            self._local.ctx_stack = []
+            self._local.ctx = {}
             self._local.gen = self._generation
             with self._lock:
                 # Synthetic tid, not thread.ident: the OS reuses
                 # idents once a thread exits (killed agents, repair
                 # threads), which would merge two threads' lanes and
                 # break span nesting within one exported lane.
-                tid = len(self._buffers) + 1
-                self._local.tid = tid
-                self._buffers.append((tid, thread.name, buf))
-        return self._local.buf
+                self._tid_counter += 1
+                self._local.tid = self._tid_counter
+                # Register the buffer for export ONLY while a file
+                # session is recording: in flight-only mode
+                # (enabled=False, ring attached — the production
+                # serve default) events go to the bounded ring and
+                # the buffer stays empty, so keeping a registration
+                # per short-lived thread (one HTTP handler thread
+                # per request) would grow _buffers without bound.
+                # enable() bumps the generation, so a thread first
+                # seen in flight-only mode re-registers here the
+                # moment a session starts.
+                if self.enabled:
+                    self._buffers.append(
+                        (self._local.tid, thread.name, buf))
+        return self._local
+
+    def _buf(self) -> list:
+        return self._ensure_local().buf
 
     def _stack(self) -> list:
-        self._buf()
-        return self._local.stack
+        return self._ensure_local().stack
+
+    def _rebuild_ctx(self):
+        local = self._ensure_local()
+        flat: Dict[str, Any] = {}
+        for args in local.ctx_stack:
+            flat.update(args)
+        local.ctx = flat
+
+    def context(self, **args) -> _TraceContext:
+        """Bind args onto every span/instant the CURRENT THREAD
+        records inside the ``with`` block (explicit event args win on
+        key collision).  The serve dispatch path binds the batch's
+        ``trace_ids`` here, so engine spans recorded underneath are
+        request-attributable without the engine knowing about
+        requests.  Nestable; inner bindings shadow outer ones."""
+        return _TraceContext(self, args)
 
     def _record(self, event: Dict[str, Any]):
-        if not self.enabled:
+        enabled = self.enabled
+        flight = self.flight
+        if not enabled and flight is None:
             return
-        buf = self._buf()
-        event["tid"] = self._local.tid
-        buf.append(event)
+        local = self._ensure_local()
+        ctx = local.ctx
+        if ctx:
+            # Merge INTO the existing args dict (explicit event args
+            # win) rather than replacing it: timed_jit_call mutates
+            # span.args after exit to attach measured XLA cost, and
+            # the recorded event must keep holding that same dict by
+            # reference or the attribution is silently lost whenever
+            # a trace context is bound (the serve dispatch path).
+            args = event.get("args")
+            if args is None:
+                event["args"] = dict(ctx)
+            else:
+                for k, v in ctx.items():
+                    args.setdefault(k, v)
+        event["tid"] = local.tid
+        if enabled:
+            local.buf.append(event)
+        if flight is not None:
+            flight.record(event)
 
     def span(self, name: str, cat: str = "default", **args) -> Any:
         """Context manager recording a complete span on exit.
 
-        Hot call sites should still guard on ``tracer.enabled`` so the
-        kwargs dict is never built while disabled."""
-        if not self.enabled:
+        Hot call sites should still guard on ``tracer.enabled`` (or
+        ``tracer.active`` for events that belong in flight-recorder
+        postmortems) so the kwargs dict is never built while off."""
+        if not self.active:
             return NOOP_SPAN
         return _Span(self, name, cat, args)
 
     def instant(self, name: str, cat: str = "default", **args):
         """Record a point-in-time event."""
-        if not self.enabled:
+        if not self.active:
             return
         parent = self._stack()
         self._record({
@@ -210,21 +324,57 @@ class Tracer:
             "args": args,
         })
 
+    def complete(self, name: str, cat: str = "default", *,
+                 t0: float, t1: float, **args):
+        """Record an already-finished span from explicit
+        ``perf_counter`` timestamps (seconds).  For intervals whose
+        start lived on no thread — a request's queue wait starts on
+        the submitting thread and ends on the scheduler thread; the
+        dispatcher records it retroactively here."""
+        if not self.active:
+            return
+        self._record({
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": float(t0) * _US,
+            "dur": max(float(t1) - float(t0), 0.0) * _US,
+            "id": next(self._ids),
+            "parent": 0,
+            "args": args,
+        })
+
     # -- lifecycle ----------------------------------------------------- #
 
+    def set_flight(self, recorder) -> None:
+        """Attach (or detach, with ``None``) the always-on flight
+        ring.  While attached, ``active`` stays true and every
+        recorded event is appended to the ring even when the session
+        tracer is disabled."""
+        self.flight = recorder
+        self.active = self.enabled or recorder is not None
+
     def enable(self):
-        """Start a fresh tracing session (previous events dropped)."""
+        """Start a fresh tracing session (previous events dropped).
+
+        ``_tid_counter`` is NOT reset: the flight ring outlives
+        sessions, and re-issuing tid 1.. to the new session's threads
+        would merge a pre-session thread's ring events with an
+        unrelated post-session thread's lane in a postmortem bundle."""
         with self._lock:
             self._generation += 1
             self._buffers = []
             self.enabled = True
+            self.active = True
 
     def disable(self):
         """Stop recording; buffered events stay readable for export."""
         self.enabled = False
+        self.active = self.flight is not None
 
     def clear(self):
-        """Drop all events; recording state unchanged."""
+        """Drop all events; recording state unchanged.  Lane ids keep
+        counting up (see :meth:`enable`)."""
         with self._lock:
             self._generation += 1
             self._buffers = []
@@ -346,6 +496,11 @@ def _parse_trace(path: str) -> Tuple[Optional[Dict[str, Any]],
         data = json.loads(text)
         if isinstance(data, dict):
             header = data.get(HEADER_KEY)
+            if header is not None and not isinstance(header, dict):
+                raise TraceFileError(
+                    f"trace file {path} has a corrupt header: "
+                    f"{HEADER_KEY} is {type(header).__name__}, "
+                    "not an object")
             events = data.get("traceEvents")
             if events is None:
                 if "ph" not in data:
@@ -366,6 +521,15 @@ def _parse_trace(path: str) -> Tuple[Optional[Dict[str, Any]],
                 row = json.loads(line)
             except json.JSONDecodeError:
                 if n == 1:
+                    if line.lstrip().startswith(
+                            '{"' + HEADER_KEY):
+                        # The exporter writes the header first, so a
+                        # process killed mid-write most often tears
+                        # exactly this line — name the failure.
+                        raise TraceFileError(
+                            f"trace file {path} has a truncated or "
+                            "corrupt header line (process died "
+                            "mid-export?)")
                     raise TraceFileError(
                         f"{path} is neither Chrome-trace JSON "
                         f"({exc}) nor JSONL (line 1 unparsable)"
@@ -376,6 +540,11 @@ def _parse_trace(path: str) -> Tuple[Optional[Dict[str, Any]],
                 )
             if isinstance(row, dict) and HEADER_KEY in row:
                 header = row[HEADER_KEY]
+                if not isinstance(header, dict):
+                    raise TraceFileError(
+                        f"trace file {path} has a corrupt header: "
+                        f"{HEADER_KEY} is {type(header).__name__}, "
+                        "not an object")
                 continue
             events.append(row)
     if not isinstance(events, list):
@@ -426,6 +595,58 @@ def load_trace_file(path: str) -> List[Dict[str, Any]]:
     return load_trace(path)[1]
 
 
+def _clock_anchor_offset(header: Optional[Dict[str, Any]],
+                         path: str) -> Optional[float]:
+    """The file's perf_counter→wall-clock rebase offset (µs), or
+    None for a legacy headerless/anchorless trace (degraded-merge
+    mode).  A header that CARRIES anchor fields but cannot yield a
+    finite offset — one field missing, a non-numeric value, NaN/Inf —
+    is corrupt, not legacy: raise a :class:`TraceFileError` naming
+    the file instead of letting a KeyError/ValueError escape
+    mid-merge."""
+    if not header:
+        return None
+    a_unix = header.get("anchor_unix_us")
+    a_perf = header.get("anchor_perf_us")
+    if a_unix is None and a_perf is None:
+        return None
+    try:
+        a_unix = float(a_unix)
+        a_perf = float(a_perf)
+    except (TypeError, ValueError):
+        raise TraceFileError(
+            f"trace file {path} has a corrupt clock anchor in its "
+            f"header (anchor_unix_us={header.get('anchor_unix_us')!r}"
+            f", anchor_perf_us={header.get('anchor_perf_us')!r})")
+    if not (math.isfinite(a_unix) and math.isfinite(a_perf)):
+        raise TraceFileError(
+            f"trace file {path} has a non-finite clock anchor in "
+            f"its header ({a_unix}, {a_perf})")
+    return a_unix - a_perf
+
+
+def _alignment_offsets(
+        loaded: Sequence[Tuple[str, Optional[Dict[str, Any]],
+                               List[Dict[str, Any]]]]
+) -> Tuple[List[float], List[bool]]:
+    """The shared alignment core of ``merge_traces`` and
+    ``load_events_aligned``: per-file rebase offsets (µs) plus which
+    files carried a clock anchor.  All anchored → wall-clock
+    offsets; any file anchorless (legacy) → degraded mode, every file
+    rebased to its own first event.  Raises :class:`TraceFileError`
+    (via :func:`_clock_anchor_offset`) on corrupt anchors."""
+    anchors = [_clock_anchor_offset(header, path)
+               for path, header, _ in loaded]
+    anchored = [off is not None for off in anchors]
+    if all(anchored):
+        return list(anchors), anchored
+    return [
+        -min((float(ev["ts"]) for ev in events if "ts" in ev),
+             default=0.0)
+        for _, _, events in loaded
+    ], anchored
+
+
 def merge_traces(paths: Sequence[str], out_path: str
                  ) -> Dict[str, Any]:
     """Align and merge N per-process trace files into one Chrome
@@ -464,23 +685,10 @@ def merge_traces(paths: Sequence[str], out_path: str
     for path in paths:
         header, events, names = _parse_trace(path)
         loaded.append((path, header, events, names))
-    anchored = [
-        bool(header and "anchor_unix_us" in header
-             and "anchor_perf_us" in header)
-        for _, header, _, _ in loaded
-    ]
+    offsets, anchored = _alignment_offsets(
+        [(path, header, events)
+         for path, header, events, _ in loaded])
     aligned = all(anchored)
-    offsets = []
-    for (path, header, events, _), has_anchor in zip(loaded, anchored):
-        if aligned:
-            offsets.append(float(header["anchor_unix_us"])
-                           - float(header["anchor_perf_us"]))
-        else:
-            # Degraded mode: rebase each file to its own first event.
-            offsets.append(-min(
-                (float(ev["ts"]) for ev in events if "ts" in ev),
-                default=0.0,
-            ))
     base = min(
         (float(ev["ts"]) + off
          for (_, _, events, _), off in zip(loaded, offsets)
@@ -579,6 +787,46 @@ def merge_traces(paths: Sequence[str], out_path: str
     }
 
 
+def load_events_aligned(paths: Sequence[str]
+                        ) -> List[Dict[str, Any]]:
+    """The in-memory form of :func:`merge_traces` for analysis
+    commands (``pydcop trace query`` over several per-process
+    files): one file loads as-is; several load rebased onto one axis
+    via their clock anchors (degrading to per-file zero like merge
+    when any input is anchorless) with lanes namespaced per file so
+    two processes' thread-1 lanes never collide.  Raises
+    :class:`TraceFileError` on unreadable files or corrupt
+    anchors."""
+    if not paths:
+        raise TraceFileError("no trace files given")
+    loaded = []
+    for path in paths:
+        header, events, _ = _parse_trace(path)
+        loaded.append((path, header, events))
+    if len(loaded) == 1:
+        return list(loaded[0][2])
+    offsets, _ = _alignment_offsets(loaded)
+    # Shift so the earliest event lands at ~0, exactly like
+    # merge_traces: wall-clock rebasing alone leaves epoch-scale µs
+    # timestamps, which would make the query output (ts_ms) unreadable
+    # for precisely the cross-process case this path exists for.
+    base = min(
+        (float(ev["ts"]) + off
+         for (_, _, events), off in zip(loaded, offsets)
+         for ev in events if "ts" in ev),
+        default=0.0)
+    out: List[Dict[str, Any]] = []
+    for fi, ((path, header, events), off) in enumerate(
+            zip(loaded, offsets)):
+        for ev in events:
+            row = dict(ev)
+            row["ts"] = float(ev.get("ts", 0.0)) + off - base
+            row["tid"] = f"{fi}:{ev.get('tid')}"
+            out.append(row)
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
 def _per_name_stats(events: Iterable[Dict[str, Any]]
                     ) -> Dict[str, Dict[str, float]]:
     durs: Dict[str, List[float]] = defaultdict(list)
@@ -665,6 +913,124 @@ def summarize_spans(events: Iterable[Dict[str, Any]],
     ]
     rows.sort(key=lambda r: (-r["total_ms"], -r["count"], r[by]))
     return rows[:top] if top else rows
+
+
+def event_matches_request(ev: Dict[str, Any],
+                          trace_id: str) -> bool:
+    """True when the event is tagged with this request's trace id —
+    either directly (``args.trace_id``, request-scoped events) or as
+    a member of a batch (``args.trace_ids``, the dispatch-context
+    tag every engine event under a serve dispatch inherits)."""
+    args = ev.get("args") or {}
+    if args.get("trace_id") == trace_id:
+        return True
+    ids = args.get("trace_ids")
+    return (isinstance(ids, (list, tuple))
+            and trace_id in ids)
+
+
+def query_request(events: Iterable[Dict[str, Any]],
+                  trace_id: str) -> Dict[str, Any]:
+    """One request's span tree out of a (possibly merged) trace.
+
+    Filters events tagged with ``trace_id`` (see
+    :func:`event_matches_request`) and rebuilds their causal tree:
+    within each thread lane, spans nest by time containment (the
+    per-thread span stack guarantees matched spans on one lane nest
+    properly); instants attach to the innermost containing span.
+    Lanes are stitched under one synthetic request root ordered by
+    time, so a request that crossed threads/processes (submit on an
+    HTTP handler, queue+dispatch+engine on the scheduler — rebased
+    lanes after a merge) still reads as a single tree.
+
+    Returns ``{trace_id, events, spans, instants, lanes, names,
+    well_nested, tree}`` — ``tree`` is a list of root nodes, each
+    ``{name, cat, ph, ts_ms, dur_ms, tid, args, children}``;
+    ``well_nested`` is False when the matched spans violate per-lane
+    nesting (a corrupted or mis-merged trace)."""
+    matched = [ev for ev in events
+               if ev.get("ph") in ("X", "i")
+               and event_matches_request(ev, trace_id)]
+    spans = [ev for ev in matched if ev.get("ph") == "X"]
+    instants = [ev for ev in matched if ev.get("ph") == "i"]
+    try:
+        check_well_nested(spans)
+        well_nested = True
+    except (ValueError, KeyError, TypeError):
+        well_nested = False
+
+    def _node(ev: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "name": ev.get("name"),
+            "cat": ev.get("cat", "default"),
+            "ph": ev.get("ph"),
+            "ts_ms": float(ev.get("ts", 0.0)) / 1000.0,
+            "dur_ms": float(ev.get("dur", 0.0)) / 1000.0,
+            "tid": ev.get("tid"),
+            "args": dict(ev.get("args") or {}),
+            "children": [],
+        }
+
+    roots: List[Dict[str, Any]] = []
+    by_tid: Dict[Any, List[Dict[str, Any]]] = defaultdict(list)
+    for ev in spans:
+        by_tid[ev.get("tid")].append(ev)
+    for tid_spans in by_tid.values():
+        tid_spans.sort(key=lambda e: (float(e.get("ts", 0.0)),
+                                      -float(e.get("dur", 0.0))))
+        stack: List[tuple] = []  # (end_ts, node)
+        for ev in tid_spans:
+            start = float(ev.get("ts", 0.0))
+            end = start + float(ev.get("dur", 0.0))
+            node = _node(ev)
+            while stack and start >= stack[-1][0] - 1.0:
+                stack.pop()
+            if stack:
+                stack[-1][1]["children"].append(node)
+            else:
+                roots.append(node)
+            stack.append((end, node))
+    # Instants: innermost containing matched span on the same lane,
+    # else a root of their own.
+    for ev in instants:
+        ts = float(ev.get("ts", 0.0))
+        tid = ev.get("tid")
+        best = None
+        best_span = None
+
+        def _walk(node):
+            nonlocal best, best_span
+            start = node["ts_ms"] * 1000.0
+            end = start + node["dur_ms"] * 1000.0
+            if (node["ph"] == "X" and node["tid"] == tid
+                    and start - 1.0 <= ts <= end + 1.0):
+                span_len = end - start
+                if best is None or span_len < best:
+                    best = span_len
+                    best_span = node
+            for child in node["children"]:
+                _walk(child)
+
+        for root in roots:
+            _walk(root)
+        node = _node(ev)
+        if best_span is not None:
+            best_span["children"].append(node)
+        else:
+            roots.append(node)
+    roots.sort(key=lambda n: n["ts_ms"])
+    for root in roots:
+        root["children"].sort(key=lambda n: n["ts_ms"])
+    return {
+        "trace_id": trace_id,
+        "events": len(matched),
+        "spans": len(spans),
+        "instants": len(instants),
+        "lanes": len({ev.get("tid") for ev in matched}),
+        "names": sorted({ev.get("name") for ev in matched}),
+        "well_nested": well_nested,
+        "tree": roots,
+    }
 
 
 def check_well_nested(events: Iterable[Dict[str, Any]]) -> None:

@@ -42,6 +42,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from pydcop_tpu.observability import flight
 from pydcop_tpu.observability.metrics import registry as metrics_registry
 from pydcop_tpu.observability.trace import tracer
 
@@ -348,14 +349,26 @@ class RecoveryRun:
             return self._rollback_shard_loss(violation)
         self.trips.append(violation)
         self._m_trips.inc(kind=violation.kind)
-        if tracer.enabled:
+        if tracer.active:
             tracer.instant("guard_trip", "resilience",
                            kind=violation.kind,
                            cycle=int(violation.cycle),
                            detail=violation.detail)
         self.attempts += 1
+        # Flight-recorder anomaly: the guard-trip escalation is
+        # black-box evidence whether or not the run survives it.
+        flight.trigger("guard_trip", trip_kind=violation.kind,
+                       cycle=int(violation.cycle),
+                       attempt=self.attempts,
+                       detail=violation.detail)
         if self.attempts > self.policy.max_restarts:
             partial = self._partial()
+            flight.trigger(
+                "recovery_exhausted", force=True,
+                trip_kind=violation.kind,
+                cycle=int(violation.cycle),
+                attempts=self.attempts,
+                last_valid_cycle=self.snapshot_cycle)
             raise RecoveryExhausted(
                 f"recovery budget exhausted after "
                 f"{self.policy.max_restarts} restarts; last trip: "
@@ -418,12 +431,15 @@ class RecoveryRun:
         """
         self.trips.append(violation)
         self._m_trips.inc(kind="shard_loss")
-        if tracer.enabled:
+        if tracer.active:
             tracer.instant("guard_trip", "resilience",
                            kind="shard_loss",
                            cycle=int(violation.cycle),
                            shard=violation.shard,
                            detail=violation.detail)
+        flight.trigger("shard_loss", shard=violation.shard,
+                       cycle=int(violation.cycle),
+                       detail=violation.detail)
         hook = getattr(self.engine, "repartition_after_loss", None)
         if hook is None:
             raise ValueError(
@@ -446,6 +462,10 @@ class RecoveryRun:
             try:
                 state = hook(violation.shard, self._snap_state)
             except NoSurvivingDevices as exc:
+                flight.trigger(
+                    "recovery_exhausted", force=True,
+                    trip_kind="shard_loss", shard=violation.shard,
+                    cycle=int(violation.cycle))
                 raise RecoveryExhausted(
                     f"no surviving devices after loss of shard "
                     f"{violation.shard} at cycle {violation.cycle}",

@@ -91,8 +91,17 @@ class AdmissionController:
     def record_dispatch(self, ok: bool) -> None:
         if ok:
             self.breaker.record_success()
-        else:
-            self.breaker.record_failure()
+            return
+        was_open = self.breaker.state == "open"
+        self.breaker.record_failure()
+        if not was_open and self.breaker.state == "open":
+            # The service just went 503: a postmortem bundle now
+            # holds the dispatch failures that tripped the circuit.
+            from pydcop_tpu.observability import flight
+
+            flight.trigger(
+                "breaker_open", breaker="serve_dispatch",
+                failure_threshold=self.policy.breaker_failures)
 
     @property
     def breaker_state(self) -> str:

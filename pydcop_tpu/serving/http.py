@@ -88,7 +88,8 @@ class _ServeHandler(_Handler):
                 result = service.result(rid)
                 if result is None:
                     self._json(202, {"id": rid,
-                                     "status": service.status(rid)})
+                                     "status": service.status(rid),
+                                     "trace_id": service.trace_id(rid)})
                     return
             except KeyError:
                 self._json(404, {"error": f"unknown request {rid!r}"})
@@ -175,7 +176,16 @@ class _ServeHandler(_Handler):
                 self._json(_result_code(result), result)
                 return
             # Fell through the wait window: hand back the id.
+        # The trace_id rides every ack: the client holds the handle
+        # that `pydcop trace query --request` takes without another
+        # round trip (a request may be gone from retention by the
+        # time anyone wants its trace).
+        try:
+            trace_id = service.trace_id(rid)
+        except KeyError:  # evicted already (tiny result_keep)
+            trace_id = None
         self._json(202, {"id": rid, "status": "queued",
+                         "trace_id": trace_id,
                          "result_url": f"/result/{rid}"})
 
 

@@ -193,7 +193,8 @@ class RequestJournal:
 def accepted_record(rid: str, dcop_yaml: str,
                     params: Dict[str, Any],
                     deadline_s: Optional[float] = None,
-                    t_submit: Optional[float] = None
+                    t_submit: Optional[float] = None,
+                    trace_id: Optional[str] = None
                     ) -> Dict[str, Any]:
     rec = {"kind": ACCEPTED, "id": rid, "dcop": dcop_yaml,
            "params": params}
@@ -201,6 +202,12 @@ def accepted_record(rid: str, dcop_yaml: str,
         rec["deadline_s"] = deadline_s
     if t_submit is not None:
         rec["t"] = t_submit
+    if trace_id:
+        # The request's causality key survives the crash with the
+        # record: a replayed request keeps its original trace_id, so
+        # `pydcop trace query` stitches pre- and post-crash spans
+        # into one request tree.
+        rec["trace_id"] = trace_id
     return rec
 
 

@@ -54,9 +54,11 @@ tolerance"):
 import contextlib
 import itertools
 import logging
+import os
 import queue
 import threading
 import time
+import uuid
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -64,6 +66,8 @@ from typing import Any, Dict, List, Optional
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.engine import batch as engine_batch
 from pydcop_tpu.engine.compile import compile_dcop
+from pydcop_tpu.observability import flight
+from pydcop_tpu.observability.metrics import CycleSnapshotter
 from pydcop_tpu.observability.metrics import registry as metrics_registry
 from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.serving import binning, journal as journal_mod
@@ -105,6 +109,11 @@ class SolveRequest:
     t_submit: float
     deadline_s: Optional[float] = None
     replayed: bool = False
+    # Request-scoped causality key: minted at submit, carried through
+    # the journal record, queue entry, dispatch context and every
+    # span/instant the request touches (docs/observability.md
+    # "Tracing a single request").
+    trace_id: str = ""
     status: str = QUEUED
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[Dict[str, Any]] = None
@@ -230,6 +239,14 @@ class SolveService:
             max_batch=self.max_batch)
         self._scheduler.start()
         self._started = True
+        if self._journal is not None:
+            # Journal backlog feeds the operator surfaces while the
+            # service runs: /healthz (replay debt before a restart)
+            # and postmortem bundles (what was pending at the
+            # anomaly).  The bound method is kept so stop() can
+            # identity-clear exactly this registration.
+            self._flight_provider = self.journal_summary
+            flight.set_journal_provider(self._flight_provider)
         if pending:
             self._replay(pending)
         return self
@@ -287,6 +304,11 @@ class SolveService:
                                    "service stopped before dispatch")
         replayable = 0
         if self._journal is not None:
+            # Identity-guarded: never strip a sibling journaled
+            # service's registration.
+            provider = getattr(self, "_flight_provider", None)
+            if provider is not None:
+                flight.clear_journal_provider(provider)
             # Every accepted-but-not-terminal request — whether still
             # queued or caught mid-collection in the scheduler — has
             # its accepted record on disk and no completion: the next
@@ -346,6 +368,12 @@ class SolveService:
         this returns — the id this hands back survives a process
         kill.
 
+        Every submit mints a ``trace_id`` (returned alongside the id
+        over the wire, journaled with the accepted record, stamped on
+        every span the request later touches) — ``pydcop trace query
+        --request <trace_id>`` reconstructs the request's span tree
+        from a trace file.
+
         Compilation happens HERE, on the submitting thread: structure
         errors surface synchronously, concurrent clients compile in
         parallel, and the scheduler thread stays dedicated to device
@@ -355,6 +383,17 @@ class SolveService:
         if not self._started:
             raise RuntimeError("SolveService is not started")
         t_submit = time.perf_counter()
+        trace_id = uuid.uuid4().hex[:16]
+        if not tracer.active:
+            return self._submit(dcop, params, request_id, deadline_s,
+                                t_submit, trace_id)
+        with tracer.span("serve_submit", "serving",
+                         trace_id=trace_id):
+            return self._submit(dcop, params, request_id, deadline_s,
+                                t_submit, trace_id)
+
+    def _submit(self, dcop: DCOP, params, request_id, deadline_s,
+                t_submit: float, trace_id: str) -> str:
         try:
             self.admission.admit(self._queue.qsize())
         except AdmissionRejected as rejection:
@@ -385,6 +424,7 @@ class SolveService:
                 dcop=dcop, graph=graph, meta=meta, params=merged,
                 bin=binning.bin_key(graph, merged),
                 t_submit=t_submit, deadline_s=deadline_s,
+                trace_id=trace_id,
             )
             with self._lock:
                 if req.id in self._requests:
@@ -405,7 +445,8 @@ class SolveService:
 
                 self._journal.append(journal_mod.accepted_record(
                     req.id, dcop_yaml(dcop), req.params,
-                    deadline_s=deadline_s, t_submit=t_submit))
+                    deadline_s=deadline_s, t_submit=t_submit,
+                    trace_id=trace_id))
                 self._journal_records.inc(kind="accepted")
             except Exception as exc:
                 with self._lock:
@@ -413,6 +454,11 @@ class SolveService:
                 self._req_total.inc(status="error")
                 raise RuntimeError(
                     f"request journal append failed: {exc}") from exc
+        # Published BEFORE the enqueue: once the request is in the
+        # queue the scheduler may dispatch (and even finish) it ahead
+        # of this thread's next line, and SSE clients are promised
+        # accepted → dispatched → finished in order.
+        self._publish_lifecycle("accepted", req)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -426,6 +472,9 @@ class SolveService:
             req.status = ERROR
             self._journal_done(req)
             self._req_total.inc(status="rejected_queue_full")
+            # The stream already saw "accepted": close the lifecycle
+            # out rather than leaving watchers waiting forever.
+            self._publish_lifecycle("error", req)
             raise QueueFullRace(
                 f"request queue full ({self._queue.maxsize})")
         self._queue_depth.set(self._queue.qsize())
@@ -442,9 +491,13 @@ class SolveService:
         than dropped."""
         from pydcop_tpu.dcop.yamldcop import load_dcop
 
+        # Replay start is black-box-worthy: the bundle shows what the
+        # crashed predecessor left behind (and the tail will show
+        # whether the replay itself went wrong).
+        flight.trigger("journal_replay", n_pending=len(records))
         span = (tracer.span("serve_replay", "serving",
                             n_pending=len(records))
-                if tracer.enabled else None)
+                if tracer.active else None)
         replayed = 0
         with (span if span is not None else contextlib.nullcontext()):
             for rec in records:
@@ -466,9 +519,19 @@ class SolveService:
                         t_submit=time.perf_counter(),
                         deadline_s=rec.get("deadline_s"),
                         replayed=True,
+                        # Keep the pre-crash causality key (pre-PR-9
+                        # journals have none: mint fresh).
+                        trace_id=(rec.get("trace_id")
+                                  or uuid.uuid4().hex[:16]),
                     )
                     with self._lock:
                         self._requests[req.id] = req
+                    # Replays re-enter the documented lifecycle from
+                    # the top: an SSE client that creates its
+                    # per-request state on "accepted" must see
+                    # replayed requests too.  Before the put, like
+                    # submit() — the scheduler may dispatch first.
+                    self._publish_lifecycle("accepted", req)
                     self._queue.put(req, timeout=30.0)
                 except Exception as exc:  # noqa: BLE001 — one bad
                     # record must not abort the rest of the replay.
@@ -496,9 +559,9 @@ class SolveService:
                         self._req_total.inc(status="error")
                     continue
                 replayed += 1
-                if tracer.enabled:
+                if tracer.active:
                     tracer.instant("serve_replay_request", "serving",
-                                   id=rid)
+                                   id=rid, trace_id=req.trace_id)
         self.replayed += replayed
         if replayed:
             self._replayed_total.inc(replayed)
@@ -532,6 +595,16 @@ class SolveService:
         if req is None:
             raise KeyError(request_id)
         return req.status
+
+    def trace_id(self, request_id: str) -> str:
+        """The request's causality key (the handle ``pydcop trace
+        query --request`` takes).  Raises ``KeyError`` for unknown
+        ids."""
+        with self._lock:
+            req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(request_id)
+        return req.trace_id
 
     def _prune_locked(self):
         """Evict oldest COMPLETED results past result_keep (pending
@@ -569,19 +642,42 @@ class SolveService:
         feeds the admission breaker, so one poison client cannot open
         the circuit for a healthy engine — while a genuinely down
         engine still fails every singleton and trips it."""
+        t_dequeue = time.perf_counter()
         for req in reqs:
             req.status = RUNNING
+            if tracer.active:
+                # The queue wait started on the submitting thread and
+                # ended here on the scheduler thread: record it
+                # retroactively from its explicit endpoints so the
+                # request tree shows time-in-queue as a real span.
+                tracer.complete(
+                    "serve_queued", "serving",
+                    t0=req.t_submit, t1=t_dequeue,
+                    trace_id=req.trace_id, request=req.id)
+            self._publish_lifecycle("dispatched", req)
         self._queue_depth.set(self._queue.qsize())
         self._dispatch_attempt(reqs, retry_depth=0)
 
     def _dispatch_attempt(self, reqs: List[SolveRequest],
                           retry_depth: int) -> None:
+        if not tracer.active:
+            return self._dispatch_attempt_inner(reqs, retry_depth)
+        # Thread-bound context: every span/instant recorded under
+        # this dispatch — serve_dispatch itself, the engine_segment
+        # inside run_stacked, jit_compile, shard instants — carries
+        # the batch's trace_ids without the engine knowing about
+        # requests.  `pydcop trace query --request ID` matches on it.
+        with tracer.context(trace_ids=[r.trace_id for r in reqs]):
+            return self._dispatch_attempt_inner(reqs, retry_depth)
+
+    def _dispatch_attempt_inner(self, reqs: List[SolveRequest],
+                                retry_depth: int) -> None:
         params = reqs[0].params
         span = (tracer.span(
             "serve_dispatch", "serving",
             bin=binning.bin_label(reqs[0].bin),
             n_real=len(reqs),
-            retry_depth=retry_depth) if tracer.enabled else None)
+            retry_depth=retry_depth) if tracer.active else None)
         try:
             with (span if span is not None
                   else contextlib.nullcontext()):
@@ -600,6 +696,14 @@ class SolveService:
                 logger.warning("serve dispatch failed (isolated "
                                "request %s): %s", reqs[0].id, exc)
                 self.admission.record_dispatch(ok=False)
+                if retry_depth > 0:
+                    # Bisection just isolated the poison request: the
+                    # black box should hold the whole bisection walk
+                    # and the innocent bin-mates' recovery.
+                    flight.trigger(
+                        "poison_bin", request=reqs[0].id,
+                        trace_id=reqs[0].trace_id,
+                        retry_depth=retry_depth, error=str(exc))
                 self._finish_error(reqs[0],
                                    f"dispatch failed: {exc}")
                 return
@@ -626,6 +730,7 @@ class SolveService:
         if pad_lanes:
             self._pad_waste.inc(pad_lanes)
         t_done = time.perf_counter()
+        converged_lanes = metrics.get("converged_lanes") or []
         for i, req in enumerate(reqs):
             # Per-request decode guard: one cost function that raises
             # on its own selected assignment must fail THAT request,
@@ -642,11 +747,14 @@ class SolveService:
                 continue
             req.result = {
                 "id": req.id,
+                "trace_id": req.trace_id,
                 "status": FINISHED,
                 "assignment": assignment,
                 "cost": cost,
                 "violations": violations,
                 "cycles": int(cycles[i]),
+                "converged": (bool(converged_lanes[i])
+                              if i < len(converged_lanes) else None),
                 "latency": {
                     "total_s": t_done - req.t_submit,
                     "dispatch_s": batch_result.time_s,
@@ -663,9 +771,15 @@ class SolveService:
             req.status = FINISHED
             self.completed += 1
             self._req_total.inc(status="ok")
-            self._latency.observe(t_done - req.t_submit)
+            # The exemplar makes the latency histogram navigable: the
+            # bucket this observation lands in remembers this
+            # trace_id, so a p99 spike in /metrics is one `pydcop
+            # trace query` away from the spans that produced it.
+            self._latency.observe(t_done - req.t_submit,
+                                  exemplar=req.trace_id)
             self._journal_done(req)
             req.done.set()
+            self._publish_lifecycle("finished", req)
 
     def _run_batch(self, reqs, params):
         """The device call, isolated for tests to stub failures."""
@@ -680,7 +794,8 @@ class SolveService:
 
     def _finish_error(self, req: SolveRequest, message: str):
         req.result = {
-            "id": req.id, "status": ERROR, "error": message,
+            "id": req.id, "trace_id": req.trace_id,
+            "status": ERROR, "error": message,
             "latency": {
                 "total_s": time.perf_counter() - req.t_submit,
             },
@@ -690,6 +805,7 @@ class SolveService:
         self._req_total.inc(status="error")
         self._journal_done(req)
         req.done.set()
+        self._publish_lifecycle("error", req)
 
     def _finish_expired(self, req: SolveRequest):
         """Terminal EXPIRED: the deadline passed before dispatch.  A
@@ -697,7 +813,8 @@ class SolveService:
         journaled terminal — an expired request must not resurrect on
         a --recover restart."""
         req.result = {
-            "id": req.id, "status": EXPIRED,
+            "id": req.id, "trace_id": req.trace_id,
+            "status": EXPIRED,
             "error": (f"deadline of {req.deadline_s}s exceeded "
                       "before dispatch"),
             "latency": {
@@ -709,6 +826,26 @@ class SolveService:
         self._req_total.inc(status="rejected_deadline")
         self._journal_done(req)
         req.done.set()
+        self._publish_lifecycle("expired", req)
+
+    def _publish_lifecycle(self, phase: str, req: SolveRequest):
+        """One request-lifecycle event onto the SSE ``/events``
+        stream (accepted → dispatched → finished / error / expired,
+        each carrying the trace_id) and, when tracing/flight is on, a
+        matching trace instant — a watching client follows a request
+        through the service in real time with the same id it would
+        hand to ``pydcop trace query``."""
+        if tracer.active:
+            tracer.instant(f"serve_{phase}", "serving",
+                           request=req.id, trace_id=req.trace_id)
+        CycleSnapshotter.publish({
+            "ts": time.time(),
+            "event": "request",
+            "phase": phase,
+            "id": req.id,
+            "trace_id": req.trace_id,
+            "status": req.status,
+        })
 
     def expire_if_overdue(self, req: SolveRequest) -> bool:
         """Scheduler hook: drop already-expired work BEFORE binning.
@@ -737,6 +874,29 @@ class SolveService:
 
     # -- introspection ------------------------------------------------- #
 
+    def journal_summary(self) -> Dict[str, Any]:
+        """Journal backlog, the operator's replay-debt gauge:
+        ``pending_replayable`` (accepted records with no terminal —
+        exactly what a ``--recover`` restart would replay right now)
+        and the journal's on-disk byte size.  Surfaced in /healthz
+        while a journaled service runs, and folded into postmortem
+        bundles (observability/flight.py's journal provider)."""
+        with self._lock:
+            pending = sum(1 for r in self._requests.values()
+                          if not r.done.is_set())
+        size = 0
+        if self._journal is not None:
+            try:
+                size = os.path.getsize(self._journal.path)
+            except OSError:
+                size = 0
+        return {
+            "dir": self.journal_dir,
+            "active": self._journal is not None,
+            "pending_replayable": pending,
+            "journal_bytes": size,
+        }
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             tracked = len(self._requests)
@@ -757,14 +917,27 @@ class SolveService:
             "max_batch": self.max_batch,
             "batch_window_s": self.batch_window_s,
             "bin_sizes": list(self.bin_sizes),
+            # The /stats face of the histogram exemplars: the p50/p99
+            # buckets' last-seen trace_ids, each resolvable by
+            # `pydcop trace query --request <trace_id>`.
+            "latency_exemplars": {
+                q: self._latency.quantile_exemplar(v)
+                for q, v in (("p50", 0.50), ("p99", 0.99))
+            },
         }
 
     def health_summary(self) -> Dict[str, Any]:
-        """The /healthz contribution: breaker open → failing (503)."""
+        """The /healthz contribution: breaker open → failing (503);
+        journaled services also report their replay debt
+        (``journal.pending_replayable`` / ``journal_bytes``) so an
+        operator sees what a restart would replay BEFORE restarting."""
         stats = self.stats()
         status = ("failing" if stats["breaker_state"] == "open"
                   else "ok")
-        return {"status": status, "serving": stats}
+        summary = {"status": status, "serving": stats}
+        if self._journal is not None:
+            summary["journal"] = self.journal_summary()
+        return summary
 
 
 class QueueFullRace(AdmissionRejected):

@@ -197,7 +197,21 @@ class TestTracer:
 class TestZeroOverheadWhenOff:
     """Disabled tracing must be one flag check: no events, no per-call
     span allocation (the shared NOOP singleton), instrumented hot
-    sites short-circuit."""
+    sites short-circuit.
+
+    Since PR 9 the always-on flight recorder keeps ``tracer.active``
+    true (events flow to its ring even while file tracing is off), so
+    the zero-overhead contract applies to the FULLY-off state: ring
+    detached AND session disabled.  The fixture detaches the default
+    ring for the duration; TestFlightRecorder covers the ring-attached
+    behavior."""
+
+    @pytest.fixture(autouse=True)
+    def _detach_flight(self):
+        saved = tracer.flight
+        tracer.set_flight(None)
+        yield
+        tracer.set_flight(saved)
 
     def test_span_returns_shared_noop_singleton(self):
         assert not tracer.enabled
@@ -240,7 +254,12 @@ _PROM_SAMPLE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
     r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
     r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
-    r" (-?[0-9.e+-]+|\+Inf)$"
+    r" (-?[0-9.e+-]+|\+Inf)"
+    # Optional OpenMetrics exemplar: ` # {trace_id="..."} value ts`
+    # (bucket samples carry one once anything observed with an
+    # exemplar — e.g. the serve plane's latency histogram).
+    r"( # \{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"\}"
+    r" -?[0-9.e+-]+( [0-9.]+)?)?$"
 )
 
 

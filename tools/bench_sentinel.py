@@ -119,6 +119,10 @@ def load_history(root: str) -> List[Dict[str, Any]]:
                 parsed.get("serve_recovery_replay_s")),
             "shard_recovery_value": _opt_float(
                 parsed.get("shard_recovery_s")),
+            # The p99 latency exemplar from the serving leg (ISSUE
+            # 9): when the newest run regresses, the report points at
+            # a concrete request trace instead of a bare number.
+            "exemplar": parsed.get("exemplar_trace_id"),
         })
     last_path = os.path.join(root, "BENCH_TPU_LAST.json")
     have_tpu_round = any(r.get("backend") == "tpu" for r in runs)
@@ -275,6 +279,18 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
             )
             if result["verdict"] == "regressed":
                 failed = True
+                # The exemplar is the SERVING leg's p99 latency
+                # trace_id — only the serve-latency family may point
+                # at it (a compile or shard regression has nothing to
+                # do with that request).
+                exemplar = (rows[-1].get("exemplar")
+                            if family == "serve" else None)
+                if exemplar:
+                    result["exemplar"] = exemplar
+                    lines.append(
+                        f"  ↳ exemplar trace {exemplar} — open it: "
+                        f"pydcop trace query --request {exemplar} "
+                        f"<trace file>")
     return {
         "root": root,
         "runs": len(runs),

@@ -38,7 +38,9 @@ Usage::
 ``make chaos-soak`` runs the full matrix; ``make test`` wires the
 ``--quick`` device-side gate (fixed seed, ~20 s): engine guard
 recovery, checkpoint corruption, guard purity, journal crash replay,
-poison-bin bisection, shard-loss repartition.
+poison-bin bisection, shard-loss repartition, and the anomaly
+postmortem (a guard trip with file tracing off must leave a
+flight-recorder bundle whose tail holds the triggering instant).
 """
 
 import argparse
@@ -528,6 +530,58 @@ def scenario_shard_trip_repartition(seed, trace):
             "shard_recovery_s": m["shard_recovery_s"]}
 
 
+def scenario_anomaly_postmortem(seed, trace):
+    """ISSUE 9 anomaly path: an injected guard trip, with file
+    tracing OFF and only the always-on flight recorder attached,
+    must leave a postmortem bundle on disk whose event tail contains
+    the triggering instant plus pre-anomaly engine context — the
+    black box works precisely when nobody was tracing."""
+    import glob
+    import json
+
+    from pydcop_tpu.algorithms.maxsum import build_engine
+    from pydcop_tpu.observability.flight import FlightRecorder
+    from pydcop_tpu.observability.trace import tracer
+    from pydcop_tpu.resilience.recovery import RecoveryPolicy
+
+    bundle_dir = tempfile.mkdtemp(prefix="soak_bundles_")
+    prev = tracer.flight
+    tracer.set_flight(FlightRecorder(events=512,
+                                     bundle_dir=bundle_dir))
+    try:
+        assert not tracer.enabled, \
+            "scenario requires file tracing OFF (black-box mode)"
+        dcop = ring_dcop()
+        res = build_engine(dcop, {}).run_checkpointed(
+            max_cycles=120, segment_cycles=7,
+            recovery=RecoveryPolicy(trip_cycles=(14,),
+                                    noise_seed=seed))
+    finally:
+        tracer.set_flight(prev)
+    assert res.metrics["guard_trips"] == 1
+    assert res.converged and res.assignment
+    assert_valid_assignment(dcop, res.assignment)
+    bundles = glob.glob(
+        os.path.join(bundle_dir, "bundle_guard_trip_*.json"))
+    assert len(bundles) == 1, \
+        f"expected exactly one guard-trip bundle, found {bundles}"
+    with open(bundles[0], encoding="utf-8") as f:
+        doc = json.load(f)
+    tail_names = [e["name"] for e in doc["events"]]
+    anomalies = [e for e in doc["events"] if e["name"] == "anomaly"]
+    assert anomalies, \
+        f"triggering instant missing from bundle tail: {tail_names}"
+    assert anomalies[-1]["args"]["kind"] == "guard_trip"
+    assert anomalies[-1]["args"]["cycle"] == 14
+    assert "engine_segment" in tail_names, \
+        "pre-anomaly engine context missing from the ring tail"
+    for section in ("metrics", "healthz", "env",
+                    "probe_diagnostics"):
+        assert section in doc, f"bundle missing {section} section"
+    return {"bundle": bundles[0],
+            "tail_events": len(doc["events"])}
+
+
 # Quick-gate ordering: the first 6 cover every failure class (kill
 # detection, engine recovery, partition healing, lossy links,
 # checkpoint corruption, guard purity).
@@ -543,6 +597,7 @@ SCENARIOS = [
     ("serve_journal_replay", scenario_serve_journal_replay),
     ("serve_poison_bin", scenario_serve_poison_bin),
     ("shard_trip_repartition", scenario_shard_trip_repartition),
+    ("anomaly_postmortem", scenario_anomaly_postmortem),
 ]
 
 # The `make test` gate (--quick): the DEVICE-SIDE failure classes —
@@ -560,6 +615,7 @@ QUICK_GATE = [
     "serve_journal_replay",
     "serve_poison_bin",
     "shard_trip_repartition",
+    "anomaly_postmortem",
 ]
 
 

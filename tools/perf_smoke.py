@@ -15,6 +15,11 @@ fast and the autotuner only makes valid choices —
 3. **Autotuner**: ``aggregation='auto'`` must pick one of the four
    named strategies (never "boundary" — numerics), record timings,
    and replay its decision from the JSON shape cache.
+4. **Flight-recorder overhead** (ISSUE 9 acceptance): the always-on
+   ring must cost <= 5% on the segmented-run benchmark — recorder
+   attached vs detached on the same warmed engine, min-of-N runs
+   (events reach the ring only at segment boundaries; the jitted
+   loop itself is untouched).
 
 Run:  python tools/perf_smoke.py      (exit 0 = all claims hold)
 """
@@ -169,12 +174,85 @@ def check_autotuner() -> dict:
             "timings_ms": info["aggregation_timings_ms"]}
 
 
+MAX_FLIGHT_OVERHEAD = 1.05  # on/off runtime ratio (<= 5%)
+
+
+def check_flight_overhead() -> dict:
+    """The ISSUE 9 perf gate: an attached flight ring may cost at
+    most 5% on the segmented-run benchmark.  Ring appends happen only
+    at segment boundaries (the jitted loop never sees the recorder),
+    so the measured ratio is noise-dominated — min-of-N per side,
+    best-of-3 attempts, exactly like the compile checks above."""
+    from pydcop_tpu.algorithms.maxsum import build_engine
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.observability.flight import FlightRecorder
+    from pydcop_tpu.observability.trace import tracer
+
+    rng = np.random.default_rng(7)
+    d = Domain("c", "", [0, 1, 2])
+    dcop = DCOP("flight_bench", objective="min")
+    vs = [Variable(f"v{i}", d) for i in range(12)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(12):
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[k], vs[(k + 1) % 12]],
+            rng.integers(0, 10, size=(3, 3)).astype(float),
+            f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    engine = build_engine(dcop, {})
+    kw = dict(max_cycles=600, segment_cycles=5,
+              stop_on_convergence=False)
+    prev = tracer.flight
+    tracer.set_flight(None)
+
+    def timed() -> float:
+        t0 = time.perf_counter()
+        engine.run_checkpointed(**kw)
+        return time.perf_counter() - t0
+
+    try:
+        timed()  # warm the jit cache once, outside the clock
+        ratio = float("inf")
+        t_off = t_on = None
+        # Bundle dir never written on the happy path: ring only.
+        ring = FlightRecorder(events=2048)
+        for _ in range(4):
+            offs, ons = [], []
+            # Interleave off/on runs pairwise: a phase of all-off
+            # followed by a phase of all-on lets CPU frequency drift
+            # masquerade as recorder overhead; alternating gives both
+            # sides the same noise exposure, min-of-N filters upward
+            # excursions.
+            for _rep in range(5):
+                tracer.set_flight(None)
+                offs.append(timed())
+                tracer.set_flight(ring)
+                ons.append(timed())
+            tracer.set_flight(None)
+            t_off, t_on = min(offs), min(ons)
+            ratio = min(ratio, t_on / t_off)
+            if ratio <= MAX_FLIGHT_OVERHEAD:
+                break
+    finally:
+        tracer.set_flight(prev)
+    assert ratio <= MAX_FLIGHT_OVERHEAD, (
+        f"flight recorder costs {(ratio - 1) * 100:.1f}% on the "
+        f"segmented run (budget {(MAX_FLIGHT_OVERHEAD - 1) * 100:.0f}"
+        f"%): off {t_off * 1e3:.0f}ms -> on {t_on * 1e3:.0f}ms")
+    return {"off_ms": round(t_off * 1e3, 1),
+            "on_ms": round(t_on * 1e3, 1),
+            "overhead": round(ratio - 1, 4)}
+
+
 def main() -> int:
     results = {}
     for name, check in (
         ("vectorized_compile", check_vectorized_compile),
         ("structure_cache", check_structure_cache),
         ("autotuner", check_autotuner),
+        ("flight_overhead", check_flight_overhead),
     ):
         try:
             results[name] = check()

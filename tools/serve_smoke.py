@@ -24,6 +24,13 @@ Starts the real service on port 0 and drives it over HTTP:
    signal makes the serve process drain and exit 0, logging the
    drained/replayable counts — accepted work is never silently
    dropped.
+5. **Request-scoped tracing** (ISSUE 9 acceptance): a real-HTTP
+   batched burst is traced; ``pydcop trace query --request ID`` (the
+   REAL CLI, on the exported trace) must return a single well-nested
+   tree holding the submit, queue, ``serve_dispatch`` and
+   ``engine_segment`` spans all tagged with that request's trace_id —
+   and the p99 bucket of ``pydcop_request_latency_seconds`` must
+   expose an exemplar trace_id resolvable by the same query.
 
 Run:  python tools/serve_smoke.py      (exit 0 = all claims hold)
 """
@@ -405,8 +412,141 @@ def leg_sigterm_drain():
           f"left replayable ({len(pending)}) — zero dropped")
 
 
+TRACE_BURST = 5
+
+
+def leg_request_tracing():
+    """ISSUE 9 acceptance: per-request causality over real HTTP.
+
+    A traced batched burst must leave every request reconstructable:
+    ``pydcop trace query --request ID`` (the real CLI, against the
+    exported trace file) returns ONE well-nested tree whose spans
+    cover submit → queue → serve_dispatch → engine_segment, all
+    tagged with that request's trace_id; and the latency histogram's
+    p99 bucket carries an exemplar trace_id the SAME query resolves."""
+    import re as _re
+
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.observability.trace import tracer
+
+    trace_path = os.path.join(
+        tempfile.mkdtemp(prefix="serve_trace_"), "serve.jsonl")
+    tracer.enable()
+    handle = api.serve(port=0, batch_window_s=0.3, max_batch=8,
+                       max_queue=32)
+    try:
+        url = handle.url
+        payloads = [dcop_yaml(build_instance(10, 900 + i))
+                    for i in range(TRACE_BURST)]
+        results = [None] * TRACE_BURST
+
+        def client(i):
+            results[i] = post(url, {
+                "dcop": payloads[i], "wait": True, "timeout": 120,
+                "params": {"max_cycles": MAX_CYCLES},
+            })
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(TRACE_BURST)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        check(all(r is not None and r[0] == 200
+                  and r[1]["status"] == "FINISHED" for r in results),
+              f"traced burst of {TRACE_BURST} completed")
+        trace_ids = [r[1].get("trace_id") for r in results]
+        check(all(trace_ids) and len(set(trace_ids)) == TRACE_BURST,
+              "every response carries a distinct trace_id")
+        stats = handle.service.stats()
+        check(stats["batched_dispatches"] >= 1,
+              "traced burst was genuinely batched "
+              f"({stats['batched_dispatches']} multi-instance "
+              "dispatch(es))")
+
+        # p99 exemplar: on the exposition AND resolvable below.
+        # Exemplars are OpenMetrics-only syntax — negotiate the
+        # dialect the way a real Prometheus with exemplar storage
+        # does; the classic text format must stay exemplar-free.
+        om_req = urllib.request.Request(
+            url + "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(om_req, timeout=30) as resp:
+            check("openmetrics-text" in resp.headers["Content-Type"],
+                  "negotiated scrape answers as OpenMetrics")
+            exposition = resp.read().decode()
+        check(exposition.rstrip().endswith("# EOF"),
+              "OpenMetrics exposition carries the # EOF terminator")
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=30) as resp:
+            classic = resp.read().decode()
+        check(" # {" not in classic,
+              "classic text-format scrape stays exemplar-free "
+              "(v0.0.4 parsers reject exemplar suffixes)")
+        ex = _re.search(
+            r'pydcop_request_latency_seconds_bucket\{[^}]*\}'
+            r' \S+ # \{trace_id="([0-9a-f]+)"\}', exposition)
+        check(ex is not None,
+              "latency histogram exposes an OpenMetrics exemplar")
+        with urllib.request.urlopen(url + "/stats",
+                                    timeout=30) as resp:
+            svc_stats = json.loads(resp.read())
+        p99 = (svc_stats.get("latency_exemplars") or {}).get("p99")
+        check(p99 is not None and p99["trace_id"] in trace_ids,
+              "p99 latency exemplar names a burst trace_id "
+              f"({p99 and p99['trace_id']})")
+    finally:
+        handle.stop()
+        tracer.export_jsonl(trace_path)
+        tracer.disable()
+
+    def query(trace_id: str) -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "trace",
+             "query", "--request", trace_id, "--json", trace_path],
+            capture_output=True, timeout=120)
+        check(proc.returncode == 0,
+              f"pydcop trace query --request {trace_id} exits 0")
+        return json.loads(proc.stdout)
+
+    tree = query(trace_ids[0])
+    check(tree["well_nested"],
+          "queried request tree is well-nested")
+    names = set(tree["names"])
+    for needed in ("serve_submit", "serve_queued", "serve_dispatch",
+                   "engine_segment"):
+        check(needed in names,
+              f"request tree contains a {needed} span "
+              f"(names: {sorted(names)})")
+
+    def _flat(nodes):
+        for node in nodes:
+            yield node
+            yield from _flat(node["children"])
+
+    for node in _flat(tree["tree"]):
+        args = node["args"]
+        tagged = (args.get("trace_id") == trace_ids[0]
+                  or trace_ids[0] in (args.get("trace_ids") or []))
+        check(tagged, f"{node['name']} span tagged with the "
+              "request's trace_id")
+    # The p99 exemplar is one hop from its trace: the SAME query
+    # resolves the trace_id the histogram exposed.
+    ex_tree = query(p99["trace_id"])
+    check(ex_tree["events"] > 0 and ex_tree["well_nested"]
+          and "engine_segment" in ex_tree["names"],
+          "p99 exemplar trace_id resolves to a full request tree "
+          f"({ex_tree['events']} events)")
+
+
 def main() -> int:
     t0 = time.perf_counter()
+    # The tracing leg runs FIRST: the latency histogram is process-
+    # global, so its p99-exemplar assertion needs the burst to be the
+    # only traffic observed so far.  The other in-process legs only
+    # read per-service stats or scrape deltas — order-independent.
+    leg_request_tracing()
     leg_coalescing()
     leg_overload()
     leg_kill9_replay()

@@ -55,7 +55,11 @@ agents: [a0, a1, a2, a3]
 """
 
 _PROM_SAMPLE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (-?[0-9.e+-]+|\+Inf)$"
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (-?[0-9.e+-]+|\+Inf)"
+    # Optional OpenMetrics exemplar suffix on bucket samples
+    # (`# {trace_id="..."} value ts`) — present once anything
+    # observed a histogram with an exemplar.
+    r"( # \{[^}]*\} -?[0-9.e+-]+( [0-9.]+)?)?$"
 )
 
 
@@ -137,8 +141,14 @@ def main() -> int:
         if err:
             return fail(err)
 
+        # 6. Request-scoped tracing (ISSUE 9): a served burst leaves
+        # every request reconstructable by `pydcop trace query`.
+        err = check_request_tracing(os.path.join(tmp, "serve.jsonl"))
+        if err:
+            return fail(err)
+
     print("trace_demo: OK (trace + metrics + summary + live "
-          "endpoint all validate)")
+          "endpoint + request query all validate)")
     return 0
 
 
@@ -226,6 +236,89 @@ def check_live_endpoint(dcop_file: str):
     finally:
         done.wait(60)
         server.stop()
+    return None
+
+
+def check_request_tracing(trace_path: str):
+    """ISSUE 9 gate: serve a 3-request burst with tracing on, then
+    `pydcop trace query --request ID` (the real CLI, on the exported
+    trace) must reconstruct ONE well-nested tree whose spans cover
+    submit → queue → dispatch → engine, all tagged with that
+    request's trace_id.  Returns an error string or None."""
+    import contextlib
+    import io
+
+    import numpy as np
+
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.dcop_cli import main as cli_main
+    from pydcop_tpu.observability.trace import tracer
+    from pydcop_tpu.serving.service import SolveService
+
+    def instance(seed):
+        rng = np.random.default_rng(seed)
+        dom = Domain("c", "", [0, 1, 2])
+        dcop = DCOP(f"demo{seed}", objective="min")
+        vs = [Variable(f"v{i}", dom) for i in range(6)]
+        for v in vs:
+            dcop.add_variable(v)
+        for k in range(6):
+            dcop.add_constraint(NAryMatrixRelation(
+                [vs[k], vs[(k + 1) % 6]],
+                rng.integers(0, 10, size=(3, 3)).astype(float),
+                f"c{k}"))
+        dcop.add_agents([AgentDef("a0")])
+        return dcop
+
+    tracer.enable()
+    svc = SolveService(batch_window_s=0.2, max_batch=4)
+    svc.start()
+    try:
+        rids = [svc.submit(instance(100 + i),
+                           params={"max_cycles": 40})
+                for i in range(3)]
+        trace_ids = []
+        for rid in rids:
+            result = svc.result(rid, wait=60.0)
+            if result is None or result["status"] != "FINISHED":
+                return f"burst request {rid} did not finish: {result}"
+            trace_ids.append(result["trace_id"])
+        if len(set(trace_ids)) != 3:
+            return f"trace_ids not distinct: {trace_ids}"
+    finally:
+        svc.stop(drain=False)
+        tracer.export_jsonl(trace_path)
+        tracer.disable()
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli_main(["trace", "query", "--request", trace_ids[0],
+                       "--json", trace_path])
+    if rc != 0:
+        return f"pydcop trace query exited {rc}"
+    tree = json.loads(out.getvalue())
+    if not tree["well_nested"]:
+        return "queried request tree is not well-nested"
+    names = set(tree["names"])
+    needed = {"serve_submit", "serve_queued", "serve_dispatch",
+              "engine_segment"}
+    if not needed <= names:
+        return (f"request tree missing spans: "
+                f"{sorted(needed - names)} (have {sorted(names)})")
+
+    def flat(nodes):
+        for node in nodes:
+            yield node
+            yield from flat(node["children"])
+
+    for node in flat(tree["tree"]):
+        args = node["args"]
+        if not (args.get("trace_id") == trace_ids[0]
+                or trace_ids[0] in (args.get("trace_ids") or [])):
+            return (f"{node['name']} span not tagged with the "
+                    "request's trace_id")
     return None
 
 
