@@ -565,6 +565,107 @@ def _bench_sharded_forced():
                              "no result line"}
 
 
+# Time-to-target-cost leg (ISSUE 10): the headline number of the
+# work-reduction stack.  A loopy LARGE-DOMAIN coloring (the regime
+# branch-and-bound pruning targets) is traced once to find the
+# reference cost — the final (converged-and-frozen) cost of the
+# fixed-budget run itself, deterministic for the fixed seed; the
+# timed quantity is a warmed PRUNED run of the full TTC_CYCLES budget
+# (the serving dispatch shape: batched dispatches never early-exit,
+# so the budget wall IS the time the answer takes).  This changes
+# what the bench optimizes from cycles/sec to wall-clock to a known
+# solution quality — judged by tools/bench_sentinel.py as a
+# lower-is-better family per backend.
+TTC_N_VARS = 240
+TTC_DOMAIN = 128
+TTC_EDGE_FACTOR = 1.5
+TTC_CYCLES = 160
+TTC_UNARY_SPREAD = 400
+
+
+def build_ttc_graph(seed: int = 11):
+    """Loopy D=TTC_DOMAIN coloring with integer unary costs, built
+    directly as arrays (same recipe as bench_scale): equality penalty
+    1 per edge, unary integers in [0, TTC_UNARY_SPREAD) — integer
+    tables keep the pruned trajectory bit-identical to dense
+    (ops/maxsum)."""
+    from pydcop_tpu.engine.compile import (
+        BIG,
+        CompiledFactorGraph,
+        FactorBucket,
+    )
+
+    rng = np.random.default_rng(seed)
+    n_factors = int(TTC_N_VARS * TTC_EDGE_FACTOR)
+    var_ids = rng.integers(
+        0, TTC_N_VARS, size=(n_factors, 2)).astype(np.int32)
+    loop = var_ids[:, 0] == var_ids[:, 1]
+    var_ids[loop, 1] = (var_ids[loop, 0] + 1) % TTC_N_VARS
+    eye = np.eye(TTC_DOMAIN, dtype=np.float32)
+    costs = np.ascontiguousarray(np.broadcast_to(
+        eye, (n_factors, TTC_DOMAIN, TTC_DOMAIN))).copy()
+    var_costs = np.full((TTC_N_VARS + 1, TTC_DOMAIN), BIG, np.float32)
+    var_costs[:-1] = rng.integers(
+        0, TTC_UNARY_SPREAD,
+        size=(TTC_N_VARS, TTC_DOMAIN)).astype(np.float32)
+    var_valid = np.zeros((TTC_N_VARS + 1, TTC_DOMAIN), bool)
+    var_valid[:-1] = True
+    return CompiledFactorGraph(
+        var_costs=var_costs, var_valid=var_valid,
+        buckets=(FactorBucket(costs, var_ids),))
+
+
+def bench_time_to_cost():
+    """{maxsum_time_to_cost_ms, ...}: wall-clock to the reference cost
+    under the SERVING dispatch shape — a fixed ``TTC_CYCLES`` budget
+    with no convergence stop (batched dispatches never early-exit:
+    engine/batch.run_stacked), so the request's time-to-answer IS the
+    full-budget wall and the reference cost is the budget run's final
+    (converged-and-frozen) cost.  The pruned trajectory is
+    bit-identical to dense, so the ratio against ``ttc_dense_ms``
+    isolates the per-cycle work reduction: after the transient the
+    survivor sets collapse and most of the budget runs the compacted
+    kernel.  Never kills the headline line (caller wraps)."""
+    from functools import partial
+
+    import jax
+
+    from pydcop_tpu.engine.timing import sync, timed_call
+    from pydcop_tpu.ops import maxsum as ops
+
+    graph = jax.device_put(build_ttc_graph())
+    trace_fn = jax.jit(partial(
+        ops.run_maxsum_trace, max_cycles=TTC_CYCLES,
+        stop_on_convergence=False))
+    _state, _values, costs = sync(trace_fn(graph))
+    costs = np.asarray(costs)
+    ref = float(costs[-1])
+    below = np.nonzero(costs <= ref)[0]
+    cycles_to_ref = int(below[0]) + 1 if below.size else TTC_CYCLES
+
+    def timed_run(prune: bool) -> float:
+        fn = jax.jit(partial(
+            ops.run_maxsum, max_cycles=TTC_CYCLES,
+            stop_on_convergence=False, prune=prune))
+        sync(fn(graph))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            _out, elapsed = timed_call(fn, graph)
+            best = min(best, elapsed)
+        return best
+
+    pruned_s = timed_run(True)
+    dense_s = timed_run(False)
+    return {
+        "maxsum_time_to_cost_ms": round(pruned_s * 1e3, 2),
+        "ttc_dense_ms": round(dense_s * 1e3, 2),
+        "ttc_ref_cost": ref,
+        "ttc_cycles": cycles_to_ref,
+        "ttc_n_vars": TTC_N_VARS,
+        "ttc_domain": TTC_DOMAIN,
+    }
+
+
 # Serving-throughput leg: closed-loop clients firing small random
 # coloring DCOPs at the solve service (pydcop_tpu/serving).  Small
 # problems + several structures is the multi-tenant traffic shape the
@@ -923,6 +1024,16 @@ def run_bench():
                 "scale_smoke_error":
                     f"{type(exc).__name__}: {exc}"[:200],
             }
+    # Time-to-target-cost leg (both backends — the work-reduction
+    # stack's headline; sentinel family "time_to_cost", lower is
+    # better).  Never kills the headline line.
+    try:
+        ttc_keys = bench_time_to_cost()
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: time-to-cost leg failed ({exc}); continuing",
+              file=sys.stderr)
+        ttc_keys = {"maxsum_time_to_cost_ms": None,
+                    "ttc_error": f"{type(exc).__name__}: {exc}"[:200]}
     # Serving-throughput leg (both backends: the request plane exists
     # on the CPU fallback too, and its trajectory is what the
     # sentinel tracks per backend).  Never kills the headline line.
@@ -991,6 +1102,7 @@ def run_bench():
         ),
         **roofline,
         **scale_keys,
+        **ttc_keys,
         **serve_keys,
         **shard_keys,
     }

@@ -63,11 +63,28 @@ algo_params = [
         "start_messages", "str", ["leafs", "leafs_vars", "all"], "all"
     ),
     # Device-path extension beyond the reference: decimation
-    # (arXiv:1706.02209) — alternate message passing with clamping the
-    # most confident variables, warm-restarting between rounds.  0
-    # disables (reference behavior); > 0 enables with that fraction
-    # (in %) of variables fixed per round.
+    # (arXiv:1706.02209) — message passing alternating with clamping
+    # the most confident variables at segment boundaries, the clamped
+    # problem warm-starting from the surviving messages
+    # (engine/runner.DecimationPlan).  0 disables (reference
+    # behavior); > 0 enables with that fraction (in %) of variables
+    # fixed per round.
     AlgoParameterDef("decimation", "int", None, 0),
+    # Margin-threshold decimation: clamp ONLY variables whose belief
+    # margin (best vs second-best value gap) exceeds this — converged
+    # parts of the graph stop paying for message updates while
+    # undecided regions keep iterating.  0 disables; combine with
+    # decimation:N to cap the per-round clamp fraction.
+    AlgoParameterDef("decimation_margin", "float", None, 0.0),
+    # Branch-and-bound message pruning (arXiv:1906.06863;
+    # ops/maxsum.prune_tables): per-edge running bounds mask dominated
+    # hypercube rows out of the binary factor->variable
+    # min-aggregation and a compacted reduction does ~D/K of the dense
+    # work once the survivors fit the static budget.  Results are
+    # IDENTICAL to the unpruned kernel (bit-identical on integer
+    # tables — gated in make perf-smoke); wins on large domains
+    # (D >= ~32), edge layout only.
+    AlgoParameterDef("prune", "bool", None, False),
     # Variable-aggregation strategy for the superstep (device path;
     # see engine/compile.build_aggregation_arrays).  "scatter" is the
     # parity default; "sorted" and "ell" (padded dense-gather edge
@@ -125,26 +142,9 @@ def _replay_auto_choice(dcop: DCOP):
 
     Returns ``(aggregation, agg_info_or_None)``.
     """
-    import jax
+    from pydcop_tpu.engine.autotune import cached_choice, dcop_shape_key
 
-    from pydcop_tpu.engine.autotune import cached_choice, shape_key
-
-    variables = list(dcop.variables.values())
-    counts: dict = {}
-    degree: dict = {}
-    for c in dcop.constraints.values():
-        if c.arity == 0:
-            continue
-        counts[c.arity] = counts.get(c.arity, 0) + 1
-        for v in c.dimensions:
-            degree[v.name] = degree.get(v.name, 0) + 1
-    key = shape_key(
-        jax.default_backend(),
-        len(variables),
-        max((len(v.domain) for v in variables), default=1),
-        sorted(counts.items()),
-        max(degree.values(), default=0),
-    )
+    key = dcop_shape_key(dcop)
     choice = cached_choice(key)
     if choice is None:
         return "scatter", None
@@ -153,6 +153,29 @@ def _replay_auto_choice(dcop: DCOP):
         "aggregation_source": "cache",
         "aggregation_key": key,
     }
+
+
+def decimation_plan_from_params(params: dict):
+    """Resolve the ``decimation`` / ``decimation_margin`` params into
+    an :class:`~pydcop_tpu.engine.runner.DecimationPlan` (None = off).
+
+    ``decimation:N`` alone is the classic schedule — top-N% of free
+    variables by belief margin clamped per round until everything is
+    fixed.  ``decimation_margin:M`` switches to threshold mode — only
+    variables whose margin exceeds M clamp (capped at N% per round
+    when both are given; uncapped otherwise), and nothing is forced,
+    so an undecided graph keeps message passing untouched."""
+    n = int(params.get("decimation", 0) or 0)
+    margin = float(params.get("decimation_margin", 0.0) or 0.0)
+    if n <= 0 and margin <= 0:
+        return None
+    from pydcop_tpu.engine.runner import DecimationPlan
+
+    return DecimationPlan(
+        margin=margin,
+        frac_per_round=(n / 100.0) if n > 0 else 1.0,
+        force_progress=margin <= 0,
+    )
 
 
 def build_engine(dcop: DCOP, params: dict, mesh=None,
@@ -186,7 +209,7 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
             raise ValueError(
                 "layout='lane' is single-device; the partitioned "
                 "engine uses the edge layout")
-        if int(params.get("decimation", 0) or 0) > 0:
+        if decimation_plan_from_params(params) is not None:
             raise ValueError(
                 "decimation clamps the single-device var_costs "
                 "table; run without shards=")
@@ -207,6 +230,7 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
             damping=params.get("damping", 0.5),
             damping_nodes=params.get("damping_nodes", "both"),
             stability=params.get("stability", STABILITY_COEFF),
+            prune=bool(params.get("prune", False)),
         )
     pad_to = 1
     if mesh is not None:
@@ -266,6 +290,7 @@ def build_engine(dcop: DCOP, params: dict, mesh=None,
         stability=params.get("stability", STABILITY_COEFF),
         mesh=mesh, n_devices=n_devices,
         layout=params.get("layout", "edge"),
+        prune=bool(params.get("prune", False)),
     )
     if agg_info is not None:
         engine.extra_metrics.update(agg_info)
@@ -282,14 +307,19 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
     params = algo_def.params
     engine = build_engine(dcop, params, mesh=mesh,
                           n_devices=n_devices, shards=shards)
-    decimation = int(params.get("decimation", 0) or 0)
-    if decimation > 0:
-        # warmup is a no-op here: run_decimated is a multi-round
-        # host-driven loop whose metrics['cycles_per_s'] already
-        # excludes compile time; re-running the whole solve would
-        # double wall time for nothing.
-        return engine.run_decimated(
-            max_cycles=max_cycles, frac=decimation / 100.0,
+    plan = decimation_plan_from_params(params)
+    if plan is not None:
+        # Decimation is the SEGMENTED mode: clamping happens at the
+        # boundaries the engine already syncs on (zero new syncs in
+        # the jitted loop), and the clamp set rides snapshots and
+        # recovery retains.  warmup is a no-op here: the segmented
+        # runner's metrics['cycles_per_s'] already excludes compile
+        # time; re-running the whole solve would double wall time for
+        # nothing.
+        return engine.run_checkpointed(
+            max_cycles=max_cycles,
+            segment_cycles=plan.cycles_per_round,
+            decimation=plan,
         )
     run = partial(
         engine.run, max_cycles=max_cycles,

@@ -59,6 +59,16 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
     backend="thread": agent-mode runtime (threads + in-process messages),
     reference-equivalent semantics.
 
+    ``algo_def="auto"`` (device backend) races the whole-algorithm
+    portfolio on the compiled graph — maxsum with/without
+    branch-and-bound pruning and decimation, plus the vectorized
+    local-search kernels (dsa/mgm/gdba) — toward the best cost
+    reachable in a short budget, solves with the winner, and caches
+    the decision by structure signature
+    (engine/autotune.autotune_portfolio): a second same-structure
+    solve replays the choice with zero measurement.  The decision and
+    per-candidate timings land in ``metrics['portfolio']``.
+
     Scaling knobs (docs/sharding.md): ``n_devices`` row-shards factor
     buckets over a mesh with replicated variable tables (any device
     algorithm; per-superstep all-reduce is O(V·D)); ``shards=N``
@@ -147,6 +157,14 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
         >>> res['status'], round(res['cost'], 3)
         ('FINISHED', 0.0)
     """
+    portfolio_info = None
+    if isinstance(algo_def, str) and algo_def == "auto":
+        if backend != "device":
+            raise ValueError(
+                "algo='auto' races device kernels: use "
+                "backend='device'")
+        algo_def, portfolio_info = _resolve_auto_algo(
+            dcop, algo_params or {})
     if isinstance(algo_def, str):
         algo_def = AlgorithmDef.build_with_default_param(
             algo_def, algo_params or {}, mode=dcop.objective
@@ -207,7 +225,7 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
 
         with tracer.span("solve", "api", algo=algo_def.algo,
                          backend=backend, max_cycles=max_cycles):
-            return _solve(
+            result = _solve(
                 dcop, algo_def, module, distribution=distribution,
                 backend=backend, timeout=timeout,
                 max_cycles=max_cycles, mesh=mesh, n_devices=n_devices,
@@ -224,9 +242,58 @@ def solve(dcop: DCOP, algo_def: Union[str, AlgorithmDef],
                 metrics_file=metrics_file, metrics_every=metrics_every,
                 serving=serve_metrics is not None,
             )
+            if portfolio_info is not None:
+                result.setdefault("metrics", {})[
+                    "portfolio"] = portfolio_info
+            return result
     finally:
         if session is not None:
             session.finish()
+
+
+def _resolve_auto_algo(dcop: DCOP, algo_params: Dict[str, Any]):
+    """Resolve ``algo="auto"`` through the portfolio racer: replay a
+    persisted same-structure decision when one exists (no re-race —
+    asserted in the work-reduction battery), otherwise compile once
+    and race the candidates on the real graph.  Returns
+    ``(AlgorithmDef, info)`` with the winner's extra params merged
+    over the caller's."""
+    from pydcop_tpu.engine.autotune import (
+        PORTFOLIO_PARAMS,
+        autotune_portfolio,
+        cached_portfolio_choice,
+        dcop_portfolio_key,
+    )
+
+    key = dcop_portfolio_key(dcop)
+    choice = cached_portfolio_choice(key)
+    if choice is not None:
+        info = {"algo": choice, "portfolio_source": "cache",
+                "portfolio_key": key}
+    else:
+        from pydcop_tpu.engine.compile import compile_dcop
+
+        graph, meta = compile_dcop(
+            dcop, noise_level=float(
+                algo_params.get("noise", 0.01) or 0.0))
+        info = autotune_portfolio(graph, key=key, meta=meta)
+    algo, extra = PORTFOLIO_PARAMS[info["algo"]]
+    module = load_algorithm_module(algo)
+    allowed = {p.name for p in module.algo_params}
+    params = {k: v for k, v in algo_params.items() if k in allowed}
+    dropped = sorted(set(algo_params) - set(params))
+    if dropped:
+        # The caller parameterized for one family; the race picked
+        # another.  Dropping (loudly) beats failing the solve — the
+        # caller asked for "whatever wins".
+        import logging
+
+        logging.getLogger("pydcop.api").warning(
+            "algo='auto' winner %s does not take parameter(s) %s; "
+            "ignored", algo, ", ".join(dropped))
+    params.update(extra)
+    return AlgorithmDef.build_with_default_param(
+        algo, params, mode=dcop.objective), info
 
 
 class ServeHandle:
@@ -401,21 +468,28 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
         initialize_multihost()
         t0 = time.perf_counter()
         # The engine probe needs chunk boundaries, so an observed solve
-        # routes through the same segmented loop checkpointing uses.
-        # Excluded: decimation (its host-driven clamping rounds are a
-        # different loop) and warmup=True (the segmented loop has no
-        # discarded warm-up call, and silently dropping a requested
-        # steady-state measurement would be worse than losing the
-        # cost curve) — both fall back to the plain path, which still
-        # traces the overall device_solve span.
+        # routes through the same segmented loop checkpointing uses —
+        # and decimation IS a segmented mode now (clamping happens at
+        # those same boundaries), so decimated solves checkpoint,
+        # recover and probe like any other.  Excluded: warmup=True
+        # (the segmented loop has no discarded warm-up call, and
+        # silently dropping a requested steady-state measurement would
+        # be worse than losing the cost curve) — it falls back to the
+        # plain path, which still traces the overall device_solve span
+        # and routes decimation through solve_on_device's own
+        # segmented call.
+        decim_plan = None
+        if hasattr(module, "decimation_plan_from_params"):
+            decim_plan = module.decimation_plan_from_params(
+                algo_def.params)
         probed = (
             observing
             and not warmup
             and hasattr(module, "build_engine")
-            and not algo_def.params.get("decimation")
         )
         if checkpoint_dir is not None or probed \
-                or recovery is not None:
+                or recovery is not None \
+                or (decim_plan is not None and not warmup):
             if not hasattr(module, "build_engine"):
                 raise NotImplementedError(
                     f"Algorithm {algo_def.algo} has no segmentable "
@@ -452,20 +526,25 @@ def _solve(dcop, algo_def, module, *, distribution, backend, timeout,
                     checkpoint_dir, every=checkpoint_every or 100,
                     keep=checkpoint_keep,
                 )
+            elif decim_plan is not None:
+                # Decimation rounds set the boundary cadence unless
+                # an explicit metrics cadence asks for finer points.
+                segment_cycles = (metrics_every
+                                  or decim_plan.cycles_per_round)
             else:
                 segment_cycles = metrics_every or 100
             if resume:
                 res = resume_from_checkpoint(
                     engine, manager, max_cycles=max_cycles,
                     probe=probe, checkpoint_async=checkpoint_async,
-                    recovery=recovery,
+                    recovery=recovery, decimation=decim_plan,
                 )
             else:
                 res = engine.run_checkpointed(
                     max_cycles=max_cycles, manager=manager,
                     segment_cycles=segment_cycles, probe=probe,
                     checkpoint_async=checkpoint_async,
-                    recovery=recovery,
+                    recovery=recovery, decimation=decim_plan,
                 )
             if probe is not None:
                 from pydcop_tpu.observability.engine_probe import (

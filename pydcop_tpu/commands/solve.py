@@ -21,7 +21,12 @@ def set_parser(subparsers):
         "solve", help="solve a static DCOP")
     parser.add_argument("dcop_files", nargs="+", help="dcop yaml file(s)")
     parser.add_argument("-a", "--algo", required=True,
-                        help="algorithm name")
+                        help="algorithm name, or 'auto' (device mode) "
+                             "to race the whole-algorithm portfolio "
+                             "on the compiled graph and solve with "
+                             "the winner — decision cached by "
+                             "structure signature "
+                             "(docs/performance.md)")
     parser.add_argument("-p", "--algo_params", action="append",
                         help="algorithm parameter as name:value")
     parser.add_argument("-d", "--distribution", default="oneagent",
@@ -189,7 +194,20 @@ def run_cmd(args) -> int:
         trace_file, trace_format = args.trace, args.trace_format
 
     dcop = load_dcop_from_file(args.dcop_files)
-    algo_def = build_algo_def(args.algo, args.algo_params, dcop.objective)
+    if args.algo == "auto":
+        # api.solve resolves the portfolio (race or cached replay)
+        # and builds the winner's AlgorithmDef itself.
+        from pydcop_tpu.commands._utils import parse_algo_params
+
+        if args.mode != "device":
+            raise ValueError(
+                "--algo auto races device kernels: use --mode device")
+        algo_def = "auto"
+        auto_params = parse_algo_params(args.algo_params)
+    else:
+        algo_def = build_algo_def(
+            args.algo, args.algo_params, dcop.objective)
+        auto_params = None
 
     if (args.checkpoint_dir or args.resume) and args.mode != "device":
         raise ValueError(
@@ -263,6 +281,7 @@ def run_cmd(args) -> int:
         with profile_ctx:
             res = solve(
                 dcop, algo_def, backend="device",
+                algo_params=auto_params,
                 max_cycles=args.cycles, n_devices=args.n_devices,
                 shards=args.shards,
                 checkpoint_dir=args.checkpoint_dir,
@@ -295,8 +314,10 @@ def run_cmd(args) -> int:
         # equivalent single trace (host-driven clamping rounds), so
         # they only get the final summary row.
         if (args.run_metrics and args.collect_on == "cycle_change"
+                and not isinstance(algo_def, str)
                 and algo_def.algo in ("maxsum", "amaxsum")
-                and not algo_def.params.get("decimation")):
+                and not algo_def.params.get("decimation")
+                and not algo_def.params.get("decimation_margin")):
             from pydcop_tpu.algorithms.maxsum import build_engine
             from pydcop_tpu.commands.metrics_io import add_csvline
 

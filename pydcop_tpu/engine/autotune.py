@@ -110,6 +110,33 @@ def graph_shape_key(graph: CompiledFactorGraph,
     )
 
 
+def dcop_shape_key(dcop, backend: Optional[str] = None) -> str:
+    """Shape key computed from a DCOP directly (variable/domain
+    counts, per-arity factor counts, max scope degree) — identical to
+    :func:`graph_shape_key` of its compiled graph at ``pad_to=1``, so
+    persisted decisions replay BEFORE compiling."""
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    variables = list(dcop.variables.values())
+    counts: Dict[int, int] = {}
+    degree: Dict[str, int] = {}
+    for c in dcop.constraints.values():
+        if c.arity == 0:
+            continue
+        counts[c.arity] = counts.get(c.arity, 0) + 1
+        for v in c.dimensions:
+            degree[v.name] = degree.get(v.name, 0) + 1
+    return shape_key(
+        backend,
+        len(variables),
+        max((len(v.domain) for v in variables), default=1),
+        sorted(counts.items()),
+        max(degree.values(), default=0),
+    )
+
+
 def cached_choice(key: str,
                   cache_file: Optional[str] = None) -> Optional[str]:
     """Replay a persisted decision for ``key`` (None on miss/invalid)
@@ -302,5 +329,265 @@ def autotune_aggregation(graph: CompiledFactorGraph, *,
             "aggregation": choice,
             "aggregation_timings_ms": timings_ms,
             "backend": backend,
+        }})
+    return result
+
+
+# --------------------------------------------------------------------- #
+# Whole-algorithm portfolio racer (ISSUE 10): the micro-timing pattern
+# above, generalized from aggregation strategies to whole kernels.
+# Each candidate races a short budget of cycles ON THE REAL COMPILED
+# GRAPH; the winner is the fastest candidate whose final cost reaches
+# the best cost any candidate achieved (within tolerance) — i.e. the
+# decision optimizes time-to-target-cost, not cycles/sec.  Decisions
+# persist in the same JSON shape cache as the aggregation autotuner
+# (distinct key prefix), so a same-structure re-solve replays with
+# zero measurement — api.solve(algo="auto") and the serving dispatch
+# path both consume the cached decision.
+
+# Candidate order IS the deterministic tie-break (parity-default
+# maxsum first).
+PORTFOLIO_CANDIDATES = (
+    "maxsum", "maxsum_prune", "maxsum_decim", "dsa", "mgm", "gdba",
+)
+
+# Winner -> (algorithm name, extra algo_params) for api.solve.
+PORTFOLIO_PARAMS = {
+    "maxsum": ("maxsum", {}),
+    "maxsum_prune": ("maxsum", {"prune": True}),
+    "maxsum_decim": ("maxsum", {"decimation": 10}),
+    "dsa": ("dsa", {}),
+    "mgm": ("mgm", {}),
+    "gdba": ("gdba", {}),
+}
+
+_PORTFOLIO_PREFIX = f"portfolio-v{_CACHE_VERSION}|"
+
+# Candidates whose final cost must come within this fraction of the
+# best achieved cost (plus an absolute epsilon for zero-cost targets)
+# to be eligible on time.
+_PORTFOLIO_COST_TOL = 0.02
+_PORTFOLIO_RACE_CYCLES = 60
+
+
+def portfolio_key(shape: str) -> str:
+    return _PORTFOLIO_PREFIX + shape
+
+
+def dcop_portfolio_key(dcop, backend: Optional[str] = None) -> str:
+    return portfolio_key(dcop_shape_key(dcop, backend))
+
+
+def cached_portfolio_choice(key: str,
+                            cache_file: Optional[str] = None
+                            ) -> Optional[str]:
+    """Replay a persisted portfolio decision (None on miss/invalid)."""
+    cached = _load_cache(cache_file or cache_path()).get(key)
+    if isinstance(cached, dict) \
+            and cached.get("algo") in PORTFOLIO_CANDIDATES:
+        return cached["algo"]
+    return None
+
+
+def _portfolio_runners(graph: CompiledFactorGraph, race_cycles: int,
+                       meta=None):
+    """Build (name -> zero-arg callable returning final cost) over the
+    placed graph.  Each callable is self-contained and warmed by its
+    first invocation; the caller times the second.
+
+    ``meta`` (a FactorGraphMeta) makes the mgm/gdba race use the SAME
+    lexical-name tie-break ranks the deployed winner would
+    (algorithms/mgm.lexic_ranks) — a race with different tie-breaks
+    would persist a decision about a trajectory the winner never
+    runs.  Without meta, index order with the +inf sentinel is the
+    closest stand-in."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from pydcop_tpu.ops import dsa as dsa_ops
+    from pydcop_tpu.ops import gdba as gdba_ops
+    from pydcop_tpu.ops import maxsum as maxsum_ops
+    from pydcop_tpu.ops import mgm as mgm_ops
+    from pydcop_tpu.ops.localsearch import assignment_cost
+
+    placed = jax.device_put(graph)
+    n_rows = graph.var_costs.shape[0]
+    if meta is not None:
+        from pydcop_tpu.algorithms.mgm import lexic_ranks
+
+        ranks = jnp.asarray(lexic_ranks(meta))
+    else:
+        ranks = jnp.concatenate([
+            jnp.arange(n_rows - 1, dtype=jnp.float32),
+            jnp.asarray([jnp.inf], dtype=jnp.float32),
+        ])
+
+    def cost_of(values):
+        full = jnp.concatenate(
+            [values, jnp.zeros((1,), values.dtype)])
+        return assignment_cost(placed, full)
+
+    def maxsum_runner(prune: bool):
+        fn = jax.jit(lambda g: cost_of(maxsum_ops.run_maxsum(
+            g, race_cycles, stop_on_convergence=False,
+            prune=prune)[1]))
+        return lambda: float(fn(placed))
+
+    def decim_runner():
+        half = max(race_cycles // 2, 1)
+        first = jax.jit(lambda g: maxsum_ops.run_maxsum(
+            g, half, stop_on_convergence=False))
+        margin_fn = jax.jit(_belief_margin)
+        rest = jax.jit(lambda g, s: cost_of(
+            maxsum_ops.run_maxsum_from(
+                g, s, half, stop_on_convergence=False)[1]))
+
+        def run():
+            state, values = first(placed)
+            margin = np.asarray(margin_fn(placed, state))
+            vals = np.asarray(jax.device_get(values))
+            var_costs = np.asarray(
+                jax.device_get(placed.var_costs)).copy()
+            n_vars = var_costs.shape[0] - 1
+            k = max(1, n_vars // 5)
+            chosen = np.argsort(-margin, kind="stable")[:k]
+            d = var_costs.shape[1]
+            from pydcop_tpu.engine.compile import BIG
+
+            for i in chosen:
+                keep = int(vals[i])
+                row = np.full((d,), BIG, var_costs.dtype)
+                row[keep] = var_costs[i, keep]
+                var_costs[i] = row
+            g2 = placed._replace(
+                var_costs=jax.device_put(var_costs))
+            state = state._replace(stable=jnp.asarray(False))
+            return float(rest(g2, state))
+
+        return run
+
+    def ls_runner(run_fn, **kw):
+        fn = jax.jit(partial(run_fn, max_cycles=race_cycles, **kw))
+        return lambda: float(fn(placed)[1])
+
+    return {
+        "maxsum": maxsum_runner(False),
+        "maxsum_prune": maxsum_runner(True),
+        "maxsum_decim": decim_runner(),
+        "dsa": ls_runner(dsa_ops.run_dsa),
+        "mgm": ls_runner(mgm_ops.run_mgm, lexic_ranks=ranks),
+        "gdba": ls_runner(gdba_ops.run_gdba, lexic_ranks=ranks),
+    }
+
+
+def _belief_margin(graph, state):
+    import jax.numpy as jnp
+
+    from pydcop_tpu.ops import maxsum as maxsum_ops
+
+    beliefs, _ = maxsum_ops.aggregate_beliefs(graph, state.f2v)
+    masked = jnp.where(graph.var_valid, beliefs, jnp.inf)[:-1]
+    best2 = jnp.sort(masked, axis=1)[:, :2]
+    return best2[:, 1] - best2[:, 0]
+
+
+def autotune_portfolio(graph: CompiledFactorGraph, *,
+                       key: Optional[str] = None,
+                       race_cycles: int = _PORTFOLIO_RACE_CYCLES,
+                       use_cache: bool = True,
+                       cache_file: Optional[str] = None,
+                       candidates=PORTFOLIO_CANDIDATES,
+                       meta=None,
+                       ) -> Dict[str, Any]:
+    """Race whole algorithm kernels on ``graph`` toward a cost target.
+
+    Every candidate runs ``race_cycles`` cycles (warmed — compile
+    excluded; honest sync through the host fetch of the scalar cost);
+    the target cost is the best final cost any candidate achieved, and
+    the winner is the fastest candidate within ``_PORTFOLIO_COST_TOL``
+    of it — deterministic tie-break by candidate order (parity-default
+    maxsum first).  A candidate that fails to build/run is dropped
+    with a note, never fatal (maxsum always runs).
+
+    Returns ``{"algo", "portfolio_source", "portfolio_timings_ms",
+    "portfolio_costs", "portfolio_target_cost", "portfolio_key"}``;
+    persists the decision under ``key`` in the shared JSON shape
+    cache (``portfolio_source`` is ``"cache"`` on replay — asserted
+    against re-racing in the work-reduction battery)."""
+    import time as _time
+
+    if key is None:
+        key = portfolio_key(graph_shape_key(graph))
+    path = cache_file or cache_path()
+    if use_cache:
+        cached = _load_cache(path).get(key)
+        if isinstance(cached, dict) \
+                and cached.get("algo") in PORTFOLIO_CANDIDATES:
+            return {
+                "algo": cached["algo"],
+                "portfolio_source": "cache",
+                "portfolio_timings_ms": cached.get(
+                    "portfolio_timings_ms", {}),
+                "portfolio_costs": cached.get("portfolio_costs", {}),
+                "portfolio_target_cost": cached.get(
+                    "portfolio_target_cost"),
+                "portfolio_key": key,
+            }
+
+    runners = _portfolio_runners(graph, race_cycles, meta=meta)
+    timings_ms: Dict[str, Optional[float]] = {}
+    costs: Dict[str, Optional[float]] = {}
+    notes: Dict[str, str] = {}
+    for name in candidates:
+        runner = runners.get(name)
+        if runner is None:
+            continue
+        try:
+            runner()  # warm: compile + one discarded run
+            t0 = _time.perf_counter()
+            cost = runner()
+            timings_ms[name] = round(
+                (_time.perf_counter() - t0) * 1e3, 4)
+            costs[name] = cost
+        except Exception as e:  # noqa: BLE001 — drop the candidate
+            notes[name] = f"{type(e).__name__}"
+            logger.warning("portfolio: %s failed to race: %s",
+                           name, e)
+            timings_ms[name] = None
+            costs[name] = None
+
+    scored = {n: (costs[n], timings_ms[n]) for n in candidates
+              if costs.get(n) is not None
+              and timings_ms.get(n) is not None}
+    if not scored:
+        choice = "maxsum"
+        target = None
+    else:
+        target = min(c for c, _ in scored.values())
+        tol = abs(target) * _PORTFOLIO_COST_TOL + 1e-9
+        eligible = {n: t for n, (c, t) in scored.items()
+                    if c <= target + tol}
+        order = {n: i for i, n in enumerate(candidates)}
+        choice = min(eligible, key=lambda n: (eligible[n], order[n]))
+    result = {
+        "algo": choice,
+        "portfolio_source": "measured",
+        "portfolio_timings_ms": timings_ms,
+        "portfolio_costs": costs,
+        "portfolio_target_cost": target,
+        "portfolio_key": key,
+    }
+    if notes:
+        result["portfolio_notes"] = notes
+    if use_cache:
+        import jax
+
+        _store_cache(path, {key: {
+            "algo": choice,
+            "portfolio_timings_ms": timings_ms,
+            "portfolio_costs": costs,
+            "portfolio_target_cost": target,
+            "backend": jax.default_backend(),
         }})
     return result

@@ -111,14 +111,21 @@ def pad_to_bin(
     jax.jit,
     static_argnames=(
         "max_cycles", "damping", "damp_vars", "damp_factors",
-        "stability",
+        "stability", "prune",
     ),
 )
 def _batched_solve(stacked, *, max_cycles, damping, damp_vars,
-                   damp_factors, stability):
+                   damp_factors, stability, prune=False):
     """One jitted program per solver-parameter combination (jit's own
     cache keys on the static args), reused across calls — a fresh
-    closure per call would retrace and recompile every time."""
+    closure per call would retrace and recompile every time.
+
+    ``prune`` threads branch-and-bound pruning into each lane.  Under
+    vmap the per-lane phase predicates batch, so the dense/compacted
+    alternation degrades toward evaluating both sides more often than
+    the solo engine would — the decision consumed here
+    (serving/service: prune="auto") was raced on the SOLO path, where
+    the win is largest; results are identical either way."""
 
     def solve_one(graph):
         state, values = maxsum_ops.run_maxsum(
@@ -128,6 +135,7 @@ def _batched_solve(stacked, *, max_cycles, damping, damp_vars,
             damp_factors=damp_factors,
             stability=stability,
             stop_on_convergence=False,
+            prune=prune,
         )
         return values, state.cycle, state.stable
 
@@ -148,6 +156,7 @@ def run_stacked(
     damping_nodes: str = "both",
     stability: float = 0.1,
     pad_to_bins: Optional[Sequence[int]] = None,
+    prune: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray, DeviceRunResult]:
     """One device dispatch over a stack of same-shaped compiled graphs.
 
@@ -178,6 +187,7 @@ def run_stacked(
         damp_vars=damping_nodes in ("vars", "both"),
         damp_factors=damping_nodes in ("factors", "both"),
         stability=stability,
+        prune=prune,
     )
     key = (
         "maxsum_batch", len(graphs), _shape_signature(stacked),

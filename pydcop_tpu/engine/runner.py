@@ -11,7 +11,7 @@ import sys
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +29,47 @@ from pydcop_tpu.observability.profiler import key_str, profiler
 from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.ops import maxsum as maxsum_ops
 from pydcop_tpu.ops import maxsum_lane as lane_ops
+
+
+@dataclass(frozen=True)
+class DecimationPlan:
+    """Segmented decimation policy (Improving Max-Sum through
+    Decimation, arXiv:1706.02209): at every segment boundary — where
+    the host already syncs for guards/probes, so the jitted loop gains
+    ZERO new syncs — variables whose belief margin (gap between best
+    and second-best value) clears ``margin`` are CLAMPED to their
+    selected value (unary costs overwritten with BIG everywhere else,
+    the one-hot-constant-message form the kernels already respect),
+    shrinking the set of edges still doing useful work round by round.
+
+    ``margin``: threshold a variable's margin must exceed to clamp
+    (0 = pure top-fraction selection, the classic decimation schedule).
+    ``frac_per_round``: cap on the fraction of ALL variables newly
+    clamped per boundary.  ``force_progress``: clamp the top-margin
+    free variable even when none clears the threshold — guarantees the
+    classic schedule terminates with everything fixed; threshold mode
+    (margin > 0) leaves it False so only genuinely confident variables
+    ever clamp.  ``cycles_per_round``: segment length used when the
+    caller does not impose one (checkpoint cadence wins when present).
+    """
+
+    margin: float = 0.0
+    frac_per_round: float = 0.1
+    force_progress: bool = True
+    cycles_per_round: int = 60
+
+
+class DecimationState(NamedTuple):
+    """Checkpoint payload of a decimated run: solver state + the clamp
+    bookkeeping that must travel with it.  A snapshot missing the
+    clamp set would resume message passing against un-clamped unary
+    costs — a silently different problem; bundling them makes
+    resume-mid-decimation reproduce the uninterrupted run (asserted
+    in tests/unit/test_workreduction_battery.py)."""
+
+    solver: Any           # MaxSumState
+    fixed: Any            # [V] bool — clamped variables
+    var_costs: Any        # [V+1, D] f32 — current (clamped) table
 
 
 @dataclass
@@ -142,6 +183,140 @@ def _fn_label(fn) -> str:
     return getattr(inner, "__name__", None) or type(fn).__name__
 
 
+class _DecimationRun:
+    """Host-side clamp bookkeeping for ONE decimated
+    ``run_checkpointed`` call: the fixed-variable mask, the clamped
+    unary table, and their rollback snapshot.  All mutation happens at
+    segment boundaries on the host; the jitted loop only ever sees a
+    fresh (replaced) graph, so decimation adds zero syncs inside it.
+    """
+
+    def __init__(self, engine, plan: DecimationPlan,
+                 initial: Optional[DecimationState] = None):
+        self.engine = engine
+        self.plan = plan
+        self.n_vars = len(engine.meta.var_names)
+        if initial is not None:
+            self.fixed = np.asarray(
+                jax.device_get(initial.fixed)).astype(bool).copy()
+            self.var_costs = np.asarray(
+                jax.device_get(initial.var_costs)).copy()
+        else:
+            self.fixed = np.zeros(self.n_vars, dtype=bool)
+            self.var_costs = np.asarray(
+                jax.device_get(engine.graph.var_costs)).copy()
+        self.rounds = 0
+        self.rollbacks = 0
+        self._snap = None
+
+    def put(self, arr: np.ndarray):
+        """Place a replacement var_costs table like the original: a
+        replicated-mesh engine needs the replicated sharding spec, a
+        single-device engine a plain device_put."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = self.engine.mesh
+        if mesh is not None and mesh.size > 1:
+            return jax.device_put(
+                arr, NamedSharding(mesh, PartitionSpec()))
+        return jax.device_put(arr)
+
+    def clamp(self, graph, state, values, margin):
+        """Select-and-clamp at one segment boundary.  Returns
+        ``(newly_clamped, graph, state)`` — a nonzero clamp count
+        replaces the graph's unary table and clears the convergence
+        flag (the clamped problem is a new problem; the warm-started
+        messages adapt)."""
+        margin = np.asarray(jax.device_get(margin))
+        vals = np.asarray(jax.device_get(values))
+        free = np.nonzero(~self.fixed)[0]
+        if free.size == 0:
+            return 0, graph, state
+        cap = max(1, int(self.plan.frac_per_round * self.n_vars))
+        if self.plan.margin > 0:
+            eligible = free[margin[free] > self.plan.margin]
+        else:
+            eligible = free
+        order = eligible[np.argsort(-margin[eligible], kind="stable")]
+        chosen = order[:cap]
+        if chosen.size == 0 and self.plan.force_progress:
+            chosen = free[
+                np.argsort(-margin[free], kind="stable")[:1]]
+        if chosen.size == 0:
+            return 0, graph, state
+        d = self.var_costs.shape[1]
+        for i in chosen:
+            keep = int(vals[i])
+            row = np.full((d,), BIG, self.var_costs.dtype)
+            row[keep] = self.var_costs[i, keep]
+            self.var_costs[i] = row
+            self.fixed[i] = True
+        self.rounds += 1
+        graph = graph._replace(
+            var_costs=self.put(self.var_costs.copy()))
+        state = state._replace(stable=jnp.asarray(False))
+        return int(chosen.size), graph, state
+
+    def retain(self, graph):
+        """Snapshot the clamp set alongside the recovery run's state
+        snapshot: a later rollback must restore BOTH, or the replayed
+        segment would run against a clamp set from its future."""
+        self._snap = (self.fixed.copy(), self.var_costs.copy(), graph)
+
+    def rollback(self):
+        """Restore the clamp set retained with the last validated
+        snapshot; returns the graph to continue with."""
+        fixed, var_costs, graph = self._snap
+        self.fixed = fixed.copy()
+        self.var_costs = var_costs.copy()
+        self.rollbacks += 1
+        return graph
+
+    def snapshot_payload(self, solver_state) -> DecimationState:
+        """Checkpoint payload: solver state + the CURRENT clamp set
+        (called after the boundary's clamping, so a resume replays
+        exactly the uninterrupted sequence)."""
+        return DecimationState(
+            solver=solver_state,
+            fixed=self.fixed.copy(),
+            var_costs=self.var_costs.copy(),
+        )
+
+    def active_edges(self, graph) -> int:
+        """Edge slots whose variable is still free — the per-round
+        shrinking work set the metrics report."""
+        n = 0
+        for b in graph.buckets:
+            ids = np.asarray(b.var_ids).reshape(-1)
+            real = ids < self.n_vars
+            n += int(np.sum(
+                real & ~self.fixed[np.minimum(ids, self.n_vars - 1)]))
+        return n
+
+    def metrics(self, graph) -> Dict[str, Any]:
+        return {
+            "decimated_vars": int(self.fixed.sum()),
+            "decimated_fraction": (
+                float(self.fixed.sum()) / self.n_vars
+                if self.n_vars else 0.0),
+            "active_edges": self.active_edges(graph),
+            "decimation_rounds": self.rounds,
+            "decimation_rollbacks": self.rollbacks,
+        }
+
+
+def decimation_template(engine, solver_template) -> DecimationState:
+    """Checkpoint restore template of a decimated run (resilience/
+    checkpoint.load_state restores into this structure/placement)."""
+    n_vars = len(engine.meta.var_names)
+    return DecimationState(
+        solver=solver_template,
+        fixed=np.zeros(n_vars, dtype=bool),
+        var_costs=np.asarray(
+            jax.device_get(engine.graph.var_costs)).copy(),
+    )
+
+
 def _place_graph(graph: CompiledFactorGraph, mesh,
                  n_devices: Optional[int]):
     """Put the graph on device(s): sharded over a mesh when requested,
@@ -235,10 +410,15 @@ class MaxSumEngine:
                  damping: float = 0.5, damping_nodes: str = "both",
                  stability: float = 0.1,
                  mesh=None, n_devices: Optional[int] = None,
-                 layout: str = "edge", donate: bool = True):
+                 layout: str = "edge", donate: bool = True,
+                 prune: bool = False):
         if layout not in ("edge", "lane"):
             raise ValueError(
                 f"layout must be 'edge' or 'lane', got {layout!r}")
+        if prune and layout == "lane":
+            raise ValueError(
+                "prune=True gathers rows of the edge-major cost "
+                "hypercubes; run with layout='edge'")
         self.meta = meta
         self.layout = layout
         if layout == "lane":
@@ -261,10 +441,11 @@ class MaxSumEngine:
             self.graph, self.mesh = _place_graph(graph, mesh, n_devices)
         self._ops = lane_ops if layout == "lane" else maxsum_ops
         self._init_solver_state(damping, damping_nodes, stability,
-                                donate)
+                                donate, prune)
 
     def _init_solver_state(self, damping: float, damping_nodes: str,
-                           stability: float, donate: bool):
+                           stability: float, donate: bool,
+                           prune: bool = False):
         """Solver-parameter and runtime-bookkeeping tail shared by
         every engine initializer (ShardedMaxSumEngine builds its own
         graph/ops head, then calls this — one place to grow when the
@@ -273,6 +454,10 @@ class MaxSumEngine:
         self.damp_vars = damping_nodes in ("vars", "both")
         self.damp_factors = damping_nodes in ("factors", "both")
         self.stability = stability
+        # Branch-and-bound message pruning (ops/maxsum.prune_tables):
+        # a per-engine constant, so the per-engine jit caches need no
+        # extra key term.  Pruning changes wall-clock, never values.
+        self.prune = prune
         # Donate the state argument of the segment program: XLA then
         # writes each segment's output state into the input buffers
         # instead of allocating fresh ones — zero steady-state
@@ -344,6 +529,7 @@ class MaxSumEngine:
                     damp_factors=self.damp_factors,
                     stability=self.stability,
                     stop_on_convergence=stop_on_convergence,
+                    prune=self.prune,
                 ),
                 donate_argnums=(1,) if self.donate else (),
             )
@@ -387,6 +573,7 @@ class MaxSumEngine:
                          probe=None,
                          checkpoint_async: bool = True,
                          recovery=None,
+                         decimation: Optional[DecimationPlan] = None,
                          ) -> "DeviceRunResult":
         """The solve loop chunked into K-cycle segments with a state
         snapshot between segments — the preemption-survival entry point
@@ -438,12 +625,30 @@ class MaxSumEngine:
         probe; with no trips the guarded trajectory is bit-identical
         to the unguarded one (guards are pure reads — tier-1
         asserted).
+
+        ``decimation`` (a :class:`DecimationPlan`) turns the segmented
+        loop into the decimated solve: at every boundary — the host is
+        already synced there — variables whose belief margin clears
+        the plan's threshold are clamped to their selected value and
+        the graph's unary table replaced (the jitted loop gains zero
+        syncs; the clamped problem warm-starts from the surviving
+        messages).  The clamp set rides every snapshot
+        (:class:`DecimationState`) and every recovery retain, so a
+        resume or a guard-trip rollback restores messages AND clamp
+        set together — never a stale active-edge mask.  Metrics gain
+        ``decimated_vars`` / ``decimated_fraction`` / ``active_edges``
+        / ``decimation_rounds`` / ``decimation_rollbacks``.
         """
         from pydcop_tpu.resilience.checkpoint import (
             AsyncCheckpointWriter,
             CheckpointManager,
         )
 
+        if decimation is not None and self._ops is not maxsum_ops:
+            raise ValueError(
+                "decimation clamps the edge-major var_costs table; "
+                "run the unsharded edge-layout engine (no shards=, "
+                "layout='edge')")
         if manager is None and checkpoint_dir is not None:
             manager = CheckpointManager(
                 checkpoint_dir, every=segment_cycles or 100
@@ -451,6 +656,23 @@ class MaxSumEngine:
         every = segment_cycles or (
             manager.every if manager is not None else 100
         )
+        graph = self.graph
+        decim = None
+        if decimation is not None:
+            initial_decim = (
+                initial_state
+                if isinstance(initial_state, DecimationState) else None
+            )
+            decim = _DecimationRun(self, decimation, initial_decim)
+            if initial_decim is not None:
+                graph = graph._replace(
+                    var_costs=decim.put(decim.var_costs.copy()))
+                initial_state = initial_decim.solver
+        elif isinstance(initial_state, DecimationState):
+            raise ValueError(
+                "initial_state carries a decimation clamp set but no "
+                "decimation plan was passed — resuming it without one "
+                "would silently solve a different problem")
         state = (
             initial_state if initial_state is not None
             else self.init_state()
@@ -461,8 +683,13 @@ class MaxSumEngine:
 
             rec = RecoveryRun(recovery, self)
             # The starting state is the first rollback target: a trip
-            # on the very first segment restarts from here.
+            # on the very first segment restarts from here — the
+            # decimation clamp set must be retained alongside it, or
+            # that first-segment rollback would unpack an empty
+            # snapshot.
             rec.retain(state, None)
+            if decim is not None:
+                decim.retain(graph)
         writer = None
         if manager is not None and checkpoint_async:
             writer = AsyncCheckpointWriter(manager)
@@ -478,6 +705,13 @@ class MaxSumEngine:
                 if values is not None and (
                     cycle >= max_cycles
                     or (stop_on_convergence and bool(state.stable))
+                    # Every variable clamped: the decimated solve is
+                    # complete by definition — the clamped unary rows
+                    # (BIG off the kept value) push message magnitudes
+                    # to the BIG scale where the relative stability
+                    # test may never settle, so waiting for it would
+                    # burn the whole cycle budget for nothing.
+                    or (decim is not None and bool(decim.fixed.all()))
                 ):
                     break
                 # A resume at/past the cycle budget still needs the
@@ -493,11 +727,11 @@ class MaxSumEngine:
                                      extra_cycles=extra,
                                      **self._segment_span_args):
                         (state, values), c_s, run_s = self._call(
-                            seg_key, fn, self.graph, state,
+                            seg_key, fn, graph, state,
                         )
                 else:
                     (state, values), c_s, run_s = self._call(
-                        seg_key, fn, self.graph, state,
+                        seg_key, fn, graph, state,
                     )
                 compile_s += c_s
                 segments += 1
@@ -505,7 +739,7 @@ class MaxSumEngine:
                     finite, g_cost = jax.device_get(
                         self._guard_fn(
                             recovery.divergence_window > 0
-                        )(self.graph, state, values))
+                        )(graph, state, values))
                     violation = rec.check(
                         int(state.cycle), bool(finite), float(g_cost))
                     if violation is not None:
@@ -513,14 +747,42 @@ class MaxSumEngine:
                         # the probe or a checkpoint.  rollback raises
                         # RecoveryExhausted past the restart budget.
                         state, values = rec.rollback(violation)
+                        if decim is not None:
+                            # The clamp set travels with the snapshot:
+                            # resuming the rolled-back messages under
+                            # a newer (stale-in-time) active-edge mask
+                            # would solve a different problem than the
+                            # one the snapshot was validated for.
+                            graph = decim.rollback()
+                        else:
+                            # A shard-loss rollback rebuilt the
+                            # engine's graph on the surviving mesh
+                            # (repartition_after_loss): re-read it.
+                            graph = self.graph
                         if max_segments is not None \
                                 and segments >= max_segments:
                             interrupted = True
                             break
                         continue
                     rec.retain(state, values)
+                    if decim is not None:
+                        decim.retain(graph)
                 if probe is not None:
                     probe.on_segment(state, values, run_s, c_s)
+                if decim is not None:
+                    # Clamp BEFORE the checkpoint: the snapshot then
+                    # carries the post-clamp set, and a resume replays
+                    # exactly the uninterrupted boundary sequence
+                    # (next segment first, next clamp after it).
+                    margin = self._margin_fn()(graph, state)
+                    newly, graph, state = decim.clamp(
+                        graph, state, values, margin)
+                    if newly and tracer.active:
+                        tracer.instant(
+                            "decimation_clamp", "engine",
+                            newly_clamped=newly,
+                            decimated_vars=int(decim.fixed.sum()),
+                            cycle=int(state.cycle))
                 if manager is not None:
                     if writer is not None:
                         snap = state
@@ -532,19 +794,31 @@ class MaxSumEngine:
                             # overlaps, no host sync.  The recovery
                             # run already retained exactly that copy
                             # (both sides only read it), so reuse it
-                            # rather than paying a second one.
+                            # rather than paying a second one.  A
+                            # decimated run copies fresh instead: the
+                            # retained copy predates this boundary's
+                            # clamp (stable flag reset).
                             snap = (
                                 rec.snapshot_state
-                                if rec is not None
+                                if rec is not None and decim is None
                                 else jax.tree_util.tree_map(
                                     jnp.copy, state)
                             )
                         # snap.cycle, not state.cycle: the original
                         # scalar is donated along with the rest of
                         # the state on the next dispatch.
-                        writer.submit(snap, snap.cycle)
+                        if decim is not None:
+                            writer.submit(
+                                decim.snapshot_payload(snap),
+                                snap.cycle)
+                        else:
+                            writer.submit(snap, snap.cycle)
                     else:
-                        manager.save(state, int(state.cycle))
+                        payload = (
+                            decim.snapshot_payload(state)
+                            if decim is not None else state
+                        )
+                        manager.save(payload, int(state.cycle))
                     checkpoints += 1
                 if max_segments is not None \
                         and segments >= max_segments:
@@ -570,7 +844,7 @@ class MaxSumEngine:
             fn = self._segment_fn(0, stop_on_convergence)
             (state, values), c_s, _ = self._call(
                 self._segment_key(0, stop_on_convergence), fn,
-                self.graph, state,
+                graph, state,
             )
             compile_s += c_s
         total = time.perf_counter() - t0
@@ -579,6 +853,10 @@ class MaxSumEngine:
         )
         values_host = np.asarray(values_host)
         cycle, stable = int(cycle), bool(stable)
+        if decim is not None and decim.fixed.all() and not interrupted:
+            # Fully decimated = solved: every variable carries its
+            # clamped value (legacy run_decimated convention).
+            stable = True
         steady = max(total - compile_s, 0.0)
         return DeviceRunResult(
             assignment=self.meta.assignment_from_indices(values_host),
@@ -596,8 +874,27 @@ class MaxSumEngine:
                 "cycles_per_s": cycle / steady if steady > 0 else 0.0,
                 "cold_start": compile_s > 0,
                 **(rec.metrics() if rec is not None else {}),
+                **(decim.metrics(graph) if decim is not None else {}),
             },
         )
+
+    def _margin_fn(self):
+        """Cached-jit belief-margin evaluation ([V] gap between best
+        and second-best value) — the decimation confidence signal,
+        computed on device and fetched at the segment boundary the
+        host is already syncing on."""
+        key = ("decim_margin",)
+        if key not in self._jitted:
+            def margin_of(graph, state):
+                beliefs, _ = maxsum_ops.aggregate_beliefs(
+                    graph, state.f2v)
+                masked = jnp.where(
+                    graph.var_valid, beliefs, jnp.inf)[:-1]
+                best2 = jnp.sort(masked, axis=1)[:, :2]
+                return best2[:, 1] - best2[:, 0]
+
+            self._jitted[key] = jax.jit(margin_of)
+        return self._jitted[key]
 
     def _fn(self, max_cycles: int, stop_on_convergence: bool):
         key = (max_cycles, stop_on_convergence)
@@ -611,16 +908,22 @@ class MaxSumEngine:
                     damp_factors=self.damp_factors,
                     stability=self.stability,
                     stop_on_convergence=stop_on_convergence,
+                    prune=self.prune,
                 )
             )
         return self._jitted[key]
 
-    def run_trace(self, max_cycles: int) -> "DeviceRunResult":
-        """Fixed-cycle run that also records the constraint cost of the
-        selected assignment after every cycle (metrics['cost_trace'],
-        numpy [max_cycles]) — the curve behind time-to-equal-cost
-        claims (bench.py)."""
-        key = ("trace", max_cycles)
+    def run_trace(self, max_cycles: int,
+                  stop_on_convergence: bool = True
+                  ) -> "DeviceRunResult":
+        """Run recording the constraint cost of the selected
+        assignment after every cycle (metrics['cost_trace'], numpy
+        [max_cycles]) — the curve behind time-to-equal-cost claims
+        (bench.py).  Default ``stop_on_convergence`` matches
+        :meth:`run`: the loop exits at the fixpoint, the cycle count
+        agrees with an untraced solve, and the curve's tail holds the
+        final cost (still a valid anytime record at full length)."""
+        key = ("trace", max_cycles, stop_on_convergence)
         if key not in self._jitted:
             base = self.meta.var_base_costs
             self._jitted[key] = jax.jit(
@@ -634,6 +937,8 @@ class MaxSumEngine:
                     var_base_costs=(
                         None if base is None else jnp.asarray(base)
                     ),
+                    stop_on_convergence=stop_on_convergence,
+                    prune=self.prune,
                 )
             )
         fn = self._jitted[key]
@@ -843,7 +1148,8 @@ class ShardedMaxSumEngine(MaxSumEngine):
                  n_shards: Optional[int] = None, mesh=None,
                  partition=None,
                  damping: float = 0.5, damping_nodes: str = "both",
-                 stability: float = 0.1, donate: bool = True):
+                 stability: float = 0.1, donate: bool = True,
+                 prune: bool = False):
         from pydcop_tpu.engine.partition import partition_compiled
         from pydcop_tpu.engine.sharding import (
             ShardOps,
@@ -874,7 +1180,7 @@ class ShardedMaxSumEngine(MaxSumEngine):
             graph, partition, mesh)
         self._ops = ShardOps(mesh, len(meta.var_names))
         self._init_solver_state(damping, damping_nodes, stability,
-                                donate)
+                                donate, prune)
         self.extra_metrics.update(part_metrics)
         self._segment_span_args["shards"] = mesh.size
 

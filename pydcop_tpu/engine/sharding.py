@@ -451,7 +451,8 @@ def _global_all(flag: jnp.ndarray) -> jnp.ndarray:
 def _superstep_local(lgraph, aux, st: ShardedMaxSumState, *,
                      damping: float, damp_vars: bool,
                      damp_factors: bool, stability: float,
-                     n_boundary: int) -> ShardedMaxSumState:
+                     n_boundary: int,
+                     prune=None) -> ShardedMaxSumState:
     """One partitioned MaxSum superstep on one shard's block — the
     exact semantics of ops.maxsum.superstep (Jacobi BSP, damping,
     SAME_COUNT send-suppression), with the variable aggregation split
@@ -462,7 +463,7 @@ def _superstep_local(lgraph, aux, st: ShardedMaxSumState, *,
         lgraph.var_valid[b.var_ids] for b in lgraph.buckets
     )
 
-    f2v_cand = maxsum_ops.factor_to_var(lgraph, st.v2f)
+    f2v_cand = maxsum_ops.factor_to_var(lgraph, st.v2f, prune=prune)
     if damp_factors and damping > 0:
         f2v_cand = maxsum_ops._damp(f2v_cand, st.f2v, damping, first)
 
@@ -621,12 +622,21 @@ class ShardOps:
                         damping: float = 0.5, damp_vars: bool = True,
                         damp_factors: bool = True,
                         stability: float = 0.1,
-                        stop_on_convergence: bool = True):
+                        stop_on_convergence: bool = True,
+                        prune: bool = False):
         """Up to ``extra_cycles`` more partitioned supersteps from an
         existing state; returns ``(state, values)`` with ``values``
         reassembled to the GLOBAL [V] order (identical interface to
         ops.maxsum.run_maxsum_from, so the segmented runner, the
-        checkpoint format and the recovery ladder work unchanged)."""
+        checkpoint format and the recovery ladder work unchanged).
+
+        ``prune=True`` applies branch-and-bound pruning to each
+        shard's local factor reductions with the same dense/compacted
+        phase alternation as the edge-major kernel; the phase
+        predicate is the GLOBAL AND of the per-shard fit tests (one
+        4-byte collective per loop-condition evaluation), so every
+        shard always runs the same kernel and the collectives inside
+        the superstep stay aligned."""
         n_bnd = graph.n_boundary
         v_loc = graph.v_loc
 
@@ -641,10 +651,38 @@ class ShardOps:
             )
             limit = st.cycle + extra_cycles
             if stop_on_convergence:
-                cond = lambda s: (s.cycle < limit) & ~s.stable  # noqa: E731
+                done = lambda s: (s.cycle >= limit) | s.stable  # noqa: E731
             else:
-                cond = lambda s: s.cycle < limit  # noqa: E731
-            st = jax.lax.while_loop(cond, lambda s: step(st=s), st)
+                done = lambda s: s.cycle >= limit  # noqa: E731
+            pt = maxsum_ops.prune_tables(lgraph) if prune else None
+            if pt is not None and all(t is None for t in pt):
+                pt = None
+            if pt is None:
+                st = jax.lax.while_loop(
+                    lambda s: ~done(s), lambda s: step(st=s), st)
+            else:
+                step_fast = partial(
+                    _superstep_local, lgraph, aux,
+                    damping=damping, damp_vars=damp_vars,
+                    damp_factors=damp_factors, stability=stability,
+                    n_boundary=n_bnd, prune=pt,
+                )
+
+                def fits(s):
+                    return _global_all(
+                        maxsum_ops.prune_fits(s.v2f, pt))
+
+                def phases(s):
+                    s = jax.lax.while_loop(
+                        lambda s: ~done(s) & ~fits(s),
+                        lambda s: step(st=s), s)
+                    s = jax.lax.while_loop(
+                        lambda s: ~done(s) & fits(s),
+                        lambda s: step_fast(st=s), s)
+                    return s
+
+                st = jax.lax.while_loop(
+                    lambda s: ~done(s), phases, st)
             values = _select_local(lgraph, aux, st, v_loc)
             return _reblock_state(st), values[None]
 
@@ -661,25 +699,35 @@ class ShardOps:
     def run_maxsum(self, graph: ShardedGraph, max_cycles: int, *,
                    damping: float = 0.5, damp_vars: bool = True,
                    damp_factors: bool = True, stability: float = 0.1,
-                   stop_on_convergence: bool = True):
+                   stop_on_convergence: bool = True,
+                   prune: bool = False):
         return self.run_maxsum_from(
             graph, self._zeros_state(graph), max_cycles,
             damping=damping, damp_vars=damp_vars,
             damp_factors=damp_factors, stability=stability,
-            stop_on_convergence=stop_on_convergence,
+            stop_on_convergence=stop_on_convergence, prune=prune,
         )
 
     def run_maxsum_trace(self, graph: ShardedGraph, max_cycles: int, *,
                          damping: float = 0.5, damp_vars: bool = True,
                          damp_factors: bool = True,
                          stability: float = 0.1,
-                         var_base_costs=None):
-        """Fixed-cycle partitioned run recording the global assignment
-        cost after every cycle: per-shard constraint cost over local
-        factors + owned-variable base costs, psum'd — each factor and
-        each variable is owned by exactly one shard, so the psum is a
+                         var_base_costs=None,
+                         stop_on_convergence: bool = True,
+                         prune: bool = False):
+        """Partitioned run recording the global assignment cost after
+        every cycle: per-shard constraint cost over local factors +
+        owned-variable base costs, psum'd — each factor and each
+        variable is owned by exactly one shard, so the psum is a
         partition of the global sum (no double counting).  Halo
-        variables' selected values ride a [B]-int exchange."""
+        variables' selected values ride a [B]-int exchange.
+
+        Early exit (``stop_on_convergence``) mirrors the edge-major
+        trace: a while_loop writes each cycle's cost into a carried
+        buffer and the tail holds the final value; every shard leaves
+        the loop on the same (globally-reduced) verdict.  ``prune`` is
+        accepted for ops-interface parity but runs dense: pruning
+        never changes values, and a trace is a value record."""
         n_bnd = graph.n_boundary
         v_loc = graph.v_loc
         n_halo = graph.local_global.shape[-1] - v_loc
@@ -716,14 +764,32 @@ class ShardOps:
                         base, values[:, None], axis=1))
                 return jax.lax.psum(cost, SHARD_AXIS), values
 
-            def step(st, _):
+            def step(carry):
+                st, costs, last = carry
                 st = step_fn(st=st)
                 cost, _ = cost_of(st)
-                return st, cost
+                costs = jax.lax.dynamic_update_slice(
+                    costs, cost[None], (st.cycle - 1,))
+                return st, costs, cost
 
-            st, costs = jax.lax.scan(
-                step, self._zeros_state_local(lgraph, n_bnd), None,
-                length=max_cycles)
+            def done(carry):
+                st = carry[0]
+                out = st.cycle >= max_cycles
+                if stop_on_convergence:
+                    # st.stable is already the global AND
+                    # (_global_all inside the superstep), so every
+                    # shard exits together.
+                    out = out | st.stable
+                return out
+
+            zero = jnp.asarray(0.0, lgraph.var_costs.dtype)
+            st, costs, last = jax.lax.while_loop(
+                lambda c: ~done(c), step,
+                (self._zeros_state_local(lgraph, n_bnd),
+                 jnp.zeros((max_cycles,), lgraph.var_costs.dtype),
+                 zero))
+            costs = jnp.where(
+                jnp.arange(max_cycles) >= st.cycle, last, costs)
             _, values = cost_of(st)
             return _reblock_state(st), values[None], costs
 

@@ -36,12 +36,17 @@ revisit Pallas if a future problem shape makes the factor update
 reduction-bound (large arity/domains) rather than dispatch-bound.
 """
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from pydcop_tpu.engine.compile import BIG, CompiledFactorGraph
+from pydcop_tpu.engine.compile import (
+    BIG,
+    PRUNE_MIN_DOMAIN,
+    CompiledFactorGraph,
+    prune_width,
+)
 
 Msgs = Tuple[jnp.ndarray, ...]  # one [F, arity, D] array per bucket
 
@@ -159,11 +164,206 @@ def _use_pallas() -> bool:
     )
 
 
-def factor_to_var(graph: CompiledFactorGraph, v2f: Msgs) -> Msgs:
-    """All factor→variable messages for one superstep."""
+class PruneTable(NamedTuple):
+    """Per-bucket branch-and-bound tables for the pruned binary-factor
+    update (arXiv:1906.06863 applied to the min-plus aggregation).
+
+    ``row_min``/``row_max`` hold, per factor and per slot of one scope
+    position, the min/max of the cost hypercube over the *other*
+    position's VALID slots — the message-independent halves of the
+    per-row lower bound (``m_q[e] + row_min[e]``) and the running
+    upper bound (``min_e(m_q[e] + row_max[e])``).  Both are pure
+    functions of the cost tables, computed ONCE outside the jitted
+    loop (never per superstep).  ``valid`` masks each position's
+    domain-padding slots out of the survivor set and the upper bound.
+    """
+
+    row_min: Tuple[jnp.ndarray, jnp.ndarray]  # per position p: [F, D]
+    row_max: Tuple[jnp.ndarray, jnp.ndarray]
+    valid: Tuple[jnp.ndarray, jnp.ndarray]    # [F, D] bool
+    costs_t: jnp.ndarray                      # [F, D, D] transposed
+    width: int                                # static gather budget
+
+
+def prune_tables(graph: CompiledFactorGraph
+                 ) -> Tuple[Optional[PruneTable], ...]:
+    """Branch-and-bound tables, one entry per bucket (None = bucket
+    stays on the dense path: non-binary arity, or a domain small
+    enough that the bound bookkeeping would cost more than the dense
+    reduction).  Call OUTSIDE the superstep loop — the tables are
+    loop-invariant."""
+    out = []
+    d = graph.var_costs.shape[1]
+    for bucket in graph.buckets:
+        if (bucket.var_ids.shape[1] != 2 or d < PRUNE_MIN_DOMAIN
+                or bucket.var_ids.shape[0] == 0):
+            out.append(None)
+            continue
+        valid0 = graph.var_valid[bucket.var_ids[:, 0]]   # [F, D]
+        valid1 = graph.var_valid[bucket.var_ids[:, 1]]
+        costs = bucket.costs                             # [F, D, D]
+        inf = jnp.asarray(jnp.inf, costs.dtype)
+        # Extrema over the VALID slots of the other position: BIG
+        # domain padding must not loosen row_max into uselessness.
+        m0 = valid0[:, :, None]
+        m1 = valid1[:, None, :]
+        out.append(PruneTable(
+            row_min=(
+                jnp.min(jnp.where(m1, costs, inf), axis=2),    # p=0
+                jnp.min(jnp.where(m0, costs, inf), axis=1),    # p=1
+            ),
+            row_max=(
+                jnp.max(jnp.where(m1, costs, -inf), axis=2),
+                jnp.max(jnp.where(m0, costs, -inf), axis=1),
+            ),
+            valid=(valid0, valid1),
+            # Direction p=0 gathers reduction rows indexed by the
+            # q=1 slot: the transposed table makes that a CONTIGUOUS
+            # row copy instead of a strided column gather (the
+            # strided form measured 4x slower on XLA:CPU).  2x table
+            # memory, paid only while pruning is on.
+            costs_t=jnp.swapaxes(costs, 1, 2),
+            width=prune_width(d),
+        ))
+    return tuple(out)
+
+
+# Relative slack added to the survivor test: the lower/upper bounds
+# and the reduction totals are DIFFERENT float computations of related
+# real quantities, each off by a few ulps — an entry whose real margin
+# is inside the rounding noise must survive, or the pruned min can
+# differ from the dense min in the last bits.  ~200x f32 eps keeps
+# every near-boundary entry (measured: zero extra survivors on the
+# benchmark families, bit-identical trajectories restored at D=192
+# where slack-free pruning drifted).
+PRUNE_SLACK = 2.5e-5
+
+
+def _survivors(msgs: jnp.ndarray, pt: PruneTable, p: int
+               ) -> jnp.ndarray:
+    """[F, D] bool: reduction rows of direction ``p`` that can still
+    attain the min.  Row ``e`` is DOMINATED when its lower bound
+    ``m_q[e] + row_min[e]`` exceeds the factor's running upper bound
+    ``min_e(m_q[e] + row_max[e])`` by more than the rounding slack:
+    every output entry is <= the upper bound, so removing the row is
+    exact (ties and near-ties keep it)."""
+    mq = msgs[:, 1 - p]
+    vq = pt.valid[1 - p]
+    inf = jnp.asarray(jnp.inf, mq.dtype)
+    lb = mq + pt.row_min[p]
+    ub = jnp.min(jnp.where(vq, mq + pt.row_max[p], inf),
+                 axis=1, keepdims=True)
+    tau = PRUNE_SLACK * (1.0 + jnp.abs(ub))
+    return vq & (lb <= ub + tau)
+
+
+def prune_fits(v2f: Msgs,
+               prune: Tuple[Optional[PruneTable], ...]) -> jnp.ndarray:
+    """Scalar bool: every prunable bucket's survivor count fits the
+    static gather budget in BOTH directions for the messages about to
+    be consumed — the phase predicate of the pruned solve loops (see
+    run_maxsum_from).  O(E) bound arithmetic, no reduction hypercube
+    touched."""
+    fits = jnp.asarray(True)
+    for msgs, pt in zip(v2f, prune):
+        if pt is None:
+            continue
+        for p in range(2):
+            n = jnp.max(jnp.sum(
+                _survivors(msgs, pt, p).astype(jnp.int32), axis=1))
+            fits = fits & (n <= pt.width)
+    return fits
+
+
+def _pruned_binary_update(bucket, msgs: jnp.ndarray,
+                          pt: PruneTable) -> jnp.ndarray:
+    """Branch-and-bound f2v update for one binary bucket ([F, 2, D]).
+
+    PRECONDITION: every factor's survivor count fits ``pt.width`` in
+    both directions (``prune_fits``) — the pruned solve loops only
+    enter this kernel while that holds, so there is no in-kernel
+    fallback.  (An XLA conditional here was measured to cost more
+    than the dense reduction it avoids: conditional branch operands —
+    the [F, D, D] cost tensors — don't alias across the control-flow
+    boundary on CPU, so every cycle paid a hypercube-sized copy.
+    While-loop phase switching keeps the big operands in the loop
+    carry/closure where they DO alias.)
+
+    Under the precondition the result is the SAME VALUE the dense
+    reduction produces — dominated rows are strictly above the
+    attainable min, ties survive, and the per-element add order
+    matches the dense path exactly ((costs + m0) + m1, reduce,
+    subtract own message) — so on integer cost tables the whole
+    trajectory is bit-identical (asserted in
+    tests/unit/test_workreduction_battery.py, gated in perf-smoke).
+
+    Work shape: survivors are compacted sort-free — the j-th survivor
+    index is recovered from the monotone prefix counts by an unrolled
+    O(K·log D) binary search (XLA sort/scatter/top_k all measured
+    20-30x slower per element on CPU) — then both directions gather
+    CONTIGUOUS [K, D] row blocks (direction 0 from the pre-transposed
+    table) and min-plus reduce over K instead of D.  Slots past the
+    last survivor duplicate a row that is either itself a survivor or
+    dominated — the gathered min stays exact either way.  The
+    per-element add order matches the dense path exactly
+    ((costs + m0) + m1, reduce, subtract own message): damping and
+    mean-normalization accrete mantissa bits cycle over cycle, so an
+    "algebraically equal" reassociation (e.g. skipping the
+    add-then-subtract of the own message) measurably drifts within
+    ~15 cycles even on integer tables.
+    """
+    costs = bucket.costs
+    m0, m1 = msgs[:, 0], msgs[:, 1]
+    k = pt.width
+    d = costs.shape[1]
+    outs = []
+    for p in range(2):
+        s = _survivors(msgs, pt, p)
+        cum = jnp.cumsum(s.astype(jnp.int32), axis=1)       # [F, D]
+        # idx[f, j] = first e with cum[e] == j+1 (the j-th survivor):
+        # an unrolled branchless lower_bound over the monotone prefix
+        # counts, all K targets searched at once — O(K·log D) gathers
+        # instead of the O(K·D) compare-and-count matrix.
+        target = jnp.arange(1, k + 1, dtype=jnp.int32)[None, :]
+        idx = jnp.zeros((cum.shape[0], k), jnp.int32)
+        bit = 1
+        while bit * 2 <= d:
+            bit <<= 1
+        while bit:
+            nxt = idx + bit
+            probe = jnp.take_along_axis(
+                cum, jnp.minimum(nxt, d) - 1, axis=1)
+            idx = jnp.where((nxt <= d) & (probe < target), nxt, idx)
+            bit >>= 1
+        idx = jnp.minimum(idx, d - 1)
+        if p == 0:
+            c_g = jnp.take_along_axis(
+                pt.costs_t, idx[:, :, None], axis=1)        # [F, K, D]
+            m1_g = jnp.take_along_axis(m1, idx, axis=1)
+            total = (c_g + m0[:, None, :]) + m1_g[:, :, None]
+            outs.append(jnp.min(total, axis=1) - m0)
+        else:
+            c_g = jnp.take_along_axis(
+                costs, idx[:, :, None], axis=1)             # [F, K, D]
+            m0_g = jnp.take_along_axis(m0, idx, axis=1)
+            total = (c_g + m0_g[:, :, None]) + m1[:, None, :]
+            outs.append(jnp.min(total, axis=1) - m1)
+    return jnp.stack(outs, axis=1)
+
+
+def factor_to_var(graph: CompiledFactorGraph, v2f: Msgs,
+                  prune: Optional[Tuple[Optional[PruneTable], ...]]
+                  = None) -> Msgs:
+    """All factor→variable messages for one superstep.  ``prune``
+    (from :func:`prune_tables`) routes binary buckets through the
+    branch-and-bound update — same values, less work as the messages
+    concentrate."""
     out = []
     use_pallas = _use_pallas()
-    for bucket, msgs in zip(graph.buckets, v2f):
+    for bi, (bucket, msgs) in enumerate(zip(graph.buckets, v2f)):
+        if prune is not None and prune[bi] is not None:
+            out.append(_pruned_binary_update(bucket, msgs, prune[bi]))
+            continue
         if use_pallas and bucket.var_ids.shape[1] == 2:
             from pydcop_tpu.ops.pallas_maxsum import (
                 binary_factor_update,
@@ -303,7 +503,9 @@ def _damp(new: Msgs, old: Msgs, damping: float,
 
 def superstep(state: MaxSumState, graph: CompiledFactorGraph, *,
               damping: float, damp_vars: bool, damp_factors: bool,
-              stability: float) -> MaxSumState:
+              stability: float,
+              prune: Optional[Tuple[Optional[PruneTable], ...]] = None,
+              ) -> MaxSumState:
     """One synchronous MaxSum cycle with the reference's exact BSP
     semantics: in cycle k BOTH sides fire from the messages sent in
     cycle k-1 (Jacobi — a factor computation and a variable computation
@@ -317,7 +519,7 @@ def superstep(state: MaxSumState, graph: CompiledFactorGraph, *,
         graph.var_valid[b.var_ids] for b in graph.buckets
     )
 
-    f2v_cand = factor_to_var(graph, state.v2f)
+    f2v_cand = factor_to_var(graph, state.v2f, prune=prune)
     if damp_factors and damping > 0:
         f2v_cand = _damp(f2v_cand, state.f2v, damping, first)
 
@@ -385,13 +587,31 @@ def run_maxsum_trace(graph: CompiledFactorGraph, max_cycles: int, *,
                      damping: float = 0.5, damp_vars: bool = True,
                      damp_factors: bool = True, stability: float = 0.1,
                      var_base_costs=None,
+                     stop_on_convergence: bool = True,
+                     prune: bool = False,
                      ) -> Tuple[MaxSumState, jnp.ndarray, jnp.ndarray]:
-    """Like run_maxsum without convergence stop, additionally recording
-    the cost of the selected assignment after every cycle
-    ([max_cycles] array) — the cost-vs-cycle curve used for
-    time-to-equal-cost benchmark claims.  ``var_base_costs`` ([V, D],
-    noise-free variable costs) makes the trace match
-    ``DCOP.solution_cost`` on problems with variable-side costs."""
+    """Like run_maxsum, additionally recording the cost of the
+    selected assignment after every cycle ([max_cycles] array) — the
+    cost-vs-cycle curve used for time-to-equal-cost benchmark claims.
+    ``var_base_costs`` ([V, D], noise-free variable costs) makes the
+    trace match ``DCOP.solution_cost`` on problems with variable-side
+    costs.
+
+    With ``stop_on_convergence`` (the default, matching run_maxsum)
+    the loop stops at the fixpoint: the cycle counter freezes at the
+    convergence cycle (traced and untraced runs agree — asserted in
+    the work-reduction battery) and the rest of the cost array holds
+    the final value, so the curve keeps its static [max_cycles]
+    shape.  Structured as a while_loop writing each cycle's cost into
+    a carried [max_cycles] buffer (``dynamic_update_slice``) rather
+    than a scan over a skip-conditional — conditional branch operands
+    don't alias on the CPU backend, so a per-cycle ``lax.cond`` was
+    measured to cost more than the superstep it skipped.  ``prune``
+    uses the same dense/compacted phase alternation as run_maxsum_from
+    (identical costs per cycle — pruning never changes values)."""
+    pt = prune_tables(graph) if prune else None
+    if pt is not None and all(t is None for t in pt):
+        pt = None
 
     def cost_of(values):
         cost = assignment_constraint_cost(graph, values)
@@ -400,18 +620,54 @@ def run_maxsum_trace(graph: CompiledFactorGraph, max_cycles: int, *,
                 var_base_costs, values[:, None], axis=1))
         return cost
 
-    def step(state, _):
-        state = superstep(
-            state, graph, damping=damping, damp_vars=damp_vars,
-            damp_factors=damp_factors, stability=stability,
-        )
-        beliefs, _ = aggregate_beliefs(graph, state.f2v)
-        values = select_values(graph, beliefs)
-        return state, cost_of(values)
+    def make_step(prune_t):
+        def step(carry):
+            state, costs, last = carry
+            state = superstep(
+                state, graph, damping=damping, damp_vars=damp_vars,
+                damp_factors=damp_factors, stability=stability,
+                prune=prune_t,
+            )
+            beliefs, _ = aggregate_beliefs(graph, state.f2v)
+            values = select_values(graph, beliefs)
+            cost = cost_of(values)
+            costs = jax.lax.dynamic_update_slice(
+                costs, cost[None], (state.cycle - 1,))
+            return state, costs, cost
+        return step
 
-    state, costs = jax.lax.scan(
-        step, init_state(graph), None, length=max_cycles
-    )
+    def done(carry):
+        state = carry[0]
+        out = state.cycle >= max_cycles
+        if stop_on_convergence:
+            out = out | state.stable
+        return out
+
+    zero = jnp.asarray(0.0, graph.var_costs.dtype)
+    carry = (init_state(graph),
+             jnp.zeros((max_cycles,), graph.var_costs.dtype), zero)
+    step_dense = make_step(None)
+    if pt is None:
+        carry = jax.lax.while_loop(
+            lambda c: ~done(c), step_dense, carry)
+    else:
+        step_fast = make_step(pt)
+
+        def phases(c):
+            c = jax.lax.while_loop(
+                lambda c: ~done(c) & ~prune_fits(c[0].v2f, pt),
+                step_dense, c)
+            c = jax.lax.while_loop(
+                lambda c: ~done(c) & prune_fits(c[0].v2f, pt),
+                step_fast, c)
+            return c
+
+        carry = jax.lax.while_loop(lambda c: ~done(c), phases, carry)
+    state, costs, last = carry
+    # Early exit leaves the tail unwritten: hold the final cost so
+    # the curve stays a valid anytime record at full length.
+    costs = jnp.where(
+        jnp.arange(max_cycles) >= state.cycle, last, costs)
     beliefs, _ = aggregate_beliefs(graph, state.f2v)
     values = select_values(graph, beliefs)
     return state, values, costs
@@ -421,6 +677,7 @@ def run_maxsum(graph: CompiledFactorGraph, max_cycles: int, *,
                damping: float = 0.5, damp_vars: bool = True,
                damp_factors: bool = True, stability: float = 0.1,
                stop_on_convergence: bool = True,
+               prune: bool = False,
                ) -> Tuple[MaxSumState, jnp.ndarray]:
     """Full MaxSum run in one XLA program (no host sync per cycle).
 
@@ -430,7 +687,7 @@ def run_maxsum(graph: CompiledFactorGraph, max_cycles: int, *,
         graph, init_state(graph), max_cycles,
         damping=damping, damp_vars=damp_vars,
         damp_factors=damp_factors, stability=stability,
-        stop_on_convergence=stop_on_convergence,
+        stop_on_convergence=stop_on_convergence, prune=prune,
     )
 
 
@@ -439,32 +696,82 @@ def run_maxsum_from(graph: CompiledFactorGraph, state: MaxSumState,
                     damping: float = 0.5, damp_vars: bool = True,
                     damp_factors: bool = True, stability: float = 0.1,
                     stop_on_convergence: bool = True,
+                    prune: bool = False,
                     ) -> Tuple[MaxSumState, jnp.ndarray]:
     """Run up to ``extra_cycles`` more supersteps from an existing state
     — the warm-start primitive for dynamic DCOPs: after a graph event
     the surviving messages stay in place and the trajectory continues
     instead of restarting from zero (SURVEY §7 "dynamic graphs ...
-    warm-starting messages")."""
+    warm-starting messages").
 
-    def step(state):
+    ``prune=True`` enables branch-and-bound pruning of the binary
+    factor→variable reductions (:func:`prune_tables`): the solve
+    becomes a pair of PHASE loops — a dense loop that runs while some
+    factor's survivor set overflows the static gather budget, and a
+    compacted fast loop that runs while every factor fits
+    (:func:`prune_fits` rides the loop conditions; each body is
+    entered only when its kernel is exact, so no per-cycle XLA
+    conditional and no hypercube-sized branch-operand copies).  The
+    two kernels produce the same values wherever both are legal, so
+    the pruned trajectory equals the dense one — pruning changes
+    wall-clock, never results."""
+    pt = prune_tables(graph) if prune else None
+    if pt is not None and all(t is None for t in pt):
+        pt = None
+
+    limit = state.cycle + extra_cycles
+
+    def done(s):
+        out = s.cycle >= limit
+        if stop_on_convergence:
+            out = out | s.stable
+        return out
+
+    def step_dense(s):
         return superstep(
-            state, graph, damping=damping, damp_vars=damp_vars,
+            s, graph, damping=damping, damp_vars=damp_vars,
             damp_factors=damp_factors, stability=stability,
         )
 
-    limit = state.cycle + extra_cycles
-    if stop_on_convergence:
-        state = jax.lax.while_loop(
-            lambda s: (s.cycle < limit) & ~s.stable,
-            step,
-            state,
-        )
+    if pt is None:
+        # The pre-pruning loop, kept VERBATIM (cond form included):
+        # even a logically-equivalent condition rewrite compiles a
+        # different XLA program, and on mesh runs a different fusion
+        # reassociates the all-reduce enough to flip near-tied
+        # argmins — the sharded bit-parity tests pin this.
+        if stop_on_convergence:
+            state = jax.lax.while_loop(
+                lambda s: (s.cycle < limit) & ~s.stable,
+                step_dense,
+                state,
+            )
+        else:
+            state = jax.lax.while_loop(
+                lambda s: s.cycle < limit,
+                step_dense,
+                state,
+            )
     else:
-        state = jax.lax.while_loop(
-            lambda s: s.cycle < limit,
-            step,
-            state,
-        )
+        def step_fast(s):
+            return superstep(
+                s, graph, damping=damping, damp_vars=damp_vars,
+                damp_factors=damp_factors, stability=stability,
+                prune=pt,
+            )
+
+        def fits(s):
+            return prune_fits(s.v2f, pt)
+
+        def phases(s):
+            # Each outer iteration makes progress: whichever inner
+            # condition holds first steps at least one cycle.
+            s = jax.lax.while_loop(
+                lambda s: ~done(s) & ~fits(s), step_dense, s)
+            s = jax.lax.while_loop(
+                lambda s: ~done(s) & fits(s), step_fast, s)
+            return s
+
+        state = jax.lax.while_loop(lambda s: ~done(s), phases, state)
     beliefs, _ = aggregate_beliefs(graph, state.f2v)
     values = select_values(graph, beliefs)
     return state, values

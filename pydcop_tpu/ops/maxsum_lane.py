@@ -297,17 +297,29 @@ def assignment_constraint_cost(graph: LaneGraph,
     return total
 
 
+def _reject_prune(prune: bool):
+    """Branch-and-bound pruning is an edge-major kernel (it gathers
+    reduction rows of the [F, D, D] hypercubes); the lane layout's
+    transposed messages would need their own compaction.  The engine
+    refuses layout='lane' + prune at construction — this guard keeps
+    the ops-level contract explicit for direct callers."""
+    if prune:
+        raise NotImplementedError(
+            "prune=True is edge-major only; run with layout='edge'")
+
+
 def run_maxsum(graph: LaneGraph, max_cycles: int, *,
                damping: float = 0.5, damp_vars: bool = True,
                damp_factors: bool = True, stability: float = 0.1,
                stop_on_convergence: bool = True,
+               prune: bool = False,
                ) -> Tuple[LaneState, jnp.ndarray]:
     """Full lane-major MaxSum run in one XLA program."""
     return run_maxsum_from(
         graph, init_state(graph), max_cycles,
         damping=damping, damp_vars=damp_vars,
         damp_factors=damp_factors, stability=stability,
-        stop_on_convergence=stop_on_convergence,
+        stop_on_convergence=stop_on_convergence, prune=prune,
     )
 
 
@@ -316,7 +328,10 @@ def run_maxsum_from(graph: LaneGraph, state: LaneState,
                     damping: float = 0.5, damp_vars: bool = True,
                     damp_factors: bool = True, stability: float = 0.1,
                     stop_on_convergence: bool = True,
+                    prune: bool = False,
                     ) -> Tuple[LaneState, jnp.ndarray]:
+    _reject_prune(prune)
+
     def step(state):
         return superstep(
             state, graph, damping=damping, damp_vars=damp_vars,
@@ -341,10 +356,15 @@ def run_maxsum_trace(graph: LaneGraph, max_cycles: int, *,
                      damping: float = 0.5, damp_vars: bool = True,
                      damp_factors: bool = True, stability: float = 0.1,
                      var_base_costs: Optional[jnp.ndarray] = None,
+                     stop_on_convergence: bool = True,
+                     prune: bool = False,
                      ) -> Tuple[LaneState, jnp.ndarray, jnp.ndarray]:
-    """Lane-major twin of ops/maxsum.run_maxsum_trace.
+    """Lane-major twin of ops/maxsum.run_maxsum_trace (same while_loop
+    + carried-cost-buffer structure, same early exit at the fixpoint
+    with the tail of the curve holding the final cost).
     ``var_base_costs`` is [V, Dmax] edge-major (FactorGraphMeta
     convention) — transposed once here, not per cycle."""
+    _reject_prune(prune)
     base_t = None if var_base_costs is None else var_base_costs.T
 
     def cost_of(values):
@@ -354,18 +374,34 @@ def run_maxsum_trace(graph: LaneGraph, max_cycles: int, *,
                 base_t, values[None, :], axis=0))
         return cost
 
-    def step(state, _):
+    def step(carry):
+        state, costs, last = carry
         state = superstep(
             state, graph, damping=damping, damp_vars=damp_vars,
             damp_factors=damp_factors, stability=stability,
         )
         beliefs, _ = aggregate_beliefs(graph, state.f2v)
         values = select_values(graph, beliefs)
-        return state, cost_of(values)
+        cost = cost_of(values)
+        costs = jax.lax.dynamic_update_slice(
+            costs, cost[None], (state.cycle - 1,))
+        return state, costs, cost
 
-    state, costs = jax.lax.scan(
-        step, init_state(graph), None, length=max_cycles
+    def done(carry):
+        state = carry[0]
+        out = state.cycle >= max_cycles
+        if stop_on_convergence:
+            out = out | state.stable
+        return out
+
+    zero = jnp.asarray(0.0, graph.var_costs.dtype)
+    state, costs, last = jax.lax.while_loop(
+        lambda c: ~done(c), step,
+        (init_state(graph),
+         jnp.zeros((max_cycles,), graph.var_costs.dtype), zero),
     )
+    costs = jnp.where(
+        jnp.arange(max_cycles) >= state.cycle, last, costs)
     beliefs, _ = aggregate_beliefs(graph, state.f2v)
     values = select_values(graph, beliefs)
     return state, values, costs

@@ -433,6 +433,14 @@ def resume_from_checkpoint(engine, manager, max_cycles: int = 1000,
     initial_state = None
     resumed_cycle = 0
     template = engine.init_state()
+    if run_kwargs.get("decimation") is not None:
+        # Decimated snapshots bundle the clamp set with the solver
+        # state (engine/runner.DecimationState) — restore into the
+        # matching structure so resume-mid-decimation continues the
+        # exact clamped problem, not the original one.
+        from pydcop_tpu.engine.runner import decimation_template
+
+        template = decimation_template(engine, template)
     # Newest-first over every snapshot on disk: load_state re-verifies
     # the checksum, so a snapshot that rots between listing and load
     # falls back to the next older one instead of resuming from

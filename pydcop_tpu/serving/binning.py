@@ -20,9 +20,11 @@ from typing import Any, Dict, Tuple
 from pydcop_tpu.engine.compile import CompiledFactorGraph
 
 # Solver parameters that are static in the batched program — the
-# params half of the bin key, in canonical order.
+# params half of the bin key, in canonical order.  ``prune`` rides in
+# the key because the pruned and dense batched programs are different
+# executables (same results — pruning never changes values).
 PARAM_KEYS = ("max_cycles", "damping", "damping_nodes", "stability",
-              "noise")
+              "noise", "prune")
 
 DEFAULT_PARAMS: Dict[str, Any] = {
     "max_cycles": 200,
@@ -30,6 +32,11 @@ DEFAULT_PARAMS: Dict[str, Any] = {
     "damping_nodes": "both",
     "stability": 0.1,
     "noise": 0.01,
+    # 0 = dense, 1 = branch-and-bound pruning, "auto" = replay the
+    # portfolio racer's cached decision for this structure (resolved
+    # to 0/1 at submit, AFTER the graph compiles — never measured on
+    # the serving path).
+    "prune": 0,
 }
 
 
@@ -58,6 +65,15 @@ def normalize_params(overrides: Dict[str, Any] = None) -> Dict[str, Any]:
             params[key] = float(params[key])
     except (TypeError, ValueError) as exc:
         raise ValueError(f"bad solver parameter value: {exc}")
+    if params["prune"] != "auto":
+        try:
+            params["prune"] = int(params["prune"])
+        except (TypeError, ValueError):
+            params["prune"] = -1  # falls through to the check below
+    if params["prune"] not in (0, 1, "auto"):
+        raise ValueError(
+            f"prune must be 0, 1 or 'auto', got "
+            f"{(overrides or {}).get('prune')!r}")
     if params["damping_nodes"] not in DAMPING_NODES:
         raise ValueError(
             f"damping_nodes must be one of {DAMPING_NODES}, got "

@@ -178,6 +178,8 @@ class SolveService:
         self.expired = 0
         self.replayed = 0
         self.dispatch_retries = 0
+        # prune="auto" submits resolved through the portfolio cache.
+        self.portfolio_resolved = 0
         self.last_stop: Optional[Dict[str, Any]] = None
         reg = metrics_registry
         self._req_total = reg.counter(
@@ -419,6 +421,25 @@ class SolveService:
             merged = binning.normalize_params(merged)
             graph, meta = compile_dcop(
                 dcop, noise_level=merged["noise"])
+            if merged["prune"] == "auto":
+                # Consume the portfolio racer's persisted decision
+                # for this structure (engine/autotune): pruned maxsum
+                # when it won the race, dense otherwise.  Replay
+                # only — the serving hot path never measures; a cache
+                # miss resolves dense.  Resolved BEFORE the bin key,
+                # so a bin is homogeneous in the compiled program it
+                # dispatches.
+                from pydcop_tpu.engine.autotune import (
+                    cached_portfolio_choice,
+                    graph_shape_key,
+                    portfolio_key,
+                )
+
+                choice = cached_portfolio_choice(
+                    portfolio_key(graph_shape_key(graph)))
+                merged["prune"] = 1 if choice == "maxsum_prune" else 0
+                with self._lock:
+                    self.portfolio_resolved += 1
             req = SolveRequest(
                 id=request_id or f"r{next(self._ids)}",
                 dcop=dcop, graph=graph, meta=meta, params=merged,
@@ -790,6 +811,7 @@ class SolveService:
             damping_nodes=params["damping_nodes"],
             stability=params["stability"],
             pad_to_bins=self.bin_sizes,
+            prune=bool(params.get("prune", 0)),
         )
 
     def _finish_error(self, req: SolveRequest, message: str):
@@ -911,6 +933,7 @@ class SolveService:
             "expired": self.expired,
             "replayed": self.replayed,
             "dispatch_retries": self.dispatch_retries,
+            "portfolio_resolved": self.portfolio_resolved,
             "journal": (self.journal_dir
                         if self._journal is not None else None),
             "tracked_requests": tracked,

@@ -112,6 +112,12 @@ def load_history(root: str) -> List[Dict[str, Any]]:
                               if sharded_value is not None else None),
             "sharded_backend": parsed.get("sharded_backend")
             or parsed.get("backend") or "cpu",
+            # Time-to-target-cost leg (ISSUE 10 bench_time_to_cost):
+            # milliseconds the pruned engine takes to reach the
+            # reference cost on the large-domain loopy graph — LOWER
+            # is better; absent before PR 10.
+            "ttc_value": _opt_float(
+                parsed.get("maxsum_time_to_cost_ms")),
             # Recovery-latency legs (ISSUE 8 bench_recovery_replay /
             # bench_sharded): seconds, LOWER is better — absent
             # before PR 8, None when the leg failed that round.
@@ -231,6 +237,10 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
         ("serve", "serve_value", "problems/s", "backend", True),
         ("sharded", "sharded_value", "cycles/s",
          "sharded_backend", True),
+        # ISSUE 10: wall-clock to the reference cost on the
+        # large-domain loopy graph (bench_time_to_cost) — the
+        # work-reduction stack's headline, LOWER is better.
+        ("time_to_cost", "ttc_value", "ms", "backend", False),
         ("serve_recovery", "serve_recovery_value", "s",
          "backend", False),
         ("shard_recovery", "shard_recovery_value", "s",

@@ -320,6 +320,83 @@ def scenario_guard_noop_device(seed, trace):
     return {"cycles": res.cycles}
 
 
+def scenario_decimation_guard_trip(seed, trace):
+    """Guard trip mid-decimation (ISSUE 10): the rollback must restore
+    the CLAMP SET together with the snapshot — resuming the
+    rolled-back messages under a stale (newer) active-edge mask would
+    silently solve a different problem.  Asserted: the trip and the
+    clamp-set rollback both happened, per-segment decimated counts are
+    monotone EXCEPT exactly across the rollback (the decimation
+    analogue of the monotone-cycle invariant), the healed run still
+    fixes every variable and ends with a valid assignment."""
+    from pydcop_tpu.algorithms.maxsum import build_engine
+    from pydcop_tpu.engine.runner import DecimationPlan
+    from pydcop_tpu.resilience.recovery import RecoveryPolicy
+
+    dcop = ring_dcop()
+
+    class FixedCountProbe:
+        """Records the engine's decimated count per validated
+        segment (on_segment fires only for validated states)."""
+
+        def __init__(self, decim_run_ref):
+            self.counts = []
+            self._ref = decim_run_ref
+
+        def on_segment(self, state, values, run_s, compile_s):
+            self.counts.append(int(self._ref[0].fixed.sum())
+                               if self._ref[0] is not None else 0)
+
+    engine = build_engine(dcop, {})
+    # Reach into the run via a mutable ref the probe reads: the
+    # engine constructs its _DecimationRun internally.
+    ref = [None]
+    orig_run = engine.run_checkpointed
+
+    def run_with_ref(**kw):
+        import pydcop_tpu.engine.runner as runner_mod
+
+        orig_cls = runner_mod._DecimationRun
+
+        class Capturing(orig_cls):
+            def __init__(self, *a, **k):
+                super().__init__(*a, **k)
+                ref[0] = self
+
+        runner_mod._DecimationRun = Capturing
+        try:
+            return orig_run(**kw)
+        finally:
+            runner_mod._DecimationRun = orig_cls
+
+    probe = FixedCountProbe(ref)
+    res = run_with_ref(
+        max_cycles=400, segment_cycles=10,
+        decimation=DecimationPlan(frac_per_round=0.25,
+                                  cycles_per_round=10),
+        recovery=RecoveryPolicy(trip_cycles=(25,), noise_seed=seed),
+        probe=probe,
+    )
+    assert res.metrics["guard_trips"] == 1
+    assert res.metrics["recovery_attempts"] == 1
+    assert res.metrics["decimation_rollbacks"] == 1, \
+        "guard trip did not roll the clamp set back with the snapshot"
+    assert res.metrics["decimated_vars"] == len(dcop.variables), \
+        "healed decimated run left variables unclamped"
+    assert res.metrics["decimated_fraction"] == 1.0
+    assert res.metrics["active_edges"] == 0
+    assert_valid_assignment(dcop, res.assignment)
+    # Monotone-decimation invariant: the validated per-segment counts
+    # never decrease (a decrease would mean a stale mask leaked past
+    # a rollback into a validated segment).
+    counts = probe.counts
+    assert all(b >= a for a, b in zip(counts, counts[1:])), \
+        f"validated decimated counts ran backwards: {counts}"
+    return {"decimated": res.metrics["decimated_vars"],
+            "rounds": res.metrics["decimation_rounds"],
+            "segment_counts": counts}
+
+
 def scenario_checkpoint_corruption(seed, trace):
     """Torn-write simulation: truncate the newest snapshot mid-file;
     resume must fall back to the previous VALID snapshot and still
@@ -598,6 +675,7 @@ SCENARIOS = [
     ("serve_poison_bin", scenario_serve_poison_bin),
     ("shard_trip_repartition", scenario_shard_trip_repartition),
     ("anomaly_postmortem", scenario_anomaly_postmortem),
+    ("decimation_guard_trip", scenario_decimation_guard_trip),
 ]
 
 # The `make test` gate (--quick): the DEVICE-SIDE failure classes —
@@ -616,6 +694,7 @@ QUICK_GATE = [
     "serve_poison_bin",
     "shard_trip_repartition",
     "anomaly_postmortem",
+    "decimation_guard_trip",
 ]
 
 
