@@ -42,6 +42,33 @@ PARITY_TIMEOUT_S = 8.0
 # cycles' worth of improvement.
 QUALITY_TOL_FRAC = 0.025
 
+# Per-leg backend resolution (ROADMAP open item 5 crumb): five
+# straight rounds silently fell back to CPU and only the post-hoc
+# probe log said why.  Every leg now records the backend it ACTUALLY
+# resolved plus the accelerator-probe outcome at that moment
+# (mirroring the /healthz ``accelerator_probe`` body), emitted as
+# ``leg_backends`` in the JSON line — the next CPU-fallback round is
+# self-explaining per leg, not per process.
+_LEG_BACKENDS = {}
+
+
+def record_leg_backend(leg: str):
+    """Snapshot the resolved backend + probe state for one leg."""
+    import jax
+
+    from pydcop_tpu.utils.cleanenv import diag_events, is_probe_failure
+
+    failures = [e for e in diag_events() if is_probe_failure(e)]
+    last = failures[-1] if failures else None
+    _LEG_BACKENDS[leg] = {
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "probe_failures": len(failures),
+        "last_probe_event": last.get("event") if last else None,
+        "last_probe_error": last.get("error") if last else None,
+    }
+    return _LEG_BACKENDS[leg]
+
 
 def build_dcop(n_vars: int, seed: int = 0):
     """n_vars-variable 3-coloring: cost-1 equality penalty per edge,
@@ -828,6 +855,202 @@ def build_dcop_small(n_vars: int, seed: int):
     return dcop
 
 
+# Mixed-structure serving leg (ISSUE 11): zipf-distributed DISTINCT
+# topologies — the production-shaped traffic on which pure structure
+# binning degenerates to batch-size-1.  The leg runs the same seeded
+# request stream twice, envelope packing ON and OFF, so the JSON line
+# carries both the envelope throughput and the no-envelope baseline it
+# must beat.
+SERVE_MIXED_STRUCTS = 24
+SERVE_MIXED_CLIENTS = 8
+SERVE_MIXED_DURATION_S = 4.0
+SERVE_MIXED_WINDOW_S = 0.005
+SERVE_MIXED_MAX_CYCLES = 60
+SERVE_MIXED_ZIPF_A = 1.05
+
+
+def build_dcop_mixed(struct_idx: int, seed: int):
+    """One of SERVE_MIXED_STRUCTS structurally DISTINCT small
+    colorings: the ring size (``14 + 3*struct_idx`` — distinct per
+    index, which alone guarantees distinct structure signatures) plus
+    ``struct_idx % 4`` half-way chords, so the edge count varies too;
+    different seeds only change cost tables."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(seed)
+    n_vars = 14 + 3 * struct_idx
+    dom = Domain("colors", "color", list(range(N_COLORS)))
+    dcop = DCOP(f"mix{struct_idx}_{seed}", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in vs:
+        dcop.add_variable(v)
+    edges = [(i, (i + 1) % n_vars) for i in range(n_vars)]
+    edges += [(i, (i + n_vars // 2) % n_vars)
+              for i in range(struct_idx % 4)]
+    seen = set()
+    for k, (i, j) in enumerate(edges):
+        if i == j or (min(i, j), max(i, j)) in seen:
+            continue
+        seen.add((min(i, j), max(i, j)))
+        table = rng.integers(0, 10, size=(N_COLORS, N_COLORS))
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[j]], table.astype(float), f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def bench_serving_mixed():
+    """Sustained throughput under zipf-diverse structures, envelope
+    packing ON vs OFF on the same seeded stream.  Emits
+    ``serve_mixed_problems_per_sec`` (the sentinel family) +
+    latency percentiles + ``serve_mixed_batched_fraction`` (requests
+    that shared a device dispatch — ~0 without envelopes on this
+    traffic) and the no-envelope baseline keys."""
+    import threading
+
+    from pydcop_tpu.serving.service import SolveService
+
+    # Structure frequencies: zipf over ranks, so a couple of
+    # structures dominate and a long tail stays rare — the worst case
+    # for pure structure binning (the tail never coalesces).
+    ranks = np.arange(1, SERVE_MIXED_STRUCTS + 1, dtype=float)
+    probs = ranks ** -SERVE_MIXED_ZIPF_A
+    probs /= probs.sum()
+    pool = {
+        s: [build_dcop_mixed(s, seed) for seed in range(4)]
+        for s in range(SERVE_MIXED_STRUCTS)
+    }
+
+    def run_once(envelope_packing: bool,
+                 duration_s: float = SERVE_MIXED_DURATION_S):
+        service = SolveService(
+            max_queue=512, batch_window_s=SERVE_MIXED_WINDOW_S,
+            max_batch=16,
+            envelope_packing=envelope_packing).start()
+        try:
+            params = {"max_cycles": SERVE_MIXED_MAX_CYCLES}
+            # Warm pass 1: one request per structure, submit-and-WAIT
+            # so each dispatches solo — compiles the layouts and the
+            # per-structure solo programs (what leftover singleton
+            # groups and the whole no-envelope run reuse; submitted
+            # together they would coalesce into one packed dispatch
+            # and leave every solo program cold).
+            for s in range(SERVE_MIXED_STRUCTS):
+                service.result(
+                    service.submit(pool[s][0], params=params),
+                    wait=60)
+            # Warm pass 1b: exact-tier bin programs — same-structure
+            # pairs for every structure, plus bin-4 for the zipf head
+            # (the sizes structure collisions actually produce).
+            for s in range(SERVE_MIXED_STRUCTS):
+                for size in ((2, 4) if s < 6 else (2,)):
+                    burst = [service.submit(pool[s][i % 4],
+                                            params=params)
+                             for i in range(size)]
+                    for rid in burst:
+                        service.result(rid, wait=60)
+            # Warm pass 2: concurrent mixed bursts of several sizes —
+            # compiles the packed-union programs on the rungs real
+            # group compositions land on (binning.UNION_LADDER bounds
+            # these; v and row rungs correlate, so a spread of burst
+            # sizes covers the set).  Exact-tier bin programs warm
+            # organically in the discardable pre-runs below — the jit
+            # cache is process-global, so without identical warm
+            # treatment whichever measured run went first would eat
+            # every compile and the comparison would be ordering
+            # noise, not packing.
+            for size in (2, 3, 5, 8, 12, SERVE_MIXED_STRUCTS):
+                burst = [service.submit(pool[s % SERVE_MIXED_STRUCTS]
+                                        [1], params=params)
+                         for s in range(size)]
+                for rid in burst:
+                    service.result(rid, wait=60)
+            stats0 = service.stats()
+            latencies = []
+            completed = [0]
+            lock = threading.Lock()
+            t_end = time.perf_counter() + duration_s
+
+            def client(idx):
+                rng = np.random.default_rng(1000 + idx)
+                i = 0
+                while time.perf_counter() < t_end:
+                    s = int(rng.choice(SERVE_MIXED_STRUCTS, p=probs))
+                    dcop = pool[s][i % 4]
+                    i += 1
+                    t0 = time.perf_counter()
+                    rid = service.submit(dcop, params=params)
+                    res = service.result(rid, wait=60)
+                    t1 = time.perf_counter()
+                    if res is not None and res["status"] == "FINISHED":
+                        with lock:
+                            latencies.append(t1 - t0)
+                            completed[0] += 1
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(SERVE_MIXED_CLIENTS)]
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=duration_s + 120)
+            elapsed = time.perf_counter() - t_start
+            stats = service.stats()
+        finally:
+            service.stop(drain=False)
+        if not latencies or elapsed <= 0:
+            return None
+        lat_ms = np.asarray(latencies) * 1e3
+        # Window-only ledger deltas: the warm passes batched too and
+        # must not inflate the fraction.
+        batched = (stats["batched_requests"]
+                   - stats0["batched_requests"])
+        return {
+            "pps": round(completed[0] / elapsed, 2),
+            "p50": round(float(np.percentile(lat_ms, 50)), 2),
+            "p99": round(float(np.percentile(lat_ms, 99)), 2),
+            "requests": completed[0],
+            # Fraction of completed requests that SHARED their device
+            # dispatch — the number that collapses on this traffic
+            # without the envelope tier.
+            "batched_fraction": round(
+                min(batched / completed[0], 1.0), 3)
+                if completed[0] else None,
+            "envelope_dispatches": (stats["envelope_dispatches"]
+                                    - stats0["envelope_dispatches"]),
+            "lane_dispatches": (stats["lane_dispatches"]
+                                - stats0["lane_dispatches"]),
+        }
+
+    # Discardable pre-runs (1 s each): the jit caches and process
+    # state are GLOBAL, so whichever measured run went first would
+    # eat every residual compile and donate its warmth to the other.
+    # After one short pass per configuration both measured runs see
+    # the same fully-warmed process.
+    run_once(True, duration_s=2.0)
+    run_once(False, duration_s=2.0)
+    on = run_once(True)
+    off = run_once(False)
+    if on is None:
+        return {"serve_mixed_problems_per_sec": None}
+    out = {
+        "serve_mixed_problems_per_sec": on["pps"],
+        "serve_mixed_p50_ms": on["p50"],
+        "serve_mixed_p99_ms": on["p99"],
+        "serve_mixed_requests": on["requests"],
+        "serve_mixed_batched_fraction": on["batched_fraction"],
+        "serve_mixed_envelope_dispatches": on["envelope_dispatches"],
+        "serve_mixed_lane_dispatches": on["lane_dispatches"],
+    }
+    if off is not None:
+        out["serve_mixed_baseline_problems_per_sec"] = off["pps"]
+        out["serve_mixed_baseline_batched_fraction"] = \
+            off["batched_fraction"]
+    return out
+
+
 def run_bench():
     import jax
 
@@ -848,6 +1071,7 @@ def run_bench():
     dcop = build_dcop(N_VARS)
     if platform != "tpu":
         _try_revive_tpu()   # re-probe right before the headline leg
+    record_leg_backend("headline")
     device_cps, res, engine = bench_device(dcop, DEVICE_CYCLES)
     thread_cps, thread_cycles, thread_cost, _asg = bench_thread(
         dcop, THREAD_TIMEOUT_S)
@@ -866,6 +1090,7 @@ def run_bench():
         }
         out.update(_artifact_keys(platform, out))
         out["probe_diagnostics"] = diag_events()
+        out["leg_backends"] = dict(_LEG_BACKENDS)
         print(json.dumps(out))
         return
 
@@ -1028,6 +1253,7 @@ def run_bench():
     # stack's headline; sentinel family "time_to_cost", lower is
     # better).  Never kills the headline line.
     try:
+        record_leg_backend("time_to_cost")
         ttc_keys = bench_time_to_cost()
     except Exception as exc:  # noqa: BLE001 — auxiliary leg
         print(f"bench: time-to-cost leg failed ({exc}); continuing",
@@ -1038,16 +1264,31 @@ def run_bench():
     # on the CPU fallback too, and its trajectory is what the
     # sentinel tracks per backend).  Never kills the headline line.
     try:
+        record_leg_backend("serve")
         serve_keys = bench_serving()
     except Exception as exc:  # noqa: BLE001 — auxiliary leg
         print(f"bench: serving leg failed ({exc}); continuing",
               file=sys.stderr)
         serve_keys = {"serve_problems_per_sec": None,
                       "serve_error": f"{type(exc).__name__}: {exc}"[:200]}
+    # Mixed-structure serving leg (ISSUE 11): zipf-diverse topologies,
+    # envelope packing vs the no-envelope baseline on the same stream;
+    # sentinel family "serve_mixed".  Never kills the headline line.
+    try:
+        record_leg_backend("serve_mixed")
+        serve_keys.update(bench_serving_mixed())
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: mixed serving leg failed ({exc}); continuing",
+              file=sys.stderr)
+        serve_keys.update({
+            "serve_mixed_problems_per_sec": None,
+            "serve_mixed_error":
+                f"{type(exc).__name__}: {exc}"[:200]})
     # Crash-recovery replay leg: journal scan + replay downtime —
     # the sentinel tracks it per backend like any other metric, so a
     # change that slows recovery is a tracked regression.
     try:
+        record_leg_backend("serve_recovery")
         serve_keys.update(bench_recovery_replay())
     except Exception as exc:  # noqa: BLE001 — auxiliary leg
         print(f"bench: recovery-replay leg failed ({exc}); "
@@ -1060,6 +1301,7 @@ def run_bench():
     # Sharded-superstep leg: real mesh on TPU (when the tunnel gave
     # us more than one chip), forced-host-device child on CPU.
     try:
+        record_leg_backend("sharded")
         if platform == "tpu" and len(jax.devices()) >= 2:
             shard_keys = bench_sharded(
                 min(SHARDED_SHARDS, len(jax.devices())))
@@ -1108,6 +1350,7 @@ def run_bench():
     }
     out.update(_artifact_keys(platform, out))
     out["probe_diagnostics"] = diag_events()
+    out["leg_backends"] = dict(_LEG_BACKENDS)
     print(json.dumps(out))
 
 

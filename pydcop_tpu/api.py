@@ -339,6 +339,8 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           journal_dir: Optional[str] = None,
           journal_sync: bool = False,
           recover: bool = False,
+          envelope_packing: bool = True,
+          envelope_overhead_ms: Optional[float] = None,
           block: bool = False) -> Optional[ServeHandle]:
     """Start the multi-tenant solve service (docs/serving.md).
 
@@ -348,6 +350,15 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     per request with latency accounting.  The front end serves
     ``POST /solve`` / ``GET /result/<id>`` / ``GET /stats`` plus the
     live telemetry routes (``/metrics``, ``/healthz``, ``/events``).
+
+    Different-structure requests that structure binning would
+    dispatch solo are additionally packed into shape-envelope
+    dispatches when a per-flush cost model says the padded batch
+    beats solo dispatches (``envelope_packing``, on by default —
+    results stay bit-identical to solo solves;
+    ``envelope_overhead_ms`` tunes the modeled per-dispatch fixed
+    cost the decision weighs against padding waste — docs/serving.md
+    "Envelope batching").
 
     Admission control: a submit past the queue's ``high_water``
     (default ``max_queue``) is rejected with 429; repeated dispatch
@@ -388,6 +399,8 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
         journal_dir=journal_dir,
         journal_sync=journal_sync,
         recover=recover,
+        envelope_packing=envelope_packing,
+        envelope_overhead_ms=envelope_overhead_ms,
     ).start()
     try:
         front_end = ServeFrontEnd(service, port=port, host=host).start()

@@ -70,6 +70,19 @@ def set_parser(subparsers):
                         help="fsync the journal per record "
                              "(machine-crash durability; the default "
                              "flush already survives a process kill)")
+    parser.add_argument("--no_envelope", "--no-envelope",
+                        action="store_true",
+                        help="disable the envelope batching tier: "
+                             "different-structure requests always "
+                             "dispatch solo (docs/serving.md "
+                             "\"Envelope batching\")")
+    parser.add_argument("--envelope_overhead_ms",
+                        "--envelope-overhead-ms",
+                        type=float, default=None, metavar="MS",
+                        help="modeled per-dispatch fixed cost the "
+                             "envelope pack-vs-solo decision weighs "
+                             "against padding waste (default 0.3; "
+                             "raise to pack more aggressively)")
     parser.add_argument("--flight_recorder_events",
                         "--flight-recorder-events",
                         type=int, default=None, metavar="N",
@@ -105,6 +118,8 @@ def run_cmd(args) -> int:
         journal_dir=args.journal_dir,
         journal_sync=args.journal_sync,
         recover=args.recover,
+        envelope_packing=not args.no_envelope,
+        envelope_overhead_ms=args.envelope_overhead_ms,
         block=True,
     )
     return 0

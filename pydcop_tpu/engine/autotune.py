@@ -389,6 +389,33 @@ def cached_portfolio_choice(key: str,
     return None
 
 
+# Public alias: consumers of the cached race timings (the serving
+# envelope cost model scales them to a request's cycle budget) need
+# the cycle count the race actually ran.
+PORTFOLIO_RACE_CYCLES = _PORTFOLIO_RACE_CYCLES
+
+
+def cached_portfolio_timing_ms(key: str,
+                               cache_file: Optional[str] = None
+                               ) -> Optional[float]:
+    """The persisted portfolio WINNER's measured race time (ms over
+    :data:`PORTFOLIO_RACE_CYCLES` cycles of the real compiled graph)
+    for ``key`` — a free per-structure solve-time prior.  The serving
+    scheduler's envelope pack-vs-solo cost model consumes it
+    (serving/binning.solve_prior_ms): a structure the portfolio racer
+    ever measured gets a real number instead of a cells*cycles
+    estimate, at zero measurement cost on the serving path.  None on
+    miss/invalid/unmeasured-winner."""
+    cached = _load_cache(cache_file or cache_path()).get(key)
+    if isinstance(cached, dict) \
+            and cached.get("algo") in PORTFOLIO_CANDIDATES:
+        timing = (cached.get("portfolio_timings_ms")
+                  or {}).get(cached["algo"])
+        if isinstance(timing, (int, float)) and timing > 0:
+            return float(timing)
+    return None
+
+
 def _portfolio_runners(graph: CompiledFactorGraph, race_cycles: int,
                        meta=None):
     """Build (name -> zero-arg callable returning final cost) over the

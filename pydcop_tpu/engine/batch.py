@@ -29,6 +29,28 @@ them through :func:`run_stacked`.  Two serving-driven extensions:
   so every padded dispatch reports ``pad_fraction`` (padded lanes /
   batch size) in ``DeviceRunResult.metrics`` — the serving
   batch-occupancy telemetry reads it instead of guessing.
+
+Two heterogeneous-structure extensions (ISSUE 11) relax the
+same-shape contract for the serving tier WITHOUT giving up
+bit-identical per-request results:
+
+- **Shape-envelope stacking.** :func:`pad_graph_to_envelope` mask-pads
+  a compiled graph up to a shape envelope (serving/binning.Envelope):
+  extra domain slots get ``BIG`` cost and ``var_valid=False`` (the
+  compile-time domain-padding discipline), extra variable rows are
+  dead invalid rows, and extra bucket rows are zero-cost rows pointing
+  at the sentinel variable (the PR-7 autopad pattern) — every kernel
+  already masks all three, so a padded graph's real variables see
+  bit-identical messages.  :func:`stack_to_envelope` pads a
+  *different*-structure group to one envelope and stacks it for a
+  single vmapped dispatch; ``run_stacked(envelope=...)`` reports
+  honest per-lane ``envelope_waste`` next to ``pad_fraction``.
+
+- **Lane packing.** :func:`run_lane_packed` routes a tiny-domain group
+  through ops/maxsum_lane instead: the graphs are concatenated into
+  one disjoint-union factor graph (factors on the lane axis, no
+  per-member shape padding at all — the only mask waste is the shared
+  domain rung), solved as one program, and sliced back per member.
 """
 
 import contextlib
@@ -42,7 +64,9 @@ import numpy as np
 
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.engine.compile import (
+    BIG,
     CompiledFactorGraph,
+    FactorBucket,
     FactorGraphMeta,
     compile_dcop,
 )
@@ -107,6 +131,123 @@ def pad_to_bin(
     return padded, n_real, (target - n_real) / target
 
 
+def _array_cells(graph: CompiledFactorGraph) -> int:
+    """Total var-table + bucket-hypercube elements (the waste unit).
+    ONE definition, shared with the scheduler's cost model: the
+    pack-vs-solo decision (serving/binning.pack_decision) and the
+    reported ``envelope_waste`` must never drift apart."""
+    from pydcop_tpu.serving.binning import graph_cells
+
+    return graph_cells(graph)
+
+
+def pad_graph_to_envelope(graph: CompiledFactorGraph,
+                          env) -> CompiledFactorGraph:
+    """Mask-pad a compiled graph up to a shape envelope
+    (serving/binning.Envelope, duck-typed: ``v_env``/``d_env``/
+    ``rows``).  Every padding element is inert by the same masking the
+    compiler already emits, so the padded graph's real variables
+    compute BIT-IDENTICAL messages (battery-asserted):
+
+    - domain slots ``d..d_env``: ``BIG`` cost, ``var_valid=False`` —
+      they never win a min-reduction, are excluded from the
+      mean-normalization and convergence test, and are masked out of
+      the final argmin;
+    - variable rows ``v..v_env``: invalid rows no factor references
+      (nothing scatters into them, their argmin result is dropped);
+    - bucket rows ``F..rows_env``: zero-cost rows whose ``var_ids``
+      all point at the sentinel row ``v_env`` (the PR-7 autopad
+      pattern — their messages aggregate into the sentinel row, which
+      every consumer drops).
+
+    The envelope must COVER the graph (each dimension >= the real
+    size, identical arity set) — a violated envelope raises instead of
+    silently truncating.  Aggregation arrays are dropped (scatter
+    path), matching the serving dispatch's compiled graphs.
+    """
+    v, d = graph.n_vars, graph.dmax
+    by_arity = {b.arity: b for b in graph.buckets}
+    env_rows = dict(env.rows)
+    if env.v_env < v or env.d_env < d:
+        raise ValueError(
+            f"envelope (v={env.v_env}, d={env.d_env}) does not cover "
+            f"graph (v={v}, d={d})")
+    if set(env_rows) != set(by_arity):
+        raise ValueError(
+            f"envelope arities {sorted(env_rows)} != graph arities "
+            f"{sorted(by_arity)}")
+    for a, b in by_arity.items():
+        if env_rows[a] < b.n_factors:
+            raise ValueError(
+                f"envelope rows {env_rows[a]} < {b.n_factors} factors "
+                f"at arity {a}")
+    if (env.v_env == v and env.d_env == d
+            and all(env_rows[a] == b.n_factors
+                    for a, b in by_arity.items())):
+        # Exact fit: nothing to pad, but the drop-aggregation-arrays
+        # contract still holds — an exact-fit member stacked next to
+        # padded members (agg fields None) must have the same pytree
+        # structure, and agg array shapes (e.g. ell's [V+1, K]) are
+        # not envelope-determined.
+        if all(a is None for a in (graph.agg_perm,
+                                   graph.agg_sorted_seg,
+                                   graph.agg_starts, graph.agg_ends,
+                                   graph.agg_ell)):
+            return graph
+        return CompiledFactorGraph(
+            var_costs=graph.var_costs, var_valid=graph.var_valid,
+            buckets=graph.buckets,
+        )
+
+    ve, de = env.v_env, env.d_env
+    dtype = graph.var_costs.dtype
+    var_costs = np.full((ve + 1, de), BIG, dtype=dtype)
+    var_costs[:v, :d] = np.asarray(graph.var_costs)[:v]
+    var_valid = np.zeros((ve + 1, de), dtype=bool)
+    var_valid[:v, :d] = np.asarray(graph.var_valid)[:v]
+
+    buckets = []
+    for a in sorted(env_rows):
+        b = by_arity[a]
+        n_facs = b.n_factors
+        costs = np.zeros((env_rows[a],) + (de,) * a,
+                         dtype=b.costs.dtype)
+        if n_facs:
+            block = np.full((n_facs,) + (de,) * a, BIG,
+                            dtype=b.costs.dtype)
+            block[(slice(None),) + (slice(0, d),) * a] = \
+                np.asarray(b.costs)
+            costs[:n_facs] = block
+        ids = np.full((env_rows[a], a), ve, dtype=np.int32)
+        real_ids = np.asarray(b.var_ids).copy()
+        # Re-point the graph's own sentinel (index v) at the
+        # envelope's (index ve) — compile-time padding rows must stay
+        # masked after the variable table grows.
+        real_ids[real_ids == v] = ve
+        ids[:n_facs] = real_ids
+        buckets.append(FactorBucket(costs=costs, var_ids=ids))
+    return CompiledFactorGraph(
+        var_costs=var_costs, var_valid=var_valid,
+        buckets=tuple(buckets),
+    )
+
+
+def stack_to_envelope(
+    graphs: Sequence[CompiledFactorGraph], env,
+) -> Tuple[List[CompiledFactorGraph], List[float]]:
+    """Pad a *different*-structure group up to one shape envelope so
+    it stacks (``stack_graphs``) into a single vmapped dispatch.
+    Returns ``(padded_graphs, envelope_waste)`` — per-member wasted
+    fraction of the envelope's cells (``1 - real/envelope``), the
+    honest-padding number ``run_stacked`` reports per dispatch."""
+    padded = [pad_graph_to_envelope(g, env) for g in graphs]
+    waste = [
+        round(1.0 - _array_cells(g) / max(_array_cells(p), 1), 4)
+        for g, p in zip(graphs, padded)
+    ]
+    return padded, waste
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -157,6 +298,7 @@ def run_stacked(
     stability: float = 0.1,
     pad_to_bins: Optional[Sequence[int]] = None,
     prune: bool = False,
+    envelope=None,
 ) -> Tuple[np.ndarray, np.ndarray, DeviceRunResult]:
     """One device dispatch over a stack of same-shaped compiled graphs.
 
@@ -173,9 +315,20 @@ def run_stacked(
     accounting — ``batch_size``, ``n_real``, ``pad_fraction``,
     ``cold_start`` — and whose ``assignment`` is empty (a batch has no
     single assignment; decode per instance via each meta).
+
+    ``envelope`` (a serving/binning.Envelope) lifts the same-shape
+    contract: every graph is mask-padded to the envelope's shapes
+    first (:func:`stack_to_envelope`), so *different*-structure
+    problems share the dispatch with bit-identical per-instance
+    results; the metrics then additionally carry ``envelope_waste``
+    (mean padded-cell fraction over real lanes) and
+    ``envelope_waste_lanes`` (per lane, dispatch order).
     """
     if not graphs:
         raise ValueError("run_stacked needs at least one graph")
+    envelope_waste: Optional[List[float]] = None
+    if envelope is not None:
+        graphs, envelope_waste = stack_to_envelope(graphs, envelope)
     n_real = len(graphs)
     pad_fraction = 0.0
     if pad_to_bins is not None:
@@ -231,7 +384,148 @@ def run_stacked(
             "converged_lanes": [bool(s) for s in stable],
         },
     )
+    if envelope_waste is not None:
+        batch_result.metrics["packing"] = "envelope"
+        batch_result.metrics["envelope_waste_lanes"] = envelope_waste
+        batch_result.metrics["envelope_waste"] = round(
+            sum(envelope_waste) / len(envelope_waste), 4
+        ) if envelope_waste else 0.0
     return values, cycles, batch_result
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_cycles", "damping", "damp_vars", "damp_factors",
+        "stability",
+    ),
+)
+def _lane_packed_solve(lane, *, max_cycles, damping, damp_vars,
+                       damp_factors, stability):
+    """One jitted lane-major solve of a packed union (see
+    ``run_lane_packed``); the suppression counters ride out so the
+    host can recover per-member convergence verdicts."""
+    from pydcop_tpu.ops import maxsum_lane as lane_ops
+
+    state, values = lane_ops.run_maxsum(
+        lane, max_cycles,
+        damping=damping, damp_vars=damp_vars,
+        damp_factors=damp_factors, stability=stability,
+        stop_on_convergence=False,
+    )
+    return values, state.cycle, state.v2f_count, state.f2v_count
+
+
+def run_lane_packed(
+    graphs: Sequence[CompiledFactorGraph],
+    max_cycles: int = 200,
+    damping: float = 0.5,
+    damping_nodes: str = "both",
+    stability: float = 0.1,
+    d_env: Optional[int] = None,
+    ladder=None,
+) -> Tuple[List[np.ndarray], np.ndarray, DeviceRunResult]:
+    """One device dispatch over a lane-packed DISJOINT UNION of
+    different-structure graphs (ops/maxsum_lane.pack_graphs): members
+    concatenate along the variable axis and each arity's factor/lane
+    axis instead of padding to a common hypercube, so heterogeneous
+    ``v_count``/factor counts carry no mask waste at all — only the
+    shared domain rung ``d_env`` (default: the group's max) is padded.
+    The tiny-domain route of the serving envelope tier
+    (docs/serving.md "Envelope batching").
+
+    ``ladder`` (a serving/binning.EnvelopeLadder) additionally rounds
+    the union's variable/row counts up the ladder with masked sentinel
+    rows, bounding the number of compiled union programs under
+    changing group compositions.
+
+    Returns ``(values, cycles, batch_result)`` like ``run_stacked``,
+    with ``values`` a per-member list (members have different variable
+    counts).  ``converged_lanes`` holds honest per-member verdicts
+    recovered from the suppression counters
+    (ops/maxsum_lane.converged_per_graph)."""
+    from pydcop_tpu.ops import maxsum_lane as lane_ops
+
+    if not graphs:
+        raise ValueError("run_lane_packed needs at least one graph")
+    union, layout = lane_ops.pack_graphs(graphs, d_env=d_env)
+    if ladder is not None:
+        from pydcop_tpu.serving.binning import envelope_key
+
+        # Ladder-round the union's variable/row counts so group
+        # compositions reuse compiled programs — but KEEP the exact
+        # domain: the caller grouped by domain rung already, and
+        # rounding d again would charge every member the rung's
+        # hypercube blowup the lane pack exists to avoid.
+        union = pad_graph_to_envelope(
+            union,
+            envelope_key(union, ladder)._replace(d_env=union.dmax))
+    lane = lane_ops.to_lane_graph(union)
+    statics = dict(
+        max_cycles=max_cycles,
+        damping=damping,
+        damp_vars=damping_nodes in ("vars", "both"),
+        damp_factors=damping_nodes in ("factors", "both"),
+        stability=stability,
+    )
+    key = (
+        "maxsum_lane_pack",
+        (lane.var_costs.shape,)
+        + tuple(b.costs.shape for b in lane.buckets),
+        tuple(sorted(statics.items())),
+    )
+    t0 = time.perf_counter()
+    span = (tracer.span("engine_segment", "engine",
+                        batch_size=len(graphs), n_real=len(graphs),
+                        packing="lane", from_cycle=0,
+                        extra_cycles=max_cycles)
+            if tracer.active else None)
+    with (span if span is not None else contextlib.nullcontext()):
+        (values, cycle, v2f_count, f2v_count), compile_s, run_s = \
+            timed_jit_call(
+                _warm, key,
+                functools.partial(_lane_packed_solve, **statics),
+                lane,
+            )
+    elapsed = time.perf_counter() - t0
+    values = np.asarray(jax.device_get(values))
+    per_values = [values[s:s + n] for s, n in layout.var_slices]
+    converged = lane_ops.converged_per_graph(
+        jax.device_get(v2f_count), jax.device_get(f2v_count), layout)
+    n_cycles = int(jax.device_get(cycle))
+    cycles = np.full((len(graphs),), n_cycles, dtype=np.int32)
+    # Honest waste accounting: members carry only domain-rung padding;
+    # the union-level ladder rounding (sentinel rows) is shared
+    # dispatch overhead, reported in the dispatch-level figure.
+    from pydcop_tpu.serving.binning import lane_cells
+
+    real_cells = [_array_cells(g) for g in graphs]
+    union_cells = max(_array_cells(union), 1)
+    member_cells = [lane_cells(g, lane.dmax) for g in graphs]
+    lane_waste = [
+        round(1.0 - r / max(m, 1), 4)
+        for r, m in zip(real_cells, member_cells)
+    ]
+    batch_result = DeviceRunResult(
+        assignment={},
+        cycles=n_cycles,
+        converged=all(converged),
+        time_s=elapsed,
+        compile_time_s=compile_s,
+        metrics={
+            "batch_size": len(graphs),
+            "n_real": len(graphs),
+            "pad_fraction": 0.0,
+            "cold_start": compile_s > 0.0,
+            "run_time_s": run_s,
+            "packing": "lane",
+            "converged_lanes": [bool(c) for c in converged],
+            "envelope_waste_lanes": lane_waste,
+            "envelope_waste": round(
+                1.0 - sum(real_cells) / union_cells, 4),
+        },
+    )
+    return per_values, cycles, batch_result
 
 
 def solve_maxsum_batch(

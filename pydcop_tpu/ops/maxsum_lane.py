@@ -49,7 +49,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pydcop_tpu.engine.compile import BIG, CompiledFactorGraph
+from pydcop_tpu.engine.compile import (
+    BIG,
+    CompiledFactorGraph,
+    FactorBucket,
+)
 from pydcop_tpu.ops.maxsum import SAME_COUNT
 
 Msgs = Tuple[jnp.ndarray, ...]  # one [D, arity, F] array per bucket
@@ -85,6 +89,136 @@ class LaneGraph(NamedTuple):
     @property
     def dmax(self) -> int:
         return self.var_costs.shape[0]
+
+
+class PackLayout(NamedTuple):
+    """Where each member of a lane-packed union landed (ISSUE 11).
+
+    Lane packing turns N *different*-structure problems into ONE
+    disjoint-union factor graph: variables concatenate (one shared
+    sentinel at the end), and each arity's factors concatenate on the
+    lane (F) axis.  Because the union is a disjoint union, message
+    passing decomposes exactly — no member's messages can reach
+    another member's variables — so per-member results equal solo
+    solves while the device sees one dense dispatch with NO
+    per-member shape padding (the only mask waste is the shared
+    domain rung)."""
+
+    # Per member: (start, n_vars) into the union's variable rows.
+    var_slices: Tuple[Tuple[int, int], ...]
+    # Per member: ((bucket_index, start, n_rows), ...) into the
+    # union's buckets — only arities the member actually has.
+    row_slices: Tuple[Tuple[Tuple[int, int, int], ...], ...]
+    # Union bucket arity order (sorted ascending).
+    arities: Tuple[int, ...]
+
+
+def pack_graphs(graphs, d_env: Optional[int] = None
+                ) -> Tuple[CompiledFactorGraph, PackLayout]:
+    """Disjoint-union pack: concatenate compiled graphs into one
+    edge-major CompiledFactorGraph (host numpy), domains mask-padded
+    to the shared ``d_env`` (default: the group's max) with the
+    compiler's own discipline (``BIG`` cost, ``var_valid=False``).
+
+    Members may have entirely different variable counts, factor
+    counts and arity sets.  Each member's rows keep their relative
+    order inside the union buckets, so the per-variable scatter-add
+    accumulates a member's contributions in the same order a solo
+    dispatch would — the parity the envelope battery asserts.
+
+    The union keeps a single sentinel row (index ``sum(v_i)``);
+    members' own compile-time sentinel references are re-pointed at
+    it.  Aggregation arrays are dropped (scatter path).  Use
+    :func:`to_lane_graph` on the result to run lane-major, and
+    :func:`converged_per_graph` to recover per-member convergence.
+    """
+    if not graphs:
+        raise ValueError("pack_graphs needs at least one graph")
+    if d_env is None:
+        d_env = max(g.dmax for g in graphs)
+    if d_env < max(g.dmax for g in graphs):
+        raise ValueError(
+            f"d_env={d_env} below the group's max domain "
+            f"{max(g.dmax for g in graphs)}")
+    v_total = sum(g.n_vars for g in graphs)
+    dtype = graphs[0].var_costs.dtype
+    var_costs = np.full((v_total + 1, d_env), BIG, dtype=dtype)
+    var_valid = np.zeros((v_total + 1, d_env), dtype=bool)
+    var_slices = []
+    offset = 0
+    for g in graphs:
+        v, d = g.n_vars, g.dmax
+        var_costs[offset:offset + v, :d] = np.asarray(g.var_costs)[:v]
+        var_valid[offset:offset + v, :d] = np.asarray(g.var_valid)[:v]
+        var_slices.append((offset, v))
+        offset += v
+
+    arities = sorted({b.arity for g in graphs for b in g.buckets})
+    bucket_index = {a: i for i, a in enumerate(arities)}
+    costs_parts = {a: [] for a in arities}
+    ids_parts = {a: [] for a in arities}
+    row_cursor = {a: 0 for a in arities}
+    row_slices = []
+    for g, (start, _v) in zip(graphs, var_slices):
+        v = g.n_vars
+        member_rows = []
+        for b in g.buckets:
+            a, n_rows, d = b.arity, b.n_factors, g.dmax
+            block = np.full((n_rows,) + (d_env,) * a, BIG,
+                            dtype=b.costs.dtype)
+            block[(slice(None),) + (slice(0, d),) * a] = \
+                np.asarray(b.costs)
+            ids = np.asarray(b.var_ids).astype(np.int32).copy()
+            # Member-local indices -> union indices; the member's own
+            # sentinel (v) re-points at the union sentinel (v_total).
+            sent = ids == v
+            ids = ids + start
+            ids[sent] = v_total
+            costs_parts[a].append(block)
+            ids_parts[a].append(ids)
+            member_rows.append(
+                (bucket_index[a], row_cursor[a], n_rows))
+            row_cursor[a] += n_rows
+        row_slices.append(tuple(member_rows))
+
+    buckets = tuple(
+        FactorBucket(
+            costs=np.concatenate(costs_parts[a], axis=0),
+            var_ids=np.concatenate(ids_parts[a], axis=0),
+        )
+        for a in arities
+    )
+    union = CompiledFactorGraph(
+        var_costs=var_costs, var_valid=var_valid, buckets=buckets,
+    )
+    layout = PackLayout(
+        var_slices=tuple(var_slices),
+        row_slices=tuple(row_slices),
+        arities=tuple(arities),
+    )
+    return union, layout
+
+
+def converged_per_graph(v2f_count, f2v_count,
+                        layout: PackLayout) -> Tuple[bool, ...]:
+    """Per-member convergence verdicts from a packed run's final
+    send-suppression counters.  An edge's count is reset to 1 on a
+    mismatched send and incremented on a match, so ``count >= 2`` on
+    every edge of a member (both directions) is exactly that member's
+    slice of the global ``stable`` conjunction — the packed dispatch
+    reports honest per-request ``converged`` flags even though the
+    union carries one shared flag.  Counter arrays are the lane-major
+    ``[arity, F]`` per-bucket LaneState counters (the F axis is
+    sliced)."""
+    verdicts = []
+    for member_rows in layout.row_slices:
+        ok = True
+        for bi, start, n_rows in member_rows:
+            for counts in (v2f_count[bi], f2v_count[bi]):
+                rows = np.asarray(counts)[:, start:start + n_rows]
+                ok = ok and bool((rows >= 2).all())
+        verdicts.append(ok)
+    return tuple(verdicts)
 
 
 def to_lane_graph(graph: CompiledFactorGraph) -> LaneGraph:

@@ -14,7 +14,11 @@ throughput service (docs/serving.md):
   vmapped device program (engine/batch.run_stacked, padded up the
   bin-size ladder so ragged batches reuse compiled programs);
 - :mod:`.binning` — structure-signature bin keys (two structures
-  never share a dispatch; same-structure requests coalesce);
+  never share an *exact* dispatch; same-structure requests coalesce)
+  plus the envelope tier: shape-envelope keys, cell accounting and
+  the pack-vs-solo cost model that lets *different*-structure
+  singletons share a mask-padded dispatch with bit-identical
+  results (docs/serving.md "Envelope batching");
 - :mod:`.admission` — backpressure (queue high-water → 429) and the
   dispatch circuit breaker (repeated engine failure → 503);
 - :mod:`.journal` — the durable request journal: length-prefixed,

@@ -13,9 +13,26 @@ domain padding, per-bucket shapes and the exact scope-index bytes.
 Solver parameters ride in the key too: ``max_cycles``/``damping``/
 ``stability`` are static arguments of the jitted batched program, so
 requests with different parameters can never share one dispatch.
+
+**Envelope tier (ISSUE 11).**  Structure binning is exact — and
+therefore degenerates to batch-size-1 under *diverse* traffic: two
+different topologies never share a dispatch, so a zipf-distributed
+request stream gets no batching at all.  :func:`envelope_key` is the
+second, coarser tier above :func:`structure_signature`: it rounds a
+graph's shape dimensions (variable count / domain / per-arity bucket
+rows) up a small ladder of **shape envelopes**, so different-structure
+problems that fit the same envelope can be mask-padded to identical
+shapes (engine/batch.pad_graph_to_envelope — the PR-7 sentinel-row
+autopad pattern) and dispatched as ONE vmapped program with results
+bit-identical to solo solves.  The ladder is powers-of-two-ish so the
+number of compiled envelope programs stays logarithmic in the traffic's
+shape spread.  :func:`pack_decision` is the scheduler's per-flush cost
+model: envelope packing is wasted-work-vs-dispatch-overhead arbitrage,
+so it only happens when the modeled win beats solo dispatch.
 """
 
-from typing import Any, Dict, Tuple
+import hashlib
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
 
 from pydcop_tpu.engine.compile import CompiledFactorGraph
 
@@ -120,8 +137,219 @@ def bin_label(key: Tuple) -> str:
     explode label cardinality, and the built-in ``hash`` is
     per-process randomized (labels must survive restarts so merged
     traces from two serving processes correlate by bin)."""
-    import hashlib
-
     (var_shape, _buckets, _agg), _params = key
     digest = hashlib.sha1(repr(key).encode()).hexdigest()[:6]
     return f"v{var_shape[0] - 1}d{var_shape[1]}h{digest}"
+
+
+# --------------------------------------------------------------------- #
+# Envelope tier: shape-envelope keys, padding accounting and the
+# per-flush pack-vs-solo cost model (ISSUE 11).
+
+class EnvelopeLadder(NamedTuple):
+    """Rounding rungs per shape dimension.  Each dimension rounds UP
+    to its first rung >= the real size (past the top rung: the next
+    power of two — an oversized problem still envelopes, it just gets
+    a rarer key).  Powers-of-two-ish defaults keep the compiled
+    envelope-program count logarithmic in the traffic's shape
+    spread."""
+
+    vars: Tuple[int, ...] = (8, 16, 32, 64, 128, 256, 512, 1024,
+                             2048, 4096)
+    domain: Tuple[int, ...] = (2, 4, 8, 16, 32, 64, 128)
+    rows: Tuple[int, ...] = (8, 16, 32, 64, 128, 256, 512, 1024,
+                             2048, 4096, 8192)
+
+
+DEFAULT_LADDER = EnvelopeLadder()
+
+# Rounding for lane-packed UNIONS (engine/batch.run_lane_packed):
+# deliberately MUCH coarser than the grouping ladder.  A union's
+# shapes depend on the flush's group composition, and every distinct
+# shape is a fresh XLA compile of the whole solve loop (~0.3-0.8 s on
+# CPU) — with fine rungs a diverse stream produces a new program
+# almost every flush and the compile stalls eat the packing win.
+# Power-of-two rungs starting at 64 keep it to a handful of programs
+# for small-problem traffic while letting SMALL groups (2-3 members)
+# pack into small unions — the pack decision charges the whole padded
+# union's cells, so a coarse-only ladder would price pairs out of
+# packing entirely.
+UNION_LADDER = EnvelopeLadder(
+    vars=(64, 128, 256, 512, 1024, 2048, 4096, 16384),
+    domain=(2, 4, 8, 16, 32, 64, 128),
+    rows=(64, 128, 256, 512, 1024, 2048, 4096, 16384),
+)
+
+
+def ladder_round(n: int, rungs: Sequence[int]) -> int:
+    """First rung >= n; past the top, the next power of two >= n."""
+    n = max(int(n), 1)
+    for r in rungs:
+        if r >= n:
+            return r
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class Envelope(NamedTuple):
+    """One shape envelope: every dimension is an upper bound a graph
+    is mask-padded to (engine/batch.pad_graph_to_envelope).  ``rows``
+    is arity-sorted ``((arity, padded_rows), ...)`` — the arity SET is
+    exact (padding hypercube rank would multiply, not add, waste), the
+    row counts are ladder rungs."""
+
+    v_env: int                          # real-variable rows (no sentinel)
+    d_env: int                          # padded domain
+    rows: Tuple[Tuple[int, int], ...]   # ((arity, rows_env), ...)
+
+
+def envelope_key(graph: CompiledFactorGraph,
+                 ladder: EnvelopeLadder = DEFAULT_LADDER) -> Envelope:
+    """The coarse second-tier key above :func:`structure_signature`:
+    ladder-rounded shape dimensions only.  Monotone (a graph that
+    grows in any dimension never gets a smaller envelope) and covering
+    (every dimension >= the graph's real size) — both battery-asserted
+    (tests/unit/test_envelope_battery.py)."""
+    return Envelope(
+        v_env=ladder_round(graph.n_vars, ladder.vars),
+        d_env=ladder_round(graph.dmax, ladder.domain),
+        rows=tuple(sorted(
+            (b.arity, ladder_round(b.n_factors, ladder.rows))
+            for b in graph.buckets
+        )),
+    )
+
+
+def graph_cells(graph: CompiledFactorGraph) -> int:
+    """Device-array elements the MaxSum superstep touches for this
+    graph — the work unit of the pack-vs-solo cost model and of the
+    honest ``envelope_waste`` accounting (variable table incl.
+    sentinel row + every bucket hypercube)."""
+    return int(
+        graph.var_costs.shape[0] * graph.var_costs.shape[1]
+        + sum(b.costs.size for b in graph.buckets)
+    )
+
+
+def envelope_cells(env: Envelope) -> int:
+    """:func:`graph_cells` of any graph padded to ``env``."""
+    return int(
+        (env.v_env + 1) * env.d_env
+        + sum(r * env.d_env ** a for a, r in env.rows)
+    )
+
+
+def lane_cells(graph: CompiledFactorGraph, d_env: int) -> int:
+    """:func:`graph_cells` of the graph with only its DOMAIN padded to
+    ``d_env`` — the per-member work in a lane-packed union dispatch
+    (ops/maxsum_lane packing concatenates factors/variables instead of
+    padding them, so the only mask waste left is the domain rung)."""
+    return int(
+        graph.var_costs.shape[0] * d_env
+        + sum(b.n_factors * d_env ** b.arity for b in graph.buckets)
+    )
+
+
+def envelope_label(env: Envelope) -> str:
+    """Low-cardinality metrics/trace label for an envelope."""
+    rows = "_".join(f"a{a}x{r}" for a, r in env.rows)
+    return f"env_v{env.v_env}d{env.d_env}_{rows or 'nofactors'}"
+
+
+# Cost-model constants, fitted on the CPU backend (the affine
+# per-dispatch model ``overhead + cycles * (per_cycle + cells *
+# per_cell)``; measured points: a 370-cell solo ring at 60 cycles
+# costs ~1.1 ms end-to-end, a 3075-cell padded union ~4.9 ms — the
+# per-CYCLE fixed op overhead, not the cell work, dominates tiny
+# problems, which is why a naive cells-only model over-packs).
+# ``PACK_OVERHEAD_MS`` is the per-dispatch fixed cost (jit-cache
+# lookup + host launch + result fetch; SolveService exposes it as
+# ``envelope_overhead_ms``).  On TPU the fixed costs are larger and
+# the cell work cheaper, so this calibration UNDER-estimates the
+# packing win there — conservative in the safe direction.
+PACK_OVERHEAD_MS = 0.3
+MODEL_US_PER_CYCLE = 5.0
+MODEL_NS_PER_CELL_CYCLE = 25.0
+
+
+def modeled_solve_ms(cells: int, max_cycles: int) -> float:
+    """Affine dispatch-compute model (ms), excluding the per-dispatch
+    fixed overhead."""
+    return max_cycles * (MODEL_US_PER_CYCLE * 1e-3
+                         + cells * MODEL_NS_PER_CELL_CYCLE * 1e-6)
+
+
+def solve_prior_ms(real_cells: int, max_cycles: int,
+                   portfolio_ms: Optional[float] = None,
+                   race_cycles: int = 60) -> Tuple[float, str]:
+    """Per-structure solo solve-time prior (ms) for the cost model.
+
+    When the PR-10 portfolio racer has a cached time-to-cost entry for
+    the structure (engine/autotune.cached_portfolio_timing_ms — a real
+    measured solve of ``race_cycles`` cycles on this backend), that is
+    the prior, scaled to the request's cycle budget.  Otherwise the
+    affine model — honest about being a model (source ``"model"``), so
+    the decision record shows which dispatches were decided on
+    measurement and which on estimate."""
+    if portfolio_ms is not None and portfolio_ms > 0:
+        return (portfolio_ms * max_cycles / max(race_cycles, 1),
+                "portfolio")
+    return modeled_solve_ms(real_cells, max_cycles), "model"
+
+
+def lane_union_cells(graphs: Sequence[CompiledFactorGraph],
+                     d_env: int,
+                     ladder: EnvelopeLadder = UNION_LADDER) -> int:
+    """Cells of the PADDED lane union these members would produce
+    (mirrors engine/batch.run_lane_packed's rounding) — what the pack
+    decision must charge, since the union's sentinel-row padding costs
+    real per-cycle time whether or not any member needed it."""
+    v_total = sum(g.n_vars for g in graphs)
+    rows: Dict[int, int] = {}
+    for g in graphs:
+        for b in g.buckets:
+            rows[b.arity] = rows.get(b.arity, 0) + b.n_factors
+    v_env = ladder_round(v_total, ladder.vars)
+    return int(
+        (v_env + 1) * d_env
+        + sum(ladder_round(r, ladder.rows) * d_env ** a
+              for a, r in rows.items())
+    )
+
+
+def pack_decision(real_cells: Sequence[int],
+                  prior_ms: Sequence[float],
+                  packed_cells_total: int,
+                  max_cycles: int,
+                  overhead_ms: float = PACK_OVERHEAD_MS
+                  ) -> Dict[str, Any]:
+    """The per-flush envelope decision: does ONE padded dispatch beat
+    N solo dispatches for this group?
+
+    Solo side: each member's measured-or-modeled solve prior plus one
+    dispatch overhead each.  Packed side: one overhead plus the affine
+    model over the WHOLE padded dispatch's cells
+    (``packed_cells_total`` — envelope lanes or the rounded lane
+    union, padding included: masked cells still burn per-cycle time).
+    Work is summed, not maxed — honest for the CPU backend where
+    batched lanes serialize, conservative for TPU where they share
+    vector units (a pack that wins under the sum model wins harder on
+    chip).  Returns the full modeled record so scheduler decisions
+    are replayable in tests and auditable in /stats."""
+    n = len(real_cells)
+    solo_ms = sum(prior_ms) + overhead_ms * n
+    packed_ms = overhead_ms + modeled_solve_ms(
+        packed_cells_total, max_cycles)
+    real_total = sum(real_cells)
+    return {
+        "n": n,
+        "packed": bool(n > 1 and packed_ms < solo_ms),
+        "solo_ms": round(solo_ms, 4),
+        "packed_ms": round(packed_ms, 4),
+        "overhead_ms": overhead_ms,
+        "packed_cells": int(packed_cells_total),
+        "waste": round(
+            1.0 - real_total / max(packed_cells_total, 1), 4),
+    }

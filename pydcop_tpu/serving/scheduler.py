@@ -117,8 +117,24 @@ class BinScheduler:
             bins.setdefault(req.bin, []).append(req)
 
     def _dispatch_bins(self, bins: Dict) -> None:
-        for key in sorted(bins, key=lambda k: -len(bins[k])):
-            reqs: List = bins[key]
+        # The flush plan (serving/service.plan_flush): multi-request
+        # bins keep the exact path; leftover singleton bins are
+        # envelope-grouped and packed when the per-flush cost model
+        # says one padded dispatch beats N solo ones.  A planner crash
+        # degrades to the old one-plan-per-bin behavior — planning is
+        # an optimization, never a correctness dependency.
+        try:
+            plans = self.service.plan_flush(bins)
+        except Exception:  # noqa: BLE001 — last line of defense
+            logger.exception("flush planning crashed; dispatching "
+                             "per bin")
+            from pydcop_tpu.serving.service import DispatchPlan
+
+            plans = [DispatchPlan(list(bins[k]))
+                     for k in sorted(bins,
+                                     key=lambda k: -len(bins[k]))]
+        for plan in plans:
+            reqs: List = plan.reqs
             for i in range(0, len(reqs), self.max_batch):
                 chunk = reqs[i:i + self.max_batch]
                 # Last line of defense: dispatch() fails batches
@@ -126,7 +142,14 @@ class BinScheduler:
                 # thread — a dead scheduler turns the service into a
                 # black hole that accepts work it will never do.
                 try:
-                    self.service.dispatch(chunk)
+                    if plan.envelope is None and plan.lane_d is None:
+                        # Positional call on the exact path: test
+                        # doubles stub dispatch(reqs).
+                        self.service.dispatch(chunk)
+                    else:
+                        self.service.dispatch(chunk,
+                                              envelope=plan.envelope,
+                                              lane_d=plan.lane_d)
                 except Exception as exc:  # noqa: BLE001
                     logger.exception("dispatch crashed")
                     for req in chunk:

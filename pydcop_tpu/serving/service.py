@@ -59,9 +59,9 @@ import queue
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, NamedTuple, Optional
 
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.engine import batch as engine_batch
@@ -119,6 +119,19 @@ class SolveRequest:
     result: Optional[Dict[str, Any]] = None
 
 
+class DispatchPlan(NamedTuple):
+    """One device dispatch the scheduler should fire for a flush
+    (:meth:`SolveService.plan_flush`).  ``envelope``/``lane_d`` both
+    None is the exact same-structure path; ``envelope`` (a
+    serving/binning.Envelope) mask-pads a heterogeneous group to one
+    shape; ``lane_d`` (a domain rung) lane-packs it as a disjoint
+    union (engine/batch.run_lane_packed)."""
+
+    reqs: List["SolveRequest"]
+    envelope: Optional[Any] = None
+    lane_d: Optional[int] = None
+
+
 class SolveService:
     """Bounded-queue, structure-binned batching solve service.
 
@@ -132,6 +145,24 @@ class SolveService:
     backpressure/breaker policy, ``result_keep`` bounds completed-
     result retention (oldest evicted first — a long-lived service must
     not leak every response it ever produced).
+
+    **Envelope batching** (ISSUE 11, on by default): structure bins
+    are exact, so diverse traffic degenerates to batch-size-1 — every
+    flush's leftover SINGLETON bins are therefore grouped by
+    shape-envelope key (serving/binning.envelope_key over
+    ``envelope_ladder``) and packed into one mask-padded dispatch when
+    the modeled win beats solo dispatch
+    (serving/binning.pack_decision: padding waste vs
+    ``envelope_overhead_ms`` per dispatch, with the PR-10 portfolio
+    cache's measured per-structure times as free priors).  Groups
+    whose domain rung is at most ``lane_domain_max`` (and that don't
+    request pruning — an edge-major-only kernel) route through
+    lane packing instead (engine/batch.run_lane_packed): a disjoint
+    union with no per-member shape padding at all.  Results stay
+    bit-identical to solo ``api.solve`` either way (mask-padded lanes
+    and union members compute exactly the solo messages — battery- and
+    smoke-asserted); ``envelope_packing=False`` restores the old
+    solo-singleton behavior.
 
     ``journal_dir`` enables the durable request journal
     (serving/journal.py): acks become crash-durable, and
@@ -150,7 +181,13 @@ class SolveService:
                  result_keep: int = 4096,
                  journal_dir: Optional[str] = None,
                  journal_sync: bool = False,
-                 recover: bool = False):
+                 recover: bool = False,
+                 envelope_packing: bool = True,
+                 envelope_ladder: Optional[
+                     binning.EnvelopeLadder] = None,
+                 envelope_overhead_ms: Optional[float] = None,
+                 lane_pack: bool = True,
+                 lane_domain_max: int = 8):
         if admission is None:
             admission = AdmissionPolicy(high_water=max_queue)
         self.admission = AdmissionController(admission)
@@ -160,6 +197,21 @@ class SolveService:
             bin_sizes or engine_batch.DEFAULT_BIN_SIZES)
         self.default_params = binning.normalize_params(default_params)
         self.result_keep = result_keep
+        self.envelope_packing = bool(envelope_packing)
+        self.envelope_ladder = (envelope_ladder
+                                or binning.DEFAULT_LADDER)
+        self.envelope_overhead_ms = float(
+            envelope_overhead_ms if envelope_overhead_ms is not None
+            else binning.PACK_OVERHEAD_MS)
+        self.lane_pack = bool(lane_pack)
+        self.lane_domain_max = int(lane_domain_max)
+        # Per-structure solve-time priors for the pack decision
+        # (portfolio-cache reads memoized — the JSON file must not be
+        # re-read per flush).
+        self._prior_memo: Dict[str, Optional[float]] = {}
+        # Recent pack-vs-solo decisions, replayable surface for tests
+        # and /stats.
+        self.envelope_decisions: "deque" = deque(maxlen=64)
         self.journal_dir = journal_dir
         self.journal_sync = journal_sync
         self.recover_on_start = recover
@@ -173,6 +225,10 @@ class SolveService:
         # Dispatch ledger (also mirrored into the registry).
         self.dispatches = 0
         self.batched_dispatches = 0
+        self.batched_requests = 0
+        self.envelope_dispatches = 0
+        self.lane_dispatches = 0
+        self.envelope_packed_requests = 0
         self.completed = 0
         self.failed = 0
         self.expired = 0
@@ -206,6 +262,16 @@ class SolveService:
         self._retries = reg.counter(
             "pydcop_serve_dispatch_retries_total",
             "Bisection retry dispatches after a failed bin dispatch")
+        self._envelope_total = reg.counter(
+            "pydcop_serve_envelope_dispatches_total",
+            "Heterogeneous-structure packed dispatches by kind "
+            "(envelope = mask-padded stack, lane = disjoint union)")
+        self._envelope_decided = reg.counter(
+            "pydcop_serve_envelope_decisions_total",
+            "Per-flush envelope pack-vs-solo cost decisions by verdict")
+        self._envelope_waste_g = reg.gauge(
+            "pydcop_serve_envelope_waste",
+            "Padded-cell fraction of the last envelope-packed dispatch")
         self._replayed_total = reg.counter(
             "pydcop_serve_replayed_total",
             "Journaled requests replayed through the queue on "
@@ -648,9 +714,151 @@ class SolveService:
                 self._requests.move_to_end(rid)
                 rotations += 1
 
+    # -- flush planning (called by the scheduler thread) --------------- #
+
+    def plan_flush(self, bins: Dict[Any, List[SolveRequest]]
+                   ) -> List[DispatchPlan]:
+        """Turn one coalescing window's bins into dispatch plans.
+
+        Multi-request bins keep the exact same-structure path
+        unchanged (identical shapes, zero padding).  Leftover
+        SINGLETON bins — exactly the population structure binning
+        cannot batch — are grouped by the coarser envelope tier:
+        same solver params + same shape envelope
+        (serving/binning.envelope_key), or same domain rung for the
+        lane route (the disjoint union accepts any variable/factor
+        counts, so lane groups only need the domain and params to
+        agree).  Each group of >= 2 goes through the
+        :func:`~pydcop_tpu.serving.binning.pack_decision` cost model —
+        packed only when the modeled dispatch-overhead saving beats
+        the padding waste — and losing groups fall back to solo
+        dispatches, so a pathological group can never be slower than
+        the old behavior by more than the model's error."""
+        plans: List[DispatchPlan] = []
+        singles: List[SolveRequest] = []
+        for key in sorted(bins, key=lambda k: -len(bins[k])):
+            reqs = bins[key]
+            if len(reqs) > 1 or not self.envelope_packing:
+                plans.append(DispatchPlan(list(reqs)))
+            else:
+                singles.append(reqs[0])
+        if len(singles) == 1:
+            plans.append(DispatchPlan(singles))
+            return plans
+        groups: Dict[Any, List[SolveRequest]] = {}
+        for req in singles:
+            env = binning.envelope_key(req.graph,
+                                       self.envelope_ladder)
+            params_part = req.bin[1]
+            lane_ok = (self.lane_pack
+                       and env.d_env <= self.lane_domain_max
+                       and not req.params.get("prune"))
+            gkey = (("lane", env.d_env, params_part) if lane_ok
+                    else ("envelope", env, params_part))
+            groups.setdefault(gkey, []).append(req)
+        for gkey, group in groups.items():
+            # Decide per max_batch CHUNK, not per group: the
+            # scheduler dispatches at most max_batch requests per
+            # device call, so a 20-member group runs as 16+4 — the
+            # cost model must price the dispatches that will actually
+            # execute, or borderline verdicts are computed against a
+            # shape that never runs.
+            for i in range(0, len(group), self.max_batch):
+                reqs = group[i:i + self.max_batch]
+                if len(reqs) == 1:
+                    plans.append(DispatchPlan(reqs))
+                    continue
+                # Lane groups are keyed by the domain RUNG (so
+                # near-sized domains coalesce) but packed at the
+                # chunk's exact max domain — the union's shapes are
+                # ladder-bounded by row/var rounding regardless, and
+                # rounding the domain would charge every member the
+                # rung's hypercube blowup.
+                shape = (max(r.graph.dmax for r in reqs)
+                         if gkey[0] == "lane" else gkey[1])
+                decision = self._pack_decision(gkey[0], shape, reqs)
+                if not decision["packed"]:
+                    plans.extend(DispatchPlan([r]) for r in reqs)
+                    continue
+                if gkey[0] == "lane":
+                    plans.append(DispatchPlan(reqs, lane_d=shape))
+                else:
+                    plans.append(DispatchPlan(reqs, envelope=shape))
+        return plans
+
+    def _pack_decision(self, kind: str, shape,
+                       reqs: List[SolveRequest]) -> Dict[str, Any]:
+        """Model one group's pack-vs-solo choice and record it (the
+        bounded ``envelope_decisions`` log, /stats, and the decision
+        counter) so the choice is replayable and auditable."""
+        real = [binning.graph_cells(r.graph) for r in reqs]
+        if kind == "lane":
+            packed_total = binning.lane_union_cells(
+                [r.graph for r in reqs], shape)
+            label = f"lane_d{shape}"
+        else:
+            # Stacked envelope: the batch pads up the bin-size ladder,
+            # and every lane (padding lanes included) is a full
+            # envelope's worth of cells.
+            packed_total = (
+                engine_batch.bin_size_for(len(reqs), self.bin_sizes)
+                * binning.envelope_cells(shape))
+            label = binning.envelope_label(shape)
+        priors, sources = [], []
+        for r, cells in zip(reqs, real):
+            ms, src = self._solve_prior(r, cells)
+            priors.append(ms)
+            sources.append(src)
+        decision = binning.pack_decision(
+            real, priors, packed_total,
+            max_cycles=reqs[0].params["max_cycles"],
+            overhead_ms=self.envelope_overhead_ms)
+        decision.update({
+            "kind": kind,
+            "label": label,
+            "prior_ms": [round(p, 4) for p in priors],
+            "prior_sources": sources,
+        })
+        # Locked: stats() snapshots this deque from other threads,
+        # and an unguarded append (maxlen eviction mutates too) can
+        # raise mid-iteration there.
+        with self._lock:
+            self.envelope_decisions.append(decision)
+        self._envelope_decided.inc(
+            verdict="packed" if decision["packed"] else "solo")
+        return decision
+
+    def _solve_prior(self, req: SolveRequest, real_cells: int):
+        """Per-structure solo solve-time prior: the PR-10 portfolio
+        cache's measured race time when one exists for this structure
+        (memoized — one JSON read per structure per process), the
+        cells*cycles model otherwise."""
+        from pydcop_tpu.engine.autotune import (
+            PORTFOLIO_RACE_CYCLES,
+            cached_portfolio_timing_ms,
+            graph_shape_key,
+            portfolio_key,
+        )
+
+        portfolio_ms = None
+        try:
+            skey = graph_shape_key(req.graph)
+            if skey in self._prior_memo:
+                portfolio_ms = self._prior_memo[skey]
+            else:
+                portfolio_ms = cached_portfolio_timing_ms(
+                    portfolio_key(skey))
+                self._prior_memo[skey] = portfolio_ms
+        except Exception:  # noqa: BLE001 — a prior is an optimization
+            portfolio_ms = None
+        return binning.solve_prior_ms(
+            real_cells, req.params["max_cycles"], portfolio_ms,
+            race_cycles=PORTFOLIO_RACE_CYCLES)
+
     # -- dispatch plane (called by the scheduler thread) --------------- #
 
-    def dispatch(self, reqs: List[SolveRequest]) -> None:
+    def dispatch(self, reqs: List[SolveRequest],
+                 envelope=None, lane_d: Optional[int] = None) -> None:
         """Solve one same-bin batch in a single device dispatch and
         complete every request in it.
 
@@ -677,33 +885,51 @@ class SolveService:
                     trace_id=req.trace_id, request=req.id)
             self._publish_lifecycle("dispatched", req)
         self._queue_depth.set(self._queue.qsize())
-        self._dispatch_attempt(reqs, retry_depth=0)
+        self._dispatch_attempt(reqs, retry_depth=0,
+                               envelope=envelope, lane_d=lane_d)
 
     def _dispatch_attempt(self, reqs: List[SolveRequest],
-                          retry_depth: int) -> None:
+                          retry_depth: int,
+                          envelope=None,
+                          lane_d: Optional[int] = None) -> None:
         if not tracer.active:
-            return self._dispatch_attempt_inner(reqs, retry_depth)
+            return self._dispatch_attempt_inner(
+                reqs, retry_depth, envelope=envelope, lane_d=lane_d)
         # Thread-bound context: every span/instant recorded under
         # this dispatch — serve_dispatch itself, the engine_segment
         # inside run_stacked, jit_compile, shard instants — carries
         # the batch's trace_ids without the engine knowing about
         # requests.  `pydcop trace query --request ID` matches on it.
         with tracer.context(trace_ids=[r.trace_id for r in reqs]):
-            return self._dispatch_attempt_inner(reqs, retry_depth)
+            return self._dispatch_attempt_inner(
+                reqs, retry_depth, envelope=envelope, lane_d=lane_d)
 
     def _dispatch_attempt_inner(self, reqs: List[SolveRequest],
-                                retry_depth: int) -> None:
+                                retry_depth: int,
+                                envelope=None,
+                                lane_d: Optional[int] = None) -> None:
         params = reqs[0].params
         span = (tracer.span(
             "serve_dispatch", "serving",
             bin=binning.bin_label(reqs[0].bin),
             n_real=len(reqs),
+            packing=("lane" if lane_d is not None else
+                     "envelope" if envelope is not None else
+                     "structure"),
             retry_depth=retry_depth) if tracer.active else None)
         try:
             with (span if span is not None
                   else contextlib.nullcontext()):
-                values, cycles, batch_result = self._run_batch(
-                    reqs, params)
+                if envelope is None and lane_d is None:
+                    # Positional call kept for the exact path: test
+                    # doubles and the overload smoke stub
+                    # _run_batch(reqs, params).
+                    values, cycles, batch_result = self._run_batch(
+                        reqs, params)
+                else:
+                    values, cycles, batch_result = self._run_batch(
+                        reqs, params, envelope=envelope,
+                        lane_d=lane_d)
                 if span is not None:
                     span.args["batch_size"] = \
                         batch_result.metrics["batch_size"]
@@ -735,7 +961,9 @@ class SolveService:
             for half in (reqs[:mid], reqs[mid:]):
                 self.dispatch_retries += 1
                 self._retries.inc()
-                self._dispatch_attempt(half, retry_depth + 1)
+                self._dispatch_attempt(half, retry_depth + 1,
+                                       envelope=envelope,
+                                       lane_d=lane_d)
             return
         self.admission.record_dispatch(ok=True)
         metrics = batch_result.metrics
@@ -744,7 +972,18 @@ class SolveService:
         self._dispatch_total.inc(kind=kind)
         if len(reqs) > 1:
             self.batched_dispatches += 1
+            self.batched_requests += len(reqs)
             self._batched_reqs.inc(len(reqs))
+        packing = metrics.get("packing") or "structure"
+        if packing in ("envelope", "lane"):
+            self.envelope_dispatches += 1
+            if packing == "lane":
+                self.lane_dispatches += 1
+            if len(reqs) > 1:
+                self.envelope_packed_requests += len(reqs)
+            self._envelope_total.inc(kind=packing)
+            self._envelope_waste_g.set(
+                metrics.get("envelope_waste") or 0.0)
         self._occupancy.set(
             metrics["n_real"] / metrics["batch_size"])
         pad_lanes = metrics["batch_size"] - metrics["n_real"]
@@ -787,6 +1026,12 @@ class SolveService:
                     "n_real": metrics["n_real"],
                     "pad_fraction": metrics["pad_fraction"],
                     "cold_start": metrics["cold_start"],
+                    "packing": packing,
+                    "envelope_waste": (
+                        metrics["envelope_waste_lanes"][i]
+                        if i < len(metrics.get(
+                            "envelope_waste_lanes") or [])
+                        else None),
                 },
             }
             req.status = FINISHED
@@ -802,16 +1047,35 @@ class SolveService:
             req.done.set()
             self._publish_lifecycle("finished", req)
 
-    def _run_batch(self, reqs, params):
-        """The device call, isolated for tests to stub failures."""
+    def _run_batch(self, reqs, params, envelope=None,
+                   lane_d: Optional[int] = None):
+        """The device call, isolated for tests to stub failures.
+        ``envelope`` routes a heterogeneous group through mask-padded
+        envelope stacking, ``lane_d`` through the disjoint-union lane
+        pack; both default to the exact same-structure stack."""
+        graphs = [r.graph for r in reqs]
+        if lane_d is not None:
+            return engine_batch.run_lane_packed(
+                graphs,
+                max_cycles=params["max_cycles"],
+                damping=params["damping"],
+                damping_nodes=params["damping_nodes"],
+                stability=params["stability"],
+                d_env=lane_d,
+                # Coarse union rounding: a handful of compiled
+                # programs must cover every group composition (see
+                # binning.UNION_LADDER).
+                ladder=binning.UNION_LADDER,
+            )
         return engine_batch.run_stacked(
-            [r.graph for r in reqs],
+            graphs,
             max_cycles=params["max_cycles"],
             damping=params["damping"],
             damping_nodes=params["damping_nodes"],
             stability=params["stability"],
             pad_to_bins=self.bin_sizes,
             prune=bool(params.get("prune", 0)),
+            envelope=envelope,
         )
 
     def _finish_error(self, req: SolveRequest, message: str):
@@ -922,12 +1186,19 @@ class SolveService:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             tracked = len(self._requests)
+            recent_decisions = list(self.envelope_decisions)[-8:]
         return {
             "queue_depth": self._queue.qsize(),
             "high_water": self.admission.policy.high_water,
             "breaker_state": self.admission.breaker_state,
             "dispatches": self.dispatches,
             "batched_dispatches": self.batched_dispatches,
+            "batched_requests": self.batched_requests,
+            "envelope_packing": self.envelope_packing,
+            "envelope_dispatches": self.envelope_dispatches,
+            "lane_dispatches": self.lane_dispatches,
+            "envelope_packed_requests": self.envelope_packed_requests,
+            "envelope_decisions": recent_decisions,
             "completed": self.completed,
             "failed": self.failed,
             "expired": self.expired,

@@ -70,14 +70,33 @@ def test_secp_style_sharded_run_records_memory():
     res8 = engine8.run(max_cycles=30, stop_on_convergence=False)
     assert res8.cycles == 30
 
-    # Bit parity vs unsharded on the identical compile.
+    # Near-parity vs unsharded on the identical compile.  NOT exact
+    # equality: this seed's "bit-parity flake" (noted since PR 10) was
+    # root-caused in PR 11 to a genuine f32 near-tie, not a sharding
+    # bug — variable l410's two best beliefs differ by ONE ULP at
+    # their magnitude (1.5e-05 at ~224.5, measured), so the sharded
+    # halo psum's float reassociation legitimately flips that argmin
+    # while every well-separated variable stays bit-identical.  The
+    # assertion therefore allows disagreement only where the
+    # assignments are cost-equivalent at f32 resolution: a handful of
+    # flipped variables at most, and total costs equal to ~1e-5
+    # relative (a REAL sharding bug would diverge the trajectories,
+    # flipping many variables and moving the cost).  The strict
+    # bit-parity discipline lives in tests/api/test_sharded_parity.py
+    # on integer tables, where no ties exist to reassociate.
     graph1, meta1 = compile_factor_graph(
         lights, constraints, noise_level=0.01, noise_seed=0,
         pad_to=mesh.size,
     )
     res1 = MaxSumEngine(graph1, meta1).run(
         max_cycles=30, stop_on_convergence=False)
-    assert res1.assignment == res8.assignment
+    differing = [
+        name for name in res1.assignment
+        if res1.assignment[name] != res8.assignment[name]
+    ]
+    assert len(differing) <= max(2, N_LIGHTS // 200), (
+        f"{len(differing)} variables differ sharded-vs-not "
+        f"({differing[:10]}...): beyond reassociation ties")
 
     # Solution quality: the run actually optimized (cost below a
     # random assignment's expected cost).
@@ -87,6 +106,13 @@ def test_secp_style_sharded_run_records_memory():
             v1, v2 = c.dimensions
             total += float(c(asg[v1.name], asg[v2.name]))
         return total
+
+    # Cost-equivalence at f32 resolution: the flipped near-tie
+    # variables (if any) must not move the solution quality.
+    cost1, cost8 = cost(res1.assignment), cost(res8.assignment)
+    assert abs(cost1 - cost8) <= 1e-4 * max(abs(cost1), 1.0), (
+        f"sharded cost {cost8} vs unsharded {cost1}: beyond "
+        "reassociation-tie tolerance")
 
     rand_cost = cost({
         v.name: int(rng.integers(0, D)) for v in lights

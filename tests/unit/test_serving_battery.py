@@ -160,14 +160,25 @@ class TestAdmission:
             ctl.admit(3)
 
     def test_breaker_opens_after_failures_and_recovers(self):
+        # Long reset for the rejection phase: the breaker-open flight
+        # bundle dump (process-global, always-on) can take > 50 ms in
+        # a full suite run, and a tiny reset window would already be
+        # HALF-OPEN by the time admit() runs (observed flake).
         ctl = AdmissionController(AdmissionPolicy(
-            high_water=10, breaker_failures=2, breaker_reset_s=0.05))
+            high_water=10, breaker_failures=2, breaker_reset_s=30.0))
         ctl.admit(0)
         ctl.record_dispatch(ok=False)
         ctl.admit(0)  # one failure: still closed
         ctl.record_dispatch(ok=False)
+        assert ctl.breaker_state == "open"
         with pytest.raises(ServiceUnavailable):
             ctl.admit(0)
+        # Recovery phase on its own controller with a short reset
+        # (its bundle is rate-limited away by the first trip above).
+        ctl = AdmissionController(AdmissionPolicy(
+            high_water=10, breaker_failures=2, breaker_reset_s=0.05))
+        ctl.record_dispatch(ok=False)
+        ctl.record_dispatch(ok=False)
         time.sleep(0.06)
         # Half-open admits; a successful probe dispatch closes it.
         ctl.admit(0)

@@ -125,6 +125,12 @@ def load_history(root: str) -> List[Dict[str, Any]]:
                 parsed.get("serve_recovery_replay_s")),
             "shard_recovery_value": _opt_float(
                 parsed.get("shard_recovery_s")),
+            # Mixed-structure serving leg (ISSUE 11
+            # bench_serving_mixed): zipf-diverse topologies through
+            # the envelope batching tier — absent before PR 11, None
+            # when the leg failed that round.
+            "serve_mixed_value": _opt_float(
+                parsed.get("serve_mixed_problems_per_sec")),
             # The p99 latency exemplar from the serving leg (ISSUE
             # 9): when the newest run regresses, the report points at
             # a concrete request trace instead of a bare number.
@@ -235,6 +241,11 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
     metrics = (
         ("bench", "value", "cycles/s", "backend", True),
         ("serve", "serve_value", "problems/s", "backend", True),
+        # ISSUE 11: throughput on zipf-diverse structures through the
+        # envelope batching tier — the traffic shape on which pure
+        # structure binning degenerates to batch-size-1.
+        ("serve_mixed", "serve_mixed_value", "problems/s",
+         "backend", True),
         ("sharded", "sharded_value", "cycles/s",
          "sharded_backend", True),
         # ISSUE 10: wall-clock to the reference cost on the

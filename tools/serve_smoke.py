@@ -175,6 +175,87 @@ def leg_coalescing():
         handle.stop()
 
 
+def leg_mixed_envelope():
+    """ISSUE 11 acceptance: a concurrent burst of DISTINCT-structure
+    requests — one per topology, so pure structure binning would
+    dispatch every one solo — must coalesce below one dispatch per
+    request via the envelope tier, with every response bit-identical
+    to the solo ``api.solve`` answer (masking proven end-to-end over
+    real HTTP, not assumed)."""
+    from pydcop_tpu import api
+
+    handle = api.serve(port=0, batch_window_s=0.3, max_batch=16,
+                       max_queue=64)
+    try:
+        url = handle.url
+        # Five distinct topologies (different variable counts -> five
+        # different structure signatures), ONE request each: zero
+        # same-structure coalescing is possible.
+        dcops = [build_instance(n, 40 + n)
+                 for n in (9, 12, 15, 18, 21)]
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        payloads = [dcop_yaml(d) for d in dcops]
+        results = [None] * len(dcops)
+
+        def client(i):
+            results[i] = post(url, {
+                "dcop": payloads[i], "wait": True, "timeout": 120,
+                "params": {"max_cycles": MAX_CYCLES},
+            })
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(dcops))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        check(all(r is not None and r[0] == 200
+                  and r[1]["status"] == "FINISHED" for r in results),
+              f"all {len(dcops)} mixed-structure responses valid")
+
+        stats = handle.service.stats()
+        n = len(dcops)
+        check(stats["dispatches"] < n,
+              f"{n} distinct-structure requests took "
+              f"{stats['dispatches']} dispatches (< {n}: envelope "
+              "packing coalesced structures)")
+        check(stats["envelope_dispatches"] >= 1,
+              ">= 1 envelope-packed dispatch "
+              f"({stats['envelope_dispatches']}, lane "
+              f"{stats['lane_dispatches']})")
+        decisions = stats["envelope_decisions"]
+        check(any(d.get("packed") for d in decisions),
+              "pack-vs-solo cost decision recorded and packed "
+              f"({decisions[-1] if decisions else None})")
+        packed_responses = [
+            r[1] for r in results
+            if r[1].get("batch", {}).get("packing") in ("envelope",
+                                                        "lane")]
+        check(len(packed_responses) >= 2,
+              f"{len(packed_responses)} responses carry packed-"
+              "dispatch accounting (packing/envelope_waste keys)")
+
+        # THE acceptance bar: every envelope-packed response equals
+        # the solo api.solve answer bit for bit.
+        for dcop, (_, res) in zip(dcops, results):
+            solo = api.solve(dcop, "maxsum", backend="device",
+                             max_cycles=MAX_CYCLES)
+            if res["assignment"] != solo["assignment"]:
+                check(False,
+                      f"mixed-burst assignment for {dcop.name} "
+                      "differs from solo api.solve")
+            if res["cost"] != solo["cost"]:
+                check(False,
+                      f"mixed-burst cost for {dcop.name} differs "
+                      "from solo api.solve")
+        check(True,
+              f"all {len(dcops)} mixed-burst answers bit-identical "
+              "to solo api.solve")
+    finally:
+        handle.stop()
+
+
 def leg_overload():
     from pydcop_tpu import api
 
@@ -548,6 +629,7 @@ def main() -> int:
     # read per-service stats or scrape deltas — order-independent.
     leg_request_tracing()
     leg_coalescing()
+    leg_mixed_envelope()
     leg_overload()
     leg_kill9_replay()
     leg_sigterm_drain()
