@@ -830,6 +830,101 @@ def bench_recovery_replay():
         shutil.rmtree(journal_dir, ignore_errors=True)
 
 
+# Stateful-session leg (ISSUE 13): the dynamic-DCOP serving workload.
+# A warm DynamicMaxSumEngine absorbs a seeded change_factor stream;
+# per event we time wall-clock until the warm trajectory RECOVERS the
+# cost a cold re-solve of the mutated problem reaches, against that
+# cold re-solve itself ON THE SAME COMPILED PROGRAM (state reset, not
+# a rebuilt engine — isolating the warm-start message benefit from
+# compile-cache effects, which would flatter warm for free).
+SESSION_N_VARS = 48
+SESSION_EVENTS = 16
+SESSION_MAX_CYCLES = 400
+SESSION_SEGMENT_CYCLES = 25
+
+
+def bench_sessions():
+    """Warm vs cold after scenario events.  Emits
+    ``session_time_to_recovered_cost_ms`` (median over the event
+    stream, LOWER is better — sentinel family ``session_recovery``),
+    ``session_events_per_sec`` (sustained apply+re-converge rate —
+    family ``session_events``), the cold-baseline median and the
+    warm/cold speedup.  None-valued on failure — never kills the
+    headline line."""
+    from pydcop_tpu.engine.dynamic import build_dynamic_engine
+
+    rng = np.random.default_rng(1306)
+    base = build_dcop_small(SESSION_N_VARS, 0)
+    params = {"noise": 0.0}
+    warm = build_dynamic_engine(base, params)
+    cold = build_dynamic_engine(base, params)
+    # Converge the initial problem, then run one throwaway
+    # SEGMENT-sized call: max_cycles is part of the superstep
+    # program's jit key, so the timed warm loop (segment-sized runs)
+    # and the timed cold runs (full-budget runs) each need their
+    # program compiled HERE or the first timed event pays a compile.
+    warm.run(max_cycles=SESSION_MAX_CYCLES)
+    warm.run(max_cycles=SESSION_SEGMENT_CYCLES)
+    cold.run(max_cycles=SESSION_MAX_CYCLES)
+    names = sorted(warm.factors)
+    warm_ms, cold_ms, matched = [], [], 0
+    warm_wall = 0.0
+    for _ in range(SESSION_EVENTS):
+        name = names[int(rng.integers(len(names)))]
+        scope = warm.factors[name].dimensions
+        table = rng.integers(
+            0, 10, size=tuple(len(v.domain) for v in scope)
+        ).astype(float)
+        from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+        # Cold baseline: same edit, messages thrown away.
+        cold.change_factor(
+            name, NAryMatrixRelation(list(scope), table, name))
+        cold._state = None
+        t0 = time.perf_counter()
+        cres = cold.run(max_cycles=SESSION_MAX_CYCLES)
+        cold_s = time.perf_counter() - t0
+        cold_cost = cold.cost(cres.assignment)
+        # Warm path: apply + re-converge from the pre-event fixpoint,
+        # in anytime segments, until the cold-solve cost is recovered
+        # (or the warm fixpoint is reached — a warm run may settle at
+        # a different local optimum).
+        t0 = time.perf_counter()
+        warm.change_factor(
+            name, NAryMatrixRelation(list(scope), table, name))
+        recovered_cost = None
+        for _seg in range(
+                SESSION_MAX_CYCLES // SESSION_SEGMENT_CYCLES + 1):
+            wres = warm.run(max_cycles=SESSION_SEGMENT_CYCLES)
+            recovered_cost = warm.cost(wres.assignment)
+            if recovered_cost <= cold_cost + 1e-9 or wres.converged:
+                break
+        warm_s = time.perf_counter() - t0
+        warm_wall += warm_s
+        warm_ms.append(warm_s * 1e3)
+        cold_ms.append(cold_s * 1e3)
+        if recovered_cost is not None \
+                and recovered_cost <= cold_cost + 1e-9:
+            matched += 1
+    warm_med = float(np.median(warm_ms))
+    cold_med = float(np.median(cold_ms))
+    return {
+        "session_time_to_recovered_cost_ms": round(warm_med, 3),
+        "session_cold_resolve_ms": round(cold_med, 3),
+        "session_warm_speedup": (round(cold_med / warm_med, 2)
+                                 if warm_med > 0 else None),
+        "session_events_per_sec": (
+            round(SESSION_EVENTS / warm_wall, 2)
+            if warm_wall > 0 else None),
+        "session_events": SESSION_EVENTS,
+        # Fraction of events where warm re-converged to a cost at
+        # least as good as the cold re-solve — the quality guard on
+        # the speed claim.
+        "session_cost_match_fraction": round(
+            matched / SESSION_EVENTS, 3),
+    }
+
+
 def build_dcop_small(n_vars: int, seed: int):
     """Ring + chord coloring with random cost tables — the serving
     bench's per-request problem (same topology per n_vars, so same
@@ -1297,6 +1392,21 @@ def run_bench():
             "serve_recovery_replay_s": None,
             "serve_recovery_error":
                 f"{type(exc).__name__}: {exc}"[:200],
+        })
+    # Stateful-session leg (ISSUE 13): warm time-to-recovered-cost
+    # after scenario events vs a cold re-solve on the same compiled
+    # program, plus sustained events/sec — sentinel families
+    # "session_recovery" (lower is better) and "session_events".
+    try:
+        record_leg_backend("sessions")
+        serve_keys.update(bench_sessions())
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: session leg failed ({exc}); continuing",
+              file=sys.stderr)
+        serve_keys.update({
+            "session_time_to_recovered_cost_ms": None,
+            "session_events_per_sec": None,
+            "session_error": f"{type(exc).__name__}: {exc}"[:200],
         })
     # Sharded-superstep leg: real mesh on TPU (when the tunnel gave
     # us more than one chip), forced-host-device child on CPU.

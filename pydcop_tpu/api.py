@@ -341,6 +341,9 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           recover: bool = False,
           envelope_packing: bool = True,
           envelope_overhead_ms: Optional[float] = None,
+          session_max: int = 64,
+          session_segment_cycles: Optional[int] = None,
+          session_checkpoint_every_events: int = 8,
           block: bool = False) -> Optional[ServeHandle]:
     """Start the multi-tenant solve service (docs/serving.md).
 
@@ -370,6 +373,17 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     crash-durable); ``recover=True`` replays accepted-but-unfinished
     journal entries through the queue on startup (``pydcop serve
     --journal_dir D --recover``); ``journal_sync`` fsyncs per record.
+
+    Stateful sessions (docs/sessions.md): ``POST /session`` opens a
+    long-lived dynamic-DCOP solve, ``PATCH /session/<id>/events``
+    streams scenario events applied between engine segments without
+    recompiling when the shape survives, SSE streams anytime results
+    and the journal replays whole sessions after a crash.
+    ``session_max`` bounds live sessions (each keeps a warm engine),
+    ``session_segment_cycles`` overrides the default anytime-segment
+    granularity, ``session_checkpoint_every_events`` the engine-state
+    snapshot cadence (journaled services; smaller = faster recovery,
+    more snapshot writes).
 
     ``port=0`` asks the OS for a free port.  ``block=True`` (the
     ``pydcop serve`` CLI) serves until SIGTERM/SIGINT, then STOPS
@@ -401,6 +415,10 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
         recover=recover,
         envelope_packing=envelope_packing,
         envelope_overhead_ms=envelope_overhead_ms,
+        session_max=session_max,
+        session_segment_cycles=session_segment_cycles,
+        session_checkpoint_every_events=(
+            session_checkpoint_every_events),
     ).start()
     try:
         front_end = ServeFrontEnd(service, port=port, host=host).start()

@@ -91,6 +91,25 @@ def set_parser(subparsers):
                              "postmortem bundles; 0 disables; "
                              "default: PYDCOP_FLIGHT_RECORDER or "
                              "2048 — docs/observability.md)")
+    parser.add_argument("--session_max", "--session-max", type=int,
+                        default=64,
+                        help="live stateful sessions allowed at once "
+                             "(each keeps a warm engine; opens past "
+                             "it get 429 — docs/sessions.md)")
+    parser.add_argument("--session_segment_cycles",
+                        "--session-segment-cycles",
+                        type=int, default=None, metavar="CYCLES",
+                        help="session anytime-segment granularity: "
+                             "cycles per engine segment between SSE "
+                             "updates (default 50; smaller = fresher "
+                             "streams, more host syncs)")
+    parser.add_argument("--session_checkpoint_every",
+                        "--session-checkpoint-every",
+                        type=int, default=8, metavar="EVENTS",
+                        help="event batches between session "
+                             "engine-state checkpoints (journaled "
+                             "services; smaller = faster --recover, "
+                             "more snapshot writes; 0 disables)")
     parser.set_defaults(func=run_cmd)
 
 
@@ -120,6 +139,9 @@ def run_cmd(args) -> int:
         recover=args.recover,
         envelope_packing=not args.no_envelope,
         envelope_overhead_ms=args.envelope_overhead_ms,
+        session_max=args.session_max,
+        session_segment_cycles=args.session_segment_cycles,
+        session_checkpoint_every_events=args.session_checkpoint_every,
         block=True,
     )
     return 0

@@ -40,6 +40,22 @@ def set_parser(subparsers):
                         help="replicated-variable sharding: row-shard "
                              "factor buckets over this many devices "
                              "(device mode, any algorithm)")
+    parser.add_argument("--scenario", default=None, metavar="FILE",
+                        help="dynamic DCOP: replay this scenario "
+                             "yaml's events (dcop/scenario.py "
+                             "vocabulary — change/add/remove factor, "
+                             "add variable, agent placement) through "
+                             "the incremental DynamicMaxSumEngine "
+                             "after the initial solve converges — "
+                             "warm-started between events, zero "
+                             "recompiles while the shape survives "
+                             "(device mode, maxsum family; "
+                             "docs/sessions.md)")
+    parser.add_argument("--scenario_event_cycles",
+                        "--scenario-event-cycles",
+                        type=int, default=None, metavar="CYCLES",
+                        help="re-convergence cycle budget per "
+                             "scenario event (default: --cycles)")
     parser.add_argument("--shards", type=int, default=None,
                         help="partitioned sharding (device mode, "
                              "maxsum family): min-edge-cut partition "
@@ -214,6 +230,8 @@ def run_cmd(args) -> int:
             "--checkpoint_dir/--resume segment the device engine's "
             "solve loop: use --mode device"
         )
+    if args.scenario:
+        return _run_scenario_cmd(args, dcop, algo_def)
     fault_plan = None
     if (args.fault_drop or args.fault_dup or args.fault_delay
             or args.fault_kill):
@@ -387,5 +405,63 @@ def run_cmd(args) -> int:
             if path:
                 add_csvline(path, args.collect_on, result)
 
+    emit_result(result, args.output)
+    return 0
+
+
+def _run_scenario_cmd(args, dcop, algo_def) -> int:
+    """``pydcop solve --scenario FILE``: dynamic-DCOP replay through
+    the incremental engine (reference CLI parity for scenario runs;
+    generators/scenario_gen.py makes the inputs).  Events apply
+    between warm-started engine segments — the same machinery the
+    serve plane's stateful sessions use (docs/sessions.md)."""
+    import time as _time
+
+    from pydcop_tpu.dcop.yamldcop import load_scenario_from_file
+    from pydcop_tpu.engine.dynamic import replay_scenario
+
+    if args.mode != "device":
+        raise ValueError(
+            "--scenario replays events through the device engine: "
+            "use --mode device")
+    if isinstance(algo_def, str) or algo_def.algo not in (
+            "maxsum", "maxsum_dynamic", "amaxsum"):
+        raise ValueError(
+            "--scenario needs a maxsum-family algorithm (the "
+            "incremental engine is MaxSum); got "
+            f"{algo_def if isinstance(algo_def, str) else algo_def.algo!r}")
+    scenario = load_scenario_from_file(args.scenario)
+    params = dict(algo_def.params)
+    # maxsum's decimation_margin knob defaults to 0.0 == OFF (same
+    # contract as decimation_plan_from_params: margin <= 0 disables),
+    # so the falsy coercion here is the knob's documented semantics.
+    margin = params.get("decimation_margin") or None
+    t0 = _time.perf_counter()
+    out = replay_scenario(
+        dcop, scenario, params=params, max_cycles=args.cycles,
+        event_cycles=args.scenario_event_cycles,
+        decimation_margin=margin,
+    )
+    result = {
+        "status": "FINISHED" if out["converged"] else "TIMEOUT",
+        "assignment": out["assignment"],
+        # Cost and violations both come from the MUTATED (live)
+        # factor set — a hard constraint the scenario removed or
+        # replaced no longer binds the solution, so the original
+        # problem's tables are not consulted.
+        "cost": out["cost"],
+        "violation": out["violations"],
+        "time": _time.perf_counter() - t0,
+        "cycle": out["cycles"],
+        "backend": "device",
+        "scenario": {
+            "file": args.scenario,
+            "events_applied": out["event_count"],
+            "recompiles": out["recompiles"],
+            "clamped": out["clamped"],
+            "orphaned_computations": out["orphaned"],
+            "events": out["events"],
+        },
+    }
     emit_result(result, args.output)
     return 0

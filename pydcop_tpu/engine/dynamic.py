@@ -30,15 +30,18 @@ recompile; mitigate with padding slack and donated buffers"):
   the device-side analogue of the repair DCOP.
 """
 
+import logging
 import math
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from pydcop_tpu.dcop.objects import Variable, _stable_noise
 from pydcop_tpu.dcop.relations import Constraint
+
+logger = logging.getLogger("pydcop.engine.dynamic")
 from pydcop_tpu.engine.compile import (
     BIG,
     CompiledFactorGraph,
@@ -83,6 +86,19 @@ class DynamicMaxSumEngine:
         self.slots: Dict[str, Tuple[int, int]] = {}
         self.factors: Dict[str, Constraint] = {}
         self.recompile_count = 0
+        # Decimation clamps: variable name -> frozen domain index.  A
+        # clamped variable's unary cost row is BIG everywhere except
+        # the frozen slot, so the warm-started loop keeps it fixed —
+        # data surgery on the var table, never a shape change, so
+        # clamping/releasing reuses the compiled superstep program.
+        self.clamps: Dict[str, int] = {}
+        # Placement bookkeeping (the device-side analogue of the
+        # reference's agent hosting): computation name -> agent, plus
+        # the set of live agents.  Departures re-home computations onto
+        # the least-loaded survivors without touching the device math
+        # (every computation already runs in the same XLA program).
+        self.placement: Dict[str, Optional[str]] = {}
+        self.agents: set = set()
         self._jitted = {}
         self._warm = set()
         self._state = None
@@ -104,12 +120,21 @@ class DynamicMaxSumEngine:
         var_valid = np.zeros((v_count + 1, self.dmax), bool)
         for i, v in enumerate(self.variables):
             d = len(v.domain)
-            costs = self.sign * v.cost_vector()[:d]
-            if self.noise_level:
-                costs = costs + _stable_noise(
-                    v.name, d, self.noise_level, self.noise_seed)
-            var_costs[i, :d] = costs
+            var_costs[i, :d] = self._var_base_row(v)
             var_valid[i, :d] = True
+        # Clamps survive a recompile: the rebuilt var table starts from
+        # base costs, so re-cut the frozen rows (clamps on variables
+        # that no longer exist are dropped).
+        self.clamps = {
+            name: idx for name, idx in self.clamps.items()
+            if name in self.var_index
+            and idx < len(self.variables[self.var_index[name]].domain)
+        }
+        for name, idx in self.clamps.items():
+            i = self.var_index[name]
+            kept = var_costs[i, idx]
+            var_costs[i, :] = BIG
+            var_costs[i, idx] = kept
 
         by_arity: Dict[int, List[Constraint]] = {}
         for c in constraints:
@@ -167,6 +192,174 @@ class DynamicMaxSumEngine:
         new_buckets = list(self.graph.buckets)
         new_buckets[bi] = FactorBucket(costs, var_ids)
         self.graph = self.graph._replace(buckets=tuple(new_buckets))
+
+    def _var_base_row(self, v: Variable) -> np.ndarray:
+        """The variable's unclamped unary cost slice (sign-folded,
+        noise-stabilized) — recomputable at any time because the noise
+        is a pure function of the variable name and seed."""
+        d = len(v.domain)
+        costs = self.sign * v.cost_vector()[:d]
+        if self.noise_level:
+            costs = costs + _stable_noise(
+                v.name, d, self.noise_level, self.noise_seed)
+        return np.asarray(costs, np.float32)
+
+    def _patch_var_rows(self, rows: Dict[int, np.ndarray]):
+        """Replace unary cost rows on a host copy of the var table and
+        refresh the device graph without recompiling (shape
+        unchanged)."""
+        var_costs = np.asarray(self.graph.var_costs).copy()
+        for i, row in rows.items():
+            var_costs[i, :] = row
+        self.graph = self.graph._replace(var_costs=var_costs)
+
+    # ------------------------------------------------------------- #
+    # decimation clamps
+    # ------------------------------------------------------------- #
+
+    def clamp_variables(self, clamps: Dict[str, int]) -> None:
+        """Freeze variables at a domain index (decimation clamp): the
+        unary row turns BIG everywhere else, so message passing keeps
+        the variable pinned while the rest of the graph adapts.  Data
+        surgery only — the compiled program is reused."""
+        # Validate and build EVERY row before recording anything: a
+        # bad entry mid-mapping must not leave earlier names recorded
+        # in self.clamps with the var table unpatched (a later
+        # recompile would silently start enforcing them).
+        rows: Dict[int, np.ndarray] = {}
+        validated: Dict[str, int] = {}
+        for name, idx in clamps.items():
+            i = self.var_index[name]
+            v = self.variables[i]
+            idx = int(idx)
+            if not 0 <= idx < len(v.domain):
+                raise ValueError(
+                    f"clamp index {idx} out of domain for {name}")
+            row = np.full(self.dmax, BIG, np.float32)
+            row[idx] = self._var_base_row(v)[idx]
+            rows[i] = row
+            validated[name] = idx
+        if rows:
+            self.clamps.update(validated)
+            self._patch_var_rows(rows)
+            self._unfreeze()
+
+    def release_clamps(self, names: Iterable[str]) -> List[str]:
+        """Release decimation clamps on exactly ``names`` (unknown /
+        unclamped names are ignored): the base unary rows are
+        recomputed and restored, and the warm-started loop is free to
+        move those variables again.  Returns the names actually
+        released."""
+        rows: Dict[int, np.ndarray] = {}
+        released = []
+        for name in names:
+            if name not in self.clamps or name not in self.var_index:
+                self.clamps.pop(name, None)
+                continue
+            del self.clamps[name]
+            i = self.var_index[name]
+            v = self.variables[i]
+            row = np.full(self.dmax, BIG, np.float32)
+            row[:len(v.domain)] = self._var_base_row(v)
+            rows[i] = row
+            released.append(name)
+        if rows:
+            self._patch_var_rows(rows)
+            self._unfreeze()
+        return released
+
+    def beliefs(self) -> np.ndarray:
+        """Host-side per-variable beliefs ``[V, dmax]``: unary costs
+        (clamps included) plus every incident factor->variable
+        message.  Before the first run this is just the unary table."""
+        bel = np.asarray(
+            self.graph.var_costs, np.float64)[:-1].copy()
+        if self._state is None:
+            return bel
+        padded = np.zeros(
+            (len(self.variables) + 1, self.dmax), np.float64)
+        padded[:-1] = bel
+        for bi, bucket in enumerate(self.graph.buckets):
+            var_ids = np.asarray(bucket.var_ids).reshape(-1)
+            msgs = np.asarray(
+                self._state.f2v[bi], np.float64).reshape(
+                    -1, self.dmax)
+            np.add.at(padded, var_ids, msgs)
+        return padded[:-1]
+
+    def decimate(self, margin: float = 0.0,
+                 max_fraction: float = 0.25) -> List[str]:
+        """Clamp the most-decided unclamped variables to their
+        current best value (the Max-Sum decimation discipline): a
+        variable qualifies when its belief margin (second best minus
+        best over the valid domain) is at least ``margin``; at most
+        ``max_fraction`` of the unclamped population clamps per call
+        (most-confident first).  Returns the clamped names."""
+        bel = self.beliefs()
+        valid = np.asarray(self.graph.var_valid)[:-1]
+        candidates = []
+        for i, v in enumerate(self.variables):
+            if v.name in self.clamps:
+                continue
+            row = np.where(valid[i], bel[i], np.inf)
+            if np.count_nonzero(np.isfinite(row)) < 2:
+                continue
+            order = np.argsort(row)
+            m = float(row[order[1]] - row[order[0]])
+            if m >= margin:
+                candidates.append((m, v.name, int(order[0])))
+        if not candidates:
+            return []
+        budget = max(
+            1, int(math.ceil(
+                max_fraction
+                * (len(self.variables) - len(self.clamps)))))
+        candidates.sort(reverse=True)
+        chosen = {name: idx for _, name, idx in candidates[:budget]}
+        self.clamp_variables(chosen)
+        return list(chosen)
+
+    # ------------------------------------------------------------- #
+    # placement bookkeeping (agent events)
+    # ------------------------------------------------------------- #
+
+    def set_placement(self, mapping: Dict[str, str]) -> None:
+        """Computation-name -> agent hosting map (reporting parity
+        with the thread runtime; the device math never moves)."""
+        self.placement = dict(mapping)
+        self.agents = {a for a in mapping.values() if a is not None}
+
+    def add_agent(self, name: str) -> None:
+        self.agents.add(name)
+
+    def remove_agent(self, name: str) -> Dict[str, Optional[str]]:
+        """Re-home the departed agent's computations onto the
+        least-loaded survivors — the device-side analogue of the
+        repair DCOP.  With no survivors the computations are orphaned
+        (mapped to ``None``) and a warning is logged: the device math
+        is unaffected, only the hosting report degrades.  Returns the
+        moved computations and their new hosts."""
+        self.agents.discard(name)
+        moved: Dict[str, Optional[str]] = {}
+        loads: Dict[str, int] = {a: 0 for a in self.agents}
+        for comp, agent in self.placement.items():
+            if agent in loads:
+                loads[agent] += 1
+        for comp, agent in list(self.placement.items()):
+            if agent != name:
+                continue
+            if loads:
+                target = min(loads, key=lambda a: (loads[a], a))
+                loads[target] += 1
+            else:
+                target = None
+            self.placement[comp] = target
+            moved[comp] = target
+        if moved and not self.agents:
+            logger.warning(
+                "remove_agent(%s): no surviving agents; %d "
+                "computation(s) orphaned", name, len(moved))
+        return moved
 
     # ------------------------------------------------------------- #
     # dynamic edits
@@ -379,12 +572,24 @@ class DynamicMaxSumEngine:
         )
 
     def cost(self, assignment: Dict) -> float:
-        """Host-side constraint cost of an assignment."""
+        """Host-side solution cost of an assignment: per-variable
+        unary costs plus every live factor — the same convention as
+        ``DCOP.solution_cost`` (the engine optimizes both, and a
+        session's reported cost must be comparable to a one-shot
+        ``api.solve``'s)."""
         total = 0.0
+        for v in self.variables:
+            total += float(v.cost_for_val(assignment[v.name]))
         for c in self.factors.values():
-            total += float(c(**{
+            value = float(c(**{
                 v.name: assignment[v.name] for v in c.dimensions
             }))
+            # Hard violations contribute 0 to the cost (the
+            # solution_cost convention) — an inf total would also be
+            # unserializable for the session JSON/SSE surfaces.
+            # replay_scenario reports the violation count alongside.
+            if abs(value) != float("inf"):
+                total += value
         return total
 
     # ------------------------------------------------------------- #
@@ -472,3 +677,230 @@ class DynamicMaxSumEngine:
             stable=np.asarray(bool(data["stable"])),
             cycle=np.asarray(int(data["cycle"]), dtype=np.int32),
         )
+
+
+# --------------------------------------------------------------------- #
+# Scenario event vocabulary (dcop/scenario.py actions -> engine edits)
+# --------------------------------------------------------------------- #
+
+# Action types the dynamic engine understands.  ``change_factor`` /
+# ``add_factor`` / ``remove_factor`` / ``add_variable`` mutate the
+# compiled arrays (dcop/scenario.py vocabulary, served by the session
+# plane — docs/sessions.md); ``remove_agent`` / ``add_agent`` are the
+# reference generator's placement events (generators/scenario_gen.py),
+# pure hosting bookkeeping on a device engine.
+EVENT_ACTIONS = ("change_factor", "add_factor", "remove_factor",
+                 "add_variable", "remove_agent", "add_agent")
+
+
+def _constraint_from_args(engine: DynamicMaxSumEngine, name: str,
+                          args: Dict[str, Any],
+                          default_scope: Optional[List[Variable]] = None
+                          ) -> Constraint:
+    """Build a Constraint from wire/scenario action args: either a
+    dense cost ``table`` over ``variables`` (names resolved against
+    the engine) or a python ``expression`` (scope inferred from free
+    variables).  ``default_scope`` serves change_factor, whose scope
+    is the live factor's when the action names none."""
+    from pydcop_tpu.dcop.relations import (
+        NAryMatrixRelation,
+        constraint_from_str,
+    )
+
+    if "expression" in args:
+        return constraint_from_str(
+            name, args["expression"], engine.variables)
+    if "table" not in args:
+        raise ValueError(
+            f"action for factor {name!r} needs a 'table' (dense cost "
+            "hypercube) or an 'expression'")
+    var_names = args.get("variables")
+    if var_names:
+        scope = []
+        for vn in var_names:
+            if vn not in engine.var_index:
+                raise ValueError(
+                    f"unknown variable {vn!r} in factor {name!r} "
+                    "(add_variable it first)")
+            scope.append(engine.variables[engine.var_index[vn]])
+    elif default_scope is not None:
+        scope = list(default_scope)
+    else:
+        raise ValueError(
+            f"factor {name!r} needs a 'variables' list")
+    return NAryMatrixRelation(
+        scope, np.asarray(args["table"], float), name)
+
+
+def apply_action(engine: DynamicMaxSumEngine, action_type: str,
+                 args: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply ONE scenario action to a live engine.
+
+    Returns ``{"type", "touched"}`` where ``touched`` is the variable
+    names the edit concerns — exactly the set whose decimation clamps
+    the caller should release (clamps elsewhere stay: the event only
+    re-opened the touched neighborhood).  Raises ``ValueError`` /
+    ``KeyError`` on malformed or unknown actions (the serving front
+    end turns these into 400s)."""
+    args = dict(args or {})
+    if action_type == "change_factor":
+        name = args["name"]
+        if name not in engine.factors:
+            raise KeyError(f"No live factor named {name}")
+        old_scope = engine.factors[name].dimensions
+        c = _constraint_from_args(engine, name, args,
+                                  default_scope=old_scope)
+        engine.change_factor(name, c)
+        return {"type": action_type,
+                "touched": [v.name for v in c.dimensions]}
+    if action_type == "add_factor":
+        name = args["name"]
+        c = _constraint_from_args(engine, name, args)
+        engine.add_factor(c)
+        return {"type": action_type,
+                "touched": [v.name for v in c.dimensions]}
+    if action_type == "remove_factor":
+        name = args["name"]
+        if name not in engine.slots:
+            raise KeyError(f"No live factor named {name}")
+        touched = [v.name
+                   for v in engine.factors[name].dimensions]
+        engine.remove_factor(name)
+        return {"type": action_type, "touched": touched}
+    if action_type == "add_variable":
+        from pydcop_tpu.dcop.objects import Domain
+
+        name = args["name"]
+        values = args.get("domain")
+        if not values:
+            raise ValueError(
+                f"add_variable {name!r} needs a 'domain' value list")
+        engine.add_variable(Variable(
+            name, Domain(f"{name}_dom", "", list(values))))
+        return {"type": action_type, "touched": [name]}
+    if action_type == "remove_agent":
+        moved = engine.remove_agent(args["agent"])
+        return {"type": action_type, "touched": [],
+                "moved": moved}
+    if action_type == "add_agent":
+        engine.add_agent(args["agent"])
+        return {"type": action_type, "touched": []}
+    raise ValueError(
+        f"unknown scenario action {action_type!r}; valid: "
+        f"{', '.join(EVENT_ACTIONS)}")
+
+
+def build_dynamic_engine(dcop, params: Optional[Dict[str, Any]] = None
+                         ) -> DynamicMaxSumEngine:
+    """A DynamicMaxSumEngine over a DCOP's variables/constraints with
+    the maxsum parameter names the serve plane uses (damping /
+    damping_nodes / stability / noise / slack), plus a round-robin
+    hosting map over the DCOP's agents so placement events have
+    something to move."""
+    params = params or {}
+    engine = DynamicMaxSumEngine(
+        list(dcop.variables.values()),
+        list(dcop.constraints.values()),
+        mode=dcop.objective,
+        noise_level=float(params.get("noise", 0.01)),
+        damping=float(params.get("damping", 0.5)),
+        damping_nodes=params.get("damping_nodes", "both"),
+        stability=float(params.get("stability", 0.1)),
+        slack=float(params.get("slack", 0.25)),
+    )
+    agents = sorted(dcop.agents) or ["a0"]
+    comps = ([v.name for v in engine.variables]
+             + sorted(engine.factors))
+    engine.set_placement({
+        comp: agents[i % len(agents)]
+        for i, comp in enumerate(comps)
+    })
+    return engine
+
+
+def replay_scenario(dcop, scenario,
+                    params: Optional[Dict[str, Any]] = None,
+                    max_cycles: int = 1000,
+                    event_cycles: Optional[int] = None,
+                    decimation_margin: Optional[float] = None,
+                    on_event=None) -> Dict[str, Any]:
+    """Replay a dcop/scenario.py event script through a
+    DynamicMaxSumEngine (the ``pydcop solve --scenario`` engine —
+    reference-CLI parity for dynamic DCOPs, docs/sessions.md).
+
+    The initial problem is solved to convergence, then each event's
+    actions are applied between engine segments (delay events become
+    segment boundaries — replay is logical time, not wall clock) and
+    the trajectory re-converges WARM from the pre-event fixpoint,
+    releasing decimation clamps on the touched variables only.
+    Returns the final assignment/cost plus a per-event record
+    (actions, recompiles delta, post-event cost/cycles)."""
+    engine = build_dynamic_engine(dcop, params)
+    budget = event_cycles or max_cycles
+    res = engine.run(max_cycles=max_cycles)
+    events: List[Dict[str, Any]] = []
+    for event in scenario:
+        t0 = time.perf_counter()
+        if event.is_delay:
+            # Logical-time replay: a delay is a chance for the
+            # trajectory to settle, not a wall-clock sleep.
+            res = engine.run(max_cycles=budget)
+            events.append({
+                "id": event.id, "delay": event.delay,
+                "cost": engine.cost(res.assignment),
+                "cycles": res.cycles,
+                "recompiles": 0,
+                "wall_s": time.perf_counter() - t0,
+            })
+            continue
+        before = engine.recompile_count
+        touched: List[str] = []
+        applied = []
+        for action in (event.actions or []):
+            info = apply_action(engine, action.type, action.args)
+            touched.extend(info["touched"])
+            applied.append(info["type"])
+        if touched:
+            engine.release_clamps(touched)
+        res = engine.run(max_cycles=budget)
+        if decimation_margin is not None:
+            engine.decimate(margin=decimation_margin)
+        rec = {
+            "id": event.id,
+            "actions": applied,
+            "touched": sorted(set(touched)),
+            "recompiles": engine.recompile_count - before,
+            "cost": engine.cost(res.assignment),
+            "cycles": res.cycles,
+            "converged": res.converged,
+            "wall_s": time.perf_counter() - t0,
+        }
+        events.append(rec)
+        if on_event is not None:
+            on_event(rec)
+    assignment = res.assignment
+    return {
+        "assignment": assignment,
+        "cost": engine.cost(assignment),
+        "cycles": res.cycles,
+        "converged": res.converged,
+        "events": events,
+        "event_count": sum(
+            1 for e in scenario if not e.is_delay),
+        "recompiles": engine.recompile_count - 1,
+        "clamped": sorted(engine.clamps),
+        # The factor set the replay ENDED with: consumers comparing
+        # against the original problem (violation counting, parity
+        # oracles) must know which constraints the events removed.
+        "factors": sorted(engine.factors),
+        # Hard violations against the LIVE (mutated) factors — a
+        # constraint the events removed or replaced no longer binds
+        # the solution, so the original problem's tables must not be
+        # consulted here.
+        "violations": sum(
+            1 for c in engine.factors.values()
+            if abs(c(**{v.name: assignment[v.name]
+                        for v in c.dimensions})) == float("inf")),
+        "orphaned": sorted(
+            c for c, a in engine.placement.items() if a is None),
+    }

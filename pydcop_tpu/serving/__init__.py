@@ -27,7 +27,15 @@ throughput service (docs/serving.md):
   ``--recover`` start (kill -9 loses zero acknowledged requests);
 - :mod:`.http` — stdlib HTTP front end (``POST /solve``,
   ``GET /result/<id>``, ``GET /stats``) mounting the PR-5 telemetry
-  routes (``/metrics``, ``/healthz``, ``/events``) alongside.
+  routes (``/metrics``, ``/healthz``, ``/events``) alongside;
+- :mod:`.sessions` — stateful solve sessions (docs/sessions.md):
+  ``POST /session`` opens a solve backed by one warm
+  ``DynamicMaxSumEngine``, ``PATCH /session/<id>/events`` streams
+  scenario events applied between engine segments (in-shape edits =
+  zero recompiles, messages warm-start from the pre-event fixpoint,
+  decimation clamps release on touched variables only),
+  ``GET /session/<id>/events`` (SSE) streams anytime results, and
+  the journal replays WHOLE sessions after a crash.
 
 Entry points: ``pydcop serve`` (commands/serve.py) and
 :func:`pydcop_tpu.api.serve`.
@@ -46,4 +54,10 @@ from pydcop_tpu.serving.journal import (  # noqa: F401
 from pydcop_tpu.serving.service import (  # noqa: F401
     SolveRequest,
     SolveService,
+)
+from pydcop_tpu.serving.sessions import (  # noqa: F401
+    SessionClosed,
+    SessionLimit,
+    SessionManager,
+    SolveSession,
 )

@@ -20,14 +20,22 @@ telemetry:
 - ``GET /metrics`` / ``/healthz`` / ``/events`` — mounted unchanged
   from the telemetry server; ``/healthz`` additionally reflects the
   serving state (open dispatch breaker → ``failing`` → 503).
+- Stateful sessions (docs/sessions.md): ``POST /session`` opens a
+  long-lived solve (201 + session_id/trace_id),
+  ``PATCH /session/<id>/events`` streams scenario events into it
+  (the 200 is journal-durable like a submit's 202),
+  ``GET /session/<id>`` polls status, ``GET /session/<id>/events``
+  streams anytime assignment/cost per segment (SSE), and
+  ``DELETE /session/<id>`` closes with the final result.
 
-curl examples live in docs/serving.md.
+curl examples live in docs/serving.md and docs/sessions.md.
 """
 
 import json
 import logging
 import math
-from typing import Any, Dict
+import queue
+from typing import Any, Dict, Optional
 
 from pydcop_tpu.observability.server import (
     TelemetryServer,
@@ -37,6 +45,10 @@ from pydcop_tpu.observability.server import (
 )
 from pydcop_tpu.serving.admission import AdmissionRejected
 from pydcop_tpu.serving.service import SolveService
+from pydcop_tpu.serving.sessions import (
+    SessionClosed,
+    scenario_yaml_to_events,
+)
 
 logger = logging.getLogger("pydcop.serving.http")
 
@@ -75,9 +87,42 @@ class _ServeHandler(_Handler):
         self._reply(code, json.dumps(payload, default=str).encode(),
                     "application/json", close=close)
 
+    def _read_json_body(self) -> Optional[Dict[str, Any]]:
+        """Read + decode the request's JSON object body; replies the
+        4xx itself and returns None on failure (callers just
+        return)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = 0
+        if length <= 0 or length > MAX_BODY_BYTES:
+            self._json(400, {"error": "body required (JSON, "
+                                      f"<= {MAX_BODY_BYTES} bytes)"},
+                       close=True)
+            return None
+        raw = self.rfile.read(length)
+        try:
+            body = json.loads(raw)
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as exc:
+            self._json(400, {"error": f"bad request body: {exc}"})
+            return None
+        return body
+
     def do_GET(self):  # noqa: N802 — stdlib name
         path = self.path.split("?", 1)[0]
         service = self.telemetry.service
+        if path.startswith("/session/"):
+            rest = path[len("/session/"):]
+            if rest.endswith("/events"):
+                self._stream_session(rest[:-len("/events")])
+                return
+            try:
+                self._json(200, service.sessions.status(rest))
+            except KeyError:
+                self._json(404, {"error": f"unknown session {rest!r}"})
+            return
         if path.startswith("/result/"):
             rid = path[len("/result/"):]
             # Both lookups can KeyError: the id may be unknown, or
@@ -102,6 +147,9 @@ class _ServeHandler(_Handler):
 
     def do_POST(self):  # noqa: N802 — stdlib name
         path = self.path.split("?", 1)[0]
+        if path == "/session":
+            self._open_session()
+            return
         if path != "/solve":
             # Replying without reading the body would leave it on the
             # socket and corrupt the next keep-alive request (the
@@ -109,27 +157,14 @@ class _ServeHandler(_Handler):
             # error path that skips the read.
             self._json(404, {"error": "unknown path"}, close=True)
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-        except ValueError:
-            length = 0
-        if length <= 0 or length > MAX_BODY_BYTES:
-            self._json(400, {"error": "body required (JSON, "
-                                      f"<= {MAX_BODY_BYTES} bytes)"},
-                       close=True)
+        body = self._read_json_body()
+        if body is None:
             return
-        raw = self.rfile.read(length)
-        try:
-            body = json.loads(raw)
-            if not isinstance(body, dict):
-                raise ValueError("body must be a JSON object")
-            yaml_src = body.get("dcop")
-            if not isinstance(yaml_src, str) or not yaml_src.strip():
-                raise ValueError(
-                    "body needs a 'dcop' key holding the problem "
-                    "as a dcop yaml string")
-        except ValueError as exc:
-            self._json(400, {"error": f"bad request body: {exc}"})
+        yaml_src = body.get("dcop")
+        if not isinstance(yaml_src, str) or not yaml_src.strip():
+            self._json(400, {"error": "bad request body: body needs "
+                                      "a 'dcop' key holding the "
+                                      "problem as a dcop yaml string"})
             return
         service = self.telemetry.service
         # Wire-level fields validate BEFORE submit: a malformed
@@ -187,6 +222,160 @@ class _ServeHandler(_Handler):
         self._json(202, {"id": rid, "status": "queued",
                          "trace_id": trace_id,
                          "result_url": f"/result/{rid}"})
+
+    # -- stateful sessions (docs/sessions.md) -------------------------- #
+
+    def _open_session(self):
+        """``POST /session`` — body ``{"dcop": yaml, "params":
+        {...}}``: opens a stateful solve whose engine lives across
+        requests.  201 + session_id/trace_id; the session starts
+        converging immediately and streams anytime results on
+        ``GET /session/<id>/events``."""
+        body = self._read_json_body()
+        if body is None:
+            return
+        yaml_src = body.get("dcop")
+        if not isinstance(yaml_src, str) or not yaml_src.strip():
+            self._json(400, {"error": "bad request body: body needs "
+                                      "a 'dcop' key holding the "
+                                      "problem as a dcop yaml string"})
+            return
+        service = self.telemetry.service
+        try:
+            from pydcop_tpu.dcop.yamldcop import load_dcop
+
+            dcop = load_dcop(yaml_src)
+            sess = service.sessions.open(
+                dcop, params=body.get("params"),
+                session_id=body.get("session_id"))
+        except AdmissionRejected as exc:
+            self._json(exc.http_status, {
+                "error": str(exc), "status": "rejected",
+                "retry": exc.http_status == 429,
+            })
+            return
+        except RuntimeError as exc:
+            self._json(500, {"error": f"internal error: {exc}"})
+            return
+        except Exception as exc:  # noqa: BLE001 — malformed problem
+            service.record_bad_request()
+            self._json(400, {"error": f"bad problem: {exc}"})
+            return
+        self._json(201, {
+            "session_id": sess.id,
+            "trace_id": sess.trace_id,
+            "status": sess.status,
+            "events_url": f"/session/{sess.id}/events",
+        })
+
+    def do_PATCH(self):  # noqa: N802 — stdlib name
+        """``PATCH /session/<id>/events`` — body ``{"events": [...]}``
+        (wire actions) or ``{"scenario": "<scenario yaml>"}``; with
+        ``"wait": true`` the reply carries the post-event segment
+        result.  The 200 is durable: the batch is journaled before
+        the ack."""
+        path = self.path.split("?", 1)[0]
+        if not (path.startswith("/session/")
+                and path.endswith("/events")):
+            self._json(404, {"error": "unknown path"}, close=True)
+            return
+        sid = path[len("/session/"):-len("/events")]
+        body = self._read_json_body()
+        if body is None:
+            return
+        service = self.telemetry.service
+        # Wire-level parsing FIRST, in its own guard: a malformed
+        # scenario yaml raises KeyError('type'/'id') from the loader,
+        # which the unknown-session handler below would otherwise
+        # mistranslate into a 404 for a perfectly live session.
+        try:
+            events = body.get("events")
+            if events is None and body.get("scenario"):
+                events = scenario_yaml_to_events(body["scenario"])
+            wait = None
+            if body.get("wait"):
+                wait = _positive_float(
+                    body.get("timeout", 30.0), "timeout")
+        except Exception as exc:  # noqa: BLE001 — malformed body
+            service.record_bad_request()
+            self._json(400, {"error": f"bad events: {exc}"})
+            return
+        try:
+            out = service.sessions.apply_events(
+                sid, events, wait=wait)
+        except KeyError:
+            self._json(404, {"error": f"unknown session {sid!r}"})
+            return
+        except SessionClosed as exc:
+            self._json(409, {"error": str(exc)})
+            return
+        except RuntimeError as exc:
+            self._json(500, {"error": f"internal error: {exc}"})
+            return
+        except Exception as exc:  # noqa: BLE001 — malformed events
+            service.record_bad_request()
+            self._json(400, {"error": f"bad events: {exc}"})
+            return
+        self._json(200, out)
+
+    def do_DELETE(self):  # noqa: N802 — stdlib name
+        """``DELETE /session/<id>`` — close the session; 200 + the
+        final result (idempotent: a second DELETE returns the same
+        final)."""
+        path = self.path.split("?", 1)[0]
+        if not path.startswith("/session/"):
+            self._json(404, {"error": "unknown path"}, close=True)
+            return
+        sid = path[len("/session/"):]
+        service = self.telemetry.service
+        try:
+            final = service.sessions.close(sid)
+        except KeyError:
+            self._json(404, {"error": f"unknown session {sid!r}"})
+            return
+        except SessionClosed as exc:
+            self._json(409, {"error": str(exc)})
+            return
+        except TimeoutError as exc:
+            self._json(504, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 — close must answer
+            self._json(500, {"error": f"internal error: {exc}"})
+            return
+        self._json(200, final)
+
+    def _stream_session(self, sid: str):
+        """``GET /session/<id>/events`` — per-session SSE: the latest
+        segment event replays on connect, then every segment /
+        terminal event streams as it lands.  The stream ends when the
+        session reaches a terminal state."""
+        service = self.telemetry.service
+        try:
+            q = service.sessions.subscribe(sid)
+        except KeyError:
+            self._json(404, {"error": f"unknown session {sid!r}"})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            while not self.telemetry._stopping.is_set():
+                try:
+                    event = q.get(timeout=1.0)
+                except queue.Empty:
+                    self.wfile.write(b": keepalive\n\n")
+                    self.wfile.flush()
+                    continue
+                self._write_event(event)
+                if event.get("status") in ("CLOSED", "ERROR",
+                                           "REPLAYABLE"):
+                    break
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away — normal SSE termination
+        finally:
+            service.sessions.unsubscribe(sid, q)
 
 
 class ServeFrontEnd(TelemetryServer):

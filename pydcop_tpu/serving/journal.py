@@ -56,6 +56,16 @@ JOURNAL_FILE = "requests.jnl"
 # Record kinds.
 ACCEPTED = "accepted"
 COMPLETED = "completed"
+# Session records (ISSUE 13, serving/sessions.py): a stateful session
+# is replayed WHOLE after a crash — open record (the base problem),
+# every acknowledged event batch, the newest engine-state checkpoint
+# marker, and a close record that retires the lot.
+SESSION_OPEN = "session_open"
+SESSION_EVENT = "session_event"
+SESSION_CKPT = "session_ckpt"
+SESSION_CLOSE = "session_close"
+SESSION_KINDS = (SESSION_OPEN, SESSION_EVENT, SESSION_CKPT,
+                 SESSION_CLOSE)
 
 
 def encode_record(record: Dict[str, Any]) -> bytes:
@@ -113,6 +123,49 @@ def pending_requests(records: List[Dict[str, Any]]
     return list(accepted.values())
 
 
+def pending_sessions(records: List[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+    """Open-but-not-closed sessions, each as ``{"open": rec,
+    "ckpt": rec_or_None, "events": [recs]}`` in open order — the
+    whole-session replay set.
+
+    ``ckpt`` is the NEWEST checkpoint marker; ``events`` holds every
+    acknowledged event batch in seq order, INCLUDING those at or
+    before the checkpoint seq — recovery needs the pre-checkpoint
+    events to rebuild the engine's factor layout structurally before
+    the checkpointed message state can be restored onto it
+    (serving/sessions.py SessionManager.recover)."""
+    open_recs: Dict[str, Dict[str, Any]] = {}
+    events: Dict[str, List[Dict[str, Any]]] = {}
+    ckpts: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        kind = rec.get("kind")
+        sid = rec.get("id")
+        if sid is None:
+            continue
+        if kind == SESSION_OPEN:
+            open_recs[sid] = rec
+            events[sid] = []
+            ckpts.pop(sid, None)
+        elif kind == SESSION_EVENT and sid in open_recs:
+            events[sid].append(rec)
+        elif kind == SESSION_CKPT and sid in open_recs:
+            prior = ckpts.get(sid)
+            if prior is None or (rec.get("seq", 0)
+                                 >= prior.get("seq", 0)):
+                ckpts[sid] = rec
+        elif kind == SESSION_CLOSE and sid in open_recs:
+            del open_recs[sid]
+            events.pop(sid, None)
+            ckpts.pop(sid, None)
+    return [
+        {"open": rec, "ckpt": ckpts.get(sid),
+         "events": sorted(events.get(sid, []),
+                          key=lambda r: r.get("seq", 0))}
+        for sid, rec in open_recs.items()
+    ]
+
+
 class RequestJournal:
     """Append-side handle on one journal directory.
 
@@ -150,13 +203,28 @@ class RequestJournal:
     @classmethod
     def recover(cls, journal_dir: str, sync: bool = False
                 ) -> Tuple["RequestJournal", List[Dict[str, Any]]]:
+        """:meth:`recover_full` without the session set — kept for
+        callers that predate stateful sessions (the compaction still
+        preserves open-session records either way: a request-only
+        consumer must never silently destroy session durability)."""
+        journal, pending, _sessions = cls.recover_full(
+            journal_dir, sync=sync)
+        return journal, pending
+
+    @classmethod
+    def recover_full(cls, journal_dir: str, sync: bool = False
+                     ) -> Tuple["RequestJournal",
+                                List[Dict[str, Any]],
+                                List[Dict[str, Any]]]:
         """Open a journal directory for crash recovery.
 
         Scans the journal, truncates a torn tail past the last valid
-        record, computes the pending (accepted-without-terminal) set,
-        and atomically compacts the file down to exactly those
-        records before reopening it for appends.  Returns the open
-        journal and the pending records, in acceptance order."""
+        record, computes the pending (accepted-without-terminal)
+        request set AND the open-session set
+        (:func:`pending_sessions`), and atomically compacts the file
+        down to exactly those records before reopening it for
+        appends.  Returns ``(journal, pending_requests,
+        pending_sessions)`` in acceptance/open order."""
         path = os.path.join(journal_dir, JOURNAL_FILE)
         records, valid_bytes, torn = scan_journal(path)
         if torn:
@@ -164,9 +232,11 @@ class RequestJournal:
                 "journal %s has a torn tail: truncating to the last "
                 "valid record at byte %d", path, valid_bytes)
         pending = pending_requests(records)
+        sessions = pending_sessions(records)
         if os.path.exists(path):
-            # Compact: pending records only, written to a temp file
-            # and renamed over the old journal — a crash mid-compact
+            # Compact: pending requests plus every open session's
+            # open/ckpt/event records, written to a temp file and
+            # renamed over the old journal — a crash mid-compact
             # leaves the (longer but equivalent) original.
             fd, tmp = tempfile.mkstemp(
                 dir=journal_dir, prefix=".jnl_tmp_")
@@ -174,6 +244,12 @@ class RequestJournal:
                 with os.fdopen(fd, "wb") as f:
                     for rec in pending:
                         f.write(encode_record(rec))
+                    for sess in sessions:
+                        f.write(encode_record(sess["open"]))
+                        if sess["ckpt"] is not None:
+                            f.write(encode_record(sess["ckpt"]))
+                        for rec in sess["events"]:
+                            f.write(encode_record(rec))
                 os.replace(tmp, path)
             except BaseException:
                 try:
@@ -185,9 +261,10 @@ class RequestJournal:
         if records or torn:
             logger.info(
                 "journal recovery: %d record(s) scanned, %d pending "
-                "request(s) to replay%s", len(records), len(pending),
+                "request(s) and %d open session(s) to replay%s",
+                len(records), len(pending), len(sessions),
                 " (torn tail truncated)" if torn else "")
-        return journal, pending
+        return journal, pending, sessions
 
 
 def accepted_record(rid: str, dcop_yaml: str,
@@ -213,3 +290,46 @@ def accepted_record(rid: str, dcop_yaml: str,
 
 def completed_record(rid: str, status: str) -> Dict[str, Any]:
     return {"kind": COMPLETED, "id": rid, "status": status}
+
+
+# --------------------------------------------------------------------- #
+# Session records (serving/sessions.py)
+
+
+def session_open_record(sid: str, dcop_yaml: str,
+                        params: Dict[str, Any],
+                        trace_id: Optional[str] = None
+                        ) -> Dict[str, Any]:
+    rec = {"kind": SESSION_OPEN, "id": sid, "dcop": dcop_yaml,
+           "params": params}
+    if trace_id:
+        rec["trace_id"] = trace_id
+    return rec
+
+
+def session_event_record(sid: str, seq: int,
+                         events: List[Dict[str, Any]],
+                         trace_id: Optional[str] = None
+                         ) -> Dict[str, Any]:
+    """One acknowledged PATCH batch: ``seq`` is the batch's position
+    in the session's event order (monotone per session — replay
+    applies batches in seq order), ``events`` the wire-form event
+    list exactly as acknowledged."""
+    rec = {"kind": SESSION_EVENT, "id": sid, "seq": int(seq),
+           "events": events}
+    if trace_id:
+        rec["trace_id"] = trace_id
+    return rec
+
+
+def session_ckpt_record(sid: str, seq: int, path: str,
+                        cycle: int = 0) -> Dict[str, Any]:
+    """Engine-state checkpoint marker: the NPZ at ``path`` holds the
+    warm message state AFTER event batch ``seq`` was applied —
+    recovery restores it and replays only the batches past ``seq``."""
+    return {"kind": SESSION_CKPT, "id": sid, "seq": int(seq),
+            "path": path, "cycle": int(cycle)}
+
+
+def session_close_record(sid: str, status: str) -> Dict[str, Any]:
+    return {"kind": SESSION_CLOSE, "id": sid, "status": status}

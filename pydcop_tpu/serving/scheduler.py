@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, List
 
+from pydcop_tpu.serving.sessions import SessionWork
+
 logger = logging.getLogger("pydcop.serving.scheduler")
 
 # Queue sentinel: wakes the loop for shutdown.
@@ -71,6 +73,12 @@ class BinScheduler:
                 continue
             if first is _STOP:
                 continue
+            # Session work (stateful sessions, serving/sessions.py)
+            # runs between request flushes on this same thread — one
+            # thread owns every device dispatch, batched or session.
+            if isinstance(first, SessionWork):
+                self.service.run_session_work(first)
+                continue
             # Deadline enforcement happens HERE, before binning: work
             # that expired while queued is dropped (terminal EXPIRED,
             # 504) instead of burning a device dispatch — and never
@@ -80,8 +88,14 @@ class BinScheduler:
                 continue
             bins: Dict = {}
             bins.setdefault(first.bin, []).append(first)
-            self._collect(q, bins)
+            session_work: List = []
+            self._collect(q, bins, session_work)
             self._dispatch_bins(bins)
+            # Session work drained during the window runs AFTER the
+            # flush (events apply between segments/dispatches by
+            # design) but in its original queue order.
+            for work in session_work:
+                self.service.run_session_work(work)
         # Shutdown: the service fails anything still queued.
 
     def _expire(self, req) -> bool:
@@ -94,11 +108,15 @@ class BinScheduler:
                              "the request anyway")
             return False
 
-    def _collect(self, q, bins: Dict) -> None:
+    def _collect(self, q, bins: Dict,
+                 session_work: List = None) -> None:
         """Linger up to the batch window, draining arrivals into
         per-bin lists.  Stops early once the largest bin can fill a
         whole dispatch — waiting longer would only add latency to a
-        batch that is already full."""
+        batch that is already full.  Session work drained mid-window
+        is stashed (in order) for the caller to run after the flush —
+        it must not block collection, and its engine mutations belong
+        between dispatches."""
         deadline = time.monotonic() + self.batch_window_s
         while not self._stop.is_set():
             if max(len(v) for v in bins.values()) >= self.max_batch:
@@ -112,6 +130,12 @@ class BinScheduler:
                 return
             if req is _STOP:
                 return
+            if isinstance(req, SessionWork):
+                if session_work is not None:
+                    session_work.append(req)
+                else:
+                    self.service.run_session_work(req)
+                continue
             if self._expire(req):
                 continue
             bins.setdefault(req.bin, []).append(req)

@@ -187,7 +187,11 @@ class SolveService:
                      binning.EnvelopeLadder] = None,
                  envelope_overhead_ms: Optional[float] = None,
                  lane_pack: bool = True,
-                 lane_domain_max: int = 8):
+                 lane_domain_max: int = 8,
+                 session_max: int = 64,
+                 session_segment_cycles: Optional[int] = None,
+                 session_checkpoint_every_events: int = 8,
+                 session_keep: int = 256):
         if admission is None:
             admission = AdmissionPolicy(high_water=max_queue)
         self.admission = AdmissionController(admission)
@@ -279,6 +283,16 @@ class SolveService:
         self._journal_records = reg.counter(
             "pydcop_serve_journal_records_total",
             "Request-journal records appended, by kind")
+        # Stateful solve sessions (ISSUE 13, serving/sessions.py):
+        # long-lived DynamicMaxSumEngine solves whose scenario events
+        # apply between engine segments on the scheduler thread.
+        from pydcop_tpu.serving.sessions import SessionManager
+
+        self.sessions = SessionManager(
+            self, max_sessions=session_max,
+            segment_cycles=session_segment_cycles,
+            checkpoint_every_events=session_checkpoint_every_events,
+            session_keep=session_keep)
 
     # -- lifecycle ----------------------------------------------------- #
 
@@ -294,11 +308,12 @@ class SolveService:
         self._was_active = metrics_registry.active
         metrics_registry.active = True
         pending = []
+        pending_sessions = []
         if self.journal_dir and self._journal is None:
             if self.recover_on_start:
-                self._journal, pending = journal_mod.\
-                    RequestJournal.recover(self.journal_dir,
-                                           sync=self.journal_sync)
+                self._journal, pending, pending_sessions = \
+                    journal_mod.RequestJournal.recover_full(
+                        self.journal_dir, sync=self.journal_sync)
             else:
                 self._journal = journal_mod.RequestJournal(
                     self.journal_dir, sync=self.journal_sync)
@@ -317,6 +332,12 @@ class SolveService:
             flight.set_journal_provider(self._flight_provider)
         if pending:
             self._replay(pending)
+        if pending_sessions:
+            # Whole-session replay: engines rebuilt from the open
+            # records, warm state restored from the newest checkpoint,
+            # journaled-but-unapplied event batches re-applied
+            # (serving/sessions.py SessionManager.recover).
+            self.sessions.recover(pending_sessions)
         return self
 
     def stop(self, drain: bool = True,
@@ -338,7 +359,7 @@ class SolveService:
         if not self._started:
             return dict(self.last_stop or
                         {"drained": 0, "replayable": 0,
-                         "failed_pending": 0})
+                         "failed_pending": 0, "parked_sessions": 0})
         completed_before = self.completed
         if drain:
             deadline = time.monotonic() + timeout
@@ -362,6 +383,13 @@ class SolveService:
             except queue.Empty:
                 break
             if not isinstance(req, SolveRequest):
+                # Queued session work dies with the queue (the
+                # session itself is parked below); wake any PATCH
+                # waiter blocked on it.
+                done = getattr(req, "done", None)
+                if done is not None:
+                    req.error = "service stopped"
+                    done.set()
                 continue
             if self._journal is not None:
                 logger.info("request %s left journaled-replayable "
@@ -370,6 +398,11 @@ class SolveService:
                 failed_pending += 1
                 self._finish_error(req,
                                    "service stopped before dispatch")
+        # Park open sessions AFTER the scheduler halted (their
+        # engines are safe to touch) and BEFORE the journal closes:
+        # journaled sessions checkpoint their warm state + stay
+        # REPLAYABLE for --recover, journal-less ones fail.
+        parked_sessions = self.sessions.park_all()
         replayable = 0
         if self._journal is not None:
             # Identity-guarded: never strip a sibling journaled
@@ -405,6 +438,7 @@ class SolveService:
             "drained": self.completed - completed_before,
             "replayable": replayable,
             "failed_pending": failed_pending,
+            "parked_sessions": parked_sessions,
         }
         return dict(self.last_stop)
 
@@ -1047,6 +1081,20 @@ class SolveService:
             req.done.set()
             self._publish_lifecycle("finished", req)
 
+    def run_session_work(self, work) -> None:
+        """Scheduler hook: one stateful-session work item (event
+        apply / engine segment / close — serving/sessions.py).
+        Guarded so a session failure can never kill the scheduler
+        thread; session-level error handling lives in the manager."""
+        try:
+            self.sessions.run_work(work)
+        except Exception:  # noqa: BLE001 — last line of defense
+            logger.exception("session work crashed")
+            done = getattr(work, "done", None)
+            if done is not None and not done.is_set():
+                work.error = "internal session work error"
+                done.set()
+
     def _run_batch(self, reqs, params, envelope=None,
                    lane_d: Optional[int] = None):
         """The device call, isolated for tests to stub failures.
@@ -1180,6 +1228,9 @@ class SolveService:
             "dir": self.journal_dir,
             "active": self._journal is not None,
             "pending_replayable": pending,
+            # Open sessions are replay debt too: a --recover restart
+            # rebuilds each one from its open/ckpt/event records.
+            "open_sessions": self.sessions.active_count(),
             "journal_bytes": size,
         }
 
@@ -1207,6 +1258,7 @@ class SolveService:
             "portfolio_resolved": self.portfolio_resolved,
             "journal": (self.journal_dir
                         if self._journal is not None else None),
+            "sessions": self.sessions.stats(),
             "tracked_requests": tracked,
             "max_batch": self.max_batch,
             "batch_window_s": self.batch_window_s,

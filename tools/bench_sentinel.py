@@ -131,6 +131,15 @@ def load_history(root: str) -> List[Dict[str, Any]]:
             # when the leg failed that round.
             "serve_mixed_value": _opt_float(
                 parsed.get("serve_mixed_problems_per_sec")),
+            # Stateful-session legs (ISSUE 13 bench_sessions):
+            # warm time-to-recovered-cost after a scenario event
+            # (ms, LOWER is better) and sustained applied events per
+            # second per session — absent before PR 13, None when
+            # the leg failed that round.
+            "session_ttr_value": _opt_float(
+                parsed.get("session_time_to_recovered_cost_ms")),
+            "session_eps_value": _opt_float(
+                parsed.get("session_events_per_sec")),
             # The p99 latency exemplar from the serving leg (ISSUE
             # 9): when the newest run regresses, the report points at
             # a concrete request trace instead of a bare number.
@@ -256,6 +265,15 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
          "backend", False),
         ("shard_recovery", "shard_recovery_value", "s",
          "sharded_backend", False),
+        # ISSUE 13: the stateful-session families — sustained
+        # scenario-event throughput per session (higher is better)
+        # and warm time-to-recovered-cost after an event (the
+        # session plane's reason to exist: it must stay far below a
+        # cold re-solve; lower is better).
+        ("session_events", "session_eps_value", "events/s",
+         "backend", True),
+        ("session_recovery", "session_ttr_value", "ms",
+         "backend", False),
     )
     series = {}
     lines = []

@@ -510,6 +510,111 @@ def scenario_serve_journal_replay(seed, trace):
         return {"replayed": 4, "torn_tail": "truncated"}
 
 
+def scenario_session_replay(seed, trace):
+    """Crash-equivalent SESSION journal (ISSUE 13): an open record,
+    3 acked event batches and a torn tail, no close — a
+    ``recover=True`` start must rebuild the session's engine, apply
+    every journaled batch, re-converge to EXACTLY the uninterrupted
+    replay's final cost, announce the replay in the trace
+    (``session_replay`` span), and a close must retire the session
+    so a second recovery has nothing to resurrect."""
+    import numpy as np
+
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.engine.dynamic import build_dynamic_engine
+    from pydcop_tpu.observability import ObservabilitySession
+    from pydcop_tpu.serving.sessions import apply_event_batch
+    from pydcop_tpu.serving.journal import (
+        RequestJournal,
+        session_event_record,
+        session_open_record,
+    )
+    from pydcop_tpu.serving.service import SolveService
+
+    rng = np.random.default_rng(seed)
+    params = {"noise": 0.01, "stability": 0.001,
+              "max_cycles": 500, "segment_cycles": 100}
+    # Path topology: max-sum is exact there, so cost equality with
+    # the uninterrupted run is a hard assertion, not a tolerance.
+    d = Domain("c", "", [0, 1, 2])
+    dcop = DCOP(f"soak_sess_{seed}", objective="min")
+    vs = [Variable(f"v{i}", d) for i in range(10)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(9):
+        table = rng.integers(0, 10, size=(3, 3)).astype(float)
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[k], vs[k + 1]], table, f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    batches = [
+        [{"type": "change_factor", "name": f"c{int(rng.integers(9))}",
+          "table": rng.integers(0, 10, size=(3, 3))
+          .astype(float).tolist()}]
+        for _ in range(3)
+    ]
+    # Uninterrupted reference through the same engine machinery.
+    ref = build_dynamic_engine(dcop, params)
+    ref.run(max_cycles=params["max_cycles"])
+    for batch in batches:
+        _applied, _touched, error = apply_event_batch(ref, batch)
+        assert error is None, f"reference batch failed: {error}"
+        ref.run(max_cycles=params["max_cycles"])
+    expected = ref.cost(
+        ref.run(max_cycles=params["max_cycles"]).assignment)
+
+    with tempfile.TemporaryDirectory() as journal_dir:
+        jnl = RequestJournal(journal_dir)
+        jnl.append(session_open_record(
+            "crash_sess", dcop_yaml(dcop), params))
+        for i, batch in enumerate(batches):
+            jnl.append(session_event_record("crash_sess", i + 1,
+                                            batch))
+        jnl.close()
+        with open(jnl.path, "ab") as f:
+            f.write(b"\x00\x00\x00\x20torn-mid-append")  # kill -9
+        svc = SolveService(journal_dir=journal_dir, recover=True,
+                           batch_window_s=0.05, max_batch=8)
+        with ObservabilitySession(trace, "chrome"):
+            svc.start()
+            try:
+                status = svc.sessions.status("crash_sess")
+                assert status["seq"] == 3 \
+                    and status["applied_seq"] == 3, \
+                    f"acked batches lost in replay: {status}"
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    status = svc.sessions.status("crash_sess")
+                    last = status["last"]
+                    if last and last.get("converged"):
+                        break
+                    time.sleep(0.05)
+                final = svc.sessions.close("crash_sess")
+                assert final["cost"] == expected, \
+                    f"recovered session cost {final['cost']} != " \
+                    f"uninterrupted {expected}"
+            finally:
+                svc.stop(drain=False)
+        svc2 = SolveService(journal_dir=journal_dir, recover=True,
+                            batch_window_s=0.05)
+        svc2.start()
+        try:
+            try:
+                svc2.sessions.status("crash_sess")
+                raise AssertionError(
+                    "closed session resurrected on second recovery")
+            except KeyError:
+                pass
+        finally:
+            svc2.stop(drain=False)
+    from pydcop_tpu.observability.trace import load_trace_file
+
+    names = {e["name"] for e in load_trace_file(trace)}
+    assert "session_replay" in names, \
+        "session_replay span missing from exported trace"
+    return {"replayed_batches": 3, "final_cost": expected}
+
+
 def scenario_serve_poison_bin(seed, trace):
     """One poison request in a bin of 6: the failed dispatch BISECTS
     — the poison request fails alone, every bin-mate succeeds, the
@@ -672,6 +777,7 @@ SCENARIOS = [
     ("delay_only_no_death", scenario_delay_only_no_death),
     ("drop_plus_kill", scenario_drop_plus_kill),
     ("serve_journal_replay", scenario_serve_journal_replay),
+    ("session_replay", scenario_session_replay),
     ("serve_poison_bin", scenario_serve_poison_bin),
     ("shard_trip_repartition", scenario_shard_trip_repartition),
     ("anomaly_postmortem", scenario_anomaly_postmortem),
@@ -691,6 +797,7 @@ QUICK_GATE = [
     "checkpoint_corruption",
     "guard_noop_device",
     "serve_journal_replay",
+    "session_replay",
     "serve_poison_bin",
     "shard_trip_repartition",
     "anomaly_postmortem",

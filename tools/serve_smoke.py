@@ -24,7 +24,14 @@ Starts the real service on port 0 and drives it over HTTP:
    signal makes the serve process drain and exit 0, logging the
    drained/replayable counts — accepted work is never silently
    dropped.
-5. **Request-scoped tracing** (ISSUE 9 acceptance): a real-HTTP
+5. **Session kill -9 + whole-session replay** (ISSUE 13
+   acceptance): a stateful session is opened over HTTP, 3 event
+   batches are acked, the process is SIGKILLed; every acked record
+   must be on disk and a ``--recover`` start must resume the
+   session, apply the journaled-but-unapplied batches, and close
+   with exactly the uninterrupted run's final cost — zero acked
+   events lost.
+6. **Request-scoped tracing** (ISSUE 9 acceptance): a real-HTTP
    batched burst is traced; ``pydcop trace query --request ID`` (the
    REAL CLI, on the exported trace) must return a single well-nested
    tree holding the submit, queue, ``serve_dispatch`` and
@@ -442,6 +449,128 @@ def leg_kill9_replay():
           "acknowledged requests")
 
 
+def build_path_instance(n_vars: int, seed: int):
+    """Path (tree) coloring: max-sum is exact here, so the recovered
+    session's final cost must EQUAL the uninterrupted replay's."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(seed)
+    dom = Domain("colors", "", [0, 1, 2])
+    dcop = DCOP(f"smoke_path_{n_vars}_{seed}", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(n_vars - 1):
+        table = rng.integers(0, 10, size=(3, 3)).astype(float)
+        dcop.add_constraint(
+            NAryMatrixRelation([vs[k], vs[k + 1]], table, f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+SESSION_PARAMS = {"noise": 0.01, "stability": 0.001,
+                  "max_cycles": 600, "segment_cycles": 100}
+
+
+def leg_session_replay():
+    """ISSUE-13 acceptance: SIGKILL a real serve subprocess
+    mid-SESSION.  A stateful session is opened over HTTP, 3 event
+    batches are acked (200s), the process dies with no drain; every
+    acked record must be on disk, and a --recover start must resume
+    the session, apply the journaled-but-unapplied batches, and
+    close with EXACTLY the final cost an uninterrupted replay of the
+    same event stream produces — zero acked events lost."""
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.engine.dynamic import build_dynamic_engine
+    from pydcop_tpu.serving.journal import scan_journal
+    from pydcop_tpu.serving.service import SolveService
+    from pydcop_tpu.serving.sessions import apply_event_batch
+
+    rng = np.random.default_rng(1306)
+    base = build_path_instance(10, 1306)
+    batches = [
+        [{"type": "change_factor", "name": f"c{i}",
+          "table": rng.integers(0, 10, size=(3, 3))
+          .astype(float).tolist()}]
+        for i in range(3)
+    ]
+    # The uninterrupted reference: the same open + event stream
+    # through a local engine (deterministic on CPU).
+    ref = build_dynamic_engine(base, SESSION_PARAMS)
+    ref.run(max_cycles=SESSION_PARAMS["max_cycles"])
+    for batch in batches:
+        _applied, _touched, error = apply_event_batch(ref, batch)
+        check(error is None, f"reference batch applied ({error})")
+        ref.run(max_cycles=SESSION_PARAMS["max_cycles"])
+    expected = ref.cost(
+        ref.run(max_cycles=SESSION_PARAMS["max_cycles"]).assignment)
+
+    journal_dir = tempfile.mkdtemp(prefix="serve_session_")
+    port = _free_port()
+    proc = _spawn_serve(port, journal_dir)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        _wait_listening(proc, url)
+        req = urllib.request.Request(
+            url + "/session",
+            data=json.dumps({"dcop": dcop_yaml(base),
+                             "params": SESSION_PARAMS}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            ack = json.loads(resp.read())
+            check(resp.status == 201 and ack.get("session_id"),
+                  "session opened over HTTP (201 + id)")
+        sid = ack["session_id"]
+        for i, batch in enumerate(batches):
+            req = urllib.request.Request(
+                url + f"/session/{sid}/events",
+                data=json.dumps({"events": batch}).encode(),
+                method="PATCH",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                body = json.loads(resp.read())
+                check(resp.status == 200
+                      and body["seq"] == i + 1,
+                      f"event batch {i + 1} acked (durable 200)")
+        # No drain, no close: the acks are the only promise left.
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    records, _, _ = scan_journal(
+        os.path.join(journal_dir, "requests.jnl"))
+    kinds = [r["kind"] for r in records if r.get("id") == sid]
+    check(kinds.count("session_open") == 1
+          and kinds.count("session_event") == 3,
+          "all acked session records on disk after SIGKILL "
+          f"(found {kinds})")
+
+    svc = SolveService(journal_dir=journal_dir, recover=True,
+                       batch_window_s=0.05, max_batch=4)
+    svc.start()
+    try:
+        status = svc.sessions.status(sid)
+        check(status["seq"] == 3 and status["applied_seq"] == 3,
+              "--recover resumed the session with ALL 3 acked "
+              "event batches applied (zero lost)")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            status = svc.sessions.status(sid)
+            if status["last"] and status["last"].get("converged"):
+                break
+            time.sleep(0.1)
+        final = svc.sessions.close(sid)
+        check(final["status"] == "CLOSED"
+              and final["cost"] == expected,
+              "recovered session's final result equals the "
+              f"uninterrupted run ({final['cost']} == {expected})")
+    finally:
+        svc.stop(drain=False)
+
+
 def leg_sigterm_drain():
     """SIGTERM (the orchestrated-restart signal): the process drains
     accepted work and exits 0, logging the drained count."""
@@ -632,6 +761,7 @@ def main() -> int:
     leg_mixed_envelope()
     leg_overload()
     leg_kill9_replay()
+    leg_session_replay()
     leg_sigterm_drain()
     print(f"serve_smoke: PASS ({time.perf_counter() - t0:.1f}s)")
     return 0
