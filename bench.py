@@ -775,6 +775,12 @@ def bench_serving():
         # The p99 bucket's exemplar: a flagged regression in the
         # sentinel points at a concrete request trace to open.
         "exemplar_trace_id": (p99_exemplar or {}).get("trace_id"),
+        # The efficiency plane's verdict on the leg (ISSUE 14):
+        # backend-honest attainment + useful-work fraction + the
+        # where-the-time-went component sums, so a throughput number
+        # always ships with the evidence of HOW the device time was
+        # spent.  Detail key — the sentinel ignores it.
+        "serve_efficiency": stats.get("efficiency"),
     }
 
 
@@ -1418,6 +1424,15 @@ def run_bench():
             shard_keys["sharded_backend"] = "tpu"
         else:
             shard_keys = _bench_sharded_forced()
+        # The leg record must name the backend the leg's values
+        # actually came from: on a single-chip TPU round the leg runs
+        # in a FORCED-CPU child while this process's default backend
+        # says tpu — the sentinel prefers the leg record over the
+        # sharded_backend fallback, so a stale parent-process label
+        # would pad the tpu sharded baseline with forced-host values.
+        if shard_keys.get("sharded_backend"):
+            _LEG_BACKENDS["sharded"]["backend"] = \
+                shard_keys["sharded_backend"]
     except Exception as exc:  # noqa: BLE001 — auxiliary leg
         print(f"bench: sharded leg failed ({exc}); continuing",
               file=sys.stderr)

@@ -350,9 +350,13 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     Incoming problems are binned by structure signature and
     same-structure requests are stacked into ONE vmapped device
     dispatch (the batched-BP throughput lever); results stream back
-    per request with latency accounting.  The front end serves
-    ``POST /solve`` / ``GET /result/<id>`` / ``GET /stats`` plus the
-    live telemetry routes (``/metrics``, ``/healthz``, ``/events``).
+    per request with latency accounting and a time LEDGER whose
+    components sum to the measured total
+    (docs/observability.md "Efficiency accounting").  The front end
+    serves ``POST /solve`` / ``GET /result/<id>`` / ``GET /stats``
+    plus the live telemetry routes (``/metrics``, ``/healthz``,
+    ``/events``, ``/profile`` — the backend-honest efficiency
+    rollup ``pydcop profile report --url`` renders).
 
     Different-structure requests that structure binning would
     dispatch solo are additionally packed into shape-envelope

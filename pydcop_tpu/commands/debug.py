@@ -4,8 +4,11 @@
 document the always-on flight recorder (observability/flight.py)
 dumps automatically on anomaly triggers: the trace-event ring tail,
 a metrics-registry snapshot, the ``/healthz`` payload, env +
-accelerator-probe diagnostics, and the pending-journal summary when a
-serve journal is active.
+accelerator-probe diagnostics, the device-efficiency rollup
+(backend-honest attainment + the where-the-time-went ledger — what
+backend was the anomalous run actually executing on, and was it doing
+useful work), the ``BENCH_TPU_PROBELOG.jsonl`` history tail, and the
+pending-journal summary when a serve journal is active.
 
 Two modes:
 

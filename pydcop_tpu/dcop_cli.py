@@ -36,6 +36,7 @@ def make_parser() -> argparse.ArgumentParser:
         generate,
         graph,
         orchestrator,
+        profile,
         replica_dist,
         run,
         serve,
@@ -64,7 +65,7 @@ def make_parser() -> argparse.ArgumentParser:
     subparsers = parser.add_subparsers(title="commands", dest="command")
     for cmd in (solve, run, distribute, graph, agent, orchestrator,
                 generate, replica_dist, batch, consolidate, trace,
-                serve, debug):
+                serve, debug, profile):
         cmd.set_parser(subparsers)
     return parser
 

@@ -71,6 +71,8 @@ from pydcop_tpu.engine.compile import (
     compile_dcop,
 )
 from pydcop_tpu.engine.runner import DeviceRunResult, timed_jit_call
+from pydcop_tpu.observability import efficiency
+from pydcop_tpu.observability.profiler import profiler
 from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.ops import maxsum as maxsum_ops
 
@@ -290,6 +292,11 @@ def _shape_signature(stacked: CompiledFactorGraph) -> tuple:
     )
 
 
+# The rollup's per-structure cell label (ONE definition, shared with
+# the dynamic engine — observability/efficiency.py).
+_structure_label = efficiency.structure_label
+
+
 def run_stacked(
     graphs: Sequence[CompiledFactorGraph],
     max_cycles: int = 200,
@@ -326,6 +333,7 @@ def run_stacked(
     """
     if not graphs:
         raise ValueError("run_stacked needs at least one graph")
+    t_pack = time.perf_counter()
     envelope_waste: Optional[List[float]] = None
     if envelope is not None:
         graphs, envelope_waste = stack_to_envelope(graphs, envelope)
@@ -378,6 +386,9 @@ def run_stacked(
             "pad_fraction": pad_fraction,
             "cold_start": compile_s > 0.0,
             "run_time_s": run_s,
+            # Host-side batch assembly (envelope padding + stacking),
+            # the ledger's ``prep`` share of this dispatch.
+            "pack_host_s": t0 - t_pack,
             # Per-request convergence verdicts (real lanes, dispatch
             # order): the serve plane folds lane i's flag into
             # request i's result.
@@ -390,6 +401,37 @@ def run_stacked(
         batch_result.metrics["envelope_waste"] = round(
             sum(envelope_waste) / len(envelope_waste), 4
         ) if envelope_waste else 0.0
+    # Efficiency accounting: every batched dispatch is an attainment
+    # sample — all lanes run the full max_cycles budget (no early
+    # stop on the batched path), so the XLA per-iteration cost entry
+    # scales by exactly max_cycles.  Everything (labels, backend
+    # resolution) stays behind the enabled gate: PYDCOP_EFFICIENCY=0
+    # must mean zero work, not discarded work.
+    if efficiency.tracker.enabled:
+        # Structure label AFTER envelope padding: a packed dispatch
+        # runs ONE compiled envelope shape — labeling by whichever
+        # member happened to be first would scatter the same program
+        # across structure cells (the lane path labels its packed
+        # union the same way).
+        record = efficiency.tracker.record_dispatch(
+            key=str(key), structure=_structure_label(graphs[0]),
+            backend=efficiency.backend_name(),
+            # The INNER device wall (sync-honest), not the outer
+            # elapsed: the outer interval also holds the profiler's
+            # one-off AOT capture on cold dispatches, which is host
+            # work, not device attainment denominator.
+            time_s=run_s, compile_s=compile_s, cycles=max_cycles,
+            n_real=n_real, batch_size=len(graphs),
+            pad_fraction=pad_fraction,
+            envelope_waste=batch_result.metrics.get(
+                "envelope_waste", 0.0) or 0.0,
+            packing=batch_result.metrics.get("packing") or (
+                "batched" if n_real > 1 else "solo"),
+            cost_entry=(profiler.get(key)
+                        if profiler.enabled else None),
+        )
+        if record is not None:
+            batch_result.metrics["efficiency"] = record
     return values, cycles, batch_result
 
 
@@ -448,6 +490,7 @@ def run_lane_packed(
 
     if not graphs:
         raise ValueError("run_lane_packed needs at least one graph")
+    t_pack = time.perf_counter()
     union, layout = lane_ops.pack_graphs(graphs, d_env=d_env)
     if ladder is not None:
         from pydcop_tpu.serving.binning import envelope_key
@@ -518,6 +561,7 @@ def run_lane_packed(
             "pad_fraction": 0.0,
             "cold_start": compile_s > 0.0,
             "run_time_s": run_s,
+            "pack_host_s": t0 - t_pack,
             "packing": "lane",
             "converged_lanes": [bool(c) for c in converged],
             "envelope_waste_lanes": lane_waste,
@@ -525,6 +569,20 @@ def run_lane_packed(
                 1.0 - sum(real_cells) / union_cells, 4),
         },
     )
+    if efficiency.tracker.enabled:
+        record = efficiency.tracker.record_dispatch(
+            key=str(key), structure=_structure_label(union),
+            backend=efficiency.backend_name(),
+            time_s=run_s, compile_s=compile_s, cycles=max_cycles,
+            n_real=len(graphs), batch_size=len(graphs),
+            pad_fraction=0.0,
+            envelope_waste=batch_result.metrics["envelope_waste"],
+            packing="lane",
+            cost_entry=(profiler.get(key)
+                        if profiler.enabled else None),
+        )
+        if record is not None:
+            batch_result.metrics["efficiency"] = record
     return per_values, cycles, batch_result
 
 

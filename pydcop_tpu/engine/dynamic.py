@@ -30,6 +30,7 @@ recompile; mitigate with padding slack and donated buffers"):
   the device-side analogue of the repair DCOP.
 """
 
+import contextlib
 import logging
 import math
 import time
@@ -48,6 +49,7 @@ from pydcop_tpu.engine.compile import (
     FactorBucket,
 )
 from pydcop_tpu.engine.runner import DeviceRunResult, timed_jit_call
+from pydcop_tpu.observability import efficiency
 from pydcop_tpu.observability.profiler import key_str, profiler
 from pydcop_tpu.ops import maxsum as ops
 
@@ -102,6 +104,17 @@ class DynamicMaxSumEngine:
         self._jitted = {}
         self._warm = set()
         self._state = None
+        # Cycle counter at the last efficiency record: run() accounts
+        # only the cycles THIS call added (the state's counter is
+        # cumulative across warm-started runs).
+        self._cycles_recorded = 0
+        # Efficiency request class this engine's dispatches report.
+        # A dynamic engine is not inherently a session — the serve
+        # plane's SessionManager relabels the engines it owns; a
+        # scenario replay or direct use stays "dynamic".
+        self.efficiency_class = "dynamic"
+        # Deferred-edit session (batch_edits): None outside a batch.
+        self._edit_session = None
         self._build(list(constraints))
 
     # ------------------------------------------------------------- #
@@ -169,29 +182,136 @@ class DynamicMaxSumEngine:
 
     def _write_row(self, costs: np.ndarray, var_ids: np.ndarray,
                    row: int, c: Constraint):
-        table = self.sign * np.asarray(c.to_array(), np.float32)
-        full = np.full(costs.shape[1:], BIG, np.float32)
-        idx = tuple(slice(0, s) for s in table.shape)
-        full[idx] = table
-        costs[row] = full
-        for p, v in enumerate(c.dimensions):
-            var_ids[row, p] = self.var_index[v.name]
+        costs[row], var_ids[row] = self._render_row(
+            costs.shape[1:], c)
 
     def _patch_bucket(self, bi: int, row: int,
                       c: Optional[Constraint]):
         """Replace one bucket row on the host copy and refresh device
-        arrays without recompiling (shapes unchanged)."""
+        arrays without recompiling (shapes unchanged).  Inside a
+        :meth:`batch_edits` session the write is DEFERRED (last write
+        per row wins) and materialized with one copy per touched
+        bucket at flush — a flattened scenario of N same-bucket
+        actions used to copy the whole bucket N times.  The row is
+        RENDERED eagerly either way (table evaluation, shape fit,
+        scope lookups): a malformed constraint must fail its own
+        action — batch-scoped, exactly like the sequential path —
+        never the flush, which only assigns pre-built arrays."""
         bucket = self.graph.buckets[bi]
+        payload = (None if c is None
+                   else self._render_row(bucket.costs.shape[1:], c))
+        if self._edit_session is not None:
+            self._edit_session["buckets"].setdefault(
+                bi, {})[row] = payload
+            return
         costs = np.asarray(bucket.costs).copy()
         var_ids = np.asarray(bucket.var_ids).copy()
-        if c is None:
-            costs[row] = 0.0
-            var_ids[row] = len(self.variables)
-        else:
-            self._write_row(costs, var_ids, row, c)
+        self._materialize_bucket_rows(costs, var_ids, {row: payload})
         new_buckets = list(self.graph.buckets)
         new_buckets[bi] = FactorBucket(costs, var_ids)
         self.graph = self.graph._replace(buckets=tuple(new_buckets))
+
+    def _render_row(self, cell_shape, c: Constraint):
+        """Evaluate one factor's cost row + scope ids against a
+        bucket's cell shape — every way a constraint can be malformed
+        (table evaluation, oversize shape, unknown scope variable)
+        raises HERE, at action scope."""
+        table = self.sign * np.asarray(c.to_array(), np.float32)
+        full = np.full(cell_shape, BIG, np.float32)
+        idx = tuple(slice(0, s) for s in table.shape)
+        full[idx] = table
+        ids = np.array([self.var_index[v.name] for v in c.dimensions],
+                       np.int32)
+        return full, ids
+
+    def _materialize_bucket_rows(self, costs: np.ndarray,
+                                 var_ids: np.ndarray, rows: Dict):
+        """Assign pre-rendered row payloads onto (already-copied)
+        bucket arrays: a ``(costs_row, ids)`` tuple writes a factor,
+        None resets the row to slack (zero cost, sentinel ids).
+        Assignment-only — cannot fail on malformed input, which is
+        what keeps a deferred flush unable to raise mid-batch."""
+        for row, payload in rows.items():
+            if payload is None:
+                costs[row] = 0.0
+                var_ids[row] = len(self.variables)
+            else:
+                costs[row], var_ids[row] = payload
+
+    # -- deferred-edit batching (ISSUE 14 satellite) ---------------- #
+
+    @contextlib.contextmanager
+    def batch_edits(self):
+        """Accumulate array surgery host-side for the duration of the
+        block and materialize ONE copy per touched bucket / var table
+        / state array at exit — behavior-identical to the immediate
+        path (asserted against the mutation-ladder battery), just
+        without the per-action full-bucket copies.  Edits that force
+        a recompile (slack exhausted, new variable) flush the pending
+        set first, so the rebuild sees exactly the state the
+        sequential path would have.  Reentrant: an inner block is a
+        no-op, the outermost flushes."""
+        if self._edit_session is not None:
+            yield self
+            return
+        self._edit_session = {
+            "buckets": {},      # bi -> {row: constraint|None}
+            "var_rows": {},     # var index -> row values
+            "zero_rows": [],    # (bi, row) state-message resets
+        }
+        try:
+            yield self
+        finally:
+            # The session clears even if the flush raises: a flush
+            # failure must never leave the engine stuck in deferred
+            # mode, silently dropping every later edit.
+            try:
+                self._flush_pending_edits()
+            finally:
+                self._edit_session = None
+
+    def _flush_pending_edits(self):
+        """Materialize the deferred edits in place (one copy per
+        touched array).  Leaves the session OPEN but empty — callers
+        that must see a consistent graph mid-batch (the recompile
+        path) flush and keep accumulating."""
+        sess = self._edit_session
+        if sess is None:
+            return
+        bucket_edits, sess["buckets"] = sess["buckets"], {}
+        var_rows, sess["var_rows"] = sess["var_rows"], {}
+        zero_rows, sess["zero_rows"] = sess["zero_rows"], []
+        if bucket_edits:
+            new_buckets = list(self.graph.buckets)
+            for bi, rows in bucket_edits.items():
+                bucket = new_buckets[bi]
+                costs = np.asarray(bucket.costs).copy()
+                var_ids = np.asarray(bucket.var_ids).copy()
+                self._materialize_bucket_rows(costs, var_ids, rows)
+                new_buckets[bi] = FactorBucket(costs, var_ids)
+            self.graph = self.graph._replace(
+                buckets=tuple(new_buckets))
+        if var_rows:
+            var_costs = np.asarray(self.graph.var_costs).copy()
+            for i, row in var_rows.items():
+                var_costs[i, :] = row
+            self.graph = self.graph._replace(var_costs=var_costs)
+        if zero_rows and self._state is not None:
+            by_bucket: Dict[int, List[int]] = {}
+            for bi, row in zero_rows:
+                by_bucket.setdefault(bi, []).append(row)
+            self._state = self._zero_state_rows(self._state, by_bucket)
+
+    def _queue_zero_row(self, bi: int, row: int):
+        """Neutralize one edge's stale message rows — immediately, or
+        deferred into the batch session (one state-array copy per
+        touched bucket per batch)."""
+        if self._state is None:
+            return
+        if self._edit_session is not None:
+            self._edit_session["zero_rows"].append((bi, row))
+            return
+        self._state = self._zero_state_rows(self._state, {bi: [row]})
 
     def _var_base_row(self, v: Variable) -> np.ndarray:
         """The variable's unclamped unary cost slice (sign-folded,
@@ -207,7 +327,11 @@ class DynamicMaxSumEngine:
     def _patch_var_rows(self, rows: Dict[int, np.ndarray]):
         """Replace unary cost rows on a host copy of the var table and
         refresh the device graph without recompiling (shape
-        unchanged)."""
+        unchanged).  Deferred under :meth:`batch_edits` — one var
+        table copy per batch."""
+        if self._edit_session is not None:
+            self._edit_session["var_rows"].update(rows)
+            return
         var_costs = np.asarray(self.graph.var_costs).copy()
         for i, row in rows.items():
             var_costs[i, :] = row
@@ -405,8 +529,7 @@ class DynamicMaxSumEngine:
         self._free[bi].append(row)
         # Stale messages on the removed edge are neutralized: zero rows
         # with sentinel var ids contribute nothing to beliefs.
-        if self._state is not None:
-            self._state = self._zero_state_row(self._state, bi, row)
+        self._queue_zero_row(bi, row)
 
     def add_factor(self, c: Constraint):
         """Insert a factor.  Fits into a slack row when one exists for
@@ -420,6 +543,11 @@ class DynamicMaxSumEngine:
         # New variables grow the var tables (shape change), so the
         # factor cannot take a slack row — register them and fall
         # through to the shared recompile path (one rebuild total).
+        # Deferred edits flush FIRST: their slack-row sentinel index
+        # is len(self.variables) at queue time, which growing the
+        # variable list would silently shift.
+        if new_vars:
+            self._flush_pending_edits()
         for v in new_vars:
             self.variables.append(v)
             self.var_index[v.name] = len(self.variables) - 1
@@ -434,9 +562,13 @@ class DynamicMaxSumEngine:
             row = self._free[bi].pop(0)
             self._patch_bucket(bi, row, c)
             self.slots[c.name] = (bi, row)
-            if self._state is not None:
-                self._state = self._zero_state_row(self._state, bi, row)
+            self._queue_zero_row(bi, row)
         else:
+            # A recompile rebuilds arrays and remaps the state by
+            # factor name: pending deferred edits must land against
+            # the OLD layout first, exactly as the sequential path
+            # would have applied them.
+            self._flush_pending_edits()
             self._recompile_carrying_messages(
                 list(self.factors.values()))
 
@@ -445,30 +577,29 @@ class DynamicMaxSumEngine:
         tables, which changes shapes -> recompile with carry-over."""
         if v.name in self.var_index:
             return
+        self._flush_pending_edits()
         self.variables.append(v)
         self.var_index[v.name] = len(self.variables) - 1
         self._recompile_carrying_messages(list(self.factors.values()))
 
-    def _zero_state_row(self, state: ops.MaxSumState, bi: int,
-                        row: int) -> ops.MaxSumState:
-        def zero(msgs):
-            arr = np.asarray(msgs[bi]).copy()
-            arr[row] = 0.0
+    def _zero_state_rows(self, state: ops.MaxSumState,
+                         rows_by_bucket: Dict[int, List[int]]
+                         ) -> ops.MaxSumState:
+        """Zero message/count rows for a set of edges, ONE array copy
+        per touched bucket (the batched form the deferred-edit session
+        flushes through; the immediate path passes a single row)."""
+        def zero(msgs, fill):
             out = list(msgs)
-            out[bi] = arr
-            return tuple(out)
-
-        def zero_count(counts):
-            arr = np.asarray(counts[bi]).copy()
-            arr[row] = 0
-            out = list(counts)
-            out[bi] = arr
+            for bi, rows in rows_by_bucket.items():
+                arr = np.asarray(out[bi]).copy()
+                arr[list(rows)] = fill
+                out[bi] = arr
             return tuple(out)
 
         return ops.MaxSumState(
-            v2f=zero(state.v2f), f2v=zero(state.f2v),
-            v2f_count=zero_count(state.v2f_count),
-            f2v_count=zero_count(state.f2v_count),
+            v2f=zero(state.v2f, 0.0), f2v=zero(state.f2v, 0.0),
+            v2f_count=zero(state.v2f_count, 0),
+            f2v_count=zero(state.f2v_count, 0),
             stable=np.asarray(False), cycle=np.asarray(state.cycle),
         )
 
@@ -555,6 +686,24 @@ class DynamicMaxSumEngine:
         }
         metrics = {"recompiles": self.recompile_count - 1,
                    "cold_start": compile_s > 0}
+        # Efficiency accounting: one warm segment of a long-lived
+        # engine is a dispatch like any other — cycles are the delta
+        # this call actually ran (the state counter is cumulative).
+        ran = max(int(state.cycle) - self._cycles_recorded, 0)
+        self._cycles_recorded = int(state.cycle)
+        if efficiency.tracker.enabled:
+            record = efficiency.tracker.record_dispatch(
+                key=str(key),
+                structure=efficiency.structure_label(self.graph),
+                backend=efficiency.backend_name(),
+                time_s=run_s, compile_s=compile_s, cycles=ran,
+                n_real=1, batch_size=1,
+                packing=self.efficiency_class,
+                cost_entry=(profiler.get(key)
+                            if profiler.enabled else None),
+            )
+            if record is not None:
+                metrics["efficiency"] = record
         if profiler.enabled:
             entry = profiler.get(key)
             if entry is not None:
@@ -677,6 +826,10 @@ class DynamicMaxSumEngine:
             stable=np.asarray(bool(data["stable"])),
             cycle=np.asarray(int(data["cycle"]), dtype=np.int32),
         )
+        # The efficiency baseline moves with the restored counter:
+        # otherwise the first post-restore run() would account every
+        # pre-checkpoint cycle as cycles IT ran, inflating attainment.
+        self._cycles_recorded = int(data["cycle"])
 
 
 # --------------------------------------------------------------------- #

@@ -24,6 +24,9 @@ from pydcop_tpu.engine.compile import (
 )
 from pydcop_tpu.engine.sharding import make_mesh, shard_graph
 from pydcop_tpu.engine.timing import sync
+from pydcop_tpu.observability.efficiency import (
+    tracker as efficiency_tracker,
+)
 from pydcop_tpu.observability.metrics import registry as metrics_registry
 from pydcop_tpu.observability.profiler import key_str, profiler
 from pydcop_tpu.observability.trace import tracer
@@ -147,6 +150,10 @@ def timed_jit_call(warm: set, key, fn, *args):
         }
     if metrics_registry.active:
         _account_jit_call(str(key), first, elapsed)
+    # Efficiency plane (observability/efficiency.py): global cold/warm
+    # dispatch accounting — the compile column of waste-by-cause,
+    # covering every engine that routes through this one chokepoint.
+    efficiency_tracker.record_jit(str(key), first, elapsed)
     if first:
         warm.add(key)
         return out, elapsed, elapsed

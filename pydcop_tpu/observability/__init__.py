@@ -1,7 +1,11 @@
 """Unified observability: tracing, metrics registry, engine telemetry.
 
-Seven parts (docs/observability.md):
+Eight parts (docs/observability.md):
 
+- :mod:`.efficiency` — the device-efficiency accounting plane:
+  per-dispatch utilization attainment, request time ledgers and the
+  where-the-time-went rollup behind ``/profile``, the ``/stats``
+  efficiency block and ``pydcop profile report``;
 - :mod:`.trace` — process-wide :data:`~pydcop_tpu.observability.trace.
   tracer` producing timestamped, parent-correlated spans with Chrome
   ``trace_event`` and JSONL exporters, plus multi-process trace
@@ -31,6 +35,10 @@ Prometheus files on the way out.
 
 from typing import Optional
 
+from pydcop_tpu.observability.efficiency import (  # noqa: F401
+    EfficiencyTracker,
+    get_tracker,
+)
 from pydcop_tpu.observability.metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,

@@ -271,6 +271,30 @@ class FlightRecorder:
             bundle["probe_diagnostics"] = list(diag_events())
         except Exception as exc:  # noqa: BLE001
             bundle["probe_diagnostics"] = [{"error": str(exc)}]
+        # The on-disk probe HISTORY tail (BENCH_TPU_PROBELOG.jsonl /
+        # record_diag format): the in-env diagnostics above cover only
+        # this process tree; the probelog is the cross-run evidence of
+        # tunnel health, so a postmortem says what backend the
+        # anomalous run actually executed on (ISSUE 14).
+        try:
+            from pydcop_tpu.utils.cleanenv import probelog_tail
+
+            tail = probelog_tail(20)
+            if tail:
+                bundle["probe_log_tail"] = tail
+        except Exception as exc:  # noqa: BLE001
+            bundle["probe_log_tail"] = [{"error": str(exc)}]
+        # The efficiency rollup (observability/efficiency.py): the
+        # postmortem's "was the device even doing useful work, and on
+        # which backend" section — backend identity, attainment and
+        # the where-the-time-went ledger at the moment of the
+        # anomaly.
+        try:
+            from pydcop_tpu.observability.efficiency import tracker
+
+            bundle["efficiency"] = tracker.rollup(top_n=5)
+        except Exception as exc:  # noqa: BLE001
+            bundle["efficiency"] = {"error": str(exc)}
         provider = get_journal_provider()
         if provider is not None:
             try:

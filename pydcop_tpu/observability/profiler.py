@@ -109,8 +109,21 @@ class XlaCostProfiler:
                 return jax.ShapeDtypeStruct(x.shape, x.dtype)
             return x
 
-        compiled = fn.lower(
-            *jax.tree_util.tree_map(aval, args)).compile()
+        # The batched/lane dispatch paths wrap their jitted callable
+        # in functools.partial to bind static kwargs; partials have no
+        # ``.lower``, so unwrap and re-apply the bound arguments —
+        # without this the serving hot path (exactly where efficiency
+        # attainment matters most) never got a cost entry.
+        kwargs: Dict[str, Any] = {}
+        target = fn
+        if not hasattr(target, "lower"):
+            inner = getattr(fn, "func", None)
+            if inner is not None and hasattr(inner, "lower"):
+                args = tuple(getattr(fn, "args", ()) or ()) + args
+                kwargs = dict(getattr(fn, "keywords", {}) or {})
+                target = inner
+        compiled = target.lower(
+            *jax.tree_util.tree_map(aval, args), **kwargs).compile()
         cost = compiled.cost_analysis()
         # Per-device list on some versions, plain dict on others.
         if isinstance(cost, (list, tuple)):

@@ -17,7 +17,11 @@ endpoint over the process-wide observability state:
   snapshots pushed by whichever
   :class:`~pydcop_tpu.observability.metrics.CycleSnapshotter` the
   current run drives (the class-wide listener hook), with keepalive
-  comments while the solve is between chunks.
+  comments while the solve is between chunks;
+- ``GET /profile`` — the live device-efficiency rollup
+  (observability/efficiency.py): backend-honest attainment, request
+  time-ledger breakdown, waste by cause, top structures by device
+  time.
 
 Lifecycle is owned by
 :class:`~pydcop_tpu.observability.ObservabilitySession` (``api.solve
@@ -166,6 +170,17 @@ class _Handler(BaseHTTPRequestHandler):
             verdict = health_verdict()
             code = 503 if verdict.get("status") == "failing" else 200
             self._reply(code, json.dumps(verdict).encode(),
+                        "application/json")
+        elif path == "/profile":
+            # The live efficiency rollup (ISSUE 14): backend-honest
+            # attainment, the request-ledger where-the-time-went
+            # breakdown, waste by cause, top structures by device
+            # time.  ``pydcop profile report --url`` renders it.
+            from pydcop_tpu.observability.efficiency import tracker
+
+            self._reply(200,
+                        json.dumps(tracker.rollup(),
+                                   default=str).encode(),
                         "application/json")
         elif path == "/events":
             self._stream_events()

@@ -66,9 +66,10 @@ from typing import Any, Dict, List, NamedTuple, Optional
 from pydcop_tpu.dcop.dcop import DCOP
 from pydcop_tpu.engine import batch as engine_batch
 from pydcop_tpu.engine.compile import compile_dcop
-from pydcop_tpu.observability import flight
+from pydcop_tpu.observability import efficiency, flight
 from pydcop_tpu.observability.metrics import CycleSnapshotter
 from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.profiler import profiler
 from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.serving import binning, journal as journal_mod
 from pydcop_tpu.serving.admission import (
@@ -109,6 +110,14 @@ class SolveRequest:
     t_submit: float
     deadline_s: Optional[float] = None
     replayed: bool = False
+    # Time-ledger breakpoints (observability/efficiency.py): enqueue
+    # (submit-thread work ends), dispatch pickup, and the flush-plan
+    # wall this request waited through — contiguous with the device
+    # and decode intervals measured at dispatch, so the ledger's
+    # components sum to the measured end-to-end latency.
+    t_enqueue: float = 0.0
+    t_dispatch: float = 0.0
+    plan_s: float = 0.0
     # Request-scoped causality key: minted at submit, carried through
     # the journal record, queue entry, dispatch context and every
     # span/instant the request touches (docs/observability.md
@@ -304,9 +313,16 @@ class SolveService:
         # Activated like an ObservabilitySession: request-plane detail
         # counters should record while the service runs; the prior
         # state is restored on stop so an embedding process (tests,
-        # bench) is left the way it was found.
+        # bench) is left the way it was found.  The XLA cost profiler
+        # rides along (one throwaway AOT compile per cache key):
+        # without its flops/bytes entries the efficiency plane can
+        # report time ledgers but never attainment — and efficiency
+        # must be an always-scrapeable signal, not a bench-only one.
+        # ``PYDCOP_XLA_PROFILE=0`` still vetoes.
         self._was_active = metrics_registry.active
         metrics_registry.active = True
+        self._was_profiling = profiler.enabled
+        profiler.enabled = True
         pending = []
         pending_sessions = []
         if self.journal_dir and self._journal is None:
@@ -370,6 +386,7 @@ class SolveService:
         self._scheduler = None
         self._started = False
         metrics_registry.active = self._was_active
+        profiler.enabled = getattr(self, "_was_profiling", False)
         # Anything still queued (drain=False, drain timeout, or a
         # submit that raced the shutdown): journaled services leave it
         # REPLAYABLE — the accepted record survives, a --recover
@@ -580,6 +597,7 @@ class SolveService:
         # of this thread's next line, and SSE clients are promised
         # accepted → dispatched → finished in order.
         self._publish_lifecycle("accepted", req)
+        req.t_enqueue = time.perf_counter()
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -653,6 +671,7 @@ class SolveService:
                     # replayed requests too.  Before the put, like
                     # submit() — the scheduler may dispatch first.
                     self._publish_lifecycle("accepted", req)
+                    req.t_enqueue = time.perf_counter()
                     self._queue.put(req, timeout=30.0)
                 except Exception as exc:  # noqa: BLE001 — one bad
                     # record must not abort the rest of the replay.
@@ -767,7 +786,23 @@ class SolveService:
         packed only when the modeled dispatch-overhead saving beats
         the padding waste — and losing groups fall back to solo
         dispatches, so a pathological group can never be slower than
-        the old behavior by more than the model's error."""
+        the old behavior by more than the model's error.
+
+        The planning wall is stamped on every request in the flush
+        (``plan_s``) — each of them waited through it, so it is a real
+        component of each one's latency ledger (the ``plan`` column of
+        where-the-time-went)."""
+        t_plan = time.perf_counter()
+        try:
+            return self._plan_flush(bins)
+        finally:
+            plan_s = time.perf_counter() - t_plan
+            for reqs in bins.values():
+                for req in reqs:
+                    req.plan_s = plan_s
+
+    def _plan_flush(self, bins: Dict[Any, List[SolveRequest]]
+                    ) -> List[DispatchPlan]:
         plans: List[DispatchPlan] = []
         singles: List[SolveRequest] = []
         for key in sorted(bins, key=lambda k: -len(bins[k])):
@@ -908,6 +943,7 @@ class SolveService:
         t_dequeue = time.perf_counter()
         for req in reqs:
             req.status = RUNNING
+            req.t_dispatch = t_dequeue
             if tracer.active:
                 # The queue wait started on the submitting thread and
                 # ended here on the scheduler thread: record it
@@ -951,6 +987,7 @@ class SolveService:
                      "envelope" if envelope is not None else
                      "structure"),
             retry_depth=retry_depth) if tracer.active else None)
+        t_dev0 = time.perf_counter()
         try:
             with (span if span is not None
                   else contextlib.nullcontext()):
@@ -1023,7 +1060,7 @@ class SolveService:
         pad_lanes = metrics["batch_size"] - metrics["n_real"]
         if pad_lanes:
             self._pad_waste.inc(pad_lanes)
-        t_done = time.perf_counter()
+        t_dev1 = time.perf_counter()
         converged_lanes = metrics.get("converged_lanes") or []
         for i, req in enumerate(reqs):
             # Per-request decode guard: one cost function that raises
@@ -1039,6 +1076,13 @@ class SolveService:
                                req.id, exc)
                 self._finish_error(req, f"result decode failed: {exc}")
                 continue
+            # Per-request finish clock AFTER the decode: this
+            # request's latency honestly includes its own host
+            # post-processing (and its wait behind batch-mates
+            # decoded before it — the ledger's ``decode`` column).
+            t_done = time.perf_counter()
+            ledger = self._request_ledger(
+                req, batch_result, t_dev0, t_dev1, t_done)
             req.result = {
                 "id": req.id,
                 "trace_id": req.trace_id,
@@ -1055,6 +1099,7 @@ class SolveService:
                     "queued_s": (t_done - req.t_submit
                                  - batch_result.time_s),
                 },
+                "ledger": ledger,
                 "batch": {
                     "size": metrics["batch_size"],
                     "n_real": metrics["n_real"],
@@ -1071,6 +1116,10 @@ class SolveService:
             req.status = FINISHED
             self.completed += 1
             self._req_total.inc(status="ok")
+            efficiency.tracker.record_ledger(
+                ledger,
+                backend=(metrics.get("efficiency") or {}).get(
+                    "backend"))
             # The exemplar makes the latency histogram navigable: the
             # bucket this observation lands in remembers this
             # trace_id, so a p99 spike in /metrics is one `pydcop
@@ -1080,6 +1129,43 @@ class SolveService:
             self._journal_done(req)
             req.done.set()
             self._publish_lifecycle("finished", req)
+
+    def _request_ledger(self, req: SolveRequest, batch_result,
+                        t_dev0: float, t_dev1: float,
+                        t_done: float) -> Dict[str, Any]:
+        """One request's time ledger from its contiguous breakpoints:
+        submit (admission+compile+journal on the submitting thread),
+        queue (bounded queue + coalescing window), plan (flush
+        planning), prep (scheduler bookkeeping + host-side batch
+        assembly), compile/execute (the device wall, split by the
+        overlapping-fields convention), decode (device end → this
+        request finished, its own host post-processing included).
+        The intervals tile [t_submit, t_done], so the components sum
+        to the measured total — the invariant the battery asserts.
+        Bisection-retry walls land in ``prep`` (everything between
+        dispatch pickup and the SUCCESSFUL device call)."""
+        # The inner device wall when the dispatch reported one (the
+        # outer time_s additionally holds the profiler's cold-capture
+        # and batch-assembly host work — that belongs in ``prep``).
+        run_s = float(batch_result.metrics.get(
+            "run_time_s", batch_result.time_s))
+        compile_s = float(batch_result.compile_time_s)
+        split = efficiency.split_device_time(run_s, compile_s)
+        t_enq = req.t_enqueue or req.t_submit
+        t_disp = req.t_dispatch or t_dev0
+        plan_s = min(max(req.plan_s, 0.0), max(t_disp - t_enq, 0.0))
+        prep = (max(t_dev0 - t_disp, 0.0)
+                + max((t_dev1 - t_dev0) - run_s, 0.0))
+        return efficiency.make_ledger(
+            t_done - req.t_submit,
+            submit=t_enq - req.t_submit,
+            queue=max(t_disp - t_enq - plan_s, 0.0),
+            plan=plan_s,
+            prep=prep,
+            compile=split["compile"],
+            execute=split["execute"],
+            decode=max(t_done - t_dev1, 0.0),
+        )
 
     def run_session_work(self, work) -> None:
         """Scheduler hook: one stateful-session work item (event
@@ -1133,6 +1219,7 @@ class SolveService:
             "latency": {
                 "total_s": time.perf_counter() - req.t_submit,
             },
+            "ledger": self._terminal_ledger(req),
         }
         req.status = ERROR
         self.failed += 1
@@ -1154,6 +1241,7 @@ class SolveService:
             "latency": {
                 "total_s": time.perf_counter() - req.t_submit,
             },
+            "ledger": self._terminal_ledger(req),
         }
         req.status = EXPIRED
         self.expired += 1
@@ -1161,6 +1249,22 @@ class SolveService:
         self._journal_done(req)
         req.done.set()
         self._publish_lifecycle("expired", req)
+
+    def _terminal_ledger(self, req: SolveRequest) -> Dict[str, Any]:
+        """Ledger for a request that terminated without a decoded
+        result (error/expired), still summing to the measured total.
+        Time after dispatch pickup — failed device attempts, decode
+        failures — is ``prep``, not queue: an operator chasing a
+        queue-wait spike must not be sent device-side seconds."""
+        now = time.perf_counter()
+        t_enq = req.t_enqueue or req.t_submit
+        t_disp = req.t_dispatch or now
+        return efficiency.make_ledger(
+            now - req.t_submit,
+            submit=t_enq - req.t_submit,
+            queue=max(min(t_disp, now) - t_enq, 0.0),
+            prep=max(now - t_disp, 0.0) if req.t_dispatch else 0.0,
+        )
 
     def _publish_lifecycle(self, phase: str, req: SolveRequest):
         """One request-lifecycle event onto the SSE ``/events``
@@ -1270,6 +1374,12 @@ class SolveService:
                 q: self._latency.quantile_exemplar(v)
                 for q, v in (("p50", 0.50), ("p99", 0.99))
             },
+            # The efficiency plane's compact face (ISSUE 14): resolved
+            # backend, attainment/useful-work rollup and the ledger's
+            # where-the-time-went component sums.  The full document
+            # (per-structure top-N, waste taxonomy) lives on
+            # ``GET /profile``.
+            "efficiency": efficiency.tracker.summary(),
         }
 
     def health_summary(self) -> Dict[str, Any]:

@@ -181,17 +181,38 @@ def apply_event_batch(engine, events: Optional[List[Dict[str, Any]]]
     the live session had, INCLUDING partially-applied failed batches
     (divergent hand-rolled copies here were how live-tolerant /
     replay-fatal drift crept in).  Returns ``(applied_action_types,
-    touched_variable_names, error_or_None)``."""
+    touched_variable_names, error_or_None)``.
+
+    The whole batch applies under the engine's deferred-edit session
+    (``DynamicMaxSumEngine.batch_edits``): per-bucket edits accumulate
+    host-side and materialize as ONE copy per touched bucket per
+    batch instead of one per action — behavior-identical (the flush
+    runs even on the early error return, so earlier actions stand
+    exactly as before), just without the per-action full-bucket
+    copies the PR-13 note flagged."""
     applied: List[str] = []
     touched: List[str] = []
-    for action in events or []:
-        args = {k: v for k, v in action.items() if k != "type"}
-        try:
-            info = apply_action(engine, action["type"], args)
-        except Exception as exc:  # noqa: BLE001 — batch-scoped
-            return applied, touched, f"event apply failed: {exc}"
-        touched.extend(info["touched"])
-        applied.append(action["type"])
+    ctx = (engine.batch_edits()
+           if hasattr(engine, "batch_edits")
+           else contextlib.nullcontext())
+    try:
+        with ctx:
+            for action in events or []:
+                args = {k: v for k, v in action.items()
+                        if k != "type"}
+                try:
+                    info = apply_action(engine, action["type"], args)
+                except Exception as exc:  # noqa: BLE001 — batch-
+                    # scoped error: earlier actions stand.
+                    return (applied, touched,
+                            f"event apply failed: {exc}")
+                touched.extend(info["touched"])
+                applied.append(action["type"])
+    except Exception as exc:  # noqa: BLE001 — a flush failure at
+        # batch exit keeps the tuple contract too: the caller (live
+        # work AND --recover replay) must get a batch error, never an
+        # exception that aborts the whole session's replay.
+        return applied, touched, f"event apply failed: {exc}"
     return applied, touched, None
 
 
@@ -359,6 +380,10 @@ class SessionManager:
         from pydcop_tpu.dcop.yamldcop import dcop_yaml
 
         engine = build_dynamic_engine(dcop, merged)
+        # This engine's dispatches are session work: the efficiency
+        # rollup's request class must say so (a scenario replay or
+        # direct dynamic-engine use stays "dynamic").
+        engine.efficiency_class = "session"
         yaml_src = dcop_yaml(dcop)
         sess = SolveSession(
             id=session_id or f"s{uuid.uuid4().hex[:12]}",
@@ -733,12 +758,14 @@ class SessionManager:
         # cycles — the budget is enforced host-side instead, and may
         # overshoot by less than one segment.
         seg = sess.params["segment_cycles"]
+        t_seg = time.perf_counter()
         span = (tracer.span("session_segment", "serving",
                             session=sess.id, cycles=seg)
                 if tracer.active else None)
         with (span if span is not None else contextlib.nullcontext()):
             res = sess.engine.run(max_cycles=seg)
             cost = sess.engine.cost(res.assignment)
+        t_seg_end = time.perf_counter()
         ran = max(res.cycles - sess.last_cycle, 0)
         sess.last_cycle = res.cycles
         sess.budget = max(sess.budget - max(ran, seg), 0)
@@ -748,6 +775,22 @@ class SessionManager:
                 and sess.params["decimation_margin"] is not None):
             sess.engine.decimate(
                 margin=sess.params["decimation_margin"])
+        # Segment time ledger (the session face of the request
+        # ledger): device compile/execute from the engine's
+        # overlapping-fields split, everything else in the segment
+        # wall — assignment decode + host cost evaluation — is
+        # ``decode``.  Components sum to the measured segment wall.
+        from pydcop_tpu.observability import efficiency
+
+        split = efficiency.split_device_time(
+            res.time_s, res.compile_time_s)
+        ledger = efficiency.make_ledger(
+            t_seg_end - t_seg,
+            compile=split["compile"],
+            execute=split["execute"],
+            decode=max((t_seg_end - t_seg) - res.time_s, 0.0),
+        )
+        efficiency.tracker.record_ledger(ledger, kind="session")
         payload = {
             "cycle": res.cycles,
             "cost": cost,
@@ -755,6 +798,7 @@ class SessionManager:
             "assignment": res.assignment,
             "recompiles": sess.recompiles,
             "clamped": len(sess.engine.clamps),
+            "ledger": ledger,
         }
         if batch_seq is not None:
             payload["batch_seq"] = batch_seq
@@ -940,6 +984,7 @@ class SessionManager:
         params = normalize_session_params(
             open_rec.get("params") or {})
         engine = build_dynamic_engine(dcop, params)
+        engine.efficiency_class = "session"
         sess = SolveSession(
             id=open_rec["id"],
             trace_id=(open_rec.get("trace_id")

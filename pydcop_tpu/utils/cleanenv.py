@@ -30,6 +30,16 @@ SAVED_AXON_ENV = "PYDCOP_SAVED_AXON"
 # can be given more rope without editing two call sites.
 PROBE_TIMEOUT_ENV = "PYDCOP_BENCH_PROBE_TIMEOUT"
 
+# On-disk accelerator-probe history (the committed
+# BENCH_TPU_PROBELOG.jsonl format: one record_diag-shaped JSON object
+# per line — {"unix": ..., "event": ..., ...}).  The in-env DIAG log
+# only covers THIS process tree; the probelog is the cross-run
+# history tools/onchip_autopilot.py appends, which is what a
+# postmortem needs to say what backend the anomalous run actually
+# executed on.  PYDCOP_PROBELOG points elsewhere.
+PROBELOG_ENV = "PYDCOP_PROBELOG"
+PROBELOG_DEFAULT = "BENCH_TPU_PROBELOG.jsonl"
+
 
 def default_probe_timeout(default=120.0):
     """The probe timeout in seconds: ``PYDCOP_BENCH_PROBE_TIMEOUT``
@@ -119,6 +129,39 @@ def record_diag(kind, **details):
     os.environ[DIAG_ENV] = json.dumps(events)
     _observe_probe_event(kind, details)
     return events
+
+
+def probelog_path():
+    """The accelerator-probe history file: ``PYDCOP_PROBELOG`` when
+    set, else ``BENCH_TPU_PROBELOG.jsonl`` in the current directory
+    (where serve/bench processes run from the repo root).  Returns
+    the path whether or not it exists."""
+    return os.environ.get(PROBELOG_ENV, PROBELOG_DEFAULT)
+
+
+def probelog_tail(n=20, path=None):
+    """The last ``n`` rows of the on-disk probe history (the
+    ``BENCH_TPU_PROBELOG.jsonl`` / ``record_diag`` event shape).
+    Unparsable lines are skipped, a missing file is an empty list —
+    this feeds postmortem bundles, which must never gain a second
+    failure from their own evidence gathering."""
+    path = path or probelog_path()
+    rows = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict):
+                    rows.append(row)
+    except OSError:
+        return []
+    return rows[-max(int(n), 0):]
 
 
 def probe_backend(timeout=120, env=None):

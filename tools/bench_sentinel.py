@@ -144,6 +144,17 @@ def load_history(root: str) -> List[Dict[str, Any]]:
             # 9): when the newest run regresses, the report points at
             # a concrete request trace instead of a bare number.
             "exemplar": parsed.get("exemplar_trace_id"),
+            # Per-leg RESOLVED backends (ISSUE 11 crumb, consumed
+            # since ISSUE 14): a run whose headline ran on TPU can
+            # still have individual legs fall back to CPU — each
+            # leg's value must be judged against ITS backend's
+            # baseline, never the headline's.  Absent before PR 11.
+            "leg_backends": {
+                leg: info.get("backend")
+                for leg, info in (
+                    parsed.get("leg_backends") or {}).items()
+                if isinstance(info, dict)
+            },
         })
     last_path = os.path.join(root, "BENCH_TPU_LAST.json")
     have_tpu_round = any(r.get("backend") == "tpu" for r in runs)
@@ -248,45 +259,86 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
     # above the ceiling).  Backends never share a baseline in any
     # family.
     metrics = (
-        ("bench", "value", "cycles/s", "backend", True),
-        ("serve", "serve_value", "problems/s", "backend", True),
+        # (family, value field, unit, fallback backend key, higher is
+        # better, bench.py leg name in ``leg_backends``)
+        ("bench", "value", "cycles/s", "backend", True, "headline"),
+        ("serve", "serve_value", "problems/s", "backend", True,
+         "serve"),
         # ISSUE 11: throughput on zipf-diverse structures through the
         # envelope batching tier — the traffic shape on which pure
         # structure binning degenerates to batch-size-1.
         ("serve_mixed", "serve_mixed_value", "problems/s",
-         "backend", True),
+         "backend", True, "serve_mixed"),
         ("sharded", "sharded_value", "cycles/s",
-         "sharded_backend", True),
+         "sharded_backend", True, "sharded"),
         # ISSUE 10: wall-clock to the reference cost on the
         # large-domain loopy graph (bench_time_to_cost) — the
         # work-reduction stack's headline, LOWER is better.
-        ("time_to_cost", "ttc_value", "ms", "backend", False),
+        ("time_to_cost", "ttc_value", "ms", "backend", False,
+         "time_to_cost"),
         ("serve_recovery", "serve_recovery_value", "s",
-         "backend", False),
+         "backend", False, "serve_recovery"),
         ("shard_recovery", "shard_recovery_value", "s",
-         "sharded_backend", False),
+         "sharded_backend", False, "sharded"),
         # ISSUE 13: the stateful-session families — sustained
         # scenario-event throughput per session (higher is better)
         # and warm time-to-recovered-cost after an event (the
         # session plane's reason to exist: it must stay far below a
         # cold re-solve; lower is better).
         ("session_events", "session_eps_value", "events/s",
-         "backend", True),
+         "backend", True, "sessions"),
         ("session_recovery", "session_ttr_value", "ms",
-         "backend", False),
+         "backend", False, "sessions"),
     )
     series = {}
     lines = []
     failed = False
-    for family, field, unit, backend_key, higher_better in metrics:
+    for (family, field, unit, backend_key, higher_better,
+         leg) in metrics:
         fmt = ".0f" if higher_better else ".3f"
+
+        def leg_backend(r):
+            # The leg's RESOLVED backend when the round recorded one
+            # (``leg_backends``, PR 11+); older rounds fall back to
+            # their per-run backend field — identical to the pre-leg
+            # behavior, so legacy histories judge unchanged.
+            return ((r.get("leg_backends") or {}).get(leg)
+                    or r.get(backend_key) or r.get("backend")
+                    or "cpu")
+
+        rows_f = [r for r in runs
+                  if "skipped" not in r and r.get(field) is not None]
         by_backend: Dict[str, List[Dict[str, Any]]] = {}
-        for r in runs:
-            if "skipped" in r or r.get(field) is None:
-                continue
-            by_backend.setdefault(
-                r.get(backend_key) or r.get("backend") or "cpu",
-                []).append(r)
+        for r in rows_f:
+            by_backend.setdefault(leg_backend(r), []).append(r)
+        # Cross-backend refusal (ISSUE 14): the newest run's leg is
+        # judged ONLY against history rows whose recorded leg backend
+        # matches its own resolved backend — a CPU-fallback round
+        # must neither regress nor pad a TPU baseline.  Rows with an
+        # explicit mismatching leg record are named as SKIPPED so the
+        # exclusion is visible, not silent.  "Newest" means the
+        # newest NUMBERED round: load_history appends the stale
+        # BENCH_TPU_LAST reference row last, and a reference artifact
+        # with no position in the chronology must not define which
+        # backend the latest round "resolved".
+        numbered_rows = [
+            r for r in rows_f
+            if re.fullmatch(r"BENCH_r\d+\.json", r.get("source", ""))
+        ]
+        newest_row = (numbered_rows[-1] if numbered_rows
+                      else rows_f[-1] if rows_f else None)
+        newest_backend = (leg_backend(newest_row)
+                          if newest_row is not None else None)
+        skipped_rows = [
+            (r["source"], leg_backend(r)) for r in rows_f
+            if (r.get("leg_backends") or {}).get(leg)
+            and leg_backend(r) != newest_backend
+        ]
+        for source, row_backend in skipped_rows:
+            lines.append(
+                f"{family}[{newest_backend}] SKIPPED {source} "
+                f"(leg ran on {row_backend}, newest resolved "
+                f"{newest_backend})")
         for backend in sorted(by_backend):
             rows = by_backend[backend]
             values = [r[field] for r in rows]
@@ -310,13 +362,23 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
             verdict = ("REGRESSED" if result["verdict"] == "regressed"
                        else "OK")
             bound_name = "floor" if higher_better else "ceiling"
+            # Only the backend the newest round actually resolved
+            # GATES: a stale series (e.g. an old TPU baseline while
+            # the newest round fell back to CPU) still reports, but
+            # its newest member is an old round that was judged in
+            # its own day — failing CI on it would block a round the
+            # report itself says was not compared against it.
+            stale = (newest_backend is not None
+                     and backend != newest_backend)
+            result["gating"] = not stale
             lines.append(
                 f"{family}[{backend}] {spark} "
                 f"{values[0]:{fmt}}→{values[-1]:{fmt}} {unit}, newest "
                 f"{direction} vs median {result['median']:{fmt}} "
                 f"({bound_name} {result['bound']:{fmt}}) {verdict}"
+                + (" (stale backend — not gating)" if stale else "")
             )
-            if result["verdict"] == "regressed":
+            if result["verdict"] == "regressed" and not stale:
                 failed = True
                 # The exemplar is the SERVING leg's p99 latency
                 # trace_id — only the serve-latency family may point

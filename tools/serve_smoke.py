@@ -38,6 +38,12 @@ Starts the real service on port 0 and drives it over HTTP:
    ``engine_segment`` spans all tagged with that request's trace_id —
    and the p99 bucket of ``pydcop_request_latency_seconds`` must
    expose an exemplar trace_id resolvable by the same query.
+7. **Efficiency accounting** (ISSUE 14 acceptance): on a real serve
+   burst every served request carries a time ledger whose components
+   sum to its measured total latency within 5%, and the
+   ``useful_work_fraction`` + attainment rollups are visible in
+   ``/stats``, ``/metrics`` (backend-labeled), ``/profile`` and
+   ``pydcop profile report --url`` (the real CLI).
 
 Run:  python tools/serve_smoke.py      (exit 0 = all claims hold)
 """
@@ -259,6 +265,133 @@ def leg_mixed_envelope():
         check(True,
               f"all {len(dcops)} mixed-burst answers bit-identical "
               "to solo api.solve")
+    finally:
+        handle.stop()
+
+
+def leg_efficiency():
+    """ISSUE 14 acceptance: on a real serve burst, every served
+    request carries a time ledger whose components sum to its
+    measured total latency within 5%, and the
+    ``useful_work_fraction`` + attainment rollups are visible on all
+    four surfaces — ``/stats``, ``/metrics`` (backend-labeled),
+    ``/profile`` and ``pydcop profile report --url`` (the real CLI
+    entry point)."""
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.observability.efficiency import (
+        ledger_component_sum,
+    )
+
+    handle = api.serve(port=0, batch_window_s=0.2, max_batch=16,
+                       max_queue=64)
+    try:
+        url = handle.url
+        payloads = [dcop_yaml(build_instance(7, 70 + s))
+                    for s in range(4)]
+        payloads.append(dcop_yaml(build_instance(11, 90)))
+
+        def burst():
+            results = [None] * len(payloads)
+
+            def client(i):
+                results[i] = post(url, {
+                    "dcop": payloads[i], "wait": True,
+                    "timeout": 120,
+                    "params": {"max_cycles": MAX_CYCLES},
+                })
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(payloads))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            return results
+
+        burst()            # cold round: compiles + cost captures
+        results = burst()  # warm round: the attainment evidence
+        check(all(r is not None and r[0] == 200
+                  and r[1]["status"] == "FINISHED" for r in results),
+              f"all {len(payloads)} efficiency-burst responses "
+              "finished")
+
+        # 1. Every served request carries a summing time ledger.
+        for _, res in results:
+            ledger = res.get("ledger")
+            check(isinstance(ledger, dict) and "total_s" in ledger,
+                  f"response {res['id']} carries a time ledger")
+            total = ledger["total_s"]
+            gap = abs(ledger_component_sum(ledger) - total)
+            check(total > 0 and gap <= 0.05 * total,
+                  f"{res['id']} ledger components sum to the "
+                  f"measured total within 5% (gap {gap * 1e3:.3f}ms "
+                  f"of {total * 1e3:.1f}ms)")
+
+        # 2. /stats carries the efficiency block with a real number.
+        with urllib.request.urlopen(url + "/stats",
+                                    timeout=30) as resp:
+            stats = json.loads(resp.read())
+        eff = stats.get("efficiency") or {}
+        check(eff.get("backend") == "cpu",
+              f"/stats efficiency block names the resolved backend "
+              f"({eff.get('backend')})")
+        check(eff.get("useful_work_fraction") is not None
+              and 0 < eff["useful_work_fraction"] <= 1.0
+              and eff.get("attainment") is not None,
+              "/stats useful_work_fraction "
+              f"({eff.get('useful_work_fraction')}) and attainment "
+              f"({eff.get('attainment')}) populated after the warm "
+              "round")
+        check(eff.get("ledger_components_s", {}).get("execute", 0)
+              > 0,
+              "/stats ledger breakdown has device execute seconds")
+
+        # 3. /metrics: backend-labeled gauges in the exposition.
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=30) as resp:
+            text = resp.read().decode()
+        check(re.search(
+            r'pydcop_useful_work_fraction\{backend="cpu"\} \S+',
+            text) is not None,
+            "backend-labeled pydcop_useful_work_fraction exported "
+            "on /metrics")
+        check(re.search(
+            r'pydcop_device_execute_seconds_total\{backend="cpu"',
+            text) is not None,
+            "backend-labeled device-execute seconds exported on "
+            "/metrics")
+
+        # 4. /profile serves the live rollup.
+        with urllib.request.urlopen(url + "/profile",
+                                    timeout=30) as resp:
+            profile = json.loads(resp.read())
+        check(profile.get("backend", {}).get("backend") == "cpu"
+              and profile.get("structures")
+              and profile.get("waste_by_cause") is not None,
+              "/profile serves the rollup (backend + structures + "
+              "waste taxonomy)")
+        cpu = profile.get("backends", {}).get("cpu") or {}
+        check(cpu.get("useful_work_fraction") is not None,
+              "/profile per-backend useful_work_fraction "
+              f"({cpu.get('useful_work_fraction')})")
+
+        # 5. The REAL CLI: pydcop profile report --url --json.
+        proc = subprocess.run(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "profile",
+             "report", "--url", url, "--json"],
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO)
+        check(proc.returncode == 0,
+              f"pydcop profile report --url exits 0 "
+              f"({proc.stderr.strip()[:200]})")
+        doc = json.loads(proc.stdout)
+        live = doc.get("live") or {}
+        check(live.get("ledger", {}).get("components_s")
+              and live.get("backends", {}).get("cpu", {})
+              .get("useful_work_fraction") is not None,
+              "profile report --json carries the ledger breakdown "
+              "and the cpu useful_work_fraction")
     finally:
         handle.stop()
 
@@ -759,6 +892,7 @@ def main() -> int:
     leg_request_tracing()
     leg_coalescing()
     leg_mixed_envelope()
+    leg_efficiency()
     leg_overload()
     leg_kill9_replay()
     leg_session_replay()
