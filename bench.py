@@ -1152,6 +1152,294 @@ def bench_serving_mixed():
     return out
 
 
+# Fleet-serving leg (ISSUE 15): aggregate problems/sec through the
+# replicated serve plane — REAL worker subprocesses behind the
+# structure-affinity router — at replicas=1/2/4 on the same seeded
+# mixed-structure stream, plus the affinity-vs-round-robin A/B at
+# replicas=2.  replicas=1 also runs THROUGH the router so every leg
+# pays the same wire overhead and the speedup isolates replication.
+FLEET_STRUCTS = (20, 24, 28, 32)
+FLEET_POOL_PER_STRUCT = 4
+FLEET_MAX_CYCLES = 60
+FLEET_DURATION_S = 4.0
+FLEET_WARM_S = 3.0
+FLEET_REPLICA_COUNTS = (1, 2, 4)
+# One FIXED closed-loop client pool across every replica count — the
+# acceptance's "same stream": with clients scaled per replica the r1
+# leg is latency-bound (clients/latency), not capacity-bound, and the
+# speedup would measure the client pool, not the fleet.
+FLEET_CLIENTS = 12
+
+
+def _fleet_post(url, payload, timeout=60):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + "/solve", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def bench_serving_fleet():
+    """Closed-loop clients against a real fleet.  Emits
+    ``fleet_problems_per_sec_r<N>`` per replica count (the sentinel
+    family ``serving_fleet`` judges the r2 value),
+    ``fleet_speedup_r2`` (r2/r1 on the same stream),
+    ``fleet_affinity_hit_fraction`` and the round-robin A/B
+    (``fleet_rr_problems_per_sec`` / ``fleet_affinity_gain``) —
+    affinity must BEAT round-robin for the routing complexity to pay
+    its way.  None-valued on failure — never kills the headline."""
+    import threading
+
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving.router import FleetRouter, RouterFrontEnd
+
+    pool = {
+        n: [dcop_yaml(build_dcop_small(n, seed))
+            for seed in range(FLEET_POOL_PER_STRUCT)]
+        for n in FLEET_STRUCTS
+    }
+    params = {"max_cycles": FLEET_MAX_CYCLES}
+    worker_args = ["--batch_window", "0.005", "--max_batch", "16",
+                   "--max_queue", "512",
+                   "--cycles", str(FLEET_MAX_CYCLES)]
+
+    def run_leg(replicas: int, affinity: str):
+        router = FleetRouter(replicas=replicas,
+                             worker_args=worker_args,
+                             affinity=affinity).start()
+        front = RouterFrontEnd(router, port=0).start()
+        url = front.url
+        try:
+            completed = [0]
+            latencies = []
+            lock = threading.Lock()
+            state = {"t_end": 0.0}
+
+            def client(idx, record):
+                rng = np.random.default_rng(7000 + idx)
+                i = 0
+                while time.perf_counter() < state["t_end"]:
+                    n = FLEET_STRUCTS[int(rng.integers(
+                        len(FLEET_STRUCTS)))]
+                    payload = pool[n][i % FLEET_POOL_PER_STRUCT]
+                    i += 1
+                    t0 = time.perf_counter()
+                    status, body = _fleet_post(url, {
+                        "dcop": payload, "wait": True,
+                        "timeout": 60, "params": params})
+                    t1 = time.perf_counter()
+                    if record and status == 200 \
+                            and body.get("status") == "FINISHED":
+                        with lock:
+                            latencies.append(t1 - t0)
+                            completed[0] += 1
+
+            def drive(duration, record):
+                state["t_end"] = time.perf_counter() + duration
+                threads = [
+                    threading.Thread(target=client,
+                                     args=(i, record))
+                    for i in range(FLEET_CLIENTS)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=duration + 120)
+
+            drive(FLEET_WARM_S, record=False)   # compile warm-up
+            t_start = time.perf_counter()
+            drive(FLEET_DURATION_S, record=True)
+            elapsed = time.perf_counter() - t_start
+            stats = router.stats()
+        finally:
+            front.stop()
+            router.stop(drain=False)
+        if not completed[0] or elapsed <= 0:
+            return None
+        lat_ms = np.asarray(latencies) * 1e3
+        return {
+            "pps": round(completed[0] / elapsed, 2),
+            "p50": round(float(np.percentile(lat_ms, 50)), 2),
+            "p99": round(float(np.percentile(lat_ms, 99)), 2),
+            "requests": completed[0],
+            "affinity_hit_fraction": stats["affinity_hit_fraction"],
+        }
+
+    out = {}
+    by_replicas = {}
+    for replicas in FLEET_REPLICA_COUNTS:
+        leg = run_leg(replicas, "structure")
+        by_replicas[replicas] = leg
+        if leg is None:
+            out[f"fleet_problems_per_sec_r{replicas}"] = None
+            continue
+        out[f"fleet_problems_per_sec_r{replicas}"] = leg["pps"]
+        if replicas == 2:
+            out["fleet_p50_ms"] = leg["p50"]
+            out["fleet_p99_ms"] = leg["p99"]
+            out["fleet_requests"] = leg["requests"]
+            out["fleet_affinity_hit_fraction"] = \
+                leg["affinity_hit_fraction"]
+    r1, r2 = by_replicas.get(1), by_replicas.get(2)
+    if r1 and r2:
+        out["fleet_speedup_r2"] = round(r2["pps"] / r1["pps"], 3)
+    rr = run_leg(2, "round_robin")
+    if rr and r2:
+        out["fleet_rr_problems_per_sec"] = rr["pps"]
+        out["fleet_affinity_gain"] = round(r2["pps"] / rr["pps"], 3)
+    return out
+
+
+# Cold-start leg (ISSUE 15): time-to-first-result of a FRESH serve
+# worker on a known structure, empty disk cache vs warm.  The warm
+# process must serve its first same-structure request with the jit
+# compile collapsed to the cache-retrieval wall (``compile`` ≈ 0 in
+# its PR-14 request ledger) — the fleet's replicas and restarts live
+# or die on this.  Workers run with PYDCOP_XLA_PROFILE=0 so the
+# profiler's untimed throwaway AOT compile cannot seed the disk cache
+# mid-dispatch and blur the A/B.  The instance is deliberately the
+# COMPILE-HEAVIEST serving shape we have — domain 8, mixed
+# binary/ternary buckets, branch-and-bound pruning enabled (the
+# pruned program roughly triples XLA's work on this family) — because
+# the leg exists to measure compile avoidance, not solve speed.
+COLD_START_N_VARS = 48
+COLD_START_TERNARY = 8
+COLD_START_DOMAIN = 8
+COLD_START_MAX_CYCLES = 200
+
+
+def build_cold_start_dcop(seed: int = 3):
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(seed)
+    d = COLD_START_DOMAIN
+    dom = Domain("c", "", list(range(d)))
+    dcop = DCOP("coldstart", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(COLD_START_N_VARS)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(COLD_START_N_VARS):
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[k], vs[(k + 1) % COLD_START_N_VARS]],
+            rng.integers(0, 10, size=(d, d)).astype(float), f"c{k}"))
+    for k in range(COLD_START_TERNARY):
+        i, j, l = rng.choice(COLD_START_N_VARS, size=3,
+                             replace=False)
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[j], vs[l]],
+            rng.integers(0, 10, size=(d, d, d)).astype(float),
+            f"t{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def bench_serve_cold_start():
+    """Two fresh serve subprocesses against one cache directory:
+    round 1 compiles (and populates the cache), round 2 must
+    deserialize.  Emits ``serve_cold_start_warm_s`` (warm
+    time-to-first-result — the ``serve_cold_start`` sentinel family,
+    LOWER is better), the cold baseline, and both request ledgers'
+    ``compile`` components.  None-valued on failure."""
+    import shutil
+    import signal as signal_mod
+    import subprocess as sp
+    import tempfile
+    import urllib.request
+
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_aot_")
+    run_dir = tempfile.mkdtemp(prefix="bench_cold_")
+    payload = dcop_yaml(build_cold_start_dcop())
+    request_params = {"max_cycles": COLD_START_MAX_CYCLES,
+                      "prune": 1}
+
+    def one_round(tag):
+        port_file = os.path.join(run_dir, f"{tag}.port")
+        env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+            "JAX_PLATFORMS", "cpu"), PYDCOP_XLA_PROFILE="0")
+        log = open(os.path.join(run_dir, f"{tag}.log"), "wb")
+        proc = sp.Popen(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "serve",
+             "--port", "0", "--port_file", port_file,
+             "--compile_cache_dir", cache_dir,
+             "--batch_window", "0.005",
+             "--cycles", str(COLD_START_MAX_CYCLES)],
+            env=env, stdout=log, stderr=log)
+        log.close()
+        try:
+            deadline = time.monotonic() + 120
+            port = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"cold-start worker died (exit "
+                        f"{proc.returncode})")
+                try:
+                    with open(port_file, encoding="utf-8") as f:
+                        port = int(f.read().strip())
+                    break
+                except (OSError, ValueError):
+                    time.sleep(0.05)
+            if port is None:
+                raise RuntimeError("cold-start worker never listened")
+            url = f"http://127.0.0.1:{port}"
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(url + "/healthz",
+                                                timeout=2):
+                        break
+                except OSError:
+                    time.sleep(0.05)
+            t0 = time.perf_counter()
+            status, body = _fleet_post(url, {
+                "dcop": payload, "wait": True, "timeout": 120,
+                "params": request_params}, timeout=150)
+            ttfr = time.perf_counter() - t0
+            if status != 200 or body.get("status") != "FINISHED":
+                raise RuntimeError(
+                    f"cold-start request failed ({status})")
+            ledger = body.get("ledger") or {}
+            return {
+                "ttfr_s": round(ttfr, 4),
+                "compile_s": round(
+                    float(ledger.get("compile_s", 0.0)), 4),
+                "execute_s": round(
+                    float(ledger.get("execute_s", 0.0)), 4),
+            }
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal_mod.SIGTERM)
+                try:
+                    proc.wait(timeout=60)
+                except sp.TimeoutExpired:
+                    proc.kill()
+
+    try:
+        cold = one_round("cold")
+        warm = one_round("warm")
+        return {
+            "serve_cold_start_warm_s": warm["ttfr_s"],
+            "serve_cold_start_cold_s": cold["ttfr_s"],
+            "serve_cold_start_warm_compile_s": warm["compile_s"],
+            "serve_cold_start_cold_compile_s": cold["compile_s"],
+            "serve_cold_start_speedup": round(
+                cold["ttfr_s"] / warm["ttfr_s"], 3)
+                if warm["ttfr_s"] else None,
+        }
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def run_bench():
     import jax
 
@@ -1397,6 +1685,34 @@ def run_bench():
         serve_keys.update({
             "serve_recovery_replay_s": None,
             "serve_recovery_error":
+                f"{type(exc).__name__}: {exc}"[:200],
+        })
+    # Fleet-serving leg (ISSUE 15): aggregate problems/sec through
+    # the replicated router at replicas=1/2/4 on the same seeded
+    # stream + the affinity-vs-round-robin A/B — sentinel family
+    # "serving_fleet" (the r2 value).  Never kills the headline.
+    try:
+        record_leg_backend("serving_fleet")
+        serve_keys.update(bench_serving_fleet())
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: fleet leg failed ({exc}); continuing",
+              file=sys.stderr)
+        serve_keys.update({
+            "fleet_problems_per_sec_r2": None,
+            "fleet_error": f"{type(exc).__name__}: {exc}"[:200],
+        })
+    # Cold-start leg (ISSUE 15): fresh-worker time-to-first-result,
+    # warm disk compile cache vs empty — sentinel family
+    # "serve_cold_start" (warm TTFR, lower is better).
+    try:
+        record_leg_backend("serve_cold_start")
+        serve_keys.update(bench_serve_cold_start())
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: cold-start leg failed ({exc}); continuing",
+              file=sys.stderr)
+        serve_keys.update({
+            "serve_cold_start_warm_s": None,
+            "serve_cold_start_error":
                 f"{type(exc).__name__}: {exc}"[:200],
         })
     # Stateful-session leg (ISSUE 13): warm time-to-recovered-cost

@@ -6,6 +6,7 @@ is the device engine (one jitted BSP program); ``backend="thread"`` runs
 the agent-mode runtime for reference-equivalent distributed execution.
 """
 
+import os
 import time
 from typing import Any, Dict, Optional, Union
 
@@ -330,6 +331,56 @@ class ServeHandle:
         return False
 
 
+class FleetHandle:
+    """A running fleet: N serve-worker processes behind one router
+    front end (docs/serving.md "Fleet-scale serving").  ``router`` is
+    the :class:`~pydcop_tpu.serving.router.FleetRouter` (replica
+    states, routing stats); ``stop()`` SIGTERM-drains every worker
+    and shuts the front end down."""
+
+    def __init__(self, router, front_end):
+        self.router = router
+        self.front_end = front_end
+
+    @property
+    def url(self):
+        return self.front_end.url
+
+    @property
+    def port(self):
+        return self.front_end.port
+
+    def stop(self, drain: bool = True) -> Dict[str, Any]:
+        self.front_end.stop()
+        return self.router.stop(drain=drain)
+
+    def __enter__(self) -> "FleetHandle":
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def _write_port_file(path: str, port: int) -> None:
+    """Atomically publish the bound port (the fleet router's worker
+    handshake; also handy for scripts wrapping ``--port 0``)."""
+    import tempfile
+
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".port_")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(f"{port}\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def serve(port: int = 8080, host: str = "127.0.0.1",
           max_queue: int = 256, batch_window_s: float = 0.02,
           max_batch: int = 16, high_water: Optional[int] = None,
@@ -344,7 +395,13 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           session_max: int = 64,
           session_segment_cycles: Optional[int] = None,
           session_checkpoint_every_events: int = 8,
-          block: bool = False) -> Optional[ServeHandle]:
+          replicas: int = 1,
+          affinity: str = "structure",
+          compile_cache_dir: Optional[str] = None,
+          heartbeat_s: float = 0.25,
+          spill_slack: int = 4,
+          port_file: Optional[str] = None,
+          block: bool = False) -> Optional[Any]:
     """Start the multi-tenant solve service (docs/serving.md).
 
     Incoming problems are binned by structure signature and
@@ -389,15 +446,59 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     snapshot cadence (journaled services; smaller = faster recovery,
     more snapshot writes).
 
-    ``port=0`` asks the OS for a free port.  ``block=True`` (the
-    ``pydcop serve`` CLI) serves until SIGTERM/SIGINT, then STOPS
-    WITH DRAIN — an orchestrated restart (k8s-style) never drops
-    accepted work: queued requests either finish in the drain window
-    or stay journaled-replayable, and the drained count is logged on
-    exit.  Returns None.  ``block=False`` returns a
-    :class:`ServeHandle` (also a context manager) for embedding and
-    tests.
+    Fleet scaling (docs/serving.md "Fleet-scale serving"):
+    ``replicas=N`` (N > 1) spawns N ``pydcop serve`` WORKER PROCESSES
+    — each a full solve service with its own scheduler thread,
+    journal segment (``<journal_dir>/replica-<k>/``) and /metrics —
+    behind a structure-affinity router speaking this same wire
+    protocol; the return value is a :class:`FleetHandle`.
+    ``affinity`` picks the routing policy (``"structure"``:
+    rendezvous-hash on the admission-time structure key so
+    same-structure traffic lands where the compiled program is warm;
+    ``"round_robin"``: the A/B baseline), ``heartbeat_s`` /
+    ``spill_slack`` tune replica death detection and hot-spot
+    spillover.  ``compile_cache_dir`` enables the persistent AOT
+    compile cache (engine/aotcache.py) — workers (and the
+    single-service path) enable it BEFORE their first jit, so a fresh
+    replica serves its first same-structure request without paying
+    XLA compilation.
+
+    ``port=0`` asks the OS for a free port (``port_file`` atomically
+    publishes the assignment — the fleet worker handshake).
+    ``block=True`` (the ``pydcop serve`` CLI) serves until
+    SIGTERM/SIGINT, then STOPS WITH DRAIN — an orchestrated restart
+    (k8s-style) never drops accepted work: queued requests either
+    finish in the drain window or stay journaled-replayable, and the
+    drained count is logged on exit.  Returns None.  ``block=False``
+    returns a :class:`ServeHandle` / :class:`FleetHandle` (both
+    context managers) for embedding and tests.
     """
+    if replicas > 1:
+        return _serve_fleet(
+            port=port, host=host, max_queue=max_queue,
+            batch_window_s=batch_window_s, max_batch=max_batch,
+            high_water=high_water, default_params=default_params,
+            breaker_failures=breaker_failures,
+            breaker_reset_s=breaker_reset_s, result_keep=result_keep,
+            journal_dir=journal_dir, journal_sync=journal_sync,
+            envelope_packing=envelope_packing,
+            envelope_overhead_ms=envelope_overhead_ms,
+            session_max=session_max,
+            session_segment_cycles=session_segment_cycles,
+            session_checkpoint_every_events=(
+                session_checkpoint_every_events),
+            replicas=replicas, affinity=affinity,
+            compile_cache_dir=compile_cache_dir,
+            heartbeat_s=heartbeat_s, spill_slack=spill_slack,
+            port_file=port_file, block=block)
+    if compile_cache_dir:
+        # Before the service compiles anything: the cache-dir config
+        # silently no-ops once a jit has run (engine/aotcache latch).
+        from pydcop_tpu.engine.aotcache import (
+            enable_persistent_compile_cache,
+        )
+
+        enable_persistent_compile_cache(compile_cache_dir)
     from pydcop_tpu.serving.admission import AdmissionPolicy
     from pydcop_tpu.serving.http import ServeFrontEnd
     from pydcop_tpu.serving.service import SolveService
@@ -435,9 +536,109 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     print(f"pydcop serve: listening on {handle.url} "
           "(POST /solve, GET /result/<id>, /metrics, /healthz)",
           file=sys.stderr)
+    if port_file:
+        _write_port_file(port_file, handle.port)
     if not block:
         return handle
+    _serve_until_signal(
+        handle,
+        lambda summary: (
+            "pydcop serve: shut down — "
+            f"{summary['drained']} request(s) drained, "
+            f"{summary['replayable']} journaled replayable, "
+            f"{summary['failed_pending']} failed pending"))
+    return None
+
+
+def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
+                 high_water, default_params, breaker_failures,
+                 breaker_reset_s, result_keep, journal_dir,
+                 journal_sync, envelope_packing, envelope_overhead_ms,
+                 session_max, session_segment_cycles,
+                 session_checkpoint_every_events, replicas, affinity,
+                 compile_cache_dir, heartbeat_s, spill_slack,
+                 port_file, block) -> Optional["FleetHandle"]:
+    """The ``replicas > 1`` serve path: build the worker CLI tail
+    from the same kwargs the single-service path consumes (so the two
+    cannot drift), spawn the fleet, mount the router front end."""
+    from pydcop_tpu.serving.router import FleetRouter, RouterFrontEnd
+
+    params = dict(default_params or {})
+    worker_args = [
+        "--max_queue", str(max_queue),
+        "--batch_window", str(batch_window_s),
+        "--max_batch", str(max_batch),
+        "--breaker_failures", str(breaker_failures),
+        "--breaker_reset", str(breaker_reset_s),
+        "--result_keep", str(result_keep),
+        "--session_max", str(session_max),
+        "--session_checkpoint_every",
+        str(session_checkpoint_every_events),
+    ]
+    if high_water is not None:
+        worker_args += ["--high_water", str(high_water)]
+    if "max_cycles" in params:
+        worker_args += ["--cycles", str(params["max_cycles"])]
+    if "damping" in params:
+        worker_args += ["--damping", str(params["damping"])]
+    # EVERY other default-param key rides as JSON — the fleet and
+    # single-service paths must not drift (a replicas=2 service
+    # dropping the caller's stability/noise/prune defaults would
+    # solve differently than replicas=1 with no error anywhere).
+    extra_params = {k: v for k, v in params.items()
+                    if k not in ("max_cycles", "damping")}
+    if extra_params:
+        import json as json_mod
+
+        worker_args += ["--params_json",
+                        json_mod.dumps(extra_params)]
+    if journal_sync:
+        worker_args += ["--journal_sync"]
+    if not envelope_packing:
+        worker_args += ["--no_envelope"]
+    if envelope_overhead_ms is not None:
+        worker_args += ["--envelope_overhead_ms",
+                        str(envelope_overhead_ms)]
+    if session_segment_cycles is not None:
+        worker_args += ["--session_segment_cycles",
+                        str(session_segment_cycles)]
+    router = FleetRouter(
+        replicas=replicas, worker_args=worker_args,
+        journal_dir=journal_dir,
+        compile_cache_dir=compile_cache_dir, affinity=affinity,
+        heartbeat_s=heartbeat_s, spill_slack=spill_slack,
+        default_params=params,
+    ).start()
+    try:
+        front_end = RouterFrontEnd(router, port=port,
+                                   host=host).start()
+    except Exception:
+        router.stop(drain=False)
+        raise
+    handle = FleetHandle(router, front_end)
+    import sys
+
+    print(f"pydcop serve: fleet of {replicas} replica(s) behind "
+          f"{handle.url} (affinity={affinity})", file=sys.stderr)
+    if port_file:
+        _write_port_file(port_file, handle.port)
+    if not block:
+        return handle
+    _serve_until_signal(
+        handle,
+        lambda summary: (
+            "pydcop serve: fleet shut down — worker exits "
+            + ", ".join(
+                f"replica-{w['index']}={w['exit']}"
+                for w in summary["workers"])))
+    return None
+
+
+def _serve_until_signal(handle, summarize) -> None:
+    """``block=True`` shared tail: wait for SIGTERM/SIGINT, cut the
+    black-box bundle, drain-stop the handle, log the summary."""
     import signal
+    import sys
     import threading
 
     stop_event = threading.Event()
@@ -459,7 +660,6 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
             sig: signal.signal(sig, _on_signal)
             for sig in (signal.SIGTERM, signal.SIGINT)
         }
-    summary = None
     try:
         stop_event.wait()
         print("pydcop serve: signal received, draining…",
@@ -475,12 +675,7 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
         summary = handle.stop(drain=True)
         for sig, handler in previous.items():
             signal.signal(sig, handler)
-        print("pydcop serve: shut down — "
-              f"{summary['drained']} request(s) drained, "
-              f"{summary['replayable']} journaled replayable, "
-              f"{summary['failed_pending']} failed pending",
-              file=sys.stderr)
-    return None
+        print(summarize(summary), file=sys.stderr)
 
 
 def _solve(dcop, algo_def, module, *, distribution, backend, timeout,

@@ -50,6 +50,15 @@ def set_parser(subparsers):
                              "don't set params.max_cycles")
     parser.add_argument("--damping", type=float, default=0.5,
                         help="default MaxSum damping for requests")
+    parser.add_argument("--params_json", "--params-json",
+                        default=None, metavar="JSON",
+                        help="service-wide solver-parameter defaults "
+                             "as a JSON object (any serving/binning "
+                             "PARAM_KEYS key: stability, noise, "
+                             "damping_nodes, prune, ...); merged over "
+                             "--cycles/--damping — how the fleet "
+                             "router forwards api.serve's full "
+                             "default_params to every worker")
     parser.add_argument("--result_keep", type=int, default=4096,
                         help="completed results retained for "
                              "GET /result/<id> (oldest evicted)")
@@ -110,29 +119,103 @@ def set_parser(subparsers):
                              "engine-state checkpoints (journaled "
                              "services; smaller = faster --recover, "
                              "more snapshot writes; 0 disables)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="worker replicas: N > 1 spawns N serve "
+                             "worker processes (each its own "
+                             "scheduler/journal segment/metrics) "
+                             "behind a structure-affinity router on "
+                             "--port (docs/serving.md \"Fleet-scale "
+                             "serving\")")
+    parser.add_argument("--affinity",
+                        choices=("structure", "round_robin"),
+                        default="structure",
+                        help="fleet routing policy: 'structure' "
+                             "rendezvous-hashes the admission-time "
+                             "structure key so same-structure "
+                             "traffic lands where the compiled "
+                             "program is warm; 'round_robin' is the "
+                             "A/B baseline")
+    parser.add_argument("--compile_cache_dir", "--compile-cache-dir",
+                        default=None, metavar="DIR",
+                        help="persistent AOT compile cache: XLA "
+                             "executables persist to DIR across "
+                             "processes, so a fresh worker serves "
+                             "its first same-structure request "
+                             "without recompiling (enabled BEFORE "
+                             "the first jit — the set-after-jit "
+                             "config latch is handled internally; "
+                             "fleet workers inherit the directory)")
+    parser.add_argument("--heartbeat", type=float, default=0.25,
+                        metavar="SECONDS",
+                        help="fleet router heartbeat cadence; a "
+                             "replica silent for ~8 expected beats "
+                             "(phi-accrual model) is declared dead "
+                             "and restarted on its journal segment")
+    parser.add_argument("--spill_slack", "--spill-slack", type=int,
+                        default=4,
+                        help="affinity spillover threshold: a "
+                             "structure-warm replica more than this "
+                             "many requests deeper in flight than "
+                             "the idlest one loses the request to it")
+    parser.add_argument("--port_file", "--port-file", default=None,
+                        metavar="PATH",
+                        help="atomically write the bound port to "
+                             "PATH once listening (with --port 0: "
+                             "how wrappers and the fleet router "
+                             "learn the assignment)")
     parser.set_defaults(func=run_cmd)
 
 
 def run_cmd(args) -> int:
+    # FIRST, before anything that could jit (probe, api import side
+    # effects): the persistent compile cache's directory config
+    # silently no-ops once a jit has run (engine/aotcache latch).
+    # Spawned fleet workers arrive here with the router's directory
+    # in PYDCOP_COMPILE_CACHE_DIR.
+    from pydcop_tpu.engine import aotcache
+
+    if args.compile_cache_dir:
+        aotcache.enable_persistent_compile_cache(
+            args.compile_cache_dir)
+    else:
+        aotcache.maybe_enable_from_env()
+
     from pydcop_tpu.api import serve
 
     if args.recover and not args.journal_dir:
         logger.error("--recover requires --journal_dir")
         return 2
+    if args.replicas > 1 and args.recover:
+        logger.error("--recover is per-worker in a fleet: the router "
+                     "always recovers journaled replica segments")
+        return 2
     if args.flight_recorder_events is not None:
         from pydcop_tpu.observability import flight
 
         flight.install(events=args.flight_recorder_events)
+    default_params = {
+        "max_cycles": args.cycles,
+        "damping": args.damping,
+    }
+    if args.params_json:
+        import json
+
+        try:
+            extra = json.loads(args.params_json)
+            if not isinstance(extra, dict):
+                raise ValueError("--params_json must be a JSON "
+                                 "object")
+        except ValueError as exc:
+            logger.error("bad --params_json: %s", exc)
+            return 2
+        default_params.update(extra)
     serve(
         port=args.port, host=args.host,
         max_queue=args.max_queue, high_water=args.high_water,
         batch_window_s=args.batch_window, max_batch=args.max_batch,
         breaker_failures=args.breaker_failures,
         breaker_reset_s=args.breaker_reset,
-        default_params={
-            "max_cycles": args.cycles,
-            "damping": args.damping,
-        },
+        default_params=default_params,
         result_keep=args.result_keep,
         journal_dir=args.journal_dir,
         journal_sync=args.journal_sync,
@@ -142,6 +225,13 @@ def run_cmd(args) -> int:
         session_max=args.session_max,
         session_segment_cycles=args.session_segment_cycles,
         session_checkpoint_every_events=args.session_checkpoint_every,
+        replicas=args.replicas,
+        affinity=args.affinity,
+        compile_cache_dir=(args.compile_cache_dir
+                           or aotcache.cache_dir()),
+        heartbeat_s=args.heartbeat,
+        spill_slack=args.spill_slack,
+        port_file=args.port_file,
         block=True,
     )
     return 0

@@ -28,6 +28,14 @@ throughput service (docs/serving.md):
 - :mod:`.http` — stdlib HTTP front end (``POST /solve``,
   ``GET /result/<id>``, ``GET /stats``) mounting the PR-5 telemetry
   routes (``/metrics``, ``/healthz``, ``/events``) alongside;
+- :mod:`.router` — fleet-scale serving (docs/serving.md
+  "Fleet-scale serving"): N worker replicas (each a full service in
+  its own process with its own journal segment) behind a
+  structure-affinity router — rendezvous hashing on the
+  admission-time structure key (:func:`.binning.affinity_key`),
+  least-loaded spillover, breaker-aware shedding, heartbeat death
+  detection with journal handoff to the restarted worker, and the
+  shared persistent AOT compile cache (engine/aotcache.py);
 - :mod:`.sessions` — stateful solve sessions (docs/sessions.md):
   ``POST /session`` opens a solve backed by one warm
   ``DynamicMaxSumEngine``, ``PATCH /session/<id>/events`` streams
@@ -50,6 +58,10 @@ from pydcop_tpu.serving.admission import (  # noqa: F401
 )
 from pydcop_tpu.serving.journal import (  # noqa: F401
     RequestJournal,
+)
+from pydcop_tpu.serving.router import (  # noqa: F401
+    FleetRouter,
+    RouterFrontEnd,
 )
 from pydcop_tpu.serving.service import (  # noqa: F401
     SolveRequest,

@@ -130,6 +130,47 @@ def bin_key(graph: CompiledFactorGraph,
     )
 
 
+def affinity_key(dcop, params: Optional[Dict[str, Any]] = None) -> str:
+    """Router-side structure-affinity key (serving/router.py): a
+    process-stable digest computed from the PROBLEM MODEL, without
+    building cost tables.
+
+    The fleet router must group traffic exactly the way a worker's
+    :func:`bin_key` will — same-structure requests must land on the
+    replica whose compiled program is already warm — but it must not
+    pay a full ``compile_dcop`` (hypercube cost-table fill) per
+    routed request.  On the serving compile path (``pad_to=1``,
+    scatter aggregation) the bin key's structure half is a pure
+    function of (variable count, max domain size, per-arity scope
+    indices) — precisely what this digest hashes, in the same
+    variable order ``compile_dcop`` uses — so two DCOPs share an
+    affinity key iff they share a serving bin key
+    (partition-equivalence asserted in tests/unit/
+    test_fleet_battery.py).  The params half rides along exactly like
+    :func:`bin_key`'s; ``prune="auto"`` is keyed as the literal
+    string (workers resolve it per structure AFTER compile — the
+    router cannot, so auto-pruned traffic may split across at most
+    two replicas per structure).
+    """
+    merged = normalize_params(params)
+    var_index = {name: i for i, name in enumerate(dcop.variables)}
+    dmax = max((len(v.domain) for v in dcop.variables.values()),
+               default=1)
+    by_arity: Dict[int, list] = {}
+    for c in dcop.constraints.values():
+        if c.arity == 0:
+            continue
+        by_arity.setdefault(c.arity, []).append(
+            tuple(var_index[v.name] for v in c.dimensions))
+    structure = (
+        len(var_index), dmax,
+        tuple((arity, tuple(by_arity[arity]))
+              for arity in sorted(by_arity)),
+        tuple((k, merged[k]) for k in PARAM_KEYS),
+    )
+    return hashlib.sha1(repr(structure).encode()).hexdigest()
+
+
 def bin_label(key: Tuple) -> str:
     """Short low-cardinality label for a bin key (metrics/trace): the
     variable-count/domain part of the shape plus a process-stable

@@ -179,6 +179,17 @@ class _ServeHandler(_Handler):
             deadline_s = body.get("deadline_s")
             if deadline_s is not None:
                 deadline_s = _positive_float(deadline_s, "deadline_s")
+            # Caller-supplied id (the fleet router mints fleet-unique
+            # ids so /result polls can be pinned to the owning
+            # replica; worker-local counters would collide across a
+            # fleet).  Validated like every other wire field.
+            request_id = body.get("request_id")
+            if request_id is not None and (
+                    not isinstance(request_id, str)
+                    or not request_id.strip()):
+                raise ValueError(
+                    f"request_id must be a non-empty string, got "
+                    f"{request_id!r}")
         except ValueError as exc:
             service.record_bad_request()
             self._json(400, {"error": f"bad request body: {exc}"})
@@ -188,6 +199,7 @@ class _ServeHandler(_Handler):
 
             dcop = load_dcop(yaml_src)
             rid = service.submit(dcop, params=body.get("params"),
+                                 request_id=request_id,
                                  deadline_s=deadline_s)
         except AdmissionRejected as exc:
             self._json(exc.http_status, {

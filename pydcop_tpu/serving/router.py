@@ -1,0 +1,982 @@
+"""Fleet router: N solve-service worker replicas behind one HTTP port.
+
+One scheduler thread owning one device cannot serve the ROADMAP's
+"millions of users" north star (open item 2).  This module scales the
+serve plane OUT: ``pydcop serve --replicas N`` (api.serve(replicas=N))
+spawns N worker processes — each a full ``pydcop serve`` instance with
+its own SolveService scheduler thread, its own journal segment
+(``<journal_dir>/replica-<k>/``), its own /metrics — behind a
+stdlib-HTTP router that speaks the existing wire protocol unchanged:
+clients POST /solve and poll /result/<id> exactly as against a single
+service and never know the fleet exists.
+
+**Structure-affinity routing.**  The router computes the structure
+bin key at admission (serving/binning.affinity_key — the PR-3/6
+structure signature without the cost-table fill) and routes by
+RENDEZVOUS HASHING on it: every replica scores
+``sha1(key || replica_id)`` and the highest healthy scorer wins, so
+same-structure traffic deterministically lands where the compiled
+program (and the batch-mates to coalesce with) is already warm —
+cache-affinity beats round-robin, and the bench proves it
+(bench.py bench_serving_fleet, ``affinity_hit_fraction`` in /stats).
+Rendezvous keeps the map stable under membership change: a replica
+death remaps ONLY the keys it owned.  Two escape hatches keep
+affinity from becoming a liability: **least-loaded spillover** (a
+primary more than ``spill_slack`` requests deeper in flight than the
+idlest healthy replica loses the request to it — hot-spot structures
+overflow instead of queueing) and **breaker-aware shedding** (a
+replica whose admission breaker reports open is dropped from the
+candidate set; if every replica sheds, the router answers 503 like a
+single service would).
+
+**Fleet lifecycle.**  A heartbeat prober GETs every replica's
+/healthz on a short cadence and scores silence with the PR-4
+phi-accrual estimator (resilience/health.PhiAccrualEstimator):
+suspicion is advisory, ``dead_misses`` expected intervals of silence
+(or the worker process exiting) is the death verdict.  A dead
+replica's journal segment is handed to its replacement: the router
+respawns worker k on ``<journal_dir>/replica-<k>/`` with
+``--recover``, so every request the dead worker acknowledged replays
+through the PR-8 machinery — SIGKILL mid-burst loses zero
+acknowledged requests (tools/chaos_soak.py ``replica_kill``).
+Requests are PINNED: the router mints the request id, remembers which
+replica owns it, and routes /result polls there (a restarted replica
+answers for its predecessor's journal).  Sessions pin the same way.
+Fleet SIGTERM drains every worker (each drains its own queue, journals
+the rest replayable) and exits 0.
+
+The router process itself never jits: compile work lives in the
+workers, warmed across restarts by the persistent AOT compile cache
+(engine/aotcache.py) whose directory the router exports to every
+worker it spawns.
+"""
+
+import hashlib
+import http.client
+import itertools
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.server import (
+    TelemetryServer,
+    _Handler,
+    get_health_provider,
+    set_health_provider,
+)
+
+logger = logging.getLogger("pydcop.serving.router")
+
+# Wire limits mirror the single-service front end (serving/http.py).
+MAX_BODY_BYTES = 8 << 20
+# Forward timeout headroom over the client's own wait window.
+FORWARD_TIMEOUT_S = 330.0
+# Bounded pin tables: oldest request pins evicted first (the same
+# retention philosophy as SolveService.result_keep).
+PIN_KEEP = 65536
+
+UP = "up"
+STARTING = "starting"
+RESTARTING = "restarting"
+DOWN = "down"
+
+
+class FleetUnavailable(Exception):
+    """No healthy, non-shedding replica can take the request (503)."""
+
+
+class Replica:
+    """One worker process slot: the process handle, its URL, health
+    bookkeeping and the warm-structure set affinity accounting reads.
+    A slot survives its process — a restarted worker reuses the slot
+    (same index, same journal segment), which is what keeps request
+    pins valid across a replica death."""
+
+    def __init__(self, index: int, journal_dir: Optional[str],
+                 log_path: str):
+        self.index = index
+        self.journal_dir = journal_dir
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.status = STARTING
+        self.estimator = None           # PhiAccrualEstimator, set on up
+        self.anchor = 0.0
+        self.breaker_open = False
+        self.queue_depth = 0
+        self.in_flight = 0
+        self.forwarded = 0
+        self.errors = 0
+        self.restarts = 0
+        self.warm: set = set()
+
+    @property
+    def url(self) -> Optional[str]:
+        if self.port is None:
+            return None
+        return f"http://127.0.0.1:{self.port}"
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "url": self.url,
+            "status": self.status,
+            "pid": self.proc.pid if self.proc else None,
+            "breaker_open": self.breaker_open,
+            "queue_depth": self.queue_depth,
+            "in_flight": self.in_flight,
+            "forwarded": self.forwarded,
+            "errors": self.errors,
+            "restarts": self.restarts,
+            "warm_structures": len(self.warm),
+            "journal_dir": self.journal_dir,
+        }
+
+
+def _rendezvous_score(digest: str, index: int) -> int:
+    """Highest-random-weight score of one (structure, replica) pair —
+    deterministic across processes and restarts (hash() is seeded per
+    process and would reshuffle the whole map on every router
+    restart, defeating the disk-warmed affinity)."""
+    h = hashlib.sha1(f"{digest}|{index}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+class FleetRouter:
+    """Spawn, monitor and route over N serve-worker replicas.
+
+    ``worker_args`` is the raw ``pydcop serve`` CLI argument tail
+    every worker is spawned with (batching/admission/session knobs —
+    built by api.serve from its kwargs, so the single-service and
+    fleet paths cannot drift).  ``journal_dir`` enables per-replica
+    durable journals (``replica-<k>/`` segments) and crash handoff;
+    ``compile_cache_dir`` is exported to every worker as the
+    persistent AOT compile cache.  ``affinity`` is ``"structure"``
+    (rendezvous on the bin key, the default) or ``"round_robin"``
+    (the A/B baseline the bench measures against)."""
+
+    def __init__(self, replicas: int = 2,
+                 worker_args: Optional[List[str]] = None,
+                 journal_dir: Optional[str] = None,
+                 compile_cache_dir: Optional[str] = None,
+                 affinity: str = "structure",
+                 heartbeat_s: float = 0.25,
+                 dead_misses: float = 8.0,
+                 spill_slack: int = 4,
+                 restart_dead: bool = True,
+                 worker_ready_timeout_s: float = 120.0,
+                 default_params: Optional[Dict[str, Any]] = None):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if affinity not in ("structure", "round_robin"):
+            raise ValueError(
+                f"affinity must be 'structure' or 'round_robin', "
+                f"got {affinity!r}")
+        self.n_replicas = int(replicas)
+        self.worker_args = list(worker_args or [])
+        self.journal_dir = journal_dir
+        self.compile_cache_dir = compile_cache_dir
+        self.affinity = affinity
+        self.heartbeat_s = float(heartbeat_s)
+        self.dead_misses = float(dead_misses)
+        self.spill_slack = int(spill_slack)
+        self.restart_dead = bool(restart_dead)
+        self.worker_ready_timeout_s = float(worker_ready_timeout_s)
+        # The fleet's service-wide solver defaults: the affinity key
+        # must normalize request params exactly the way the WORKERS
+        # will (their SolveService merges over these same defaults).
+        # Hashing against the module defaults instead would split
+        # same-bin traffic whenever a client spells a service default
+        # explicitly — e.g. params={} vs params={"max_cycles": 60}
+        # on a --cycles 60 fleet.
+        self.default_params = dict(default_params or {})
+        self.replicas: List[Replica] = []
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+        self._pins: "OrderedDict[str, int]" = OrderedDict()
+        self._session_pins: "OrderedDict[str, int]" = OrderedDict()
+        self._monitor: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        self._started = False
+        self._run_dir: Optional[str] = None
+        # Routing ledger (all mirrored on /stats).
+        self.routed = 0
+        self.affinity_hits = 0
+        self.spillovers = 0
+        self.shed = 0
+        self.reroutes = 0
+        self.deaths = 0
+        reg = metrics_registry
+        self._routed_total = reg.counter(
+            "pydcop_router_requests_total",
+            "Requests routed to replicas, by outcome")
+        self._affinity_total = reg.counter(
+            "pydcop_router_affinity_hits_total",
+            "Routed requests that landed on a structure-warm replica")
+        self._up_gauge = reg.gauge(
+            "pydcop_router_replicas_up",
+            "Live (heartbeat-passing) worker replicas")
+        self._restarts_total = reg.counter(
+            "pydcop_router_replica_restarts_total",
+            "Worker replicas restarted after a death verdict")
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def start(self) -> "FleetRouter":
+        import tempfile
+
+        if self._started:
+            return self
+        self._was_active = metrics_registry.active
+        metrics_registry.active = True
+        self._run_dir = tempfile.mkdtemp(prefix="pydcop_fleet_")
+        try:
+            for k in range(self.n_replicas):
+                journal = (os.path.join(self.journal_dir,
+                                        f"replica-{k}")
+                           if self.journal_dir else None)
+                replica = Replica(
+                    k, journal,
+                    os.path.join(self._run_dir, f"replica-{k}.log"))
+                self.replicas.append(replica)
+                self._spawn(replica, recover=False)
+            deadline = time.monotonic() + self.worker_ready_timeout_s
+            for replica in self.replicas:
+                self._wait_ready(replica, deadline)
+        except BaseException:
+            # Partial startup must not orphan detached workers: one
+            # replica failing to come up kills every one already
+            # spawned (stop() is a no-op before _started flips).
+            for replica in self.replicas:
+                if replica.proc is not None \
+                        and replica.proc.poll() is None:
+                    try:
+                        replica.proc.kill()
+                        replica.proc.wait(timeout=10.0)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+            self.replicas = []
+            metrics_registry.active = self._was_active
+            raise
+        self._stopping.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="pydcop-fleet-monitor",
+            daemon=True)
+        self._monitor.start()
+        self._started = True
+        self._up_gauge.set(self.up_count())
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: float = 120.0) -> Dict[str, Any]:
+        """Drain and stop the whole fleet: SIGTERM every worker (each
+        drains its queue and journals leftovers replayable — the
+        single-service contract), wait for clean exits, reap
+        stragglers.  Returns per-worker exit codes."""
+        if not self._started:
+            return {"workers": []}
+        self._stopping.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=max(self.heartbeat_s * 4, 2.0))
+            self._monitor = None
+        sig = signal.SIGTERM if drain else signal.SIGKILL
+        for replica in self.replicas:
+            if replica.proc is not None and replica.proc.poll() is None:
+                try:
+                    replica.proc.send_signal(sig)
+                except OSError:
+                    pass
+        exits = []
+        deadline = time.monotonic() + timeout
+        for replica in self.replicas:
+            code = None
+            if replica.proc is not None:
+                try:
+                    code = replica.proc.wait(
+                        timeout=max(deadline - time.monotonic(), 1.0))
+                except subprocess.TimeoutExpired:
+                    replica.proc.kill()
+                    try:
+                        code = replica.proc.wait(timeout=10.0)
+                    except subprocess.TimeoutExpired:
+                        code = None
+            replica.status = DOWN
+            exits.append({"index": replica.index, "exit": code,
+                          "restarts": replica.restarts})
+        # Final sweep: a restart thread that raced the signal loop
+        # above may have spawned a replacement after its slot was
+        # signaled — nothing it spawns may outlive the fleet.
+        for replica in self.replicas:
+            if replica.proc is not None \
+                    and replica.proc.poll() is None:
+                try:
+                    replica.proc.kill()
+                    replica.proc.wait(timeout=10.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        self._started = False
+        metrics_registry.active = self._was_active
+        return {"workers": exits}
+
+    def _spawn(self, replica: Replica, recover: bool) -> None:
+        """Start (or restart) worker k.  ``recover`` replays the
+        slot's journal segment — the handoff: the restarted process
+        owns its predecessor's acknowledged requests."""
+        port_file = os.path.join(self._run_dir,
+                                 f"replica-{replica.index}.port")
+        try:
+            os.unlink(port_file)
+        except OSError:
+            pass
+        cmd = [sys.executable, "-m", "pydcop_tpu.dcop_cli", "serve",
+               "--port", "0", "--host", "127.0.0.1",
+               "--port_file", port_file]
+        if replica.journal_dir:
+            cmd += ["--journal_dir", replica.journal_dir]
+            if recover or os.path.exists(os.path.join(
+                    replica.journal_dir, "requests.jnl")):
+                cmd += ["--recover"]
+        cmd += self.worker_args
+        env = dict(os.environ)
+        if self.compile_cache_dir:
+            # The worker enables the persistent AOT cache at spawn,
+            # before its first jit (engine/aotcache latch).
+            env["PYDCOP_COMPILE_CACHE_DIR"] = self.compile_cache_dir
+        log = open(replica.log_path, "ab")
+        try:
+            replica.proc = subprocess.Popen(
+                cmd, env=env, stdout=log, stderr=log,
+                start_new_session=True)
+        finally:
+            log.close()
+        replica.port = None
+        replica.status = STARTING if replica.restarts == 0 \
+            else RESTARTING
+        replica.breaker_open = False
+        # A fresh process is NOT warm, whatever its predecessor
+        # compiled: affinity hit accounting must restart from zero
+        # (the disk compile cache softens the restarted replica's
+        # cold calls, but a disk retrieval is still not a warm jit
+        # cache — counting it as a hit would inflate
+        # affinity_hit_fraction after every death).
+        replica.warm = set()
+        logger.info("replica %d spawned (pid %d%s)", replica.index,
+                    replica.proc.pid,
+                    ", recover" if recover else "")
+
+    def _wait_ready(self, replica: Replica, deadline: float) -> None:
+        port_file = os.path.join(self._run_dir,
+                                 f"replica-{replica.index}.port")
+        while time.monotonic() < deadline:
+            if replica.proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet worker {replica.index} died on startup "
+                    f"(exit {replica.proc.returncode}); log: "
+                    f"{replica.log_path}")
+            try:
+                with open(port_file, encoding="utf-8") as f:
+                    replica.port = int(f.read().strip())
+            except (OSError, ValueError):
+                time.sleep(0.05)
+                continue
+            try:
+                status, _ctype, _body = self._forward(
+                    replica, "GET", "/healthz", None, timeout=2.0)
+            except OSError:
+                time.sleep(0.05)
+                continue
+            if status in (200, 503):
+                from pydcop_tpu.resilience.health import (
+                    PhiAccrualEstimator,
+                )
+
+                now = time.monotonic()
+                replica.estimator = PhiAccrualEstimator(
+                    expected=self.heartbeat_s)
+                replica.anchor = now
+                replica.estimator.beat(now)
+                replica.status = UP
+                logger.info("replica %d ready on %s", replica.index,
+                            replica.url)
+                return
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"fleet worker {replica.index} never became ready; "
+            f"log: {replica.log_path}")
+
+    # -- health & restarts --------------------------------------------- #
+
+    def up_count(self) -> int:
+        return sum(1 for r in self.replicas if r.status == UP)
+
+    def _monitor_loop(self) -> None:
+        while not self._stopping.wait(self.heartbeat_s):
+            for replica in self.replicas:
+                if self._stopping.is_set():
+                    return
+                try:
+                    self._probe(replica)
+                except Exception:  # noqa: BLE001 — the prober must
+                    # outlive any single replica's weirdness.
+                    logger.exception("heartbeat probe crashed for "
+                                     "replica %d", replica.index)
+            self._up_gauge.set(self.up_count())
+
+    def _probe(self, replica: Replica) -> None:
+        if replica.status not in (UP, DOWN):
+            return  # mid-(re)start — the restart path owns it
+        proc_dead = (replica.proc is not None
+                     and replica.proc.poll() is not None)
+        beat_ok = False
+        if not proc_dead and replica.port is not None:
+            try:
+                status, _ctype, body = self._forward(
+                    replica, "GET", "/healthz", None,
+                    timeout=max(self.heartbeat_s * 2, 1.0))
+                beat_ok = status in (200, 503)
+                if beat_ok:
+                    doc = json.loads(body)
+                    serving = doc.get("serving") or {}
+                    replica.breaker_open = (
+                        serving.get("breaker_state") == "open")
+                    replica.queue_depth = int(
+                        serving.get("queue_depth") or 0)
+            except (OSError, ValueError):
+                beat_ok = False
+        now = time.monotonic()
+        if beat_ok:
+            if replica.status == DOWN:
+                # A replica marked down on a forward error but whose
+                # process lived: it answered again — back in service.
+                replica.status = UP
+            replica.estimator.beat(now)
+            return
+        missed = (replica.estimator.missed(now, replica.anchor)
+                  if replica.estimator else float("inf"))
+        if proc_dead or missed >= self.dead_misses:
+            self._declare_dead(replica, proc_dead=proc_dead,
+                               missed=missed)
+
+    def _declare_dead(self, replica: Replica, proc_dead: bool,
+                      missed: float) -> None:
+        if replica.status == RESTARTING or self._stopping.is_set():
+            # A fleet mid-shutdown SIGTERMs its own workers; the
+            # monitor must not mistake those exits for deaths and
+            # restart what stop() is draining.
+            return
+        self.deaths += 1
+        logger.warning(
+            "replica %d declared dead (%s, %.1f expected heartbeats "
+            "silent)", replica.index,
+            "process exited" if proc_dead else "heartbeat silence",
+            missed if missed != float("inf") else -1.0)
+        replica.status = RESTARTING
+        if replica.proc is not None and replica.proc.poll() is None:
+            try:
+                replica.proc.kill()
+                replica.proc.wait(timeout=10.0)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        if not self.restart_dead:
+            replica.status = DOWN
+            return
+        replica.restarts += 1
+        self._restarts_total.inc()
+        # Restart OFF the monitor thread: a replacement worker takes
+        # seconds to import and become ready, and the prober must keep
+        # watching the OTHER replicas meanwhile (a second simultaneous
+        # death must still be detected within the advertised bound).
+        # The status is already RESTARTING, so the monitor skips this
+        # slot until the restart thread resolves it to UP or DOWN.
+        threading.Thread(
+            target=self._restart, args=(replica,),
+            name=f"pydcop-fleet-restart-{replica.index}",
+            daemon=True).start()
+
+    def _restart(self, replica: Replica) -> None:
+        if self._stopping.is_set():
+            replica.status = DOWN
+            return
+        try:
+            # The journal handoff: --recover replays the dead
+            # worker's acknowledged-but-unfinished requests and open
+            # sessions through the fresh process.
+            self._spawn(replica, recover=True)
+            self._wait_ready(
+                replica,
+                time.monotonic() + self.worker_ready_timeout_s)
+        except Exception:  # noqa: BLE001
+            logger.exception("replica %d restart failed",
+                             replica.index)
+            replica.status = DOWN
+
+    # -- routing -------------------------------------------------------- #
+
+    def candidates(self) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.status == UP and not r.breaker_open]
+
+    def pick(self, digest: Optional[str]) -> Tuple[Replica, bool]:
+        """Choose the replica for one admission.  Returns
+        ``(replica, affinity_hit)``; raises :class:`FleetUnavailable`
+        when every replica is down or shedding."""
+        with self._lock:
+            live = self.candidates()
+            if not live:
+                self.shed += 1
+                self._routed_total.inc(outcome="shed")
+                raise FleetUnavailable(
+                    "no healthy replica available (all down or "
+                    "breaker-open)")
+            if self.affinity == "round_robin" or digest is None:
+                chosen = live[next(self._rr) % len(live)]
+                spilled = False
+            else:
+                ranked = sorted(
+                    live, key=lambda r: _rendezvous_score(
+                        digest, r.index),
+                    reverse=True)
+                chosen = ranked[0]
+                idlest = min(live, key=lambda r: r.in_flight)
+                spilled = (chosen.in_flight
+                           >= idlest.in_flight + self.spill_slack)
+                if spilled:
+                    # Hot-spot overflow: a structure-warm replica
+                    # deep in flight loses to the idlest one — the
+                    # cold compile there costs less than queueing
+                    # behind the backlog (and warms a second home for
+                    # the structure while it's hot).
+                    chosen = idlest
+                    self.spillovers += 1
+            hit = digest is not None and digest in chosen.warm
+            if digest is not None:
+                chosen.warm.add(digest)
+            chosen.in_flight += 1
+            chosen.forwarded += 1
+            self.routed += 1
+            if hit:
+                self.affinity_hits += 1
+        self._routed_total.inc(outcome="spillover" if spilled
+                               else "affinity" if hit else "routed")
+        if hit:
+            self._affinity_total.inc()
+        return chosen, hit
+
+    def release(self, replica: Replica) -> None:
+        with self._lock:
+            replica.in_flight = max(replica.in_flight - 1, 0)
+
+    def pin(self, request_id: str, replica: Replica,
+            table: Optional["OrderedDict[str, int]"] = None) -> None:
+        table = self._pins if table is None else table
+        with self._lock:
+            table[request_id] = replica.index
+            while len(table) > PIN_KEEP:
+                table.popitem(last=False)
+
+    def pinned(self, request_id: str,
+               table: Optional["OrderedDict[str, int]"] = None
+               ) -> Optional[Replica]:
+        table = self._pins if table is None else table
+        with self._lock:
+            index = table.get(request_id)
+        return self.replicas[index] if index is not None else None
+
+    def mark_forward_error(self, replica: Replica) -> None:
+        """A live forward failed at the socket: stop routing there
+        NOW; the heartbeat prober (or the process reaper) confirms
+        death and owns the restart."""
+        with self._lock:
+            replica.errors += 1
+            if replica.status == UP:
+                replica.status = DOWN
+
+    # -- plumbing ------------------------------------------------------- #
+
+    def _forward(self, replica: Replica, method: str, path: str,
+                 body: Optional[bytes],
+                 timeout: float = FORWARD_TIMEOUT_S
+                 ) -> Tuple[int, str, bytes]:
+        conn = http.client.HTTPConnection("127.0.0.1", replica.port,
+                                          timeout=timeout)
+        try:
+            headers = {}
+            if body is not None:
+                headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            return (resp.status,
+                    resp.getheader("Content-Type",
+                                   "application/json"),
+                    payload)
+        finally:
+            conn.close()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            routed = self.routed
+            hits = self.affinity_hits
+            doc = {
+                "replicas": self.n_replicas,
+                "up": self.up_count(),
+                "affinity": self.affinity,
+                "routed": routed,
+                "affinity_hits": hits,
+                "affinity_hit_fraction": (round(hits / routed, 4)
+                                          if routed else None),
+                "spillovers": self.spillovers,
+                "shed": self.shed,
+                "reroutes": self.reroutes,
+                "deaths": self.deaths,
+                "spill_slack": self.spill_slack,
+                "heartbeat_s": self.heartbeat_s,
+                "pinned_requests": len(self._pins),
+                "pinned_sessions": len(self._session_pins),
+                "workers": [r.summary() for r in self.replicas],
+            }
+        from pydcop_tpu.engine import aotcache
+
+        doc["compile_cache"] = (
+            {"dir": self.compile_cache_dir}
+            if self.compile_cache_dir else {"dir": None})
+        if aotcache.enabled():
+            doc["compile_cache"] = aotcache.stats()
+        return doc
+
+    def health_summary(self) -> Dict[str, Any]:
+        """The fleet /healthz: failing (503) only when NOTHING can
+        serve; degraded while any replica is down/restarting."""
+        up = self.up_count()
+        status = ("failing" if up == 0
+                  else "degraded" if up < self.n_replicas else "ok")
+        return {"status": status, "fleet": {
+            "replicas": self.n_replicas, "up": up,
+            "workers": [r.summary() for r in self.replicas],
+        }}
+
+
+class _RouterHandler(_Handler):
+    """The fleet's client-facing wire protocol — same routes as the
+    single-service front end (serving/http.py), implemented by
+    admission-time routing + forwarding."""
+
+    def _json(self, code: int, payload: Dict[str, Any],
+              close: bool = False):
+        self._reply(code, json.dumps(payload, default=str).encode(),
+                    "application/json", close=close)
+
+    def _read_body(self) -> Optional[bytes]:
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = 0
+        if length <= 0 or length > MAX_BODY_BYTES:
+            self._json(400, {"error": "body required (JSON, "
+                                      f"<= {MAX_BODY_BYTES} bytes)"},
+                       close=True)
+            return None
+        return self.rfile.read(length)
+
+    @property
+    def router(self) -> FleetRouter:
+        return self.telemetry.router
+
+    def _proxy(self, replica: Replica, method: str, path: str,
+               body: Optional[bytes],
+               timeout: float = FORWARD_TIMEOUT_S) -> None:
+        try:
+            status, ctype, payload = self.router._forward(
+                replica, method, path, body, timeout=timeout)
+        except OSError as exc:
+            self.router.mark_forward_error(replica)
+            self._json(503, {
+                "error": f"replica {replica.index} unreachable "
+                         f"({exc}); recovering — retry",
+                "status": "rejected", "retry": True})
+            return
+        self._reply(status, payload, ctype)
+
+    # -- request plane -------------------------------------------------- #
+
+    def do_POST(self):  # noqa: N802 — stdlib name
+        path = self.path.split("?", 1)[0]
+        if path == "/solve":
+            self._route_solve()
+        elif path == "/session":
+            self._route_session_open()
+        else:
+            self._json(404, {"error": "unknown path"}, close=True)
+
+    def _admission_key(self, raw: bytes
+                       ) -> Tuple[Optional[dict], Optional[str]]:
+        """Parse the body far enough to route: returns (body json,
+        affinity digest).  Malformed bodies get their 4xx HERE — the
+        router is the client's first contact and must speak the same
+        validation language as a worker."""
+        try:
+            body = json.loads(raw)
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as exc:
+            self._json(400, {"error": f"bad request body: {exc}"})
+            return None, None
+        yaml_src = body.get("dcop")
+        if not isinstance(yaml_src, str) or not yaml_src.strip():
+            self._json(400, {"error": "bad request body: body needs "
+                                      "a 'dcop' key holding the "
+                                      "problem as a dcop yaml string"})
+            return None, None
+        digest = None
+        try:
+            from pydcop_tpu.dcop.yamldcop import load_dcop
+            from pydcop_tpu.serving import binning
+
+            merged = dict(self.router.default_params)
+            merged.update(body.get("params") or {})
+            digest = binning.affinity_key(load_dcop(yaml_src),
+                                          merged)
+        except Exception as exc:  # noqa: BLE001 — malformed problem
+            self._json(400, {"error": f"bad problem: {exc}"})
+            return None, None
+        return body, digest
+
+    def _route_solve(self):
+        raw = self._read_body()
+        if raw is None:
+            return
+        body, digest = self._admission_key(raw)
+        if body is None:
+            return
+        # The router ALWAYS mints the id (a client-supplied one is
+        # ignored): worker-local counters collide across replicas,
+        # the pin table needs a fleet-unique handle before the worker
+        # ever answers, and an externally chosen id could clobber
+        # another request's pin — duplicate-id rejection is
+        # per-worker, so two replicas would happily accept the same
+        # spoofed id.
+        rid = f"f{uuid.uuid4().hex[:16]}"
+        body["request_id"] = rid
+        payload = json.dumps(body).encode()
+        tried: set = set()
+        while True:
+            try:
+                replica, _hit = self.router.pick(digest)
+            except FleetUnavailable as exc:
+                self._json(503, {"error": str(exc),
+                                 "status": "rejected", "retry": True})
+                return
+            if replica.index in tried:
+                # pick() charged this replica's in_flight; this exit
+                # path never forwards, so it must release here or the
+                # slot leaks and the spillover heuristic sees a
+                # permanently-busier replica.
+                self.router.release(replica)
+                self._json(503, {
+                    "error": "every healthy replica failed the "
+                             "forward; retry",
+                    "status": "rejected", "retry": True})
+                return
+            tried.add(replica.index)
+            self.router.pin(rid, replica)
+            try:
+                status, ctype, out = self.router._forward(
+                    replica, "POST", "/solve", payload)
+            except OSError:
+                # Nothing was acked by the worker: re-routing the
+                # identical body is safe (the id travels with it).
+                self.router.mark_forward_error(replica)
+                with self.router._lock:
+                    self.router.reroutes += 1
+                continue
+            finally:
+                self.router.release(replica)
+            self._reply(status, out, ctype)
+            return
+
+    # -- result / stats / sessions -------------------------------------- #
+
+    def do_GET(self):  # noqa: N802 — stdlib name
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/result/"):
+            rid = path[len("/result/"):]
+            replica = self.router.pinned(rid)
+            if replica is None:
+                self._json(404, {"error": f"unknown request {rid!r}"})
+                return
+            if replica.status != UP:
+                self._json(503, {
+                    "error": f"replica {replica.index} recovering; "
+                             "retry", "retry": True})
+                return
+            self._proxy(replica, "GET", path, None, timeout=30.0)
+        elif path.startswith("/session/"):
+            sid = path[len("/session/"):].split("/", 1)[0]
+            replica = self.router.pinned(
+                sid, self.router._session_pins)
+            if replica is None:
+                self._json(404, {"error": f"unknown session {sid!r}"})
+                return
+            if path.endswith("/events"):
+                self._proxy_sse(replica, path)
+            else:
+                self._proxy(replica, "GET", path, None, timeout=30.0)
+        elif path == "/stats":
+            self._fleet_stats()
+        else:
+            super().do_GET()
+
+    def _fleet_stats(self):
+        """Router stats + a live per-worker /stats fetch: ONE surface
+        that answers both "how is traffic spread" and "what is each
+        replica doing"."""
+        doc = self.router.stats()
+        for worker in doc["workers"]:
+            replica = self.router.replicas[worker["index"]]
+            if replica.status != UP:
+                continue
+            try:
+                status, _ctype, body = self.router._forward(
+                    replica, "GET", "/stats", None, timeout=10.0)
+                if status == 200:
+                    worker["stats"] = json.loads(body)
+            except (OSError, ValueError):
+                pass
+        self._json(200, doc)
+
+    def _route_session_open(self):
+        raw = self._read_body()
+        if raw is None:
+            return
+        body, digest = self._admission_key(raw)
+        if body is None:
+            return
+        try:
+            replica, _hit = self.router.pick(digest)
+        except FleetUnavailable as exc:
+            self._json(503, {"error": str(exc), "status": "rejected",
+                             "retry": True})
+            return
+        try:
+            status, ctype, out = self.router._forward(
+                replica, "POST", "/session", json.dumps(body).encode())
+        except OSError as exc:
+            self.router.mark_forward_error(replica)
+            self._json(503, {"error": f"replica unreachable ({exc}); "
+                                      "retry", "retry": True})
+            return
+        finally:
+            self.router.release(replica)
+        if status == 201:
+            try:
+                sid = json.loads(out).get("session_id")
+                if sid:
+                    # Sessions are stateful: every later PATCH/GET/
+                    # DELETE must land on the replica holding the
+                    # warm engine.
+                    self.router.pin(sid, replica,
+                                    self.router._session_pins)
+            except ValueError:
+                pass
+        self._reply(status, out, ctype)
+
+    def _session_replica(self, path: str) -> Optional[Replica]:
+        sid = path[len("/session/"):].split("/", 1)[0]
+        replica = self.router.pinned(sid, self.router._session_pins)
+        if replica is None:
+            self._json(404, {"error": f"unknown session {sid!r}"},
+                       close=True)
+            return None
+        return replica
+
+    def do_PATCH(self):  # noqa: N802 — stdlib name
+        path = self.path.split("?", 1)[0]
+        if not (path.startswith("/session/")
+                and path.endswith("/events")):
+            self._json(404, {"error": "unknown path"}, close=True)
+            return
+        raw = self._read_body()
+        if raw is None:
+            return
+        replica = self._session_replica(path)
+        if replica is not None:
+            self._proxy(replica, "PATCH", path, raw)
+
+    def do_DELETE(self):  # noqa: N802 — stdlib name
+        path = self.path.split("?", 1)[0]
+        if not path.startswith("/session/"):
+            self._json(404, {"error": "unknown path"}, close=True)
+            return
+        replica = self._session_replica(path)
+        if replica is not None:
+            self._proxy(replica, "DELETE", path, None)
+
+    def _proxy_sse(self, replica: Replica, path: str):
+        """Stream a worker's per-session SSE through: chunks are
+        relayed as they arrive until either side closes."""
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", replica.port, timeout=FORWARD_TIMEOUT_S)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+        except OSError as exc:
+            self._json(503, {"error": f"replica unreachable ({exc})"})
+            return
+        if resp.status != 200:
+            self._reply(resp.status, resp.read(),
+                        resp.getheader("Content-Type",
+                                       "application/json"))
+            conn.close()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            while not self.telemetry._stopping.is_set():
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                self.wfile.write(chunk)
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # either side went away — normal SSE termination
+        finally:
+            conn.close()
+
+
+class RouterFrontEnd(TelemetryServer):
+    """The fleet's single client-facing HTTP server.  Mounts the
+    router wire protocol over the telemetry routes; while running,
+    the fleet health summary feeds the process-wide /healthz
+    provider (zero live replicas → 503, like a single service's open
+    breaker)."""
+
+    handler_class = _RouterHandler
+
+    def __init__(self, router: FleetRouter, port: int = 0,
+                 host: str = "127.0.0.1", registry=None):
+        super().__init__(port=port, host=host, registry=registry)
+        self.router = router
+        self._prior_provider = None
+
+    def start(self) -> "RouterFrontEnd":
+        super().start()
+        self._prior_provider = get_health_provider()
+        set_health_provider(self.router.health_summary)
+        return self
+
+    def stop(self):
+        set_health_provider(self._prior_provider)
+        self._prior_provider = None
+        super().stop()

@@ -7,6 +7,7 @@ localhost ports.
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -18,6 +19,89 @@ ENV = {
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
 }
 FIXTURE = os.path.join(INSTANCES, "coloring_4agents_10vars.yaml")
+
+# The orchestrator binds ``port`` and the agent process binds
+# ``port+1 .. port+n_agents`` — a CONTIGUOUS block.  Fixed ports
+# (19340/19480 historically) flake on warm reruns: the previous run's
+# sockets linger in TIME_WAIT, the agent process dies with
+# EADDRINUSE, and the orchestrator then times out on an empty
+# directory.  ``_free_port_block`` probes OS-chosen candidates until a
+# whole block binds, and ``_run_orchestrated`` retries the spawn when
+# the (tiny) pick-to-bind race still loses.
+PORT_BLOCK = 5
+
+
+def _free_port_block(n: int = PORT_BLOCK, attempts: int = 50) -> int:
+    """A base port p such that p..p+n-1 all bind right now."""
+    for _ in range(attempts):
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            base = probe.getsockname()[1]
+        if base + n >= 65536:
+            continue
+        held = []
+        try:
+            for offset in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + offset))
+                held.append(s)
+        except OSError:
+            continue
+        finally:
+            for s in held:
+                s.close()
+        return base
+    raise RuntimeError(f"no free block of {n} ports found")
+
+
+def _run_orchestrated(agent_args, orch_args, orch_timeout,
+                      agent_wait, attempts: int = 3):
+    """Spawn the agent process on a fresh port block, run the
+    orchestrator against it, retry both ONLY on an EADDRINUSE loser
+    (the agent dying on startup, or the orchestrator reporting the
+    bind error) — any other orchestrator failure is a real failure
+    and raises immediately, stderr attached."""
+    last_error = None
+    for _ in range(attempts):
+        port = _free_port_block()
+        agent_proc = subprocess.Popen(
+            [sys.executable, "-m", "pydcop_tpu.dcop_cli",
+             *agent_args(port)],
+            env=ENV, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            time.sleep(0.5)
+            if agent_proc.poll() is not None:
+                # Lost the pick-to-bind race: a fresh block, again.
+                last_error = RuntimeError(
+                    f"agent process died on startup (exit "
+                    f"{agent_proc.returncode}, base port {port})")
+                continue
+            proc = subprocess.run(
+                [sys.executable, "-m", "pydcop_tpu.dcop_cli",
+                 *orch_args(port)],
+                timeout=orch_timeout, env=ENV,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            if proc.returncode != 0:
+                stderr = proc.stderr.decode(errors="replace")
+                if "Address already in use" not in stderr:
+                    raise AssertionError(
+                        f"orchestrator failed (exit "
+                        f"{proc.returncode}), not a port race:\n"
+                        f"{stderr[-1500:]}")
+                last_error = RuntimeError(
+                    f"orchestrator lost the port race on {port}")
+                continue
+            result = json.loads(proc.stdout)
+            # Agents exit once the orchestrator stops them.
+            assert agent_proc.wait(timeout=agent_wait) == 0
+            return result
+        finally:
+            if agent_proc.poll() is None:
+                agent_proc.kill()
+    raise last_error
 
 
 def test_solve_mode_process():
@@ -34,30 +118,18 @@ def test_solve_mode_process():
 
 
 def test_orchestrator_and_agent_commands(tmp_path):
-    port = 19340
-    agent_proc = subprocess.Popen(
-        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "40",
-         "agent", "-n", "a1", "a2", "a3", "a4",
-         "-o", f"127.0.0.1:{port}", "-p", str(port + 1),
-         "--capacity", "100"],
-        env=ENV, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    result = _run_orchestrated(
+        agent_args=lambda port: [
+            "-t", "40", "agent", "-n", "a1", "a2", "a3", "a4",
+            "-o", f"127.0.0.1:{port}", "-p", str(port + 1),
+            "--capacity", "100"],
+        orch_args=lambda port: [
+            "-t", "4", "orchestrator", "-a", "dsa", "-d", "adhoc",
+            "--port", str(port), FIXTURE],
+        orch_timeout=120, agent_wait=30,
     )
-    try:
-        time.sleep(0.5)
-        out = subprocess.check_output(
-            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "4",
-             "orchestrator", "-a", "dsa", "-d", "adhoc",
-             "--port", str(port), FIXTURE],
-            timeout=120, env=ENV, stderr=subprocess.DEVNULL,
-        )
-        result = json.loads(out)
-        assert result["backend"] == "multi-machine"
-        assert len(result["assignment"]) == 10
-        # Agents exit once the orchestrator stops them.
-        assert agent_proc.wait(timeout=30) == 0
-    finally:
-        if agent_proc.poll() is None:
-            agent_proc.kill()
+    assert result["backend"] == "multi-machine"
+    assert len(result["assignment"]) == 10
 
 
 def test_solve_mode_process_maxsum():
@@ -102,36 +174,24 @@ def test_orchestrator_scenario_repair_over_http(tmp_path):
     scenario that removes agent a1 mid-run, 2-replication, repair over
     real HTTP transports — the full reference resilience flow
     (orchestrator.py:955-1178) end to end."""
-    port = 19480
     scenario = os.path.join(
         os.path.dirname(__file__), "..", "instances",
         "scenario_remove_a1.yaml")
-    agent_proc = subprocess.Popen(
-        [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "90",
-         "agent", "-n", "a1", "a2", "a3", "a4",
-         "-o", f"127.0.0.1:{port}", "-p", str(port + 1),
-         "--capacity", "100", "--replication"],
-        env=ENV, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    result = _run_orchestrated(
+        agent_args=lambda port: [
+            "-t", "90", "agent", "-n", "a1", "a2", "a3", "a4",
+            "-o", f"127.0.0.1:{port}", "-p", str(port + 1),
+            "--capacity", "100", "--replication"],
+        orch_args=lambda port: [
+            "-t", "15", "orchestrator", "-a", "dsa", "-d", "adhoc",
+            "-k", "2", "-s", scenario, "--port", str(port), FIXTURE],
+        orch_timeout=120, agent_wait=45,
     )
-    try:
-        time.sleep(0.5)
-        out = subprocess.check_output(
-            [sys.executable, "-m", "pydcop_tpu.dcop_cli", "-t", "15",
-             "orchestrator", "-a", "dsa", "-d", "adhoc",
-             "-k", "2", "-s", scenario, "--port", str(port),
-             FIXTURE],
-            timeout=120, env=ENV, stderr=subprocess.DEVNULL,
-        )
-        result = json.loads(out)
-        assert result["backend"] == "multi-machine"
-        # All 10 variables still assigned despite a1's departure.
-        assert len(result["assignment"]) == 10
-        replication = result["replication"]
-        assert replication["ktarget"] == 2
-        # a1 hosted computations; they must have been repaired onto
-        # surviving agents.
-        assert replication["repaired"]
-        assert agent_proc.wait(timeout=45) == 0
-    finally:
-        if agent_proc.poll() is None:
-            agent_proc.kill()
+    assert result["backend"] == "multi-machine"
+    # All 10 variables still assigned despite a1's departure.
+    assert len(result["assignment"]) == 10
+    replication = result["replication"]
+    assert replication["ktarget"] == 2
+    # a1 hosted computations; they must have been repaired onto
+    # surviving agents.
+    assert replication["repaired"]

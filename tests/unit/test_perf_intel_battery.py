@@ -64,14 +64,19 @@ import bench_sentinel  # noqa: E402  (tools/ is not a package)
 
 @pytest.fixture(autouse=True)
 def _clean_observability():
-    """Tracer off, profiler off+cleared, health provider cleared
-    around every test."""
+    """Tracer off, profiler off+cleared, health provider cleared,
+    registry inactive around every test.  The registry flag is
+    NORMALIZED to False at setup (not just restored at teardown):
+    a battery that ran earlier in the process and leaked
+    ``active=True`` — any started-service crash simulation can —
+    must not change what this battery's tests observe."""
     tracer.disable()
     tracer.clear()
     profiler.enabled = False
     profiler.clear()
     set_health_provider(None)
     was_active = global_registry.active
+    global_registry.active = False
     yield
     tracer.disable()
     tracer.clear()

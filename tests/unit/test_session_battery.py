@@ -136,6 +136,27 @@ def _exact_cost(engine) -> float:
     return api.solve(_mutated_dcop(engine), "dpop")["cost"]
 
 
+@pytest.fixture(autouse=True)
+def _restore_observability_flags():
+    """The crash-simulation tests kill a started service's scheduler
+    directly (no ``stop()``) — exactly how a real crash looks, but
+    ``SolveService.start()`` latches ``metrics_registry.active`` and
+    ``profiler.enabled`` process-wide and only ``stop()`` restores
+    them.  Without this restore the flags leak ``True`` into every
+    battery that runs after this one (test_perf_intel_battery's
+    session-leak test was the first casualty)."""
+    from pydcop_tpu.observability.metrics import (
+        registry as global_registry,
+    )
+    from pydcop_tpu.observability.profiler import profiler
+
+    was_active = global_registry.active
+    was_profiling = profiler.enabled
+    yield
+    global_registry.active = was_active
+    profiler.enabled = was_profiling
+
+
 def _service(**kw) -> SolveService:
     kw.setdefault("batch_window_s", 0.02)
     kw.setdefault("max_batch", 8)

@@ -140,6 +140,16 @@ def load_history(root: str) -> List[Dict[str, Any]]:
                 parsed.get("session_time_to_recovered_cost_ms")),
             "session_eps_value": _opt_float(
                 parsed.get("session_events_per_sec")),
+            # Fleet-serving legs (ISSUE 15 bench_serving_fleet /
+            # bench_serve_cold_start): aggregate problems/sec through
+            # 2 router-fronted worker replicas, and the fresh-worker
+            # warm-disk-cache time-to-first-result (s, LOWER is
+            # better) — absent before PR 15, None when the leg failed
+            # that round.
+            "fleet_value": _opt_float(
+                parsed.get("fleet_problems_per_sec_r2")),
+            "cold_start_value": _opt_float(
+                parsed.get("serve_cold_start_warm_s")),
             # The p99 latency exemplar from the serving leg (ISSUE
             # 9): when the newest run regresses, the report points at
             # a concrete request trace instead of a bare number.
@@ -278,6 +288,15 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
          "time_to_cost"),
         ("serve_recovery", "serve_recovery_value", "s",
          "backend", False, "serve_recovery"),
+        # ISSUE 15: the fleet-scale serving families — aggregate
+        # replicas=2 throughput through the structure-affinity
+        # router (higher is better) and a fresh worker's warm-cache
+        # time-to-first-result (the persistent AOT compile cache's
+        # reason to exist; lower is better).
+        ("serving_fleet", "fleet_value", "problems/s",
+         "backend", True, "serving_fleet"),
+        ("serve_cold_start", "cold_start_value", "s",
+         "backend", False, "serve_cold_start"),
         ("shard_recovery", "shard_recovery_value", "s",
          "sharded_backend", False, "sharded"),
         # ISSUE 13: the stateful-session families — sustained

@@ -45,6 +45,7 @@ flight-recorder bundle whose tail holds the triggering instant).
 
 import argparse
 import os
+import shutil
 import sys
 import tempfile
 import time
@@ -712,6 +713,102 @@ def scenario_shard_trip_repartition(seed, trace):
             "shard_recovery_s": m["shard_recovery_s"]}
 
 
+def scenario_replica_kill(seed, trace):
+    """ISSUE 15: SIGKILL one of two fleet replicas mid-burst.  Every
+    202-acked request must complete through the router — the survivors
+    keep serving while the dead replica's journal segment is handed to
+    its restarted replacement and replayed — zero acknowledged
+    requests lost, and the fleet SIGTERM-drains clean (every worker
+    exit 0)."""
+    import json
+    import signal as signal_mod
+    import urllib.error
+    import urllib.request
+
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    journal_dir = tempfile.mkdtemp(prefix="soak_fleet_")
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.25,
+                       max_batch=8, journal_dir=journal_dir,
+                       heartbeat_s=0.15)
+    try:
+        url = handle.url
+
+        def post(payload):
+            req = urllib.request.Request(
+                url + "/solve", data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read())
+
+        acked, dcops = [], {}
+        for i in range(10):
+            dcop = _serve_instance(10, seed * 1000 + i)
+            status, body = post({"dcop": dcop_yaml(dcop),
+                                 "params": {"max_cycles": 150}})
+            assert status == 202, f"burst request {i}: {status}"
+            acked.append(body["id"])
+            dcops[body["id"]] = dcop
+        # Mid-burst: batch windows still open on both replicas.
+        victim = handle.router.replicas[seed % 2]
+        os.kill(victim.proc.pid, signal_mod.SIGKILL)
+
+        # The survivors must keep admitting DURING the recovery.
+        extra = _serve_instance(10, seed * 1000 + 99)
+        status, body = post({"dcop": dcop_yaml(extra),
+                             "params": {"max_cycles": 150}})
+        assert status in (200, 202, 503), \
+            f"router wedged during replica death ({status})"
+        if status == 202:
+            acked.append(body["id"])
+            dcops[body["id"]] = extra
+
+        done = {}
+        deadline = time.monotonic() + 120
+        while len(done) < len(acked) \
+                and time.monotonic() < deadline:
+            for rid in acked:
+                if rid in done:
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                            url + f"/result/{rid}",
+                            timeout=10) as resp:
+                        if resp.status == 200:
+                            done[rid] = json.loads(resp.read())
+                except (urllib.error.HTTPError, OSError):
+                    pass
+            time.sleep(0.1)
+        lost = sorted(set(acked) - set(done))
+        assert not lost, \
+            f"{len(lost)} acked request(s) lost to the SIGKILL: " \
+            f"{lost}"
+        assert all(r["status"] == "FINISHED"
+                   for r in done.values()), \
+            {k: v["status"] for k, v in done.items()
+             if v["status"] != "FINISHED"}
+        for rid in acked[:2]:
+            assert_valid_assignment(dcops[rid],
+                                    done[rid]["assignment"])
+        assert victim.restarts == 1, \
+            f"victim restarted {victim.restarts} times, wanted 1"
+        stats = handle.router.stats()
+        assert stats["deaths"] == 1 and stats["up"] == 2
+    finally:
+        summary = handle.stop()
+        shutil.rmtree(journal_dir, ignore_errors=True)
+    exits = [w["exit"] for w in summary["workers"]]
+    assert exits == [0, 0], \
+        f"fleet SIGTERM drain not clean: exits {exits}"
+    return {"acked": len(acked), "completed": len(done),
+            "victim": victim.index,
+            "deaths": stats["deaths"]}
+
+
 def scenario_anomaly_postmortem(seed, trace):
     """ISSUE 9 anomaly path: an injected guard trip, with file
     tracing OFF and only the always-on flight recorder attached,
@@ -779,6 +876,7 @@ SCENARIOS = [
     ("serve_journal_replay", scenario_serve_journal_replay),
     ("session_replay", scenario_session_replay),
     ("serve_poison_bin", scenario_serve_poison_bin),
+    ("replica_kill", scenario_replica_kill),
     ("shard_trip_repartition", scenario_shard_trip_repartition),
     ("anomaly_postmortem", scenario_anomaly_postmortem),
     ("decimation_guard_trip", scenario_decimation_guard_trip),
@@ -799,6 +897,7 @@ QUICK_GATE = [
     "serve_journal_replay",
     "session_replay",
     "serve_poison_bin",
+    "replica_kill",
     "shard_trip_repartition",
     "anomaly_postmortem",
     "decimation_guard_trip",

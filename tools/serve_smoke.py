@@ -44,6 +44,10 @@ Starts the real service on port 0 and drives it over HTTP:
    ``useful_work_fraction`` + attainment rollups are visible in
    ``/stats``, ``/metrics`` (backend-labeled), ``/profile`` and
    ``pydcop profile report --url`` (the real CLI).
+8. **2-replica fleet burst** (ISSUE 15 acceptance): a mixed-structure
+   burst against a real 2-worker fleet behind the structure-affinity
+   router answers every request bit-identical to solo ``api.solve``,
+   with affinity accounting on /stats and a clean whole-fleet drain.
 
 Run:  python tools/serve_smoke.py      (exit 0 = all claims hold)
 """
@@ -465,6 +469,71 @@ def leg_overload():
               f"{OVERLOAD_BURST})")
     finally:
         handle.stop()
+
+
+FLEET_BURST = 10
+
+
+def leg_fleet_burst():
+    """ISSUE 15 acceptance: a concurrent mixed-structure burst
+    against a REAL 2-replica fleet (worker subprocesses behind the
+    structure-affinity router) must answer every request
+    bit-identical to solo ``api.solve`` — the fleet is wire-invisible
+    — with both replicas carrying traffic, affinity accounting on
+    /stats, and a clean whole-fleet drain (every worker exit 0)."""
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.1,
+                       max_batch=8, heartbeat_s=0.2)
+    try:
+        url = handle.url
+        dcops = ([build_instance(10, 600 + s) for s in range(5)]
+                 + [build_instance(14, 650 + s) for s in range(5)])
+        payloads = [dcop_yaml(d) for d in dcops]
+        results = [None] * len(dcops)
+
+        def client(i):
+            results[i] = post(url, {
+                "dcop": payloads[i], "wait": True, "timeout": 120,
+                "params": {"max_cycles": MAX_CYCLES},
+            })
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(dcops))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        check(all(r is not None and r[0] == 200
+                  and r[1]["status"] == "FINISHED" for r in results),
+              f"all {len(dcops)} fleet-burst responses finished")
+        for dcop, (_, res) in zip(dcops, results):
+            solo = api.solve(dcop, "maxsum", backend="device",
+                             max_cycles=MAX_CYCLES)
+            if res["assignment"] != solo["assignment"] \
+                    or res["cost"] != solo["cost"]:
+                check(False,
+                      f"fleet answer for {dcop.name} differs from "
+                      "solo api.solve")
+        check(True, f"all {len(dcops)} fleet answers bit-identical "
+              "to solo api.solve")
+        with urllib.request.urlopen(url + "/stats",
+                                    timeout=30) as resp:
+            stats = json.loads(resp.read())
+        check(stats["up"] == 2, "both replicas up through the burst")
+        loads = [w["forwarded"] for w in stats["workers"]]
+        check(all(n > 0 for n in loads),
+              f"both replicas carried traffic ({loads})")
+        check(stats["affinity_hit_fraction"] is not None
+              and stats["affinity_hit_fraction"] > 0,
+              "affinity accounting on /stats (hit fraction "
+              f"{stats['affinity_hit_fraction']})")
+    finally:
+        summary = handle.stop()
+    check([w["exit"] for w in summary["workers"]] == [0, 0],
+          "fleet drain: every worker exited 0 "
+          f"({summary['workers']})")
 
 
 KILL9_BURST = 10
@@ -894,6 +963,7 @@ def main() -> int:
     leg_mixed_envelope()
     leg_efficiency()
     leg_overload()
+    leg_fleet_burst()
     leg_kill9_replay()
     leg_session_replay()
     leg_sigterm_drain()
