@@ -1295,6 +1295,325 @@ def bench_serving_fleet():
     return out
 
 
+# Elastic-fleet leg (ISSUE 16): one two-host fleet (socket-distinct
+# replica processes striped over simulated host identities) driven
+# through four phases — baseline throughput, live session migration
+# with hard cost parity, a 4x closed-loop traffic step against the
+# SLO autoscaler, and a host kill mid-burst that must lose zero
+# acknowledged requests or session events.  Sentinel family
+# "fleet_elastic" (the baseline problems/sec).
+FLEET_ELASTIC_N_VARS = 24
+FLEET_ELASTIC_POOL = 4
+FLEET_ELASTIC_MAX_CYCLES = 60
+FLEET_ELASTIC_BASE_CLIENTS = 3
+FLEET_ELASTIC_STEP_CLIENTS = 12      # the 4x traffic step
+FLEET_ELASTIC_WARM_S = 2.0
+FLEET_ELASTIC_PHASE_S = 4.0
+FLEET_ELASTIC_SETTLE_S = 5.0         # autoscale reaction window
+FLEET_ELASTIC_BURST = 12
+# Session params through the router: admission validates them, so
+# only solver keys (no session-only knobs like segment_cycles).
+FLEET_ELASTIC_SESSION_PARAMS = {
+    "noise": 0.01, "stability": 0.001, "max_cycles": 500}
+
+
+def _fleet_req(url, method="GET", payload=None, timeout=60):
+    import urllib.error
+    import urllib.request
+
+    data = (json.dumps(payload).encode()
+            if payload is not None else None)
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def _elastic_session_problem(seed: int, n_batches: int):
+    """A 10-variable integer-table path problem, its event batches,
+    and the UNINTERRUPTED reference cost (warm engine, every batch
+    applied in-process) — migration parity is judged by hard
+    equality against this."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.engine.dynamic import build_dynamic_engine
+    from pydcop_tpu.serving.sessions import apply_event_batch
+
+    rng = np.random.default_rng(seed)
+    dom = Domain("c", "", [0, 1, 2])
+    dcop = DCOP(f"elastic{seed}", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(10)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(9):
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[k], vs[k + 1]],
+            rng.integers(0, 10, size=(3, 3)).astype(float), f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    batches = [
+        [{"type": "change_factor",
+          "name": f"c{int(rng.integers(9))}",
+          "table": rng.integers(0, 10, size=(3, 3))
+                      .astype(float).tolist()}]
+        for _ in range(n_batches)
+    ]
+    params = dict(FLEET_ELASTIC_SESSION_PARAMS)
+    ref = build_dynamic_engine(dcop, params)
+    ref.run(max_cycles=params["max_cycles"])
+    for batch in batches:
+        _asg, _trace, err = apply_event_batch(ref, batch)
+        if err is not None:
+            raise RuntimeError(f"reference event failed: {err}")
+        ref.run(max_cycles=params["max_cycles"])
+    expected = ref.cost(
+        ref.run(max_cycles=params["max_cycles"]).assignment)
+    return dcop_yaml(dcop), batches, expected
+
+
+def _elastic_patch_acked(url, sid, batch, deadline_s=120.0):
+    """PATCH until the batch is acked: 409 (frozen mid-migration)
+    and 503 (owner recovering) are the fleet saying retry."""
+    deadline = time.perf_counter() + deadline_s
+    while True:
+        status, out = _fleet_req(
+            url + f"/session/{sid}/events", "PATCH",
+            {"events": batch, "wait": True, "timeout": 30.0})
+        if status == 200:
+            return out
+        if status not in (409, 503) \
+                or time.perf_counter() > deadline:
+            raise RuntimeError(f"PATCH not acked: {status} {out}")
+        time.sleep(0.2)
+
+
+def _elastic_close_session(url, sid, deadline_s=120.0):
+    deadline = time.perf_counter() + deadline_s
+    while time.perf_counter() < deadline:
+        status, st = _fleet_req(url + f"/session/{sid}")
+        if status == 200:
+            last = st.get("last")
+            if last and last.get("converged"):
+                break
+        time.sleep(0.05)
+    status, final = _fleet_req(url + f"/session/{sid}", "DELETE")
+    if status != 200:
+        raise RuntimeError(f"session close failed: {status} {final}")
+    return final
+
+
+def bench_fleet_elastic():
+    """Elastic two-host fleet under churn.  Emits
+    ``fleet_elastic_problems_per_sec`` (baseline closed-loop
+    throughput — the sentinel value), migration cost parity
+    (``fleet_elastic_migrate_cost_ok``), the 4x-step p99 ratio vs
+    baseline with autoscaler reaction
+    (``fleet_elastic_p99_ratio`` / ``fleet_elastic_scale_ups``), and
+    the host-kill ledger (``fleet_elastic_lost`` — MUST be 0,
+    ``fleet_elastic_session_events_ok``).  None-valued on failure —
+    never kills the headline."""
+    import shutil
+    import tempfile
+    import threading
+
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving.router import FleetRouter, RouterFrontEnd
+
+    pool = [dcop_yaml(build_dcop_small(FLEET_ELASTIC_N_VARS, seed))
+            for seed in range(FLEET_ELASTIC_POOL)]
+    params = {"max_cycles": FLEET_ELASTIC_MAX_CYCLES}
+    worker_args = ["--batch_window", "0.005", "--max_batch", "16",
+                   "--max_queue", "512",
+                   "--cycles", str(FLEET_ELASTIC_MAX_CYCLES)]
+    journal_dir = tempfile.mkdtemp(prefix="bench_elastic_jnl_")
+    cache_dir = tempfile.mkdtemp(prefix="bench_elastic_aot_")
+    router = FleetRouter(
+        replicas=2, worker_args=worker_args,
+        journal_dir=journal_dir, compile_cache_dir=cache_dir,
+        hosts=2, min_replicas=2, max_replicas=4,
+        autoscale_interval_s=1.0, heartbeat_s=0.2).start()
+    front = RouterFrontEnd(router, port=0).start()
+    url = front.url
+    out = {}
+    try:
+        lock = threading.Lock()
+        state = {"t_end": 0.0}
+
+        def drive(n_clients, duration, record):
+            completed = [0]
+            latencies = []
+
+            def client(idx):
+                rng = np.random.default_rng(8100 + idx)
+                while time.perf_counter() < state["t_end"]:
+                    payload = pool[int(rng.integers(len(pool)))]
+                    t0 = time.perf_counter()
+                    status, body = _fleet_post(url, {
+                        "dcop": payload, "wait": True,
+                        "timeout": 60, "params": params})
+                    t1 = time.perf_counter()
+                    if record and status == 200 \
+                            and body.get("status") == "FINISHED":
+                        with lock:
+                            latencies.append(t1 - t0)
+                            completed[0] += 1
+
+            state["t_end"] = time.perf_counter() + duration
+            t_start = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=duration + 120)
+            elapsed = time.perf_counter() - t_start
+            if not record or not completed[0] or elapsed <= 0:
+                return None
+            lat_ms = np.asarray(latencies) * 1e3
+            return {
+                "pps": round(completed[0] / elapsed, 2),
+                "p50": round(float(np.percentile(lat_ms, 50)), 2),
+                "p99": round(float(np.percentile(lat_ms, 99)), 2),
+                "requests": completed[0],
+            }
+
+        # Phase A — baseline throughput/latency on the 2-host floor.
+        drive(FLEET_ELASTIC_BASE_CLIENTS, FLEET_ELASTIC_WARM_S,
+              record=False)
+        base = drive(FLEET_ELASTIC_BASE_CLIENTS,
+                     FLEET_ELASTIC_PHASE_S, record=True)
+        if base is None:
+            return {"fleet_elastic_problems_per_sec": None,
+                    "fleet_elastic_error":
+                        "baseline produced no completions"}
+        out["fleet_elastic_problems_per_sec"] = base["pps"]
+        out["fleet_elastic_p50_ms"] = base["p50"]
+        out["fleet_elastic_p99_ms"] = base["p99"]
+        out["fleet_elastic_requests"] = base["requests"]
+
+        # Phase B — live migration with hard cost parity: the
+        # migrated session must finish at EXACTLY the uninterrupted
+        # reference cost on integer tables.
+        yaml_a, batches_a, expected_a = \
+            _elastic_session_problem(4201, 4)
+        status, body = _fleet_req(
+            url + "/session", "POST",
+            {"dcop": yaml_a,
+             "params": FLEET_ELASTIC_SESSION_PARAMS})
+        if status != 201:
+            raise RuntimeError(
+                f"session open failed: {status} {body}")
+        sid = body["session_id"]
+        for batch in batches_a[:2]:
+            _elastic_patch_acked(url, sid, batch)
+        src = router.pinned(sid, router._session_pins)
+        status, body = _fleet_req(url + "/admin/migrate", "POST",
+                                  {"session_id": sid})
+        dst = router.pinned(sid, router._session_pins)
+        moved = (status == 200 and src is not None
+                 and dst is not None and dst.index != src.index)
+        for batch in batches_a[2:]:
+            _elastic_patch_acked(url, sid, batch)
+        final = _elastic_close_session(url, sid)
+        out["fleet_elastic_migrate_cost_ok"] = bool(
+            moved and final.get("cost") == expected_a)
+        out["fleet_elastic_migrations"] = router.migrations
+
+        # Phase C — 4x traffic step against the autoscaler.  The SLO
+        # is pegged to the measured baseline (armed only now, so the
+        # baseline itself ran on the fixed floor), the settle window
+        # gives the control loop time to spawn, and the recorded
+        # window judges the post-reaction p99.
+        router.slo_p99_ms = max(1.5 * base["p99"], 25.0)
+        out["fleet_elastic_slo_p99_ms"] = round(
+            router.slo_p99_ms, 2)
+        drive(FLEET_ELASTIC_STEP_CLIENTS, FLEET_ELASTIC_SETTLE_S,
+              record=False)
+        step = drive(FLEET_ELASTIC_STEP_CLIENTS,
+                     FLEET_ELASTIC_PHASE_S, record=True)
+        out["fleet_elastic_scale_ups"] = router.scale_ups
+        out["fleet_elastic_replicas_after_step"] = router.up_count()
+        if step is not None:
+            out["fleet_elastic_step_p99_ms"] = step["p99"]
+            ratio = (step["p99"] / base["p99"]
+                     if base["p99"] > 0 else None)
+            out["fleet_elastic_p99_ratio"] = (
+                round(ratio, 3) if ratio is not None else None)
+            out["fleet_elastic_p99_within_2x"] = bool(
+                ratio is not None and ratio <= 2.0)
+        # Freeze the fleet size for the kill phase: a concurrent
+        # scale-down would blur whose journal replays what.
+        router.slo_p99_ms = None
+
+        # Phase D — host kill mid-burst.  Every 202 and every acked
+        # event batch is a durability promise; killing the host that
+        # owns the warm session (both its replica processes) must
+        # lose none of them.
+        yaml_b, batches_b, expected_b = \
+            _elastic_session_problem(4301, 3)
+        status, body = _fleet_req(
+            url + "/session", "POST",
+            {"dcop": yaml_b,
+             "params": FLEET_ELASTIC_SESSION_PARAMS})
+        if status != 201:
+            raise RuntimeError(
+                f"session open failed: {status} {body}")
+        sid_b = body["session_id"]
+        for batch in batches_b[:2]:
+            _elastic_patch_acked(url, sid_b, batch)
+        pinned = router.pinned(sid_b, router._session_pins)
+        victim_host = pinned.host_id if pinned else "host0"
+        acked = []
+        for k in range(FLEET_ELASTIC_BURST):
+            status, body = _fleet_post(url, {
+                "dcop": pool[k % len(pool)], "params": params})
+            if status == 202:
+                acked.append(body["id"])
+        t_kill = time.perf_counter()
+        victims = [r for r in router.replicas
+                   if r.host_id == victim_host and r.managed
+                   and not r.retired and r.proc is not None
+                   and r.proc.poll() is None]
+        for r in victims:
+            r.proc.kill()
+        out["fleet_elastic_burst_acked"] = len(acked)
+        out["fleet_elastic_host_killed"] = len(victims)
+        remaining = set(acked)
+        deadline = time.perf_counter() + 180.0
+        while remaining and time.perf_counter() < deadline:
+            for rid in list(remaining):
+                status, body = _fleet_req(url + f"/result/{rid}")
+                if status == 200 \
+                        and body.get("status") == "FINISHED":
+                    remaining.discard(rid)
+            if remaining:
+                time.sleep(0.25)
+        out["fleet_elastic_lost"] = len(remaining)
+        out["fleet_elastic_kill_recover_s"] = round(
+            time.perf_counter() - t_kill, 2)
+        # The acked events survived iff the next batch lands as seq 3
+        # and the session still converges to the reference cost.
+        ack3 = _elastic_patch_acked(url, sid_b, batches_b[2],
+                                    deadline_s=180.0)
+        final_b = _elastic_close_session(url, sid_b,
+                                         deadline_s=180.0)
+        out["fleet_elastic_session_events_ok"] = bool(
+            ack3.get("seq") == 3
+            and final_b.get("cost") == expected_b)
+        out["fleet_elastic_deaths"] = router.deaths
+        return out
+    finally:
+        front.stop()
+        router.stop(drain=False)
+        shutil.rmtree(journal_dir, ignore_errors=True)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 # Cold-start leg (ISSUE 15): time-to-first-result of a FRESH serve
 # worker on a known structure, empty disk cache vs warm.  The warm
 # process must serve its first same-structure request with the jit
@@ -1700,6 +2019,22 @@ def run_bench():
         serve_keys.update({
             "fleet_problems_per_sec_r2": None,
             "fleet_error": f"{type(exc).__name__}: {exc}"[:200],
+        })
+    # Elastic-fleet leg (ISSUE 16): two-host fleet under churn —
+    # baseline throughput, live-migration cost parity, a 4x traffic
+    # step against the SLO autoscaler, and a host kill mid-burst
+    # with a zero-acked-loss ledger — sentinel family
+    # "fleet_elastic".  Never kills the headline.
+    try:
+        record_leg_backend("fleet_elastic")
+        serve_keys.update(bench_fleet_elastic())
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: elastic-fleet leg failed ({exc}); continuing",
+              file=sys.stderr)
+        serve_keys.update({
+            "fleet_elastic_problems_per_sec": None,
+            "fleet_elastic_error":
+                f"{type(exc).__name__}: {exc}"[:200],
         })
     # Cold-start leg (ISSUE 15): fresh-worker time-to-first-result,
     # warm disk compile cache vs empty — sentinel family

@@ -400,6 +400,12 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           compile_cache_dir: Optional[str] = None,
           heartbeat_s: float = 0.25,
           spill_slack: int = 4,
+          hosts: int = 1,
+          slo_p99_ms: Optional[float] = None,
+          min_replicas: Optional[int] = None,
+          max_replicas: Optional[int] = None,
+          join: Optional[str] = None,
+          host_id: Optional[str] = None,
           port_file: Optional[str] = None,
           block: bool = False) -> Optional[Any]:
     """Start the multi-tenant solve service (docs/serving.md).
@@ -463,6 +469,20 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     replica serves its first same-structure request without paying
     XLA compilation.
 
+    Elastic fleet (docs/serving.md "Elastic fleet"): ``hosts=H``
+    stripes locally spawned replicas over H simulated host identities
+    (host-kill chaos, CI two-host topologies); ``slo_p99_ms`` +
+    ``max_replicas`` arm SLO-driven autoscaling (the router grows the
+    fleet toward ``max_replicas`` when rolling p99 or queue depth
+    breaches the SLO, drains back toward ``min_replicas`` — migrating
+    warm sessions off, never killing them — when quiet).  ``join``
+    turns a SINGLE-replica serve into a remote fleet member: after
+    the front end binds, the worker announces its own URL to the
+    router at ``join`` via ``POST /fleet/join`` (``host_id``
+    overrides the announced host identity, default
+    :func:`pydcop_tpu.engine.multihost.fleet_host_id`); incompatible
+    with ``replicas > 1``.
+
     ``port=0`` asks the OS for a free port (``port_file`` atomically
     publishes the assignment — the fleet worker handshake).
     ``block=True`` (the ``pydcop serve`` CLI) serves until
@@ -473,6 +493,11 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     returns a :class:`ServeHandle` / :class:`FleetHandle` (both
     context managers) for embedding and tests.
     """
+    if join and replicas > 1:
+        raise ValueError(
+            "join= is for single-replica remote workers; a local "
+            "fleet (replicas > 1) IS the router — point the workers' "
+            "join at its URL instead")
     if replicas > 1:
         return _serve_fleet(
             port=port, host=host, max_queue=max_queue,
@@ -490,6 +515,8 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
             replicas=replicas, affinity=affinity,
             compile_cache_dir=compile_cache_dir,
             heartbeat_s=heartbeat_s, spill_slack=spill_slack,
+            hosts=hosts, slo_p99_ms=slo_p99_ms,
+            min_replicas=min_replicas, max_replicas=max_replicas,
             port_file=port_file, block=block)
     if compile_cache_dir:
         # Before the service compiles anything: the cache-dir config
@@ -538,6 +565,10 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           file=sys.stderr)
     if port_file:
         _write_port_file(port_file, handle.port)
+    if join:
+        # Announce AFTER the front end binds: the router health-probes
+        # the announced URL before admitting it to the fleet.
+        _announce_join(join, handle.url, host_id)
     if not block:
         return handle
     _serve_until_signal(
@@ -550,6 +581,47 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     return None
 
 
+def _announce_join(join_url: str, own_url: str,
+                   host_id: Optional[str] = None) -> bool:
+    """Announce this worker to a fleet router's ``POST /fleet/join``.
+
+    Best-effort with small retries (the router may still be binding
+    during a parallel bring-up): a failed announce leaves the worker
+    serving standalone with a warning — operators re-announce by
+    restarting or curling /fleet/join themselves — rather than
+    refusing to serve at all."""
+    import json
+    import sys
+    import time
+    import urllib.request
+
+    from pydcop_tpu.engine.multihost import fleet_host_id
+
+    payload = json.dumps({
+        "url": own_url,
+        "host_id": host_id or fleet_host_id(),
+    }).encode()
+    target = join_url.rstrip("/") + "/fleet/join"
+    last: Optional[Exception] = None
+    for attempt in range(5):
+        if attempt:
+            time.sleep(min(0.5 * attempt, 2.0))
+        try:
+            req = urllib.request.Request(
+                target, data=payload, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                resp.read()
+            print(f"pydcop serve: joined fleet at {join_url}",
+                  file=sys.stderr)
+            return True
+        except (OSError, ValueError) as exc:
+            last = exc
+    print(f"pydcop serve: fleet join at {join_url} failed ({last}); "
+          "serving standalone", file=sys.stderr)
+    return False
+
+
 def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
                  high_water, default_params, breaker_failures,
                  breaker_reset_s, result_keep, journal_dir,
@@ -557,6 +629,7 @@ def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
                  session_max, session_segment_cycles,
                  session_checkpoint_every_events, replicas, affinity,
                  compile_cache_dir, heartbeat_s, spill_slack,
+                 hosts, slo_p99_ms, min_replicas, max_replicas,
                  port_file, block) -> Optional["FleetHandle"]:
     """The ``replicas > 1`` serve path: build the worker CLI tail
     from the same kwargs the single-service path consumes (so the two
@@ -608,6 +681,8 @@ def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
         compile_cache_dir=compile_cache_dir, affinity=affinity,
         heartbeat_s=heartbeat_s, spill_slack=spill_slack,
         default_params=params,
+        hosts=hosts, slo_p99_ms=slo_p99_ms,
+        min_replicas=min_replicas, max_replicas=max_replicas,
     ).start()
     try:
         front_end = RouterFrontEnd(router, port=port,

@@ -157,6 +157,37 @@ def set_parser(subparsers):
                              "structure-warm replica more than this "
                              "many requests deeper in flight than "
                              "the idlest one loses the request to it")
+    parser.add_argument("--hosts", type=int, default=1,
+                        help="simulated host identities the local "
+                             "fleet's replicas stripe over (host-kill "
+                             "chaos + CI two-host topologies; replica "
+                             "k gets host id 'host<k %% hosts>')")
+    parser.add_argument("--join", default=None, metavar="ROUTER_URL",
+                        help="single-replica remote fleet member: "
+                             "after binding, announce this worker's "
+                             "URL to the fleet router at ROUTER_URL "
+                             "via POST /fleet/join (incompatible "
+                             "with --replicas > 1)")
+    parser.add_argument("--host_id", "--host-id", default=None,
+                        help="host identity announced with --join "
+                             "(default: PYDCOP_HOST_ID or the "
+                             "machine hostname)")
+    parser.add_argument("--slo_p99_ms", "--slo-p99-ms", type=float,
+                        default=None, metavar="MS",
+                        help="autoscaling SLO: with --max_replicas, "
+                             "the router grows the fleet when rolling "
+                             "p99 latency or queue depth breaches "
+                             "this target and drains back when quiet "
+                             "(docs/serving.md \"Elastic fleet\")")
+    parser.add_argument("--min_replicas", "--min-replicas", type=int,
+                        default=None,
+                        help="autoscale floor (default: 1)")
+    parser.add_argument("--max_replicas", "--max-replicas", type=int,
+                        default=None,
+                        help="autoscale ceiling; must be >= "
+                             "--replicas (autoscaling is armed only "
+                             "when both this and --slo_p99_ms are "
+                             "set)")
     parser.add_argument("--port_file", "--port-file", default=None,
                         metavar="PATH",
                         help="atomically write the bound port to "
@@ -188,6 +219,11 @@ def run_cmd(args) -> int:
     if args.replicas > 1 and args.recover:
         logger.error("--recover is per-worker in a fleet: the router "
                      "always recovers journaled replica segments")
+        return 2
+    if args.join and args.replicas > 1:
+        logger.error("--join is for single-replica remote workers; "
+                     "a local fleet (--replicas > 1) IS the router — "
+                     "point remote workers' --join at its URL")
         return 2
     if args.flight_recorder_events is not None:
         from pydcop_tpu.observability import flight
@@ -231,6 +267,12 @@ def run_cmd(args) -> int:
                            or aotcache.cache_dir()),
         heartbeat_s=args.heartbeat,
         spill_slack=args.spill_slack,
+        hosts=args.hosts,
+        slo_p99_ms=args.slo_p99_ms,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        join=args.join,
+        host_id=args.host_id,
         port_file=args.port_file,
         block=True,
     )

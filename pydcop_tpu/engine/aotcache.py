@@ -212,6 +212,30 @@ def split_cold_call(elapsed_s: float, before: Dict[str, float],
     return min(retrieval, max(elapsed_s, 0.0))
 
 
+def disk_stats(directory: Optional[str]) -> Dict[str, int]:
+    """Entry count + byte size of a cache DIRECTORY, independent of
+    this process's cache state.  The fleet router never jits, so its
+    own ``enabled()`` stays False — but it still owns the shared cache
+    dir its workers populate, and reports how warm the fleet's disk
+    cache is (how much compile work a scale-up prewarm can skip) from
+    here."""
+    entries = 0
+    size = 0
+    if directory:
+        try:
+            for name in os.listdir(directory):
+                if name.endswith("-cache"):
+                    entries += 1
+                try:
+                    size += os.path.getsize(
+                        os.path.join(directory, name))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+    return {"entries": entries, "bytes": size}
+
+
 def stats() -> Dict[str, Any]:
     """Operator-facing snapshot: config + counters + on-disk size
     (surfaced in /stats on every worker and in the router's fleet
@@ -220,20 +244,5 @@ def stats() -> Dict[str, Any]:
     with _lock:
         out["enabled"] = _state["enabled"]
         out["dir"] = _state["dir"]
-    entries = 0
-    size = 0
-    if out["dir"]:
-        try:
-            for name in os.listdir(out["dir"]):
-                if name.endswith("-cache"):
-                    entries += 1
-                try:
-                    size += os.path.getsize(
-                        os.path.join(out["dir"], name))
-                except OSError:
-                    pass
-        except OSError:
-            pass
-    out["entries"] = entries
-    out["bytes"] = size
+    out.update(disk_stats(out["dir"]))
     return out

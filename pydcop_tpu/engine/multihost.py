@@ -152,6 +152,23 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     return jax.process_count() > 1
 
 
+def fleet_host_id() -> str:
+    """Stable identity of the host this process serves from, for the
+    fleet control plane (serving/router.py).  Remote replicas announce
+    it on ``POST /fleet/join`` so the router can reason about host
+    topology (which replicas die together when a machine dies); a
+    two-host CI simulation on one box overrides it per process with
+    ``PYDCOP_HOST_ID``.  Distinct from the data-plane rank above: a
+    serving fleet is N independent single-host engines, not one
+    jax.distributed world."""
+    host = os.environ.get("PYDCOP_HOST_ID")
+    if host:
+        return host
+    import socket
+
+    return socket.gethostname()
+
+
 def multihost_configured() -> bool:
     """True when the environment asks for a distributed runtime (the
     ``PYDCOP_*`` conventions above), regardless of whether the join

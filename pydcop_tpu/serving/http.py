@@ -150,6 +150,9 @@ class _ServeHandler(_Handler):
         if path == "/session":
             self._open_session()
             return
+        if path.startswith("/admin/"):
+            self._admin(path[len("/admin/"):])
+            return
         if path != "/solve":
             # Replying without reading the body would leave it on the
             # socket and corrupt the next keep-alive request (the
@@ -234,6 +237,68 @@ class _ServeHandler(_Handler):
         self._json(202, {"id": rid, "status": "queued",
                          "trace_id": trace_id,
                          "result_url": f"/result/{rid}"})
+
+    # -- migration admin plane (docs/serving.md) ----------------------- #
+
+    def _admin(self, op: str):
+        """``POST /admin/<op>_session`` — the worker side of live
+        session migration (docs/serving.md).  The fleet router drives
+        these; they are same-box trust, like ``/solve``:
+
+        - ``export_session`` — drain + checkpoint the session, freeze
+          it MIGRATING, return the portable bundle (200).
+        - ``import_session`` — journal + rebuild a bundle's session
+          here (201).  The import journals *before* it rebuilds, so a
+          crash mid-import leaves a replayable journal, never a lost
+          session.
+        - ``retire_session`` — close out a MIGRATING session on the
+          source once the target owns it (200, idempotent).
+        - ``resume_session`` — roll a MIGRATING session back to OPEN
+          after a failed import (200).
+        """
+        if op not in ("export_session", "import_session",
+                      "retire_session", "resume_session"):
+            self._json(404, {"error": "unknown path"}, close=True)
+            return
+        body = self._read_json_body()
+        if body is None:
+            return
+        service = self.telemetry.service
+        try:
+            if op == "import_session":
+                from pydcop_tpu.serving import migration
+
+                sess = migration.install_bundle(
+                    service.sessions, body)
+                self._json(201, {"session_id": sess.id,
+                                 "trace_id": sess.trace_id,
+                                 "seq": sess.seq,
+                                 "status": sess.status})
+                return
+            sid = body.get("session_id")
+            if not isinstance(sid, str) or not sid.strip():
+                raise ValueError("body needs a 'session_id' string")
+            if op == "export_session":
+                wait = _positive_float(body.get("wait", 60.0), "wait")
+                out = service.sessions.export_session(sid, wait=wait)
+            elif op == "retire_session":
+                out = service.sessions.retire_session(
+                    sid, moved_to=body.get("moved_to"))
+            else:  # resume_session
+                out = service.sessions.resume_session(sid)
+            self._json(200, out)
+        except KeyError as exc:
+            self._json(404, {"error": f"unknown session: {exc}"})
+        except SessionClosed as exc:
+            self._json(409, {"error": str(exc)})
+        except TimeoutError as exc:
+            self._json(504, {"error": str(exc)})
+        except ValueError as exc:
+            service.record_bad_request()
+            self._json(400, {"error": f"bad request body: {exc}"})
+        except Exception as exc:  # noqa: BLE001 — admin must answer
+            logger.warning("admin %s failed: %s", op, exc)
+            self._json(500, {"error": f"internal error: {exc}"})
 
     # -- stateful sessions (docs/sessions.md) -------------------------- #
 
@@ -382,7 +447,7 @@ class _ServeHandler(_Handler):
                     continue
                 self._write_event(event)
                 if event.get("status") in ("CLOSED", "ERROR",
-                                           "REPLAYABLE"):
+                                           "REPLAYABLE", "MIGRATED"):
                     break
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away — normal SSE termination

@@ -123,6 +123,36 @@ def pending_requests(records: List[Dict[str, Any]]
     return list(accepted.values())
 
 
+# How many completed-with-result records a compaction retains.  The
+# tail is the crash-durable result cache: big enough to cover every
+# ack a client could still be polling across a restart, small enough
+# that compaction actually compacts (a record with a result payload
+# is a few hundred bytes — the accepted record's problem yaml, the
+# bulky part, is already dropped with the pair).
+COMPLETED_KEEP = 256
+
+
+def completed_results(records: List[Dict[str, Any]],
+                      keep: int = COMPLETED_KEEP
+                      ) -> List[Dict[str, Any]]:
+    """The newest ``keep`` completed records that carry a ``result``
+    payload, newest-completion-last — what a restarted worker loads
+    into its recovered-result cache so a pre-crash 202 still resolves
+    to its outcome.  Plain completed records (no payload: pre-ISSUE-16
+    journals, or appends that could not serialize the result) are
+    tombstones only and are never retained."""
+    seen: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        if rec.get("kind") == COMPLETED and rec.get("id") is not None \
+                and rec.get("result") is not None:
+            # Re-insert so a re-completion (replay finishing a request
+            # a prior segment also finished) keeps the newest outcome.
+            seen.pop(rec["id"], None)
+            seen[rec["id"]] = rec
+    out = list(seen.values())
+    return out[-keep:] if keep >= 0 else out
+
+
 def pending_sessions(records: List[Dict[str, Any]]
                      ) -> List[Dict[str, Any]]:
     """Open-but-not-closed sessions, each as ``{"open": rec,
@@ -134,7 +164,16 @@ def pending_sessions(records: List[Dict[str, Any]]
     before the checkpoint seq — recovery needs the pre-checkpoint
     events to rebuild the engine's factor layout structurally before
     the checkpointed message state can be restored onto it
-    (serving/sessions.py SessionManager.recover)."""
+    (serving/sessions.py SessionManager.recover).
+
+    Exception — the recovery-time bound (ISSUE 16): a REBASED
+    checkpoint marker carries the session's CURRENT problem
+    serialized (``"dcop"`` key, serving/migration.engine_dcop_yaml),
+    so the factor layout can be rebuilt from the marker alone and
+    every batch at or before its seq is dead weight: those events are
+    DROPPED here, which both bounds replay work and shrinks what
+    compaction keeps for a long-lived session from its full event
+    history to the post-checkpoint tail."""
     open_recs: Dict[str, Dict[str, Any]] = {}
     events: Dict[str, List[Dict[str, Any]]] = {}
     ckpts: Dict[str, Dict[str, Any]] = {}
@@ -158,12 +197,86 @@ def pending_sessions(records: List[Dict[str, Any]]
             del open_recs[sid]
             events.pop(sid, None)
             ckpts.pop(sid, None)
-    return [
-        {"open": rec, "ckpt": ckpts.get(sid),
-         "events": sorted(events.get(sid, []),
-                          key=lambda r: r.get("seq", 0))}
-        for sid, rec in open_recs.items()
-    ]
+    out = []
+    for sid, rec in open_recs.items():
+        ckpt = ckpts.get(sid)
+        evs = sorted(events.get(sid, []),
+                     key=lambda r: r.get("seq", 0))
+        if ckpt is not None and ckpt.get("dcop"):
+            ckpt_seq = ckpt.get("seq", 0)
+            evs = [r for r in evs if r.get("seq", 0) > ckpt_seq]
+        out.append({"open": rec, "ckpt": ckpt, "events": evs})
+    return out
+
+
+def compact_journal(journal_dir: str
+                    ) -> Tuple[List[Dict[str, Any]],
+                               List[Dict[str, Any]],
+                               List[Dict[str, Any]]]:
+    """Compact a journal IN PLACE without opening it for appends:
+    scan, truncate a torn tail, and atomically rewrite the file down
+    to the pending requests, every open session's replay records
+    (post-rebased-checkpoint only — see :func:`pending_sessions`),
+    and the newest :data:`COMPLETED_KEEP` completed-with-result
+    records (:func:`completed_results` — the crash-durable outcomes a
+    restarted worker serves to clients still polling a pre-crash ack).
+
+    Returns ``(pending_requests, pending_sessions, results)``.  This
+    is the owner-less half of :meth:`RequestJournal.recover_full`:
+    the fleet router runs it over a DEAD replica's segment before
+    handing the segment to a replacement (or migrating its sessions
+    to survivors), so the restarted worker's ``--recover`` replay
+    visits only still-pending records instead of the segment's full
+    history."""
+    path = os.path.join(journal_dir, JOURNAL_FILE)
+    records, valid_bytes, torn = scan_journal(path)
+    if torn:
+        logger.warning(
+            "journal %s has a torn tail: truncating to the last "
+            "valid record at byte %d", path, valid_bytes)
+    pending = pending_requests(records)
+    sessions = pending_sessions(records)
+    results = completed_results(records)
+    if os.path.exists(path):
+        # Pending requests, retained results, plus every open
+        # session's open/ckpt/event records, written to a temp file
+        # and renamed over the old journal — a crash mid-compact
+        # leaves the (longer but equivalent) original.
+        fd, tmp = tempfile.mkstemp(
+            dir=journal_dir, prefix=".jnl_tmp_")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                for rec in pending:
+                    f.write(encode_record(rec))
+                for rec in results:
+                    f.write(encode_record(rec))
+                for sess in sessions:
+                    f.write(encode_record(sess["open"]))
+                    if sess["ckpt"] is not None:
+                        f.write(encode_record(sess["ckpt"]))
+                    for rec in sess["events"]:
+                        f.write(encode_record(rec))
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    return pending, sessions, results
+
+
+def append_record(journal_dir: str, record: Dict[str, Any]) -> None:
+    """One-shot durable append to a journal nobody holds open — the
+    fleet router's tool for closing out a DEAD replica's sessions
+    after migrating them to survivors (the restarted worker must not
+    resurrect what a survivor already owns)."""
+    os.makedirs(journal_dir, exist_ok=True)
+    path = os.path.join(journal_dir, JOURNAL_FILE)
+    with open(path, "ab") as f:
+        f.write(encode_record(record))
+        f.flush()
+        os.fsync(f.fileno())
 
 
 class RequestJournal:
@@ -203,11 +316,12 @@ class RequestJournal:
     @classmethod
     def recover(cls, journal_dir: str, sync: bool = False
                 ) -> Tuple["RequestJournal", List[Dict[str, Any]]]:
-        """:meth:`recover_full` without the session set — kept for
-        callers that predate stateful sessions (the compaction still
-        preserves open-session records either way: a request-only
-        consumer must never silently destroy session durability)."""
-        journal, pending, _sessions = cls.recover_full(
+        """:meth:`recover_full` without the session and result sets —
+        kept for callers that predate stateful sessions (the
+        compaction still preserves open-session and retained-result
+        records either way: a request-only consumer must never
+        silently destroy session or result durability)."""
+        journal, pending, _sessions, _results = cls.recover_full(
             journal_dir, sync=sync)
         return journal, pending
 
@@ -215,56 +329,28 @@ class RequestJournal:
     def recover_full(cls, journal_dir: str, sync: bool = False
                      ) -> Tuple["RequestJournal",
                                 List[Dict[str, Any]],
+                                List[Dict[str, Any]],
                                 List[Dict[str, Any]]]:
         """Open a journal directory for crash recovery.
 
         Scans the journal, truncates a torn tail past the last valid
         record, computes the pending (accepted-without-terminal)
-        request set AND the open-session set
-        (:func:`pending_sessions`), and atomically compacts the file
-        down to exactly those records before reopening it for
-        appends.  Returns ``(journal, pending_requests,
-        pending_sessions)`` in acceptance/open order."""
-        path = os.path.join(journal_dir, JOURNAL_FILE)
-        records, valid_bytes, torn = scan_journal(path)
-        if torn:
-            logger.warning(
-                "journal %s has a torn tail: truncating to the last "
-                "valid record at byte %d", path, valid_bytes)
-        pending = pending_requests(records)
-        sessions = pending_sessions(records)
-        if os.path.exists(path):
-            # Compact: pending requests plus every open session's
-            # open/ckpt/event records, written to a temp file and
-            # renamed over the old journal — a crash mid-compact
-            # leaves the (longer but equivalent) original.
-            fd, tmp = tempfile.mkstemp(
-                dir=journal_dir, prefix=".jnl_tmp_")
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    for rec in pending:
-                        f.write(encode_record(rec))
-                    for sess in sessions:
-                        f.write(encode_record(sess["open"]))
-                        if sess["ckpt"] is not None:
-                            f.write(encode_record(sess["ckpt"]))
-                        for rec in sess["events"]:
-                            f.write(encode_record(rec))
-                os.replace(tmp, path)
-            except BaseException:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise
+        request set, the open-session set
+        (:func:`pending_sessions`), and the retained
+        completed-with-result set (:func:`completed_results`), and
+        atomically compacts the file down to exactly those records
+        before reopening it for appends (:func:`compact_journal`).
+        Returns ``(journal, pending_requests, pending_sessions,
+        results)`` in acceptance/open/completion order."""
+        pending, sessions, results = compact_journal(journal_dir)
         journal = cls(journal_dir, sync=sync)
-        if records or torn:
+        if pending or sessions:
             logger.info(
-                "journal recovery: %d record(s) scanned, %d pending "
-                "request(s) and %d open session(s) to replay%s",
-                len(records), len(pending), len(sessions),
-                " (torn tail truncated)" if torn else "")
-        return journal, pending, sessions
+                "journal recovery: %d pending request(s) and %d "
+                "open session(s) to replay (%d completed result(s) "
+                "retained)",
+                len(pending), len(sessions), len(results))
+        return journal, pending, sessions, results
 
 
 def accepted_record(rid: str, dcop_yaml: str,
@@ -288,8 +374,19 @@ def accepted_record(rid: str, dcop_yaml: str,
     return rec
 
 
-def completed_record(rid: str, status: str) -> Dict[str, Any]:
-    return {"kind": COMPLETED, "id": rid, "status": status}
+def completed_record(rid: str, status: str,
+                     result: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Terminal record.  ``result`` (the request's wire-form result
+    dict) makes the OUTCOME crash-durable, not just the fact of
+    completion: a client holding a durable 202 whose request finished
+    moments before the process died polls the restarted worker and
+    gets its 200 from the journal instead of a 404 (the in-memory
+    result cache died with the process)."""
+    rec = {"kind": COMPLETED, "id": rid, "status": status}
+    if result is not None:
+        rec["result"] = result
+    return rec
 
 
 # --------------------------------------------------------------------- #
@@ -323,12 +420,25 @@ def session_event_record(sid: str, seq: int,
 
 
 def session_ckpt_record(sid: str, seq: int, path: str,
-                        cycle: int = 0) -> Dict[str, Any]:
+                        cycle: int = 0,
+                        dcop: Optional[str] = None
+                        ) -> Dict[str, Any]:
     """Engine-state checkpoint marker: the NPZ at ``path`` holds the
     warm message state AFTER event batch ``seq`` was applied —
-    recovery restores it and replays only the batches past ``seq``."""
-    return {"kind": SESSION_CKPT, "id": sid, "seq": int(seq),
-            "path": path, "cycle": int(cycle)}
+    recovery restores it and replays only the batches past ``seq``.
+
+    ``dcop`` REBASES the checkpoint: the session's current problem
+    (open-record problem + every batch through ``seq``, serialized
+    back to dcop yaml by serving/migration.engine_dcop_yaml).  A
+    rebased marker lets recovery rebuild the factor layout from the
+    marker alone, so compaction drops the pre-checkpoint event tail
+    entirely (:func:`pending_sessions`) — replay time is bounded by
+    the checkpoint cadence, not session age."""
+    rec = {"kind": SESSION_CKPT, "id": sid, "seq": int(seq),
+           "path": path, "cycle": int(cycle)}
+    if dcop:
+        rec["dcop"] = dcop
+    return rec
 
 
 def session_close_record(sid: str, status: str) -> Dict[str, Any]:

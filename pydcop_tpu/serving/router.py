@@ -49,21 +49,50 @@ The router process itself never jits: compile work lives in the
 workers, warmed across restarts by the persistent AOT compile cache
 (engine/aotcache.py) whose directory the router exports to every
 worker it spawns.
+
+**Elastic fleet (ISSUE 16).**  Three extensions turn the unit cell
+into a control plane:
+
+- *Multi-host membership*: locally-spawned replicas get a simulated
+  host identity (``hosts=H`` stripes them ``host0..host{H-1}`` — a
+  two-host topology runs as socket-distinct processes on one box for
+  CI), and REMOTE replicas join over the wire: a worker started with
+  ``--join <router_url>`` announces its address at
+  ``POST /fleet/join`` and is probed/phi-scored exactly like a local
+  one — the router never restarts what it didn't spawn, it just
+  routes around the silence until the replica re-announces.
+- *Live session migration* (serving/migration.py): drain-checkpoint
+  a warm session on its replica, hand the bundle to another, repoint
+  the pin.  Triggers: operator ``POST /admin/migrate``, scale-down
+  drain, and replica DEATH — the restart path first compacts the
+  dead segment's journal and ADOPTS its open sessions onto survivors
+  (bundle built from the compacted records) so warm sessions resume
+  in seconds instead of waiting out a worker respawn.
+- *SLO autoscaling + fairness*: the monitor compares rolling
+  forwarded-request p99 and queue depth against ``--slo_p99_ms`` and
+  spawns (prewarmed from the admission exemplar cache, backed by the
+  shared AOT disk cache) or drains replicas between
+  ``--min_replicas`` and ``--max_replicas``; a weighted-fair
+  admission queue (:class:`FairScheduler`, virtual-time WFQ keyed on
+  the request's ``tenant``) keeps one tenant's burst from starving
+  another's.
 """
 
 import hashlib
+import heapq
 import http.client
 import itertools
 import json
 import logging
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from pydcop_tpu.observability.metrics import registry as metrics_registry
@@ -88,24 +117,136 @@ UP = "up"
 STARTING = "starting"
 RESTARTING = "restarting"
 DOWN = "down"
+# Scale-down: out of the candidate set while its sessions migrate to
+# survivors; resolves to DOWN (retired) or back to UP on a failed
+# drain.
+DRAINING = "draining"
+
+# Fair-queue admission wait before a 429: long enough to absorb a
+# burst, short enough that a starved client learns it is being
+# shaped.
+FAIR_WAIT_S = 30.0
 
 
 class FleetUnavailable(Exception):
     """No healthy, non-shedding replica can take the request (503)."""
 
 
+class ForwardNotSent(OSError):
+    """A forward failed BEFORE any request bytes were written (the
+    connect itself was refused/reset).  The worker cannot have seen —
+    let alone acked — the request, so re-picking a healthy replica
+    and resending the identical body is unconditionally safe.  Any
+    OSError past this point is ambiguous (bytes may have reached a
+    worker that journaled the request before dying mid-response) and
+    must surface to the client WITH the minted request id instead of
+    being silently resent."""
+
+
+class FairScheduler:
+    """Weighted fair queuing over request tenants (virtual-time WFQ,
+    the classic start-time fair queue collapsed to unit-cost
+    requests): each admission gets a finish tag
+    ``max(vtime, tenant's last tag) + 1/weight`` and admissions leave
+    the queue in tag order, so a tenant flooding N requests only
+    advances its OWN tag N steps — a quiet tenant's next request tags
+    just past the current virtual time and overtakes the flood's
+    tail.  Capacity (concurrent admitted requests) scales with live
+    replicas: ``up * fair_share``.  A request that can't get a slot
+    within its wait window is rejected (429) — shaping, not failure.
+
+    Deliberately tiny and lock-simple: the router's forward path is
+    hundreds of requests per second, not millions, and the property
+    that matters — one tenant's zipf storm cannot starve another's
+    sessions — is a tag-ordering property, not a throughput one."""
+
+    def __init__(self, fair_share: int = 8):
+        self.fair_share = int(fair_share)
+        self._cond = threading.Condition()
+        self._vtime = 0.0
+        self._last_tag: Dict[str, float] = {}
+        self._heap: List[Tuple[float, int, str]] = []
+        self._seq = itertools.count()
+        self._active = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.queued_peak = 0
+
+    def acquire(self, tenant: str, up: int,
+                timeout: float = FAIR_WAIT_S,
+                weight: float = 1.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            tag = (max(self._vtime,
+                       self._last_tag.get(tenant, 0.0))
+                   + 1.0 / max(weight, 1e-6))
+            self._last_tag[tenant] = tag
+            me = (tag, next(self._seq), tenant)
+            heapq.heappush(self._heap, me)
+            self.queued_peak = max(self.queued_peak,
+                                   len(self._heap))
+            while True:
+                cap = max(up, 1) * self.fair_share
+                if self._heap[0] == me and self._active < cap:
+                    heapq.heappop(self._heap)
+                    self._active += 1
+                    self._vtime = max(self._vtime, tag)
+                    self.admitted += 1
+                    self._cond.notify_all()
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._heap.remove(me)
+                    heapq.heapify(self._heap)
+                    self.rejected += 1
+                    self._cond.notify_all()
+                    return False
+                self._cond.wait(min(remaining, 0.1))
+
+    def release(self) -> None:
+        with self._cond:
+            self._active = max(self._active - 1, 0)
+            self._cond.notify_all()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "fair_share": self.fair_share,
+                "active": self._active,
+                "queued": len(self._heap),
+                "queued_peak": self.queued_peak,
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+                "tenants": len(self._last_tag),
+            }
+
+
 class Replica:
-    """One worker process slot: the process handle, its URL, health
-    bookkeeping and the warm-structure set affinity accounting reads.
-    A slot survives its process — a restarted worker reuses the slot
-    (same index, same journal segment), which is what keeps request
-    pins valid across a replica death."""
+    """One worker slot: the process handle (local spawns), its URL,
+    health bookkeeping and the warm-structure set affinity accounting
+    reads.  A slot survives its process — a restarted worker reuses
+    the slot (same index, same journal segment), which is what keeps
+    request pins valid across a replica death.
+
+    ``managed=False`` marks a REMOTE replica that joined over the
+    wire (``POST /fleet/join``): no process handle, no journal
+    segment the router can touch — a dead remote goes DOWN and stays
+    there until it re-announces.  ``host_id`` is the (possibly
+    simulated) host identity used by the multi-host chaos proof;
+    ``retired`` marks a slot drained away by scale-down — terminal
+    for the slot, the prober must not resurrect it."""
 
     def __init__(self, index: int, journal_dir: Optional[str],
-                 log_path: str):
+                 log_path: str, host: str = "127.0.0.1",
+                 managed: bool = True,
+                 host_id: Optional[str] = None):
         self.index = index
         self.journal_dir = journal_dir
         self.log_path = log_path
+        self.host = host
+        self.managed = managed
+        self.host_id = host_id
+        self.retired = False
         self.proc: Optional[subprocess.Popen] = None
         self.port: Optional[int] = None
         self.status = STARTING
@@ -123,13 +264,16 @@ class Replica:
     def url(self) -> Optional[str]:
         if self.port is None:
             return None
-        return f"http://127.0.0.1:{self.port}"
+        return f"http://{self.host}:{self.port}"
 
     def summary(self) -> Dict[str, Any]:
         return {
             "index": self.index,
             "url": self.url,
             "status": self.status,
+            "host_id": self.host_id,
+            "managed": self.managed,
+            "retired": self.retired,
             "pid": self.proc.pid if self.proc else None,
             "breaker_open": self.breaker_open,
             "queue_depth": self.queue_depth,
@@ -174,13 +318,29 @@ class FleetRouter:
                  spill_slack: int = 4,
                  restart_dead: bool = True,
                  worker_ready_timeout_s: float = 120.0,
-                 default_params: Optional[Dict[str, Any]] = None):
+                 default_params: Optional[Dict[str, Any]] = None,
+                 hosts: int = 1,
+                 slo_p99_ms: Optional[float] = None,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 fair_share: int = 8,
+                 autoscale_interval_s: float = 2.0,
+                 scale_down_quiet_checks: int = 10):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         if affinity not in ("structure", "round_robin"):
             raise ValueError(
                 f"affinity must be 'structure' or 'round_robin', "
                 f"got {affinity!r}")
+        if hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {hosts}")
+        if max_replicas is not None and max_replicas < replicas:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) must be >= replicas "
+                f"({replicas})")
+        if min_replicas is not None and min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}")
         self.n_replicas = int(replicas)
         self.worker_args = list(worker_args or [])
         self.journal_dir = journal_dir
@@ -208,6 +368,37 @@ class FleetRouter:
         self._stopping = threading.Event()
         self._started = False
         self._run_dir: Optional[str] = None
+        # Elastic-fleet control plane (ISSUE 16).  Autoscaling is
+        # armed only when BOTH slo_p99_ms and max_replicas are set;
+        # no control-loop thread starts in __init__ (policy unit
+        # tests construct routers without start()).
+        self.hosts = int(hosts)
+        self.slo_p99_ms = (float(slo_p99_ms)
+                           if slo_p99_ms else None)
+        self.min_replicas = (int(min_replicas)
+                             if min_replicas else None)
+        self.max_replicas = (int(max_replicas)
+                             if max_replicas else None)
+        self.autoscale_interval_s = float(autoscale_interval_s)
+        self.scale_down_quiet_checks = int(scale_down_quiet_checks)
+        self.fair = FairScheduler(fair_share)
+        self._lat: "deque[float]" = deque(maxlen=512)
+        self._scaling = False
+        self._quiet_checks = 0
+        self._last_autoscale = 0.0
+        # Admission exemplars for prewarming scaled-up replicas: the
+        # most recent (dcop yaml, params) per structure digest, LRU-
+        # bounded — replayed against a fresh worker before it takes
+        # traffic, so its first client request meets a warm jit cache
+        # (fed from the shared AOT disk cache, so the prewarm itself
+        # is a disk retrieval, not a cold compile).
+        self._exemplars: "OrderedDict[str, Tuple[str, Any]]" = (
+            OrderedDict())
+        self.exemplar_keep = 8
+        self.migrations = 0
+        self.adopted_sessions = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
         # Routing ledger (all mirrored on /stats).
         self.routed = 0
         self.affinity_hits = 0
@@ -246,7 +437,12 @@ class FleetRouter:
                            if self.journal_dir else None)
                 replica = Replica(
                     k, journal,
-                    os.path.join(self._run_dir, f"replica-{k}.log"))
+                    os.path.join(self._run_dir, f"replica-{k}.log"),
+                    # Striped simulated host identity: replicas of
+                    # one "host" share a fate in the host_kill chaos
+                    # scenario while remaining socket-distinct
+                    # processes.
+                    host_id=f"host{k % self.hosts}")
                 self.replicas.append(replica)
                 self._spawn(replica, recover=False)
             deadline = time.monotonic() + self.worker_ready_timeout_s
@@ -420,7 +616,8 @@ class FleetRouter:
 
     def _monitor_loop(self) -> None:
         while not self._stopping.wait(self.heartbeat_s):
-            for replica in self.replicas:
+            # Snapshot: the autoscaler appends replicas concurrently.
+            for replica in list(self.replicas):
                 if self._stopping.is_set():
                     return
                 try:
@@ -430,10 +627,17 @@ class FleetRouter:
                     logger.exception("heartbeat probe crashed for "
                                      "replica %d", replica.index)
             self._up_gauge.set(self.up_count())
+            try:
+                self._maybe_autoscale()
+            except Exception:  # noqa: BLE001 — the control loop must
+                # never take the prober down with it.
+                logger.exception("autoscale check crashed")
 
     def _probe(self, replica: Replica) -> None:
+        if replica.retired:
+            return  # scaled away on purpose — not a death
         if replica.status not in (UP, DOWN):
-            return  # mid-(re)start — the restart path owns it
+            return  # mid-(re)start/drain — that path owns it
         proc_dead = (replica.proc is not None
                      and replica.proc.poll() is not None)
         beat_ok = False
@@ -486,6 +690,12 @@ class FleetRouter:
                 replica.proc.wait(timeout=10.0)
             except (OSError, subprocess.TimeoutExpired):
                 pass
+        if not replica.managed:
+            # A remote replica is not ours to restart: route around
+            # it.  The DOWN slot revives when it answers the prober
+            # again or re-announces at /fleet/join.
+            replica.status = DOWN
+            return
         if not self.restart_dead:
             replica.status = DOWN
             return
@@ -506,6 +716,31 @@ class FleetRouter:
         if self._stopping.is_set():
             replica.status = DOWN
             return
+        if replica.journal_dir:
+            # Before the replacement replays anything: compact the
+            # dead segment (torn tail truncated, completed records
+            # dropped — the --recover replay visits only pending
+            # records) and ADOPT its open sessions onto survivors.
+            # Adopted sessions resume warm on a live replica in
+            # seconds; whatever fails to adopt stays in the segment
+            # for the restart-in-place replay — strictly the old
+            # behavior, never worse.
+            try:
+                from pydcop_tpu.serving import (
+                    migration as migration_mod)
+
+                adopted = migration_mod.adopt_dead_sessions(
+                    self, replica)
+                if adopted:
+                    with self._lock:
+                        self.adopted_sessions += adopted
+            except Exception:  # noqa: BLE001 — adoption is an
+                # optimization over restart-in-place, never a
+                # precondition for it.
+                logger.exception(
+                    "replica %d: dead-session adoption failed; "
+                    "falling back to restart-in-place replay",
+                    replica.index)
         try:
             # The journal handoff: --recover replays the dead
             # worker's acknowledged-but-unfinished requests and open
@@ -600,15 +835,288 @@ class FleetRouter:
             if replica.status == UP:
                 replica.status = DOWN
 
+    # -- multi-host membership ------------------------------------------ #
+
+    def register_remote(self, url: str,
+                        host_id: Optional[str] = None
+                        ) -> Dict[str, Any]:
+        """Admit a remote replica that announced itself (``POST
+        /fleet/join`` — a worker started with ``--join``).  The slot
+        is probed before admission and then heartbeat-scored exactly
+        like a local one; a re-announce of the same address revives
+        its existing slot (same index → existing pins stay valid).
+        Raises ValueError for a bad address, RuntimeError when the
+        announced endpoint doesn't answer /healthz."""
+        from urllib.parse import urlparse
+
+        parsed = urlparse(url if "//" in url else f"http://{url}")
+        host, port = parsed.hostname, parsed.port
+        if not host or not port:
+            raise ValueError(
+                f"bad replica url {url!r} (need host:port)")
+        with self._lock:
+            replica = next(
+                (r for r in self.replicas
+                 if not r.managed and r.host == host
+                 and r.port == port), None)
+            if replica is None:
+                import tempfile
+
+                index = len(self.replicas)
+                log_path = os.path.join(
+                    self._run_dir or tempfile.gettempdir(),
+                    f"remote-{index}.log")
+                replica = Replica(index, None, log_path, host=host,
+                                  managed=False, host_id=host_id)
+                replica.port = int(port)
+                self.replicas.append(replica)
+        try:
+            status, _ctype, _body = self._forward(
+                replica, "GET", "/healthz", None, timeout=5.0)
+        except OSError as exc:
+            with self._lock:
+                if replica.status != UP:
+                    replica.status = DOWN
+            raise RuntimeError(
+                f"joining replica {url} failed its admission probe: "
+                f"{exc}")
+        if status not in (200, 503):
+            raise RuntimeError(
+                f"joining replica {url} answered /healthz with "
+                f"{status}")
+        from pydcop_tpu.resilience.health import PhiAccrualEstimator
+
+        now = time.monotonic()
+        with self._lock:
+            replica.estimator = PhiAccrualEstimator(
+                expected=self.heartbeat_s)
+            replica.anchor = now
+            replica.estimator.beat(now)
+            replica.retired = False
+            if host_id:
+                replica.host_id = host_id
+            replica.status = UP
+        self._up_gauge.set(self.up_count())
+        logger.info("remote replica %d joined from %s (host %s)",
+                    replica.index, replica.url, replica.host_id)
+        return {"index": replica.index, "status": UP,
+                "heartbeat_s": self.heartbeat_s}
+
+    # -- SLO autoscaling ------------------------------------------------ #
+
+    def record_latency(self, ms: float) -> None:
+        with self._lock:
+            self._lat.append(float(ms))
+
+    def rolling_p99(self) -> Optional[float]:
+        with self._lock:
+            lat = sorted(self._lat)
+        if not lat:
+            return None
+        return lat[min(int(0.99 * len(lat)), len(lat) - 1)]
+
+    def note_exemplar(self, digest: Optional[str], dcop_yaml: str,
+                      params: Optional[Dict[str, Any]]) -> None:
+        """Remember one admission per structure digest for replica
+        prewarming (LRU over ``exemplar_keep`` structures)."""
+        if digest is None:
+            return
+        with self._lock:
+            self._exemplars[digest] = (dcop_yaml, params)
+            self._exemplars.move_to_end(digest)
+            while len(self._exemplars) > self.exemplar_keep:
+                self._exemplars.popitem(last=False)
+
+    def autoscale_decision(self) -> Optional[str]:
+        """The scaling policy, side-effect-free except for the quiet-
+        streak counter: ``"up"`` when the rolling p99 breaches the
+        SLO (or queues run deep) with headroom below max_replicas;
+        ``"down"`` after ``scale_down_quiet_checks`` consecutive
+        checks comfortably under it with an idle replica above the
+        floor; None otherwise.  Inert unless both ``slo_p99_ms`` and
+        ``max_replicas`` are configured."""
+        if not self.slo_p99_ms or not self.max_replicas:
+            return None
+        p99 = self.rolling_p99()
+        with self._lock:
+            managed = [r for r in self.replicas
+                       if r.managed and not r.retired]
+            live = [r for r in managed if r.status == UP]
+            n_active = len([r for r in managed
+                            if r.status in (UP, STARTING,
+                                            RESTARTING, DRAINING)])
+            queue_depth = sum(r.queue_depth for r in live)
+        floor = self.min_replicas or 1
+        if n_active < self.max_replicas and (
+                (p99 is not None and p99 > self.slo_p99_ms)
+                or queue_depth > 2 * max(len(live), 1)):
+            self._quiet_checks = 0
+            return "up"
+        if n_active > floor and (
+                (p99 is None or p99 < self.slo_p99_ms / 2)
+                and queue_depth == 0
+                and any(r.in_flight == 0 for r in live)):
+            self._quiet_checks += 1
+            if self._quiet_checks >= self.scale_down_quiet_checks:
+                self._quiet_checks = 0
+                return "down"
+            return None
+        self._quiet_checks = 0
+        return None
+
+    def _maybe_autoscale(self) -> None:
+        if not self.slo_p99_ms or not self.max_replicas:
+            return
+        if self._scaling or self._stopping.is_set():
+            return
+        now = time.monotonic()
+        if now - self._last_autoscale < self.autoscale_interval_s:
+            return
+        decision = self.autoscale_decision()
+        if decision is None:
+            return
+        self._last_autoscale = now
+        self._scaling = True
+        # Off the monitor thread: a spawn takes seconds of import
+        # and the prober must keep watching the fleet meanwhile.
+        threading.Thread(
+            target=self._scale, args=(decision,),
+            name="pydcop-fleet-scale", daemon=True).start()
+
+    def _scale(self, decision: str) -> None:
+        try:
+            if decision == "up":
+                self._scale_up()
+            else:
+                self._scale_down()
+        except Exception:  # noqa: BLE001
+            logger.exception("autoscale %s failed", decision)
+        finally:
+            self._scaling = False
+
+    def _scale_up(self) -> None:
+        with self._lock:
+            index = len(self.replicas)
+            journal = (os.path.join(self.journal_dir,
+                                    f"replica-{index}")
+                       if self.journal_dir else None)
+            replica = Replica(
+                index, journal,
+                os.path.join(self._run_dir, f"replica-{index}.log"),
+                host_id=f"host{index % self.hosts}")
+            self.replicas.append(replica)
+            self.n_replicas += 1
+        logger.info("autoscale up: spawning replica %d", index)
+        self._spawn(replica, recover=False)
+        self._wait_ready(
+            replica, time.monotonic() + self.worker_ready_timeout_s)
+        # Prewarm BEFORE taking traffic: _wait_ready flipped the slot
+        # UP; hold it back out of the candidate set while the
+        # exemplars replay (each a disk-cache retrieval, not a cold
+        # compile, thanks to the shared AOT cache dir).
+        replica.status = STARTING
+        self._prewarm(replica)
+        replica.status = UP
+        with self._lock:
+            self.scale_ups += 1
+            # The SLO window must not keep scaling on latencies
+            # measured by the smaller fleet.
+            self._lat.clear()
+        self._up_gauge.set(self.up_count())
+        logger.info("autoscale up: replica %d serving", index)
+
+    def _prewarm(self, replica: Replica) -> None:
+        with self._lock:
+            exemplars = list(self._exemplars.items())
+        for digest, (dcop_yaml, params) in exemplars[-4:]:
+            body: Dict[str, Any] = {"dcop": dcop_yaml,
+                                    "wait": True, "timeout": 60.0}
+            if params:
+                body["params"] = params
+            try:
+                self._forward(replica, "POST", "/solve",
+                              json.dumps(body).encode(),
+                              timeout=90.0)
+                # Unlike a crash respawn, this replica genuinely
+                # executed the structure: its in-process jit cache is
+                # warm for it.
+                replica.warm.add(digest)
+            except OSError as exc:
+                logger.warning(
+                    "replica %d prewarm forward failed (%s)",
+                    replica.index, exc)
+                return
+
+    def _scale_down(self) -> None:
+        with self._lock:
+            live = [r for r in self.replicas
+                    if r.managed and not r.retired
+                    and r.status == UP]
+            floor = self.min_replicas or 1
+            if len(live) <= floor:
+                return
+            victim = next(
+                (r for r in reversed(live)
+                 if r.in_flight == 0 and r.queue_depth == 0), None)
+            if victim is None:
+                return
+            victim.status = DRAINING
+        logger.info("autoscale down: draining replica %d",
+                    victim.index)
+        with self._lock:
+            sids = [sid for sid, idx in self._session_pins.items()
+                    if idx == victim.index]
+        from pydcop_tpu.serving import migration as migration_mod
+
+        for sid in sids:
+            try:
+                migration_mod.migrate_session(self, sid)
+            except Exception:  # noqa: BLE001 — a drain that can't
+                # move every session aborts: the replica goes back to
+                # serving rather than stranding a warm session.
+                logger.exception(
+                    "autoscale down aborted: session %s would not "
+                    "migrate off replica %d", sid, victim.index)
+                victim.status = UP
+                return
+        if victim.proc is not None and victim.proc.poll() is None:
+            try:
+                victim.proc.send_signal(signal.SIGTERM)
+                victim.proc.wait(timeout=60.0)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    victim.proc.kill()
+                    victim.proc.wait(timeout=10.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        with self._lock:
+            victim.status = DOWN
+            victim.retired = True
+            self.scale_downs += 1
+            self.n_replicas = max(self.n_replicas - 1, 1)
+            self._lat.clear()
+        self._up_gauge.set(self.up_count())
+        logger.info("autoscale down: replica %d retired",
+                    victim.index)
+
     # -- plumbing ------------------------------------------------------- #
 
     def _forward(self, replica: Replica, method: str, path: str,
                  body: Optional[bytes],
                  timeout: float = FORWARD_TIMEOUT_S
                  ) -> Tuple[int, str, bytes]:
-        conn = http.client.HTTPConnection("127.0.0.1", replica.port,
+        conn = http.client.HTTPConnection(replica.host, replica.port,
                                           timeout=timeout)
         try:
+            # Connect SEPARATELY from the request: a refusal here
+            # proves zero request bytes were written, which is what
+            # licenses the submit-forward retry (ForwardNotSent).
+            # Failures past the connect are ambiguous and stay plain
+            # OSErrors.
+            try:
+                conn.connect()
+            except OSError as exc:
+                raise ForwardNotSent(str(exc)) from exc
             headers = {}
             if body is not None:
                 headers["Content-Type"] = "application/json"
@@ -638,19 +1146,34 @@ class FleetRouter:
                 "shed": self.shed,
                 "reroutes": self.reroutes,
                 "deaths": self.deaths,
+                "migrations": self.migrations,
+                "adopted_sessions": self.adopted_sessions,
                 "spill_slack": self.spill_slack,
                 "heartbeat_s": self.heartbeat_s,
+                "hosts": self.hosts,
                 "pinned_requests": len(self._pins),
                 "pinned_sessions": len(self._session_pins),
                 "workers": [r.summary() for r in self.replicas],
             }
+        doc["fairness"] = self.fair.stats()
+        if self.slo_p99_ms:
+            doc["autoscale"] = {
+                "slo_p99_ms": self.slo_p99_ms,
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "rolling_p99_ms": self.rolling_p99(),
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+            }
         from pydcop_tpu.engine import aotcache
 
-        doc["compile_cache"] = (
-            {"dir": self.compile_cache_dir}
-            if self.compile_cache_dir else {"dir": None})
-        if aotcache.enabled():
-            doc["compile_cache"] = aotcache.stats()
+        # The router never jits, so the process-local cache stats are
+        # meaningless here; report the SHARED directory its workers
+        # populate (how warm a scale-up prewarm will find the disk).
+        doc["compile_cache"] = {"dir": self.compile_cache_dir}
+        if self.compile_cache_dir:
+            doc["compile_cache"].update(
+                aotcache.disk_stats(self.compile_cache_dir))
         return doc
 
     def health_summary(self) -> Dict[str, Any]:
@@ -697,12 +1220,25 @@ class _RouterHandler(_Handler):
         try:
             status, ctype, payload = self.router._forward(
                 replica, method, path, body, timeout=timeout)
-        except OSError as exc:
+        except ForwardNotSent as exc:
+            # Zero bytes reached the worker: the operation certainly
+            # did not happen.
             self.router.mark_forward_error(replica)
             self._json(503, {
                 "error": f"replica {replica.index} unreachable "
                          f"({exc}); recovering — retry",
                 "status": "rejected", "retry": True})
+            return
+        except OSError as exc:
+            # The request MAY have been received (and, for a PATCH,
+            # acked into the journal) before the socket died: the
+            # client must reconcile, not blind-resend.
+            self.router.mark_forward_error(replica)
+            self._json(503, {
+                "error": f"replica {replica.index} failed mid-"
+                         f"request ({exc}); outcome unknown — "
+                         "reconcile before retrying",
+                "status": "unknown", "retry": True})
             return
         self._reply(status, payload, ctype)
 
@@ -714,8 +1250,67 @@ class _RouterHandler(_Handler):
             self._route_solve()
         elif path == "/session":
             self._route_session_open()
+        elif path == "/fleet/join":
+            self._fleet_join()
+        elif path == "/admin/migrate":
+            self._admin_migrate()
         else:
             self._json(404, {"error": "unknown path"}, close=True)
+
+    def _fleet_join(self):
+        raw = self._read_body()
+        if raw is None:
+            return
+        try:
+            doc = json.loads(raw)
+            url = doc.get("url")
+            if not url or not isinstance(url, str):
+                raise ValueError("body needs a 'url' string "
+                                 "(the joining replica's address)")
+        except ValueError as exc:
+            self._json(400, {"error": f"bad join body: {exc}"})
+            return
+        try:
+            out = self.router.register_remote(url,
+                                              doc.get("host_id"))
+        except ValueError as exc:
+            self._json(400, {"error": str(exc)})
+            return
+        except RuntimeError as exc:
+            self._json(503, {"error": str(exc), "retry": True})
+            return
+        self._json(200, out)
+
+    def _admin_migrate(self):
+        raw = self._read_body()
+        if raw is None:
+            return
+        try:
+            doc = json.loads(raw)
+            sid = doc.get("session_id")
+            if not sid or not isinstance(sid, str):
+                raise ValueError("body needs a 'session_id'")
+            target = doc.get("target")
+            if target is not None and not isinstance(target, int):
+                raise ValueError("'target' must be a replica index")
+        except ValueError as exc:
+            self._json(400, {"error": f"bad migrate body: {exc}"})
+            return
+        from pydcop_tpu.serving import migration as migration_mod
+
+        try:
+            out = migration_mod.migrate_session(
+                self.router, sid, target_index=target)
+        except KeyError:
+            self._json(404, {"error": f"unknown session {sid!r}"})
+            return
+        except ValueError as exc:
+            self._json(400, {"error": str(exc)})
+            return
+        except (OSError, RuntimeError) as exc:
+            self._json(503, {"error": str(exc), "retry": True})
+            return
+        self._json(200, out)
 
     def _admission_key(self, raw: bytes
                        ) -> Tuple[Optional[dict], Optional[str]]:
@@ -757,6 +1352,29 @@ class _RouterHandler(_Handler):
         body, digest = self._admission_key(raw)
         if body is None:
             return
+        router = self.router
+        # Weighted-fair admission by tenant (an optional body key the
+        # workers never see): one tenant's zipf storm queues behind
+        # its own tag chain while other tenants' requests overtake
+        # it.  Absent tenants share one lane, which is exactly the
+        # pre-fairness behavior.
+        tenant = str(body.pop("tenant", "") or "default")
+        if not router.fair.acquire(tenant, router.up_count()):
+            self._json(429, {
+                "error": f"fair-queue admission timed out for "
+                         f"tenant {tenant!r}; retry with backoff",
+                "status": "rejected", "retry": True})
+            return
+        try:
+            self._route_solve_admitted(body, digest)
+        finally:
+            router.fair.release()
+
+    def _route_solve_admitted(self, body: dict,
+                              digest: Optional[str]):
+        router = self.router
+        router.note_exemplar(digest, body.get("dcop"),
+                             body.get("params"))
         # The router ALWAYS mints the id (a client-supplied one is
         # ignored): worker-local counters collide across replicas,
         # the pin table needs a fleet-unique handle before the worker
@@ -767,10 +1385,11 @@ class _RouterHandler(_Handler):
         rid = f"f{uuid.uuid4().hex[:16]}"
         body["request_id"] = rid
         payload = json.dumps(body).encode()
+        t0 = time.monotonic()
         tried: set = set()
         while True:
             try:
-                replica, _hit = self.router.pick(digest)
+                replica, _hit = router.pick(digest)
             except FleetUnavailable as exc:
                 self._json(503, {"error": str(exc),
                                  "status": "rejected", "retry": True})
@@ -780,26 +1399,47 @@ class _RouterHandler(_Handler):
                 # path never forwards, so it must release here or the
                 # slot leaks and the spillover heuristic sees a
                 # permanently-busier replica.
-                self.router.release(replica)
+                router.release(replica)
                 self._json(503, {
                     "error": "every healthy replica failed the "
                              "forward; retry",
                     "status": "rejected", "retry": True})
                 return
             tried.add(replica.index)
-            self.router.pin(rid, replica)
+            router.pin(rid, replica)
             try:
-                status, ctype, out = self.router._forward(
+                status, ctype, out = router._forward(
                     replica, "POST", "/solve", payload)
-            except OSError:
-                # Nothing was acked by the worker: re-routing the
-                # identical body is safe (the id travels with it).
-                self.router.mark_forward_error(replica)
-                with self.router._lock:
-                    self.router.reroutes += 1
+            except ForwardNotSent:
+                # The connect was refused: zero bytes reached the
+                # worker, so nothing was acked — re-picking a healthy
+                # replica and resending the identical body (the id
+                # travels with it) is unconditionally safe.
+                router.mark_forward_error(replica)
+                with router._lock:
+                    router.reroutes += 1
                 continue
+            except OSError as exc:
+                # Bytes MAY have reached a worker that journaled the
+                # request before dying mid-response.  Blind resend
+                # risks a duplicate solve under the same structure
+                # bin; instead the client gets the minted id — the
+                # pin survives the replica's restart, so
+                # /result/<id> either finds the journaled request's
+                # replayed result (it was acked) or 404s (it never
+                # landed; resubmitting is then safe).
+                router.mark_forward_error(replica)
+                self._json(503, {
+                    "error": f"replica {replica.index} failed mid-"
+                             f"forward ({exc}); outcome unknown — "
+                             f"poll the result url, resubmit on 404",
+                    "status": "unknown", "retry": True,
+                    "request_id": rid,
+                    "result_url": f"/result/{rid}"})
+                return
             finally:
-                self.router.release(replica)
+                router.release(replica)
+            router.record_latency((time.monotonic() - t0) * 1000.0)
             self._reply(status, out, ctype)
             return
 
@@ -860,22 +1500,46 @@ class _RouterHandler(_Handler):
         body, digest = self._admission_key(raw)
         if body is None:
             return
-        try:
-            replica, _hit = self.router.pick(digest)
-        except FleetUnavailable as exc:
-            self._json(503, {"error": str(exc), "status": "rejected",
-                             "retry": True})
-            return
-        try:
-            status, ctype, out = self.router._forward(
-                replica, "POST", "/session", json.dumps(body).encode())
-        except OSError as exc:
-            self.router.mark_forward_error(replica)
-            self._json(503, {"error": f"replica unreachable ({exc}); "
-                                      "retry", "retry": True})
-            return
-        finally:
+        payload = json.dumps(body).encode()
+        tried: set = set()
+        while True:
+            try:
+                replica, _hit = self.router.pick(digest)
+            except FleetUnavailable as exc:
+                self._json(503, {"error": str(exc),
+                                 "status": "rejected", "retry": True})
+                return
+            if replica.index in tried:
+                self.router.release(replica)
+                self._json(503, {
+                    "error": "every healthy replica refused the "
+                             "session open; retry",
+                    "status": "rejected", "retry": True})
+                return
+            tried.add(replica.index)
+            try:
+                status, ctype, out = self.router._forward(
+                    replica, "POST", "/session", payload)
+            except ForwardNotSent:
+                # Connect refused: no worker saw the open — re-pick.
+                self.router.mark_forward_error(replica)
+                with self.router._lock:
+                    self.router.reroutes += 1
+                self.router.release(replica)
+                continue
+            except OSError as exc:
+                # The open may have been journaled before the socket
+                # died; a blind re-open would mint a second session.
+                self.router.mark_forward_error(replica)
+                self.router.release(replica)
+                self._json(503, {
+                    "error": f"replica failed mid-open ({exc}); "
+                             "outcome unknown — retry with an "
+                             "explicit session_id to stay idempotent",
+                    "status": "unknown", "retry": True})
+                return
             self.router.release(replica)
+            break
         if status == 201:
             try:
                 sid = json.loads(out).get("session_id")
@@ -922,10 +1586,20 @@ class _RouterHandler(_Handler):
 
     def _proxy_sse(self, replica: Replica, path: str):
         """Stream a worker's per-session SSE through: chunks are
-        relayed as they arrive until either side closes."""
+        relayed as they arrive until either side closes.
+
+        The upstream read runs on a SHORT timeout (a few worker
+        keepalive periods) instead of the forward timeout: when the
+        owning replica is SIGKILLed the TCP peer may simply go
+        silent, and a client must observe a clean reconnectable EOF
+        within seconds — not a five-minute hang.  A timeout while the
+        replica is still UP just keeps reading (the worker's 1 s
+        keepalives make that rare)."""
+        read_timeout = max(self.router.heartbeat_s * 8, 3.0)
         try:
             conn = http.client.HTTPConnection(
-                "127.0.0.1", replica.port, timeout=FORWARD_TIMEOUT_S)
+                replica.host, replica.port,
+                timeout=FORWARD_TIMEOUT_S)
             conn.request("GET", path)
             resp = conn.getresponse()
         except OSError as exc:
@@ -942,9 +1616,21 @@ class _RouterHandler(_Handler):
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
         self.end_headers()
+        if conn.sock is not None:
+            conn.sock.settimeout(read_timeout)
         try:
             while not self.telemetry._stopping.is_set():
-                chunk = resp.read1(65536)
+                try:
+                    chunk = resp.read1(65536)
+                except socket.timeout:
+                    if replica.status != UP:
+                        # The owner died under the stream: end it
+                        # cleanly; the client reconnects through the
+                        # router and lands on whoever owns the
+                        # session now (the restarted replica, or a
+                        # survivor that adopted it).
+                        break
+                    continue
                 if not chunk:
                     break
                 self.wfile.write(chunk)

@@ -231,6 +231,11 @@ class SolveService:
         self._journal = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._requests: "OrderedDict[str, SolveRequest]" = OrderedDict()
+        # Outcomes recovered from the journal's completed-with-result
+        # tail (--recover): rid -> wire-form result dict.  Read-mostly
+        # after start(); bounded by journal.COMPLETED_KEEP.
+        self._recovered_results: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._scheduler = None
@@ -325,9 +330,11 @@ class SolveService:
         profiler.enabled = True
         pending = []
         pending_sessions = []
+        recovered_results = []
         if self.journal_dir and self._journal is None:
             if self.recover_on_start:
-                self._journal, pending, pending_sessions = \
+                (self._journal, pending, pending_sessions,
+                 recovered_results) = \
                     journal_mod.RequestJournal.recover_full(
                         self.journal_dir, sync=self.journal_sync)
             else:
@@ -346,6 +353,16 @@ class SolveService:
             # identity-clear exactly this registration.
             self._flight_provider = self.journal_summary
             flight.set_journal_provider(self._flight_provider)
+        if recovered_results:
+            # The predecessor's journaled outcomes: a client still
+            # polling a pre-crash ack gets its 200 from here instead
+            # of a 404 (the in-memory result cache died with the
+            # process).  Live requests shadow this cache — result()
+            # checks ``_requests`` first.
+            with self._lock:
+                for rec in recovered_results:
+                    self._recovered_results[rec["id"]] = (
+                        rec.get("result") or {})
         if pending:
             self._replay(pending)
         if pending_sessions:
@@ -690,7 +707,11 @@ class SolveService:
                         try:
                             self._journal.append(
                                 journal_mod.completed_record(
-                                    rid, ERROR))
+                                    rid, ERROR, result={
+                                        "id": rid, "status": ERROR,
+                                        "error": ("journal replay "
+                                                  f"failed: {exc}"),
+                                    }))
                             self._journal_records.inc(kind="completed")
                         except Exception:
                             logger.warning(
@@ -720,10 +741,16 @@ class SolveService:
                wait: Optional[float] = None) -> Optional[Dict[str, Any]]:
         """The request's result dict, or None while pending.  With
         ``wait`` (seconds), block up to that long for completion.
-        Raises ``KeyError`` for unknown ids."""
+        Ids finished by a crashed predecessor resolve from the
+        recovered-result cache (--recover).  Raises ``KeyError`` for
+        unknown ids."""
         with self._lock:
             req = self._requests.get(request_id)
+            if req is None:
+                recovered = self._recovered_results.get(request_id)
         if req is None:
+            if recovered is not None:
+                return dict(recovered)
             raise KeyError(request_id)
         if wait:
             req.done.wait(wait)
@@ -732,7 +759,11 @@ class SolveService:
     def status(self, request_id: str) -> str:
         with self._lock:
             req = self._requests.get(request_id)
+            if req is None:
+                recovered = self._recovered_results.get(request_id)
         if req is None:
+            if recovered is not None:
+                return recovered.get("status", ERROR)
             raise KeyError(request_id)
         return req.status
 
@@ -742,7 +773,11 @@ class SolveService:
         ids."""
         with self._lock:
             req = self._requests.get(request_id)
+            if req is None:
+                recovered = self._recovered_results.get(request_id)
         if req is None:
+            if recovered is not None and recovered.get("trace_id"):
+                return recovered["trace_id"]
             raise KeyError(request_id)
         return req.trace_id
 
@@ -1297,14 +1332,27 @@ class SolveService:
         return True
 
     def _journal_done(self, req: SolveRequest):
-        """Journal a terminal outcome.  Never raises into the
-        scheduler thread: a failed completion append costs at most
-        one duplicate solve after a crash, never the service."""
+        """Journal a terminal outcome WITH the result payload: the
+        outcome is durable, not just the fact of completion, so a
+        client polling across a crash gets its 200 from the
+        replacement process (journal.completed_results).  Never
+        raises into the scheduler thread: a failed completion append
+        costs at most one duplicate solve after a crash, never the
+        service."""
         if self._journal is None:
             return
         try:
-            self._journal.append(
-                journal_mod.completed_record(req.id, req.status))
+            try:
+                rec = journal_mod.completed_record(
+                    req.id, req.status, result=req.result)
+                journal_mod.encode_record(rec)
+            except (TypeError, ValueError):
+                # A result that will not serialize (should not
+                # happen — it is served as JSON) degrades to the
+                # payload-less tombstone rather than losing the
+                # terminal record entirely.
+                rec = journal_mod.completed_record(req.id, req.status)
+            self._journal.append(rec)
             self._journal_records.inc(kind="completed")
         except Exception as exc:  # noqa: BLE001
             logger.warning("journal completion append failed for "

@@ -38,6 +38,7 @@ import contextlib
 import logging
 import os
 import queue
+import tempfile
 import threading
 import time
 import uuid
@@ -61,11 +62,21 @@ logger = logging.getLogger("pydcop.serving.sessions")
 # Session states.  OPEN sessions accept events and run segments;
 # CLOSED/ERROR are terminal; REPLAYABLE is terminal for THIS process
 # only — the journal still holds the session, a --recover restart
-# resumes it.
+# resumes it.  MIGRATING freezes new event acks while a migration
+# export drains the session (serving/migration.py) — it resolves to
+# MIGRATED (terminal here: another replica owns the warm engine now)
+# or back to OPEN when the move fails.
 OPEN = "OPEN"
 CLOSED = "CLOSED"
 ERROR = "ERROR"
 REPLAYABLE = "REPLAYABLE"
+MIGRATING = "MIGRATING"
+MIGRATED = "MIGRATED"
+
+# checkpoint_session sentinel: "compute the rebased problem yourself"
+# vs. an explicit rebased yaml (or None for a plain marker) the
+# export path already computed.
+_UNSET = object()
 
 # Session solver parameters and their defaults.  ``max_cycles`` is the
 # re-convergence budget per ACTIVATION (open, or one event batch);
@@ -271,6 +282,14 @@ class SolveSession:
     error: Optional[str] = None
     done: threading.Event = field(default_factory=threading.Event)
     subscribers: List["queue.Queue"] = field(default_factory=list)
+    # In-memory copy of every acknowledged batch (seq/events/
+    # trace_id), the migration-export fallback when the engine's
+    # current problem can't be rebased to yaml: bundle = base problem
+    # + this log.  Trimmed at every REBASED checkpoint (the base
+    # advances past those batches), so it holds at most one
+    # checkpoint interval of events — except on the rare rebase-
+    # failure path, where it must keep the full tail.
+    event_log: List[Dict[str, Any]] = field(default_factory=list)
     # Serializes seq-assign + journal append + enqueue for THIS
     # session: concurrent PATCHes must reach the journal and the
     # queue in seq order, or crash replay (which applies in seq
@@ -287,7 +306,7 @@ class SessionWork:
     flushes — session mutations and segments interleave with batched
     one-shot dispatches on the single device-owning thread."""
 
-    kind: str                # "events" | "segment" | "close"
+    kind: str                # "events" | "segment" | "close" | "export"
     session: SolveSession
     events: Optional[List[Dict[str, Any]]] = None
     seq: int = 0
@@ -296,6 +315,10 @@ class SessionWork:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[Dict[str, Any]] = None
     error: Optional[str] = None
+    # Export drain: the work re-enqueued ITSELF behind acked event
+    # batches still in the queue — run_work must not wake the waiter
+    # yet (see _work_export).
+    deferred: bool = False
 
 
 class SessionManager:
@@ -328,6 +351,8 @@ class SessionManager:
         self.closed = 0
         self.errored = 0
         self.replayed_sessions = 0
+        self.migrated_in = 0
+        self.migrated_out = 0
         reg = metrics_registry
         self._active_g = reg.gauge(
             "pydcop_sessions_active",
@@ -456,6 +481,15 @@ class SessionManager:
         # across it also makes the failure rollback safe (no other
         # thread can have taken a later seq meanwhile).
         with sess.order_lock:
+            # Re-check under the SAME lock a migration export uses to
+            # freeze the session: a batch acked after the export
+            # drained would be journaled here but absent from the
+            # bundle — a lost acked event on the target.  Holding
+            # order_lock makes freeze-vs-ack atomic (409: the client
+            # retries against the new owner).
+            if sess.status != OPEN:
+                raise SessionClosed(
+                    f"session {session_id} is {sess.status}")
             with self._lock:
                 sess.seq += 1
                 seq = sess.seq
@@ -472,6 +506,8 @@ class SessionManager:
                     raise RuntimeError(
                         f"session journal append failed: {exc}"
                     ) from exc
+            sess.event_log.append({"seq": seq, "events": events,
+                                   "trace_id": batch_trace})
             work = SessionWork("events", sess, events=events,
                                seq=seq, trace_id=batch_trace)
             # Event work is an acked durable batch: it may WAIT for
@@ -532,6 +568,82 @@ class SessionManager:
             raise RuntimeError(work.error)
         return dict(sess.final or {})
 
+    # -- migration (serving/migration.py drives these) ----------------- #
+
+    def export_session(self, session_id: str,
+                       wait: float = 60.0) -> Dict[str, Any]:
+        """Drain-checkpoint a session for migration and return its
+        bundle.  The session is left MIGRATING: new PATCHes 409
+        until :meth:`retire_session` (move succeeded) or
+        :meth:`resume_session` (move failed) resolves it."""
+        sess = self._get(session_id)
+        if sess.status != OPEN:
+            raise SessionClosed(
+                f"session {session_id} is {sess.status}")
+        work = SessionWork("export", sess)
+        if not self._enqueue(work, block_s=10.0):
+            raise RuntimeError(
+                "service queue full: session export could not be "
+                "scheduled")
+        work.done.wait(wait)
+        if not work.done.is_set():
+            raise TimeoutError(
+                f"session {session_id} export timed out after "
+                f"{wait}s")
+        if work.error is not None:
+            raise RuntimeError(work.error)
+        return work.result or {}
+
+    def resume_session(self, session_id: str) -> Dict[str, Any]:
+        """Un-freeze a MIGRATING session after a failed move: back to
+        OPEN with a fresh re-convergence budget — the session must
+        never have zero owners."""
+        sess = self._get(session_id)
+        with sess.order_lock:
+            if sess.status != MIGRATING:
+                raise SessionClosed(
+                    f"session {session_id} is {sess.status}")
+            sess.status = OPEN
+            sess.budget = sess.params["max_cycles"]
+        self._refresh_gauge()
+        self._publish(sess, "resumed")
+        self._enqueue(SessionWork("segment", sess))
+        return {"session_id": sess.id, "status": OPEN}
+
+    def retire_session(self, session_id: str,
+                       moved_to: Optional[str] = None
+                       ) -> Dict[str, Any]:
+        """Finish a migration on the source side: journal a MIGRATED
+        close (this segment's --recover must not resurrect what the
+        target now owns), retire the checkpoint and end the SSE
+        streams — subscribers get a terminal ``migrated`` event, then
+        reconnect through the router and land on the new owner.
+        Idempotent for already-MIGRATED sessions."""
+        sess = self._get(session_id)
+        with sess.order_lock:
+            if sess.status == MIGRATED and sess.final is not None:
+                return dict(sess.final)
+            if sess.status != MIGRATING:
+                raise SessionClosed(
+                    f"session {session_id} is {sess.status}")
+            sess.status = MIGRATED
+        sess.final = {
+            "session_id": sess.id,
+            "trace_id": sess.trace_id,
+            "status": MIGRATED,
+        }
+        if moved_to:
+            sess.final["moved_to"] = moved_to
+        self.migrated_out += 1
+        self._sessions_total.inc(status="migrated")
+        self._journal_close(sess, MIGRATED)
+        self._retire_ckpt(sess)
+        self._refresh_gauge()
+        self._publish(sess, "migrated",
+                      {"moved_to": moved_to} if moved_to else None)
+        sess.done.set()
+        return dict(sess.final)
+
     def status(self, session_id: str) -> Dict[str, Any]:
         sess = self._get(session_id)
         with self._lock:
@@ -569,8 +681,10 @@ class SessionManager:
         excess = len(self._sessions) - self.session_keep
         if excess <= 0:
             return
+        # MIGRATING is live-adjacent, not terminal: its client still
+        # holds the id and the move may resolve back to OPEN.
         for sid in [sid for sid, s in self._sessions.items()
-                    if s.status != OPEN][:excess]:
+                    if s.status not in (OPEN, MIGRATING)][:excess]:
             del self._sessions[sid]
 
     def _enqueue(self, work: SessionWork,
@@ -669,7 +783,14 @@ class SessionManager:
         is attributable to the session like a one-shot request's
         dispatch spans."""
         sess = work.session
-        if sess.status != OPEN:
+        # MIGRATING still runs "events" (acked batches queued before
+        # the export freeze MUST apply — the export re-enqueues
+        # itself behind them) and "export" itself; everything else
+        # needs OPEN.
+        allowed = (sess.status == OPEN
+                   or (sess.status == MIGRATING
+                       and work.kind in ("events", "export")))
+        if not allowed:
             work.error = f"session is {sess.status}"
             work.done.set()
             return
@@ -686,6 +807,8 @@ class SessionManager:
                     self._work_segment(sess)
                 elif work.kind == "close":
                     self._work_close(work)
+                elif work.kind == "export":
+                    self._work_export(work)
                 else:
                     raise ValueError(
                         f"unknown session work {work.kind!r}")
@@ -696,7 +819,8 @@ class SessionManager:
             self._fail(sess, f"{work.kind} failed: {exc}")
             work.error = str(exc)
         finally:
-            work.done.set()
+            if not work.deferred:
+                work.done.set()
 
     def _work_events(self, work: SessionWork) -> None:
         """Apply one acknowledged batch between segments: array
@@ -845,6 +969,91 @@ class SessionManager:
         work.result = sess.final
         sess.done.set()
 
+    def _work_export(self, work: SessionWork) -> None:
+        """Drain-checkpoint the session into a migration bundle
+        (scheduler thread).  Freeze first (new acks 409 under the
+        same order_lock apply_events holds), then make sure every
+        ALREADY-acked batch has applied: if any are still queued
+        behind this work, re-enqueue ourselves after them
+        (``deferred`` keeps the waiter blocked) — the freeze bounds
+        the loop to the batches acked before it.  Any failure resumes
+        the session: a failed export must never cost an owner."""
+        sess = work.session
+        work.deferred = False
+        with sess.order_lock:
+            if sess.status not in (OPEN, MIGRATING):
+                work.error = f"session is {sess.status}"
+                return
+            sess.status = MIGRATING
+            if sess.applied_seq != sess.seq:
+                work.deferred = True
+                if not self._enqueue(work):
+                    work.deferred = False
+                    work.error = ("service queue full during export "
+                                  "drain")
+                    sess.status = OPEN
+                return
+        try:
+            from pydcop_tpu.serving import migration as migration_mod
+
+            rebased = None
+            try:
+                rebased = migration_mod.engine_dcop_yaml(
+                    sess.engine, name=f"session_{sess.id}")
+            except Exception as exc:  # noqa: BLE001 — fall back to
+                # base problem + the acked-batch log.
+                logger.info(
+                    "session %s: problem rebase failed (%s); "
+                    "bundling base problem + %d event batch(es)",
+                    sess.id, exc, len(sess.event_log))
+            npz_bytes = None
+            ckpt_seq = None
+            if self.checkpoint_session(sess, rebased_yaml=rebased):
+                path = self._ckpt_path(sess)
+                with contextlib.suppress(OSError):
+                    with open(path, "rb") as f:
+                        npz_bytes = f.read()
+            elif sess.engine._state is not None:
+                # Journal-less service: snapshot straight into the
+                # bundle via a throwaway tmp file.
+                fd, tmp = tempfile.mkstemp(suffix=".npz")
+                os.close(fd)
+                try:
+                    sess.engine.checkpoint(tmp)
+                    with open(tmp, "rb") as f:
+                        npz_bytes = f.read()
+                except Exception as exc:  # noqa: BLE001 — a cold
+                    # import beats a failed migration.
+                    logger.warning(
+                        "session %s: export snapshot failed (%s); "
+                        "bundle ships without warm state",
+                        sess.id, exc)
+                finally:
+                    with contextlib.suppress(OSError):
+                        os.unlink(tmp)
+            if npz_bytes is not None:
+                ckpt_seq = sess.applied_seq
+            work.result = migration_mod.build_bundle(
+                sess.id, sess.trace_id,
+                rebased or sess.dcop_yaml,
+                rebased=rebased is not None,
+                params=sess.params,
+                seq=sess.seq,
+                cycle=sess.last_cycle,
+                events=(None if rebased is not None
+                        else list(sess.event_log)),
+                npz_bytes=npz_bytes,
+                ckpt_seq=ckpt_seq,
+            )
+            self._publish(sess, "migrating")
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("session %s export failed", sess.id)
+            work.error = f"export failed: {exc}"
+            with sess.order_lock:
+                if sess.status == MIGRATING:
+                    sess.status = OPEN
+            self._enqueue(SessionWork("segment", sess))
+
     def _fail(self, sess: SolveSession, message: str) -> None:
         sess.error = message
         sess.status = ERROR
@@ -883,15 +1092,40 @@ class SessionManager:
         return os.path.join(self.service.journal_dir,
                             f"session_{sess.id}.npz")
 
-    def checkpoint_session(self, sess: SolveSession) -> bool:
+    def checkpoint_session(self, sess: SolveSession,
+                           rebased_yaml: Any = _UNSET) -> bool:
         """Snapshot the engine's warm message state next to the
         journal (tmp+rename — a crash mid-write leaves the previous
         snapshot) and journal the marker.  Returns True when a
         checkpoint landed.  Only meaningful on the scheduler thread
-        (or after it stopped: the stop() park path)."""
+        (or after it stopped: the stop() park path).
+
+        The marker is REBASED whenever the engine's current problem
+        serializes back to yaml (serving/migration.engine_dcop_yaml):
+        recovery then rebuilds the factor layout from the marker
+        alone and compaction drops the pre-checkpoint event tail —
+        replay time is bounded by the checkpoint cadence, not session
+        age (the ISSUE-16 recovery bound).  Pass ``rebased_yaml``
+        (a yaml string, or None for a plain marker) to skip the
+        recompute when the caller already serialized it."""
         path = self._ckpt_path(sess)
         if path is None or sess.engine._state is None:
             return False
+        if rebased_yaml is _UNSET:
+            try:
+                from pydcop_tpu.serving import (
+                    migration as migration_mod)
+
+                rebased_yaml = migration_mod.engine_dcop_yaml(
+                    sess.engine, name=f"session_{sess.id}")
+            except Exception as exc:  # noqa: BLE001 — a plain
+                # (un-rebased) marker is the pre-ISSUE-16 behavior:
+                # strictly worse replay time, never worse
+                # correctness.
+                logger.info(
+                    "session %s: checkpoint rebase failed (%s); "
+                    "writing a plain marker", sess.id, exc)
+                rebased_yaml = None
         # np.savez appends ".npz" to names without it: the tmp name
         # must already end in .npz or the rename source won't exist.
         tmp = path + ".tmp.npz"
@@ -902,7 +1136,7 @@ class SessionManager:
             if journal is not None:
                 journal.append(journal_mod.session_ckpt_record(
                     sess.id, sess.applied_seq, path,
-                    cycle=sess.last_cycle))
+                    cycle=sess.last_cycle, dcop=rebased_yaml))
                 self.service._journal_records.inc(
                     kind="session_ckpt")
         except Exception as exc:  # noqa: BLE001 — a failed snapshot
@@ -913,6 +1147,17 @@ class SessionManager:
                 os.unlink(tmp)
             return False
         sess.events_since_ckpt = 0
+        if rebased_yaml:
+            # The base problem advanced past every batch through
+            # applied_seq: the in-memory fallback log (and the
+            # export-bundle base) advance with it.  order_lock —
+            # apply_events appends to the log under it, so the
+            # filter-and-replace can't drop a concurrent ack.
+            with sess.order_lock:
+                sess.dcop_yaml = rebased_yaml
+                sess.event_log[:] = [
+                    r for r in sess.event_log
+                    if r.get("seq", 0) > sess.applied_seq]
         return True
 
     def _maybe_checkpoint(self, sess: SolveSession) -> None:
@@ -980,7 +1225,14 @@ class SessionManager:
 
     def _recover_one(self, load_dcop, open_rec, ckpt_rec,
                      event_recs) -> SolveSession:
-        dcop = load_dcop(open_rec["dcop"])
+        # A REBASED checkpoint marker carries the session's problem
+        # as of its seq (engine_dcop_yaml): the factor layout
+        # rebuilds from the marker alone and the pre-checkpoint
+        # batches (already dropped by journal compaction) never
+        # replay — recovery work is bounded by the checkpoint
+        # cadence, not session age.
+        base_yaml = (ckpt_rec or {}).get("dcop") or open_rec["dcop"]
+        dcop = load_dcop(base_yaml)
         params = normalize_session_params(
             open_rec.get("params") or {})
         engine = build_dynamic_engine(dcop, params)
@@ -989,7 +1241,7 @@ class SessionManager:
             id=open_rec["id"],
             trace_id=(open_rec.get("trace_id")
                       or uuid.uuid4().hex[:16]),
-            dcop_yaml=open_rec["dcop"],
+            dcop_yaml=base_yaml,
             params=params,
             engine=engine,
             budget=params["max_cycles"],
@@ -1039,9 +1291,18 @@ class SessionManager:
         # batch-scoped, same as live): both counters land on the max
         # journaled seq.
         sess.seq = max(
-            (r.get("seq", 0) for r in event_recs), default=0)
+            [r.get("seq", 0) for r in event_recs]
+            + [(ckpt_rec or {}).get("seq", 0)] or [0])
         sess.applied_seq = sess.seq
         sess.events_applied = applied
+        # Seed the migration-export fallback log with the batches
+        # the base problem does NOT already include.
+        base_seq = ((ckpt_rec or {}).get("seq", 0)
+                    if (ckpt_rec or {}).get("dcop") else -1)
+        sess.event_log = [
+            {"seq": r.get("seq", 0), "events": r.get("events") or [],
+             "trace_id": r.get("trace_id", "")}
+            for r in event_recs if r.get("seq", 0) > base_seq]
         with self._lock:
             self._sessions[sess.id] = sess
         self._publish(sess, "open", {"replayed": True})
@@ -1058,8 +1319,13 @@ class SessionManager:
         waiter.  Returns the parked-session count.  Runs after the
         scheduler halted, so touching the engines is safe."""
         with self._lock:
+            # MIGRATING parks too: a stop mid-migration leaves the
+            # journal authoritative — no close record was written, so
+            # a --recover restart resumes the session here (worst
+            # case the target ALSO imported it; the router pin
+            # decides the owner).
             open_sessions = [s for s in self._sessions.values()
-                             if s.status == OPEN]
+                             if s.status in (OPEN, MIGRATING)]
         journaled = self.service._journal is not None
         for sess in open_sessions:
             if journaled:
@@ -1101,6 +1367,8 @@ class SessionManager:
                 "closed": self.closed,
                 "errored": self.errored,
                 "replayed": self.replayed_sessions,
+                "migrated_in": self.migrated_in,
+                "migrated_out": self.migrated_out,
                 "max_sessions": self.max_sessions,
                 "events_applied": sum(
                     s.events_applied

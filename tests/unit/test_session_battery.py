@@ -821,7 +821,7 @@ class TestSessionJournal:
         jnl.append(session_open_record("gone", "y2", {}))
         jnl.append(session_close_record("gone", "CLOSED"))
         jnl.close()
-        jnl2, pending, sessions = \
+        jnl2, pending, sessions, _results = \
             journal_mod.RequestJournal.recover_full(journal_dir)
         jnl2.close()
         assert pending == []

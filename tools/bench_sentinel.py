@@ -85,7 +85,16 @@ def load_history(root: str) -> List[Dict[str, Any]]:
         except (OSError, ValueError) as exc:
             runs.append({"source": name, "skipped": str(exc)})
             continue
-        parsed = doc.get("parsed") or {}
+        # A brand-new (or hand-edited) history may hold JSON that is
+        # valid but not a run document — a bare list, a string.  Skip
+        # it like an unreadable file, never crash the sentinel.
+        if not isinstance(doc, dict):
+            runs.append({"source": name,
+                         "skipped": "not a JSON object"})
+            continue
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            parsed = {}
         value = parsed.get("value")
         if value is None:
             runs.append({"source": name,
@@ -150,6 +159,13 @@ def load_history(root: str) -> List[Dict[str, Any]]:
                 parsed.get("fleet_problems_per_sec_r2")),
             "cold_start_value": _opt_float(
                 parsed.get("serve_cold_start_warm_s")),
+            # Elastic-fleet leg (ISSUE 16 bench_fleet_elastic):
+            # baseline closed-loop problems/sec through the two-host
+            # fleet that also survives the leg's migration, 4x-step
+            # autoscale, and host-kill phases — absent before PR 16,
+            # None when the leg failed that round.
+            "fleet_elastic_value": _opt_float(
+                parsed.get("fleet_elastic_problems_per_sec")),
             # The p99 latency exemplar from the serving leg (ISSUE
             # 9): when the newest run regresses, the report points at
             # a concrete request trace instead of a bare number.
@@ -172,6 +188,8 @@ def load_history(root: str) -> List[Dict[str, Any]]:
         try:
             with open(last_path, encoding="utf-8") as f:
                 doc = json.load(f)
+            if not isinstance(doc, dict):
+                raise ValueError("not a JSON object")
             value = doc.get("value")
             if value is not None:
                 runs.append({
@@ -297,6 +315,12 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
          "backend", True, "serving_fleet"),
         ("serve_cold_start", "cold_start_value", "s",
          "backend", False, "serve_cold_start"),
+        # ISSUE 16: steady-state throughput through the elastic
+        # two-host fleet — the rate the migration/autoscale/host-kill
+        # machinery must not tax.  A brand-new family: until 3 rounds
+        # exist its verdict is "insufficient", never a crash or gate.
+        ("fleet_elastic", "fleet_elastic_value", "problems/s",
+         "backend", True, "fleet_elastic"),
         ("shard_recovery", "shard_recovery_value", "s",
          "sharded_backend", False, "sharded"),
         # ISSUE 13: the stateful-session families — sustained

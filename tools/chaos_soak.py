@@ -809,6 +809,251 @@ def scenario_replica_kill(seed, trace):
             "deaths": stats["deaths"]}
 
 
+def _session_chaos_problem(seed):
+    """Path-topology dynamic session problem + event batches +
+    uninterrupted reference cost.  Path topology: max-sum is exact
+    there, so cost equality across a migration/kill is a hard
+    assertion, not a tolerance (same recipe as session_replay)."""
+    import numpy as np
+
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+    from pydcop_tpu.engine.dynamic import build_dynamic_engine
+    from pydcop_tpu.serving.sessions import apply_event_batch
+
+    rng = np.random.default_rng(seed)
+    params = {"noise": 0.01, "stability": 0.001, "max_cycles": 500}
+    d = Domain("c", "", [0, 1, 2])
+    dcop = DCOP(f"soak_mig_{seed}", objective="min")
+    vs = [Variable(f"v{i}", d) for i in range(10)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(9):
+        table = rng.integers(0, 10, size=(3, 3)).astype(float)
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[k], vs[k + 1]], table, f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    batches = [
+        [{"type": "change_factor",
+          "name": f"c{int(rng.integers(9))}",
+          "table": rng.integers(0, 10, size=(3, 3))
+          .astype(float).tolist()}]
+        for _ in range(5)
+    ]
+    ref = build_dynamic_engine(dcop, params)
+    ref.run(max_cycles=params["max_cycles"])
+    for batch in batches:
+        _applied, _touched, error = apply_event_batch(ref, batch)
+        assert error is None, f"reference batch failed: {error}"
+        ref.run(max_cycles=params["max_cycles"])
+    expected = ref.cost(
+        ref.run(max_cycles=params["max_cycles"]).assignment)
+    return dcop, params, batches, expected
+
+
+def _fleet_request(url, method="GET", payload=None, timeout=60):
+    import json
+    import urllib.error
+    import urllib.request
+
+    data = (json.dumps(payload).encode()
+            if payload is not None else None)
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def _patch_until_acked(url, sid, batch, deadline_s=90):
+    """PATCH with the elastic-fleet client contract: 409 means the
+    session is frozen MIGRATING (retry lands on the new owner through
+    the repointed pin), 503 means the owner is being
+    recovered/adopted.  Both resolve; anything else is a failure."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        status, out = _fleet_request(
+            url + f"/session/{sid}/events", "PATCH",
+            {"events": batch, "wait": True, "timeout": 30.0})
+        if status == 200:
+            return out
+        assert status in (409, 503), \
+            f"PATCH failed non-retryably: {status} {out}"
+        assert time.monotonic() < deadline, \
+            f"PATCH never recovered: last {status} {out}"
+        time.sleep(0.2)
+
+
+def scenario_session_migrate(seed, trace):
+    """ISSUE 16 live migration under PATCH traffic: a warm session is
+    migrated between replicas (operator ``POST /admin/migrate``)
+    while a client keeps streaming event batches.  Every acked batch
+    must survive the move — the final cost equals the uninterrupted
+    single-engine run on integer tables (hard equality, path
+    topology) — and the router pin must point at the new owner."""
+    import threading
+
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    dcop, params, batches, expected = _session_chaos_problem(seed)
+    journal_dir = tempfile.mkdtemp(prefix="soak_mig_")
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.05,
+                       journal_dir=journal_dir, heartbeat_s=0.15)
+    try:
+        url = handle.url
+        status, body = _fleet_request(
+            url + "/session", "POST",
+            {"dcop": dcop_yaml(dcop), "params": params})
+        assert status == 201, f"open failed: {status} {body}"
+        sid = body["session_id"]
+        _patch_until_acked(url, sid, batches[0])
+        _patch_until_acked(url, sid, batches[1])
+        source = handle.router.pinned(
+            sid, handle.router._session_pins)
+
+        migrate_result = {}
+
+        def _migrate():
+            migrate_result["reply"] = _fleet_request(
+                url + "/admin/migrate", "POST",
+                {"session_id": sid}, timeout=120)
+
+        mover = threading.Thread(target=_migrate, daemon=True)
+        mover.start()
+        # Live PATCH traffic DURING the move: the freeze window 409s,
+        # the retry lands on whichever side owns the session.
+        for batch in batches[2:]:
+            _patch_until_acked(url, sid, batch)
+        mover.join(timeout=120)
+        assert not mover.is_alive(), "/admin/migrate hung"
+        status, out = migrate_result["reply"]
+        assert status == 200, f"migrate failed: {status} {out}"
+        target = handle.router.pinned(
+            sid, handle.router._session_pins)
+        assert target.index != source.index, \
+            "router pin did not move with the session"
+
+        st = {}
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            _code, st = _fleet_request(url + f"/session/{sid}")
+            last = st.get("last")
+            if last and last.get("converged"):
+                break
+            time.sleep(0.05)
+        assert st.get("applied_seq") == len(batches), \
+            f"acked batches lost across migration: {st}"
+        status, final = _fleet_request(url + f"/session/{sid}",
+                                       "DELETE")
+        assert status == 200, f"close failed: {status} {final}"
+        assert final["cost"] == expected, \
+            f"migrated session cost {final['cost']} != " \
+            f"uninterrupted {expected}"
+        stats = handle.router.stats()
+        assert stats["migrations"] == 1, stats["migrations"]
+    finally:
+        handle.stop()
+        shutil.rmtree(journal_dir, ignore_errors=True)
+    return {"final_cost": expected,
+            "from": source.index, "to": target.index}
+
+
+def scenario_host_kill(seed, trace):
+    """ISSUE 16 host death: a 4-replica fleet striped over 2
+    simulated hosts loses ALL of host0's replicas (SIGKILL) mid-burst
+    with a warm session pinned somewhere.  Zero acked solve requests
+    lost (journal replay through the restarted slots), zero acked
+    session events lost (the session is adopted by a survivor if its
+    owner died), and the fleet heals back to 4 up."""
+    import signal as signal_mod
+
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    dcop, params, batches, expected = _session_chaos_problem(seed)
+    journal_dir = tempfile.mkdtemp(prefix="soak_hostkill_")
+    handle = api.serve(port=0, replicas=4, hosts=2,
+                       batch_window_s=0.25, max_batch=8,
+                       journal_dir=journal_dir, heartbeat_s=0.15)
+    try:
+        url = handle.url
+        status, body = _fleet_request(
+            url + "/session", "POST",
+            {"dcop": dcop_yaml(dcop), "params": params})
+        assert status == 201, f"open failed: {status} {body}"
+        sid = body["session_id"]
+        _patch_until_acked(url, sid, batches[0])
+        _patch_until_acked(url, sid, batches[1])
+
+        acked = []
+        for i in range(10):
+            inst = _serve_instance(10, seed * 1000 + i)
+            status, body = _fleet_request(
+                url + "/solve",
+                "POST", {"dcop": dcop_yaml(inst),
+                         "params": {"max_cycles": 150}})
+            assert status == 202, f"burst request {i}: {status}"
+            acked.append(body["id"])
+
+        # Mid-burst: kill EVERY replica of host0 at once.
+        victims = [r for r in handle.router.replicas
+                   if r.host_id == "host0"]
+        assert len(victims) == 2, \
+            [r.host_id for r in handle.router.replicas]
+        for victim in victims:
+            os.kill(victim.proc.pid, signal_mod.SIGKILL)
+
+        done = {}
+        deadline = time.monotonic() + 180
+        while len(done) < len(acked) \
+                and time.monotonic() < deadline:
+            for rid in acked:
+                if rid in done:
+                    continue
+                code, out = _fleet_request(
+                    url + f"/result/{rid}", timeout=10)
+                if code == 200:
+                    done[rid] = out
+            time.sleep(0.1)
+        lost = sorted(set(acked) - set(done))
+        assert not lost, \
+            f"{len(lost)} acked request(s) lost to the host kill: " \
+            f"{lost}"
+        assert all(r["status"] == "FINISHED"
+                   for r in done.values()), \
+            {k: v["status"] for k, v in done.items()
+             if v["status"] != "FINISHED"}
+
+        # Every acked session event survived — through adoption when
+        # the owner died with its host, in place otherwise.
+        _patch_until_acked(url, sid, batches[2], deadline_s=180)
+        _code, st = _fleet_request(url + f"/session/{sid}")
+        assert st.get("seq") == 3 and st.get("applied_seq") == 3, \
+            f"acked session events lost: {st}"
+        status, final = _fleet_request(url + f"/session/{sid}",
+                                       "DELETE")
+        assert status == 200, f"close failed: {status} {final}"
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if handle.router.up_count() == 4:
+                break
+            time.sleep(0.1)
+        stats = handle.router.stats()
+        assert stats["up"] == 4, \
+            f"fleet never healed: {stats['up']}/4 up"
+        assert stats["deaths"] == 2, stats["deaths"]
+    finally:
+        handle.stop()
+        shutil.rmtree(journal_dir, ignore_errors=True)
+    return {"acked": len(acked), "completed": len(done),
+            "deaths": stats["deaths"],
+            "session_events": st["applied_seq"]}
+
+
 def scenario_anomaly_postmortem(seed, trace):
     """ISSUE 9 anomaly path: an injected guard trip, with file
     tracing OFF and only the always-on flight recorder attached,
@@ -877,6 +1122,8 @@ SCENARIOS = [
     ("session_replay", scenario_session_replay),
     ("serve_poison_bin", scenario_serve_poison_bin),
     ("replica_kill", scenario_replica_kill),
+    ("session_migrate", scenario_session_migrate),
+    ("host_kill", scenario_host_kill),
     ("shard_trip_repartition", scenario_shard_trip_repartition),
     ("anomaly_postmortem", scenario_anomaly_postmortem),
     ("decimation_guard_trip", scenario_decimation_guard_trip),
@@ -898,6 +1145,8 @@ QUICK_GATE = [
     "session_replay",
     "serve_poison_bin",
     "replica_kill",
+    "session_migrate",
+    "host_kill",
     "shard_trip_repartition",
     "anomaly_postmortem",
     "decimation_guard_trip",

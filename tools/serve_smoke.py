@@ -48,6 +48,11 @@ Starts the real service on port 0 and drives it over HTTP:
    burst against a real 2-worker fleet behind the structure-affinity
    router answers every request bit-identical to solo ``api.solve``,
    with affinity accounting on /stats and a clean whole-fleet drain.
+9. **Elastic-fleet migration** (ISSUE 16 acceptance): an operator
+   ``POST /admin/migrate`` moves a warm session between replicas of a
+   host-striped fleet with zero acked events lost — the router pin
+   follows the session and the fairness/migration control surfaces
+   are live on /stats.
 
 Run:  python tools/serve_smoke.py      (exit 0 = all claims hold)
 """
@@ -536,6 +541,111 @@ def leg_fleet_burst():
           f"({summary['workers']})")
 
 
+def leg_elastic_fleet():
+    """ISSUE 16 acceptance (smoke slice): on a real 2-replica fleet,
+    an operator ``POST /admin/migrate`` moves a warm session between
+    replicas with zero acked events lost — PATCHes before and after
+    the move all land, the router pin follows the session, the final
+    close answers from the new owner — and the elastic control
+    surfaces (fairness ledger, migrations counter, per-host worker
+    identity) are all live on /stats."""
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    handle = api.serve(port=0, replicas=2, hosts=2,
+                       batch_window_s=0.1, max_batch=8,
+                       heartbeat_s=0.2)
+    try:
+        url = handle.url
+        base = build_path_instance(10, 1606)
+        rng = np.random.default_rng(1606)
+        params = {"noise": 0.01, "stability": 0.001,
+                  "max_cycles": 500}
+        req = urllib.request.Request(
+            url + "/session",
+            data=json.dumps({"dcop": dcop_yaml(base),
+                             "params": params}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            ack = json.loads(resp.read())
+            check(resp.status == 201 and ack.get("session_id"),
+                  "fleet session opened (201 + id)")
+        sid = ack["session_id"]
+
+        def patch(batch):
+            deadline = time.monotonic() + 90
+            while True:
+                req = urllib.request.Request(
+                    url + f"/session/{sid}/events",
+                    data=json.dumps({"events": batch,
+                                     "wait": True}).encode(),
+                    method="PATCH",
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=60) as resp:
+                        return json.loads(resp.read())
+                except urllib.error.HTTPError as err:
+                    check(err.code in (409, 503)
+                          and time.monotonic() < deadline,
+                          f"PATCH retryable during migration "
+                          f"(got {err.code})")
+                    time.sleep(0.2)
+
+        batch = [{"type": "change_factor", "name": "c3",
+                  "table": rng.integers(0, 10, size=(3, 3))
+                  .astype(float).tolist()}]
+        patch(batch)
+        source = handle.router.pinned(
+            sid, handle.router._session_pins)
+        req = urllib.request.Request(
+            url + "/admin/migrate",
+            data=json.dumps({"session_id": sid}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            moved = json.loads(resp.read())
+            check(resp.status == 200
+                  and moved["from"] == source.index,
+                  f"operator migrate moved the session "
+                  f"({moved['from']} -> {moved['to']})")
+        target = handle.router.pinned(
+            sid, handle.router._session_pins)
+        check(target.index != source.index,
+              "router pin repointed to the new owner")
+        out = patch(batch)
+        check(out["seq"] == 2,
+              "post-migration PATCH acked on the new owner "
+              f"(seq {out['seq']})")
+        with urllib.request.urlopen(url + f"/session/{sid}",
+                                    timeout=30) as resp:
+            st = json.loads(resp.read())
+        check(st["applied_seq"] == 2 or st["seq"] == 2,
+              f"zero acked events lost across the move ({st['seq']}"
+              f"/{st['applied_seq']})")
+        req = urllib.request.Request(url + f"/session/{sid}",
+                                     method="DELETE")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            final = json.loads(resp.read())
+        check(resp.status == 200 and final["status"] == "CLOSED",
+              "migrated session closes cleanly on the new owner")
+        with urllib.request.urlopen(url + "/stats",
+                                    timeout=30) as resp:
+            stats = json.loads(resp.read())
+        check(stats["migrations"] == 1,
+              f"migrations counter on /stats ({stats['migrations']})")
+        check(stats["fairness"]["admitted"] >= 0
+              and "active" in stats["fairness"],
+              "weighted-fair admission ledger on /stats")
+        hosts = {w["host_id"] for w in stats["workers"]}
+        check(hosts == {"host0", "host1"},
+              f"replicas striped over simulated hosts ({hosts})")
+    finally:
+        summary = handle.stop()
+    check([w["exit"] for w in summary["workers"]] == [0, 0],
+          "elastic fleet drain: every worker exited 0 "
+          f"({summary['workers']})")
+
+
 KILL9_BURST = 10
 
 
@@ -964,6 +1074,7 @@ def main() -> int:
     leg_efficiency()
     leg_overload()
     leg_fleet_burst()
+    leg_elastic_fleet()
     leg_kill9_replay()
     leg_session_replay()
     leg_sigterm_drain()
