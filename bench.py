@@ -1759,6 +1759,79 @@ def bench_serve_cold_start():
         shutil.rmtree(run_dir, ignore_errors=True)
 
 
+DPOP_EXACT_N = 300
+DPOP_EXACT_D = 8
+DPOP_EXACT_REPS = 5
+
+
+def build_dpop_exact_dcop(n: int = DPOP_EXACT_N,
+                          d: int = DPOP_EXACT_D, seed: int = 1709):
+    """Width-bounded exact-inference instance: a random spanning tree
+    (induced width stays small) over a mid-sized domain, seeded so
+    every round solves the same problem."""
+    import numpy as np
+
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(seed)
+    dom = Domain("c", "", list(range(d)))
+    dcop = DCOP("dpop_exact", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(1, n):
+        p = int(rng.integers(max(0, i - 3), i))
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[p], vs[i]], rng.random((d, d)), f"c{i}"))
+    # Short-range cross edges push the induced width past 1 so the
+    # UTIL sweep carries real separators, while the bounded bandwidth
+    # keeps the hypercubes far under the element cap.
+    for k in range(5, n, 5):
+        lo = max(0, k - 4)
+        q = int(rng.integers(lo, k))
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[q], vs[k]], rng.random((d, d)), f"x{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def bench_dpop_exact():
+    """Exact-inference leg: warmed, best-of-N wall time for a full
+    DPOP sweep (UTIL up + VALUE down, CEC on) on the width-bounded
+    seeded instance — sentinel family ``dpop_exact`` (ms, LOWER is
+    better).  The warm-up run eats every signature-bucket compile, so
+    the measured reps are the serving-steady-state cost of an exact
+    answer."""
+    from pydcop_tpu.computations_graph import pseudotree as pt
+    from pydcop_tpu.engine.dpop import DpopEngine
+    from pydcop_tpu.ops.dpop import tree_stats
+
+    dcop = build_dpop_exact_dcop()
+    tree = pt.build_computation_graph(dcop)
+    stats = tree_stats(tree)
+    engine = DpopEngine(tree, mode="min", cec=True)
+    warm = engine.run()   # compiles + caches CEC survivors
+    best = None
+    for _ in range(DPOP_EXACT_REPS):
+        t0 = time.perf_counter()
+        res = engine.run()
+        elapsed = time.perf_counter() - t0
+        best = elapsed if best is None else min(best, elapsed)
+    cost, violations = dcop.solution_cost(res.assignment)
+    if violations:
+        raise RuntimeError("exact sweep produced violations")
+    return {
+        "dpop_exact_ms": round(best * 1000.0, 3),
+        "dpop_exact_cold_ms": round(warm.time_s * 1000.0, 3),
+        "dpop_exact_induced_width": stats["induced_width"],
+        "dpop_exact_levels": stats["levels"],
+        "dpop_exact_cec_pruned": res.metrics.get("cec_pruned"),
+        "dpop_exact_cost": round(float(cost), 4),
+    }
+
+
 def run_bench():
     import jax
 
@@ -1792,6 +1865,7 @@ def run_bench():
             "unit": "cycles/s",
             "vs_baseline": None,
             "backend": platform,
+            "host_cpus": os.cpu_count(),
             "baseline_cycles_completed": thread_cycles,
             "note": "threaded baseline completed no full cycle in "
                     f"{THREAD_TIMEOUT_S}s",
@@ -2065,6 +2139,19 @@ def run_bench():
             "session_events_per_sec": None,
             "session_error": f"{type(exc).__name__}: {exc}"[:200],
         })
+    # Exact-inference leg (ISSUE 17): warmed best-of-N full DPOP
+    # sweep on the width-bounded seeded instance — sentinel family
+    # "dpop_exact" (lower is better).
+    try:
+        record_leg_backend("dpop_exact")
+        serve_keys.update(bench_dpop_exact())
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: dpop exact leg failed ({exc}); continuing",
+              file=sys.stderr)
+        serve_keys.update({
+            "dpop_exact_ms": None,
+            "dpop_exact_error": f"{type(exc).__name__}: {exc}"[:200],
+        })
     # Sharded-superstep leg: real mesh on TPU (when the tunnel gave
     # us more than one chip), forced-host-device child on CPU.
     try:
@@ -2097,6 +2184,11 @@ def run_bench():
         "unit": "cycles/s",
         "vs_baseline": round(device_cps / thread_cps, 1),
         "backend": platform,
+        # Host hardware class: CPU-fallback rates scale with the core
+        # count of the bench box, so the sentinel keys CPU baselines on
+        # it (a 1-core round must not be judged against an 8-core
+        # history — same refusal the backend split already applies).
+        "host_cpus": os.cpu_count(),
         "device_kind": device_kind,
         "baseline": "own threaded agent runtime "
                     f"({THREAD_AGENTS} agent threads, same problem)",

@@ -47,12 +47,18 @@ from pydcop_tpu.dcop.relations import (
     projection,
 )
 from pydcop_tpu.engine.runner import DeviceRunResult
-from pydcop_tpu.ops.dpop import UtilTooLargeError, solve_sweep
+from pydcop_tpu.ops.dpop import UtilTooLargeError
 
 GRAPH_TYPE = "pseudotree"
 
 algo_params = [
     AlgoParameterDef("engine", "str", ["auto", "jit", "numpy"], "auto"),
+    # Cross-edge consistency preprocessing (ops/dpop.cec_survivors):
+    # prunes soft-dominated domain values before the UTIL tables are
+    # built.  Bit-identical assignments either way; "on" shrinks the
+    # hypercubes (raising the width ceiling), "off" skips the host-side
+    # dominance pass on problems already far under the cap.
+    AlgoParameterDef("cec", "str", ["on", "off"], "on"),
 ]
 
 
@@ -78,8 +84,10 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
     import time
 
     requested = "auto"
+    cec = True
     if algo_def is not None and algo_def.params:
         requested = algo_def.params.get("engine", "auto")
+        cec = algo_def.params.get("cec", "on") != "off"
     engine = requested
     t0 = time.perf_counter()
     graph = pt.build_computation_graph(dcop)
@@ -96,17 +104,24 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
 
     if engine == "jit":
         try:
-            assignment, stats = solve_sweep(graph, mode)
+            # The engine tier (engine/dpop.DpopEngine) routes every
+            # kernel dispatch through timed_jit_call, so exact solves
+            # show up in tracing, metrics and the efficiency ledgers
+            # exactly like the iterative engines.
+            from pydcop_tpu.engine.dpop import DpopEngine
+
+            res = DpopEngine(graph, mode=mode, cec=cec).run()
             elapsed = time.perf_counter() - t0
-            cost, _ = dcop.solution_cost(assignment)
+            cost, _ = dcop.solution_cost(res.assignment)
+            stats = dict(res.metrics)
             stats["device_cost"] = cost
             stats["engine"] = "jit"
             return DeviceRunResult(
-                assignment=assignment,
-                cycles=stats.pop("levels"),
+                assignment=res.assignment,
+                cycles=res.cycles,
                 converged=True,
                 time_s=elapsed,
-                compile_time_s=0.0,
+                compile_time_s=res.compile_time_s,
                 metrics=stats,
             )
         except (ImportError, UtilTooLargeError) as e:
@@ -129,7 +144,8 @@ def solve_on_device(dcop: DCOP, algo_def: AlgorithmDef,
         converged=True,
         time_s=elapsed,
         compile_time_s=0.0,
-        metrics={**stats, "device_cost": cost, "engine": "numpy"},
+        metrics={**stats, "device_cost": cost, "engine": "numpy",
+                 "optimal": True},
     )
 
 

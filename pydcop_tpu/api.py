@@ -264,6 +264,7 @@ def _resolve_auto_algo(dcop: DCOP, algo_params: Dict[str, Any]):
         autotune_portfolio,
         cached_portfolio_choice,
         dcop_portfolio_key,
+        dpop_portfolio_runner,
     )
 
     key = dcop_portfolio_key(dcop)
@@ -277,7 +278,15 @@ def _resolve_auto_algo(dcop: DCOP, algo_params: Dict[str, Any]):
         graph, meta = compile_dcop(
             dcop, noise_level=float(
                 algo_params.get("noise", 0.01) or 0.0))
-        info = autotune_portfolio(graph, key=key, meta=meta)
+        # Exact inference enters the race width-keyed: the runner is
+        # None past DPOP_RACE_MAX_ELEMENTS (computed from the
+        # pseudo-tree, CEC shrinkage included), so wide structures
+        # resolve to an iterative winner without paying an exact
+        # attempt.
+        info = autotune_portfolio(
+            graph, key=key, meta=meta,
+            extra_runners={
+                "dpop": dpop_portfolio_runner(dcop, graph, meta)})
     algo, extra = PORTFOLIO_PARAMS[info["algo"]]
     module = load_algorithm_module(algo)
     allowed = {p.name for p in module.algo_params}
@@ -395,6 +404,7 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           session_max: int = 64,
           session_segment_cycles: Optional[int] = None,
           session_checkpoint_every_events: int = 8,
+          session_certify_after: Optional[float] = None,
           replicas: int = 1,
           affinity: str = "structure",
           compile_cache_dir: Optional[str] = None,
@@ -450,7 +460,13 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     ``session_segment_cycles`` overrides the default anytime-segment
     granularity, ``session_checkpoint_every_events`` the engine-state
     snapshot cadence (journaled services; smaller = faster recovery,
-    more snapshot writes).
+    more snapshot writes).  ``session_certify_after=S`` arms the
+    exact-inference oracle tier (docs/sessions.md "The oracle tier"):
+    a session whose event stream has quiesced for S seconds gets a
+    background DPOP solve of its current problem that either
+    certifies the warm fixpoint as optimal or upgrades the served
+    assignment to the true optimum, publishing the certified-cost
+    delta on the session SSE stream and in ``/stats``.
 
     Fleet scaling (docs/serving.md "Fleet-scale serving"):
     ``replicas=N`` (N > 1) spawns N ``pydcop serve`` WORKER PROCESSES
@@ -512,6 +528,7 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
             session_segment_cycles=session_segment_cycles,
             session_checkpoint_every_events=(
                 session_checkpoint_every_events),
+            session_certify_after=session_certify_after,
             replicas=replicas, affinity=affinity,
             compile_cache_dir=compile_cache_dir,
             heartbeat_s=heartbeat_s, spill_slack=spill_slack,
@@ -551,6 +568,7 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
         session_segment_cycles=session_segment_cycles,
         session_checkpoint_every_events=(
             session_checkpoint_every_events),
+        session_certify_after=session_certify_after,
     ).start()
     try:
         front_end = ServeFrontEnd(service, port=port, host=host).start()
@@ -627,7 +645,8 @@ def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
                  breaker_reset_s, result_keep, journal_dir,
                  journal_sync, envelope_packing, envelope_overhead_ms,
                  session_max, session_segment_cycles,
-                 session_checkpoint_every_events, replicas, affinity,
+                 session_checkpoint_every_events,
+                 session_certify_after, replicas, affinity,
                  compile_cache_dir, heartbeat_s, spill_slack,
                  hosts, slo_p99_ms, min_replicas, max_replicas,
                  port_file, block) -> Optional["FleetHandle"]:
@@ -675,6 +694,9 @@ def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
     if session_segment_cycles is not None:
         worker_args += ["--session_segment_cycles",
                         str(session_segment_cycles)]
+    if session_certify_after is not None:
+        worker_args += ["--session_certify_after",
+                        str(session_certify_after)]
     router = FleetRouter(
         replicas=replicas, worker_args=worker_args,
         journal_dir=journal_dir,

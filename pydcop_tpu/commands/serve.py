@@ -119,6 +119,16 @@ def set_parser(subparsers):
                              "engine-state checkpoints (journaled "
                              "services; smaller = faster --recover, "
                              "more snapshot writes; 0 disables)")
+    parser.add_argument("--session_certify_after",
+                        "--session-certify-after",
+                        type=float, default=None, metavar="SECONDS",
+                        help="exact-inference oracle tier: after a "
+                             "session's event stream quiesces for "
+                             "this many seconds, a background DPOP "
+                             "solve certifies (or improves) the warm "
+                             "fixpoint and publishes the certified-"
+                             "cost delta (default: off — "
+                             "docs/sessions.md)")
     parser.add_argument("--replicas", type=int, default=1,
                         help="worker replicas: N > 1 spawns N serve "
                              "worker processes (each its own "
@@ -261,6 +271,7 @@ def run_cmd(args) -> int:
         session_max=args.session_max,
         session_segment_cycles=args.session_segment_cycles,
         session_checkpoint_every_events=args.session_checkpoint_every,
+        session_certify_after=args.session_certify_after,
         replicas=args.replicas,
         affinity=args.affinity,
         compile_cache_dir=(args.compile_cache_dir

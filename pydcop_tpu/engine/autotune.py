@@ -346,9 +346,14 @@ def autotune_aggregation(graph: CompiledFactorGraph, *,
 # path both consume the cached decision.
 
 # Candidate order IS the deterministic tie-break (parity-default
-# maxsum first).
+# maxsum first).  "dpop" (exact inference, ISSUE 17) is a *conditional*
+# candidate: it only races when the caller supplies its runner via
+# ``extra_runners`` — which :func:`dpop_portfolio_runner` refuses to
+# build past the width ceiling, so wide structures never pay an exact
+# attempt and always resolve to an iterative winner.
 PORTFOLIO_CANDIDATES = (
     "maxsum", "maxsum_prune", "maxsum_decim", "dsa", "mgm", "gdba",
+    "dpop",
 )
 
 # Winner -> (algorithm name, extra algo_params) for api.solve.
@@ -359,7 +364,15 @@ PORTFOLIO_PARAMS = {
     "dsa": ("dsa", {}),
     "mgm": ("mgm", {}),
     "gdba": ("gdba", {}),
+    "dpop": ("dpop", {}),
 }
+
+# Width gate for *racing* exact inference: deliberately far below
+# ops/dpop.MAX_NODE_ELEMENTS — the race is a latency probe, and a
+# hypercube this side of the gate solves in the same ballpark as a
+# 60-cycle iterative race leg.  Past it, DPOP may still be reachable
+# explicitly (algo="dpop"), just not auto-raced.
+DPOP_RACE_MAX_ELEMENTS = 2 ** 20
 
 _PORTFOLIO_PREFIX = f"portfolio-v{_CACHE_VERSION}|"
 
@@ -508,6 +521,54 @@ def _portfolio_runners(graph: CompiledFactorGraph, race_cycles: int,
     }
 
 
+def dpop_portfolio_runner(dcop, graph: CompiledFactorGraph, meta):
+    """Zero-arg exact-inference race leg, or None past the width gate.
+
+    Width is decided from the pseudo-tree BEFORE any table exists
+    (ops/dpop.tree_stats via engine.dpop.dpop_feasibility, CEC
+    shrinkage included), so an over-wide structure costs one cheap
+    host-side pass and never allocates a hypercube.  The returned
+    runner scores its assignment through the SAME compiled-graph
+    ``assignment_cost`` the iterative racers use — one cost scale for
+    the whole race (max-objective negation included)."""
+    from pydcop_tpu.computations_graph import pseudotree as pt
+    from pydcop_tpu.engine.dpop import DpopEngine, dpop_feasibility
+
+    try:
+        ptree = pt.build_computation_graph(dcop)
+    except Exception as e:  # noqa: BLE001 — no tree, no exact leg
+        logger.debug("portfolio: no pseudo-tree for dpop leg: %s", e)
+        return None
+    verdict = dpop_feasibility(
+        ptree, mode=dcop.objective, cec=True,
+        max_elements=DPOP_RACE_MAX_ELEMENTS)
+    if not verdict["feasible"]:
+        logger.debug(
+            "portfolio: dpop leg skipped (max_elements %s > gate %s)",
+            verdict["max_elements"], DPOP_RACE_MAX_ELEMENTS)
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from pydcop_tpu.ops.localsearch import assignment_cost
+
+    engine = DpopEngine(ptree, mode=dcop.objective, cec=True)
+    placed = jax.device_put(graph)
+    index_of = {
+        name: {v: i for i, v in enumerate(dom)}
+        for name, dom in zip(meta.var_names, meta.domains)
+    }
+
+    def run():
+        res = engine.run()
+        idx = jnp.asarray(
+            [index_of[n][res.assignment[n]] for n in meta.var_names]
+            + [0], dtype=jnp.int32)
+        return float(assignment_cost(placed, idx))
+
+    return run
+
+
 def _belief_margin(graph, state):
     import jax.numpy as jnp
 
@@ -526,6 +587,7 @@ def autotune_portfolio(graph: CompiledFactorGraph, *,
                        cache_file: Optional[str] = None,
                        candidates=PORTFOLIO_CANDIDATES,
                        meta=None,
+                       extra_runners=None,
                        ) -> Dict[str, Any]:
     """Race whole algorithm kernels on ``graph`` toward a cost target.
 
@@ -563,6 +625,12 @@ def autotune_portfolio(graph: CompiledFactorGraph, *,
             }
 
     runners = _portfolio_runners(graph, race_cycles, meta=meta)
+    if extra_runners:
+        # Conditional candidates (e.g. the width-gated dpop leg): a
+        # None value means "not raced on this structure" — same as an
+        # absent runner.
+        runners.update(
+            {k: v for k, v in extra_runners.items() if v is not None})
     timings_ms: Dict[str, Optional[float]] = {}
     costs: Dict[str, Optional[float]] = {}
     notes: Dict[str, str] = {}

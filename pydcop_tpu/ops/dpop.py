@@ -34,6 +34,18 @@ table exceeds ``MAX_NODE_ELEMENTS`` raises ``UtilTooLargeError``
 pseudotree computation_memory); callers fall back to the host-numpy
 path when the *total* work is too small to amortize device dispatch or
 too large for device memory (see algorithms/dpop.py).
+
+Cross-edge consistency (arXiv 1909.06537): before building node plans,
+``cec_survivors`` prunes domain values that are *soft-dominated* — value
+``a`` of variable ``x`` is removed when some earlier value ``b`` costs
+no more than ``a`` under every completion of the rest of the problem,
+certified by the bound  u(b) - u(a) + sum over constraints containing x
+of max over other coordinates of (c[b,..] - c[a,..]) <= 0  (min mode;
+reductions and inequality flip for max).  Because the dominator has a
+*smaller* domain index, first-optimum tie-breaking always lands on a
+surviving value, so the final assignment is bit-identical with CEC on
+or off — pruning only shrinks every hypercube axis the variable touches
+and thereby raises the width ceiling under ``MAX_NODE_ELEMENTS``.
 """
 
 from collections import defaultdict
@@ -86,15 +98,10 @@ def _transpose_to_axes(array: np.ndarray, positions: List[int]
     return axes, np.ascontiguousarray(np.transpose(array, order))
 
 
-def compile_tree(graph, mode: str) -> Dict[str, _NodePlan]:
-    """Build per-node static plans: dims, shapes, local components.
-
-    ``graph`` is a ComputationPseudoTree; child-UTIL components are
-    added level by level during the sweep (their arrays are produced by
-    the previous level's kernels).
-    """
+def _tree_layout(graph, survivors: Optional[Dict[str, np.ndarray]] = None):
+    """Shared host-side layout pass: nodes, depths, separator sets and
+    per-node (dims, shape) with survivor-shrunk domain sizes."""
     from pydcop_tpu.computations_graph.pseudotree import node_depths
-    from pydcop_tpu.dcop.relations import NAryMatrixRelation
 
     nodes = {n.name: n for n in graph.nodes}
     depth = node_depths(graph)
@@ -111,27 +118,158 @@ def compile_tree(graph, mode: str) -> Dict[str, _NodePlan]:
         s.discard(name)
         sep[name] = s
 
-    plans: Dict[str, _NodePlan] = {}
-    for name, node in nodes.items():
-        var = node.variable
+    def dom_size(name: str) -> int:
+        if survivors is not None and name in survivors:
+            return int(len(survivors[name]))
+        return len(nodes[name].variable.domain)
+
+    layout: Dict[str, Tuple[Tuple[str, ...], Tuple[int, ...]]] = {}
+    for name in nodes:
         # Deterministic dim order: own variable first, then separator
         # variables shallowest-first (ties by name) — ancestors of the
         # node by the pseudo-tree property.
         sep_sorted = sorted(sep[name], key=lambda v: (depth[v], v))
         dims = (name,) + tuple(sep_sorted)
-        domain_of = {name: len(var.domain)}
+        shape = tuple(dom_size(d) for d in dims)
+        layout[name] = (dims, shape)
+    return nodes, depth, sep, layout
+
+
+def tree_stats(graph, survivors: Optional[Dict[str, np.ndarray]] = None
+               ) -> Dict[str, int]:
+    """Width/size accounting for a pseudo-tree *without* materializing
+    any table — safe to call on arbitrarily wide problems.
+
+    Returns node count, level count, induced width (largest separator,
+    in variables), the largest per-node UTIL element count and the total
+    across nodes.  Callers compare ``max_elements`` against
+    ``MAX_NODE_ELEMENTS`` to decide whether exact inference is feasible
+    (optionally after CEC shrinkage via ``survivors``).
+    """
+    nodes, depth, sep, layout = _tree_layout(graph, survivors)
+    max_elements = 0
+    total_elements = 0
+    for name, (dims, shape) in layout.items():
+        n = int(np.prod(shape, dtype=np.float64))
+        max_elements = max(max_elements, n)
+        total_elements += n
+    return {
+        "nodes": len(nodes),
+        "levels": (max(depth.values()) + 1) if depth else 0,
+        "induced_width": max((len(s) for s in sep.values()), default=0),
+        "max_elements": max_elements,
+        "total_elements": total_elements,
+    }
+
+
+def cec_survivors(graph, mode: str = "min", max_rounds: int = 8
+                  ) -> Tuple[Dict[str, np.ndarray], Dict[str, int]]:
+    """Cross-edge consistency: per-variable surviving domain indices.
+
+    A value ``a`` is pruned when an earlier value ``b`` soft-dominates
+    it: ``u(b) - u(a) + sum_c reduce_ctx(c[b] - c[a])`` is ``<= 0`` with
+    ``reduce = max`` in min mode (``>= 0`` / ``min`` in max mode), the
+    context ranging over current survivors of the other scope variables.
+    Iterated to a bounded fixpoint — each round's shrinkage tightens the
+    neighbour contexts and can unlock further pruning.
+
+    Returns ``(survivors, meta)`` where ``survivors`` maps variable name
+    to a sorted int array of original domain indices and ``meta`` holds
+    ``{"rounds", "pruned", "values"}``.
+    """
+    nodes = {n.name: n for n in graph.nodes}
+    variables = {name: node.variable for name, node in nodes.items()}
+
+    # Every constraint is assigned to exactly one pseudo-tree node;
+    # bucket the dense form by incident variable.
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    incident: Dict[str, List[Tuple[Tuple[str, ...], np.ndarray]]] = {
+        name: [] for name in nodes
+    }
+    for node in nodes.values():
         for c in node.constraints:
-            for v in c.dimensions:
-                domain_of[v.name] = len(v.domain)
-        # Children contribute dims too; domain sizes resolved from the
-        # child variables themselves below (graph nodes know them).
-        for child in node.children:
-            domain_of[nodes[child].variable.name] = \
-                len(nodes[child].variable.domain)
-        shape = tuple(
-            domain_of.get(d) or len(nodes[d].variable.domain)
-            for d in dims
-        )
+            dense = NAryMatrixRelation.from_func_relation(c)
+            dims = tuple(v.name for v in dense.dimensions)
+            mat = np.asarray(dense.matrix, dtype=np.float64)
+            for d in dims:
+                incident[d].append((dims, mat))
+
+    unary = {
+        name: np.asarray(var.cost_vector(), dtype=np.float64)
+        for name, var in variables.items()
+    }
+    survivors: Dict[str, np.ndarray] = {
+        name: np.arange(len(var.domain), dtype=np.int64)
+        for name, var in variables.items()
+    }
+
+    total_values = sum(len(v.domain) for v in variables.values())
+    rounds = 0
+    changed = True
+    while changed and rounds < max_rounds:
+        changed = False
+        rounds += 1
+        for name in sorted(nodes):
+            keep_idx = survivors[name]
+            k = len(keep_idx)
+            if k <= 1:
+                continue
+            u = unary[name][keep_idx]
+            # D[b, a]: certified worst-case cost(b) - cost(a) bound.
+            D = u[:, None] - u[None, :]
+            for dims, mat in incident[name]:
+                sub = mat
+                for ax, d in enumerate(dims):
+                    sub = np.take(sub, survivors[d], axis=ax)
+                ax_x = dims.index(name)
+                sub = np.moveaxis(sub, ax_x, 0).reshape(k, -1)
+                diff = sub[:, None, :] - sub[None, :, :]
+                D = D + (
+                    diff.max(axis=2) if mode == "min"
+                    else diff.min(axis=2)
+                )
+            keep = np.ones(k, dtype=bool)
+            for a in range(1, k):
+                col = D[:a, a]
+                dominated = (
+                    bool((col <= 0.0).any()) if mode == "min"
+                    else bool((col >= 0.0).any())
+                )
+                if dominated:
+                    keep[a] = False
+            if not keep.all():
+                survivors[name] = keep_idx[keep]
+                changed = True
+    kept_values = sum(len(s) for s in survivors.values())
+    meta = {
+        "rounds": rounds,
+        "pruned": total_values - kept_values,
+        "values": total_values,
+    }
+    return survivors, meta
+
+
+def compile_tree(graph, mode: str,
+                 survivors: Optional[Dict[str, np.ndarray]] = None
+                 ) -> Dict[str, _NodePlan]:
+    """Build per-node static plans: dims, shapes, local components.
+
+    ``graph`` is a ComputationPseudoTree; child-UTIL components are
+    added level by level during the sweep (their arrays are produced by
+    the previous level's kernels).  When ``survivors`` is given (from
+    ``cec_survivors``) every table axis is sliced to the surviving
+    domain indices before planning, so the element cap is checked
+    against the *shrunk* hypercubes.
+    """
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    nodes, depth, sep, layout = _tree_layout(graph, survivors)
+
+    plans: Dict[str, _NodePlan] = {}
+    for name, node in nodes.items():
+        var = node.variable
+        dims, shape = layout[name]
         n_elements = int(np.prod(shape, dtype=np.int64))
         if n_elements > MAX_NODE_ELEMENTS:
             raise UtilTooLargeError(
@@ -140,15 +278,18 @@ def compile_tree(graph, mode: str) -> Dict[str, _NodePlan]:
             )
         plan = _NodePlan(name, dims, shape, node.parent, depth[name])
         pos = {d: i for i, d in enumerate(dims)}
-        plan.add_component(
-            (0,), np.asarray(var.cost_vector(), dtype=np.float32)
-        )
+        u = np.asarray(var.cost_vector(), dtype=np.float32)
+        if survivors is not None:
+            u = u[survivors[name]]
+        plan.add_component((0,), u)
         for c in node.constraints:
             dense = NAryMatrixRelation.from_func_relation(c)
+            mat = np.asarray(dense.matrix, dtype=np.float32)
+            if survivors is not None:
+                for ax, v in enumerate(dense.dimensions):
+                    mat = np.take(mat, survivors[v.name], axis=ax)
             positions = [pos[v.name] for v in dense.dimensions]
-            axes, arr = _transpose_to_axes(
-                np.asarray(dense.matrix, dtype=np.float32), positions
-            )
+            axes, arr = _transpose_to_axes(mat, positions)
             plan.add_component(axes, arr)
         plans[name] = plan
     return plans
@@ -193,13 +334,31 @@ def _kernel_for(signature: Tuple) -> Any:
     return _KERNEL_CACHE[signature]
 
 
-def solve_sweep(graph, mode: str = "min"
+def solve_sweep(graph, mode: str = "min", cec: bool = False,
+                call: Optional[Any] = None,
+                precomputed_survivors: Optional[Tuple] = None
                 ) -> Tuple[Dict[str, Any], Dict[str, int]]:
     """Run the full DPOP solve with level-batched jitted kernels.
 
+    ``cec`` enables cross-edge consistency preprocessing (assignment is
+    bit-identical either way; tables shrink).  ``call`` is an optional
+    invocation hook ``call(signature, kernel, *stacked) -> kernel_out``
+    — engine tiers pass ``timed_jit_call`` wrappers here so compile/run
+    accounting, tracing and efficiency ledgers see every dispatch.
+    ``precomputed_survivors`` short-circuits the (host-heavy) dominance
+    pass with a cached ``cec_survivors`` result for repeat solves of a
+    static problem.
+
     Returns (assignment, stats).
     """
-    plans = compile_tree(graph, mode)
+    survivors = None
+    cec_meta = {"rounds": 0, "pruned": 0, "values": 0}
+    if cec:
+        if precomputed_survivors is not None:
+            survivors, cec_meta = precomputed_survivors
+        else:
+            survivors, cec_meta = cec_survivors(graph, mode)
+    plans = compile_tree(graph, mode, survivors=survivors)
     nodes = {n.name: n for n in graph.nodes}
     by_level: Dict[int, List[str]] = defaultdict(list)
     for name, plan in plans.items():
@@ -229,7 +388,11 @@ def solve_sweep(graph, mode: str = "min"
                 )
                 for axes in axes_tuples
             ]
-            acc, util = _kernel_for(key)(*stacked)
+            kernel = _kernel_for(key)
+            if call is None:
+                acc, util = kernel(*stacked)
+            else:
+                acc, util = call(key, kernel, *stacked)
             n_kernel_calls += 1
             acc_np = np.asarray(acc)
             util_np = None if util is None else np.asarray(util)
@@ -250,25 +413,31 @@ def solve_sweep(graph, mode: str = "min"
                     msg_size += arr.size
 
     # VALUE sweep, root level down: slice on ancestors' values, pick
-    # the first optimum (reference find_arg_optimal order).
+    # the first optimum (reference find_arg_optimal order).  With CEC
+    # active, table axes index *surviving* values, so ancestor values
+    # map through the survivor list and the chosen row maps back to the
+    # original domain.
     assignment: Dict[str, Any] = {}
+    chosen_pos: Dict[str, int] = {}
     argopt = np.argmin if mode == "min" else np.argmax
     for level in range(0, max_depth + 1):
         for name in sorted(by_level[level]):
             plan = plans[name]
             var = nodes[name].variable
-            idx = tuple(
-                var_index(nodes[d].variable, assignment[d])
-                for d in plan.dims[1:]
-            )
+            idx = tuple(chosen_pos[d] for d in plan.dims[1:])
             vec = joined[name][(slice(None),) + idx]
-            assignment[name] = var.domain[int(argopt(vec))]
+            pos = int(argopt(vec))
+            orig = pos if survivors is None else int(survivors[name][pos])
+            chosen_pos[name] = pos
+            assignment[name] = var.domain[orig]
             msg_count += len(nodes[name].children)
     stats = {
         "msg_count": msg_count,
         "msg_size": msg_size,
         "kernel_calls": n_kernel_calls,
         "levels": max_depth + 1,
+        "cec_rounds": cec_meta["rounds"],
+        "cec_pruned": cec_meta["pruned"],
     }
     return assignment, stats
 

@@ -41,7 +41,7 @@ from pydcop_tpu.engine.compile import CompiledFactorGraph
 # the key because the pruned and dense batched programs are different
 # executables (same results — pruning never changes values).
 PARAM_KEYS = ("max_cycles", "damping", "damping_nodes", "stability",
-              "noise", "prune")
+              "noise", "prune", "algo")
 
 DEFAULT_PARAMS: Dict[str, Any] = {
     "max_cycles": 200,
@@ -54,10 +54,19 @@ DEFAULT_PARAMS: Dict[str, Any] = {
     # to 0/1 at submit, AFTER the graph compiles — never measured on
     # the serving path).
     "prune": 0,
+    # "maxsum" = the iterative batched engine; "dpop" = exact
+    # inference (ISSUE 17): results carry ``optimal: true``, width is
+    # checked AT SUBMIT against ops/dpop.MAX_NODE_ELEMENTS (CEC
+    # shrinkage included) and an over-wide problem is a structured 400
+    # ``rejected_width``, never a dispatch-time 500.  Rides the bin
+    # key, so dpop traffic never shares a dispatch with maxsum.
+    "algo": "maxsum",
 }
 
 
 DAMPING_NODES = ("vars", "factors", "both", "none")
+
+SERVING_ALGOS = ("maxsum", "dpop")
 
 
 def normalize_params(overrides: Dict[str, Any] = None) -> Dict[str, Any]:
@@ -95,6 +104,10 @@ def normalize_params(overrides: Dict[str, Any] = None) -> Dict[str, Any]:
         raise ValueError(
             f"damping_nodes must be one of {DAMPING_NODES}, got "
             f"{params['damping_nodes']!r}")
+    if params["algo"] not in SERVING_ALGOS:
+        raise ValueError(
+            f"algo must be one of {SERVING_ALGOS}, got "
+            f"{params['algo']!r}")
     return params
 
 

@@ -44,7 +44,7 @@ from pydcop_tpu.observability.server import (
     set_health_provider,
 )
 from pydcop_tpu.serving.admission import AdmissionRejected
-from pydcop_tpu.serving.service import SolveService
+from pydcop_tpu.serving.service import SolveService, WidthRejected
 from pydcop_tpu.serving.sessions import (
     SessionClosed,
     scenario_yaml_to_events,
@@ -74,9 +74,16 @@ def _positive_float(value: Any, name: str) -> float:
 
 def _result_code(result: Dict[str, Any]) -> int:
     """HTTP status for a terminal result body: 504 for a
-    deadline-expired request, 200 otherwise (an ERROR result is a
-    well-formed 200 reply whose body says the solve failed)."""
-    return 504 if result.get("status") == "EXPIRED" else 200
+    deadline-expired request, 400 for a dispatch-time width rejection
+    (the client sent a problem exact inference cannot afford — a
+    client fault, not a server one), 200 otherwise (a generic ERROR
+    result is a well-formed 200 reply whose body says the solve
+    failed)."""
+    if result.get("status") == "EXPIRED":
+        return 504
+    if result.get("status_detail") == "rejected_width":
+        return 400
+    return 200
 
 
 class _ServeHandler(_Handler):
@@ -209,6 +216,22 @@ class _ServeHandler(_Handler):
                 "error": str(exc),
                 "status": "rejected",
                 "retry": exc.http_status == 429,
+            })
+            return
+        except WidthRejected as exc:
+            # ``algo:"dpop"`` on a problem whose UTIL hypercubes bust
+            # the element cap even after CEC shrinkage.  The width
+            # check runs on the submitting thread before anything is
+            # queued, so this is a clean structured 400: no orphaned
+            # request, nothing fed to the admission breaker, and the
+            # body tells the client exactly how far over the cap the
+            # problem is (retrying the same shape cannot help).
+            self._json(400, {
+                "error": str(exc),
+                "status": exc.status,
+                "max_elements": exc.max_elements,
+                "max_elements_cap": exc.cap,
+                "retry": False,
             })
             return
         except RuntimeError as exc:

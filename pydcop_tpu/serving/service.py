@@ -71,6 +71,7 @@ from pydcop_tpu.observability.metrics import CycleSnapshotter
 from pydcop_tpu.observability.metrics import registry as metrics_registry
 from pydcop_tpu.observability.profiler import profiler
 from pydcop_tpu.observability.trace import tracer
+from pydcop_tpu.ops.dpop import UtilTooLargeError
 from pydcop_tpu.serving import binning, journal as journal_mod
 from pydcop_tpu.serving.admission import (
     AdmissionController,
@@ -89,6 +90,24 @@ FINISHED = "FINISHED"
 ERROR = "ERROR"
 EXPIRED = "EXPIRED"
 REPLAYABLE = "REPLAYABLE"
+
+
+class WidthRejected(ValueError):
+    """``algo="dpop"`` on a problem whose UTIL hypercubes bust
+    ``ops/dpop.MAX_NODE_ELEMENTS`` even after CEC shrinkage.
+
+    Raised ON THE SUBMITTING THREAD (width is decided from the
+    pseudo-tree before any table exists), so the front end turns it
+    into a structured 400 ``rejected_width`` — never a dispatch-time
+    ``MemoryError`` feeding the admission breaker and a 500."""
+
+    status = "rejected_width"
+
+    def __init__(self, message: str, max_elements: int = 0,
+                 cap: int = 0):
+        super().__init__(message)
+        self.max_elements = int(max_elements)
+        self.cap = int(cap)
 
 
 @dataclass
@@ -110,6 +129,10 @@ class SolveRequest:
     t_submit: float
     deadline_s: Optional[float] = None
     replayed: bool = False
+    # Exact-inference requests (params["algo"] == "dpop") carry their
+    # pseudo-tree from the submit-time width check to the dispatch —
+    # built once per request, on the submitting thread.
+    exact_tree: Any = None
     # Time-ledger breakpoints (observability/efficiency.py): enqueue
     # (submit-thread work ends), dispatch pickup, and the flush-plan
     # wall this request waited through — contiguous with the device
@@ -200,7 +223,8 @@ class SolveService:
                  session_max: int = 64,
                  session_segment_cycles: Optional[int] = None,
                  session_checkpoint_every_events: int = 8,
-                 session_keep: int = 256):
+                 session_keep: int = 256,
+                 session_certify_after: Optional[float] = None):
         if admission is None:
             admission = AdmissionPolicy(high_water=max_queue)
         self.admission = AdmissionController(admission)
@@ -254,6 +278,11 @@ class SolveService:
         self.dispatch_retries = 0
         # prune="auto" submits resolved through the portfolio cache.
         self.portfolio_resolved = 0
+        # Exact-inference plane (ISSUE 17): dispatches completed via
+        # DpopEngine, and the shared warm-key set that keeps repeat
+        # same-signature solves attributed as warm in the jit ledger.
+        self.dpop_dispatches = 0
+        self._dpop_warm: set = set()
         self.last_stop: Optional[Dict[str, Any]] = None
         reg = metrics_registry
         self._req_total = reg.counter(
@@ -306,7 +335,8 @@ class SolveService:
             self, max_sessions=session_max,
             segment_cycles=session_segment_cycles,
             checkpoint_every_events=session_checkpoint_every_events,
-            session_keep=session_keep)
+            session_keep=session_keep,
+            certify_after=session_certify_after)
 
     # -- lifecycle ----------------------------------------------------- #
 
@@ -574,12 +604,15 @@ class SolveService:
                 merged["prune"] = 1 if choice == "maxsum_prune" else 0
                 with self._lock:
                     self.portfolio_resolved += 1
+            exact_tree = None
+            if merged["algo"] == "dpop":
+                exact_tree = self._check_width(dcop)
             req = SolveRequest(
                 id=request_id or f"r{next(self._ids)}",
                 dcop=dcop, graph=graph, meta=meta, params=merged,
                 bin=binning.bin_key(graph, merged),
                 t_submit=t_submit, deadline_s=deadline_s,
-                trace_id=trace_id,
+                trace_id=trace_id, exact_tree=exact_tree,
             )
             with self._lock:
                 if req.id in self._requests:
@@ -587,6 +620,13 @@ class SolveService:
                         f"duplicate request id {req.id!r}")
                 self._requests[req.id] = req
                 self._prune_locked()
+        except WidthRejected:
+            # Its own ledger status: an over-wide exact request is a
+            # capacity verdict about the problem, not a malformed
+            # payload — operators watching rejected_bad_request for
+            # client bugs must not see width verdicts in that count.
+            self._req_total.inc(status="rejected_width")
+            raise
         except Exception:
             self._req_total.inc(status="rejected_bad_request")
             raise
@@ -842,7 +882,13 @@ class SolveService:
         singles: List[SolveRequest] = []
         for key in sorted(bins, key=lambda k: -len(bins[k])):
             reqs = bins[key]
-            if len(reqs) > 1 or not self.envelope_packing:
+            if reqs[0].params.get("algo") == "dpop":
+                # Exact-inference bins never enter envelope/lane
+                # packing: DPOP batches WITHIN each problem (the
+                # level-batched signature buckets), and cross-problem
+                # stacking has no meaning for a tree sweep.
+                plans.append(DispatchPlan(list(reqs)))
+            elif len(reqs) > 1 or not self.envelope_packing:
                 plans.append(DispatchPlan(list(reqs)))
             else:
                 singles.append(reqs[0])
@@ -1041,6 +1087,17 @@ class SolveService:
                         batch_result.metrics["batch_size"]
                     span.args["pad_fraction"] = \
                         batch_result.metrics["pad_fraction"]
+        except UtilTooLargeError as exc:
+            # Width bust discovered only at dispatch (the submit-time
+            # gate passed on CEC-shrunk estimates, the actual sweep
+            # still blew the cap).  This is the PROBLEM's shape, not a
+            # device fault: reject the whole bin with the structured
+            # width status, feed nothing to the admission breaker, and
+            # skip bisection — halving a bin cannot un-widen a tree.
+            self._dispatch_total.inc(kind="rejected_width")
+            for req in reqs:
+                self._finish_rejected_width(req, str(exc))
+            return
         except Exception as exc:  # noqa: BLE001 — fail/bisect the
             # batch, not the scheduler thread: the service must keep
             # serving.
@@ -1148,6 +1205,12 @@ class SolveService:
                         else None),
                 },
             }
+            if metrics.get("optimal"):
+                # Exact-inference dispatch: the served assignment is a
+                # certified optimum, and the client can trust it as
+                # one (the flag only ever rides a DPOP sweep's
+                # result — iterative engines never set it).
+                req.result["optimal"] = True
             req.status = FINISHED
             self.completed += 1
             self._req_total.inc(status="ok")
@@ -1216,12 +1279,111 @@ class SolveService:
                 work.error = "internal session work error"
                 done.set()
 
+    def _check_width(self, dcop: DCOP):
+        """Submit-time width gate for ``algo="dpop"``: build the
+        pseudo-tree, verdict via engine/dpop.dpop_feasibility (CEC
+        shrinkage included — pruning is how the ceiling rises), raise
+        :class:`WidthRejected` when even the shrunk hypercubes bust
+        ``ops/dpop.MAX_NODE_ELEMENTS``.  Returns the pseudo-tree so
+        the dispatch never rebuilds it."""
+        from pydcop_tpu.computations_graph import pseudotree as pt
+        from pydcop_tpu.engine.dpop import dpop_feasibility
+
+        tree = pt.build_computation_graph(dcop)
+        verdict = dpop_feasibility(tree, mode=dcop.objective, cec=True)
+        if not verdict["feasible"]:
+            effective = (verdict["cec_max_elements"]
+                         or verdict["max_elements"])
+            raise WidthRejected(
+                f"problem too wide for exact inference: largest UTIL "
+                f"hypercube has {effective} elements (cap "
+                f"{verdict['max_elements_cap']}, induced width "
+                f"{verdict['induced_width']}); use the iterative "
+                f"solver (algo=maxsum) for this structure",
+                max_elements=effective,
+                cap=verdict["max_elements_cap"])
+        return tree
+
+    def _run_batch_dpop(self, reqs, params):
+        """Exact-inference dispatch: one DpopEngine solve per request
+        (no cross-problem stacking — the level-batched signature
+        buckets batch WITHIN each problem, and same-bin requests share
+        every compiled kernel through the signature cache plus the
+        service-wide warm set).  Returns the same ``(values, cycles,
+        batch_result)`` triple as the stacked path, so the generic
+        decode/ledger/lifecycle code downstream is one code path."""
+        import numpy as np
+
+        from pydcop_tpu.computations_graph import pseudotree as pt
+        from pydcop_tpu.engine.dpop import DpopEngine
+        from pydcop_tpu.engine.runner import DeviceRunResult
+
+        t0 = time.perf_counter()
+        values, cycles, kernel_calls = [], [], 0
+        compile_s = 0.0
+        for req in reqs:
+            tree = req.exact_tree
+            if tree is None:
+                tree = pt.build_computation_graph(req.dcop)
+            engine = DpopEngine(
+                tree, mode=req.dcop.objective, cec=True,
+                warm=self._dpop_warm)
+            res = engine.run()
+            index_of = {
+                name: {v: i for i, v in enumerate(dom)}
+                for name, dom in zip(req.meta.var_names,
+                                     req.meta.domains)
+            }
+            values.append(np.asarray(
+                [index_of[n][res.assignment[n]]
+                 for n in req.meta.var_names], dtype=np.int64))
+            cycles.append(res.cycles)
+            kernel_calls += res.metrics.get("kernel_calls", 0)
+            compile_s += res.compile_time_s
+        elapsed = time.perf_counter() - t0
+        with self._lock:
+            self.dpop_dispatches += 1
+        batch_result = DeviceRunResult(
+            assignment={},
+            cycles=max(cycles) if cycles else 0,
+            converged=True,
+            time_s=elapsed,
+            compile_time_s=min(compile_s, elapsed),
+            metrics={
+                "batch_size": len(reqs),
+                "n_real": len(reqs),
+                "pad_fraction": 0.0,
+                "cold_start": compile_s > 0.0,
+                "run_time_s": elapsed,
+                "converged_lanes": [True] * len(reqs),
+                "packing": "dpop",
+                "optimal": True,
+                "kernel_calls": kernel_calls,
+            },
+        )
+        if efficiency.tracker.enabled:
+            record = efficiency.tracker.record_dispatch(
+                key=f"dpop_batch_{len(reqs)}",
+                structure=efficiency.structure_label(reqs[0].graph),
+                backend=efficiency.backend_name(),
+                time_s=elapsed, compile_s=batch_result.compile_time_s,
+                cycles=max(cycles) if cycles else 0,
+                n_real=len(reqs), batch_size=len(reqs),
+                pad_fraction=0.0, envelope_waste=0.0,
+                packing="dpop", cost_entry=None,
+            )
+            if record is not None:
+                batch_result.metrics["efficiency"] = record
+        return np.asarray(values), np.asarray(cycles), batch_result
+
     def _run_batch(self, reqs, params, envelope=None,
                    lane_d: Optional[int] = None):
         """The device call, isolated for tests to stub failures.
         ``envelope`` routes a heterogeneous group through mask-padded
         envelope stacking, ``lane_d`` through the disjoint-union lane
         pack; both default to the exact same-structure stack."""
+        if params.get("algo") == "dpop":
+            return self._run_batch_dpop(reqs, params)
         graphs = [r.graph for r in reqs]
         if lane_d is not None:
             return engine_batch.run_lane_packed(
@@ -1246,6 +1408,28 @@ class SolveService:
             prune=bool(params.get("prune", 0)),
             envelope=envelope,
         )
+
+    def _finish_rejected_width(self, req: SolveRequest, message: str):
+        """Terminal for a dispatch-time width bust: an ERROR result
+        whose ``status_detail`` is ``rejected_width`` (the front end
+        maps it to a 400 — the client sent an un-servable problem
+        shape, not a flaky one worth retrying)."""
+        req.result = {
+            "id": req.id, "trace_id": req.trace_id,
+            "status": ERROR,
+            "status_detail": "rejected_width",
+            "error": f"problem too wide for exact inference: {message}",
+            "latency": {
+                "total_s": time.perf_counter() - req.t_submit,
+            },
+            "ledger": self._terminal_ledger(req),
+        }
+        req.status = ERROR
+        self.failed += 1
+        self._req_total.inc(status="rejected_width")
+        self._journal_done(req)
+        req.done.set()
+        self._publish_lifecycle("error", req)
 
     def _finish_error(self, req: SolveRequest, message: str):
         req.result = {
@@ -1407,6 +1591,7 @@ class SolveService:
             "expired": self.expired,
             "replayed": self.replayed,
             "dispatch_retries": self.dispatch_retries,
+            "dpop_dispatches": self.dpop_dispatches,
             "portfolio_resolved": self.portfolio_resolved,
             "journal": (self.journal_dir
                         if self._journal is not None else None),

@@ -297,6 +297,13 @@ class SolveSession:
     # live process had.
     order_lock: threading.Lock = field(
         default_factory=threading.Lock)
+    # Exact-certification oracle bookkeeping (docs/sessions.md): the
+    # highest event seq whose quiesced fixpoint has been certified by
+    # a background DPOP solve, and the seq a certify timer is already
+    # pending for (both -1 initially so the seq-0 fixpoint — the
+    # initial convergence before any event — is certifiable too).
+    certified_seq: int = -1
+    certify_scheduled_seq: int = -1
 
 
 @dataclass
@@ -306,7 +313,7 @@ class SessionWork:
     flushes — session mutations and segments interleave with batched
     one-shot dispatches on the single device-owning thread."""
 
-    kind: str                # "events" | "segment" | "close" | "export"
+    kind: str   # "events" | "segment" | "close" | "export" | "certify"
     session: SolveSession
     events: Optional[List[Dict[str, Any]]] = None
     seq: int = 0
@@ -333,11 +340,24 @@ class SessionManager:
     def __init__(self, service, max_sessions: int = 64,
                  segment_cycles: Optional[int] = None,
                  checkpoint_every_events: int = 8,
-                 session_keep: int = 256):
+                 session_keep: int = 256,
+                 certify_after: Optional[float] = None):
         self.service = service
         self.max_sessions = int(max_sessions)
         self.default_segment_cycles = segment_cycles
         self.checkpoint_every_events = int(checkpoint_every_events)
+        # Exact-certification oracle: when set, a session whose event
+        # stream has quiesced for this many seconds gets a background
+        # DPOP solve of its CURRENT (mutated) problem on the scheduler
+        # thread — certifying the warm fixpoint as optimal, or
+        # replacing the served assignment with the true optimum.  None
+        # disables the tier (the default: exact solves are not free).
+        self.certify_after = (None if certify_after is None
+                              else float(certify_after))
+        self.certifications = 0
+        self.certified_improved = 0
+        self.certify_skipped_width = 0
+        self.last_certification: Optional[Dict[str, Any]] = None
         # Terminal-session retention (the session analogue of the
         # service's result_keep): closed/errored sessions keep their
         # final result pollable until evicted oldest-first past this
@@ -753,6 +773,19 @@ class SessionManager:
             if phase in ("segment", "closed", "error", "replayable"):
                 if phase == "segment":
                     sess.last = dict(event)
+            elif phase == "certified" and "assignment" in event:
+                # An improving certification REPLACES the served
+                # anytime answer in place: merge the exact
+                # cost/assignment over the last segment event (the
+                # SSE replay-on-connect and close paths read
+                # ``sess.last``) without touching the warm engine —
+                # no recompile, and the next event batch resumes the
+                # iterative fixpoint exactly where it was.
+                merged = dict(sess.last or {})
+                merged.update({k: event[k] for k in (
+                    "assignment", "cost", "optimal",
+                    "certified_seq") if k in event})
+                sess.last = merged
             subscribers = list(sess.subscribers)
         for q in subscribers:
             try:
@@ -809,6 +842,8 @@ class SessionManager:
                     self._work_close(work)
                 elif work.kind == "export":
                     self._work_export(work)
+                elif work.kind == "certify":
+                    self._work_certify(work)
                 else:
                     raise ValueError(
                         f"unknown session work {work.kind!r}")
@@ -937,8 +972,32 @@ class SessionManager:
             return
         last = sess.last or {}
         if last.get("converged") or sess.budget <= 0:
+            # Quiesced: the warm fixpoint is what clients will be
+            # served until the next event.  If the oracle tier is on,
+            # arm the certification timer — a fresh event batch
+            # before it fires advances applied_seq and the stale
+            # certify work no-ops.
+            self._maybe_schedule_certify(sess)
             return
         self._enqueue(SessionWork("segment", sess))
+
+    def _maybe_schedule_certify(self, sess: SolveSession) -> None:
+        if self.certify_after is None or sess.status != OPEN:
+            return
+        target = sess.applied_seq
+        if sess.certified_seq >= target \
+                or sess.certify_scheduled_seq >= target:
+            return
+        sess.certify_scheduled_seq = target
+
+        def _fire():
+            # Timer thread: only enqueue (put_nowait is thread-safe);
+            # all engine work stays on the scheduler thread.
+            self._enqueue(SessionWork("certify", sess, seq=target))
+
+        timer = threading.Timer(self.certify_after, _fire)
+        timer.daemon = True
+        timer.start()
 
     def _work_close(self, work: SessionWork) -> None:
         sess = work.session
@@ -1053,6 +1112,102 @@ class SessionManager:
                 if sess.status == MIGRATING:
                     sess.status = OPEN
             self._enqueue(SessionWork("segment", sess))
+
+    def _work_certify(self, work: SessionWork) -> None:
+        """The session oracle (scheduler thread): an exact DPOP solve
+        of the session's CURRENT mutated problem, run only after the
+        event stream quiesced for ``certify_after`` seconds.  Either
+        certifies the warm fixpoint as optimal (delta 0) or replaces
+        the served assignment with the true optimum — in both cases
+        the certified delta goes to the session SSE stream and the
+        /stats rollup.  Failures degrade to a log line: the oracle is
+        an accuracy tier, never allowed to kill a healthy session."""
+        sess = work.session
+        if sess.applied_seq != work.seq or sess.certified_seq >= work.seq:
+            # Stale: new events arrived while the timer ran (their
+            # quiescence re-arms with a newer seq), or a concurrent
+            # timer already certified this seq.
+            return
+        last = sess.last or {}
+        fixpoint_cost = last.get("cost")
+        if fixpoint_cost is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            from pydcop_tpu.computations_graph import pseudotree as pt
+            from pydcop_tpu.dcop.yamldcop import load_dcop
+            from pydcop_tpu.engine.dpop import (
+                DpopEngine,
+                dpop_feasibility,
+            )
+            from pydcop_tpu.serving import migration as migration_mod
+
+            # Rebase the engine's live problem (event surgery
+            # included) back to a DCOP — the same round-trip the
+            # migration exporter uses.  Unrebasable problems skip
+            # certification rather than certifying the wrong problem.
+            yaml_src = migration_mod.engine_dcop_yaml(
+                sess.engine, name=f"certify_{sess.id}")
+            dcop = load_dcop(yaml_src)
+            tree = pt.build_computation_graph(dcop)
+            verdict = dpop_feasibility(tree, mode=dcop.objective,
+                                       cec=True)
+            if not verdict["feasible"]:
+                self.certify_skipped_width += 1
+                self._publish(sess, "certify_skipped", {
+                    "reason": "rejected_width",
+                    "induced_width": verdict["induced_width"],
+                    "max_elements": (verdict["cec_max_elements"]
+                                     or verdict["max_elements"]),
+                })
+                return
+            span = (tracer.span("session_certify", "serving",
+                                session=sess.id, seq=work.seq)
+                    if tracer.active else None)
+            with (span if span is not None
+                  else contextlib.nullcontext()):
+                res = DpopEngine(tree, mode=dcop.objective,
+                                 cec=True).run()
+                # Score the exact assignment with the ENGINE's cost
+                # function — the same scale every published segment
+                # cost uses, so the delta below is apples-to-apples.
+                exact_cost = sess.engine.cost(res.assignment)
+            delta = (float(fixpoint_cost) - float(exact_cost)
+                     if dcop.objective == "min"
+                     else float(exact_cost) - float(fixpoint_cost))
+            improved = delta > 1e-9
+            sess.certified_seq = work.seq
+            self.certifications += 1
+            if improved:
+                self.certified_improved += 1
+            payload: Dict[str, Any] = {
+                "certified_seq": work.seq,
+                "certified_cost": exact_cost,
+                "fixpoint_cost": fixpoint_cost,
+                "delta": delta,
+                "optimal": True,
+                "improved": improved,
+                "induced_width": res.metrics.get("induced_width"),
+                "certify_s": time.perf_counter() - t0,
+            }
+            if improved:
+                # _publish folds the exact assignment + cost into
+                # sess.last — the served answer upgrades in place,
+                # the warm engine is untouched.
+                payload["assignment"] = res.assignment
+                payload["cost"] = exact_cost
+            self.last_certification = {
+                "session": sess.id, "seq": work.seq,
+                "delta": delta, "improved": improved,
+                "certified_cost": exact_cost,
+                "fixpoint_cost": fixpoint_cost,
+            }
+            work.result = dict(payload)
+            self._publish(sess, "certified", payload)
+        except Exception as exc:  # noqa: BLE001 — oracle failures
+            # must not take the session down with them.
+            logger.warning("session %s certification failed: %s",
+                           sess.id, exc)
 
     def _fail(self, sess: SolveSession, message: str) -> None:
         sess.error = message
@@ -1375,4 +1530,15 @@ class SessionManager:
                     for s in self._sessions.values()),
                 "recompiles": sum(
                     s.recompiles for s in self._sessions.values()),
+                # The oracle tier's rollup (docs/sessions.md): how
+                # many quiesced fixpoints were certified, how many
+                # certifications IMPROVED the served answer, and the
+                # most recent certified-cost delta.
+                "certify_after": self.certify_after,
+                "certifications": self.certifications,
+                "certified_improved": self.certified_improved,
+                "certify_skipped_width": self.certify_skipped_width,
+                "last_certification": (
+                    dict(self.last_certification)
+                    if self.last_certification else None),
             }

@@ -1,0 +1,513 @@
+"""Battery for the exact-inference subsystem (ISSUE 17):
+
+- exactness: DPOP assignments score exactly the SyncBB optimum on
+  seeded trees and width-bounded cyclic graphs with integer tables;
+- cross-edge consistency (CEC) preprocessing: CEC-on assignments are
+  bit-identical to CEC-off on random structures in both objective
+  modes, crafted dominated instances actually prune (and shrink the
+  UTIL hypercubes tree_stats reports), and the ``cec=off`` algo
+  param turns the pass off;
+- pseudo-tree construction: deterministic across repeated builds,
+  depth/level invariants hold, and the host-numpy engine fallback
+  still engages below the device-amortization threshold;
+- width-keyed portfolio routing: on the domino chain (a structure
+  where every iterative candidate's 60-cycle race leg is far from
+  the optimum) ``algo="auto"`` resolves to DPOP, the decision
+  replays from the persisted cache with zero re-measurement, and an
+  over-width structure keeps DPOP out of the race entirely;
+- the serving tier: ``algo:"dpop"`` over real HTTP returns
+  ``optimal: true`` with the same assignment as a solo exact solve,
+  an over-width request is a structured 400 ``rejected_width`` (the
+  admission breaker never sees it), and ``/stats`` counts the exact
+  dispatches;
+- the session oracle: a quiesced session is certified by a
+  background exact solve (delta in ``/stats`` + the session SSE
+  stream), and an IMPROVING certification replaces the served
+  assignment without recompiling the warm engine.
+"""
+
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pydcop_tpu import api
+from pydcop_tpu.algorithms import AlgorithmDef
+from pydcop_tpu.algorithms.dpop import solve_on_device
+from pydcop_tpu.computations_graph import pseudotree as pt
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.ops import dpop as dpop_ops
+
+
+def _random_dcop(n, d, seed, extra_edges=0, objective="min",
+                 integer=True, lo=0, hi=20):
+    """Random spanning tree + optional extra edges, integer tables by
+    default (integer optima make cost equality exact, not approx)."""
+    rng = np.random.default_rng(seed)
+    dom = Domain("c", "", list(range(d)))
+    dcop = DCOP("t", objective=objective)
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+
+    def table(shape):
+        if integer:
+            return rng.integers(lo, hi, shape).astype(float)
+        return rng.random(shape)
+
+    k = 0
+    for i in range(1, n):
+        p = rng.integers(0, i)
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[p], vs[i]], table((d, d)), f"c{k}"))
+        k += 1
+    for _ in range(extra_edges):
+        i, j = rng.choice(n, size=2, replace=False)
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[j]], table((d, d)), f"c{k}"))
+        k += 1
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def _domino_chain(n=140, weak_at=None):
+    """The portfolio battery structure: a binary agreement chain with
+    one weak link in the middle and opposing biases pinned at the two
+    ends.  The optimum (cost 1: break at the weak link) needs
+    end-to-end propagation — more cycles than any iterative
+    candidate's race leg gets — so DPOP is the only candidate whose
+    race answer lands within cost tolerance of the best."""
+    weak_at = n // 2 if weak_at is None else weak_at
+    dom = Domain("b", "", [0, 1])
+    dcop = DCOP("domino", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for i in range(n - 1):
+        m = (np.array([[0.0, 1.0], [1.0, 0.0]]) if i == weak_at
+             else np.array([[0.0, 5.0], [5.0, 0.0]]))
+        if i == 0:
+            m = m + np.array([[0.0, 0.0], [3.0, 3.0]])   # v0 -> 0
+        if i == n - 2:
+            m = m + np.array([[3.0, 0.0], [3.0, 0.0]])   # v_last -> 1
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[i], vs[i + 1]], m, f"m{i}"))
+    dcop.add_agents([AgentDef(f"a{i}") for i in range(n)])
+    return dcop
+
+
+def _dpop(dcop, engine="jit", cec="on"):
+    algo = AlgorithmDef.build_with_default_param(
+        "dpop", {"engine": engine, "cec": cec}, mode=dcop.objective)
+    return solve_on_device(dcop, algo)
+
+
+# ------------------------------------------------------------------ #
+# exactness: DPOP == SyncBB optimum
+
+
+class TestExactnessVsSyncBB:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_tree_optimum(self, seed):
+        dcop = _random_dcop(10, 3, seed)
+        exact = _dpop(dcop)
+        ref = api.solve(dcop, "syncbb", backend="device")
+        cost, violations = dcop.solution_cost(exact.assignment)
+        assert violations == 0
+        assert cost == ref.cost, \
+            "DPOP must land exactly on the SyncBB optimum"
+        assert exact.metrics["optimal"] is True
+
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_width_bounded_graph_optimum(self, seed):
+        """Back edges widen separators: still exact, still optimal."""
+        dcop = _random_dcop(9, 3, seed, extra_edges=4)
+        exact = _dpop(dcop)
+        ref = api.solve(dcop, "syncbb", backend="device")
+        cost, _ = dcop.solution_cost(exact.assignment)
+        assert cost == ref.cost
+
+    def test_max_mode_optimum(self):
+        dcop = _random_dcop(8, 3, 5, extra_edges=2, objective="max")
+        exact = _dpop(dcop)
+        ref = api.solve(dcop, "syncbb", backend="device")
+        cost, _ = dcop.solution_cost(exact.assignment)
+        assert cost == ref.cost
+
+
+# ------------------------------------------------------------------ #
+# CEC preprocessing
+
+
+class TestCecConsistency:
+    @pytest.mark.parametrize("objective", ["min", "max"])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_bit_identical_assignments(self, seed, objective):
+        dcop = _random_dcop(25, 4, seed, extra_edges=5,
+                            objective=objective, integer=False)
+        graph = pt.build_computation_graph(dcop)
+        a_off, s_off = dpop_ops.solve_sweep(graph, mode=objective,
+                                            cec=False)
+        a_on, s_on = dpop_ops.solve_sweep(graph, mode=objective,
+                                          cec=True)
+        assert a_on == a_off, \
+            "CEC must be a pure optimization: identical assignments"
+        assert s_on["cec_pruned"] >= 0
+
+    def test_dominated_values_are_pruned(self):
+        """Crafted domination: half the domain carries a flat +10
+        offset in its unary AND every binary row — soft dominance
+        prunes those values and tree_stats shrinks."""
+        rng = np.random.default_rng(7)
+        d = 6
+        dom = Domain("c", "", list(range(d)))
+        dcop = DCOP("dom", objective="min")
+        vs = [Variable(f"v{i}", dom) for i in range(8)]
+        offset = np.zeros(d)
+        offset[d // 2:] = 10.0
+        for v in vs:
+            dcop.add_variable(v)
+        for i in range(1, 8):
+            base = rng.random((d, d))
+            m = base + offset[:, None] + offset[None, :]
+            dcop.add_constraint(NAryMatrixRelation(
+                [vs[i - 1], vs[i]], m, f"c{i}"))
+        dcop.add_agents([AgentDef("a0")])
+        graph = pt.build_computation_graph(dcop)
+        survivors, meta = dpop_ops.cec_survivors(graph, "min")
+        assert meta["pruned"] > 0, "domination must prune something"
+        raw = dpop_ops.tree_stats(graph)
+        shrunk = dpop_ops.tree_stats(graph, survivors)
+        assert shrunk["max_elements"] < raw["max_elements"], \
+            "pruned survivors must shrink the UTIL hypercubes"
+        a_on, stats = dpop_ops.solve_sweep(graph, "min", cec=True)
+        a_off, _ = dpop_ops.solve_sweep(graph, "min", cec=False)
+        assert a_on == a_off
+        assert stats["cec_pruned"] == meta["pruned"]
+
+    def test_cec_off_param_disables_the_pass(self):
+        dcop = _random_dcop(12, 3, 9)
+        res = _dpop(dcop, cec="off")
+        assert res.metrics.get("cec_pruned", 0) == 0
+        on = _dpop(dcop, cec="on")
+        assert on.assignment == res.assignment
+
+
+# ------------------------------------------------------------------ #
+# pseudo-tree construction
+
+
+class TestPseudoTreeInvariants:
+    def test_deterministic_across_builds(self):
+        dcop = _random_dcop(30, 3, 11, extra_edges=6)
+
+        def shape(graph):
+            return sorted(
+                (n.name, n.parent, tuple(sorted(n.pseudo_parents)),
+                 tuple(sorted(n.children)))
+                for n in graph.nodes)
+
+        g1 = pt.build_computation_graph(dcop)
+        g2 = pt.build_computation_graph(dcop)
+        assert shape(g1) == shape(g2), \
+            "pseudo-tree construction must be deterministic"
+        s1 = dpop_ops.tree_stats(g1)
+        s2 = dpop_ops.tree_stats(g2)
+        assert s1 == s2
+
+    def test_depth_and_level_invariants(self):
+        dcop = _random_dcop(40, 3, 13, extra_edges=8)
+        graph = pt.build_computation_graph(dcop)
+        depths = pt.node_depths(graph)
+        by_name = {n.name: n for n in graph.nodes}
+        for name, node in by_name.items():
+            if node.parent is None:
+                assert depths[name] == 0
+            else:
+                assert depths[name] == depths[node.parent] + 1
+            # Pseudo-parents are ancestors: strictly shallower.
+            for pp in node.pseudo_parents:
+                assert depths[pp] < depths[name]
+        stats = dpop_ops.tree_stats(graph)
+        assert stats["nodes"] == 40
+        assert stats["levels"] == max(depths.values()) + 1
+        assert 1 <= stats["induced_width"] <= 39
+
+    def test_numpy_fallback_below_amortization_threshold(self):
+        """Tiny problems never pay device dispatch: engine=auto routes
+        them through the host-numpy sweep."""
+        dcop = _random_dcop(6, 2, 17)
+        res = _dpop(dcop, engine="auto")
+        assert res.metrics["engine"] == "numpy"
+        jit = _dpop(dcop, engine="jit")
+        cost_np, _ = dcop.solution_cost(res.assignment)
+        cost_jit, _ = dcop.solution_cost(jit.assignment)
+        assert cost_np == cost_jit
+
+
+# ------------------------------------------------------------------ #
+# width-keyed portfolio routing
+
+
+class TestPortfolioRouting:
+    def test_auto_picks_dpop_on_domino_then_replays_cached(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PYDCOP_AGG_AUTOTUNE_CACHE",
+                           str(tmp_path / "autotune.json"))
+        dcop = _domino_chain(140)
+        res = api.solve(dcop, "auto", backend="device")
+        info = res["metrics"]["portfolio"]
+        assert info["algo"] == "dpop", \
+            "only the exact candidate is cost-eligible on the domino"
+        assert res.cost == 1.0, "auto must serve the true optimum"
+        # Same structure again: the decision replays from the shape
+        # cache — no re-measurement race.
+        res2 = api.solve(_domino_chain(140), "auto", backend="device")
+        info2 = res2["metrics"]["portfolio"]
+        assert info2["portfolio_source"] == "cache"
+        assert info2["algo"] == "dpop"
+        assert res2.cost == 1.0
+
+    def test_over_width_structure_races_without_dpop(
+            self, tmp_path, monkeypatch):
+        """Past the race's element gate the dpop runner declines:
+        auto resolves to an iterative candidate instead of failing."""
+        from pydcop_tpu.engine.autotune import (
+            DPOP_RACE_MAX_ELEMENTS,
+            dpop_portfolio_runner,
+        )
+        from pydcop_tpu.engine.compile import compile_dcop
+
+        # A 10-variable clique over a 10-value domain: induced width
+        # 9, UTIL hypercubes of 10^10 cells — far past the race gate.
+        n, d = 10, 10
+        dom = Domain("c", "", list(range(d)))
+        dcop = DCOP("clique", objective="min")
+        vs = [Variable(f"x{i}", dom) for i in range(n)]
+        for v in vs:
+            dcop.add_variable(v)
+        rng = np.random.default_rng(3)
+        k = 0
+        for i in range(n):
+            for j in range(i + 1, n):
+                dcop.add_constraint(NAryMatrixRelation(
+                    [vs[i], vs[j]], rng.random((d, d)), f"c{k}"))
+                k += 1
+        dcop.add_agents([AgentDef("a0")])
+        ptree = pt.build_computation_graph(dcop)
+        stats = dpop_ops.tree_stats(ptree)
+        assert stats["max_elements"] > DPOP_RACE_MAX_ELEMENTS
+        graph, meta = compile_dcop(dcop)
+        assert dpop_portfolio_runner(dcop, graph, meta) is None, \
+            "over-width structures must not enter the race"
+
+
+# ------------------------------------------------------------------ #
+# serving tier over real HTTP
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _wide_clique_yaml(n=12, d=10):
+    lines = ["name: wide", "objective: min", "domains:",
+             "  d: {values: [" + ", ".join(map(str, range(d))) + "]}",
+             "variables:"]
+    for i in range(n):
+        lines.append(f"  x{i}: {{domain: d}}")
+    lines.append("constraints:")
+    for i, j in itertools.combinations(range(n), 2):
+        lines.append(f"  c{i}_{j}: {{type: intention, function: "
+                     f"\"1 if x{i} == x{j} else 0\"}}")
+    lines.append("agents: [a0]")
+    return "\n".join(lines)
+
+
+class TestDpopServingHTTP:
+    def test_dpop_request_is_optimal_and_matches_solo(self):
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        dcop = _random_dcop(10, 3, 21)
+        yaml_src = dcop_yaml(dcop)
+        with api.serve(port=0, batch_window_s=0.02) as handle:
+            code, res = _post(handle.url + "/solve",
+                              {"dcop": yaml_src, "wait": True,
+                               "params": {"algo": "dpop"}})
+            assert code == 200 and res["status"] == "FINISHED"
+            assert res["optimal"] is True, \
+                "exact dispatches must certify their result"
+            solo = _dpop(dcop)
+            assert {k: v for k, v in res["assignment"].items()} == \
+                {k: v for k, v in solo.assignment.items()}
+            stats = _get(handle.url + "/stats")
+            assert stats["dpop_dispatches"] >= 1
+            # The iterative default never carries the flag.
+            code2, res2 = _post(handle.url + "/solve",
+                                {"dcop": yaml_src, "wait": True})
+            assert code2 == 200 and "optimal" not in res2
+
+    def test_over_width_is_structured_400_not_breaker_500(self):
+        with api.serve(port=0, batch_window_s=0.02,
+                       breaker_failures=1) as handle:
+            code, res = _post(handle.url + "/solve",
+                              {"dcop": _wide_clique_yaml(),
+                               "wait": True,
+                               "params": {"algo": "dpop"}})
+            assert code == 400, \
+                "an over-width exact request is a client error"
+            assert res["status"] == "rejected_width"
+            assert res["max_elements"] > res["max_elements_cap"]
+            assert res["retry"] is False
+            # The breaker never saw it (breaker_failures=1 would have
+            # opened on a single dispatch failure): healthy service,
+            # iterative requests still served.
+            stats = _get(handle.url + "/stats")
+            assert stats["breaker_state"] == "closed"
+            code2, res2 = _post(
+                handle.url + "/solve",
+                {"dcop": _wide_clique_yaml(), "wait": True,
+                 "params": {"algo": "maxsum", "max_cycles": 20}})
+            assert code2 == 200 and res2["status"] == "FINISHED"
+
+    def test_unknown_algo_param_rejected(self):
+        with api.serve(port=0, batch_window_s=0.02) as handle:
+            code, res = _post(handle.url + "/solve",
+                              {"dcop": _wide_clique_yaml(4, 2),
+                               "wait": True,
+                               "params": {"algo": "simplex"}})
+            assert code == 400
+            assert "algo" in res["error"]
+
+
+# ------------------------------------------------------------------ #
+# the session oracle
+
+
+class TestSessionOracle:
+    def _open(self, svc, dcop, params):
+        return svc.sessions.open(dcop, params=params)
+
+    def _wait_quiesced(self, svc, sid, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = svc.sessions.status(sid)
+            last = st["last"]
+            if last is not None and (last.get("converged")
+                                     or st.get("budget", 1) == 0):
+                return st
+            time.sleep(0.05)
+        raise AssertionError(f"session {sid} never quiesced")
+
+    def _wait_certified(self, svc, n=1, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            stats = svc.sessions.stats()
+            if stats["certifications"] >= n:
+                return stats
+            time.sleep(0.05)
+        raise AssertionError("oracle never certified "
+                             f"(stats: {svc.sessions.stats()})")
+
+    def test_quiesced_session_is_certified_with_delta_in_stats(self):
+        from pydcop_tpu.serving.service import SolveService
+
+        svc = SolveService(batch_window_s=0.02,
+                           session_certify_after=0.2).start()
+        try:
+            sess = self._open(svc, _random_dcop(10, 3, 31),
+                              {"noise": 0.0, "max_cycles": 300})
+            q = svc.sessions.subscribe(sess.id)
+            self._wait_quiesced(svc, sess.id)
+            stats = self._wait_certified(svc)
+            cert = stats["last_certification"]
+            assert cert["session"] == sess.id
+            assert cert["delta"] >= 0.0
+            assert stats["certify_after"] == pytest.approx(0.2)
+            # The SSE stream carried the certified event.
+            deadline = time.monotonic() + 10
+            phases = []
+            while time.monotonic() < deadline:
+                try:
+                    ev = q.get(timeout=0.5)
+                except Exception:
+                    continue
+                phases.append(ev.get("phase"))
+                if ev.get("phase") == "certified":
+                    assert ev["optimal"] is True
+                    assert ev["certified_cost"] == pytest.approx(
+                        cert["certified_cost"])
+                    assert "delta" in ev
+                    break
+            assert "certified" in phases, \
+                f"no certified SSE event (saw {phases})"
+        finally:
+            svc.stop(drain=False)
+
+    def test_improving_certification_updates_served_assignment(self):
+        """On the domino chain the warm fixpoint is provably
+        suboptimal within the cycle budget: the oracle's exact solve
+        must IMPROVE the served answer in place — no recompile."""
+        from pydcop_tpu.serving.service import SolveService
+
+        svc = SolveService(batch_window_s=0.02,
+                           session_certify_after=0.2).start()
+        try:
+            dcop = _domino_chain(60, weak_at=30)
+            sess = self._open(svc, dcop, {
+                "noise": 0.0, "max_cycles": 30,
+                "segment_cycles": 15})
+            # Certification only happens after quiescence — waiting
+            # for it subsumes waiting for the fixpoint.
+            stats = self._wait_certified(svc)
+            cert = stats["last_certification"]
+            assert cert["improved"] is True
+            assert cert["delta"] > 0
+            assert cert["certified_cost"] == pytest.approx(1.0)
+            assert cert["fixpoint_cost"] > cert["certified_cost"]
+            st1 = svc.sessions.status(sess.id)
+            assert st1["last"]["cost"] == pytest.approx(1.0), \
+                "the served answer must upgrade to the optimum"
+            assert st1["last"]["optimal"] is True
+            cost, violations = dcop.solution_cost(
+                st1["last"]["assignment"])
+            assert violations == 0 and cost == pytest.approx(1.0)
+            assert st1["recompiles"] == 0, \
+                "certification must never recompile the warm engine"
+            assert stats["certified_improved"] >= 1
+        finally:
+            svc.stop(drain=False)
+
+    def test_oracle_off_by_default(self):
+        from pydcop_tpu.serving.service import SolveService
+
+        svc = SolveService(batch_window_s=0.02).start()
+        try:
+            sess = self._open(svc, _random_dcop(8, 3, 37),
+                              {"noise": 0.0, "max_cycles": 200})
+            self._wait_quiesced(svc, sess.id)
+            time.sleep(0.5)
+            stats = svc.sessions.stats()
+            assert stats["certify_after"] is None
+            assert stats["certifications"] == 0
+        finally:
+            svc.stop(drain=False)

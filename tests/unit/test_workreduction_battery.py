@@ -339,19 +339,29 @@ class TestPortfolio:
         from pydcop_tpu.engine.autotune import (
             PORTFOLIO_CANDIDATES,
             autotune_portfolio,
+            dpop_portfolio_runner,
         )
 
-        _dcop, graph = self._graph()
+        dcop = coloring_dcop(n=30, seed=0)
+        graph, meta = compile_dcop(dcop, noise_level=0.01,
+                                   use_cache=False)
         with tempfile.TemporaryDirectory() as td:
             cache = os.path.join(td, "tune.json")
+            dpop_runner = dpop_portfolio_runner(dcop, graph, meta)
             info = autotune_portfolio(
-                graph, race_cycles=30, cache_file=cache)
+                graph, race_cycles=30, cache_file=cache,
+                extra_runners={"dpop": dpop_runner})
             assert info["algo"] in PORTFOLIO_CANDIDATES
             assert info["portfolio_source"] == "measured"
             timed = [n for n, t in
                      info["portfolio_timings_ms"].items()
                      if t is not None]
-            assert set(timed) == set(PORTFOLIO_CANDIDATES)
+            # "dpop" only races when the structure is width-feasible
+            # (runner is None past the gate) — every unconditional
+            # candidate must have been timed either way.
+            expected = set(PORTFOLIO_CANDIDATES) - (
+                set() if dpop_runner is not None else {"dpop"})
+            assert set(timed) == expected
             assert info["portfolio_target_cost"] is not None
             replay = autotune_portfolio(
                 graph, race_cycles=30, cache_file=cache)

@@ -7,8 +7,10 @@ Every PR's driver appends a ``BENCH_r<N>.json`` (the supervised
 a perf regression only surfaced when a human eyeballed the numbers.
 This tool parses the whole history, builds a noise-aware baseline per
 backend (CPU-fallback and TPU rates differ by orders of magnitude and
-must never share a baseline), and fails when the newest run regresses
-beyond threshold.
+must never share a baseline — and CPU baselines are further keyed on
+the host's core count once a round records ``host_cpus``, because a
+1-core bench box measures the same code ~3x slower than an 8-core
+one), and fails when the newest run regresses beyond threshold.
 
 Noise model: the baseline is the MEDIAN of the trailing window with a
 MAD (median absolute deviation) spread — both robust to the single
@@ -109,6 +111,11 @@ def load_history(root: str) -> List[Dict[str, Any]]:
             # Rounds 1-5 all fell back to CPU; the earliest line
             # predates the backend key, so absent means cpu.
             "backend": parsed.get("backend") or "cpu",
+            # Host hardware class (ISSUE 17): CPU-fallback rates scale
+            # with the bench box's core count, so CPU baselines are
+            # keyed on it (``cpu@<n>``) once a round records it —
+            # rounds that predate the key stay plain ``cpu``.
+            "host_cpus": parsed.get("host_cpus"),
             # Serving-throughput leg (PR-6 bench_serving); absent in
             # earlier rounds, None when the leg failed that round.
             "serve_value": (float(serve_value)
@@ -159,6 +166,12 @@ def load_history(root: str) -> List[Dict[str, Any]]:
                 parsed.get("fleet_problems_per_sec_r2")),
             "cold_start_value": _opt_float(
                 parsed.get("serve_cold_start_warm_s")),
+            # Exact-inference leg (ISSUE 17 bench_dpop_exact):
+            # warmed best-of-N full DPOP sweep (UTIL up + VALUE
+            # down, CEC on) on the width-bounded seeded instance
+            # (ms, LOWER is better) — absent before PR 17, None
+            # when the leg failed that round.
+            "dpop_value": _opt_float(parsed.get("dpop_exact_ms")),
             # Elastic-fleet leg (ISSUE 16 bench_fleet_elastic):
             # baseline closed-loop problems/sec through the two-host
             # fleet that also survives the leg's migration, 4x-step
@@ -323,6 +336,12 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
          "backend", True, "fleet_elastic"),
         ("shard_recovery", "shard_recovery_value", "s",
          "sharded_backend", False, "sharded"),
+        # ISSUE 17: warm wall-clock of one exact DPOP sweep on the
+        # width-bounded seeded instance (ms, LOWER is better) — a
+        # brand-new family: until 3 rounds exist its verdict is
+        # "insufficient", never a crash or gate.
+        ("dpop_exact", "dpop_value", "ms", "backend", False,
+         "dpop_exact"),
         # ISSUE 13: the stateful-session families — sustained
         # scenario-event throughput per session (higher is better)
         # and warm time-to-recovered-cost after an event (the
@@ -345,9 +364,21 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
             # (``leg_backends``, PR 11+); older rounds fall back to
             # their per-run backend field — identical to the pre-leg
             # behavior, so legacy histories judge unchanged.
-            return ((r.get("leg_backends") or {}).get(leg)
+            base = ((r.get("leg_backends") or {}).get(leg)
                     or r.get(backend_key) or r.get("backend")
                     or "cpu")
+            # CPU rates are host-bound: the same code measures ~3x
+            # slower on a 1-core box than the 8-core boxes earlier
+            # rounds ran on.  Once a round records its core count,
+            # its CPU series is keyed ``cpu@<n>`` so it is judged only
+            # against same-class hosts — the exact refusal the
+            # backend split (ISSUE 14) applies between cpu and tpu.
+            # Accelerator backends keep their plain key: their rates
+            # are device-bound, not host-core-bound.
+            cpus = r.get("host_cpus")
+            if base == "cpu" and cpus:
+                return f"cpu@{int(cpus)}"
+            return base
 
         rows_f = [r for r in runs
                   if "skipped" not in r and r.get(field) is not None]

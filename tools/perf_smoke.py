@@ -26,6 +26,12 @@ fast and the autotuner only makes valid choices —
    dispatch — tracker on vs off, PAIRWISE interleaved so CPU
    frequency drift and concurrent-load flake cannot masquerade as
    plane overhead.
+6. **Cross-edge consistency** (ISSUE 17 acceptance): on a seeded
+   low-width graph with soft-dominated domain values, the CEC
+   preprocessing pass must either speed the warmed UTIL sweep by
+   >= 1.2x or gain >= 1 effective width rung (one domain factor off
+   the largest UTIL hypercube) — CEC-on vs CEC-off PAIRWISE
+   interleaved — while the returned assignment stays bit-identical.
 
 Run:  python tools/perf_smoke.py      (exit 0 = all claims hold)
 """
@@ -516,6 +522,103 @@ def check_efficiency_overhead() -> dict:
             "overhead": round(ratio - 1, 4)}
 
 
+CEC_MIN_SPEEDUP = 1.2
+CEC_N_VARS = 60
+CEC_DOMAIN = 8
+
+
+def build_cec_graph(seed=17, n=CEC_N_VARS, d=CEC_DOMAIN):
+    """Seeded low-width instance where CEC provably bites: a banded
+    chain whose factor tables carry a +10 offset on the upper half of
+    every domain (``m[a][b] = base + off[a] + off[b]``), so those
+    values are soft-dominated from every context and the consistency
+    pass halves each hypercube axis."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+
+    rng = np.random.default_rng(seed)
+    dom = Domain("c", "", list(range(d)))
+    dcop = DCOP("cec_bench", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    off = np.where(np.arange(d) < d // 2, 0.0, 10.0)
+    k = 0
+    for i in range(1, n):
+        for j in (i - 1, i - 2):
+            if j < 0:
+                continue
+            table = (rng.random((d, d))
+                     + off[:, None] + off[None, :])
+            dcop.add_constraint(
+                NAryMatrixRelation([vs[j], vs[i]], table, f"c{k}"))
+            k += 1
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def check_cec() -> dict:
+    """The ISSUE 17 perf gate: CEC preprocessing must pay for itself
+    on the UTIL sweep.  Both engines are warmed (compiles and the
+    one-shot dominance pass land outside the clock — serving and the
+    portfolio race reuse cached survivors the same way), then CEC-off
+    and CEC-on sweeps interleave PAIRWISE (the PR-9 methodology),
+    min-of-N per side.  Pass = >= 1.2x sweep throughput OR >= 1
+    effective width rung gained; bit-identical assignment always."""
+    import math
+
+    from pydcop_tpu.computations_graph import pseudotree as pt
+    from pydcop_tpu.engine.dpop import DpopEngine
+    from pydcop_tpu.ops.dpop import cec_survivors, tree_stats
+
+    dcop = build_cec_graph()
+    tree = pt.build_computation_graph(dcop)
+    survivors, meta = cec_survivors(tree, "min")
+    assert meta["pruned"] > 0, (
+        "CEC pruned nothing on the dominated-value instance "
+        f"({meta})")
+    raw = tree_stats(tree)["max_elements"]
+    shrunk = tree_stats(tree, survivors)["max_elements"]
+    # One rung = one domain factor off the largest hypercube: the
+    # width-ceiling currency (a problem one rung smaller admits one
+    # more separator variable at the same element cap).
+    rungs = (math.log(raw / shrunk, CEC_DOMAIN) if shrunk else 0.0)
+
+    on = DpopEngine(tree, mode="min", cec=True)
+    off = DpopEngine(tree, mode="min", cec=False)
+    res_on = on.run()    # warm: compiles + survivor cache
+    res_off = off.run()
+    assert res_on.assignment == res_off.assignment, (
+        "CEC-on assignment diverged from CEC-off")
+    ratio = 0.0
+    t_on = t_off = None
+    for _ in range(3):  # best-of-attempts damps a noisy neighbor
+        offs, ons = [], []
+        for _rep in range(4):  # pairwise interleaved
+            t0 = time.perf_counter()
+            off.run()
+            offs.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            on.run()
+            ons.append(time.perf_counter() - t0)
+        t_off, t_on = min(offs), min(ons)
+        ratio = max(ratio, t_off / t_on)
+        if ratio >= CEC_MIN_SPEEDUP:
+            break
+    assert ratio >= CEC_MIN_SPEEDUP or rungs >= 1.0, (
+        f"CEC gained only {ratio:.2f}x sweep throughput (need >= "
+        f"{CEC_MIN_SPEEDUP}x) and {rungs:.2f} width rungs (need >= "
+        f"1): off {t_off * 1e3:.1f}ms -> on {t_on * 1e3:.1f}ms, "
+        f"max_elements {raw} -> {shrunk}")
+    return {"off_ms": round(t_off * 1e3, 2),
+            "on_ms": round(t_on * 1e3, 2),
+            "speedup": round(ratio, 2),
+            "pruned_values": meta["pruned"],
+            "max_elements_raw": raw,
+            "max_elements_cec": shrunk,
+            "width_rungs_gained": round(rungs, 2)}
+
+
 def main() -> int:
     results = {}
     for name, check in (
@@ -526,6 +629,7 @@ def main() -> int:
         ("decimation", check_decimation),
         ("flight_overhead", check_flight_overhead),
         ("efficiency_overhead", check_efficiency_overhead),
+        ("cec", check_cec),
     ):
         try:
             results[name] = check()

@@ -53,6 +53,12 @@ Starts the real service on port 0 and drives it over HTTP:
    host-striped fleet with zero acked events lost — the router pin
    follows the session and the fairness/migration control surfaces
    are live on /stats.
+10. **Exact-inference tier** (ISSUE 17 acceptance): a request with
+    ``params.algo="dpop"`` answers with ``optimal: true`` and the
+    assignment the solo exact solve produces, while a problem whose
+    UTIL hypercube exceeds the element cap gets a structured 400
+    (``status: rejected_width``) — never a 500, and the service
+    keeps serving iterative traffic afterwards.
 
 Run:  python tools/serve_smoke.py      (exit 0 = all claims hold)
 """
@@ -646,6 +652,89 @@ def leg_elastic_fleet():
           f"({summary['workers']})")
 
 
+def build_wide_clique(n_vars: int = 12, d: int = 10):
+    """Pairwise clique over a 10-value domain: induced width
+    ``n_vars - 1`` puts the root UTIL hypercube at ``d**n_vars``
+    cells — astronomically past the element cap, so the exact tier
+    must refuse it cleanly."""
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.relations import NAryMatrixRelation
+
+    rng = np.random.default_rng(17)
+    dom = Domain("d", "", list(range(d)))
+    dcop = DCOP("smoke_wide", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n_vars)]
+    for v in vs:
+        dcop.add_variable(v)
+    k = 0
+    for i in range(n_vars):
+        for j in range(i + 1, n_vars):
+            dcop.add_constraint(NAryMatrixRelation(
+                [vs[i], vs[j]], rng.random((d, d)), f"c{k}"))
+            k += 1
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def leg_dpop_exact():
+    """ISSUE 17 acceptance: the exact tier on the wire.  A
+    ``params.algo="dpop"`` request answers ``optimal: true`` with
+    the solo exact assignment; an over-width problem gets a
+    structured 400 (``rejected_width``) — never a 500 — and the
+    service still serves iterative traffic afterwards."""
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+    handle = api.serve(port=0, batch_window_s=0.05, max_batch=8,
+                       max_queue=64)
+    try:
+        url = handle.url
+        dcop = build_path_instance(14, 1701)
+        status, res = post(url, {
+            "dcop": dcop_yaml(dcop), "wait": True, "timeout": 120,
+            "params": {"algo": "dpop"},
+        })
+        check(status == 200 and res["status"] == "FINISHED",
+              f"dpop request finished over HTTP (status {status})")
+        check(res.get("optimal") is True,
+              "exact-tier response carries optimal: true")
+        solo = api.solve(dcop, "dpop", backend="device")
+        check(res["assignment"] == solo["assignment"]
+              and res["cost"] == solo["cost"],
+              "served exact answer identical to solo api.solve "
+              f"(cost {res['cost']})")
+
+        status, body = post(url, {
+            "dcop": dcop_yaml(build_wide_clique()), "wait": True,
+            "timeout": 120, "params": {"algo": "dpop"},
+        })
+        check(status == 400,
+              f"over-width exact request answers 400 (got {status})")
+        check(body.get("status") == "rejected_width"
+              and body.get("max_elements", 0)
+              > body.get("max_elements_cap", 0)
+              and body.get("retry") is False,
+              "400 body is structured: rejected_width + element "
+              f"count {body.get('max_elements')} > cap "
+              f"{body.get('max_elements_cap')}, retry false")
+
+        # The refusal must not poison the service for everyone else.
+        status, res = post(url, {
+            "dcop": dcop_yaml(build_instance(9, 1702)), "wait": True,
+            "timeout": 120, "params": {"max_cycles": MAX_CYCLES},
+        })
+        check(status == 200 and res["status"] == "FINISHED",
+              "iterative traffic still served after the width "
+              "refusal")
+        stats = handle.service.stats()
+        check(stats["dpop_dispatches"] >= 1,
+              "exact dispatches accounted on /stats "
+              f"({stats['dpop_dispatches']})")
+    finally:
+        handle.stop()
+
+
 KILL9_BURST = 10
 
 
@@ -1073,6 +1162,7 @@ def main() -> int:
     leg_mixed_envelope()
     leg_efficiency()
     leg_overload()
+    leg_dpop_exact()
     leg_fleet_burst()
     leg_elastic_fleet()
     leg_kill9_replay()
