@@ -1008,9 +1008,15 @@ def bench_serving_mixed():
     ``serve_mixed_problems_per_sec`` (the sentinel family) +
     latency percentiles + ``serve_mixed_batched_fraction`` (requests
     that shared a device dispatch — ~0 without envelopes on this
-    traffic) and the no-envelope baseline keys."""
+    traffic) and the no-envelope baseline keys.  Also emits
+    ``serve_overlap_fraction`` (ISSUE 18): the measured-window
+    fraction of device execute wall the pipelined scheduler hid
+    decode work under."""
     import threading
 
+    from pydcop_tpu.observability.efficiency import (
+        tracker as efficiency_tracker,
+    )
     from pydcop_tpu.serving.service import SolveService
 
     # Structure frequencies: zipf over ranks, so a couple of
@@ -1025,10 +1031,11 @@ def bench_serving_mixed():
     }
 
     def run_once(envelope_packing: bool,
-                 duration_s: float = SERVE_MIXED_DURATION_S):
+                 duration_s: float = SERVE_MIXED_DURATION_S,
+                 pipeline: bool = True):
         service = SolveService(
             max_queue=512, batch_window_s=SERVE_MIXED_WINDOW_S,
-            max_batch=16,
+            max_batch=16, pipeline=pipeline, speculate=False,
             envelope_packing=envelope_packing).start()
         try:
             params = {"max_cycles": SERVE_MIXED_MAX_CYCLES}
@@ -1069,6 +1076,12 @@ def bench_serving_mixed():
                 for rid in burst:
                     service.result(rid, wait=60)
             stats0 = service.stats()
+            # Window-scoped efficiency ledger (ISSUE 18): the warm
+            # passes above dispatch and decode too, so the overlap
+            # fraction must come from a tracker cleared at the
+            # measured window's start, not the service-lifetime
+            # /stats ratio.
+            efficiency_tracker.clear()
             latencies = []
             completed = [0]
             lock = threading.Lock()
@@ -1099,6 +1112,7 @@ def bench_serving_mixed():
                 t.join(timeout=duration_s + 120)
             elapsed = time.perf_counter() - t_start
             stats = service.stats()
+            rollup = efficiency_tracker.rollup()
         finally:
             service.stop(drain=False)
         if not latencies or elapsed <= 0:
@@ -1123,6 +1137,13 @@ def bench_serving_mixed():
                                     - stats0["envelope_dispatches"]),
             "lane_dispatches": (stats["lane_dispatches"]
                                 - stats0["lane_dispatches"]),
+            # Measured-window decode/dispatch overlap: fraction of
+            # device execute wall the pipelined scheduler hid decode
+            # work under (0.0 with --no_pipeline).
+            "overlap_fraction": rollup.get(
+                "pipeline_overlap_fraction"),
+            "pipelined_dispatches": (rollup.get("pipeline") or
+                                     {}).get("dispatches", 0),
         }
 
     # Discardable pre-runs (1 s each): the jit caches and process
@@ -1144,6 +1165,11 @@ def bench_serving_mixed():
         "serve_mixed_batched_fraction": on["batched_fraction"],
         "serve_mixed_envelope_dispatches": on["envelope_dispatches"],
         "serve_mixed_lane_dispatches": on["lane_dispatches"],
+        # Sentinel family ``serve_overlap`` (ISSUE 18): measured-
+        # window pipelined decode/dispatch overlap fraction.
+        "serve_overlap_fraction": on["overlap_fraction"],
+        "serve_overlap_pipelined_dispatches":
+            on["pipelined_dispatches"],
     }
     if off is not None:
         out["serve_mixed_baseline_problems_per_sec"] = off["pps"]

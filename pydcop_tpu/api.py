@@ -401,6 +401,8 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           recover: bool = False,
           envelope_packing: bool = True,
           envelope_overhead_ms: Optional[float] = None,
+          pipeline: bool = True,
+          speculate: bool = True,
           session_max: int = 64,
           session_segment_cycles: Optional[int] = None,
           session_checkpoint_every_events: int = 8,
@@ -524,6 +526,7 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
             journal_dir=journal_dir, journal_sync=journal_sync,
             envelope_packing=envelope_packing,
             envelope_overhead_ms=envelope_overhead_ms,
+            pipeline=pipeline, speculate=speculate,
             session_max=session_max,
             session_segment_cycles=session_segment_cycles,
             session_checkpoint_every_events=(
@@ -564,6 +567,8 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
         recover=recover,
         envelope_packing=envelope_packing,
         envelope_overhead_ms=envelope_overhead_ms,
+        pipeline=pipeline,
+        speculate=speculate,
         session_max=session_max,
         session_segment_cycles=session_segment_cycles,
         session_checkpoint_every_events=(
@@ -644,6 +649,7 @@ def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
                  high_water, default_params, breaker_failures,
                  breaker_reset_s, result_keep, journal_dir,
                  journal_sync, envelope_packing, envelope_overhead_ms,
+                 pipeline, speculate,
                  session_max, session_segment_cycles,
                  session_checkpoint_every_events,
                  session_certify_after, replicas, affinity,
@@ -688,6 +694,10 @@ def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
         worker_args += ["--journal_sync"]
     if not envelope_packing:
         worker_args += ["--no_envelope"]
+    if not pipeline:
+        worker_args += ["--no_pipeline"]
+    if not speculate:
+        worker_args += ["--no_speculate"]
     if envelope_overhead_ms is not None:
         worker_args += ["--envelope_overhead_ms",
                         str(envelope_overhead_ms)]

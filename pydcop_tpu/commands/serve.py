@@ -92,6 +92,18 @@ def set_parser(subparsers):
                              "envelope pack-vs-solo decision weighs "
                              "against padding waste (default 0.3; "
                              "raise to pack more aggressively)")
+    parser.add_argument("--no_pipeline", "--no-pipeline",
+                        action="store_true",
+                        help="disable pipelined flush decode: every "
+                             "dispatch waits for its results before "
+                             "the next one launches (docs/"
+                             "performance.md \"Closed-loop "
+                             "efficiency\")")
+    parser.add_argument("--no_speculate", "--no-speculate",
+                        action="store_true",
+                        help="disable speculative envelope "
+                             "compilation: programs compile on the "
+                             "request path, on first use only")
     parser.add_argument("--flight_recorder_events",
                         "--flight-recorder-events",
                         type=int, default=None, metavar="N",
@@ -268,6 +280,8 @@ def run_cmd(args) -> int:
         recover=args.recover,
         envelope_packing=not args.no_envelope,
         envelope_overhead_ms=args.envelope_overhead_ms,
+        pipeline=not args.no_pipeline,
+        speculate=not args.no_speculate,
         session_max=args.session_max,
         session_segment_cycles=args.session_segment_cycles,
         session_checkpoint_every_events=args.session_checkpoint_every,

@@ -56,7 +56,15 @@ bit-identical per-request results:
 import contextlib
 import functools
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Dict,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import jax
 import jax.numpy as jnp
@@ -70,7 +78,12 @@ from pydcop_tpu.engine.compile import (
     FactorGraphMeta,
     compile_dcop,
 )
-from pydcop_tpu.engine.runner import DeviceRunResult, timed_jit_call
+from pydcop_tpu.engine.runner import (
+    DeviceRunResult,
+    finish_jit_call,
+    launch_jit_call,
+    timed_jit_call,
+)
 from pydcop_tpu.observability import efficiency
 from pydcop_tpu.observability.profiler import profiler
 from pydcop_tpu.observability.trace import tracer
@@ -297,6 +310,201 @@ def _shape_signature(stacked: CompiledFactorGraph) -> tuple:
 _structure_label = efficiency.structure_label
 
 
+class _StackedPrep(NamedTuple):
+    """Host-side assembly of one stacked dispatch — everything the
+    decode/accounting tail needs, shared by the synchronous
+    (:func:`run_stacked`) and pipelined (:func:`launch_stacked` /
+    :func:`collect_stacked`) paths so the two cannot drift."""
+
+    graphs: tuple
+    stacked: CompiledFactorGraph
+    statics: dict
+    key: tuple
+    n_real: int
+    pad_fraction: float
+    envelope_waste: Optional[List[float]]
+    max_cycles: int
+    t_pack: float
+
+
+class PendingDispatch(NamedTuple):
+    """A launched-but-uncollected device dispatch (JAX async
+    dispatch): the device is executing while the host does other work.
+    Produced by :func:`launch_stacked` / :func:`launch_lane_packed`,
+    consumed exactly once by the matching ``collect_*``."""
+
+    kind: str         # "stacked" | "lane"
+    raw: Any          # launched device outputs (futures)
+    prep: Any
+    key: tuple
+    t_launch: float
+
+
+def _prepare_stacked(graphs, max_cycles, damping, damping_nodes,
+                     stability, pad_to_bins, prune,
+                     envelope) -> _StackedPrep:
+    if not graphs:
+        raise ValueError("run_stacked needs at least one graph")
+    t_pack = time.perf_counter()
+    envelope_waste: Optional[List[float]] = None
+    if envelope is not None:
+        graphs, envelope_waste = stack_to_envelope(graphs, envelope)
+    n_real = len(graphs)
+    pad_fraction = 0.0
+    if pad_to_bins is not None:
+        graphs, n_real, pad_fraction = pad_to_bin(graphs, pad_to_bins)
+    stacked = stack_graphs(graphs)
+    statics = dict(
+        max_cycles=max_cycles,
+        damping=damping,
+        damp_vars=damping_nodes in ("vars", "both"),
+        damp_factors=damping_nodes in ("factors", "both"),
+        stability=stability,
+        prune=prune,
+    )
+    key = (
+        "maxsum_batch", len(graphs), _shape_signature(stacked),
+        tuple(sorted(statics.items())),
+    )
+    return _StackedPrep(tuple(graphs), stacked, statics, key, n_real,
+                        pad_fraction, envelope_waste, max_cycles,
+                        t_pack)
+
+
+def _finish_stacked(prep: _StackedPrep, values, cycles, stable,
+                    elapsed: float, compile_s: float, run_s: float,
+                    t0: float, pipelined: bool = False):
+    """Decode + accounting tail shared by both dispatch paths: ONE
+    coalesced ``device_get`` for the whole output pytree (one host
+    sync per dispatch instead of three), then the DeviceRunResult
+    metrics and the efficiency-plane dispatch sample."""
+    values, cycles, stable = jax.device_get((values, cycles, stable))
+    n_real = prep.n_real
+    values = np.asarray(values)[:n_real]
+    cycles = np.asarray(cycles)[:n_real]
+    stable = np.asarray(stable)[:n_real]
+    batch_result = DeviceRunResult(
+        assignment={},
+        cycles=int(cycles.max()) if cycles.size else 0,
+        converged=bool(stable.all()) if stable.size else False,
+        time_s=elapsed,
+        compile_time_s=compile_s,
+        metrics={
+            "batch_size": len(prep.graphs),
+            "n_real": n_real,
+            "pad_fraction": prep.pad_fraction,
+            "cold_start": compile_s > 0.0,
+            "run_time_s": run_s,
+            # Host-side batch assembly (envelope padding + stacking),
+            # the ledger's ``prep`` share of this dispatch.
+            "pack_host_s": t0 - prep.t_pack,
+            # Per-request convergence verdicts (real lanes, dispatch
+            # order): the serve plane folds lane i's flag into
+            # request i's result.
+            "converged_lanes": [bool(s) for s in stable],
+            # Total device cells of the dispatched stack (padding
+            # lanes included) and the jit program key: the
+            # self-tuning pack planner regresses measured execute
+            # walls on cells, and the speculative compiler matches
+            # completed programs against its precompiled set.
+            "cells_total": (_array_cells(prep.graphs[0])
+                            * len(prep.graphs)),
+            "program_key": str(prep.key),
+        },
+    )
+    if pipelined:
+        batch_result.metrics["pipelined"] = True
+    if prep.envelope_waste is not None:
+        envelope_waste = prep.envelope_waste
+        batch_result.metrics["packing"] = "envelope"
+        batch_result.metrics["envelope_waste_lanes"] = envelope_waste
+        batch_result.metrics["envelope_waste"] = round(
+            sum(envelope_waste) / len(envelope_waste), 4
+        ) if envelope_waste else 0.0
+    # Efficiency accounting: every batched dispatch is an attainment
+    # sample — all lanes run the full max_cycles budget (no early
+    # stop on the batched path), so the XLA per-iteration cost entry
+    # scales by exactly max_cycles.  Everything (labels, backend
+    # resolution) stays behind the enabled gate: PYDCOP_EFFICIENCY=0
+    # must mean zero work, not discarded work.
+    if efficiency.tracker.enabled:
+        # Structure label AFTER envelope padding: a packed dispatch
+        # runs ONE compiled envelope shape — labeling by whichever
+        # member happened to be first would scatter the same program
+        # across structure cells (the lane path labels its packed
+        # union the same way).
+        record = efficiency.tracker.record_dispatch(
+            key=str(prep.key),
+            structure=_structure_label(prep.graphs[0]),
+            backend=efficiency.backend_name(),
+            # The INNER device wall (sync-honest), not the outer
+            # elapsed: the outer interval also holds the profiler's
+            # one-off AOT capture on cold dispatches, which is host
+            # work, not device attainment denominator.
+            time_s=run_s, compile_s=compile_s, cycles=prep.max_cycles,
+            n_real=n_real, batch_size=len(prep.graphs),
+            pad_fraction=prep.pad_fraction,
+            envelope_waste=batch_result.metrics.get(
+                "envelope_waste", 0.0) or 0.0,
+            packing=batch_result.metrics.get("packing") or (
+                "batched" if n_real > 1 else "solo"),
+            cost_entry=(profiler.get(prep.key)
+                        if profiler.enabled else None),
+        )
+        if record is not None:
+            batch_result.metrics["efficiency"] = record
+    return values, cycles, batch_result
+
+
+def launch_stacked(
+    graphs: Sequence[CompiledFactorGraph],
+    max_cycles: int = 200,
+    damping: float = 0.5,
+    damping_nodes: str = "both",
+    stability: float = 0.1,
+    pad_to_bins: Optional[Sequence[int]] = None,
+    prune: bool = False,
+    envelope=None,
+) -> Optional[PendingDispatch]:
+    """Async-launch a stacked dispatch without waiting for results
+    (the pipelined serving flush: dispatch k+1 launches while k's
+    arrays are still in flight).  Returns ``None`` when the program
+    is COLD — trace+compile must stay on the synchronous
+    :func:`run_stacked` path where the profiler/aotcache cold-call
+    attribution lives — and the caller falls back."""
+    prep = _prepare_stacked(graphs, max_cycles, damping,
+                            damping_nodes, stability, pad_to_bins,
+                            prune, envelope)
+    if prep.key not in _warm:
+        return None
+    t0 = time.perf_counter()
+    raw = launch_jit_call(
+        _warm, prep.key,
+        functools.partial(_batched_solve, **prep.statics),
+        prep.stacked)
+    return PendingDispatch("stacked", raw, prep, prep.key, t0)
+
+
+def collect_stacked(pending: PendingDispatch):
+    """Force completion of a :func:`launch_stacked` dispatch and run
+    the shared decode/accounting tail.  Returns the same
+    ``(values, cycles, batch_result)`` triple as :func:`run_stacked`;
+    ``run_time_s`` is the honest launch-to-completion device wall."""
+    prep: _StackedPrep = pending.prep
+    span = (tracer.span("engine_segment", "engine",
+                        batch_size=len(prep.graphs),
+                        n_real=prep.n_real, from_cycle=0,
+                        extra_cycles=prep.max_cycles, pipelined=True)
+            if tracer.active else None)
+    with (span if span is not None else contextlib.nullcontext()):
+        (values, cycles, stable), run_s = finish_jit_call(
+            pending.key, pending.raw, pending.t_launch)
+    elapsed = time.perf_counter() - pending.t_launch
+    return _finish_stacked(prep, values, cycles, stable, elapsed,
+                           0.0, run_s, pending.t_launch,
+                           pipelined=True)
+
+
 def run_stacked(
     graphs: Sequence[CompiledFactorGraph],
     max_cycles: int = 200,
@@ -331,29 +539,9 @@ def run_stacked(
     (mean padded-cell fraction over real lanes) and
     ``envelope_waste_lanes`` (per lane, dispatch order).
     """
-    if not graphs:
-        raise ValueError("run_stacked needs at least one graph")
-    t_pack = time.perf_counter()
-    envelope_waste: Optional[List[float]] = None
-    if envelope is not None:
-        graphs, envelope_waste = stack_to_envelope(graphs, envelope)
-    n_real = len(graphs)
-    pad_fraction = 0.0
-    if pad_to_bins is not None:
-        graphs, n_real, pad_fraction = pad_to_bin(graphs, pad_to_bins)
-    stacked = stack_graphs(graphs)
-    statics = dict(
-        max_cycles=max_cycles,
-        damping=damping,
-        damp_vars=damping_nodes in ("vars", "both"),
-        damp_factors=damping_nodes in ("factors", "both"),
-        stability=stability,
-        prune=prune,
-    )
-    key = (
-        "maxsum_batch", len(graphs), _shape_signature(stacked),
-        tuple(sorted(statics.items())),
-    )
+    prep = _prepare_stacked(graphs, max_cycles, damping,
+                            damping_nodes, stability, pad_to_bins,
+                            prune, envelope)
     t0 = time.perf_counter()
     # A batched dispatch IS one engine segment (the whole solve in
     # one program): the span name matches the segmented loop's so
@@ -361,78 +549,19 @@ def run_stacked(
     # under a serve dispatch the thread-bound trace context stamps
     # the batch's trace_ids onto it.
     span = (tracer.span("engine_segment", "engine",
-                        batch_size=len(graphs), n_real=n_real,
+                        batch_size=len(prep.graphs),
+                        n_real=prep.n_real,
                         from_cycle=0, extra_cycles=max_cycles)
             if tracer.active else None)
     with (span if span is not None else contextlib.nullcontext()):
         (values, cycles, stable), compile_s, run_s = timed_jit_call(
-            _warm, key,
-            functools.partial(_batched_solve, **statics),
-            stacked,
+            _warm, prep.key,
+            functools.partial(_batched_solve, **prep.statics),
+            prep.stacked,
         )
     elapsed = time.perf_counter() - t0
-    values = np.asarray(jax.device_get(values))[:n_real]
-    cycles = np.asarray(jax.device_get(cycles))[:n_real]
-    stable = np.asarray(jax.device_get(stable))[:n_real]
-    batch_result = DeviceRunResult(
-        assignment={},
-        cycles=int(cycles.max()) if cycles.size else 0,
-        converged=bool(stable.all()) if stable.size else False,
-        time_s=elapsed,
-        compile_time_s=compile_s,
-        metrics={
-            "batch_size": len(graphs),
-            "n_real": n_real,
-            "pad_fraction": pad_fraction,
-            "cold_start": compile_s > 0.0,
-            "run_time_s": run_s,
-            # Host-side batch assembly (envelope padding + stacking),
-            # the ledger's ``prep`` share of this dispatch.
-            "pack_host_s": t0 - t_pack,
-            # Per-request convergence verdicts (real lanes, dispatch
-            # order): the serve plane folds lane i's flag into
-            # request i's result.
-            "converged_lanes": [bool(s) for s in stable],
-        },
-    )
-    if envelope_waste is not None:
-        batch_result.metrics["packing"] = "envelope"
-        batch_result.metrics["envelope_waste_lanes"] = envelope_waste
-        batch_result.metrics["envelope_waste"] = round(
-            sum(envelope_waste) / len(envelope_waste), 4
-        ) if envelope_waste else 0.0
-    # Efficiency accounting: every batched dispatch is an attainment
-    # sample — all lanes run the full max_cycles budget (no early
-    # stop on the batched path), so the XLA per-iteration cost entry
-    # scales by exactly max_cycles.  Everything (labels, backend
-    # resolution) stays behind the enabled gate: PYDCOP_EFFICIENCY=0
-    # must mean zero work, not discarded work.
-    if efficiency.tracker.enabled:
-        # Structure label AFTER envelope padding: a packed dispatch
-        # runs ONE compiled envelope shape — labeling by whichever
-        # member happened to be first would scatter the same program
-        # across structure cells (the lane path labels its packed
-        # union the same way).
-        record = efficiency.tracker.record_dispatch(
-            key=str(key), structure=_structure_label(graphs[0]),
-            backend=efficiency.backend_name(),
-            # The INNER device wall (sync-honest), not the outer
-            # elapsed: the outer interval also holds the profiler's
-            # one-off AOT capture on cold dispatches, which is host
-            # work, not device attainment denominator.
-            time_s=run_s, compile_s=compile_s, cycles=max_cycles,
-            n_real=n_real, batch_size=len(graphs),
-            pad_fraction=pad_fraction,
-            envelope_waste=batch_result.metrics.get(
-                "envelope_waste", 0.0) or 0.0,
-            packing=batch_result.metrics.get("packing") or (
-                "batched" if n_real > 1 else "solo"),
-            cost_entry=(profiler.get(key)
-                        if profiler.enabled else None),
-        )
-        if record is not None:
-            batch_result.metrics["efficiency"] = record
-    return values, cycles, batch_result
+    return _finish_stacked(prep, values, cycles, stable, elapsed,
+                           compile_s, run_s, t0)
 
 
 @functools.partial(
@@ -486,6 +615,42 @@ def run_lane_packed(
     counts).  ``converged_lanes`` holds honest per-member verdicts
     recovered from the suppression counters
     (ops/maxsum_lane.converged_per_graph)."""
+    prep = _prepare_lane(graphs, max_cycles, damping, damping_nodes,
+                         stability, d_env, ladder)
+    t0 = time.perf_counter()
+    span = (tracer.span("engine_segment", "engine",
+                        batch_size=len(graphs), n_real=len(graphs),
+                        packing="lane", from_cycle=0,
+                        extra_cycles=max_cycles)
+            if tracer.active else None)
+    with (span if span is not None else contextlib.nullcontext()):
+        (values, cycle, v2f_count, f2v_count), compile_s, run_s = \
+            timed_jit_call(
+                _warm, prep.key,
+                functools.partial(_lane_packed_solve, **prep.statics),
+                prep.lane,
+            )
+    elapsed = time.perf_counter() - t0
+    return _finish_lane(prep, values, cycle, v2f_count, f2v_count,
+                        elapsed, compile_s, run_s, t0)
+
+
+class _LanePrep(NamedTuple):
+    """Host-side assembly of one lane-packed dispatch (see
+    :class:`_StackedPrep`)."""
+
+    graphs: tuple
+    union: CompiledFactorGraph
+    layout: Any
+    lane: Any
+    statics: dict
+    key: tuple
+    max_cycles: int
+    t_pack: float
+
+
+def _prepare_lane(graphs, max_cycles, damping, damping_nodes,
+                  stability, d_env, ladder) -> _LanePrep:
     from pydcop_tpu.ops import maxsum_lane as lane_ops
 
     if not graphs:
@@ -517,25 +682,25 @@ def run_lane_packed(
         + tuple(b.costs.shape for b in lane.buckets),
         tuple(sorted(statics.items())),
     )
-    t0 = time.perf_counter()
-    span = (tracer.span("engine_segment", "engine",
-                        batch_size=len(graphs), n_real=len(graphs),
-                        packing="lane", from_cycle=0,
-                        extra_cycles=max_cycles)
-            if tracer.active else None)
-    with (span if span is not None else contextlib.nullcontext()):
-        (values, cycle, v2f_count, f2v_count), compile_s, run_s = \
-            timed_jit_call(
-                _warm, key,
-                functools.partial(_lane_packed_solve, **statics),
-                lane,
-            )
-    elapsed = time.perf_counter() - t0
-    values = np.asarray(jax.device_get(values))
-    per_values = [values[s:s + n] for s, n in layout.var_slices]
+    return _LanePrep(tuple(graphs), union, layout, lane, statics,
+                     key, max_cycles, t_pack)
+
+
+def _finish_lane(prep: _LanePrep, values, cycle, v2f_count,
+                 f2v_count, elapsed: float, compile_s: float,
+                 run_s: float, t0: float, pipelined: bool = False):
+    from pydcop_tpu.ops import maxsum_lane as lane_ops
+
+    graphs = prep.graphs
+    # ONE coalesced device_get for the whole output pytree (the old
+    # path paid 4 separate host syncs per dispatch).
+    values, cycle, v2f_count, f2v_count = jax.device_get(
+        (values, cycle, v2f_count, f2v_count))
+    values = np.asarray(values)
+    per_values = [values[s:s + n] for s, n in prep.layout.var_slices]
     converged = lane_ops.converged_per_graph(
-        jax.device_get(v2f_count), jax.device_get(f2v_count), layout)
-    n_cycles = int(jax.device_get(cycle))
+        v2f_count, f2v_count, prep.layout)
+    n_cycles = int(cycle)
     cycles = np.full((len(graphs),), n_cycles, dtype=np.int32)
     # Honest waste accounting: members carry only domain-rung padding;
     # the union-level ladder rounding (sentinel rows) is shared
@@ -543,8 +708,8 @@ def run_lane_packed(
     from pydcop_tpu.serving.binning import lane_cells
 
     real_cells = [_array_cells(g) for g in graphs]
-    union_cells = max(_array_cells(union), 1)
-    member_cells = [lane_cells(g, lane.dmax) for g in graphs]
+    union_cells = max(_array_cells(prep.union), 1)
+    member_cells = [lane_cells(g, prep.lane.dmax) for g in graphs]
     lane_waste = [
         round(1.0 - r / max(m, 1), 4)
         for r, m in zip(real_cells, member_cells)
@@ -561,29 +726,77 @@ def run_lane_packed(
             "pad_fraction": 0.0,
             "cold_start": compile_s > 0.0,
             "run_time_s": run_s,
-            "pack_host_s": t0 - t_pack,
+            "pack_host_s": t0 - prep.t_pack,
             "packing": "lane",
             "converged_lanes": [bool(c) for c in converged],
             "envelope_waste_lanes": lane_waste,
             "envelope_waste": round(
                 1.0 - sum(real_cells) / union_cells, 4),
+            "cells_total": union_cells,
+            "program_key": str(prep.key),
         },
     )
+    if pipelined:
+        batch_result.metrics["pipelined"] = True
     if efficiency.tracker.enabled:
         record = efficiency.tracker.record_dispatch(
-            key=str(key), structure=_structure_label(union),
+            key=str(prep.key), structure=_structure_label(prep.union),
             backend=efficiency.backend_name(),
-            time_s=run_s, compile_s=compile_s, cycles=max_cycles,
+            time_s=run_s, compile_s=compile_s, cycles=prep.max_cycles,
             n_real=len(graphs), batch_size=len(graphs),
             pad_fraction=0.0,
             envelope_waste=batch_result.metrics["envelope_waste"],
             packing="lane",
-            cost_entry=(profiler.get(key)
+            cost_entry=(profiler.get(prep.key)
                         if profiler.enabled else None),
         )
         if record is not None:
             batch_result.metrics["efficiency"] = record
     return per_values, cycles, batch_result
+
+
+def launch_lane_packed(
+    graphs: Sequence[CompiledFactorGraph],
+    max_cycles: int = 200,
+    damping: float = 0.5,
+    damping_nodes: str = "both",
+    stability: float = 0.1,
+    d_env: Optional[int] = None,
+    ladder=None,
+) -> Optional[PendingDispatch]:
+    """Async-launch a lane-packed dispatch (see
+    :func:`launch_stacked`); ``None`` when the union program is cold —
+    compile stays on the synchronous path."""
+    prep = _prepare_lane(graphs, max_cycles, damping, damping_nodes,
+                         stability, d_env, ladder)
+    if prep.key not in _warm:
+        return None
+    t0 = time.perf_counter()
+    raw = launch_jit_call(
+        _warm, prep.key,
+        functools.partial(_lane_packed_solve, **prep.statics),
+        prep.lane)
+    return PendingDispatch("lane", raw, prep, prep.key, t0)
+
+
+def collect_lane_packed(pending: PendingDispatch):
+    """Force completion of a :func:`launch_lane_packed` dispatch and
+    run the shared decode/accounting tail."""
+    prep: _LanePrep = pending.prep
+    span = (tracer.span("engine_segment", "engine",
+                        batch_size=len(prep.graphs),
+                        n_real=len(prep.graphs), packing="lane",
+                        from_cycle=0, extra_cycles=prep.max_cycles,
+                        pipelined=True)
+            if tracer.active else None)
+    with (span if span is not None else contextlib.nullcontext()):
+        (values, cycle, v2f_count, f2v_count), run_s = \
+            finish_jit_call(pending.key, pending.raw,
+                            pending.t_launch)
+    elapsed = time.perf_counter() - pending.t_launch
+    return _finish_lane(prep, values, cycle, v2f_count, f2v_count,
+                        elapsed, 0.0, run_s, pending.t_launch,
+                        pipelined=True)
 
 
 def solve_maxsum_batch(

@@ -157,24 +157,69 @@ def timed_jit_call(warm: set, key, fn, *args):
         }
     if metrics_registry.active:
         _account_jit_call(str(key), first, elapsed)
-    # Efficiency plane (observability/efficiency.py): global cold/warm
-    # dispatch accounting — the compile column of waste-by-cause,
-    # covering every engine that routes through this one chokepoint.
-    efficiency_tracker.record_jit(str(key), first, elapsed)
     if first:
         warm.add(key)
+        disk_compile = None
         if aot_before is not None:
             disk_compile = aotcache.split_cold_call(
                 elapsed, aot_before, aotcache.counters())
-            if disk_compile is not None:
-                # Every executable came off the disk cache: the cold
-                # interval holds trace + retrieval + first run, with
-                # zero XLA compile — charge only the retrieval wall
-                # to ``compile`` so the cold-start ledger says what
-                # actually happened.
-                return out, disk_compile, elapsed
+        # Efficiency plane (observability/efficiency.py): global
+        # cold/warm dispatch accounting — the compile column of
+        # waste-by-cause, covering every engine that routes through
+        # this one chokepoint.  The disk-attributed compile (when
+        # available) goes to the tracker too, or /profile's compile
+        # waste would keep charging whole cold intervals the
+        # persistent cache actually saved.
+        efficiency_tracker.record_jit(str(key), first, elapsed,
+                                      compile_s=disk_compile)
+        if disk_compile is not None:
+            # Every executable came off the disk cache: the cold
+            # interval holds trace + retrieval + first run, with
+            # zero XLA compile — charge only the retrieval wall
+            # to ``compile`` so the cold-start ledger says what
+            # actually happened.
+            return out, disk_compile, elapsed
         return out, elapsed, elapsed
+    efficiency_tracker.record_jit(str(key), first, elapsed)
     return out, 0.0, elapsed
+
+
+def launch_jit_call(warm: set, key, fn, *args):
+    """Async-launch a WARM cached-jit dispatch without forcing
+    completion (JAX async dispatch: the call returns device futures
+    almost immediately while the backend executes).  The pipelined
+    serving path uses this to issue dispatch k+1 while dispatch k's
+    results are still in flight; :func:`finish_jit_call` later forces
+    completion and performs exactly the accounting a warm
+    :func:`timed_jit_call` would have.
+
+    Only valid for warm keys: a cold launch would hide trace+compile
+    inside an unattributed wait (and the profiler/aotcache cold-call
+    bookkeeping lives on the synchronous path).  Callers gate on
+    warmth and fall back to ``timed_jit_call`` when cold.
+    """
+    if key not in warm:
+        raise RuntimeError(
+            f"launch_jit_call on cold key {key!r}: cold dispatches "
+            "must go through timed_jit_call")
+    return fn(*args)
+
+
+def finish_jit_call(key, out, t_launch: float):
+    """Force completion of a launched warm dispatch and account it.
+
+    ``t_launch`` is the perf_counter the caller took just before
+    :func:`launch_jit_call`; the elapsed interval is the honest device
+    wall of the dispatch — launch, execution (possibly overlapped with
+    host work on other dispatches) and the residual completion wait.
+    Returns ``(out, run_s)``; the warm-call compile time is 0 by
+    definition."""
+    out = sync(out)
+    elapsed = time.perf_counter() - t_launch
+    if metrics_registry.active:
+        _account_jit_call(str(key), False, elapsed)
+    efficiency_tracker.record_jit(str(key), False, elapsed)
+    return out, elapsed
 
 
 def _account_jit_call(skey: str, first: bool, elapsed: float):

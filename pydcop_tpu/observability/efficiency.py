@@ -325,10 +325,29 @@ class EfficiencyTracker:
         self._jit_cold_s = 0.0
         self._jit_cold = 0
         self._jit_warm = 0
+        self._overlap_s = 0.0
+        self._overlap_execute_s = 0.0
+        self._overlap_dispatches = 0
         self._last_attainment: Optional[float] = None
         self._last_useful: Optional[float] = None
 
     # -- recorders ------------------------------------------------------ #
+
+    def record_overlap(self, overlap_s: float,
+                       execute_s: float) -> None:
+        """One pipelined dispatch's device/host overlap: the wall the
+        host spent elsewhere (decoding the previous dispatch,
+        launching the next) while this dispatch's device work was in
+        flight, clamped by the caller to the dispatch's own execute
+        wall.  ``pipeline_overlap_fraction = overlap_s / execute_s``
+        over all pipelined dispatches — 0 on the synchronous path, →1
+        when the device never waits for host-side decode."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._overlap_s += max(float(overlap_s), 0.0)
+            self._overlap_execute_s += max(float(execute_s), 0.0)
+            self._overlap_dispatches += 1
 
     def record_dispatch(self, key: str, structure: str, backend: str,
                         time_s: float, compile_s: float, cycles: int,
@@ -451,17 +470,23 @@ class EfficiencyTracker:
         except Exception:  # noqa: BLE001
             pass
 
-    def record_jit(self, key: str, first: bool, elapsed: float
-                   ) -> None:
+    def record_jit(self, key: str, first: bool, elapsed: float,
+                   compile_s: Optional[float] = None) -> None:
         """timed_jit_call hook: global cold-compile wall + dispatch
         counts (the compile column of waste-by-cause, covering every
-        engine — one-shot, segmented, dynamic, batched)."""
+        engine — one-shot, segmented, dynamic, batched).
+        ``compile_s`` overrides the charged compile wall when the
+        caller attributed the cold interval more precisely — a cold
+        dispatch whose executables all deserialized from the
+        persistent AOT cache charges only the retrieval wall
+        (engine/aotcache.split_cold_call), not the whole interval."""
         if not self.enabled:
             return
         with self._lock:
             if first:
                 self._jit_cold += 1
-                self._jit_cold_s += float(elapsed)
+                self._jit_cold_s += float(
+                    elapsed if compile_s is None else compile_s)
             else:
                 self._jit_warm += 1
 
@@ -558,6 +583,9 @@ class EfficiencyTracker:
             jit = {"cold_dispatches": self._jit_cold,
                    "warm_dispatches": self._jit_warm,
                    "cold_compile_s": round(self._jit_cold_s, 6)}
+            overlap_s = self._overlap_s
+            overlap_execute_s = self._overlap_execute_s
+            overlap_n = self._overlap_dispatches
         by_backend: Dict[str, List[_StructureAgg]] = {}
         for (backend, _structure), agg in cells.items():
             by_backend.setdefault(backend, []).append(agg)
@@ -597,6 +625,14 @@ class EfficiencyTracker:
             },
             "waste_by_cause": waste,
             "jit": jit,
+            "pipeline": {
+                "overlap_s": round(overlap_s, 6),
+                "execute_s": round(overlap_execute_s, 6),
+                "dispatches": overlap_n,
+            },
+            "pipeline_overlap_fraction": (
+                round(overlap_s / overlap_execute_s, 6)
+                if overlap_execute_s > 0 else 0.0),
         }
 
     def summary(self) -> Dict[str, Any]:
@@ -615,6 +651,8 @@ class EfficiencyTracker:
             "dispatches": agg.get("dispatches", 0),
             "ledger_components_s": roll["ledger"]["components_s"],
             "waste_by_cause": roll["waste_by_cause"],
+            "pipeline_overlap_fraction":
+                roll["pipeline_overlap_fraction"],
         }
 
     def clear(self) -> None:
@@ -627,6 +665,9 @@ class EfficiencyTracker:
             self._jit_cold_s = 0.0
             self._jit_cold = 0
             self._jit_warm = 0
+            self._overlap_s = 0.0
+            self._overlap_execute_s = 0.0
+            self._overlap_dispatches = 0
             self._last_attainment = None
             self._last_useful = None
 

@@ -328,16 +328,33 @@ MODEL_US_PER_CYCLE = 5.0
 MODEL_NS_PER_CELL_CYCLE = 25.0
 
 
-def modeled_solve_ms(cells: int, max_cycles: int) -> float:
+def modeled_solve_ms(cells: int, max_cycles: int,
+                     constants: Optional[Dict[str, Any]] = None
+                     ) -> float:
     """Affine dispatch-compute model (ms), excluding the per-dispatch
-    fixed overhead."""
-    return max_cycles * (MODEL_US_PER_CYCLE * 1e-3
-                         + cells * MODEL_NS_PER_CELL_CYCLE * 1e-6)
+    fixed overhead.
+
+    ``constants`` overrides the compiled-in CPU-fitted defaults with
+    online-fitted ones (engine/autotune.fitted_pack_constants — keys
+    ``us_per_cycle`` / ``ns_per_cell_cycle``): the self-tuning pack
+    planner feeds measured ledgers of past dispatches back into the
+    very model that prices the next one."""
+    us_per_cycle = MODEL_US_PER_CYCLE
+    ns_per_cell = MODEL_NS_PER_CELL_CYCLE
+    if constants:
+        us_per_cycle = float(
+            constants.get("us_per_cycle", us_per_cycle))
+        ns_per_cell = float(
+            constants.get("ns_per_cell_cycle", ns_per_cell))
+    return max_cycles * (us_per_cycle * 1e-3
+                         + cells * ns_per_cell * 1e-6)
 
 
 def solve_prior_ms(real_cells: int, max_cycles: int,
                    portfolio_ms: Optional[float] = None,
-                   race_cycles: int = 60) -> Tuple[float, str]:
+                   race_cycles: int = 60,
+                   constants: Optional[Dict[str, Any]] = None
+                   ) -> Tuple[float, str]:
     """Per-structure solo solve-time prior (ms) for the cost model.
 
     When the PR-10 portfolio racer has a cached time-to-cost entry for
@@ -350,7 +367,8 @@ def solve_prior_ms(real_cells: int, max_cycles: int,
     if portfolio_ms is not None and portfolio_ms > 0:
         return (portfolio_ms * max_cycles / max(race_cycles, 1),
                 "portfolio")
-    return modeled_solve_ms(real_cells, max_cycles), "model"
+    return (modeled_solve_ms(real_cells, max_cycles,
+                             constants=constants), "model")
 
 
 def lane_union_cells(graphs: Sequence[CompiledFactorGraph],
@@ -377,7 +395,8 @@ def pack_decision(real_cells: Sequence[int],
                   prior_ms: Sequence[float],
                   packed_cells_total: int,
                   max_cycles: int,
-                  overhead_ms: float = PACK_OVERHEAD_MS
+                  overhead_ms: float = PACK_OVERHEAD_MS,
+                  constants: Optional[Dict[str, Any]] = None
                   ) -> Dict[str, Any]:
     """The per-flush envelope decision: does ONE padded dispatch beat
     N solo dispatches for this group?
@@ -391,19 +410,29 @@ def pack_decision(real_cells: Sequence[int],
     batched lanes serialize, conservative for TPU where they share
     vector units (a pack that wins under the sum model wins harder on
     chip).  Returns the full modeled record so scheduler decisions
-    are replayable in tests and auditable in /stats."""
+    are replayable in tests and auditable in /stats.
+
+    ``constants`` threads online-fitted model constants through
+    (see :func:`modeled_solve_ms`); the decision records where its
+    constants came from (``constants_source: fitted|default``) so an
+    operator reading ``envelope_decisions`` can tell a measured
+    verdict from a cold-start one."""
     n = len(real_cells)
+    if constants and "overhead_ms" in constants \
+            and float(constants["overhead_ms"]) > 0:
+        overhead_ms = float(constants["overhead_ms"])
     solo_ms = sum(prior_ms) + overhead_ms * n
     packed_ms = overhead_ms + modeled_solve_ms(
-        packed_cells_total, max_cycles)
+        packed_cells_total, max_cycles, constants=constants)
     real_total = sum(real_cells)
     return {
         "n": n,
         "packed": bool(n > 1 and packed_ms < solo_ms),
         "solo_ms": round(solo_ms, 4),
         "packed_ms": round(packed_ms, 4),
-        "overhead_ms": overhead_ms,
+        "overhead_ms": round(overhead_ms, 4),
         "packed_cells": int(packed_cells_total),
         "waste": round(
             1.0 - real_total / max(packed_cells_total, 1), 4),
+        "constants_source": "fitted" if constants else "default",
     }

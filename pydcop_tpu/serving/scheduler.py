@@ -50,6 +50,12 @@ class BinScheduler:
     def start(self):
         self._thread.start()
 
+    def thread_ident(self):
+        """The scheduler thread's ident — the one thread allowed to
+        own a device dispatch (the speculation battery asserts
+        background compiles never run on it)."""
+        return self._thread.ident
+
     def shutdown(self, timeout: float = 30.0):
         self._stop.set()
         # Unblock a waiting get() immediately.
@@ -144,9 +150,10 @@ class BinScheduler:
         # The flush plan (serving/service.plan_flush): multi-request
         # bins keep the exact path; leftover singleton bins are
         # envelope-grouped and packed when the per-flush cost model
-        # says one padded dispatch beats N solo ones.  A planner crash
-        # degrades to the old one-plan-per-bin behavior — planning is
-        # an optimization, never a correctness dependency.
+        # says one padded dispatch beats N solo ones.  Planner
+        # crashes degrade INSIDE plan_flush (once-per-flush log +
+        # one-plan-per-bin fallback) — this guard is only the last
+        # line of defense against the wrapper itself breaking.
         try:
             plans = self.service.plan_flush(bins)
         except Exception:  # noqa: BLE001 — last line of defense
@@ -157,26 +164,75 @@ class BinScheduler:
             plans = [DispatchPlan(list(bins[k]))
                      for k in sorted(bins,
                                      key=lambda k: -len(bins[k]))]
+        chunks: List = []
         for plan in plans:
             reqs: List = plan.reqs
             for i in range(0, len(reqs), self.max_batch):
-                chunk = reqs[i:i + self.max_batch]
-                # Last line of defense: dispatch() fails batches
-                # cleanly on engine errors, but NOTHING may kill this
-                # thread — a dead scheduler turns the service into a
-                # black hole that accepts work it will never do.
+                chunks.append((reqs[i:i + self.max_batch],
+                               plan.envelope, plan.lane_d))
+        # Pipelined flush (ISSUE 18 tentpole a): launch chunk k+1's
+        # device call while chunk k's arrays are still in flight, and
+        # drain completed dispatches in PICKUP order (a request's
+        # terminal callbacks fire in the order the scheduler picked
+        # its chunk up — the ordering tests rely on).  At most two
+        # dispatches are in flight: deeper pipelines buy nothing
+        # (one device) and hold more results hostage to a crash.
+        launch = getattr(self.service, "launch_dispatch", None)
+        collect = getattr(self.service, "collect_dispatch", None)
+        pipelined = launch is not None and collect is not None
+        pending: List = []
+        for chunk, envelope, lane_d in chunks:
+            pb = None
+            if pipelined:
                 try:
-                    if plan.envelope is None and plan.lane_d is None:
-                        # Positional call on the exact path: test
-                        # doubles stub dispatch(reqs).
-                        self.service.dispatch(chunk)
-                    else:
-                        self.service.dispatch(chunk,
-                                              envelope=plan.envelope,
-                                              lane_d=plan.lane_d)
-                except Exception as exc:  # noqa: BLE001
-                    logger.exception("dispatch crashed")
-                    for req in chunk:
-                        if not req.done.is_set():
-                            self.service._finish_error(
-                                req, f"internal dispatch error: {exc}")
+                    pb = launch(chunk, envelope=envelope,
+                                lane_d=lane_d)
+                except Exception:  # noqa: BLE001
+                    logger.exception("pipelined launch crashed; "
+                                     "falling back to synchronous "
+                                     "dispatch")
+                    pb = None
+            if pb is not None:
+                pending.append(pb)
+                while len(pending) > 1:
+                    self._collect_one(pending.pop(0), collect)
+                continue
+            # Synchronous chunk (pipelining off, cold program, DPOP,
+            # or a stubbed device call): drain EVERY in-flight
+            # dispatch first so terminal ordering stays pickup order.
+            while pending:
+                self._collect_one(pending.pop(0), collect)
+            # Last line of defense: dispatch() fails batches
+            # cleanly on engine errors, but NOTHING may kill this
+            # thread — a dead scheduler turns the service into a
+            # black hole that accepts work it will never do.
+            try:
+                if envelope is None and lane_d is None:
+                    # Positional call on the exact path: test
+                    # doubles stub dispatch(reqs).
+                    self.service.dispatch(chunk)
+                else:
+                    self.service.dispatch(chunk,
+                                          envelope=envelope,
+                                          lane_d=lane_d)
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("dispatch crashed")
+                for req in chunk:
+                    if not req.done.is_set():
+                        self.service._finish_error(
+                            req, f"internal dispatch error: {exc}")
+        while pending:
+            self._collect_one(pending.pop(0), collect)
+
+    def _collect_one(self, pb, collect) -> None:
+        """Drain one in-flight dispatch; collect_dispatch handles its
+        own failures (synchronous re-run), so anything escaping here
+        is a harness bug — fail the batch, never the thread."""
+        try:
+            collect(pb)
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("pipelined collect crashed")
+            for req in pb.reqs:
+                if not req.done.is_set():
+                    self.service._finish_error(
+                        req, f"internal dispatch error: {exc}")

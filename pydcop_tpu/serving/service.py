@@ -164,6 +164,22 @@ class DispatchPlan(NamedTuple):
     lane_d: Optional[int] = None
 
 
+class PendingBatch(NamedTuple):
+    """One launched-but-uncollected pipelined dispatch
+    (:meth:`SolveService.launch_dispatch`): the device is executing
+    bin k while the scheduler launches bin k+1 and decodes bin k-1.
+    Consumed exactly once by :meth:`SolveService.collect_dispatch`.
+    ``t_launch_end`` bounds the overlap measurement — host wall after
+    it and before collect was spent on OTHER work while this
+    dispatch's device work was in flight."""
+
+    reqs: List["SolveRequest"]
+    pending: Any                    # engine_batch.PendingDispatch
+    envelope: Optional[Any] = None
+    lane_d: Optional[int] = None
+    t_launch_end: float = 0.0
+
+
 class SolveService:
     """Bounded-queue, structure-binned batching solve service.
 
@@ -220,6 +236,8 @@ class SolveService:
                  envelope_overhead_ms: Optional[float] = None,
                  lane_pack: bool = True,
                  lane_domain_max: int = 8,
+                 pipeline: bool = True,
+                 speculate: bool = False,
                  session_max: int = 64,
                  session_segment_cycles: Optional[int] = None,
                  session_checkpoint_every_events: int = 8,
@@ -242,6 +260,21 @@ class SolveService:
             else binning.PACK_OVERHEAD_MS)
         self.lane_pack = bool(lane_pack)
         self.lane_domain_max = int(lane_domain_max)
+        # Closed-loop hot path (ISSUE 18): pipelined flush decode
+        # (launch bin k+1 while bin k's arrays are still in flight)
+        # and speculative envelope compilation (predict-and-AOT-build
+        # the programs the traffic will need, off the scheduler
+        # thread).  ``--no_pipeline`` / ``--no_speculate`` isolate
+        # each piece.
+        self.pipeline = bool(pipeline)
+        self.speculate = bool(speculate)
+        self._speculator = None
+        self._scheduler_ident: Optional[int] = None
+        # Per-flush caches the planner refreshes at most once per
+        # flush: the autotune JSON document (portfolio priors) and
+        # the ledger-fitted pack-model constants.
+        self._flush_autotune_data: Optional[Dict[str, Any]] = None
+        self._flush_constants: Optional[Dict[str, float]] = None
         # Per-structure solve-time priors for the pack decision
         # (portfolio-cache reads memoized — the JSON file must not be
         # re-read per flush).
@@ -276,6 +309,8 @@ class SolveService:
         self.expired = 0
         self.replayed = 0
         self.dispatch_retries = 0
+        self.pipelined_dispatches = 0
+        self.speculative_hits = 0
         # prune="auto" submits resolved through the portfolio cache.
         self.portfolio_resolved = 0
         # Exact-inference plane (ISSUE 17): dispatches completed via
@@ -370,10 +405,19 @@ class SolveService:
             else:
                 self._journal = journal_mod.RequestJournal(
                     self.journal_dir, sync=self.journal_sync)
+        if self.speculate and self._speculator is None:
+            from pydcop_tpu.serving.speculate import (
+                SpeculativeCompiler,
+            )
+
+            self._speculator = SpeculativeCompiler(
+                bin_sizes=self.bin_sizes)
+            self._speculator.start()
         self._scheduler = BinScheduler(
             self, batch_window_s=self.batch_window_s,
             max_batch=self.max_batch)
         self._scheduler.start()
+        self._scheduler_ident = self._scheduler.thread_ident()
         self._started = True
         if self._journal is not None:
             # Journal backlog feeds the operator surfaces while the
@@ -431,6 +475,9 @@ class SolveService:
                 time.sleep(0.01)
         self._scheduler.shutdown(timeout=timeout)
         self._scheduler = None
+        if self._speculator is not None:
+            self._speculator.stop()
+            self._speculator = None
         self._started = False
         metrics_registry.active = self._was_active
         profiler.enabled = getattr(self, "_was_profiling", False)
@@ -866,15 +913,57 @@ class SolveService:
         The planning wall is stamped on every request in the flush
         (``plan_s``) — each of them waited through it, so it is a real
         component of each one's latency ledger (the ``plan`` column of
-        where-the-time-went)."""
+        where-the-time-went).
+
+        Planner crashes degrade HERE, once per flush: planning is an
+        optimization, never a correctness dependency, so an exception
+        logs ONE traceback and falls back to the old one-plan-per-bin
+        behavior for the whole flush (the scheduler's per-chunk guard
+        stays the last line of defense)."""
         t_plan = time.perf_counter()
+        self._refresh_flush_caches()
         try:
             return self._plan_flush(bins)
+        except Exception:  # noqa: BLE001 — degrade, don't crash
+            logger.exception(
+                "flush planning crashed; dispatching per bin")
+            return [DispatchPlan(list(bins[k]))
+                    for k in sorted(bins, key=lambda k: -len(bins[k]))]
         finally:
             plan_s = time.perf_counter() - t_plan
             for reqs in bins.values():
                 for req in reqs:
                     req.plan_s = plan_s
+
+    def _refresh_flush_caches(self) -> None:
+        """Once-per-flush reads of the autotune surfaces the planner
+        consults per GROUP otherwise: the shape-cache JSON document
+        (portfolio priors for structures not yet memoized) and the
+        ledger-fitted pack-model constants (tentpole c — cold start
+        falls back to the compiled-in defaults via ``None``)."""
+        from pydcop_tpu.engine import autotune
+
+        try:
+            self._flush_autotune_data = autotune._load_cache(
+                autotune.cache_path())
+        except Exception:  # noqa: BLE001 — priors are an optimization
+            self._flush_autotune_data = None
+        self._flush_constants = None
+        if autotune.pack_fit_enabled():
+            try:
+                fitted = autotune.fitted_pack_constants(
+                    efficiency.backend_name())
+                if (fitted
+                        and self.envelope_overhead_ms
+                        != binning.PACK_OVERHEAD_MS):
+                    # An operator-set (or test-forced) dispatch
+                    # overhead must not be silently overridden by the
+                    # fitted one — only the MODEL constants apply.
+                    fitted = {k: v for k, v in fitted.items()
+                              if k != "overhead_ms"}
+                self._flush_constants = fitted or None
+            except Exception:  # noqa: BLE001
+                self._flush_constants = None
 
     def _plan_flush(self, bins: Dict[Any, List[SolveRequest]]
                     ) -> List[DispatchPlan]:
@@ -893,6 +982,7 @@ class SolveService:
             else:
                 singles.append(reqs[0])
         if len(singles) == 1:
+            self._observe_for_speculation(singles[0], count=1)
             plans.append(DispatchPlan(singles))
             return plans
         groups: Dict[Any, List[SolveRequest]] = {}
@@ -907,6 +997,7 @@ class SolveService:
                     else ("envelope", env, params_part))
             groups.setdefault(gkey, []).append(req)
         for gkey, group in groups.items():
+            self._observe_for_speculation(group[0], count=len(group))
             # Decide per max_batch CHUNK, not per group: the
             # scheduler dispatches at most max_batch requests per
             # device call, so a 20-member group runs as 16+4 — the
@@ -936,6 +1027,25 @@ class SolveService:
                     plans.append(DispatchPlan(reqs, envelope=shape))
         return plans
 
+    def _observe_for_speculation(self, req: SolveRequest,
+                                 count: int) -> None:
+        """Feed the arrival histogram (tentpole b): one cheap
+        ``observe`` per envelope group per flush — the speculator
+        predicts the bin rungs this structure's traffic will need
+        next and AOT-builds them off-thread.  Never raises into the
+        planner."""
+        if self._speculator is None:
+            return
+        if req.params.get("algo") == "dpop":
+            return
+        try:
+            env = binning.envelope_key(req.graph,
+                                       self.envelope_ladder)
+            self._speculator.observe(req.graph, env, req.params,
+                                     count)
+        except Exception:  # noqa: BLE001 — speculation is optional
+            pass
+
     def _pack_decision(self, kind: str, shape,
                        reqs: List[SolveRequest]) -> Dict[str, Any]:
         """Model one group's pack-vs-solo choice and record it (the
@@ -962,7 +1072,8 @@ class SolveService:
         decision = binning.pack_decision(
             real, priors, packed_total,
             max_cycles=reqs[0].params["max_cycles"],
-            overhead_ms=self.envelope_overhead_ms)
+            overhead_ms=self.envelope_overhead_ms,
+            constants=self._flush_constants)
         decision.update({
             "kind": kind,
             "label": label,
@@ -996,14 +1107,18 @@ class SolveService:
             if skey in self._prior_memo:
                 portfolio_ms = self._prior_memo[skey]
             else:
+                # The flush-preloaded JSON document (one disk read
+                # per flush, not one per unmemoized group member).
                 portfolio_ms = cached_portfolio_timing_ms(
-                    portfolio_key(skey))
+                    portfolio_key(skey),
+                    data=self._flush_autotune_data)
                 self._prior_memo[skey] = portfolio_ms
         except Exception:  # noqa: BLE001 — a prior is an optimization
             portfolio_ms = None
         return binning.solve_prior_ms(
             real_cells, req.params["max_cycles"], portfolio_ms,
-            race_cycles=PORTFOLIO_RACE_CYCLES)
+            race_cycles=PORTFOLIO_RACE_CYCLES,
+            constants=self._flush_constants)
 
     # -- dispatch plane (called by the scheduler thread) --------------- #
 
@@ -1038,6 +1153,135 @@ class SolveService:
         self._queue_depth.set(self._queue.qsize())
         self._dispatch_attempt(reqs, retry_depth=0,
                                envelope=envelope, lane_d=lane_d)
+
+    def launch_dispatch(self, reqs: List[SolveRequest],
+                        envelope=None, lane_d: Optional[int] = None,
+                        ) -> Optional[PendingBatch]:
+        """Pipelined dispatch front half (ISSUE 18 tentpole a): issue
+        the device call for this batch WITHOUT waiting for its
+        results (JAX async dispatch) so the scheduler can launch the
+        next bin / decode the previous one while the device works.
+
+        Returns a :class:`PendingBatch` to hand to
+        :meth:`collect_dispatch`, or None when this batch must go
+        through the synchronous :meth:`dispatch` instead — pipelining
+        disabled, a DPOP bin (the exact engine owns its own batching),
+        a test double stubbing the device call (``_run_batch`` /
+        ``dispatch`` overridden: the stub IS the contract under test),
+        a cold program (the compile must be timed and attributed on
+        the synchronous path), or a launch failure (the synchronous
+        path owns error isolation and bisection)."""
+        if not self.pipeline:
+            return None
+        params = reqs[0].params
+        if params.get("algo") == "dpop":
+            return None
+        if (type(self)._run_batch is not SolveService._run_batch
+                or "_run_batch" in self.__dict__
+                or type(self).dispatch is not SolveService.dispatch
+                or "dispatch" in self.__dict__):
+            return None
+        graphs = [r.graph for r in reqs]
+        t_dequeue = time.perf_counter()
+        try:
+            if lane_d is not None:
+                pending = engine_batch.launch_lane_packed(
+                    graphs,
+                    max_cycles=params["max_cycles"],
+                    damping=params["damping"],
+                    damping_nodes=params["damping_nodes"],
+                    stability=params["stability"],
+                    d_env=lane_d,
+                    ladder=binning.UNION_LADDER,
+                )
+            else:
+                pending = engine_batch.launch_stacked(
+                    graphs,
+                    max_cycles=params["max_cycles"],
+                    damping=params["damping"],
+                    damping_nodes=params["damping_nodes"],
+                    stability=params["stability"],
+                    pad_to_bins=self.bin_sizes,
+                    prune=bool(params.get("prune", 0)),
+                    envelope=envelope,
+                )
+        except Exception as exc:  # noqa: BLE001 — sync path retries
+            logger.debug("pipelined launch failed (%s); falling back "
+                         "to the synchronous path", exc)
+            return None
+        if pending is None:
+            return None
+        for req in reqs:
+            req.status = RUNNING
+            req.t_dispatch = t_dequeue
+            if tracer.active:
+                tracer.complete(
+                    "serve_queued", "serving",
+                    t0=req.t_submit, t1=t_dequeue,
+                    trace_id=req.trace_id, request=req.id)
+            self._publish_lifecycle("dispatched", req)
+        self._queue_depth.set(self._queue.qsize())
+        self.pipelined_dispatches += 1
+        return PendingBatch(reqs, pending, envelope, lane_d,
+                            time.perf_counter())
+
+    def collect_dispatch(self, pb: PendingBatch) -> None:
+        """Pipelined dispatch back half: block on the launched device
+        work, then run the SAME decode/terminal tail as the
+        synchronous path.  Never raises: a collect failure re-runs
+        the batch through the synchronous dispatch attempt (the
+        results are deterministic, so re-execution is safe, and the
+        synchronous path owns bisection/breaker semantics)."""
+        t_collect0 = time.perf_counter()
+        reqs = pb.reqs
+        ctx = (tracer.context(
+            trace_ids=[r.trace_id for r in reqs])
+            if tracer.active else contextlib.nullcontext())
+        with ctx:
+            span = (tracer.span(
+                "serve_dispatch", "serving",
+                bin=binning.bin_label(reqs[0].bin),
+                n_real=len(reqs),
+                packing=("lane" if pb.lane_d is not None else
+                         "envelope" if pb.envelope is not None else
+                         "structure"),
+                retry_depth=0, pipelined=True)
+                if tracer.active else None)
+            try:
+                with (span if span is not None
+                      else contextlib.nullcontext()):
+                    if pb.pending.kind == "lane":
+                        values, cycles, batch_result = \
+                            engine_batch.collect_lane_packed(
+                                pb.pending)
+                    else:
+                        values, cycles, batch_result = \
+                            engine_batch.collect_stacked(pb.pending)
+                    if span is not None:
+                        span.args["batch_size"] = \
+                            batch_result.metrics["batch_size"]
+                        span.args["pad_fraction"] = \
+                            batch_result.metrics["pad_fraction"]
+            except Exception as exc:  # noqa: BLE001
+                logger.warning(
+                    "pipelined collect failed (%d requests): %s; "
+                    "re-dispatching synchronously", len(reqs), exc)
+                self._dispatch_attempt(reqs, retry_depth=0,
+                                       envelope=pb.envelope,
+                                       lane_d=pb.lane_d)
+                return
+            t_dev1 = time.perf_counter()
+            # Overlap accounting: host wall between launch-done and
+            # collect-start was spent on other dispatches' work while
+            # this one's device work was in flight, clamped to the
+            # dispatch's own execute wall.
+            run_s = float(batch_result.metrics.get(
+                "run_time_s", batch_result.time_s))
+            overlap = min(max(t_collect0 - pb.t_launch_end, 0.0),
+                          max(run_s, 0.0))
+            efficiency.tracker.record_overlap(overlap, run_s)
+            self._complete_batch(reqs, batch_result, values, cycles,
+                                 pb.pending.t_launch, t_dev1)
 
     def _dispatch_attempt(self, reqs: List[SolveRequest],
                           retry_depth: int,
@@ -1128,6 +1372,20 @@ class SolveService:
                                        envelope=envelope,
                                        lane_d=lane_d)
             return
+        self._complete_batch(reqs, batch_result, values, cycles,
+                             t_dev0, t_dev1=None)
+
+    def _complete_batch(self, reqs: List[SolveRequest], batch_result,
+                        values, cycles, t_dev0: float,
+                        t_dev1: Optional[float] = None) -> None:
+        """Decode + terminal tail of a SUCCESSFUL device dispatch,
+        shared verbatim by the synchronous path
+        (:meth:`_dispatch_attempt_inner`) and the pipelined one
+        (:meth:`collect_dispatch`) so their accounting cannot drift:
+        per-request decode with its own failure isolation, honest
+        ledgers, journal/lifecycle terminals — plus the closed-loop
+        feedback taps (pack-model fit samples, speculation hit
+        accounting)."""
         self.admission.record_dispatch(ok=True)
         metrics = batch_result.metrics
         self.dispatches += 1
@@ -1152,7 +1410,9 @@ class SolveService:
         pad_lanes = metrics["batch_size"] - metrics["n_real"]
         if pad_lanes:
             self._pad_waste.inc(pad_lanes)
-        t_dev1 = time.perf_counter()
+        if t_dev1 is None:
+            t_dev1 = time.perf_counter()
+        self._feed_closed_loop(reqs, batch_result)
         converged_lanes = metrics.get("converged_lanes") or []
         for i, req in enumerate(reqs):
             # Per-request decode guard: one cost function that raises
@@ -1227,6 +1487,35 @@ class SolveService:
             self._journal_done(req)
             req.done.set()
             self._publish_lifecycle("finished", req)
+
+    def _feed_closed_loop(self, reqs: List[SolveRequest],
+                          batch_result) -> None:
+        """The measured-dispatch feedback taps (ISSUE 18): a warm
+        maxsum dispatch feeds one (cells, cycles, execute) sample to
+        the online pack-model fit, and a cold dispatch whose program
+        key was speculatively AOT-built counts as a speculation hit
+        (the XLA build left the request path — the cold call resolved
+        as a disk-cache hit).  Both are advisory: failures are
+        swallowed, the dispatch result is already decided."""
+        metrics = batch_result.metrics
+        try:
+            program_key = metrics.get("program_key")
+            if (self._speculator is not None and program_key
+                    and metrics.get("cold_start")):
+                if self._speculator.record_hit(program_key):
+                    self.speculative_hits += 1
+            cells = metrics.get("cells_total")
+            if cells and not metrics.get("cold_start"):
+                from pydcop_tpu.engine import autotune
+
+                if autotune.pack_fit_enabled():
+                    run_s = float(metrics.get(
+                        "run_time_s", batch_result.time_s))
+                    autotune.record_pack_sample(
+                        efficiency.backend_name(), int(cells),
+                        int(reqs[0].params["max_cycles"]), run_s)
+        except Exception:  # noqa: BLE001 — feedback, not serving
+            pass
 
     def _request_ledger(self, req: SolveRequest, batch_result,
                         t_dev0: float, t_dev1: float,
@@ -1574,6 +1863,7 @@ class SolveService:
         with self._lock:
             tracked = len(self._requests)
             recent_decisions = list(self.envelope_decisions)[-8:]
+        eff = efficiency.tracker.summary()
         return {
             "queue_depth": self._queue.qsize(),
             "high_water": self.admission.policy.high_water,
@@ -1593,6 +1883,24 @@ class SolveService:
             "dispatch_retries": self.dispatch_retries,
             "dpop_dispatches": self.dpop_dispatches,
             "portfolio_resolved": self.portfolio_resolved,
+            # The closed-loop hot path's /stats faces (ISSUE 18):
+            # pipelined launch/collect counters with the overlap
+            # fraction, and the speculative compiler's ledger —
+            # ``speculative_compiles_total`` with at least one hit is
+            # the smoke-asserted signal that compile stalls left the
+            # request path.
+            "pipeline": {
+                "enabled": self.pipeline,
+                "pipelined_dispatches": self.pipelined_dispatches,
+                "overlap_fraction":
+                    eff["pipeline_overlap_fraction"],
+            },
+            "speculation": dict(
+                {"enabled": self.speculate,
+                 "hits": self.speculative_hits},
+                **(self._speculator.stats()
+                   if self._speculator is not None else
+                   {"speculative_compiles_total": 0})),
             "journal": (self.journal_dir
                         if self._journal is not None else None),
             "sessions": self.sessions.stats(),
@@ -1612,7 +1920,7 @@ class SolveService:
             # where-the-time-went component sums.  The full document
             # (per-structure top-N, waste taxonomy) lives on
             # ``GET /profile``.
-            "efficiency": efficiency.tracker.summary(),
+            "efficiency": eff,
         }
 
     def health_summary(self) -> Dict[str, Any]:

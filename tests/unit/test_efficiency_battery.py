@@ -928,3 +928,189 @@ class TestReviewRegressions:
             efficiency.tracker.enabled = was
         assert "efficiency" not in res.metrics
         assert efficiency.tracker.rollup()["structures"] == []
+
+
+# ------------------------------------------------------------------ #
+# closed-loop hot path (ISSUE 18): pipelined flushes + speculation
+# ------------------------------------------------------------------ #
+
+class TestPipelinedFlush:
+    """Ledger honesty and terminal ordering when dispatch k+1
+    launches before dispatch k decodes (the pipelined flush path)."""
+
+    def _pipelined_burst(self, dcops, service_kw=None):
+        """Warm pass (compiles every program synchronously), then the
+        measured burst on warm programs — only warm dispatches take
+        the pipelined launch/collect path."""
+        kw = dict({"pipeline": True}, **(service_kw or {}))
+        service = SolveService(batch_window_s=0.05, max_batch=16,
+                               **kw).start()
+        completions = []
+        orig_pub = service._publish_lifecycle
+
+        def pub(event, req):
+            if event == "finished":
+                completions.append(req.id)
+            return orig_pub(event, req)
+
+        service._publish_lifecycle = pub
+        try:
+            ids = [service.submit(d, params=PARAMS) for d in dcops]
+            warm = [service.result(i, wait=60) for i in ids]
+            assert all(r["status"] == "FINISHED" for r in warm), warm
+            completions.clear()
+            ids = [service.submit(d, params=PARAMS) for d in dcops]
+            results = [service.result(i, wait=60) for i in ids]
+            assert all(r["status"] == "FINISHED"
+                       for r in results), results
+            reqs = {i: service._requests[i] for i in ids}
+            stats = service.stats()
+        finally:
+            service.stop()
+        return ids, results, reqs, stats, completions
+
+    def test_multibin_pipelined_ledgers_and_ordering(self):
+        # Two structures x 2 requests: two bins per flush, so the
+        # second bin's device call launches while the first bin's
+        # arrays are still in flight (scheduler pending depth 2).
+        dcops = ([_ring(6, s) for s in range(2)]
+                 + [_ring(9, s) for s in range(2)])
+        ids, results, reqs, stats, completions = \
+            self._pipelined_burst(dcops)
+        assert stats["pipeline"]["enabled"]
+        assert stats["pipeline"]["pipelined_dispatches"] >= 2, stats
+        roll = efficiency.tracker.rollup()
+        assert roll["pipeline"]["dispatches"] >= 2
+        assert 0.0 <= roll["pipeline_overlap_fraction"] <= 1.0
+        for res in results:
+            # Sum-to-latency holds on the pipelined path, and decode
+            # is attributed to the owning request (its own host
+            # post-processing wall, never zeroed by the overlap).
+            _assert_ledger_sums(res["ledger"])
+            assert res["ledger"]["decode_s"] > 0.0, res["ledger"]
+        # Terminal callbacks fire in pickup order: the order the
+        # scheduler dispatched (t_dispatch), not decode-completion
+        # races.
+        pickup = sorted(ids, key=lambda i: reqs[i].t_dispatch)
+        assert completions == pickup, (completions, pickup)
+
+    def test_pipelined_envelope_and_lane_ledgers(self):
+        mixed = [_ring(5, 0), _ring(6, 1), _ring(7, 2)]
+        for kw in ({"envelope_overhead_ms": 1e6, "lane_pack": False},
+                   {"envelope_overhead_ms": 1e6}):
+            efficiency.tracker.clear()
+            _ids, results, _reqs, stats, _comp = \
+                self._pipelined_burst(mixed, service_kw=kw)
+            assert stats["pipeline"]["pipelined_dispatches"] >= 1
+            kinds = {r["batch"]["packing"] for r in results}
+            assert kinds <= {"envelope", "lane"}, kinds
+            for res in results:
+                _assert_ledger_sums(res["ledger"])
+
+    def test_no_pipeline_knob_stays_synchronous(self):
+        dcops = [_ring(6, s) for s in range(2)]
+        _ids, results, _reqs, stats, _comp = self._pipelined_burst(
+            dcops, service_kw={"pipeline": False})
+        assert stats["pipeline"]["pipelined_dispatches"] == 0
+        for res in results:
+            _assert_ledger_sums(res["ledger"])
+
+    def test_stubbed_run_batch_never_pipelines(self):
+        # A test double stubbing the device call IS the contract
+        # under test for a pile of batteries: the pipelined path must
+        # step aside for it.
+        service = SolveService(batch_window_s=0.02, pipeline=True)
+        calls = []
+        orig = SolveService._run_batch
+
+        def stub(reqs, params):
+            calls.append(len(reqs))
+            return orig(service, reqs, params)
+
+        service._run_batch = stub
+        service.start()
+        try:
+            i = service.submit(_ring(6, 0), params=PARAMS)
+            r1 = service.result(i, wait=60)
+            i = service.submit(_ring(6, 1), params=PARAMS)
+            r2 = service.result(i, wait=60)
+        finally:
+            stats = service.stats()
+            service.stop()
+        assert r1["status"] == r2["status"] == "FINISHED"
+        assert len(calls) == 2, calls
+        assert stats["pipeline"]["pipelined_dispatches"] == 0
+
+
+class TestSpeculativeCompiles:
+    """Tentpole (b) discipline: background compiles never run on the
+    device-owning scheduler thread, are compile-only (trace-span
+    asserted), and a speculated program's first real dispatch counts
+    as a hit."""
+
+    def test_speculation_off_thread_and_hits(self):
+        from pydcop_tpu.observability.trace import tracer
+        from pydcop_tpu.serving import binning
+
+        tracer.enable()
+        service = SolveService(batch_window_s=0.05, max_batch=16,
+                               pipeline=True, speculate=True).start()
+        try:
+            sched_ident = service._scheduler_ident
+            assert sched_ident is not None
+            # Phase 1: a recurring solo structure seeds the arrival
+            # histogram; the speculator AOT-builds the bin rungs its
+            # traffic will need (bs=2 among them).  The structure is
+            # unique to this test (ring 11) — a key another battery
+            # test already dispatched would be live-warm, which the
+            # speculator rightly refuses to rebuild (and whose first
+            # dispatch here would not be cold, so no hit either).
+            for s in range(2):
+                i = service.submit(_ring(11, s), params=PARAMS)
+                assert service.result(i, wait=60)[
+                    "status"] == "FINISHED"
+            graph, _ = compile_dcop(_ring(11, 0), pad_to=1,
+                                    aggregation="scatter")
+            p = binning.normalize_params(PARAMS)
+            prep = engine_batch._prepare_stacked(
+                [graph, graph], p["max_cycles"], p["damping"],
+                p["damping_nodes"], p["stability"],
+                service.bin_sizes, False, None)
+            expected = str(prep.key)
+            import time as _time
+
+            deadline = _time.time() + 120
+            spec = service._speculator
+            while (_time.time() < deadline
+                   and expected not in spec.compiled_keys):
+                _time.sleep(0.2)
+            assert expected in spec.compiled_keys, spec.stats()
+            # Phase 2: the predicted bin-of-2 arrives; its program is
+            # cold in the jit cache but speculatively built — a hit.
+            ids = [service.submit(_ring(11, s), params=PARAMS)
+                   for s in (7, 8)]
+            results = [service.result(i, wait=60) for i in ids]
+            assert all(r["status"] == "FINISHED" for r in results)
+            stats = service.stats()
+            assert stats["speculation"]["enabled"]
+            assert stats["speculation"][
+                "speculative_compiles_total"] >= 1
+            assert stats["speculation"]["hits"] >= 1, stats
+            # Discipline: every compile ran off the scheduler thread.
+            assert spec.records, "no compile records"
+            for rec in spec.records:
+                assert rec["thread_ident"] != sched_ident, rec
+                assert rec["compile_only"], rec
+            # Trace-span asserted too: speculative_compile spans
+            # carry their thread and the compile-only flag, and none
+            # ever ran on the dispatch-owning thread.
+            spans = [e for e in tracer.events()
+                     if e.get("name") == "speculative_compile"]
+            assert spans, "no speculative_compile spans recorded"
+            for ev in spans:
+                assert ev["args"]["compile_only"] is True
+                assert ev["args"]["thread"] != sched_ident
+        finally:
+            service.stop()
+            tracer.disable()
+            tracer.clear()

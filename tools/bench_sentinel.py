@@ -147,6 +147,13 @@ def load_history(root: str) -> List[Dict[str, Any]]:
             # when the leg failed that round.
             "serve_mixed_value": _opt_float(
                 parsed.get("serve_mixed_problems_per_sec")),
+            # Pipelined-flush overlap (ISSUE 18 bench_serving_mixed):
+            # measured-window fraction of device execute wall the
+            # scheduler hid decode work under — HIGHER is better, a
+            # drop means the closed-loop hot path stopped
+            # overlapping.  Absent before PR 18.
+            "serve_overlap_value": _opt_float(
+                parsed.get("serve_overlap_fraction")),
             # Stateful-session legs (ISSUE 13 bench_sessions):
             # warm time-to-recovered-cost after a scenario event
             # (ms, LOWER is better) and sustained applied events per
@@ -310,6 +317,12 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
         # structure binning degenerates to batch-size-1.
         ("serve_mixed", "serve_mixed_value", "problems/s",
          "backend", True, "serve_mixed"),
+        # ISSUE 18: decode/dispatch overlap fraction of the pipelined
+        # scheduler on the same mixed leg — a brand-new family: until
+        # 3 rounds exist its verdict is "insufficient", never a crash
+        # or gate.
+        ("serve_overlap", "serve_overlap_value", "fraction",
+         "backend", True, "serve_mixed"),
         ("sharded", "sharded_value", "cycles/s",
          "sharded_backend", True, "sharded"),
         # ISSUE 10: wall-clock to the reference cost on the
@@ -357,7 +370,9 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
     failed = False
     for (family, field, unit, backend_key, higher_better,
          leg) in metrics:
-        fmt = ".0f" if higher_better else ".3f"
+        # Rates print whole, latencies and fractions keep precision.
+        fmt = (".3f" if (not higher_better or unit == "fraction")
+               else ".0f")
 
         def leg_backend(r):
             # The leg's RESOLVED backend when the round recorded one

@@ -53,7 +53,13 @@ Starts the real service on port 0 and drives it over HTTP:
    host-striped fleet with zero acked events lost — the router pin
    follows the session and the fairness/migration control surfaces
    are live on /stats.
-10. **Exact-inference tier** (ISSUE 17 acceptance): a request with
+10. **Pipelined flushes + speculative compiles** (ISSUE 18
+    acceptance): a real-HTTP mixed burst served with pipelining and
+    speculation ON answers bit-identical to solo ``api.solve``;
+    ``/stats`` shows ``speculative_compiles_total`` with >= 1 hit
+    and >= 2 pipelined dispatches, and the ``/profile`` compile
+    waste share is lower than the same workload with both OFF.
+11. **Exact-inference tier** (ISSUE 17 acceptance): a request with
     ``params.algo="dpop"`` answers with ``optimal: true`` and the
     assignment the solo exact solve produces, while a problem whose
     UTIL hypercube exceeds the element cap gets a structured 400
@@ -409,6 +415,235 @@ def leg_efficiency():
               "and the cpu useful_work_fraction")
     finally:
         handle.stop()
+
+
+def leg_pipelined_speculation():
+    """ISSUE 18 acceptance: a real-HTTP mixed burst served with
+    pipelining + speculation ON answers bit-identical to solo
+    ``api.solve``; ``/stats`` shows ``speculative_compiles_total``
+    with >= 1 hit and >= 1 pipelined dispatch; and the ``/profile``
+    compile waste share is LOWER than an identical workload served
+    with both knobs OFF (the speculated program lands in the
+    persistent AOT cache, so the first real dispatch retrieves
+    instead of building)."""
+    import tempfile as _tempfile
+
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.engine import batch as engine_batch
+    from pydcop_tpu.engine.compile import compile_dcop
+    from pydcop_tpu.observability import efficiency
+    from pydcop_tpu.serving import binning
+
+    def get_json(url, route):
+        with urllib.request.urlopen(url + route, timeout=30) as r:
+            return json.loads(r.read())
+
+    def expected_key(dcop):
+        graph, _ = compile_dcop(dcop)
+        p = binning.normalize_params({"max_cycles": MAX_CYCLES})
+        prep = engine_batch._prepare_stacked(
+            [graph, graph], p["max_cycles"], p["damping"],
+            p["damping_nodes"], p["stability"],
+            (1, 2, 4, 8, 16), False, None)
+        return str(prep.key)
+
+    def run(on: bool, ns, cache_dir):
+        # The comparison runs share one process, so each side gets
+        # structures of its OWN sizes — a structure the other side
+        # already compiled would serve from the warm jit cache and
+        # hide the compile cost this leg exists to compare.
+        efficiency.tracker.clear()
+        handle = api.serve(
+            port=0, batch_window_s=0.25, max_batch=16, max_queue=64,
+            pipeline=on, speculate=on, compile_cache_dir=cache_dir)
+        pairs = []
+        try:
+            url = handle.url
+            for n in ns:
+                # Two sequential solos seed the structure (and, ON,
+                # the speculator's arrival histogram).
+                for seed in (n * 10, n * 10 + 1):
+                    d = build_instance(n, seed)
+                    code, res = post(url, {
+                        "dcop": dcop_yaml(d), "wait": True,
+                        "timeout": 120,
+                        "params": {"max_cycles": MAX_CYCLES}})
+                    check(code == 200
+                          and res["status"] == "FINISHED",
+                          f"solo n={n} seed={seed} served "
+                          f"(speculation={'on' if on else 'off'})")
+                    pairs.append((d, res))
+            if on:
+                # Wait for the bin-of-2 programs the structures'
+                # traffic predicts to land in the AOT cache, then
+                # for the speculator to go quiet — on a small box
+                # the background builds contend with live compiles
+                # for cores, and the measured window below must see
+                # only serving work.
+                spec = handle.service._speculator
+                deadline = time.time() + 120
+                for n in ns:
+                    want = expected_key(build_instance(n, n * 10))
+                    while (time.time() < deadline
+                           and want not in spec.compiled_keys):
+                        time.sleep(0.1)
+                    check(want in spec.compiled_keys,
+                          f"speculative bin-of-2 build for n={n} "
+                          f"landed ({spec.stats()})")
+                while (time.time() < deadline
+                       and spec.stats()["queued"] > 0):
+                    time.sleep(0.1)
+                time.sleep(0.5)
+            # The measured serving window: the profile compared
+            # below covers ONLY the traffic from here on, the same
+            # window on both sides (the seeding solos above pay
+            # first-arrival compiles no speculation can predict).
+            efficiency.tracker.clear()
+            for n in ns:
+                # The predicted bin-of-2 arrives — cold in the jit
+                # cache; ON, its executable comes off the disk.
+                burst = [build_instance(n, n * 10 + s)
+                         for s in (2, 3)]
+                res2 = [None] * 2
+
+                def client(i, d=None):
+                    res2[i] = post(url, {
+                        "dcop": dcop_yaml(d), "wait": True,
+                        "timeout": 120,
+                        "params": {"max_cycles": MAX_CYCLES}})
+
+                threads = [threading.Thread(target=client,
+                                            args=(i,),
+                                            kwargs={"d": d})
+                           for i, d in enumerate(burst)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=180)
+                check(all(r is not None and r[0] == 200
+                          and r[1]["status"] == "FINISHED"
+                          for r in res2),
+                      f"bin-of-2 burst for n={n} served")
+                pairs.extend(
+                    (d, r[1]) for d, r in zip(burst, res2))
+            # Final mixed burst: both structures warm at bin 2 —
+            # the flush the pipelined scheduler overlaps.
+            mixed = [build_instance(n, n * 10 + s)
+                     for n in ns for s in (4, 5)]
+            resm = [None] * len(mixed)
+
+            def mclient(i, d=None):
+                resm[i] = post(url, {
+                    "dcop": dcop_yaml(d), "wait": True,
+                    "timeout": 120,
+                    "params": {"max_cycles": MAX_CYCLES}})
+
+            threads = [threading.Thread(target=mclient, args=(i,),
+                                        kwargs={"d": d})
+                       for i, d in enumerate(mixed)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            check(all(r is not None and r[0] == 200
+                      and r[1]["status"] == "FINISHED"
+                      for r in resm),
+                  f"mixed {len(mixed)}-request burst served")
+            pairs.extend((d, r[1]) for d, r in zip(mixed, resm))
+            stats = get_json(url, "/stats")
+            profile_doc = get_json(url, "/profile")
+        finally:
+            handle.stop()
+        return pairs, stats, profile_doc
+
+    def compile_share(doc):
+        total = doc["ledger"]["total_s"]
+        check(total > 0, "profile ledger total positive")
+        return doc["waste_by_cause"]["compile_s"] / total
+
+    # Everything (the solo-compare api.solve calls included) runs
+    # with the tempdirs alive — the persistent-cache config latches
+    # on the last enabled directory, and jit warns on every write
+    # into a deleted one.  The XLA cost profiler is vetoed for the
+    # comparison: its throwaway AOT build on every cold dispatch
+    # runs BEFORE the engine's timed interval and (with the
+    # persistent cache on) writes the disk entry the live jit then
+    # retrieves, so with it enabled BOTH sides' /profile compile
+    # waste collapses to retrieval-sized slivers and the check
+    # compares noise.  Vetoed, the OFF side pays its full XLA
+    # builds inside the timed interval while the ON side still
+    # retrieves what the speculator pre-built.
+    prior_profile = os.environ.get("PYDCOP_XLA_PROFILE")
+    os.environ["PYDCOP_XLA_PROFILE"] = "0"
+    try:
+        with _tempfile.TemporaryDirectory() as td_off, \
+                _tempfile.TemporaryDirectory() as td_on:
+            pairs_off, stats_off, prof_off = run(
+                False, (23, 25), td_off)
+            pairs_on, stats_on, prof_on = run(True, (26, 29), td_on)
+            _check_pipelined_speculation(
+                compile_share, pairs_off, stats_off, prof_off,
+                pairs_on, stats_on, prof_on)
+    finally:
+        if prior_profile is None:
+            del os.environ["PYDCOP_XLA_PROFILE"]
+        else:
+            os.environ["PYDCOP_XLA_PROFILE"] = prior_profile
+
+
+def _check_pipelined_speculation(compile_share, pairs_off, stats_off,
+                                 prof_off, pairs_on, stats_on,
+                                 prof_on):
+    """Assertions for :func:`leg_pipelined_speculation`, run while
+    the cache tempdirs are still alive (the solo-compare api.solve
+    calls jit into the latched persistent-cache directory)."""
+    check(not stats_off["pipeline"]["enabled"]
+          and stats_off["pipeline"]["pipelined_dispatches"] == 0,
+          "OFF run never pipelined")
+    check(stats_on["speculation"]["enabled"],
+          "speculation reported enabled on the ON run")
+    check(stats_on["speculation"]
+          ["speculative_compiles_total"] >= 1,
+          "/stats shows speculative_compiles_total >= 1 "
+          f"({stats_on['speculation']})")
+    check(stats_on["speculation"]["hits"] >= 1,
+          ">= 1 speculative hit on a real cold dispatch "
+          f"({stats_on['speculation']})")
+    check(stats_on["pipeline"]["pipelined_dispatches"] >= 2,
+          ">= 2 pipelined dispatches on the mixed flush "
+          f"({stats_on['pipeline']})")
+
+    # THE acceptance bar: every ON response (pipelined,
+    # speculated, packed or not) equals the solo api.solve
+    # answer bit for bit.
+    for dcop, res in pairs_on + pairs_off:
+        solo = api_solve_cached(dcop)
+        if res["assignment"] != solo["assignment"]:
+            check(False,
+                  f"served assignment for {dcop.name} differs "
+                  "from solo api.solve")
+    check(True,
+          f"all {len(pairs_on) + len(pairs_off)} served "
+          "answers bit-identical to solo api.solve")
+
+    share_off = compile_share(prof_off)
+    share_on = compile_share(prof_on)
+    check(share_on < share_off,
+          "compile waste share lower with speculation ON "
+          f"({share_on:.3f} < {share_off:.3f})")
+
+
+_SOLO_CACHE = {}
+
+
+def api_solve_cached(dcop):
+    from pydcop_tpu import api
+
+    if dcop.name not in _SOLO_CACHE:
+        _SOLO_CACHE[dcop.name] = api.solve(
+            dcop, "maxsum", backend="device", max_cycles=MAX_CYCLES)
+    return _SOLO_CACHE[dcop.name]
 
 
 def leg_overload():
@@ -1161,6 +1396,7 @@ def main() -> int:
     leg_coalescing()
     leg_mixed_envelope()
     leg_efficiency()
+    leg_pipelined_speculation()
     leg_overload()
     leg_dpop_exact()
     leg_fleet_burst()
