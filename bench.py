@@ -1321,6 +1321,178 @@ def bench_serving_fleet():
     return out
 
 
+# Partition-tolerant fleet leg (ISSUE 19): the SAME closed-loop
+# 2-replica stream run clean and then under a seeded 1%-drop /
+# 20ms-delay netfault plan on the router->replica /solve links
+# (liveness probes spared via the path= scope, so the leg measures
+# retry absorption, not false death verdicts).  Every request
+# carries a deadline_s; the router's idempotent retry must absorb
+# every injected fault — zero acked requests lost, zero retry
+# budgets exhausted — or the leg fails.  Sentinel family
+# "fleet_faulted" (the faulted problems/sec: its own family, NOT
+# compared against the clean serving_fleet numbers).
+FLEET_FAULT_SPEC = ("seed=19;link=router>replica-*,path=/solve,"
+                    "drop=0.01,delay_ms=20")
+FLEET_FAULT_DEADLINE_S = 30.0
+
+
+def bench_serving_fleet_faulted():
+    """Closed-loop clients against a 2-replica fleet with seeded
+    drop+delay on the solve links.  Emits
+    ``fleet_faulted_problems_per_sec`` (the sentinel value),
+    ``fleet_faulted_clean_problems_per_sec`` /
+    ``fleet_faulted_throughput_fraction`` (the same stream with the
+    plan cleared, same process, for the overhead read),
+    ``fleet_faulted_retries`` and the two MUST-be-zero ledgers
+    ``fleet_faulted_lost_acked`` / ``fleet_faulted_budget_exceeded``.
+    None-valued on failure — never kills the headline."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving import netfault
+    from pydcop_tpu.serving.router import FleetRouter, RouterFrontEnd
+
+    pool = {
+        n: [dcop_yaml(build_dcop_small(n, seed))
+            for seed in range(FLEET_POOL_PER_STRUCT)]
+        for n in FLEET_STRUCTS
+    }
+    params = {"max_cycles": FLEET_MAX_CYCLES}
+    worker_args = ["--batch_window", "0.005", "--max_batch", "16",
+                   "--max_queue", "512",
+                   "--cycles", str(FLEET_MAX_CYCLES)]
+
+    def poll_result(url, rid, deadline):
+        while time.perf_counter() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        url + f"/result/{rid}", timeout=10) as resp:
+                    body = json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                body = json.loads(err.read())
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if body.get("status") in ("FINISHED", "ERROR"):
+                return body.get("status") == "FINISHED"
+            time.sleep(0.2)
+        return False
+
+    def run_leg(faulted: bool):
+        router = FleetRouter(replicas=2, worker_args=worker_args,
+                             affinity="structure").start()
+        front = RouterFrontEnd(router, port=0).start()
+        url = front.url
+        try:
+            completed = [0]
+            acked_pending = []
+            lock = threading.Lock()
+            state = {"t_end": 0.0}
+
+            def client(idx, record):
+                rng = np.random.default_rng(9000 + idx)
+                i = 0
+                while time.perf_counter() < state["t_end"]:
+                    n = FLEET_STRUCTS[int(rng.integers(
+                        len(FLEET_STRUCTS)))]
+                    payload = pool[n][i % FLEET_POOL_PER_STRUCT]
+                    i += 1
+                    status, body = _fleet_post(url, {
+                        "dcop": payload, "wait": True,
+                        "timeout": 60, "params": params,
+                        "deadline_s": FLEET_FAULT_DEADLINE_S})
+                    if not record:
+                        continue
+                    if status == 200 \
+                            and body.get("status") == "FINISHED":
+                        with lock:
+                            completed[0] += 1
+                    elif status in (200, 202) and body.get("id"):
+                        # Acked but not finished in the wait window:
+                        # the zero-loss ledger must resolve it.
+                        with lock:
+                            acked_pending.append(body["id"])
+
+            def drive(duration, record):
+                state["t_end"] = time.perf_counter() + duration
+                threads = [
+                    threading.Thread(target=client,
+                                     args=(i, record))
+                    for i in range(FLEET_CLIENTS)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=duration + 120)
+
+            drive(FLEET_WARM_S, record=False)   # clean warm-up
+            if faulted:
+                netfault.install(FLEET_FAULT_SPEC)
+            t_start = time.perf_counter()
+            drive(FLEET_DURATION_S, record=True)
+            elapsed = time.perf_counter() - t_start
+            injected = netfault.counters()
+            netfault.clear()
+            stats = router.stats()
+            # Resolve every acked-but-pending id AFTER the faults are
+            # cleared: an ack the fleet cannot honor is a lost
+            # request, whatever the link did.
+            lost = 0
+            poll_deadline = time.perf_counter() + 60.0
+            for rid in acked_pending:
+                done = poll_result(url, rid, poll_deadline)
+                if done:
+                    completed[0] += 1
+                else:
+                    lost += 1
+        finally:
+            netfault.clear()
+            front.stop()
+            router.stop(drain=False)
+        if not completed[0] or elapsed <= 0:
+            return None
+        return {
+            "pps": round(completed[0] / elapsed, 2),
+            "requests": completed[0],
+            "lost": lost,
+            "retries": stats.get("retries", 0),
+            "budget_exceeded": stats.get("retry_budget_exceeded", 0),
+            "injected": injected,
+        }
+
+    clean = run_leg(faulted=False)
+    faulted = run_leg(faulted=True)
+    if faulted is None:
+        return {"fleet_faulted_problems_per_sec": None,
+                "fleet_faulted_error":
+                    "faulted leg produced no completions"}
+    if faulted["lost"]:
+        raise RuntimeError(
+            f"{faulted['lost']} acked request(s) lost under the "
+            f"injected fault plan (retries {faulted['retries']})")
+    if faulted["budget_exceeded"]:
+        raise RuntimeError(
+            f"{faulted['budget_exceeded']} retry budget(s) exhausted "
+            f"under a {FLEET_FAULT_DEADLINE_S:.0f}s deadline")
+    out = {
+        "fleet_faulted_problems_per_sec": faulted["pps"],
+        "fleet_faulted_requests": faulted["requests"],
+        "fleet_faulted_lost_acked": faulted["lost"],
+        "fleet_faulted_retries": faulted["retries"],
+        "fleet_faulted_budget_exceeded": faulted["budget_exceeded"],
+        "fleet_faulted_injected_drop":
+            faulted["injected"].get("drop", 0),
+        "fleet_faulted_injected_delay":
+            faulted["injected"].get("delay", 0),
+    }
+    if clean:
+        out["fleet_faulted_clean_problems_per_sec"] = clean["pps"]
+        out["fleet_faulted_throughput_fraction"] = round(
+            faulted["pps"] / clean["pps"], 3)
+    return out
+
+
 # Elastic-fleet leg (ISSUE 16): one two-host fleet (socket-distinct
 # replica processes striped over simulated host identities) driven
 # through four phases — baseline throughput, live session migration
@@ -2119,6 +2291,22 @@ def run_bench():
         serve_keys.update({
             "fleet_problems_per_sec_r2": None,
             "fleet_error": f"{type(exc).__name__}: {exc}"[:200],
+        })
+    # Partition-tolerant fleet leg (ISSUE 19): the same closed-loop
+    # stream under a seeded 1%-drop/20ms-delay plan on the solve
+    # links, zero-acked-loss + deadline-budget ledgers — sentinel
+    # family "fleet_faulted" (its own family, never compared against
+    # the clean fleet numbers).  Never kills the headline.
+    try:
+        record_leg_backend("fleet_faulted")
+        serve_keys.update(bench_serving_fleet_faulted())
+    except Exception as exc:  # noqa: BLE001 — auxiliary leg
+        print(f"bench: faulted-fleet leg failed ({exc}); continuing",
+              file=sys.stderr)
+        serve_keys.update({
+            "fleet_faulted_problems_per_sec": None,
+            "fleet_faulted_error":
+                f"{type(exc).__name__}: {exc}"[:200],
         })
     # Elastic-fleet leg (ISSUE 16): two-host fleet under churn —
     # baseline throughput, live-migration cost parity, a 4x traffic

@@ -411,6 +411,7 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           affinity: str = "structure",
           compile_cache_dir: Optional[str] = None,
           heartbeat_s: float = 0.25,
+          probe_timeout_s: Optional[float] = None,
           spill_slack: int = 4,
           hosts: int = 1,
           slo_p99_ms: Optional[float] = None,
@@ -534,7 +535,9 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
             session_certify_after=session_certify_after,
             replicas=replicas, affinity=affinity,
             compile_cache_dir=compile_cache_dir,
-            heartbeat_s=heartbeat_s, spill_slack=spill_slack,
+            heartbeat_s=heartbeat_s,
+            probe_timeout_s=probe_timeout_s,
+            spill_slack=spill_slack,
             hosts=hosts, slo_p99_ms=slo_p99_ms,
             min_replicas=min_replicas, max_replicas=max_replicas,
             port_file=port_file, block=block)
@@ -591,7 +594,8 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     if join:
         # Announce AFTER the front end binds: the router health-probes
         # the announced URL before admitting it to the fleet.
-        _announce_join(join, handle.url, host_id)
+        _announce_join(join, handle.url, host_id,
+                       journal_dir=journal_dir)
     if not block:
         return handle
     _serve_until_signal(
@@ -605,36 +609,50 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
 
 
 def _announce_join(join_url: str, own_url: str,
-                   host_id: Optional[str] = None) -> bool:
+                   host_id: Optional[str] = None,
+                   journal_dir: Optional[str] = None) -> bool:
     """Announce this worker to a fleet router's ``POST /fleet/join``.
 
     Best-effort with small retries (the router may still be binding
     during a parallel bring-up): a failed announce leaves the worker
     serving standalone with a warning — operators re-announce by
     restarting or curling /fleet/join themselves — rather than
-    refusing to serve at all."""
+    refusing to serve at all.
+
+    ``journal_dir`` rides along when the worker journals: a router
+    that can see the same filesystem uses it for dead-session
+    adoption (serving/migration.adopt_dead_sessions).  The socket I/O
+    routes through the netfault seam like every other fleet link, so
+    an injected partition also severs discovery."""
     import json
     import sys
     import time
-    import urllib.request
+    import urllib.parse
 
     from pydcop_tpu.engine.multihost import fleet_host_id
+    from pydcop_tpu.serving import netfault
 
-    payload = json.dumps({
-        "url": own_url,
-        "host_id": host_id or fleet_host_id(),
-    }).encode()
-    target = join_url.rstrip("/") + "/fleet/join"
+    own_host_id = host_id or fleet_host_id()
+    doc = {"url": own_url, "host_id": own_host_id}
+    if journal_dir:
+        doc["journal_dir"] = journal_dir
+    payload = json.dumps(doc).encode()
+    parsed = urllib.parse.urlsplit(join_url)
+    router_host = parsed.hostname or "127.0.0.1"
+    router_port = parsed.port or 80
+    path = (parsed.path.rstrip("/") or "") + "/fleet/join"
     last: Optional[Exception] = None
     for attempt in range(5):
         if attempt:
             time.sleep(min(0.5 * attempt, 2.0))
         try:
-            req = urllib.request.Request(
-                target, data=payload, method="POST",
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=5.0) as resp:
-                resp.read()
+            status, _ctype, body = netfault.exchange(
+                ("worker", own_host_id), ("router", router_host),
+                router_host, router_port, "POST", path,
+                body=payload, timeout=5.0)
+            if status >= 400:
+                raise ValueError(
+                    f"join answered {status}: {body[:200]!r}")
             print(f"pydcop serve: joined fleet at {join_url}",
                   file=sys.stderr)
             return True
@@ -653,7 +671,8 @@ def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
                  session_max, session_segment_cycles,
                  session_checkpoint_every_events,
                  session_certify_after, replicas, affinity,
-                 compile_cache_dir, heartbeat_s, spill_slack,
+                 compile_cache_dir, heartbeat_s, probe_timeout_s,
+                 spill_slack,
                  hosts, slo_p99_ms, min_replicas, max_replicas,
                  port_file, block) -> Optional["FleetHandle"]:
     """The ``replicas > 1`` serve path: build the worker CLI tail
@@ -711,7 +730,8 @@ def _serve_fleet(*, port, host, max_queue, batch_window_s, max_batch,
         replicas=replicas, worker_args=worker_args,
         journal_dir=journal_dir,
         compile_cache_dir=compile_cache_dir, affinity=affinity,
-        heartbeat_s=heartbeat_s, spill_slack=spill_slack,
+        heartbeat_s=heartbeat_s, probe_timeout_s=probe_timeout_s,
+        spill_slack=spill_slack,
         default_params=params,
         hosts=hosts, slo_p99_ms=slo_p99_ms,
         min_replicas=min_replicas, max_replicas=max_replicas,

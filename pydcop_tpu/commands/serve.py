@@ -173,6 +173,13 @@ def set_parser(subparsers):
                              "replica silent for ~8 expected beats "
                              "(phi-accrual model) is declared dead "
                              "and restarted on its journal segment")
+    parser.add_argument("--probe_timeout_s", "--probe-timeout-s",
+                        type=float, default=None, metavar="SECONDS",
+                        help="liveness probe timeout (default: "
+                             "max(4x heartbeat, 1.0)); raise it when "
+                             "links are slow so latency reads as "
+                             "GRAY degradation on /healthz instead "
+                             "of false-killing replicas")
     parser.add_argument("--spill_slack", "--spill-slack", type=int,
                         default=4,
                         help="affinity spillover threshold: a "
@@ -291,6 +298,7 @@ def run_cmd(args) -> int:
         compile_cache_dir=(args.compile_cache_dir
                            or aotcache.cache_dir()),
         heartbeat_s=args.heartbeat,
+        probe_timeout_s=args.probe_timeout_s,
         spill_slack=args.spill_slack,
         hosts=args.hosts,
         slo_p99_ms=args.slo_p99_ms,

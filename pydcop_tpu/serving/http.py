@@ -47,6 +47,7 @@ from pydcop_tpu.serving.admission import AdmissionRejected
 from pydcop_tpu.serving.service import SolveService, WidthRejected
 from pydcop_tpu.serving.sessions import (
     SessionClosed,
+    StaleEpoch,
     scenario_yaml_to_events,
 )
 
@@ -278,9 +279,14 @@ class _ServeHandler(_Handler):
           source once the target owns it (200, idempotent).
         - ``resume_session`` — roll a MIGRATING session back to OPEN
           after a failed import (200).
+        - ``fence_session`` — revoke this replica's stale copy of a
+          session whose ownership epoch moved on while it was
+          partitioned (200, idempotent; 409 when the fence itself is
+          stale).
         """
         if op not in ("export_session", "import_session",
-                      "retire_session", "resume_session"):
+                      "retire_session", "resume_session",
+                      "fence_session"):
             self._json(404, {"error": "unknown path"}, close=True)
             return
         body = self._read_json_body()
@@ -307,11 +313,18 @@ class _ServeHandler(_Handler):
             elif op == "retire_session":
                 out = service.sessions.retire_session(
                     sid, moved_to=body.get("moved_to"))
+            elif op == "fence_session":
+                out = service.sessions.fence_session(
+                    sid, int(body.get("epoch") or 0))
             else:  # resume_session
                 out = service.sessions.resume_session(sid)
             self._json(200, out)
         except KeyError as exc:
             self._json(404, {"error": f"unknown session: {exc}"})
+        except StaleEpoch as exc:
+            self._json(409, {"error": str(exc), "stale_epoch": True,
+                             "session_epoch": exc.session_epoch,
+                             "request_epoch": exc.request_epoch})
         except SessionClosed as exc:
             self._json(409, {"error": str(exc)})
         except TimeoutError as exc:
@@ -396,15 +409,27 @@ class _ServeHandler(_Handler):
             if body.get("wait"):
                 wait = _positive_float(
                     body.get("timeout", 30.0), "timeout")
+            epoch = body.get("epoch")
+            if epoch is not None:
+                epoch = int(epoch)
         except Exception as exc:  # noqa: BLE001 — malformed body
             service.record_bad_request()
             self._json(400, {"error": f"bad events: {exc}"})
             return
         try:
             out = service.sessions.apply_events(
-                sid, events, wait=wait)
+                sid, events, wait=wait, epoch=epoch)
         except KeyError:
             self._json(404, {"error": f"unknown session {sid!r}"})
+            return
+        except StaleEpoch as exc:
+            # Structured 409 (ISSUE 19): the fenced/stale side MUST be
+            # machine-distinguishable from an ordinary closed-session
+            # race — clients re-resolve ownership through the router
+            # instead of retrying here.
+            self._json(409, {"error": str(exc), "stale_epoch": True,
+                             "session_epoch": exc.session_epoch,
+                             "request_epoch": exc.request_epoch})
             return
         except SessionClosed as exc:
             self._json(409, {"error": str(exc)})

@@ -395,10 +395,14 @@ def completed_record(rid: str, status: str,
 
 def session_open_record(sid: str, dcop_yaml: str,
                         params: Dict[str, Any],
-                        trace_id: Optional[str] = None
-                        ) -> Dict[str, Any]:
+                        trace_id: Optional[str] = None,
+                        epoch: int = 1) -> Dict[str, Any]:
+    """``epoch`` is the session's ownership fencing epoch (ISSUE 19):
+    recovery restores it so a journal-recovered copy rejects writes
+    minted for a NEWER owner, and a migrated-in copy (whose bundle
+    carries the bumped epoch) outranks the fenced original."""
     rec = {"kind": SESSION_OPEN, "id": sid, "dcop": dcop_yaml,
-           "params": params}
+           "params": params, "epoch": max(int(epoch), 1)}
     if trace_id:
         rec["trace_id"] = trace_id
     return rec

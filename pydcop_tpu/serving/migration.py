@@ -100,7 +100,8 @@ def build_bundle(session_id: str, trace_id: str, dcop_yaml: str,
                  events: Optional[List[Dict[str, Any]]] = None,
                  npz_bytes: Optional[bytes] = None,
                  ckpt_seq: Optional[int] = None,
-                 npz_path: Optional[str] = None) -> Dict[str, Any]:
+                 npz_path: Optional[str] = None,
+                 epoch: int = 1) -> Dict[str, Any]:
     bundle: Dict[str, Any] = {
         "version": BUNDLE_VERSION,
         "session_id": session_id,
@@ -110,6 +111,7 @@ def build_bundle(session_id: str, trace_id: str, dcop_yaml: str,
         "params": dict(params or {}),
         "seq": int(seq),
         "cycle": int(cycle),
+        "epoch": max(int(epoch), 1),
         "events": [
             {"seq": int(r.get("seq", 0)),
              "events": r.get("events") or [],
@@ -189,7 +191,8 @@ def install_bundle(manager, bundle: Dict[str, Any]):
         os.replace(tmp, npz_dest)
 
     open_rec = journal_mod.session_open_record(
-        sid, dcop_src, params, trace_id=trace_id or None)
+        sid, dcop_src, params, trace_id=trace_id or None,
+        epoch=int(bundle.get("epoch") or 1))
     event_recs = [
         journal_mod.session_event_record(
             sid, r.get("seq", 0), r.get("events") or [],
@@ -271,6 +274,12 @@ def migrate_session(router, session_id: str,
             f"export failed on replica {source.index} ({status}): "
             f"{body[:300]!r}")
     bundle = json.loads(body)
+    # Ownership epoch bumps ON THE MOVE (ISSUE 19): the target's copy
+    # carries the new epoch, the router stamps it on every forwarded
+    # PATCH, and any write still addressed to the source's epoch is
+    # rejected as stale — split-brain fencing, not best-effort retire.
+    new_epoch = router.bump_epoch(session_id)
+    bundle["epoch"] = new_epoch
 
     try:
         status, _ctype, body = router._forward(
@@ -302,12 +311,13 @@ def migrate_session(router, session_id: str,
                         "moved_to": target.url}).encode(),
             timeout=30.0)
     except OSError:
-        # The target owns the session (pin repointed); an unretired
-        # source copy costs a duplicate replay after ITS next
-        # restart, never correctness — the pin decides the owner.
+        # The target owns the session (pin repointed + epoch bumped);
+        # an unretired source copy is fenced when the source heals —
+        # arm the fence now so the next successful probe flushes it.
+        router.record_fence(source.index, session_id, new_epoch)
         logger.warning("session %s: retire on replica %d "
-                       "unreachable; duplicate replay possible",
-                       session_id, source.index)
+                       "unreachable; fence armed at epoch %d",
+                       session_id, source.index, new_epoch)
     with router._lock:
         router.migrations += 1
     logger.info("session %s migrated: replica %d -> %d",
@@ -349,6 +359,11 @@ def adopt_dead_sessions(router, dead) -> int:
         target = min(live, key=lambda r: r.in_flight)
         seqs = [r.get("seq", 0) for r in rec.get("events") or []]
         seq = max([ckpt.get("seq", 0)] + seqs)
+        # Adoption is a forced move: bump the ownership epoch past
+        # whatever the (possibly merely partitioned) dead replica
+        # journaled, so a healed original cannot double-apply.
+        new_epoch = router.bump_epoch(
+            sid, floor=int(open_rec.get("epoch") or 1) + 1)
         bundle = build_bundle(
             sid, open_rec.get("trace_id") or "",
             ckpt.get("dcop") or open_rec["dcop"],
@@ -358,7 +373,8 @@ def adopt_dead_sessions(router, dead) -> int:
             events=rec.get("events"),
             npz_path=ckpt.get("path"),
             ckpt_seq=(ckpt.get("seq")
-                      if ckpt.get("path") else None))
+                      if ckpt.get("path") else None),
+            epoch=new_epoch)
         try:
             status, _ctype, body = router._forward(
                 target, "POST", "/admin/import_session",
@@ -378,6 +394,10 @@ def adopt_dead_sessions(router, dead) -> int:
             dead.journal_dir,
             journal_mod.session_close_record(sid, "MIGRATED"))
         router.pin(sid, target, router._session_pins)
+        # The close record covers a restart-in-place; a replica that
+        # was merely PARTITIONED never restarts, so arm a fence that
+        # flushes the moment it answers the prober again.
+        router.record_fence(dead.index, sid, new_epoch)
         adopted += 1
         with router._lock:
             router.migrations += 1

@@ -80,13 +80,12 @@ into a control plane:
 
 import hashlib
 import heapq
-import http.client
 import itertools
 import json
 import logging
 import os
+import random
 import signal
-import socket
 import subprocess
 import sys
 import threading
@@ -96,6 +95,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.serving import netfault
 from pydcop_tpu.observability.server import (
     TelemetryServer,
     _Handler,
@@ -126,21 +126,28 @@ DRAINING = "draining"
 # burst, short enough that a starved client learns it is being
 # shaped.
 FAIR_WAIT_S = 30.0
+# Ambiguous-forward retry budget when the client sent no deadline_s:
+# a few backed-off resends, never minutes of hidden spinning.
+DEFAULT_RETRY_BUDGET_S = 10.0
+# How long a /result poll waits out a mid-restart pin before telling
+# the client to retry: the journal-recovered twin usually answers
+# within a couple of heartbeats.
+RESULT_HEDGE_S = 2.0
 
 
 class FleetUnavailable(Exception):
     """No healthy, non-shedding replica can take the request (503)."""
 
 
-class ForwardNotSent(OSError):
-    """A forward failed BEFORE any request bytes were written (the
-    connect itself was refused/reset).  The worker cannot have seen —
-    let alone acked — the request, so re-picking a healthy replica
-    and resending the identical body is unconditionally safe.  Any
-    OSError past this point is ambiguous (bytes may have reached a
-    worker that journaled the request before dying mid-response) and
-    must surface to the client WITH the minted request id instead of
-    being silently resent."""
+# A forward that failed BEFORE any request bytes were written (the
+# connect itself was refused/reset — or the netfault plane injected a
+# drop/partition).  The worker cannot have seen — let alone acked —
+# the request, so re-picking a healthy replica and resending the
+# identical body is unconditionally safe.  Any OSError past this
+# point is ambiguous (bytes may have reached a worker that journaled
+# the request before dying mid-response) and is retried only against
+# the SAME replica, where the submit is idempotent on the minted id.
+ForwardNotSent = netfault.NotSent
 
 
 class FairScheduler:
@@ -259,6 +266,17 @@ class Replica:
         self.errors = 0
         self.restarts = 0
         self.warm: set = set()
+        # Gray-failure scoring: EWMA of /healthz probe round-trip.
+        # A link can be slow-but-alive (injected delay, a saturated
+        # box) — that is suspicion, not death, and must neither kill
+        # the replica nor hide on /healthz.
+        self.probe_ewma_ms: Optional[float] = None
+        self.gray = False
+        # One death verdict per down-episode: mark_forward_error may
+        # flip the slot DOWN before the prober's verdict, and a
+        # verdict already acted on (restart/adoption) must not re-run
+        # every beat while the slot stays dark.
+        self.death_handled = False
 
     @property
     def url(self) -> Optional[str]:
@@ -283,6 +301,9 @@ class Replica:
             "restarts": self.restarts,
             "warm_structures": len(self.warm),
             "journal_dir": self.journal_dir,
+            "probe_ms": (round(self.probe_ewma_ms, 2)
+                         if self.probe_ewma_ms is not None else None),
+            "gray": self.gray,
         }
 
 
@@ -314,6 +335,7 @@ class FleetRouter:
                  compile_cache_dir: Optional[str] = None,
                  affinity: str = "structure",
                  heartbeat_s: float = 0.25,
+                 probe_timeout_s: Optional[float] = None,
                  dead_misses: float = 8.0,
                  spill_slack: int = 4,
                  restart_dead: bool = True,
@@ -347,6 +369,13 @@ class FleetRouter:
         self.compile_cache_dir = compile_cache_dir
         self.affinity = affinity
         self.heartbeat_s = float(heartbeat_s)
+        # Probe timeout scales with the heartbeat instead of a
+        # hardcoded constant: injected link delay should raise
+        # SUSPICION (gray verdicts), not instantly false-kill a
+        # replica whose answers arrive late but arrive.
+        self.probe_timeout_s = (float(probe_timeout_s)
+                                if probe_timeout_s
+                                else max(self.heartbeat_s * 4, 1.0))
         self.dead_misses = float(dead_misses)
         self.spill_slack = int(spill_slack)
         self.restart_dead = bool(restart_dead)
@@ -364,6 +393,15 @@ class FleetRouter:
         self._rr = itertools.count()
         self._pins: "OrderedDict[str, int]" = OrderedDict()
         self._session_pins: "OrderedDict[str, int]" = OrderedDict()
+        # Epoch-fenced session ownership: the router is the epoch
+        # authority.  Every repoint (migration, adoption) bumps the
+        # session's epoch; PATCHes carry it; a replica still holding
+        # the pre-repoint copy rejects/gets fenced instead of
+        # double-applying events after a healed partition.
+        self._session_epochs: "OrderedDict[str, int]" = OrderedDict()
+        # replica index -> {session_id: epoch}: stale copies to fence
+        # the moment that replica answers the prober again.
+        self._fences: Dict[int, Dict[str, int]] = {}
         self._monitor: Optional[threading.Thread] = None
         self._stopping = threading.Event()
         self._started = False
@@ -406,6 +444,9 @@ class FleetRouter:
         self.shed = 0
         self.reroutes = 0
         self.deaths = 0
+        self.retries = 0
+        self.retry_budget_exceeded = 0
+        self.fenced_sessions = 0
         reg = metrics_registry
         self._routed_total = reg.counter(
             "pydcop_router_requests_total",
@@ -586,7 +627,8 @@ class FleetRouter:
                 continue
             try:
                 status, _ctype, _body = self._forward(
-                    replica, "GET", "/healthz", None, timeout=2.0)
+                    replica, "GET", "/healthz", None,
+                    timeout=self.probe_timeout_s)
             except OSError:
                 time.sleep(0.05)
                 continue
@@ -601,6 +643,7 @@ class FleetRouter:
                 replica.anchor = now
                 replica.estimator.beat(now)
                 replica.status = UP
+                replica.death_handled = False
                 logger.info("replica %d ready on %s", replica.index,
                             replica.url)
                 return
@@ -641,11 +684,12 @@ class FleetRouter:
         proc_dead = (replica.proc is not None
                      and replica.proc.poll() is not None)
         beat_ok = False
+        t_probe = time.monotonic()
         if not proc_dead and replica.port is not None:
             try:
                 status, _ctype, body = self._forward(
                     replica, "GET", "/healthz", None,
-                    timeout=max(self.heartbeat_s * 2, 1.0))
+                    timeout=self.probe_timeout_s)
                 beat_ok = status in (200, 503)
                 if beat_ok:
                     doc = json.loads(body)
@@ -658,15 +702,36 @@ class FleetRouter:
                 beat_ok = False
         now = time.monotonic()
         if beat_ok:
+            # Latency-aware scoring: an answer that took a large
+            # fraction of the probe timeout marks the link GRAY
+            # (slow-but-alive).  Gray is a /healthz verdict, not a
+            # routing change — suspicion is advisory (PR-4).
+            dt_ms = (now - t_probe) * 1000.0
+            replica.probe_ewma_ms = (
+                dt_ms if replica.probe_ewma_ms is None
+                else 0.7 * replica.probe_ewma_ms + 0.3 * dt_ms)
+            replica.gray = (replica.probe_ewma_ms
+                            > self.gray_threshold_ms())
             if replica.status == DOWN:
                 # A replica marked down on a forward error but whose
                 # process lived: it answered again — back in service.
+                # A healed partition heals HERE, which is exactly
+                # where its stale session copies must be fenced
+                # before any client byte can reach them.
                 replica.status = UP
+                replica.death_handled = False
+                self._flush_fences(replica)
             replica.estimator.beat(now)
             return
+        replica.gray = False
         missed = (replica.estimator.missed(now, replica.anchor)
                   if replica.estimator else float("inf"))
-        if proc_dead or missed >= self.dead_misses:
+        # One verdict per down-episode: re-declaring every beat would
+        # inflate the death count and re-run adoption against an
+        # already-drained segment.  The episode ends at the beat_ok
+        # revival above.
+        if not replica.death_handled \
+                and (proc_dead or missed >= self.dead_misses):
             self._declare_dead(replica, proc_dead=proc_dead,
                                missed=missed)
 
@@ -677,6 +742,7 @@ class FleetRouter:
             # monitor must not mistake those exits for deaths and
             # restart what stop() is draining.
             return
+        replica.death_handled = True
         self.deaths += 1
         logger.warning(
             "replica %d declared dead (%s, %.1f expected heartbeats "
@@ -693,8 +759,17 @@ class FleetRouter:
         if not replica.managed:
             # A remote replica is not ours to restart: route around
             # it.  The DOWN slot revives when it answers the prober
-            # again or re-announces at /fleet/join.
+            # again or re-announces at /fleet/join.  If it announced
+            # a reachable journal segment (same-box remote), its warm
+            # sessions are adoptable exactly like a managed death —
+            # and the adoption's epoch bump is what fences the
+            # partitioned original when it heals.
             replica.status = DOWN
+            if replica.journal_dir:
+                threading.Thread(
+                    target=self._adopt_from, args=(replica,),
+                    name=f"pydcop-fleet-adopt-{replica.index}",
+                    daemon=True).start()
             return
         if not self.restart_dead:
             replica.status = DOWN
@@ -712,6 +787,26 @@ class FleetRouter:
             name=f"pydcop-fleet-restart-{replica.index}",
             daemon=True).start()
 
+    def _adopt_from(self, replica: Replica) -> None:
+        """Compact a dead replica's journal segment and ADOPT its
+        open sessions onto survivors (serving/migration.py).  Safe to
+        fail: whatever doesn't adopt stays in the segment for a
+        restart-in-place replay."""
+        try:
+            from pydcop_tpu.serving import migration as migration_mod
+
+            adopted = migration_mod.adopt_dead_sessions(self, replica)
+            if adopted:
+                with self._lock:
+                    self.adopted_sessions += adopted
+        except Exception:  # noqa: BLE001 — adoption is an
+            # optimization over restart-in-place, never a
+            # precondition for it.
+            logger.exception(
+                "replica %d: dead-session adoption failed; "
+                "falling back to restart-in-place replay",
+                replica.index)
+
     def _restart(self, replica: Replica) -> None:
         if self._stopping.is_set():
             replica.status = DOWN
@@ -725,22 +820,7 @@ class FleetRouter:
             # seconds; whatever fails to adopt stays in the segment
             # for the restart-in-place replay — strictly the old
             # behavior, never worse.
-            try:
-                from pydcop_tpu.serving import (
-                    migration as migration_mod)
-
-                adopted = migration_mod.adopt_dead_sessions(
-                    self, replica)
-                if adopted:
-                    with self._lock:
-                        self.adopted_sessions += adopted
-            except Exception:  # noqa: BLE001 — adoption is an
-                # optimization over restart-in-place, never a
-                # precondition for it.
-                logger.exception(
-                    "replica %d: dead-session adoption failed; "
-                    "falling back to restart-in-place replay",
-                    replica.index)
+            self._adopt_from(replica)
         try:
             # The journal handoff: --recover replays the dead
             # worker's acknowledged-but-unfinished requests and open
@@ -749,6 +829,10 @@ class FleetRouter:
             self._wait_ready(
                 replica,
                 time.monotonic() + self.worker_ready_timeout_s)
+            # The fresh process recovered a journal whose adopted
+            # sessions carry a MIGRATED close — but if that append
+            # raced the death, the fence table still knows.
+            self._flush_fences(replica)
         except Exception:  # noqa: BLE001
             logger.exception("replica %d restart failed",
                              replica.index)
@@ -835,18 +919,93 @@ class FleetRouter:
             if replica.status == UP:
                 replica.status = DOWN
 
+    def gray_threshold_ms(self) -> float:
+        """Probe EWMA above this marks a link gray: a healthy
+        in-box probe answers in single-digit milliseconds, so a
+        sustained large fraction of the probe timeout is a slow link,
+        not noise."""
+        return max(0.35 * self.probe_timeout_s * 1000.0, 120.0)
+
+    # -- epoch-fenced session ownership --------------------------------- #
+
+    def session_epoch(self, session_id: str) -> int:
+        with self._lock:
+            return self._session_epochs.get(session_id, 1)
+
+    def note_session(self, session_id: str) -> None:
+        """A session opened through the router: epoch authority
+        starts at 1 (what the worker journaled)."""
+        with self._lock:
+            self._session_epochs.setdefault(session_id, 1)
+            while len(self._session_epochs) > PIN_KEEP:
+                self._session_epochs.popitem(last=False)
+
+    def bump_epoch(self, session_id: str, floor: int = 0) -> int:
+        """Advance a session's ownership epoch — called by every
+        repoint (migration, dead-session adoption) BEFORE the new
+        owner takes traffic.  Monotonic for the session's lifetime:
+        the returned epoch is journaled by the new owner and carried
+        on every PATCH the router forwards.  ``floor`` lets a caller
+        that saw a higher epoch in a journal (adoption of a copy that
+        itself migrated in) keep the advance strictly past it."""
+        with self._lock:
+            epoch = max(self._session_epochs.get(session_id, 1) + 1,
+                        int(floor))
+            self._session_epochs[session_id] = epoch
+            self._session_epochs.move_to_end(session_id)
+            while len(self._session_epochs) > PIN_KEEP:
+                self._session_epochs.popitem(last=False)
+            return epoch
+
+    def record_fence(self, index: int, session_id: str,
+                     epoch: int) -> None:
+        """Remember that replica ``index`` holds a STALE copy of the
+        session as of ``epoch``: the moment that replica answers the
+        prober again (healed partition, revived slot) it gets fenced
+        before a client byte can reach the stale copy."""
+        with self._lock:
+            table = self._fences.setdefault(index, {})
+            table[session_id] = max(epoch,
+                                    table.get(session_id, 0))
+
+    def _flush_fences(self, replica: Replica) -> None:
+        with self._lock:
+            pending = self._fences.pop(replica.index, None)
+        if not pending:
+            return
+        for sid, epoch in pending.items():
+            try:
+                self._forward(
+                    replica, "POST", "/admin/fence_session",
+                    json.dumps({"session_id": sid,
+                                "epoch": epoch}).encode(),
+                    timeout=self.probe_timeout_s)
+                with self._lock:
+                    self.fenced_sessions += 1
+                logger.info("fenced stale session %s (epoch %d) on "
+                            "replica %d", sid, epoch, replica.index)
+            except OSError:
+                # It answered once, it will answer the prober again —
+                # re-arm so the next heal attempt retries the fence.
+                self.record_fence(replica.index, sid, epoch)
+
     # -- multi-host membership ------------------------------------------ #
 
     def register_remote(self, url: str,
-                        host_id: Optional[str] = None
+                        host_id: Optional[str] = None,
+                        journal_dir: Optional[str] = None
                         ) -> Dict[str, Any]:
         """Admit a remote replica that announced itself (``POST
         /fleet/join`` — a worker started with ``--join``).  The slot
         is probed before admission and then heartbeat-scored exactly
         like a local one; a re-announce of the same address revives
         its existing slot (same index → existing pins stay valid).
-        Raises ValueError for a bad address, RuntimeError when the
-        announced endpoint doesn't answer /healthz."""
+        ``journal_dir`` is the worker's own journal segment when the
+        router can reach it on disk (same-box remotes, the CI
+        topology): it makes the remote's sessions adoptable after a
+        death/partition verdict.  Raises ValueError for a bad
+        address, RuntimeError when the announced endpoint doesn't
+        answer /healthz."""
         from urllib.parse import urlparse
 
         parsed = urlparse(url if "//" in url else f"http://{url}")
@@ -870,6 +1029,8 @@ class FleetRouter:
                                   managed=False, host_id=host_id)
                 replica.port = int(port)
                 self.replicas.append(replica)
+            if journal_dir and os.path.isdir(journal_dir):
+                replica.journal_dir = journal_dir
         try:
             status, _ctype, _body = self._forward(
                 replica, "GET", "/healthz", None, timeout=5.0)
@@ -896,6 +1057,10 @@ class FleetRouter:
             if host_id:
                 replica.host_id = host_id
             replica.status = UP
+            replica.death_handled = False
+        # A re-announce is a heal: stale session copies recorded
+        # against this slot get fenced before it serves.
+        self._flush_fences(replica)
         self._up_gauge.set(self.up_count())
         logger.info("remote replica %d joined from %s (host %s)",
                     replica.index, replica.url, replica.host_id)
@@ -1105,30 +1270,16 @@ class FleetRouter:
                  body: Optional[bytes],
                  timeout: float = FORWARD_TIMEOUT_S
                  ) -> Tuple[int, str, bytes]:
-        conn = http.client.HTTPConnection(replica.host, replica.port,
-                                          timeout=timeout)
-        try:
-            # Connect SEPARATELY from the request: a refusal here
-            # proves zero request bytes were written, which is what
-            # licenses the submit-forward retry (ForwardNotSent).
-            # Failures past the connect are ambiguous and stay plain
-            # OSErrors.
-            try:
-                conn.connect()
-            except OSError as exc:
-                raise ForwardNotSent(str(exc)) from exc
-            headers = {}
-            if body is not None:
-                headers["Content-Type"] = "application/json"
-            conn.request(method, path, body=body, headers=headers)
-            resp = conn.getresponse()
-            payload = resp.read()
-            return (resp.status,
-                    resp.getheader("Content-Type",
-                                   "application/json"),
-                    payload)
-        finally:
-            conn.close()
+        # Every router->replica byte crosses the netfault seam: a
+        # connect refusal (or an injected drop/partition) surfaces as
+        # ForwardNotSent — zero bytes delivered, retry-safe — while
+        # anything past the connect stays a plain, ambiguous OSError
+        # (including an injected lost response).
+        return netfault.exchange(
+            "router",
+            (f"replica-{replica.index}", replica.host_id or ""),
+            replica.host, replica.port, method, path,
+            body=body, timeout=timeout)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -1146,10 +1297,14 @@ class FleetRouter:
                 "shed": self.shed,
                 "reroutes": self.reroutes,
                 "deaths": self.deaths,
+                "retries": self.retries,
+                "retry_budget_exceeded": self.retry_budget_exceeded,
+                "fenced_sessions": self.fenced_sessions,
                 "migrations": self.migrations,
                 "adopted_sessions": self.adopted_sessions,
                 "spill_slack": self.spill_slack,
                 "heartbeat_s": self.heartbeat_s,
+                "probe_timeout_s": self.probe_timeout_s,
                 "hosts": self.hosts,
                 "pinned_requests": len(self._pins),
                 "pinned_sessions": len(self._session_pins),
@@ -1176,16 +1331,50 @@ class FleetRouter:
                 aotcache.disk_stats(self.compile_cache_dir))
         return doc
 
+    def link_verdicts(self) -> List[Dict[str, Any]]:
+        """Per-link router->replica health verdicts: ``ok``, ``gray``
+        (slow-but-alive — answers arrive, late), ``starting``
+        (mid-(re)start/drain) or ``dead``.  Retired slots (scaled
+        away on purpose) don't count against the fleet."""
+        out = []
+        for r in self.replicas:
+            if r.retired:
+                continue
+            if r.status == UP:
+                verdict = "gray" if r.gray else "ok"
+            elif r.status in (STARTING, RESTARTING, DRAINING):
+                verdict = "starting"
+            else:
+                verdict = "dead"
+            out.append({
+                "replica": r.index, "host_id": r.host_id,
+                "status": r.status, "verdict": verdict,
+                "probe_ms": (round(r.probe_ewma_ms, 2)
+                             if r.probe_ewma_ms is not None
+                             else None),
+            })
+        return out
+
     def health_summary(self) -> Dict[str, Any]:
         """The fleet /healthz: failing (503) only when NOTHING can
-        serve; degraded while any replica is down/restarting."""
+        serve; degraded while any replica is down/restarting OR any
+        link's verdict is not ok (gray failure must not hide behind a
+        green fleet light)."""
         up = self.up_count()
+        links = self.link_verdicts()
+        degraded = (up < self.n_replicas
+                    or any(l["verdict"] != "ok" for l in links))
         status = ("failing" if up == 0
-                  else "degraded" if up < self.n_replicas else "ok")
-        return {"status": status, "fleet": {
+                  else "degraded" if degraded else "ok")
+        doc = {"status": status, "fleet": {
             "replicas": self.n_replicas, "up": up,
+            "links": links,
             "workers": [r.summary() for r in self.replicas],
         }}
+        injected = netfault.counters()
+        if injected:
+            doc["fleet"]["netfault_injected"] = injected
+        return doc
 
 
 class _RouterHandler(_Handler):
@@ -1271,8 +1460,9 @@ class _RouterHandler(_Handler):
             self._json(400, {"error": f"bad join body: {exc}"})
             return
         try:
-            out = self.router.register_remote(url,
-                                              doc.get("host_id"))
+            out = self.router.register_remote(
+                url, doc.get("host_id"),
+                journal_dir=doc.get("journal_dir"))
         except ValueError as exc:
             self._json(400, {"error": str(exc)})
             return
@@ -1386,6 +1576,16 @@ class _RouterHandler(_Handler):
         body["request_id"] = rid
         payload = json.dumps(body).encode()
         t0 = time.monotonic()
+        # The ambiguous-failure retry budget is the client's own
+        # remaining patience: a deadline_s in the body bounds it (a
+        # retry that lands after the client gave up helps nobody),
+        # else a modest default.
+        try:
+            deadline_s = float(body.get("deadline_s") or 0.0)
+        except (TypeError, ValueError):
+            deadline_s = 0.0
+        budget = t0 + (deadline_s if deadline_s > 0
+                       else DEFAULT_RETRY_BUDGET_S)
         tried: set = set()
         while True:
             try:
@@ -1408,57 +1608,83 @@ class _RouterHandler(_Handler):
             tried.add(replica.index)
             router.pin(rid, replica)
             try:
-                status, ctype, out = router._forward(
-                    replica, "POST", "/solve", payload)
+                result = self._forward_retrying(
+                    replica, payload, rid, budget)
             except ForwardNotSent:
-                # The connect was refused: zero bytes reached the
-                # worker, so nothing was acked — re-picking a healthy
-                # replica and resending the identical body (the id
-                # travels with it) is unconditionally safe.
+                # The connect was refused before ANY attempt reached
+                # the worker: zero bytes delivered, nothing acked —
+                # re-picking a healthy replica and resending the
+                # identical body (the id travels with it) is
+                # unconditionally safe.
                 router.mark_forward_error(replica)
                 with router._lock:
                     router.reroutes += 1
-                continue
-            except OSError as exc:
-                # Bytes MAY have reached a worker that journaled the
-                # request before dying mid-response.  Blind resend
-                # risks a duplicate solve under the same structure
-                # bin; instead the client gets the minted id — the
-                # pin survives the replica's restart, so
-                # /result/<id> either finds the journaled request's
-                # replayed result (it was acked) or 404s (it never
-                # landed; resubmitting is then safe).
-                router.mark_forward_error(replica)
-                self._json(503, {
-                    "error": f"replica {replica.index} failed mid-"
-                             f"forward ({exc}); outcome unknown — "
-                             f"poll the result url, resubmit on 404",
-                    "status": "unknown", "retry": True,
-                    "request_id": rid,
-                    "result_url": f"/result/{rid}"})
-                return
-            finally:
                 router.release(replica)
+                continue
+            router.release(replica)
+            if result is None:
+                return  # budget exhausted; 503 already sent
+            status, ctype, out = result
             router.record_latency((time.monotonic() - t0) * 1000.0)
             self._reply(status, out, ctype)
             return
+
+    def _forward_retrying(self, replica: Replica, payload: bytes,
+                          rid: str, budget: float
+                          ) -> Optional[Tuple[int, str, bytes]]:
+        """Forward one /solve to ONE replica, absorbing ambiguous
+        failures with jittered exponential backoff while the deadline
+        budget lasts.
+
+        Resending after bytes went out is safe ONLY here: the pinned
+        replica dedupes on the router-minted id (same table, and —
+        across a restart — the same journal segment), so N deliveries
+        execute once.  Another replica has a different journal;
+        re-picking after an ambiguous failure could double-execute,
+        which is why a first-attempt connect refusal (ForwardNotSent)
+        propagates to the caller's re-pick loop while everything
+        later retries HERE.  Returns the response tuple, or None
+        after answering the 503-outcome-unknown itself."""
+        router = self.router
+        attempt = 0
+        while True:
+            try:
+                return router._forward(replica, "POST", "/solve",
+                                       payload)
+            except OSError as exc:
+                if attempt == 0 and isinstance(exc, ForwardNotSent):
+                    raise
+                attempt += 1
+                backoff = min(0.05 * (2 ** attempt), 1.0)
+                backoff *= 0.5 + random.random() * 0.5
+                if time.monotonic() + backoff > budget:
+                    with router._lock:
+                        router.retry_budget_exceeded += 1
+                    router.mark_forward_error(replica)
+                    # The client gets the minted id — the pin
+                    # survives the replica's restart, so
+                    # /result/<id> either finds the journaled
+                    # request's replayed result (it was acked) or
+                    # 404s (it never landed; resubmitting is safe).
+                    self._json(503, {
+                        "error": f"replica {replica.index} failed "
+                                 f"mid-forward ({exc}); outcome "
+                                 "unknown — poll the result url, "
+                                 "resubmit on 404",
+                        "status": "unknown", "retry": True,
+                        "request_id": rid,
+                        "result_url": f"/result/{rid}"})
+                    return None
+                with router._lock:
+                    router.retries += 1
+                time.sleep(backoff)
 
     # -- result / stats / sessions -------------------------------------- #
 
     def do_GET(self):  # noqa: N802 — stdlib name
         path = self.path.split("?", 1)[0]
         if path.startswith("/result/"):
-            rid = path[len("/result/"):]
-            replica = self.router.pinned(rid)
-            if replica is None:
-                self._json(404, {"error": f"unknown request {rid!r}"})
-                return
-            if replica.status != UP:
-                self._json(503, {
-                    "error": f"replica {replica.index} recovering; "
-                             "retry", "retry": True})
-                return
-            self._proxy(replica, "GET", path, None, timeout=30.0)
+            self._route_result(path[len("/result/"):], path)
         elif path.startswith("/session/"):
             sid = path[len("/session/"):].split("/", 1)[0]
             replica = self.router.pinned(
@@ -1474,6 +1700,35 @@ class _RouterHandler(_Handler):
             self._fleet_stats()
         else:
             super().do_GET()
+
+    def _route_result(self, rid: str, path: str) -> None:
+        """Hedged /result read: the pin may point at a replica that
+        is mid-restart — its journal-recovered twin answers for every
+        COMPLETED record within a couple of heartbeats, so wait
+        briefly (re-reading the pin: adoption may repoint it
+        meanwhile) instead of bouncing every poll straight to 503."""
+        router = self.router
+        deadline = time.monotonic() + RESULT_HEDGE_S
+        while True:
+            replica = router.pinned(rid)
+            if replica is None:
+                self._json(404, {"error": f"unknown request {rid!r}"})
+                return
+            if replica.status == UP:
+                try:
+                    status, ctype, payload = router._forward(
+                        replica, "GET", path, None, timeout=30.0)
+                except OSError:
+                    status = None
+                if status is not None:
+                    self._reply(status, payload, ctype)
+                    return
+            if time.monotonic() >= deadline:
+                self._json(503, {
+                    "error": f"replica {replica.index} recovering; "
+                             "retry", "retry": True})
+                return
+            time.sleep(min(max(router.heartbeat_s, 0.05), 0.25))
 
     def _fleet_stats(self):
         """Router stats + a live per-worker /stats fetch: ONE surface
@@ -1549,6 +1804,7 @@ class _RouterHandler(_Handler):
                     # warm engine.
                     self.router.pin(sid, replica,
                                     self.router._session_pins)
+                    self.router.note_session(sid)
             except ValueError:
                 pass
         self._reply(status, out, ctype)
@@ -1572,8 +1828,30 @@ class _RouterHandler(_Handler):
         if raw is None:
             return
         replica = self._session_replica(path)
-        if replica is not None:
-            self._proxy(replica, "PATCH", path, raw)
+        if replica is None:
+            return
+        if replica.status != UP:
+            # Affinity-stranded: the warm state lives (or lived) on
+            # that replica; shed honestly instead of silently
+            # re-homing — adoption repoints the pin when it can.
+            self._json(503, {
+                "error": f"session owner (replica {replica.index}) "
+                         "is recovering; retry",
+                "status": "rejected", "retry": True})
+            return
+        sid = path[len("/session/"):].split("/", 1)[0]
+        try:
+            doc = json.loads(raw)
+            if isinstance(doc, dict):
+                # The ownership fence travels with every forwarded
+                # event batch: a replica holding a pre-repoint copy
+                # of the session rejects this epoch with a 409
+                # instead of double-applying.
+                doc["epoch"] = self.router.session_epoch(sid)
+                raw = json.dumps(doc).encode()
+        except ValueError:
+            pass  # the worker's validation answers malformed bodies
+        self._proxy(replica, "PATCH", path, raw)
 
     def do_DELETE(self):  # noqa: N802 — stdlib name
         path = self.path.split("?", 1)[0]
@@ -1597,11 +1875,11 @@ class _RouterHandler(_Handler):
         keepalives make that rare)."""
         read_timeout = max(self.router.heartbeat_s * 8, 3.0)
         try:
-            conn = http.client.HTTPConnection(
-                replica.host, replica.port,
-                timeout=FORWARD_TIMEOUT_S)
-            conn.request("GET", path)
-            resp = conn.getresponse()
+            conn, resp = netfault.open_stream(
+                "router",
+                (f"replica-{replica.index}", replica.host_id or ""),
+                replica.host, replica.port, "GET", path, None,
+                FORWARD_TIMEOUT_S)
         except OSError as exc:
             self._json(503, {"error": f"replica unreachable ({exc})"})
             return
@@ -1622,7 +1900,7 @@ class _RouterHandler(_Handler):
             while not self.telemetry._stopping.is_set():
                 try:
                     chunk = resp.read1(65536)
-                except socket.timeout:
+                except TimeoutError:  # socket.timeout is its alias
                     if replica.status != UP:
                         # The owner died under the stream: end it
                         # cleanly; the client reconnects through the

@@ -92,6 +92,13 @@ EXPIRED = "EXPIRED"
 REPLAYABLE = "REPLAYABLE"
 
 
+class _DuplicateDelivery(Exception):
+    """A caller-supplied request id was delivered again (duplicate
+    socket delivery, a router retry after a lost response): resolved
+    inside ``_submit`` by acknowledging the ORIGINAL — never an
+    error, never a second execution."""
+
+
 class WidthRejected(ValueError):
     """``algo="dpop"`` on a problem whose UTIL hypercubes bust
     ``ops/dpop.MAX_NODE_ELEMENTS`` even after CEC shrinkage.
@@ -313,6 +320,7 @@ class SolveService:
         self.speculative_hits = 0
         # prune="auto" submits resolved through the portfolio cache.
         self.portfolio_resolved = 0
+        self.deduped = 0
         # Exact-inference plane (ISSUE 17): dispatches completed via
         # DpopEngine, and the shared warm-key set that keeps repeat
         # same-signature solves attributed as warm in the jit ledger.
@@ -607,6 +615,25 @@ class SolveService:
 
     def _submit(self, dcop: DCOP, params, request_id, deadline_s,
                 t_submit: float, trace_id: str) -> str:
+        if request_id is not None:
+            # Submit is IDEMPOTENT on caller-supplied ids (the fleet
+            # router mints one per request and, after an ambiguous
+            # forward failure, retries against this same replica): a
+            # re-delivery — duplicate on the wire, a resend after the
+            # response was lost, even across a restart (the journal
+            # feeds _recovered_results; replay keeps original ids) —
+            # acknowledges the ORIGINAL instead of executing twice or
+            # rejecting.  Internally-minted ids (request_id=None)
+            # skip this: a fresh ``r<N>`` colliding with a recovered
+            # result would falsely swallow a brand-new request.
+            with self._lock:
+                known = (request_id in self._requests
+                         or request_id in self._recovered_results)
+                if known:
+                    self.deduped += 1
+            if known:
+                self._req_total.inc(status="deduped")
+                return request_id
         try:
             self.admission.admit(self._queue.qsize())
         except AdmissionRejected as rejection:
@@ -663,10 +690,20 @@ class SolveService:
             )
             with self._lock:
                 if req.id in self._requests:
+                    if request_id is not None:
+                        # Two deliveries raced past the early dedupe
+                        # check: the one that lost the insert race is
+                        # a duplicate, not an error.
+                        raise _DuplicateDelivery()
                     raise ValueError(
                         f"duplicate request id {req.id!r}")
                 self._requests[req.id] = req
                 self._prune_locked()
+        except _DuplicateDelivery:
+            with self._lock:
+                self.deduped += 1
+            self._req_total.inc(status="deduped")
+            return request_id
         except WidthRejected:
             # Its own ledger status: an over-wide exact request is a
             # capacity verdict about the problem, not a malformed
@@ -1883,6 +1920,7 @@ class SolveService:
             "dispatch_retries": self.dispatch_retries,
             "dpop_dispatches": self.dpop_dispatches,
             "portfolio_resolved": self.portfolio_resolved,
+            "deduped": self.deduped,
             # The closed-loop hot path's /stats faces (ISSUE 18):
             # pipelined launch/collect counters with the overlap
             # fraction, and the speculative compiler's ledger —

@@ -72,6 +72,12 @@ ERROR = "ERROR"
 REPLAYABLE = "REPLAYABLE"
 MIGRATING = "MIGRATING"
 MIGRATED = "MIGRATED"
+# Epoch fencing (partition tolerance): a replica that kept serving a
+# session through a partition while the router repointed ownership
+# elsewhere holds a STALE copy — when the partition heals, the copy
+# is FENCED (terminal here: every write 409s) instead of silently
+# double-applying events the new owner already owns.
+FENCED = "FENCED"
 
 # checkpoint_session sentinel: "compute the rebased problem yourself"
 # vs. an explicit rebased yaml (or None for a plain marker) the
@@ -254,6 +260,25 @@ class SessionClosed(Exception):
     """Events/close against a terminal session (409 on the wire)."""
 
 
+class StaleEpoch(SessionClosed):
+    """An event batch carried an ownership epoch that doesn't match
+    this replica's copy of the session (or the copy itself is
+    FENCED).  A structured 409 on the wire — the split-brain guard:
+    the client (or router) reconciles ownership instead of this
+    replica double-applying what the real owner already owns."""
+
+    def __init__(self, session_id: str, session_epoch: int,
+                 request_epoch: Optional[int]):
+        self.session_id = session_id
+        self.session_epoch = int(session_epoch)
+        self.request_epoch = (None if request_epoch is None
+                              else int(request_epoch))
+        super().__init__(
+            f"session {session_id} ownership epoch is "
+            f"{session_epoch}, request carried {request_epoch} — "
+            "stale owner fenced; reconcile via the router")
+
+
 @dataclass
 class SolveSession:
     """One stateful solve: a warm engine plus its bookkeeping.
@@ -268,6 +293,12 @@ class SolveSession:
     params: Dict[str, Any]
     engine: Any
     status: str = OPEN
+    # Ownership epoch: bumped by the fleet router on every repoint
+    # (migration, dead-replica adoption), journaled with the open
+    # record, checked against the epoch each forwarded event batch
+    # carries.  1 for sessions that never moved (and for every
+    # journal written before epochs existed).
+    epoch: int = 1
     seq: int = 0            # acknowledged (journaled) event batches
     applied_seq: int = 0    # batches actually applied to the engine
     events_applied: int = 0  # individual actions applied
@@ -396,7 +427,8 @@ class SessionManager:
 
     def open(self, dcop, params: Optional[Dict[str, Any]] = None,
              session_id: Optional[str] = None,
-             trace_id: Optional[str] = None) -> SolveSession:
+             trace_id: Optional[str] = None,
+             epoch: int = 1) -> SolveSession:
         """Open a session: build the dynamic engine (host-side, on
         the calling thread — malformed problems fail synchronously as
         400s), journal the open record, enqueue the first
@@ -437,6 +469,7 @@ class SessionManager:
             params=merged,
             engine=engine,
             budget=merged["max_cycles"],
+            epoch=max(int(epoch), 1),
         )
         with self._lock:
             # Limit check and insert under ONE lock hold: a
@@ -460,7 +493,7 @@ class SessionManager:
             try:
                 journal.append(journal_mod.session_open_record(
                     sess.id, yaml_src, merged,
-                    trace_id=sess.trace_id))
+                    trace_id=sess.trace_id, epoch=sess.epoch))
                 self.service._journal_records.inc(kind="session_open")
             except Exception as exc:
                 with self._lock:
@@ -479,16 +512,26 @@ class SessionManager:
 
     def apply_events(self, session_id: str,
                      events: List[Dict[str, Any]],
-                     wait: Optional[float] = None) -> Dict[str, Any]:
+                     wait: Optional[float] = None,
+                     epoch: Optional[int] = None) -> Dict[str, Any]:
         """Acknowledge one event batch: validate (400s raise here),
         journal it (the ack is durable), enqueue the apply.  With
         ``wait`` (seconds), block for the post-event segment and
         include its result.  The returned ``seq`` is the batch's
-        position in the session's event order."""
+        position in the session's event order.
+
+        ``epoch`` (set on every router-forwarded batch) is the
+        ownership fence: a mismatch against this replica's copy is a
+        structured 409 (:class:`StaleEpoch`) — never an apply.  A
+        direct client (no router, ``epoch=None``) skips the check."""
         sess = self._get(session_id)
+        if sess.status == FENCED:
+            raise StaleEpoch(session_id, sess.epoch, epoch)
         if sess.status != OPEN:
             raise SessionClosed(
                 f"session {session_id} is {sess.status}")
+        if epoch is not None and int(epoch) != sess.epoch:
+            raise StaleEpoch(session_id, sess.epoch, epoch)
         events = validate_events(events)
         batch_trace = uuid.uuid4().hex[:16]
         # seq assignment, journal append and enqueue are ONE atomic
@@ -502,14 +545,18 @@ class SessionManager:
         # thread can have taken a later seq meanwhile).
         with sess.order_lock:
             # Re-check under the SAME lock a migration export uses to
-            # freeze the session: a batch acked after the export
-            # drained would be journaled here but absent from the
-            # bundle — a lost acked event on the target.  Holding
-            # order_lock makes freeze-vs-ack atomic (409: the client
-            # retries against the new owner).
+            # freeze the session (and a fence uses to revoke it): a
+            # batch acked after the export drained would be journaled
+            # here but absent from the bundle — a lost acked event on
+            # the target.  Holding order_lock makes freeze-vs-ack
+            # atomic (409: the client retries against the new owner).
+            if sess.status == FENCED:
+                raise StaleEpoch(session_id, sess.epoch, epoch)
             if sess.status != OPEN:
                 raise SessionClosed(
                     f"session {session_id} is {sess.status}")
+            if epoch is not None and int(epoch) != sess.epoch:
+                raise StaleEpoch(session_id, sess.epoch, epoch)
             with self._lock:
                 sess.seq += 1
                 seq = sess.seq
@@ -664,6 +711,49 @@ class SessionManager:
         sess.done.set()
         return dict(sess.final)
 
+    def fence_session(self, session_id: str,
+                      epoch: int) -> Dict[str, Any]:
+        """Revoke this replica's copy of a session whose ownership
+        moved while the replica was partitioned/presumed dead:
+        terminal FENCED, journaled (this segment's ``--recover`` must
+        not resurrect the stale copy), checkpoint retired, SSE
+        subscribers get a terminal ``fenced`` event — they reconnect
+        through the router and land on the real owner.  Idempotent;
+        a fence carrying an epoch BELOW this copy's is itself stale
+        and rejected (:class:`StaleEpoch`)."""
+        sess = self._get(session_id)
+        epoch = int(epoch)
+        with sess.order_lock:
+            if sess.status == FENCED:
+                return dict(sess.final or {"session_id": sess.id,
+                                           "status": FENCED})
+            if epoch < sess.epoch:
+                raise StaleEpoch(session_id, sess.epoch, epoch)
+            if sess.status not in (OPEN, MIGRATING, REPLAYABLE):
+                # Terminal already: the copy can't serve writes, so
+                # there is nothing left to fence.
+                return {"session_id": sess.id,
+                        "status": sess.status}
+            sess.status = FENCED
+            # Record the epoch that outranked this copy: a later
+            # stale write gets told how far behind it is.
+            sess.epoch = max(sess.epoch, epoch)
+        sess.final = {
+            "session_id": sess.id,
+            "trace_id": sess.trace_id,
+            "status": FENCED,
+            "epoch": epoch,
+        }
+        self._sessions_total.inc(status="fenced")
+        self._journal_close(sess, FENCED)
+        self._retire_ckpt(sess)
+        self._refresh_gauge()
+        self._publish(sess, "fenced", {"epoch": epoch})
+        sess.done.set()
+        logger.info("session %s fenced at epoch %d (stale copy "
+                    "revoked)", sess.id, epoch)
+        return dict(sess.final)
+
     def status(self, session_id: str) -> Dict[str, Any]:
         sess = self._get(session_id)
         with self._lock:
@@ -671,6 +761,7 @@ class SessionManager:
                 "session_id": sess.id,
                 "trace_id": sess.trace_id,
                 "status": sess.status,
+                "epoch": sess.epoch,
                 "seq": sess.seq,
                 "applied_seq": sess.applied_seq,
                 "events_applied": sess.events_applied,
@@ -1103,6 +1194,7 @@ class SessionManager:
                         else list(sess.event_log)),
                 npz_bytes=npz_bytes,
                 ckpt_seq=ckpt_seq,
+                epoch=sess.epoch,
             )
             self._publish(sess, "migrating")
         except Exception as exc:  # noqa: BLE001
@@ -1401,6 +1493,7 @@ class SessionManager:
             engine=engine,
             budget=params["max_cycles"],
             replayed=True,
+            epoch=max(int(open_rec.get("epoch") or 1), 1),
         )
         ckpt_seq = (ckpt_rec or {}).get("seq", -1)
         pre = [r for r in event_recs

@@ -1085,6 +1085,98 @@ class TestBenchSentinel:
         assert doc["series"]["cpu"]["values"] == self.STEADY
 
 
+def _write_serving_history(path, rounds):
+    """Rounds with the closed-loop serving families alongside the
+    compute headline — the population the host-shift guard pools.
+    Each round is a dict of parsed keys; ``value``/``backend`` are
+    filled in when absent."""
+    for i, parsed in enumerate(rounds, 1):
+        doc = {"value": 890.0, "backend": "cpu", **parsed}
+        with open(os.path.join(path, f"BENCH_r{i:02d}.json"),
+                  "w", encoding="utf-8") as f:
+            json.dump({"n": i, "parsed": doc}, f)
+
+
+class TestHostShiftGuard:
+    """Common-mode rejection for host-scheduler-bound serving legs
+    (ISSUE 19): a drop shared by the whole host-bound population —
+    including the envelope-off control arm — is a host-class change
+    and must not gate, while an isolated family drop (which cannot
+    move the population median) must still fail the sentinel."""
+
+    STEADY = {
+        "serve_problems_per_sec": 120.0,
+        "serve_mixed_problems_per_sec": 270.0,
+        "serve_mixed_baseline_problems_per_sec": 210.0,
+        "fleet_elastic_problems_per_sec": 8.0,
+    }
+
+    def _history(self, newest):
+        return [dict(self.STEADY) for _ in range(6)] + [newest]
+
+    def test_common_mode_drop_held_not_gated(self, tmp_path):
+        """Every host-bound series (and the control arm) at 55% of
+        its median: a host shift — reported, estimator recorded, but
+        ``failed`` stays False."""
+        newest = {k: 0.55 * v for k, v in self.STEADY.items()}
+        _write_serving_history(str(tmp_path), self._history(newest))
+        report = bench_sentinel.run_check(str(tmp_path))
+        assert report["failed"] is False
+        guard = report["host_shift"]
+        assert guard["fired"] is True
+        assert guard["estimator"] == pytest.approx(0.55, abs=0.01)
+        assert (report["series"]["serve_mixed:cpu"]["verdict"]
+                == "host-shift")
+        assert (report["series"]["serve_mixed:cpu"]["gating"]
+                is False)
+        assert any("host-shift guard" in line
+                   for line in report["lines"])
+        # The compute headline was steady and still judges normally.
+        assert report["series"]["cpu"]["verdict"] == "ok"
+        assert bench_sentinel.main(["--root", str(tmp_path)]) == 0
+
+    def test_isolated_drop_still_gates(self, tmp_path):
+        """Only serve_mixed collapses; the rest of the population
+        (control arm included) is steady, so the median ratio stays
+        ~1 and the regression gates exactly as before the guard."""
+        newest = dict(self.STEADY)
+        newest["serve_mixed_problems_per_sec"] = (
+            0.55 * self.STEADY["serve_mixed_problems_per_sec"])
+        _write_serving_history(str(tmp_path), self._history(newest))
+        report = bench_sentinel.run_check(str(tmp_path))
+        assert report["failed"] is True
+        assert report["host_shift"]["fired"] is False
+        assert (report["series"]["serve_mixed:cpu"]["verdict"]
+                == "regressed")
+        assert bench_sentinel.main(["--root", str(tmp_path)]) == 1
+
+    def test_compute_regression_gates_through_host_shift(
+            self, tmp_path):
+        """A genuine compute regression coinciding with a host shift
+        still fails: the headline family is not host-bound, so the
+        guard never holds it."""
+        newest = {k: 0.55 * v for k, v in self.STEADY.items()}
+        newest["value"] = 0.6 * 890.0
+        _write_serving_history(str(tmp_path), self._history(newest))
+        report = bench_sentinel.run_check(str(tmp_path))
+        assert report["failed"] is True
+        assert report["host_shift"]["fired"] is True
+        assert report["series"]["cpu"]["verdict"] == "regressed"
+
+    def test_control_arm_alone_never_fails(self, tmp_path):
+        """The control arm regressing by itself is host evidence, not
+        a PR regression — too few host-bound series for the guard to
+        conclude anything, and the control family never gates."""
+        rounds = [{"serve_mixed_baseline_problems_per_sec": 210.0}
+                  for _ in range(6)]
+        rounds.append({"serve_mixed_baseline_problems_per_sec": 80.0})
+        _write_serving_history(str(tmp_path), rounds)
+        report = bench_sentinel.run_check(str(tmp_path))
+        assert report["failed"] is False
+        assert (report["series"]["serve_mixed_baseline:cpu"]["gating"]
+                is False)
+
+
 # ------------------------------------------------------------------ #
 # bench probe observability satellites
 

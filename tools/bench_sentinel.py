@@ -20,6 +20,24 @@ the relative term guards stable series (MAD ~ 0 would otherwise flag
 every wiggle), the MAD term widens tolerance on genuinely noisy
 series (shared-CPU benchmark hosts jitter ±15% run to run).
 
+Host-shift guard (ISSUE 19): the closed-loop serving legs are bound
+by the host's thread scheduler, not device compute — the same code
+measures 2x slower when a shared box degrades, even at the same core
+count (so the ``cpu@<n>`` class key cannot see it).  The guard
+detects that from the data: every HOST-BOUND family's newest/median
+speed ratio is pooled (including the envelope-off control arm
+``serve_mixed_baseline``, the same workload every round), and when
+the MEDIAN ratio itself falls beyond the relative tolerance the drop
+is common-mode — a host-class change, not a code regression (one
+code change does not slow serve, fleet, sessions, cold-start AND the
+feature-off control arm in unison).  Host-bound regressions in such
+a round are reported loudly but do not gate; compute-bound families
+(headline, sharded, dpop, time-to-cost) always gate, and an isolated
+single-family drop still fails because it cannot move the median.
+The blind spot (a stack-wide code slowdown coinciding with the
+round) self-heals: the trailing window re-medians over the following
+same-class rounds and a persistent regression resurfaces.
+
 Usage::
 
     python tools/bench_sentinel.py             # report + exit 1 on
@@ -48,6 +66,10 @@ DEFAULT_REL_TOL = 0.15
 DEFAULT_MAD_MULT = 3.0
 DEFAULT_WINDOW = 5
 MIN_POINTS = 3  # newest + at least 2 history points to call anything
+# Host-shift guard: the common-mode estimator needs at least this many
+# host-bound series with judgeable history before it may conclude
+# anything — two ratios have no meaningful median.
+HOST_SHIFT_MIN_SERIES = 3
 
 _SPARKS = "▁▂▃▄▅▆▇█"
 
@@ -147,6 +169,13 @@ def load_history(root: str) -> List[Dict[str, Any]]:
             # when the leg failed that round.
             "serve_mixed_value": _opt_float(
                 parsed.get("serve_mixed_problems_per_sec")),
+            # Envelope-OFF control arm of the same mixed leg: the one
+            # series whose workload and code path barely change round
+            # to round, so its drift measures the HOST, not the PR.
+            # Never gates on its own — it anchors the host-shift
+            # guard's common-mode estimator (ISSUE 19).
+            "serve_mixed_baseline_value": _opt_float(
+                parsed.get("serve_mixed_baseline_problems_per_sec")),
             # Pipelined-flush overlap (ISSUE 18 bench_serving_mixed):
             # measured-window fraction of device execute wall the
             # scheduler hid decode work under — HIGHER is better, a
@@ -186,6 +215,15 @@ def load_history(root: str) -> List[Dict[str, Any]]:
             # None when the leg failed that round.
             "fleet_elastic_value": _opt_float(
                 parsed.get("fleet_elastic_problems_per_sec")),
+            # Partition-tolerant fleet leg (ISSUE 19
+            # bench_serving_fleet_faulted): closed-loop problems/sec
+            # through a 2-replica fleet under a seeded 1%-drop /
+            # 20ms-delay plan on the solve links.  Its OWN family —
+            # a faulted round must never be judged against (or
+            # pollute the baseline of) the clean fleet numbers.
+            # Absent before PR 19, None when the leg failed.
+            "fleet_faulted_value": _opt_float(
+                parsed.get("fleet_faulted_problems_per_sec")),
             # The p99 latency exemplar from the serving leg (ISSUE
             # 9): when the newest run regresses, the report points at
             # a concrete request trace instead of a bare number.
@@ -308,68 +346,99 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
     # family.
     metrics = (
         # (family, value field, unit, fallback backend key, higher is
-        # better, bench.py leg name in ``leg_backends``)
-        ("bench", "value", "cycles/s", "backend", True, "headline"),
+        # better, bench.py leg name in ``leg_backends``, host-bound).
+        # ``host_bound=True`` marks closed-loop serving legs whose
+        # rate is dominated by the host's thread scheduler rather
+        # than device compute — the population the host-shift guard
+        # pools its common-mode estimator over (ISSUE 19).  Compute
+        # families stay False and always gate.
+        ("bench", "value", "cycles/s", "backend", True, "headline",
+         False),
         ("serve", "serve_value", "problems/s", "backend", True,
-         "serve"),
+         "serve", True),
         # ISSUE 11: throughput on zipf-diverse structures through the
         # envelope batching tier — the traffic shape on which pure
         # structure binning degenerates to batch-size-1.
         ("serve_mixed", "serve_mixed_value", "problems/s",
-         "backend", True, "serve_mixed"),
+         "backend", True, "serve_mixed", True),
+        # ISSUE 19: the envelope-OFF control arm of the same leg.
+        # Same workload every round, so its drift measures the host;
+        # it feeds the host-shift estimator and NEVER gates (see
+        # CONTROL_FAMILIES below).
+        ("serve_mixed_baseline", "serve_mixed_baseline_value",
+         "problems/s", "backend", True, "serve_mixed", True),
         # ISSUE 18: decode/dispatch overlap fraction of the pipelined
         # scheduler on the same mixed leg — a brand-new family: until
         # 3 rounds exist its verdict is "insufficient", never a crash
-        # or gate.
+        # or gate.  A fraction, so host-speed cancels: not host-bound.
         ("serve_overlap", "serve_overlap_value", "fraction",
-         "backend", True, "serve_mixed"),
+         "backend", True, "serve_mixed", False),
         ("sharded", "sharded_value", "cycles/s",
-         "sharded_backend", True, "sharded"),
+         "sharded_backend", True, "sharded", False),
         # ISSUE 10: wall-clock to the reference cost on the
         # large-domain loopy graph (bench_time_to_cost) — the
         # work-reduction stack's headline, LOWER is better.
         ("time_to_cost", "ttc_value", "ms", "backend", False,
-         "time_to_cost"),
+         "time_to_cost", False),
         ("serve_recovery", "serve_recovery_value", "s",
-         "backend", False, "serve_recovery"),
+         "backend", False, "serve_recovery", True),
         # ISSUE 15: the fleet-scale serving families — aggregate
         # replicas=2 throughput through the structure-affinity
         # router (higher is better) and a fresh worker's warm-cache
         # time-to-first-result (the persistent AOT compile cache's
         # reason to exist; lower is better).
         ("serving_fleet", "fleet_value", "problems/s",
-         "backend", True, "serving_fleet"),
+         "backend", True, "serving_fleet", True),
         ("serve_cold_start", "cold_start_value", "s",
-         "backend", False, "serve_cold_start"),
+         "backend", False, "serve_cold_start", True),
         # ISSUE 16: steady-state throughput through the elastic
         # two-host fleet — the rate the migration/autoscale/host-kill
         # machinery must not tax.  A brand-new family: until 3 rounds
         # exist its verdict is "insufficient", never a crash or gate.
         ("fleet_elastic", "fleet_elastic_value", "problems/s",
-         "backend", True, "fleet_elastic"),
+         "backend", True, "fleet_elastic", True),
+        # ISSUE 19: throughput through the same fleet under the
+        # seeded drop+delay plan — the injected-fault leg is judged
+        # as its own family so the retry tax is tracked against
+        # faulted rounds only, never against the clean fleet
+        # baseline.  A brand-new family: until 3 rounds exist its
+        # verdict is "insufficient", never a crash or gate.
+        ("fleet_faulted", "fleet_faulted_value", "problems/s",
+         "backend", True, "fleet_faulted", True),
         ("shard_recovery", "shard_recovery_value", "s",
-         "sharded_backend", False, "sharded"),
+         "sharded_backend", False, "sharded", False),
         # ISSUE 17: warm wall-clock of one exact DPOP sweep on the
         # width-bounded seeded instance (ms, LOWER is better) — a
         # brand-new family: until 3 rounds exist its verdict is
         # "insufficient", never a crash or gate.
         ("dpop_exact", "dpop_value", "ms", "backend", False,
-         "dpop_exact"),
+         "dpop_exact", False),
         # ISSUE 13: the stateful-session families — sustained
         # scenario-event throughput per session (higher is better)
         # and warm time-to-recovered-cost after an event (the
         # session plane's reason to exist: it must stay far below a
         # cold re-solve; lower is better).
         ("session_events", "session_eps_value", "events/s",
-         "backend", True, "sessions"),
+         "backend", True, "sessions", True),
         ("session_recovery", "session_ttr_value", "ms",
-         "backend", False, "sessions"),
+         "backend", False, "sessions", True),
     )
+    # Families that only anchor the host-shift estimator: their
+    # regressions never set ``failed`` even when the guard does not
+    # fire — the control arm exists to measure the host, not the PR.
+    control_families = {"serve_mixed_baseline"}
     series = {}
     lines = []
     failed = False
+    # Host-shift guard state: every host-bound GATING series with a
+    # judgeable baseline contributes its speed ratio (newest/median
+    # for rates, median/newest for latencies — >1 means the host got
+    # faster either way); regressions in that population are held
+    # here until the common-mode estimator decides whether they gate.
+    host_ratios: Dict[str, float] = {}
+    host_pending: List[Dict[str, Any]] = []
     for (family, field, unit, backend_key, higher_better,
-         leg) in metrics:
+         leg, host_bound) in metrics:
         # Rates print whole, latencies and fractions keep precision.
         fmt = (".3f" if (not higher_better or unit == "fraction")
                else ".0f")
@@ -460,6 +529,7 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
             stale = (newest_backend is not None
                      and backend != newest_backend)
             result["gating"] = not stale
+            line_idx = len(lines)
             lines.append(
                 f"{family}[{backend}] {spark} "
                 f"{values[0]:{fmt}}→{values[-1]:{fmt}} {unit}, newest "
@@ -467,8 +537,23 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
                 f"({bound_name} {result['bound']:{fmt}}) {verdict}"
                 + (" (stale backend — not gating)" if stale else "")
             )
+            if host_bound and not stale and result["median"]:
+                newest_v = result["newest"]
+                if higher_better:
+                    host_ratios[label] = newest_v / result["median"]
+                elif newest_v:
+                    host_ratios[label] = result["median"] / newest_v
             if result["verdict"] == "regressed" and not stale:
-                failed = True
+                if family in control_families:
+                    # The control arm's own drop IS the host signal —
+                    # it feeds the estimator above, never ``failed``.
+                    result["gating"] = False
+                elif host_bound:
+                    host_pending.append({"label": label,
+                                         "result": result,
+                                         "line": line_idx})
+                else:
+                    failed = True
                 # The exemplar is the SERVING leg's p99 latency
                 # trace_id — only the serve-latency family may point
                 # at it (a compile or shard regression has nothing to
@@ -481,12 +566,44 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
                         f"  ↳ exemplar trace {exemplar} — open it: "
                         f"pydcop trace query --request {exemplar} "
                         f"<trace file>")
+    # Host-shift guard: with enough host-bound series to pool, a
+    # common-mode drop (the MEDIAN ratio itself beyond the relative
+    # tolerance) means the bench host changed class — the same
+    # refusal ``cpu@<n>`` keying applies to core-count changes,
+    # detected from the data instead of nproc.  Held host-bound
+    # regressions then report as ``host-shift`` without gating; with
+    # no shift (an isolated drop cannot move the median) they gate
+    # exactly as before.
+    estimator = (statistics.median(host_ratios.values())
+                 if len(host_ratios) >= HOST_SHIFT_MIN_SERIES
+                 else None)
+    shift = estimator is not None and estimator < 1.0 - rel_tol
+    host_shift = {"fired": shift, "estimator": estimator,
+                  "threshold": 1.0 - rel_tol, "ratios": host_ratios}
+    if host_pending and shift:
+        for pend in host_pending:
+            pend["result"]["verdict"] = "host-shift"
+            pend["result"]["gating"] = False
+            lines[pend["line"]] = (
+                lines[pend["line"]].replace(
+                    " REGRESSED",
+                    " REGRESSED (host-shift — not gating)"))
+        held = ", ".join(p["label"] for p in host_pending)
+        lines.append(
+            f"host-shift guard: median speed ratio "
+            f"{estimator:.2f} across {len(host_ratios)} host-bound "
+            f"series (incl. the envelope-off control arm) is below "
+            f"{1.0 - rel_tol:.2f} — the bench host changed class, "
+            f"not the code; held from gating: {held}")
+    elif host_pending:
+        failed = True
     return {
         "root": root,
         "runs": len(runs),
         "skipped": [r["source"] for r in skipped],
         "series": series,
         "lines": lines,
+        "host_shift": host_shift,
         "failed": failed,
     }
 

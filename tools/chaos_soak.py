@@ -1054,6 +1054,245 @@ def scenario_host_kill(seed, trace):
             "session_events": st["applied_seq"]}
 
 
+def scenario_fleet_partition_heal(seed, trace):
+    """ISSUE 19 split-brain: a remote-joined replica owning a warm
+    session is PARTITIONED (netfault blackhole) mid-PATCH-burst, the
+    router declares it dead and ADOPTS the session onto a survivor
+    (epoch bump), the partition heals — and the healed original is
+    FENCED at the revival probe: its stale copy rejects direct writes
+    with a structured 409, the surviving copy holds every acked
+    batch, and the final cost equals the uninterrupted run (hard
+    equality, path topology)."""
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving import netfault
+
+    dcop, params, batches, expected = _session_chaos_problem(seed)
+    journal_dir = tempfile.mkdtemp(prefix="soak_fpart_")
+    remote_journal = tempfile.mkdtemp(prefix="soak_fpart_remote_")
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.05,
+                       journal_dir=journal_dir, heartbeat_s=0.15)
+    remote = api.serve(port=0, batch_window_s=0.05,
+                       journal_dir=remote_journal)
+    try:
+        url = handle.url
+        router = handle.router
+        status, body = _fleet_request(
+            url + "/session", "POST",
+            {"dcop": dcop_yaml(dcop), "params": params})
+        assert status == 201, f"open failed: {status} {body}"
+        sid = body["session_id"]
+        _patch_until_acked(url, sid, batches[0])
+
+        remote_idx = router.register_remote(
+            remote.url, host_id="hostB",
+            journal_dir=remote_journal)["index"]
+        status, out = _fleet_request(
+            url + "/admin/migrate", "POST",
+            {"session_id": sid, "target": remote_idx}, timeout=120)
+        assert status == 200, f"migrate to remote failed: " \
+                              f"{status} {out}"
+        assert router.session_epoch(sid) == 2
+        _patch_until_acked(url, sid, batches[1])
+        _code, st = _fleet_request(remote.url + f"/session/{sid}")
+        assert st.get("epoch") == 2, \
+            f"migrated-in copy lost its epoch: {st}"
+
+        # Sever router->remote.  The prober's verdict fires adoption
+        # (the remote announced a reachable journal segment); PATCH
+        # traffic sheds 503-with-retry until the pin repoints.
+        netfault.install("link=*>hostB,blackhole=1,hold_s=0.05")
+        _patch_until_acked(url, sid, batches[2], deadline_s=120)
+        _patch_until_acked(url, sid, batches[3], deadline_s=120)
+        survivor = router.pinned(sid, router._session_pins)
+        assert survivor.index != remote_idx, \
+            "session was not adopted off the partitioned replica"
+        assert router.session_epoch(sid) >= 3
+        injected = netfault.counters()
+        assert injected.get("blackhole", 0) > 0, injected
+
+        # Heal.  The revival probe must fence the stale copy BEFORE
+        # any client byte can reach it.
+        netfault.clear()
+        deadline = time.monotonic() + 60
+        fenced_st = {}
+        while time.monotonic() < deadline:
+            _code, fenced_st = _fleet_request(
+                remote.url + f"/session/{sid}")
+            if fenced_st.get("status") == "FENCED":
+                break
+            time.sleep(0.1)
+        assert fenced_st.get("status") == "FENCED", \
+            f"healed replica was not fenced: {fenced_st}"
+
+        # Direct stale write to the healed original: structured 409.
+        status, out = _fleet_request(
+            remote.url + f"/session/{sid}/events", "PATCH",
+            {"events": batches[4], "epoch": 2})
+        assert status == 409 and out.get("stale_epoch") is True, \
+            f"stale write not fenced: {status} {out}"
+        assert out.get("session_epoch", 0) >= 2, out
+
+        # The router-facing session keeps serving: last batch lands
+        # on the survivor, nothing acked was lost or double-applied.
+        _patch_until_acked(url, sid, batches[4])
+        _code, st = _fleet_request(url + f"/session/{sid}")
+        assert st.get("seq") == len(batches) \
+            and st.get("applied_seq") == len(batches), \
+            f"acked events lost/doubled across the partition: {st}"
+        status, final = _fleet_request(url + f"/session/{sid}",
+                                       "DELETE")
+        assert status == 200, f"close failed: {status} {final}"
+        assert final["cost"] == expected, \
+            f"post-partition cost {final['cost']} != " \
+            f"uninterrupted {expected}"
+        stats = router.stats()
+        assert stats["adopted_sessions"] >= 1, stats
+    finally:
+        netfault.clear()
+        handle.stop()
+        remote.stop()
+        shutil.rmtree(journal_dir, ignore_errors=True)
+        shutil.rmtree(remote_journal, ignore_errors=True)
+    return {"final_cost": expected,
+            "epoch": router.session_epoch(sid),
+            "injected": injected}
+
+
+def scenario_fleet_gray_failure(seed, trace):
+    """ISSUE 19 gray failure: a replica whose link turns SLOW (500 ms
+    injected delay, under the probe timeout) must be reported as a
+    degraded/gray link on /healthz — and must NOT be declared dead
+    (latency-aware probe scoring beats binary liveness).  Clearing
+    the fault returns the fleet to ok."""
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving import netfault
+
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.05,
+                       heartbeat_s=0.2)
+    try:
+        url = handle.url
+        router = handle.router
+        deaths0 = router.stats()["deaths"]
+        netfault.install("link=router>replica-1,delay_ms=500")
+        gray = {}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            _code, hz = _fleet_request(url + "/healthz", timeout=10)
+            links = (hz.get("fleet") or {}).get("links") or []
+            gray = next((l for l in links
+                         if l.get("verdict") == "gray"), {})
+            if hz.get("status") == "degraded" and gray:
+                break
+            time.sleep(0.1)
+        assert gray, f"slow link never went gray: {hz}"
+        assert gray["replica"] == 1, gray
+        assert hz.get("status") == "degraded", hz
+        assert (hz["fleet"].get("netfault_injected") or {}) \
+            .get("delay", 0) > 0, hz
+        assert router.stats()["deaths"] == deaths0, \
+            "gray (slow-but-alive) replica was falsely killed"
+
+        # Slow is not dead: a solve routed to the gray replica still
+        # completes (the injected delay rides the forward too).
+        inst = _serve_instance(8, seed)
+        status, body = _fleet_request(
+            url + "/solve", "POST",
+            {"dcop": dcop_yaml(inst), "params": {"max_cycles": 80}})
+        assert status == 202, f"solve under gray: {status} {body}"
+        deadline = time.monotonic() + 60
+        code, out = 0, {}
+        while time.monotonic() < deadline:
+            code, out = _fleet_request(
+                url + f"/result/{body['id']}", timeout=10)
+            if code == 200:
+                break
+            time.sleep(0.1)
+        assert code == 200 and out["status"] == "FINISHED", \
+            f"solve lost under gray link: {code} {out}"
+
+        netfault.clear()
+        deadline = time.monotonic() + 30
+        hz = {}
+        while time.monotonic() < deadline:
+            _code, hz = _fleet_request(url + "/healthz", timeout=10)
+            if hz.get("status") == "ok":
+                break
+            time.sleep(0.1)
+        assert hz.get("status") == "ok", \
+            f"fleet never recovered from gray: {hz}"
+        assert router.stats()["deaths"] == deaths0
+    finally:
+        netfault.clear()
+        handle.stop()
+    return {"gray_probe_ms": gray.get("probe_ms"),
+            "deaths": deaths0}
+
+
+def scenario_fleet_retry_idempotent(seed, trace):
+    """ISSUE 19 ambiguous-failure retry: the response to a forwarded
+    /solve is LOST after the worker executed it (netfault
+    lose_response).  The router's deadline-bounded retry redelivers
+    to the SAME pinned replica; the worker dedupes on the
+    router-minted id — the client sees one 202 and one result,
+    exactly one execution, retries within the deadline budget."""
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving import netfault
+
+    journal_dir = tempfile.mkdtemp(prefix="soak_fretry_")
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.05,
+                       journal_dir=journal_dir, heartbeat_s=0.15)
+    try:
+        url = handle.url
+        router = handle.router
+        netfault.install(
+            f"seed={seed};link=router>replica-*,path=/solve,"
+            "lose_response=1.0,times=1")
+        inst = _serve_instance(10, seed)
+        t0 = time.monotonic()
+        status, body = _fleet_request(
+            url + "/solve", "POST",
+            {"dcop": dcop_yaml(inst),
+             "params": {"max_cycles": 120}, "deadline_s": 30.0})
+        elapsed = time.monotonic() - t0
+        assert status == 202, \
+            f"solve not retried through lost response: " \
+            f"{status} {body}"
+        assert elapsed < 30.0, \
+            f"retry blew the deadline budget: {elapsed:.1f}s"
+        injected = netfault.counters()
+        assert injected.get("lose_response", 0) == 1, injected
+
+        deadline = time.monotonic() + 60
+        code, out = 0, {}
+        while time.monotonic() < deadline:
+            code, out = _fleet_request(
+                url + f"/result/{body['id']}", timeout=10)
+            if code == 200:
+                break
+            time.sleep(0.1)
+        assert code == 200 and out["status"] == "FINISHED", \
+            f"result lost: {code} {out}"
+
+        assert router.stats()["retries"] >= 1, router.stats()
+        # Exactly one execution: the redelivery hit the worker's
+        # dedupe table, not the solve queue.
+        replica = router.pinned(body["id"])
+        _code, wstats = _fleet_request(
+            f"http://{replica.host}:{replica.port}/stats",
+            timeout=10)
+        assert wstats.get("deduped", 0) >= 1, wstats
+    finally:
+        netfault.clear()
+        handle.stop()
+        shutil.rmtree(journal_dir, ignore_errors=True)
+    return {"retries": router.stats()["retries"],
+            "deduped": wstats.get("deduped"),
+            "elapsed_s": round(elapsed, 2)}
+
+
 def scenario_anomaly_postmortem(seed, trace):
     """ISSUE 9 anomaly path: an injected guard trip, with file
     tracing OFF and only the always-on flight recorder attached,
@@ -1124,6 +1363,9 @@ SCENARIOS = [
     ("replica_kill", scenario_replica_kill),
     ("session_migrate", scenario_session_migrate),
     ("host_kill", scenario_host_kill),
+    ("fleet_partition_heal", scenario_fleet_partition_heal),
+    ("fleet_gray_failure", scenario_fleet_gray_failure),
+    ("fleet_retry_idempotent", scenario_fleet_retry_idempotent),
     ("shard_trip_repartition", scenario_shard_trip_repartition),
     ("anomaly_postmortem", scenario_anomaly_postmortem),
     ("decimation_guard_trip", scenario_decimation_guard_trip),
@@ -1147,6 +1389,9 @@ QUICK_GATE = [
     "replica_kill",
     "session_migrate",
     "host_kill",
+    "fleet_partition_heal",
+    "fleet_gray_failure",
+    "fleet_retry_idempotent",
     "shard_trip_repartition",
     "anomaly_postmortem",
     "decimation_guard_trip",

@@ -5,6 +5,13 @@ module of the package under a scrubbed CPU backend — catching syntax
 errors, missing imports, and module-level typos across the whole tree
 in one pass.
 
+Also a fault-injection seam lint (ISSUE 19): every socket-touching
+call in ``pydcop_tpu/serving/`` must route through
+``serving/netfault.py`` — raw ``http.client``/``urllib``/``socket``
+use in the serve plane would silently bypass the injectable link
+faults the chaos gate relies on, making partition scenarios prove
+nothing about the code path production runs.
+
 Run:  python tools/static_check.py      (exit 0 = clean)
 """
 
@@ -15,6 +22,44 @@ import pkgutil
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Tokens that open sockets directly.  serving/netfault.py is the one
+# allowed user (it IS the seam); serving/http.py and telemetry.py are
+# SERVER-side (socketserver binds, no outbound links to fault), so
+# only outbound-client tokens are banned there.
+_SOCKET_TOKENS = (
+    "http.client",
+    "HTTPConnection(",
+    "urllib.request",
+    "urlopen(",
+    "socket.create_connection",
+)
+_SEAM_ALLOWLIST = ("netfault.py",)
+
+
+def check_netfault_seam() -> int:
+    serving = os.path.join(REPO, "pydcop_tpu", "serving")
+    bad = []
+    for fname in sorted(os.listdir(serving)):
+        if not fname.endswith(".py") or fname in _SEAM_ALLOWLIST:
+            continue
+        path = os.path.join(serving, fname)
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                code = line.split("#", 1)[0]
+                for tok in _SOCKET_TOKENS:
+                    if tok in code:
+                        bad.append((fname, lineno, tok,
+                                    line.strip()))
+    if bad:
+        print("static_check: raw socket I/O in the serve plane must "
+              "route through serving/netfault.py (the fault-"
+              "injection seam):")
+        for fname, lineno, tok, line in bad:
+            print(f"  pydcop_tpu/serving/{fname}:{lineno}: "
+                  f"{tok!r} in: {line}")
+        return 1
+    return 0
 
 
 def main() -> int:
@@ -28,6 +73,9 @@ def main() -> int:
         os.path.join(REPO, "tests"), quiet=1, force=True)
     if not ok:
         print("static_check: byte-compilation failed")
+        return 1
+
+    if check_netfault_seam():
         return 1
 
     import pydcop_tpu
