@@ -1318,6 +1318,28 @@ def bench_serving_fleet():
     if rr and r2:
         out["fleet_rr_problems_per_sec"] = rr["pps"]
         out["fleet_affinity_gain"] = round(r2["pps"] / rr["pps"], 3)
+    # Fleet-trace side-channel (ISSUE 20): the SAME r2 leg with the
+    # trace plane off (workers inherit the env knob).  The r2 leg
+    # above ran with tracing ON (the default), so off/on is the
+    # plane's whole cost — context minting, header stamping, span
+    # shipping, collector ingest.  The perf-smoke pairwise gate
+    # enforces <= 2%; this emits the longer-horizon number for the
+    # sentinel history.
+    from pydcop_tpu.observability import fleettrace
+
+    prev = os.environ.get(fleettrace.ENV_KNOB)
+    os.environ[fleettrace.ENV_KNOB] = "0"
+    try:
+        off = run_leg(2, "structure")
+    finally:
+        if prev is None:
+            os.environ.pop(fleettrace.ENV_KNOB, None)
+        else:
+            os.environ[fleettrace.ENV_KNOB] = prev
+    if off and r2:
+        out["fleet_trace_off_problems_per_sec"] = off["pps"]
+        out["fleet_trace_overhead"] = round(
+            off["pps"] / r2["pps"], 3)
     return out
 
 

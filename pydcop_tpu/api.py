@@ -419,6 +419,7 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
           max_replicas: Optional[int] = None,
           join: Optional[str] = None,
           host_id: Optional[str] = None,
+          fleet_trace: Optional[bool] = None,
           port_file: Optional[str] = None,
           block: bool = False) -> Optional[Any]:
     """Start the multi-tenant solve service (docs/serving.md).
@@ -511,7 +512,21 @@ def serve(port: int = 8080, host: str = "127.0.0.1",
     drained count is logged on exit.  Returns None.  ``block=False``
     returns a :class:`ServeHandle` / :class:`FleetHandle` (both
     context managers) for embedding and tests.
+
+    ``fleet_trace`` forces fleet-wide causal tracing on/off
+    (docs/observability.md "Fleet tracing"): the router mints one
+    trace context per admission, stamps it on every forwarded
+    submit/event-batch/fence/migration/retry, and collects replica
+    spans for ``GET /fleet/forensics/<id>``.  ``None`` (default)
+    defers to ``PYDCOP_FLEET_TRACE`` (on unless set to 0); an
+    explicit value is exported to that env var so spawned workers
+    inherit it.
     """
+    if fleet_trace is not None:
+        # The knob lives in the environment on purpose: spawned fleet
+        # workers inherit it, and every header/shipping decision
+        # reads it per call — so toggling is honest fleet-wide.
+        os.environ["PYDCOP_FLEET_TRACE"] = "1" if fleet_trace else "0"
     if join and replicas > 1:
         raise ValueError(
             "join= is for single-replica remote workers; a local "

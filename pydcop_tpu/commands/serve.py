@@ -217,6 +217,21 @@ def set_parser(subparsers):
                              "--replicas (autoscaling is armed only "
                              "when both this and --slo_p99_ms are "
                              "set)")
+    parser.add_argument("--fleet_trace", "--fleet-trace",
+                        action="store_true", dest="fleet_trace",
+                        default=None,
+                        help="force fleet-wide causal tracing ON: "
+                             "the router mints a trace context per "
+                             "admission, stamps it on every forward, "
+                             "and collects replica spans for "
+                             "/fleet/forensics (default: on unless "
+                             "PYDCOP_FLEET_TRACE=0)")
+    parser.add_argument("--no_fleet_trace", "--no-fleet-trace",
+                        action="store_false", dest="fleet_trace",
+                        help="disable fleet tracing (headers, span "
+                             "shipping and the router collector; "
+                             "sets PYDCOP_FLEET_TRACE=0 for spawned "
+                             "workers too)")
     parser.add_argument("--port_file", "--port-file", default=None,
                         metavar="PATH",
                         help="atomically write the bound port to "
@@ -306,6 +321,7 @@ def run_cmd(args) -> int:
         max_replicas=args.max_replicas,
         join=args.join,
         host_id=args.host_id,
+        fleet_trace=args.fleet_trace,
         port_file=args.port_file,
         block=True,
     )

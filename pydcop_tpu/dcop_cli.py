@@ -33,6 +33,7 @@ def make_parser() -> argparse.ArgumentParser:
         consolidate,
         debug,
         distribute,
+        fleet,
         generate,
         graph,
         orchestrator,
@@ -65,7 +66,7 @@ def make_parser() -> argparse.ArgumentParser:
     subparsers = parser.add_subparsers(title="commands", dest="command")
     for cmd in (solve, run, distribute, graph, agent, orchestrator,
                 generate, replica_dist, batch, consolidate, trace,
-                serve, debug, profile):
+                serve, debug, profile, fleet):
         cmd.set_parser(subparsers)
     return parser
 

@@ -450,6 +450,67 @@ def get_registry() -> MetricsRegistry:
     return registry
 
 
+def merge_snapshots(snapshots: Dict[str, Dict[str, Any]],
+                    label: str = "replica") -> Dict[str, Any]:
+    """Merge per-process registry snapshots (``registry.snapshot()``
+    shape, keyed by source name) into one snapshot whose every sample
+    gains a ``label`` identifying where it came from — the
+    ``GET /fleet/metrics`` aggregation.  Summation is deliberately
+    NOT done here: keeping the per-source samples (distinguished by
+    the label) preserves conservation checks — summing
+    ``pydcop_requests_total`` across ``replica`` labels must
+    reproduce the router's own admission ledger, which a pre-summed
+    view could fake."""
+    merged: Dict[str, Any] = {}
+    for source in sorted(snapshots):
+        snap = snapshots[source] or {}
+        for name, family in snap.items():
+            out = merged.setdefault(
+                name, {"kind": family.get("kind", "untyped"),
+                       "samples": []})
+            for sample in family.get("samples", []):
+                row = dict(sample)
+                labels = dict(row.get("labels") or {})
+                labels[label] = source
+                row["labels"] = labels
+                out["samples"].append(row)
+    return merged
+
+
+def render_snapshot_prometheus(merged: Dict[str, Any]) -> str:
+    """Prometheus text exposition for a merged snapshot (the
+    ``merge_snapshots`` shape).  Counter/gauge samples render
+    directly; histogram snapshot rows expand back into
+    ``_bucket``/``_sum``/``_count`` series.  HELP lines are omitted —
+    help text does not survive the snapshot wire format."""
+    lines: List[str] = []
+    for name in sorted(merged):
+        family = merged[name]
+        lines.append(f"# TYPE {name} {family.get('kind', 'untyped')}")
+        for sample in family.get("samples", []):
+            labels = sample.get("labels") or {}
+            base = tuple(sorted(
+                (str(k), str(v)) for k, v in labels.items()))
+            if "value" in sample:
+                lines.append(_format_sample(name, base,
+                                            sample["value"]))
+                continue
+            # Histogram snapshot row: buckets + the implicit +Inf.
+            for le, count in sorted(
+                    (sample.get("buckets") or {}).items()):
+                bkey = tuple(sorted(base + (("le", str(le)),)))
+                lines.append(_format_sample(f"{name}_bucket", bkey,
+                                            count))
+            inf_key = tuple(sorted(base + (("le", "+Inf"),)))
+            lines.append(_format_sample(f"{name}_bucket", inf_key,
+                                        sample.get("count", 0.0)))
+            lines.append(_format_sample(f"{name}_sum", base,
+                                        sample.get("sum", 0.0)))
+            lines.append(_format_sample(f"{name}_count", base,
+                                        sample.get("count", 0.0)))
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 class CycleSnapshotter:
     """Progress recorder shared by both backends: maintains the
     monotone ``pydcop_cycles_total`` counter, the ``pydcop_cycle`` /

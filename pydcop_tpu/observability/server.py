@@ -166,6 +166,16 @@ class _Handler(BaseHTTPRequestHandler):
                 "application/openmetrics-text; version=1.0.0; "
                 "charset=utf-8" if openmetrics
                 else "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/metrics.json":
+            # The registry snapshot as JSON: the machine-mergeable
+            # form the fleet router's GET /fleet/metrics aggregator
+            # pulls from every replica (text exposition round-trips
+            # lossily; the snapshot keeps kinds and histogram
+            # structure intact).
+            self._reply(200,
+                        json.dumps(self.telemetry.registry.snapshot(),
+                                   default=str).encode(),
+                        "application/json")
         elif path == "/healthz":
             verdict = health_verdict()
             code = 503 if verdict.get("status") == "failing" else 200

@@ -31,18 +31,21 @@ telemetry:
 curl examples live in docs/serving.md and docs/sessions.md.
 """
 
+import contextlib
 import json
 import logging
 import math
 import queue
 from typing import Any, Dict, Optional
 
+from pydcop_tpu.observability import fleettrace
 from pydcop_tpu.observability.server import (
     TelemetryServer,
     _Handler,
     get_health_provider,
     set_health_provider,
 )
+from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.serving.admission import AdmissionRejected
 from pydcop_tpu.serving.service import SolveService, WidthRejected
 from pydcop_tpu.serving.sessions import (
@@ -205,13 +208,19 @@ class _ServeHandler(_Handler):
             service.record_bad_request()
             self._json(400, {"error": f"bad request body: {exc}"})
             return
+        # The fleet router's wire-propagated trace context (ISSUE 20):
+        # adopting it makes this replica's serve_* spans part of the
+        # router's admission trace in the fleet collector.
+        ctx = fleettrace.decode_headers(self.headers)
         try:
             from pydcop_tpu.dcop.yamldcop import load_dcop
 
             dcop = load_dcop(yaml_src)
             rid = service.submit(dcop, params=body.get("params"),
                                  request_id=request_id,
-                                 deadline_s=deadline_s)
+                                 deadline_s=deadline_s,
+                                 trace_id=(ctx.trace_id if ctx
+                                           else None))
         except AdmissionRejected as exc:
             self._json(exc.http_status, {
                 "error": str(exc),
@@ -284,6 +293,26 @@ class _ServeHandler(_Handler):
           partitioned (200, idempotent; 409 when the fence itself is
           stale).
         """
+        if op == "trace_collector":
+            # ``POST /admin/trace_collector`` — the router pushes its
+            # fleet-collector address here (at fleet start, after a
+            # replica restart, on a --join) so this process's span
+            # shipper knows where completed spans go; ``enable:
+            # false`` detaches it (the perf-smoke pairwise gate
+            # toggles tracing at runtime this way).
+            body = self._read_json_body()
+            if body is None:
+                return
+            try:
+                out = fleettrace.configure_shipper(
+                    body.get("url"),
+                    source=str(body.get("source") or "worker"),
+                    enable=bool(body.get("enable", True)))
+            except Exception as exc:  # noqa: BLE001 — admin answers
+                self._json(500, {"error": f"internal error: {exc}"})
+                return
+            self._json(200, out)
+            return
         if op not in ("export_session", "import_session",
                       "retire_session", "resume_session",
                       "fence_session"):
@@ -293,32 +322,44 @@ class _ServeHandler(_Handler):
         if body is None:
             return
         service = self.telemetry.service
+        # Migration/fence admin calls are router-driven: the fleet
+        # context on them tags this replica's side of the hop (the
+        # import/export spans inside the session manager record
+        # under it via the thread-bound args).
+        ctx = fleettrace.decode_headers(self.headers)
+        admin_ctx = (tracer.context(trace_ids=[ctx.trace_id])
+                     if ctx is not None and tracer.active
+                     else contextlib.nullcontext())
         try:
-            if op == "import_session":
-                from pydcop_tpu.serving import migration
+            with admin_ctx:
+                if op == "import_session":
+                    from pydcop_tpu.serving import migration
 
-                sess = migration.install_bundle(
-                    service.sessions, body)
-                self._json(201, {"session_id": sess.id,
-                                 "trace_id": sess.trace_id,
-                                 "seq": sess.seq,
-                                 "status": sess.status})
-                return
-            sid = body.get("session_id")
-            if not isinstance(sid, str) or not sid.strip():
-                raise ValueError("body needs a 'session_id' string")
-            if op == "export_session":
-                wait = _positive_float(body.get("wait", 60.0), "wait")
-                out = service.sessions.export_session(sid, wait=wait)
-            elif op == "retire_session":
-                out = service.sessions.retire_session(
-                    sid, moved_to=body.get("moved_to"))
-            elif op == "fence_session":
-                out = service.sessions.fence_session(
-                    sid, int(body.get("epoch") or 0))
-            else:  # resume_session
-                out = service.sessions.resume_session(sid)
-            self._json(200, out)
+                    sess = migration.install_bundle(
+                        service.sessions, body)
+                    self._json(201, {"session_id": sess.id,
+                                     "trace_id": sess.trace_id,
+                                     "seq": sess.seq,
+                                     "status": sess.status})
+                    return
+                sid = body.get("session_id")
+                if not isinstance(sid, str) or not sid.strip():
+                    raise ValueError(
+                        "body needs a 'session_id' string")
+                if op == "export_session":
+                    wait = _positive_float(
+                        body.get("wait", 60.0), "wait")
+                    out = service.sessions.export_session(
+                        sid, wait=wait)
+                elif op == "retire_session":
+                    out = service.sessions.retire_session(
+                        sid, moved_to=body.get("moved_to"))
+                elif op == "fence_session":
+                    out = service.sessions.fence_session(
+                        sid, int(body.get("epoch") or 0))
+                else:  # resume_session
+                    out = service.sessions.resume_session(sid)
+                self._json(200, out)
         except KeyError as exc:
             self._json(404, {"error": f"unknown session: {exc}"})
         except StaleEpoch as exc:
@@ -358,9 +399,11 @@ class _ServeHandler(_Handler):
             from pydcop_tpu.dcop.yamldcop import load_dcop
 
             dcop = load_dcop(yaml_src)
+            ctx = fleettrace.decode_headers(self.headers)
             sess = service.sessions.open(
                 dcop, params=body.get("params"),
-                session_id=body.get("session_id"))
+                session_id=body.get("session_id"),
+                trace_id=ctx.trace_id if ctx else None)
         except AdmissionRejected as exc:
             self._json(exc.http_status, {
                 "error": str(exc), "status": "rejected",
@@ -416,9 +459,11 @@ class _ServeHandler(_Handler):
             service.record_bad_request()
             self._json(400, {"error": f"bad events: {exc}"})
             return
+        ctx = fleettrace.decode_headers(self.headers)
         try:
             out = service.sessions.apply_events(
-                sid, events, wait=wait, epoch=epoch)
+                sid, events, wait=wait, epoch=epoch,
+                trace_id=ctx.trace_id if ctx else None)
         except KeyError:
             self._json(404, {"error": f"unknown session {sid!r}"})
             return
@@ -480,6 +525,13 @@ class _ServeHandler(_Handler):
         except KeyError:
             self._json(404, {"error": f"unknown session {sid!r}"})
             return
+        # Router-proxied streams carry the fleet context: the attach
+        # instant is what lets forensics show WHO was watching the
+        # session while the events under inspection streamed.
+        ctx = fleettrace.decode_headers(self.headers)
+        if ctx is not None and tracer.active:
+            tracer.instant("session_stream_attach", "serving",
+                           session=sid, trace_id=ctx.trace_id)
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")

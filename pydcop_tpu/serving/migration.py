@@ -59,8 +59,11 @@ import json
 import logging
 import os
 import tempfile
+import uuid
 from typing import Any, Dict, List, Optional
 
+from pydcop_tpu.observability import fleettrace
+from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.serving import journal as journal_mod
 
 logger = logging.getLogger("pydcop.serving.migration")
@@ -264,11 +267,17 @@ def migrate_session(router, session_id: str,
     if target.index == source.index:
         raise ValueError("target is the session's current replica")
 
+    # The whole export→import→retire hop rides ONE trace context —
+    # the session's own when the router remembers it, a fresh one
+    # otherwise — so forensics shows the migration inside the
+    # session's causal tree.
+    ctx = fleettrace.TraceContext(
+        router.trace_for(session_id) or uuid.uuid4().hex[:16])
     status, _ctype, body = router._forward(
         source, "POST", "/admin/export_session",
         json.dumps({"session_id": session_id,
                     "wait": timeout}).encode(),
-        timeout=timeout + 30.0)
+        timeout=timeout + 30.0, trace=ctx)
     if status != 200:
         raise RuntimeError(
             f"export failed on replica {source.index} ({status}): "
@@ -284,7 +293,8 @@ def migrate_session(router, session_id: str,
     try:
         status, _ctype, body = router._forward(
             target, "POST", "/admin/import_session",
-            json.dumps(bundle).encode(), timeout=timeout + 30.0)
+            json.dumps(bundle).encode(), timeout=timeout + 30.0,
+            trace=ctx)
         if status != 201:
             raise RuntimeError(
                 f"import failed on replica {target.index} "
@@ -296,7 +306,7 @@ def migrate_session(router, session_id: str,
             router._forward(
                 source, "POST", "/admin/resume_session",
                 json.dumps({"session_id": session_id}).encode(),
-                timeout=30.0)
+                timeout=30.0, trace=ctx)
         except OSError:
             logger.warning("session %s: import failed AND source "
                            "resume unreachable — the source journal "
@@ -309,7 +319,7 @@ def migrate_session(router, session_id: str,
             source, "POST", "/admin/retire_session",
             json.dumps({"session_id": session_id,
                         "moved_to": target.url}).encode(),
-            timeout=30.0)
+            timeout=30.0, trace=ctx)
     except OSError:
         # The target owns the session (pin repointed + epoch bumped);
         # an unretired source copy is fenced when the source heals —
@@ -320,6 +330,10 @@ def migrate_session(router, session_id: str,
                        session_id, source.index, new_epoch)
     with router._lock:
         router.migrations += 1
+    if tracer.active:
+        tracer.instant("router_migrate", "fleet",
+                       trace_id=ctx.trace_id, session=session_id,
+                       source=source.index, target=target.index)
     logger.info("session %s migrated: replica %d -> %d",
                 session_id, source.index, target.index)
     return {"session_id": session_id, "from": source.index,
@@ -375,10 +389,14 @@ def adopt_dead_sessions(router, dead) -> int:
             ckpt_seq=(ckpt.get("seq")
                       if ckpt.get("path") else None),
             epoch=new_epoch)
+        ctx = fleettrace.TraceContext(
+            router.trace_for(sid) or open_rec.get("trace_id")
+            or uuid.uuid4().hex[:16])
         try:
             status, _ctype, body = router._forward(
                 target, "POST", "/admin/import_session",
-                json.dumps(bundle).encode(), timeout=120.0)
+                json.dumps(bundle).encode(), timeout=120.0,
+                trace=ctx)
             if status != 201:
                 raise RuntimeError(
                     f"import answered {status}: {body[:200]!r}")
@@ -401,6 +419,11 @@ def adopt_dead_sessions(router, dead) -> int:
         adopted += 1
         with router._lock:
             router.migrations += 1
+        if tracer.active:
+            tracer.instant("router_migrate", "fleet",
+                           trace_id=ctx.trace_id, session=sid,
+                           source=dead.index, target=target.index,
+                           adopted=True)
         logger.info("session %s adopted by replica %d after replica "
                     "%d death", sid, target.index, dead.index)
     return adopted

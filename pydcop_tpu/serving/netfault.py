@@ -184,6 +184,20 @@ class FaultPlan:
     def _count(self, kind: str) -> None:
         with self._lock:
             self._injected[kind] = self._injected.get(kind, 0) + 1
+        # Injections announce themselves to the trace plane: the
+        # instant inherits any thread-bound trace context (the router
+        # binds the forwarded request's trace_ids around the
+        # exchange), so /fleet/forensics shows the fault INSIDE the
+        # causal tree it perturbed.  Off the fault path this never
+        # runs — the no-fault hot path stays instant-free.
+        try:
+            from pydcop_tpu.observability.trace import tracer
+
+            if tracer.active:
+                tracer.instant("netfault_injected", "fleet",
+                               kind=kind)
+        except Exception:  # noqa: BLE001 — telemetry never breaks IO
+            pass
 
     def injected(self) -> Dict[str, int]:
         with self._lock:

@@ -78,6 +78,7 @@ into a control plane:
   another's.
 """
 
+import contextlib
 import hashlib
 import heapq
 import itertools
@@ -94,7 +95,9 @@ import uuid
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from pydcop_tpu.observability import fleettrace
 from pydcop_tpu.observability.metrics import registry as metrics_registry
+from pydcop_tpu.observability.trace import tracer
 from pydcop_tpu.serving import netfault
 from pydcop_tpu.observability.server import (
     TelemetryServer,
@@ -447,6 +450,15 @@ class FleetRouter:
         self.retries = 0
         self.retry_budget_exceeded = 0
         self.fenced_sessions = 0
+        # Fleet trace plane (ISSUE 20): the collector exists once a
+        # front end attaches (attach_collector — it needs the bound
+        # URL to push to worker span shippers); the trace tables map
+        # router-minted request/session ids to their trace contexts
+        # for the /fleet/forensics lookup.
+        self.collector: Optional[fleettrace.FleetCollector] = None
+        self.collector_url: Optional[str] = None
+        self._request_traces: "OrderedDict[str, str]" = OrderedDict()
+        self._session_traces: "OrderedDict[str, str]" = OrderedDict()
         reg = metrics_registry
         self._routed_total = reg.counter(
             "pydcop_router_requests_total",
@@ -460,6 +472,10 @@ class FleetRouter:
         self._restarts_total = reg.counter(
             "pydcop_router_replica_restarts_total",
             "Worker replicas restarted after a death verdict")
+        self._burn_gauge = reg.gauge(
+            "pydcop_slo_burn_rate",
+            "Rolling forwarded p99 over the --slo_p99_ms target "
+            "(>1 means the fleet is burning error budget)")
 
     # -- lifecycle ----------------------------------------------------- #
 
@@ -628,7 +644,7 @@ class FleetRouter:
             try:
                 status, _ctype, _body = self._forward(
                     replica, "GET", "/healthz", None,
-                    timeout=self.probe_timeout_s)
+                    timeout=self.probe_timeout_s, trace=None)
             except OSError:
                 time.sleep(0.05)
                 continue
@@ -644,6 +660,11 @@ class FleetRouter:
                 replica.estimator.beat(now)
                 replica.status = UP
                 replica.death_handled = False
+                # A (re)started worker's span shipper starts blank:
+                # re-push the collector address so its spans keep
+                # landing in the fleet trace (no-op before a front
+                # end attaches).
+                self.push_trace_config(replica)
                 logger.info("replica %d ready on %s", replica.index,
                             replica.url)
                 return
@@ -670,6 +691,12 @@ class FleetRouter:
                     logger.exception("heartbeat probe crashed for "
                                      "replica %d", replica.index)
             self._up_gauge.set(self.up_count())
+            if self.slo_p99_ms:
+                # SLO burn rate: rolling p99 over the target.  A
+                # fleet with no recent traffic burns nothing.
+                p99 = self.rolling_p99()
+                self._burn_gauge.set(
+                    round(p99 / self.slo_p99_ms, 6) if p99 else 0.0)
             try:
                 self._maybe_autoscale()
             except Exception:  # noqa: BLE001 — the control loop must
@@ -689,7 +716,7 @@ class FleetRouter:
             try:
                 status, _ctype, body = self._forward(
                     replica, "GET", "/healthz", None,
-                    timeout=self.probe_timeout_s)
+                    timeout=self.probe_timeout_s, trace=None)
                 beat_ok = status in (200, 503)
                 if beat_ok:
                     doc = json.loads(body)
@@ -844,10 +871,15 @@ class FleetRouter:
         return [r for r in self.replicas
                 if r.status == UP and not r.breaker_open]
 
-    def pick(self, digest: Optional[str]) -> Tuple[Replica, bool]:
+    def pick(self, digest: Optional[str],
+             detail: Optional[Dict[str, Any]] = None
+             ) -> Tuple[Replica, bool]:
         """Choose the replica for one admission.  Returns
         ``(replica, affinity_hit)``; raises :class:`FleetUnavailable`
-        when every replica is down or shedding."""
+        when every replica is down or shedding.  ``detail`` (an
+        optional caller-owned dict) is filled with the route-pick
+        reason — chosen replica, affinity hit, spillover — so the
+        trace plane can record WHY without a second lock trip."""
         with self._lock:
             live = self.candidates()
             if not live:
@@ -884,6 +916,19 @@ class FleetRouter:
             self.routed += 1
             if hit:
                 self.affinity_hits += 1
+        if detail is not None:
+            detail.update({
+                "replica": chosen.index,
+                "host_id": chosen.host_id,
+                "affinity_hit": hit,
+                "spilled": spilled,
+                "reason": ("spillover" if spilled
+                           else "affinity" if hit
+                           else "round_robin"
+                           if (self.affinity == "round_robin"
+                               or digest is None)
+                           else "rendezvous"),
+            })
         self._routed_total.inc(outcome="spillover" if spilled
                                else "affinity" if hit else "routed")
         if hit:
@@ -974,20 +1019,108 @@ class FleetRouter:
         if not pending:
             return
         for sid, epoch in pending.items():
+            # The fence travels in the session's own fleet trace:
+            # forensics on a migrated session shows WHEN its stale
+            # copy was revoked, not just that it was.
+            ctx = fleettrace.TraceContext(
+                self.trace_for(sid) or uuid.uuid4().hex[:16])
             try:
                 self._forward(
                     replica, "POST", "/admin/fence_session",
                     json.dumps({"session_id": sid,
                                 "epoch": epoch}).encode(),
-                    timeout=self.probe_timeout_s)
+                    timeout=self.probe_timeout_s, trace=ctx)
                 with self._lock:
                     self.fenced_sessions += 1
+                if tracer.active:
+                    tracer.instant("router_fence_flush", "fleet",
+                                   trace_id=ctx.trace_id, session=sid,
+                                   epoch=epoch, replica=replica.index)
                 logger.info("fenced stale session %s (epoch %d) on "
                             "replica %d", sid, epoch, replica.index)
             except OSError:
                 # It answered once, it will answer the prober again —
                 # re-arm so the next heal attempt retries the fence.
                 self.record_fence(replica.index, sid, epoch)
+
+    # -- fleet trace plane (ISSUE 20) ----------------------------------- #
+
+    def attach_collector(self, url: str) -> None:
+        """Arm the fleet trace plane: create the collector, tap the
+        router's own flight recorder into it (route-pick/retry/fence
+        spans land in the merged trace's ``router`` lane), and push
+        the collector address to every live replica's span shipper.
+        The front end calls this once it knows its bound URL;
+        idempotent, and a no-op with ``PYDCOP_FLEET_TRACE=0``."""
+        self.collector_url = url
+        if not fleettrace.enabled():
+            return
+        if self.collector is None:
+            self.collector = fleettrace.FleetCollector()
+        self.collector.attach_router_tap()
+        for replica in list(self.replicas):
+            if replica.status == UP:
+                self.push_trace_config(replica)
+
+    def detach_collector(self) -> None:
+        """Disarm the plane: stop observing router spans, tell live
+        replicas to stop shipping.  Collected events stay queryable
+        (a stopped fleet's trace is still forensics material)."""
+        if self.collector is not None:
+            self.collector.detach_router_tap()
+        for replica in list(self.replicas):
+            if replica.status == UP:
+                self.push_trace_config(replica, enable=False)
+
+    def set_fleet_trace(self, on: bool) -> None:
+        """Runtime toggle (the perf-smoke pairwise gate flips this
+        between timed phases): sets the env knob gating this
+        process's header stamping and minting, then re-arms or
+        disarms the collector and every worker's shipper."""
+        os.environ[fleettrace.ENV_KNOB] = "1" if on else "0"
+        if on and self.collector_url:
+            self.attach_collector(self.collector_url)
+        elif not on:
+            self.detach_collector()
+
+    def push_trace_config(self, replica: Replica,
+                          enable: bool = True) -> None:
+        """Tell one replica where to ship completed spans.  Best
+        effort by contract: telemetry config must never become a
+        lifecycle dependency — a failed push just means that
+        replica's lane stays empty until the next heal/restart."""
+        if self.collector_url is None:
+            return
+        body = json.dumps({
+            "url": self.collector_url,
+            "source": f"replica-{replica.index}",
+            "enable": bool(enable and fleettrace.enabled()),
+        }).encode()
+        try:
+            self._forward(replica, "POST", "/admin/trace_collector",
+                          body, timeout=10.0, trace=None)
+        except OSError:
+            logger.debug("replica %d trace-collector config push "
+                         "failed", replica.index)
+
+    def note_request_trace(self, rid: str, trace_id: str) -> None:
+        with self._lock:
+            self._request_traces[rid] = trace_id
+            while len(self._request_traces) > PIN_KEEP:
+                self._request_traces.popitem(last=False)
+
+    def note_session_trace(self, sid: str, trace_id: str) -> None:
+        with self._lock:
+            self._session_traces[sid] = trace_id
+            while len(self._session_traces) > PIN_KEEP:
+                self._session_traces.popitem(last=False)
+
+    def trace_for(self, handle: str) -> Optional[str]:
+        """The trace id behind a router-minted request id or a
+        session id — the ``/fleet/forensics/<id>`` entry point."""
+        with self._lock:
+            return (self._request_traces.get(handle)
+                    or self._session_traces.get(handle))
 
     # -- multi-host membership ------------------------------------------ #
 
@@ -1033,7 +1166,8 @@ class FleetRouter:
                 replica.journal_dir = journal_dir
         try:
             status, _ctype, _body = self._forward(
-                replica, "GET", "/healthz", None, timeout=5.0)
+                replica, "GET", "/healthz", None, timeout=5.0,
+                trace=None)
         except OSError as exc:
             with self._lock:
                 if replica.status != UP:
@@ -1061,6 +1195,9 @@ class FleetRouter:
         # A re-announce is a heal: stale session copies recorded
         # against this slot get fenced before it serves.
         self._flush_fences(replica)
+        # Joined replicas ship spans like spawned ones: hand the
+        # fresh member the collector address.
+        self.push_trace_config(replica)
         self._up_gauge.set(self.up_count())
         logger.info("remote replica %d joined from %s (host %s)",
                     replica.index, replica.url, replica.host_id)
@@ -1201,7 +1338,7 @@ class FleetRouter:
             try:
                 self._forward(replica, "POST", "/solve",
                               json.dumps(body).encode(),
-                              timeout=90.0)
+                              timeout=90.0, trace=None)
                 # Unlike a crash respawn, this replica genuinely
                 # executed the structure: its in-process jit cache is
                 # warm for it.
@@ -1268,18 +1405,35 @@ class FleetRouter:
 
     def _forward(self, replica: Replica, method: str, path: str,
                  body: Optional[bytes],
-                 timeout: float = FORWARD_TIMEOUT_S
+                 timeout: float = FORWARD_TIMEOUT_S,
+                 trace: Optional[fleettrace.TraceContext] = None
                  ) -> Tuple[int, str, bytes]:
         # Every router->replica byte crosses the netfault seam: a
         # connect refusal (or an injected drop/partition) surfaces as
         # ForwardNotSent — zero bytes delivered, retry-safe — while
         # anything past the connect stays a plain, ambiguous OSError
         # (including an injected lost response).
-        return netfault.exchange(
-            "router",
-            (f"replica-{replica.index}", replica.host_id or ""),
-            replica.host, replica.port, method, path,
-            body=body, timeout=timeout)
+        #
+        # ``trace`` is mandatory at every call site (the static-check
+        # trace-seam lint enforces the explicit kwarg): request-plane
+        # forwards carry the admission context so the replica's spans
+        # join the fleet trace; telemetry-plane probes pass
+        # ``trace=None`` on purpose.
+        headers = None
+        trace_cm = contextlib.nullcontext()
+        if trace is not None and fleettrace.enabled():
+            headers = {fleettrace.HEADER: trace.encode()}
+            if tracer.active:
+                # Thread-bound context: anything recorded UNDER this
+                # exchange (a netfault injection instant, most
+                # usefully) lands inside the request's causal tree.
+                trace_cm = tracer.context(trace_ids=[trace.trace_id])
+        with trace_cm:
+            return netfault.exchange(
+                "router",
+                (f"replica-{replica.index}", replica.host_id or ""),
+                replica.host, replica.port, method, path,
+                body=body, timeout=timeout, headers=headers)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -1405,10 +1559,12 @@ class _RouterHandler(_Handler):
 
     def _proxy(self, replica: Replica, method: str, path: str,
                body: Optional[bytes],
-               timeout: float = FORWARD_TIMEOUT_S) -> None:
+               timeout: float = FORWARD_TIMEOUT_S,
+               trace=None) -> None:
         try:
             status, ctype, payload = self.router._forward(
-                replica, method, path, body, timeout=timeout)
+                replica, method, path, body, timeout=timeout,
+                trace=trace)
         except ForwardNotSent as exc:
             # Zero bytes reached the worker: the operation certainly
             # did not happen.
@@ -1441,6 +1597,8 @@ class _RouterHandler(_Handler):
             self._route_session_open()
         elif path == "/fleet/join":
             self._fleet_join()
+        elif path == "/fleet/spans":
+            self._fleet_spans()
         elif path == "/admin/migrate":
             self._admin_migrate()
         else:
@@ -1575,6 +1733,12 @@ class _RouterHandler(_Handler):
         rid = f"f{uuid.uuid4().hex[:16]}"
         body["request_id"] = rid
         payload = json.dumps(body).encode()
+        # Admission is where the causal trace is born: the minted
+        # context travels on every forward (and retry) of this
+        # request, and the rid→trace map lets /fleet/forensics and
+        # later /result reads rejoin the same trace.
+        ctx = fleettrace.mint()
+        router.note_request_trace(rid, ctx.trace_id)
         t0 = time.monotonic()
         # The ambiguous-failure retry budget is the client's own
         # remaining patience: a deadline_s in the body bounds it (a
@@ -1587,50 +1751,68 @@ class _RouterHandler(_Handler):
         budget = t0 + (deadline_s if deadline_s > 0
                        else DEFAULT_RETRY_BUDGET_S)
         tried: set = set()
-        while True:
-            try:
-                replica, _hit = router.pick(digest)
-            except FleetUnavailable as exc:
-                self._json(503, {"error": str(exc),
-                                 "status": "rejected", "retry": True})
-                return
-            if replica.index in tried:
-                # pick() charged this replica's in_flight; this exit
-                # path never forwards, so it must release here or the
-                # slot leaks and the spillover heuristic sees a
-                # permanently-busier replica.
+        span_cm = (tracer.span("router_request", "fleet",
+                               trace_id=ctx.trace_id, request=rid)
+                   if tracer.active else contextlib.nullcontext())
+        with span_cm:
+            while True:
+                detail: Dict[str, Any] = {}
+                try:
+                    replica, _hit = router.pick(digest, detail=detail)
+                except FleetUnavailable as exc:
+                    self._json(503, {"error": str(exc),
+                                     "status": "rejected",
+                                     "retry": True})
+                    return
+                if tracer.active:
+                    tracer.instant("router_route_pick", "fleet",
+                                   trace_id=ctx.trace_id, request=rid,
+                                   **detail)
+                if replica.index in tried:
+                    # pick() charged this replica's in_flight; this
+                    # exit path never forwards, so it must release
+                    # here or the slot leaks and the spillover
+                    # heuristic sees a permanently-busier replica.
+                    router.release(replica)
+                    self._json(503, {
+                        "error": "every healthy replica failed the "
+                                 "forward; retry",
+                        "status": "rejected", "retry": True})
+                    return
+                tried.add(replica.index)
+                router.pin(rid, replica)
+                try:
+                    result = self._forward_retrying(
+                        replica, payload, rid, budget, ctx)
+                except ForwardNotSent as exc:
+                    # The connect was refused before ANY attempt
+                    # reached the worker: zero bytes delivered,
+                    # nothing acked — re-picking a healthy replica
+                    # and resending the identical body (the id
+                    # travels with it) is unconditionally safe.
+                    if tracer.active:
+                        tracer.instant("router_repick", "fleet",
+                                       trace_id=ctx.trace_id,
+                                       request=rid,
+                                       replica=replica.index,
+                                       error=str(exc))
+                    router.mark_forward_error(replica)
+                    with router._lock:
+                        router.reroutes += 1
+                    router.release(replica)
+                    continue
                 router.release(replica)
-                self._json(503, {
-                    "error": "every healthy replica failed the "
-                             "forward; retry",
-                    "status": "rejected", "retry": True})
+                if result is None:
+                    return  # budget exhausted; 503 already sent
+                status, ctype, out = result
+                router.record_latency(
+                    (time.monotonic() - t0) * 1000.0)
+                self._reply(status, out, ctype)
                 return
-            tried.add(replica.index)
-            router.pin(rid, replica)
-            try:
-                result = self._forward_retrying(
-                    replica, payload, rid, budget)
-            except ForwardNotSent:
-                # The connect was refused before ANY attempt reached
-                # the worker: zero bytes delivered, nothing acked —
-                # re-picking a healthy replica and resending the
-                # identical body (the id travels with it) is
-                # unconditionally safe.
-                router.mark_forward_error(replica)
-                with router._lock:
-                    router.reroutes += 1
-                router.release(replica)
-                continue
-            router.release(replica)
-            if result is None:
-                return  # budget exhausted; 503 already sent
-            status, ctype, out = result
-            router.record_latency((time.monotonic() - t0) * 1000.0)
-            self._reply(status, out, ctype)
-            return
 
     def _forward_retrying(self, replica: Replica, payload: bytes,
-                          rid: str, budget: float
+                          rid: str, budget: float,
+                          ctx: Optional[fleettrace.TraceContext] = None
                           ) -> Optional[Tuple[int, str, bytes]]:
         """Forward one /solve to ONE replica, absorbing ambiguous
         failures with jittered exponential backoff while the deadline
@@ -1650,11 +1832,18 @@ class _RouterHandler(_Handler):
         while True:
             try:
                 return router._forward(replica, "POST", "/solve",
-                                       payload)
+                                       payload, trace=ctx)
             except OSError as exc:
                 if attempt == 0 and isinstance(exc, ForwardNotSent):
                     raise
                 attempt += 1
+                if tracer.active and ctx is not None:
+                    tracer.instant(
+                        "router_retry", "fleet",
+                        trace_id=ctx.trace_id, request=rid,
+                        attempt=attempt, replica=replica.index,
+                        not_sent=isinstance(exc, ForwardNotSent),
+                        error=str(exc))
                 backoff = min(0.05 * (2 ** attempt), 1.0)
                 backoff *= 0.5 + random.random() * 0.5
                 if time.monotonic() + backoff > budget:
@@ -1692,12 +1881,23 @@ class _RouterHandler(_Handler):
             if replica is None:
                 self._json(404, {"error": f"unknown session {sid!r}"})
                 return
+            tid = self.router.trace_for(sid)
+            ctx = fleettrace.TraceContext(tid) if tid else None
             if path.endswith("/events"):
-                self._proxy_sse(replica, path)
+                self._proxy_sse(replica, path, ctx)
             else:
-                self._proxy(replica, "GET", path, None, timeout=30.0)
+                self._proxy(replica, "GET", path, None, timeout=30.0,
+                            trace=ctx)
         elif path == "/stats":
             self._fleet_stats()
+        elif path == "/fleet/metrics":
+            self._fleet_metrics()
+        elif path == "/fleet/profile":
+            self._fleet_profile()
+        elif path == "/fleet/trace":
+            self._fleet_trace()
+        elif path.startswith("/fleet/forensics/"):
+            self._fleet_forensics(path[len("/fleet/forensics/"):])
         else:
             super().do_GET()
 
@@ -1708,6 +1908,8 @@ class _RouterHandler(_Handler):
         briefly (re-reading the pin: adoption may repoint it
         meanwhile) instead of bouncing every poll straight to 503."""
         router = self.router
+        tid = router.trace_for(rid)
+        ctx = fleettrace.TraceContext(tid) if tid else None
         deadline = time.monotonic() + RESULT_HEDGE_S
         while True:
             replica = router.pinned(rid)
@@ -1717,7 +1919,8 @@ class _RouterHandler(_Handler):
             if replica.status == UP:
                 try:
                     status, ctype, payload = router._forward(
-                        replica, "GET", path, None, timeout=30.0)
+                        replica, "GET", path, None, timeout=30.0,
+                        trace=ctx)
                 except OSError:
                     status = None
                 if status is not None:
@@ -1741,7 +1944,8 @@ class _RouterHandler(_Handler):
                 continue
             try:
                 status, _ctype, body = self.router._forward(
-                    replica, "GET", "/stats", None, timeout=10.0)
+                    replica, "GET", "/stats", None, timeout=10.0,
+                    trace=None)
                 if status == 200:
                     worker["stats"] = json.loads(body)
             except (OSError, ValueError):
@@ -1756,6 +1960,11 @@ class _RouterHandler(_Handler):
         if body is None:
             return
         payload = json.dumps(body).encode()
+        # Session opens mint their own context: the worker adopts it
+        # as the session trace_id, so every later event batch, SSE
+        # attach, and migration hop for this session can be stitched
+        # back to this admission.
+        ctx = fleettrace.mint()
         tried: set = set()
         while True:
             try:
@@ -1774,7 +1983,7 @@ class _RouterHandler(_Handler):
             tried.add(replica.index)
             try:
                 status, ctype, out = self.router._forward(
-                    replica, "POST", "/session", payload)
+                    replica, "POST", "/session", payload, trace=ctx)
             except ForwardNotSent:
                 # Connect refused: no worker saw the open — re-pick.
                 self.router.mark_forward_error(replica)
@@ -1805,6 +2014,12 @@ class _RouterHandler(_Handler):
                     self.router.pin(sid, replica,
                                     self.router._session_pins)
                     self.router.note_session(sid)
+                    self.router.note_session_trace(sid, ctx.trace_id)
+                    if tracer.active:
+                        tracer.instant("router_session_open", "fleet",
+                                       trace_id=ctx.trace_id,
+                                       session=sid,
+                                       replica=replica.index)
             except ValueError:
                 pass
         self._reply(status, out, ctype)
@@ -1851,7 +2066,13 @@ class _RouterHandler(_Handler):
                 raw = json.dumps(doc).encode()
         except ValueError:
             pass  # the worker's validation answers malformed bodies
-        self._proxy(replica, "PATCH", path, raw)
+        tid = self.router.trace_for(sid)
+        ctx = fleettrace.TraceContext(tid) if tid else fleettrace.mint()
+        if tracer.active:
+            tracer.instant("router_session_events", "fleet",
+                           trace_id=ctx.trace_id, session=sid,
+                           replica=replica.index)
+        self._proxy(replica, "PATCH", path, raw, trace=ctx)
 
     def do_DELETE(self):  # noqa: N802 — stdlib name
         path = self.path.split("?", 1)[0]
@@ -1860,9 +2081,142 @@ class _RouterHandler(_Handler):
             return
         replica = self._session_replica(path)
         if replica is not None:
-            self._proxy(replica, "DELETE", path, None)
+            sid = path[len("/session/"):].split("/", 1)[0]
+            tid = self.router.trace_for(sid)
+            self._proxy(replica, "DELETE", path, None,
+                        trace=fleettrace.TraceContext(tid)
+                        if tid else None)
 
-    def _proxy_sse(self, replica: Replica, path: str):
+    # -- fleet trace / telemetry surfaces (ISSUE 20) --------------------- #
+
+    def _fleet_spans(self):
+        """Collector ingest: replicas POST batches of completed spans
+        here.  Shipping is lossy-by-design on the worker side; this
+        endpoint only validates and files what arrives."""
+        raw = self._read_body()
+        if raw is None:
+            return
+        collector = self.router.collector
+        if collector is None:
+            self._json(503, {"error": "fleet trace collector is not "
+                                      "attached", "retry": True})
+            return
+        try:
+            doc = json.loads(raw)
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+            out = collector.ingest(doc)
+        except ValueError as exc:
+            self._json(400, {"error": f"bad span batch: {exc}"})
+            return
+        self._json(200, out)
+
+    def _fleet_trace(self):
+        """The merged fleet trace, live: one lane per source (router
+        + each replica), rebased onto the router's clock — the same
+        document `pydcop fleet forensics --trace` consumes offline."""
+        collector = self.router.collector
+        if collector is None:
+            self._json(503, {"error": "fleet tracing is disabled "
+                                      "(PYDCOP_FLEET_TRACE=0)"})
+            return
+        self._json(200, collector.merged_doc())
+
+    def _fleet_metrics(self):
+        """Every replica's metric registry plus the router's own,
+        merged under a `replica` label.  Per-source samples survive
+        the merge, so conservation checks (summed replica counters ==
+        router admission ledger) read straight off this surface."""
+        from pydcop_tpu.observability import metrics as metrics_mod
+
+        router = self.router
+        snaps: Dict[str, Dict] = {
+            "router": self.telemetry.registry.snapshot()}
+        for replica in router.replicas:
+            if replica.status != UP:
+                continue
+            try:
+                status, _ctype, body = router._forward(
+                    replica, "GET", "/metrics.json", None,
+                    timeout=10.0, trace=None)
+                if status == 200:
+                    snaps[f"replica-{replica.index}"] = \
+                        json.loads(body)
+            except (OSError, ValueError):
+                continue  # a recovering replica just skips one scrape
+        merged = metrics_mod.merge_snapshots(snaps)
+        query = (self.path.split("?", 1)[1]
+                 if "?" in self.path else "")
+        if "format=json" in query:
+            self._json(200, {"sources": sorted(snaps),
+                             "metrics": merged})
+            return
+        text = metrics_mod.render_snapshot_prometheus(merged)
+        self._reply(200, text.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+
+    def _fleet_profile(self):
+        """Pooled efficiency rollup: each UP replica's /profile doc,
+        device-time-weighted into one fleet attainment + summed
+        ledgers."""
+        from pydcop_tpu.observability import efficiency
+
+        router = self.router
+        docs: Dict[str, Dict] = {}
+        for replica in router.replicas:
+            if replica.status != UP:
+                continue
+            try:
+                status, _ctype, body = router._forward(
+                    replica, "GET", "/profile", None,
+                    timeout=10.0, trace=None)
+                if status == 200:
+                    docs[f"replica-{replica.index}"] = \
+                        json.loads(body)
+            except (OSError, ValueError):
+                continue
+        self._json(200, efficiency.pooled_rollup(docs))
+
+    def _fleet_forensics(self, rid: str):
+        """One request's full causal story, reconstructed from the
+        merged fleet trace: the admission span, every route pick and
+        retry, and the winning replica's serve ledger — as the same
+        query document `pydcop fleet forensics` renders."""
+        from pydcop_tpu.observability.trace import query_request
+
+        collector = self.router.collector
+        if collector is None:
+            self._json(503, {"error": "fleet tracing is disabled "
+                                      "(PYDCOP_FLEET_TRACE=0)"})
+            return
+        rid = rid.split("?", 1)[0].strip("/")
+        if not rid:
+            self._json(400, {"error": "need /fleet/forensics/<id>"})
+            return
+        events = collector.merged_events()
+        trace_id = self.router.trace_for(rid)
+        if trace_id is None:
+            # Fall back to scanning: a request (or session) id that
+            # aged out of the bounded map may still live in the
+            # retained spans themselves.
+            for ev in events:
+                args = ev.get("args") or {}
+                if rid in (args.get("request"), args.get("session")):
+                    trace_id = args.get("trace_id")
+                    if trace_id:
+                        break
+        if not trace_id:
+            self._json(404, {"error": f"unknown request {rid!r}: no "
+                                      "trace recorded (tracing off, "
+                                      "spans dropped, or id aged "
+                                      "out)"})
+            return
+        doc = query_request(events, trace_id)
+        doc["request_id"] = rid
+        doc["dropped_spans"] = collector.dropped_spans()
+        self._json(200, doc)
+
+    def _proxy_sse(self, replica: Replica, path: str, trace=None):
         """Stream a worker's per-session SSE through: chunks are
         relayed as they arrive until either side closes.
 
@@ -1874,12 +2228,15 @@ class _RouterHandler(_Handler):
         replica is still UP just keeps reading (the worker's 1 s
         keepalives make that rare)."""
         read_timeout = max(self.router.heartbeat_s * 8, 3.0)
+        headers = ({fleettrace.HEADER: trace.encode()}
+                   if trace is not None and fleettrace.enabled()
+                   else None)
         try:
             conn, resp = netfault.open_stream(
                 "router",
                 (f"replica-{replica.index}", replica.host_id or ""),
                 replica.host, replica.port, "GET", path, None,
-                FORWARD_TIMEOUT_S)
+                FORWARD_TIMEOUT_S, headers=headers)
         except OSError as exc:
             self._json(503, {"error": f"replica unreachable ({exc})"})
             return
@@ -1938,9 +2295,15 @@ class RouterFrontEnd(TelemetryServer):
         super().start()
         self._prior_provider = get_health_provider()
         set_health_provider(self.router.health_summary)
+        if fleettrace.enabled():
+            # The front end's own URL is the collector address every
+            # replica ships spans to; attaching also pushes that
+            # config to workers already UP.
+            self.router.attach_collector(self.url)
         return self
 
     def stop(self):
+        self.router.detach_collector()
         set_health_provider(self._prior_provider)
         self._prior_provider = None
         super().stop()

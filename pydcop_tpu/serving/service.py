@@ -573,7 +573,8 @@ class SolveService:
     def submit(self, dcop: DCOP,
                params: Optional[Dict[str, Any]] = None,
                request_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> str:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> str:
         """Admit, compile and enqueue one problem; returns the request
         id.  Raises :class:`~pydcop_tpu.serving.admission.
         AdmissionRejected` (429/503 at the front end) on backpressure
@@ -593,7 +594,10 @@ class SolveService:
         over the wire, journaled with the accepted record, stamped on
         every span the request later touches) — ``pydcop trace query
         --request <trace_id>`` reconstructs the request's span tree
-        from a trace file.
+        from a trace file.  A caller-supplied ``trace_id`` (the fleet
+        router's wire-propagated context, ISSUE 20) is adopted
+        instead, so this replica's spans nest under the router's
+        admission trace in the fleet collector.
 
         Compilation happens HERE, on the submitting thread: structure
         errors surface synchronously, concurrent clients compile in
@@ -604,7 +608,7 @@ class SolveService:
         if not self._started:
             raise RuntimeError("SolveService is not started")
         t_submit = time.perf_counter()
-        trace_id = uuid.uuid4().hex[:16]
+        trace_id = trace_id or uuid.uuid4().hex[:16]
         if not tracer.active:
             return self._submit(dcop, params, request_id, deadline_s,
                                 t_submit, trace_id)
@@ -633,6 +637,14 @@ class SolveService:
                     self.deduped += 1
             if known:
                 self._req_total.inc(status="deduped")
+                # Telemetry-visible dedupe: the fleet forensics tree
+                # proves "N deliveries, one execute" from this
+                # instant alone (it carries the router's propagated
+                # trace_id, same as the winning delivery's spans).
+                if tracer.active:
+                    tracer.instant("serve_dedupe", "serving",
+                                   request=request_id,
+                                   trace_id=trace_id)
                 return request_id
         try:
             self.admission.admit(self._queue.qsize())
@@ -703,6 +715,9 @@ class SolveService:
             with self._lock:
                 self.deduped += 1
             self._req_total.inc(status="deduped")
+            if tracer.active:
+                tracer.instant("serve_dedupe", "serving",
+                               request=request_id, trace_id=trace_id)
             return request_id
         except WidthRejected:
             # Its own ledger status: an over-wide exact request is a

@@ -513,7 +513,9 @@ class SessionManager:
     def apply_events(self, session_id: str,
                      events: List[Dict[str, Any]],
                      wait: Optional[float] = None,
-                     epoch: Optional[int] = None) -> Dict[str, Any]:
+                     epoch: Optional[int] = None,
+                     trace_id: Optional[str] = None
+                     ) -> Dict[str, Any]:
         """Acknowledge one event batch: validate (400s raise here),
         journal it (the ack is durable), enqueue the apply.  With
         ``wait`` (seconds), block for the post-event segment and
@@ -533,7 +535,11 @@ class SessionManager:
         if epoch is not None and int(epoch) != sess.epoch:
             raise StaleEpoch(session_id, sess.epoch, epoch)
         events = validate_events(events)
-        batch_trace = uuid.uuid4().hex[:16]
+        # A router-propagated batch context (ISSUE 20) is adopted as
+        # this batch's trace id so the apply's spans land in the same
+        # fleet trace as the router's forwarding instant; a direct
+        # client's batch mints its own, as before.
+        batch_trace = trace_id or uuid.uuid4().hex[:16]
         # seq assignment, journal append and enqueue are ONE atomic
         # step per session: with concurrent PATCHes (the front end is
         # a threading HTTP server) a later seq must never reach the

@@ -1,0 +1,801 @@
+"""Fleet trace plane battery (ISSUE 20): wire-propagated trace
+context, lossy span shipping, the router-merged collector, and the
+aggregated fleet surfaces.
+
+- the ``X-Pydcop-Trace`` codec: roundtrip, parent annotation, and
+  garbage tolerance (a malformed header must yield None, never an
+  error on the request path);
+- :class:`SpanShipper` is provably non-blocking and lossy-honest: a
+  stalled/dead collector bounds the queue, counts every drop, and
+  ``record()`` stays O(1) fast; a live collector receives everything
+  with ``dropped_spans == 0``;
+- :class:`FleetCollector` merges per-source lanes onto one clock
+  (anchor rebase, tid namespacing, id striding) such that
+  ``query_request`` reconstructs a well-nested tree from it;
+- ``merge_snapshots``/``render_snapshot_prometheus`` preserve every
+  per-replica sample under a ``replica`` label (the conservation
+  property ``/fleet/metrics`` is built on) and render valid
+  exposition text;
+- ``efficiency.pooled_rollup`` sums ledgers and device-time-weights
+  attainment;
+- a REAL 2-replica fleet over HTTP: submit/session/SSE context
+  propagation (the worker adopts the router-minted trace_id),
+  ``/fleet/metrics`` conservation against the router's admission
+  ledger, pooled ``/fleet/profile``, live + offline forensics (the
+  ``pydcop fleet forensics`` command), unknown-request 404;
+- the acceptance proof: under a seeded netfault plan that loses a
+  /solve response after execution, ``/fleet/forensics/<id>`` shows
+  ONE well-nested tree containing the route pick, the injected
+  fault, the retry hop, the dedupe hit, and exactly one execute
+  span — idempotency proven from telemetry alone.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pydcop_tpu.dcop.dcop import DCOP
+from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+from pydcop_tpu.dcop.relations import NAryMatrixRelation
+from pydcop_tpu.observability import fleettrace
+from pydcop_tpu.observability.fleettrace import (
+    FleetCollector,
+    SpanShipper,
+    TraceContext,
+)
+from pydcop_tpu.observability.trace import query_request
+
+
+def _ring(n: int, seed: int) -> DCOP:
+    rng = np.random.default_rng(seed)
+    dom = Domain("c", "", [0, 1, 2])
+    dcop = DCOP(f"ftrace_{n}_{seed}", objective="min")
+    vs = [Variable(f"v{i}", dom) for i in range(n)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(n):
+        table = rng.integers(0, 10, size=(3, 3)).astype(float)
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[k], vs[(k + 1) % n]], table, f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    return dcop
+
+
+def _req(url, method="GET", payload=None, timeout=30, raw=False):
+    data = (json.dumps(payload).encode()
+            if payload is not None else None)
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = resp.read()
+            return resp.status, (body if raw else json.loads(body))
+    except urllib.error.HTTPError as err:
+        body = err.read()
+        if not raw:
+            try:
+                body = json.loads(body)
+            except ValueError:
+                pass
+        return err.code, body
+
+
+def _tree_nodes(roots):
+    for node in roots:
+        yield node
+        yield from _tree_nodes(node["children"])
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------------ #
+# wire codec
+
+
+class TestTraceContextCodec:
+    def test_roundtrip(self):
+        ctx = TraceContext("abc123")
+        assert TraceContext.decode(ctx.encode()).trace_id == "abc123"
+        assert TraceContext.decode(ctx.encode()).parent is None
+
+    def test_roundtrip_with_parent(self):
+        ctx = TraceContext("abc123", parent="42")
+        back = TraceContext.decode(ctx.encode())
+        assert (back.trace_id, back.parent) == ("abc123", "42")
+
+    def test_garbage_tolerant(self):
+        for bad in (None, "", "   ", ";;;", ";parent=5",
+                    "x" * 300, 17):
+            assert TraceContext.decode(bad) is None, bad
+
+    def test_decode_headers(self):
+        class Headers(dict):
+            pass
+
+        hdrs = Headers({fleettrace.HEADER: "tid9;parent=7"})
+        ctx = fleettrace.decode_headers(hdrs)
+        assert (ctx.trace_id, ctx.parent) == ("tid9", "7")
+        assert fleettrace.decode_headers(Headers()) is None
+
+    def test_mint_is_unique(self):
+        ids = {fleettrace.mint().trace_id for _ in range(64)}
+        assert len(ids) == 64
+
+
+# ------------------------------------------------------------------ #
+# span shipper: bounded, non-blocking, lossy-honest
+
+
+class TestSpanShipper:
+    def _event(self, i):
+        return {"name": f"s{i}", "cat": "t", "ph": "X",
+                "ts": float(i), "dur": 1.0, "id": i, "tid": 0,
+                "args": {"trace_id": "t0"}}
+
+    def test_bounded_and_fast_under_stalled_collector(self):
+        """10k records against a dead collector: the queue never
+        exceeds its cap, every overflow is counted, and record()
+        stays O(1) — span shipping must not backpressure solves."""
+        shipper = SpanShipper("test", max_queue=512, batch_max=64,
+                              flush_interval_s=3600.0)
+        shipper.set_target(
+            f"http://127.0.0.1:{_free_port()}", "test")
+        t0 = time.perf_counter()
+        for i in range(10_000):
+            shipper.record(self._event(i))
+        elapsed = time.perf_counter() - t0
+        assert len(shipper._queue) <= 512
+        assert shipper.dropped_spans >= 10_000 - 512
+        assert elapsed < 2.0, (
+            f"record() of 10k events took {elapsed:.2f}s — the "
+            "bounded queue must make drops O(1)")
+        # The dead collector turns the next flush's batch into
+        # counted drops, never an exception, never a retry.
+        before = shipper.dropped_spans
+        shipped = shipper.flush()
+        assert shipped == 0
+        assert shipper.dropped_spans == before + 64
+        assert shipper.shipped == 0
+
+    def test_unconfigured_url_counts_drops(self):
+        shipper = SpanShipper("test", max_queue=64)
+        for i in range(10):
+            shipper.record(self._event(i))
+        assert shipper.flush() == 0
+        assert shipper.dropped_spans == 10
+
+    def test_live_collector_receives_everything(self):
+        """A reachable collector gets every queued event batch-wise
+        with zero drops — lossiness is a failure-mode contract, not a
+        sampling strategy."""
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        collector = FleetCollector()
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                raw = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                collector.ingest(json.loads(raw))
+                out = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+        server = HTTPServer(("127.0.0.1", 0), Handler)
+        thread = threading.Thread(target=server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        try:
+            shipper = SpanShipper("replica-9", batch_max=16)
+            shipper.set_target(
+                f"http://127.0.0.1:{server.server_port}",
+                "replica-9")
+            for i in range(40):
+                shipper.record(self._event(i))
+            total = 0
+            while total < 40:
+                n = shipper.flush()
+                assert n > 0, "flush stalled with events queued"
+                total += n
+            assert shipper.dropped_spans == 0
+            assert shipper.shipped == 40
+            assert collector.sources() == ["replica-9"]
+            merged = collector.merged_events()
+            assert len(merged) == 40
+            assert all(ev["tid"] == "replica-9:0" for ev in merged)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_record_copies_live_events(self):
+        """Recorded events are LIVE dicts on the hot path; the
+        shipper must snapshot event + args at record time."""
+        shipper = SpanShipper("test")
+        ev = self._event(0)
+        shipper.record(ev)
+        ev["args"]["trace_id"] = "mutated"
+        ev["name"] = "mutated"
+        queued = shipper._queue[0]
+        assert queued["name"] == "s0"
+        assert queued["args"]["trace_id"] == "t0"
+
+
+# ------------------------------------------------------------------ #
+# collector merge
+
+
+class TestFleetCollector:
+    def _batch(self, source, anchor_unix_us, events):
+        return {
+            "source": source,
+            "header": {"anchor_perf_us": 0.0,
+                       "anchor_unix_us": anchor_unix_us},
+            "dropped_spans": 0,
+            "events": events,
+        }
+
+    def test_merge_rebases_and_namespaces(self):
+        """Two sources with different clock anchors merge onto one
+        axis: a replica event stamped 'earlier' in perf time but
+        anchored later lands later; tids are namespaced and span ids
+        strided so query_request can't cross-wire lanes."""
+        collector = FleetCollector()
+        collector.ingest(self._batch("router", 1_000_000.0, [
+            {"name": "router_request", "cat": "fleet", "ph": "X",
+             "ts": 0.0, "dur": 500.0, "id": 1, "tid": 5,
+             "args": {"trace_id": "tA", "request": "r1"}},
+        ]))
+        collector.ingest(self._batch("replica-0", 1_000_100.0, [
+            {"name": "serve_dispatch", "cat": "serving", "ph": "X",
+             "ts": 10.0, "dur": 200.0, "id": 1, "tid": 5,
+             "args": {"trace_ids": ["tA"]}},
+            {"name": "serve_dedupe", "cat": "serving", "ph": "i",
+             "ts": 300.0, "id": 2, "tid": 5,
+             "args": {"trace_id": "tA"}},
+        ]))
+        merged = collector.merged_events()
+        assert len(merged) == 3
+        tids = {ev["tid"] for ev in merged}
+        assert tids == {"router:5", "replica-0:5"}
+        by_name = {ev["name"]: ev for ev in merged}
+        # Anchor rebase: replica-0's perf ts=10 sits at unix
+        # 1_000_110 vs the router span's 1_000_000 -> +110us.
+        assert by_name["router_request"]["ts"] == pytest.approx(0.0)
+        assert by_name["serve_dispatch"]["ts"] == pytest.approx(110.0)
+        # Id striding keeps same-valued per-process ids distinct.
+        assert (by_name["router_request"]["id"]
+                != by_name["serve_dispatch"]["id"])
+
+        doc = query_request(merged, "tA")
+        assert doc["events"] == 3
+        assert doc["well_nested"]
+        assert doc["lanes"] == 2
+        # The dispatch nests under the router span in time; the
+        # dedupe instant attaches to the dispatch's lane.
+        names = set(doc["names"])
+        assert names == {"router_request", "serve_dispatch",
+                         "serve_dedupe"}
+
+    def test_lane_bound_and_drop_ledger(self):
+        collector = FleetCollector(lane_events=100)
+        events = [{"name": f"e{i}", "cat": "t", "ph": "i",
+                   "ts": float(i), "id": i, "tid": 0, "args": {}}
+                  for i in range(250)]
+        out = collector.ingest(self._batch("replica-1", 0.0, events))
+        assert out == {"accepted": 250, "source": "replica-1"}
+        collector.ingest({"source": "replica-1", "header": {},
+                          "dropped_spans": 17, "events": []})
+        doc = collector.merged_doc()
+        assert doc["sources"][0]["events"] == 100  # bounded lane
+        assert doc["dropped_spans"] == 17
+
+    def test_ingest_rejects_bad_batch(self):
+        collector = FleetCollector()
+        with pytest.raises(ValueError):
+            collector.ingest({"source": "x", "events": 3})
+
+
+# ------------------------------------------------------------------ #
+# merged metrics + pooled profile (pure functions)
+
+
+class TestMergeSnapshots:
+    SNAPS = {
+        "replica-0": {
+            "pydcop_requests_total": {
+                "kind": "counter",
+                "samples": [
+                    {"labels": {"status": "ok"}, "value": 3.0},
+                    {"labels": {"status": "deduped"}, "value": 1.0},
+                ]},
+            "pydcop_request_latency_seconds": {
+                "kind": "histogram",
+                "samples": [{
+                    "labels": {}, "count": 3, "sum": 0.3,
+                    "buckets": {0.1: 1, 1.0: 3},
+                    "exemplars": {}}]},
+        },
+        "replica-1": {
+            "pydcop_requests_total": {
+                "kind": "counter",
+                "samples": [
+                    {"labels": {"status": "ok"}, "value": 2.0},
+                ]},
+        },
+    }
+
+    def test_conservation_under_merge(self):
+        """Merging must PRESERVE per-source samples (labeled, not
+        summed): the /fleet/metrics conservation check — summed
+        ``pydcop_requests_total`` across replica labels equals the
+        router admission ledger — reads directly off the output."""
+        from pydcop_tpu.observability.metrics import merge_snapshots
+
+        merged = merge_snapshots(self.SNAPS)
+        samples = merged["pydcop_requests_total"]["samples"]
+        assert len(samples) == 3
+        assert all("replica" in s["labels"] for s in samples)
+        ok = sum(s["value"] for s in samples
+                 if s["labels"]["status"] == "ok")
+        assert ok == 5.0
+        per_replica = {s["labels"]["replica"]: s["value"]
+                       for s in samples
+                       if s["labels"]["status"] == "ok"}
+        assert per_replica == {"replica-0": 3.0, "replica-1": 2.0}
+
+    def test_prometheus_render(self):
+        from pydcop_tpu.observability.metrics import (
+            merge_snapshots,
+            render_snapshot_prometheus,
+        )
+
+        text = render_snapshot_prometheus(
+            merge_snapshots(self.SNAPS))
+        assert ("pydcop_requests_total{replica=\"replica-0\","
+                "status=\"ok\"} 3" in text)
+        assert "# TYPE pydcop_requests_total counter" in text
+        assert ("pydcop_request_latency_seconds_count"
+                "{replica=\"replica-0\"} 3" in text)
+        assert "le=\"+Inf\"" in text
+
+
+class TestPooledRollup:
+    def test_sums_and_weighted_attainment(self):
+        from pydcop_tpu.observability.efficiency import pooled_rollup
+
+        docs = {
+            "replica-0": {
+                "backends": {"cpu": {"attainment": 0.2,
+                                     "execute_s": 3.0}},
+                "ledger": {"components_s": {"execute": 3.0},
+                           "counts": {"requests": 4},
+                           "total_s": 4.0,
+                           "unaccounted_abs_s": 0.1},
+                "waste_by_cause": {"padding": 0.5},
+                "jit": {"cold_dispatches": 1, "warm_dispatches": 9,
+                        "cold_compile_s": 2.0},
+                "pipeline": {"overlap_s": 1.0, "execute_s": 3.0,
+                             "dispatches": 10},
+            },
+            "replica-1": {
+                "backends": {"cpu": {"attainment": 0.6,
+                                     "execute_s": 1.0}},
+                "ledger": {"components_s": {"execute": 1.0},
+                           "counts": {"requests": 2},
+                           "total_s": 2.0,
+                           "unaccounted_abs_s": 0.0},
+                "waste_by_cause": {},
+                "jit": {"cold_dispatches": 0, "warm_dispatches": 5,
+                        "cold_compile_s": 0.0},
+                "pipeline": {"overlap_s": 0.5, "execute_s": 1.0,
+                             "dispatches": 5},
+            },
+        }
+        pooled = pooled_rollup(docs)
+        assert pooled["n_replicas"] == 2
+        # Device-time weighting: (0.2*3 + 0.6*1) / 4 = 0.3 — the
+        # busy replica dominates.
+        assert pooled["attainment"] == pytest.approx(0.3)
+        assert pooled["ledger"]["components_s"]["execute"] \
+            == pytest.approx(4.0)
+        assert pooled["ledger"]["counts"]["requests"] == 6
+        assert pooled["jit"]["warm_dispatches"] == 14
+        assert pooled["pipeline"]["dispatches"] == 15
+        assert set(pooled["replicas"]) == set(docs)
+
+    def test_empty_fleet(self):
+        from pydcop_tpu.observability.efficiency import pooled_rollup
+
+        pooled = pooled_rollup({})
+        assert pooled["n_replicas"] == 0
+        assert pooled["attainment"] is None
+
+
+# ------------------------------------------------------------------ #
+# real 2-replica fleet: propagation, conservation, forensics
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    from pydcop_tpu import api
+
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.05,
+                       heartbeat_s=0.2)
+    try:
+        yield handle
+    finally:
+        handle.stop()
+
+
+def _ok_total(url):
+    """Summed ``pydcop_requests_total{status=ok}`` across replica
+    labels off the merged fleet scrape."""
+    status, doc = _req(url + "/fleet/metrics?format=json")
+    assert status == 200, doc
+    fam = doc["metrics"].get("pydcop_requests_total",
+                             {"samples": []})
+    return sum(s["value"] for s in fam["samples"]
+               if s["labels"].get("status") == "ok")
+
+
+class TestFleetSurfaces:
+    def test_metrics_conservation_against_router_ledger(self, fleet):
+        """Delta-based conservation on a seeded burst: N routed
+        solves move BOTH the summed replica-labeled ok-counter and
+        the router's admission ledger by exactly N."""
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        url = fleet.url
+        ok_before = _ok_total(url)
+        routed_before = fleet.router.stats()["routed"]
+        n = 4
+        for i in range(n):
+            status, out = _req(url + "/solve", "POST", {
+                "dcop": dcop_yaml(_ring(7 + (i % 2), 40 + i)),
+                "params": {"max_cycles": 50},
+                "wait": True, "timeout": 120})
+            assert status == 200 and out["status"] == "FINISHED", out
+        assert fleet.router.stats()["routed"] - routed_before == n
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if _ok_total(url) - ok_before == n:
+                break
+            time.sleep(0.2)
+        assert _ok_total(url) - ok_before == n, (
+            "merged replica counters do not conserve the admission "
+            "ledger")
+        # The text rendering carries the same labeled rows.
+        status, text = _req(url + "/fleet/metrics", raw=True)
+        assert status == 200
+        assert b'replica="replica-' in text
+        assert b"pydcop_requests_total" in text
+
+    def test_submit_propagation_and_live_forensics(self, fleet):
+        """The worker adopts the router-minted trace_id (the submit
+        ack's trace_id matches the forensics doc) and the live tree
+        contains the route pick plus the winning replica's serve
+        ledger on a separate lane."""
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        url = fleet.url
+        status, ack = _req(url + "/solve", "POST", {
+            "dcop": dcop_yaml(_ring(9, 77)),
+            "params": {"max_cycles": 50}})
+        assert status == 202, ack
+        rid = ack["id"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            code, out = _req(url + f"/result/{rid}", timeout=10)
+            if code == 200:
+                break
+            time.sleep(0.1)
+        assert code == 200 and out["status"] == "FINISHED"
+
+        doc, names = {}, set()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            code, doc = _req(url + f"/fleet/forensics/{rid}")
+            if code == 200:
+                names = set(doc["names"])
+                if "serve_dispatch" in names:
+                    break
+            time.sleep(0.25)
+        assert code == 200, doc
+        assert doc["request_id"] == rid
+        # The ack's trace_id IS the router-minted one: adoption, not
+        # coincidence.
+        assert doc["trace_id"] == ack["trace_id"]
+        assert doc["well_nested"]
+        assert doc["lanes"] >= 2, "router + replica lanes expected"
+        assert "router_request" in names
+        assert "router_route_pick" in names
+        assert {"serve_submit", "serve_dispatch"} <= names, names
+        picks = [node for node in _tree_nodes(doc["tree"])
+                 if node["name"] == "router_route_pick"]
+        assert picks and "reason" in picks[0]["args"]
+        assert "replica" in picks[0]["args"]
+
+    def test_session_sse_propagation(self, fleet):
+        """Open/PATCH/SSE-attach all join the session's trace: one
+        forensics tree per session id spanning router and worker
+        lanes."""
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        url = fleet.url
+        status, ack = _req(url + "/session", "POST", {
+            "dcop": dcop_yaml(_ring(6, 91)),
+            "params": {"max_cycles": 40}})
+        assert status == 201, ack
+        sid = ack["session_id"]
+        try:
+            code, out = _req(url + f"/session/{sid}/events", "PATCH", {
+                "events": [{"type": "change_factor", "name": "c0",
+                            "table": [[1, 2, 3], [4, 5, 6],
+                                      [7, 8, 9]]}],
+                "wait": True}, timeout=60)
+            assert code == 200 and out["applied"] is True, out
+            stream = urllib.request.urlopen(
+                url + f"/session/{sid}/events", timeout=10)
+            time.sleep(0.3)
+            stream.close()
+
+            doc, names = {}, set()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                code, doc = _req(url + f"/fleet/forensics/{sid}")
+                if code == 200:
+                    names = set(doc["names"])
+                    if {"session_open", "session_events",
+                            "session_stream_attach"} <= names:
+                        break
+                time.sleep(0.25)
+            assert code == 200, doc
+            assert doc["trace_id"] == ack["trace_id"]
+            assert doc["well_nested"]
+            assert "router_session_open" in names
+            assert "router_session_events" in names
+            assert "session_open" in names
+            assert "session_events" in names
+            assert "session_stream_attach" in names
+        finally:
+            _req(url + f"/session/{sid}", "DELETE")
+
+    def test_fleet_profile_pools_both_replicas(self, fleet):
+        status, doc = _req(fleet.url + "/fleet/profile")
+        assert status == 200, doc
+        assert doc["n_replicas"] == 2
+        assert set(doc["replicas"]) == {"replica-0", "replica-1"}
+        ledger = doc["ledger"]
+        total = max(float(ledger.get("total_s") or 0.0), 1e-9)
+        assert abs(float(ledger.get("unaccounted_abs_s") or 0.0)) \
+            <= 0.05 * total
+
+    def test_unknown_request_404(self, fleet):
+        status, doc = _req(fleet.url + "/fleet/forensics/nosuchid")
+        assert status == 404
+        assert "nosuchid" in doc["error"]
+
+    def test_offline_forensics_command(self, fleet, tmp_path,
+                                       capsys):
+        """Save /fleet/trace to disk, then reconstruct the tree with
+        ``pydcop fleet forensics --trace FILE`` — same machinery,
+        no live router needed."""
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        url = fleet.url
+        status, ack = _req(url + "/solve", "POST", {
+            "dcop": dcop_yaml(_ring(8, 55)),
+            "params": {"max_cycles": 50},
+            "wait": True, "timeout": 120})
+        assert status == 200, ack
+        rid = ack["id"]
+        # Wait for the worker's spans to ship before snapshotting.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            code, doc = _req(url + f"/fleet/forensics/{rid}")
+            if code == 200 and "serve_dispatch" in doc["names"]:
+                break
+            time.sleep(0.25)
+        status, trace_doc = _req(url + "/fleet/trace")
+        assert status == 200
+        assert trace_doc["version"] == 1
+        path = tmp_path / "fleet_trace.json"
+        path.write_text(json.dumps(trace_doc))
+
+        import argparse
+
+        from pydcop_tpu.commands import fleet as fleet_cmd
+
+        args = argparse.Namespace(
+            request_id=rid, url=None, trace=[str(path)],
+            timeout=10.0, as_json=True)
+        rc = fleet_cmd.run_forensics(args)
+        out = capsys.readouterr().out
+        assert rc == 0
+        offline = json.loads(out)
+        assert offline["request_id"] == rid
+        assert offline["well_nested"]
+        assert "router_route_pick" in offline["names"]
+
+        # The annotated timeline printer: callouts for route picks.
+        args = argparse.Namespace(
+            request_id=rid, url=None, trace=[str(path)],
+            timeout=10.0, as_json=False)
+        rc = fleet_cmd.run_forensics(args)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[route-pick]" in out
+        assert f"request {rid}" in out
+
+        # Unknown id offline -> exit 1.
+        args = argparse.Namespace(
+            request_id="nope", url=None, trace=[str(path)],
+            timeout=10.0, as_json=False)
+        assert fleet_cmd.run_forensics(args) == 1
+
+    def test_live_forensics_command(self, fleet, capsys):
+        """--url mode against the running router front end."""
+        import argparse
+
+        from pydcop_tpu.commands import fleet as fleet_cmd
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        status, ack = _req(fleet.url + "/solve", "POST", {
+            "dcop": dcop_yaml(_ring(8, 56)),
+            "params": {"max_cycles": 50},
+            "wait": True, "timeout": 120})
+        assert status == 200, ack
+        args = argparse.Namespace(
+            request_id=ack["id"], url=fleet.url, trace=None,
+            timeout=10.0, as_json=True)
+        rc = fleet_cmd.run_forensics(args)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert json.loads(out)["trace_id"] == ack["trace_id"]
+        # Exactly one of --url/--trace: both or neither is exit 2.
+        args = argparse.Namespace(
+            request_id="x", url=None, trace=None,
+            timeout=10.0, as_json=False)
+        assert fleet_cmd.run_forensics(args) == 2
+
+
+# ------------------------------------------------------------------ #
+# the acceptance proof: forensics under injected faults
+
+
+class TestForensicsUnderFaults:
+    def test_retried_request_tree_proves_idempotency(self):
+        """A /solve whose response is LOST after execution: the
+        router retries, the worker dedupes, and the forensics tree —
+        telemetry alone — shows the route pick, the injected fault,
+        the retry hop, the dedupe hit, and EXACTLY ONE execute span,
+        well-nested, with the winning replica's serve ledger."""
+        from pydcop_tpu import api
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+        from pydcop_tpu.serving import netfault
+
+        handle = api.serve(port=0, replicas=2, batch_window_s=0.05,
+                           heartbeat_s=0.15)
+        try:
+            url = handle.url
+            netfault.install(
+                "seed=20;link=router>replica-*,path=/solve,"
+                "lose_response=1.0,times=1")
+            status, ack = _req(url + "/solve", "POST", {
+                "dcop": dcop_yaml(_ring(10, 20)),
+                "params": {"max_cycles": 100},
+                "deadline_s": 30.0})
+            assert status == 202, ack
+            rid = ack["id"]
+            assert netfault.counters().get("lose_response") == 1
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                code, out = _req(url + f"/result/{rid}", timeout=10)
+                if code == 200:
+                    break
+                time.sleep(0.1)
+            assert code == 200 and out["status"] == "FINISHED"
+
+            doc, names = {}, set()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                code, doc = _req(url + f"/fleet/forensics/{rid}")
+                if code == 200:
+                    names = set(doc["names"])
+                    if {"router_retry", "serve_dedupe",
+                            "serve_dispatch",
+                            "netfault_injected"} <= names:
+                        break
+                time.sleep(0.25)
+            assert code == 200, doc
+            assert doc["well_nested"], sorted(names)
+            assert "router_route_pick" in names, sorted(names)
+            assert "router_retry" in names, sorted(names)
+            assert "netfault_injected" in names, sorted(names)
+            assert "serve_dedupe" in names, sorted(names)
+            # The winning replica's full serve ledger rode along.
+            assert {"serve_submit", "serve_dispatch"} <= names
+            flat = list(_tree_nodes(doc["tree"]))
+            executes = [n for n in flat
+                        if n["name"] == "serve_dispatch"
+                        and n["ph"] == "X"]
+            assert len(executes) == 1, (
+                f"{len(executes)} executions in the tree — "
+                "idempotent forwarding demands exactly one")
+            retries = [n for n in flat
+                       if n["name"] == "router_retry"]
+            assert len(retries) >= 1
+            assert all(r["args"].get("request") == rid
+                       for r in retries)
+        finally:
+            netfault.clear()
+            handle.stop()
+
+
+# ------------------------------------------------------------------ #
+# knob: PYDCOP_FLEET_TRACE=0 disables the plane
+
+
+class TestFleetTraceKnob:
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv("PYDCOP_FLEET_TRACE", "0")
+        assert not fleettrace.enabled()
+        monkeypatch.setenv("PYDCOP_FLEET_TRACE", "off")
+        assert not fleettrace.enabled()
+        monkeypatch.setenv("PYDCOP_FLEET_TRACE", "1")
+        assert fleettrace.enabled()
+        monkeypatch.delenv("PYDCOP_FLEET_TRACE")
+        assert fleettrace.enabled()
+
+    def test_configure_shipper_respects_knob(self, monkeypatch):
+        monkeypatch.setenv("PYDCOP_FLEET_TRACE", "0")
+        state = fleettrace.configure_shipper(
+            "http://127.0.0.1:1", source="replica-0", enable=True)
+        assert state["enabled"] is False
+        assert fleettrace.shipper() is None
+
+    def test_disabled_fleet_answers_503_on_trace_surfaces(
+            self, monkeypatch):
+        """With the knob off the router never attaches a collector:
+        the trace surfaces answer 503 (disabled), the serving wire
+        keeps working untouched."""
+        from pydcop_tpu import api
+        from pydcop_tpu.dcop.yamldcop import dcop_yaml
+
+        monkeypatch.setenv("PYDCOP_FLEET_TRACE", "0")
+        handle = api.serve(port=0, replicas=2,
+                           batch_window_s=0.05, heartbeat_s=0.2)
+        try:
+            url = handle.url
+            status, out = _req(url + "/solve", "POST", {
+                "dcop": dcop_yaml(_ring(7, 33)),
+                "params": {"max_cycles": 50},
+                "wait": True, "timeout": 120})
+            assert status == 200 and out["status"] == "FINISHED"
+            status, _doc = _req(url + "/fleet/trace")
+            assert status == 503
+            status, _doc = _req(url + "/fleet/forensics/whatever")
+            assert status == 503
+            # The aggregated metric/profile surfaces stay up — they
+            # scrape registries, not spans.
+            status, _doc = _req(url + "/fleet/metrics?format=json")
+            assert status == 200
+        finally:
+            handle.stop()

@@ -378,8 +378,12 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
         # ISSUE 10: wall-clock to the reference cost on the
         # large-domain loopy graph (bench_time_to_cost) — the
         # work-reduction stack's headline, LOWER is better.
+        # Host-bound: wall-clock ms of cpu-resolved compute tracks
+        # host speed; the work-reduction logic itself is gated
+        # load-immune by perf-smoke's same-box decimation-vs-baseline
+        # wall ratio (DECIM_MAX_FRACTION).
         ("time_to_cost", "ttc_value", "ms", "backend", False,
-         "time_to_cost", False),
+         "time_to_cost", True),
         ("serve_recovery", "serve_recovery_value", "s",
          "backend", False, "serve_recovery", True),
         # ISSUE 15: the fleet-scale serving families — aggregate
@@ -405,14 +409,24 @@ def run_check(root: str, rel_tol: float = DEFAULT_REL_TOL,
         # verdict is "insufficient", never a crash or gate.
         ("fleet_faulted", "fleet_faulted_value", "problems/s",
          "backend", True, "fleet_faulted", True),
+        # Host-bound like serve_recovery/session_recovery: on a
+        # cpu-resolved round this wall-clock is host compute, so it
+        # tracks a host-class change 1:1 (r09: identical trees
+        # measured +26% on the shifted box).  Real recovery-path
+        # regressions still gate on quiet rounds, and kernel-level
+        # slowdowns are caught machine-independently by the golden
+        # ratio races in tests/unit/test_perf_regression.py.
         ("shard_recovery", "shard_recovery_value", "s",
-         "sharded_backend", False, "sharded", False),
+         "sharded_backend", False, "sharded", True),
         # ISSUE 17: warm wall-clock of one exact DPOP sweep on the
         # width-bounded seeded instance (ms, LOWER is better) — a
         # brand-new family: until 3 rounds exist its verdict is
-        # "insufficient", never a crash or gate.
+        # "insufficient", never a crash or gate.  Host-bound for the
+        # same reason as shard_recovery: cpu-resolved wall-ms of a
+        # jitted sweep IS host speed; the load-immune dpop kernel
+        # gate lives in test_perf_regression.py.
         ("dpop_exact", "dpop_value", "ms", "backend", False,
-         "dpop_exact", False),
+         "dpop_exact", True),
         # ISSUE 13: the stateful-session families — sustained
         # scenario-event throughput per session (higher is better)
         # and warm time-to-recovered-cost after an event (the

@@ -1293,6 +1293,92 @@ def scenario_fleet_retry_idempotent(seed, trace):
             "elapsed_s": round(elapsed, 2)}
 
 
+def scenario_forensics_under_faults(seed, trace):
+    """ISSUE 20 forensics gate: a request whose response is LOST
+    after execution (netfault lose_response) must be fully
+    reconstructable from telemetry ALONE — ``GET
+    /fleet/forensics/<id>`` shows one well-nested causal tree with
+    the route pick, the retry hop, the dedupe hit on redelivery, and
+    exactly ONE execute (``serve_dispatch``) span.  No log grepping,
+    no worker /stats: the trace plane itself proves idempotency."""
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving import netfault
+
+    journal_dir = tempfile.mkdtemp(prefix="soak_forensics_")
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.05,
+                       journal_dir=journal_dir, heartbeat_s=0.15)
+    try:
+        url = handle.url
+        netfault.install(
+            f"seed={seed};link=router>replica-*,path=/solve,"
+            "lose_response=1.0,times=1")
+        inst = _serve_instance(10, seed)
+        status, body = _fleet_request(
+            url + "/solve", "POST",
+            {"dcop": dcop_yaml(inst),
+             "params": {"max_cycles": 120}, "deadline_s": 30.0})
+        assert status == 202, \
+            f"solve not retried through lost response: " \
+            f"{status} {body}"
+        rid = body["id"]
+        deadline = time.monotonic() + 60
+        code, out = 0, {}
+        while time.monotonic() < deadline:
+            code, out = _fleet_request(
+                url + f"/result/{rid}", timeout=10)
+            if code == 200:
+                break
+            time.sleep(0.1)
+        assert code == 200 and out["status"] == "FINISHED", \
+            f"result lost: {code} {out}"
+
+        # Span shipping is async (bounded batches on a flush
+        # interval): give the worker's shipper a few flushes before
+        # judging the merged tree.
+        def _nodes(roots):
+            for node in roots:
+                yield node
+                yield from _nodes(node["children"])
+
+        names, doc = set(), {}
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            code, doc = _fleet_request(
+                url + f"/fleet/forensics/{rid}", timeout=10)
+            if code == 200:
+                names = set(doc["names"])
+                if {"router_retry", "serve_dedupe",
+                        "serve_dispatch"} <= names:
+                    break
+            time.sleep(0.25)
+        assert code == 200, f"forensics unavailable: {code} {doc}"
+        assert doc["well_nested"], \
+            f"forensics tree not well-nested: {sorted(names)}"
+        assert "router_route_pick" in names, sorted(names)
+        assert "router_retry" in names, \
+            f"retry hop missing from the tree: {sorted(names)}"
+        assert "netfault_injected" in names, \
+            f"injected fault missing from the tree: {sorted(names)}"
+        assert "serve_dedupe" in names, \
+            f"dedupe hit missing from the tree: {sorted(names)}"
+        flat = list(_nodes(doc["tree"]))
+        executes = [n for n in flat
+                    if n["name"] == "serve_dispatch"
+                    and n["ph"] == "X"]
+        assert len(executes) == 1, (
+            f"forensics shows {len(executes)} executions of {rid} "
+            "(idempotent forwarding demands exactly one)")
+        retries = [n for n in flat if n["name"] == "router_retry"]
+    finally:
+        netfault.clear()
+        handle.stop()
+        shutil.rmtree(journal_dir, ignore_errors=True)
+    return {"spans": doc["spans"], "instants": doc["instants"],
+            "lanes": doc["lanes"], "retry_hops": len(retries),
+            "well_nested": doc["well_nested"]}
+
+
 def scenario_anomaly_postmortem(seed, trace):
     """ISSUE 9 anomaly path: an injected guard trip, with file
     tracing OFF and only the always-on flight recorder attached,
@@ -1366,6 +1452,7 @@ SCENARIOS = [
     ("fleet_partition_heal", scenario_fleet_partition_heal),
     ("fleet_gray_failure", scenario_fleet_gray_failure),
     ("fleet_retry_idempotent", scenario_fleet_retry_idempotent),
+    ("forensics_under_faults", scenario_forensics_under_faults),
     ("shard_trip_repartition", scenario_shard_trip_repartition),
     ("anomaly_postmortem", scenario_anomaly_postmortem),
     ("decimation_guard_trip", scenario_decimation_guard_trip),
@@ -1392,6 +1479,7 @@ QUICK_GATE = [
     "fleet_partition_heal",
     "fleet_gray_failure",
     "fleet_retry_idempotent",
+    "forensics_under_faults",
     "shard_trip_repartition",
     "anomaly_postmortem",
     "decimation_guard_trip",

@@ -625,6 +625,109 @@ def check_netfault_overhead() -> dict:
             "overhead": round(ratio - 1, 4)}
 
 
+MAX_FLEETTRACE_OVERHEAD = 1.02  # on/off runtime ratio (<= 2%)
+
+
+def check_fleettrace_overhead() -> dict:
+    """The ISSUE 20 perf gate: fleet tracing ON (context minting,
+    header stamping, route-pick/retry instants, the flight tap and
+    span shipping on both sides) may cost at most 2% on the routed
+    serving hop versus ``PYDCOP_FLEET_TRACE=0``.  The toggle is
+    ``FleetRouter.set_fleet_trace`` — the same env-knob flip + worker
+    config push an operator gets — so both sides of the pair run the
+    honest production path.  Noise discipline is the PR-9 methodology:
+    pairwise-interleaved off/on batches, min-of-N per side,
+    best-of-attempts, early exit once the budget holds.
+
+    Rider invariant: with tracing ON the pooled ``/fleet/profile``
+    ledger must still sum — telemetry that breaks the efficiency
+    accounting is worse than no telemetry."""
+    from urllib.parse import urlsplit
+
+    from pydcop_tpu import api
+    from pydcop_tpu.dcop.dcop import DCOP
+    from pydcop_tpu.dcop.objects import AgentDef, Domain, Variable
+    from pydcop_tpu.dcop.yamldcop import dcop_yaml
+    from pydcop_tpu.serving import netfault
+
+    rng = np.random.default_rng(20)
+    d = Domain("c", "", [0, 1, 2])
+    dcop = DCOP("fleettrace_bench", objective="min")
+    vs = [Variable(f"v{i}", d) for i in range(4)]
+    for v in vs:
+        dcop.add_variable(v)
+    for k in range(3):
+        dcop.add_constraint(NAryMatrixRelation(
+            [vs[k], vs[k + 1]],
+            rng.integers(0, 10, size=(3, 3)).astype(float),
+            f"c{k}"))
+    dcop.add_agents([AgentDef("a0")])
+    body = json.dumps({
+        "dcop": dcop_yaml(dcop),
+        "params": {"max_cycles": 50},
+        "wait": True,
+    }).encode()
+
+    handle = api.serve(port=0, replicas=2, batch_window_s=0.01,
+                       heartbeat_s=0.25)
+    try:
+        router = handle.router
+        parts = urlsplit(handle.url)
+        host, port = parts.hostname, parts.port
+
+        def hop() -> None:
+            status, _ctype, _payload = netfault.exchange(
+                "perf-client", "router", host, port,
+                "POST", "/solve", body=body, timeout=60.0)
+            assert status in (200, 202), \
+                f"routed solve hop answered {status}"
+
+        def timed() -> float:
+            t0 = time.perf_counter()
+            for _ in range(20):
+                hop()
+            return time.perf_counter() - t0
+
+        router.set_fleet_trace(True)
+        hop()    # compile the structure on first delivery
+        timed()  # warm the routed socket path, outside the clock
+        ratio = float("inf")
+        t_off = t_on = None
+        for _ in range(4):
+            offs, ons = [], []
+            for _rep in range(4):
+                router.set_fleet_trace(False)
+                offs.append(timed())
+                router.set_fleet_trace(True)
+                ons.append(timed())
+            t_off, t_on = min(offs), min(ons)
+            ratio = min(ratio, t_on / t_off)
+            if ratio <= MAX_FLEETTRACE_OVERHEAD:
+                break
+
+        status, _ctype, payload = netfault.exchange(
+            "perf-client", "router", host, port,
+            "GET", "/fleet/profile", timeout=30.0)
+        assert status == 200, f"/fleet/profile answered {status}"
+        ledger = json.loads(payload)["ledger"]
+        total = max(float(ledger.get("total_s") or 0.0), 1e-9)
+        unacct = abs(float(ledger.get("unaccounted_abs_s") or 0.0))
+        assert unacct <= 0.05 * total, (
+            f"pooled ledger no longer sums with tracing on: "
+            f"|unaccounted| {unacct:.4f}s > 5% of {total:.4f}s")
+    finally:
+        handle.stop()
+    assert ratio <= MAX_FLEETTRACE_OVERHEAD, (
+        f"fleet tracing costs {(ratio - 1) * 100:.1f}% on the routed "
+        f"serving hop (budget "
+        f"{(MAX_FLEETTRACE_OVERHEAD - 1) * 100:.0f}%): off "
+        f"{t_off * 1e3:.0f}ms -> on {t_on * 1e3:.0f}ms")
+    return {"off_ms": round(t_off * 1e3, 1),
+            "on_ms": round(t_on * 1e3, 1),
+            "overhead": round(ratio - 1, 4),
+            "ledger_unaccounted_s": round(unacct, 4)}
+
+
 CEC_MIN_SPEEDUP = 1.2
 CEC_N_VARS = 60
 CEC_DOMAIN = 8
@@ -837,6 +940,7 @@ def main() -> int:
         ("flight_overhead", check_flight_overhead),
         ("efficiency_overhead", check_efficiency_overhead),
         ("netfault_overhead", check_netfault_overhead),
+        ("fleettrace_overhead", check_fleettrace_overhead),
         ("cec", check_cec),
         ("pipelining", check_pipelining),
     ):

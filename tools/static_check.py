@@ -62,6 +62,68 @@ def check_netfault_seam() -> int:
     return 0
 
 
+def _call_sites(src: str, needle: str):
+    """Yield (lineno, full_call_text) for every ``needle(`` call in
+    ``src`` with balanced-paren capture (calls span lines).  ``def
+    needle(`` definitions are skipped — the lint is about callers."""
+    lines = src.splitlines()
+    i = 0
+    while i < len(lines):
+        code = lines[i].split("#", 1)[0]
+        col = code.find(needle + "(")
+        if col < 0 or code.lstrip().startswith("def "):
+            i += 1
+            continue
+        depth, j, text = 0, i, []
+        pos = col + len(needle)
+        while j < len(lines):
+            chunk = lines[j].split("#", 1)[0]
+            seg = chunk[pos:] if j == i else chunk
+            for ch in seg:
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+            text.append(seg)
+            if depth <= 0 and j >= i:
+                break
+            pos = 0
+            j += 1
+        yield i + 1, "\n".join(text)
+        i = j + 1
+
+
+def check_trace_seam() -> int:
+    """Fleet-trace context seam (ISSUE 20): every router-side
+    ``_forward(``/``open_stream(`` call site must DECIDE about trace
+    context explicitly — ``trace=`` (``headers=`` for streams), even
+    if the decision is ``trace=None`` (telemetry-plane probes).  A
+    forward without the kwarg is a causal-tree hole: the replica
+    would mint a fresh trace_id and the hop vanishes from
+    ``/fleet/forensics``."""
+    bad = []
+    for fname in ("router.py", "migration.py"):
+        path = os.path.join(REPO, "pydcop_tpu", "serving", fname)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for lineno, call in _call_sites(src, "_forward"):
+            if "trace=" not in call:
+                bad.append((fname, lineno, "_forward", "trace="))
+        for lineno, call in _call_sites(src, "open_stream"):
+            if "headers=" not in call:
+                bad.append((fname, lineno, "open_stream", "headers="))
+    if bad:
+        print("static_check: router forwarding call sites must "
+              "attach trace context explicitly (trace=ctx, or "
+              "trace=None for telemetry-plane probes) — see "
+              "docs/observability.md \"Fleet tracing\":")
+        for fname, lineno, fn, kwarg in bad:
+            print(f"  pydcop_tpu/serving/{fname}:{lineno}: "
+                  f"{fn}(...) without {kwarg}")
+        return 1
+    return 0
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -76,6 +138,9 @@ def main() -> int:
         return 1
 
     if check_netfault_seam():
+        return 1
+
+    if check_trace_seam():
         return 1
 
     import pydcop_tpu
